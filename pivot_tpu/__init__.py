@@ -1,0 +1,27 @@
+"""pivot_tpu — a TPU-native cost-aware DAG-scheduling simulation framework.
+
+A brand-new framework with the capabilities of the PIVOT scheduling simulator
+(dcvan24/pivot-scheduling): a discrete-event simulator for cost-aware placement
+of DAG-structured, data-intensive container workloads on simulated cross-cloud
+infrastructure, driven by Alibaba 2018 cluster-trace jobs.
+
+Architecture (TPU-first, see SURVEY.md §7; modules land incrementally —
+check the tree for what has shipped so far):
+  - ``des``        : a minimal deterministic discrete-event kernel (CPU).
+  - ``workload``   : Application / TaskGroup / Task DAG model + generators +
+                     the Alibaba trace loader.
+  - ``infra``      : simulated cross-cloud fabric — hosts, zone-local storage,
+                     chunked fair-share network routes, and the zone×zone
+                     bandwidth / egress-cost matrices kept as dense arrays.
+  - ``sched``      : two-level scheduler runtime and placement policies, each
+                     available in ``naive`` (reference-faithful Python
+                     baseline), ``numpy`` (vectorized) and ``tpu`` (fused JAX
+                     kernel) modes.
+  - ``ops``        : the fused fit/score/argmin placement kernels (jit/vmap/
+                     lax.scan, optional Pallas).
+  - ``parallel``   : device meshes, sharded ensemble scheduling, Monte-Carlo
+                     rollouts.
+  - ``experiments``: experiment drivers, CLI, plots, trace sampler.
+"""
+
+__version__ = "0.1.0"

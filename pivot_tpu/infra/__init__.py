@@ -1,0 +1,392 @@
+"""Simulated cross-cloud infrastructure: hosts, storage, cluster, executor.
+
+Capability parity with the reference's ``resources/__init__.py``:
+
+  * ``HostResource``  — 4-dim (cpus, mem, disk, gpus) capacity vector with
+    all-or-nothing admission (ref ``:370-461``).  The reference guards four
+    SimPy Containers with a mutex because its ``subscribe`` yields between
+    checks; here admission is a single synchronous check-and-reserve, atomic
+    by cooperative scheduling — same observable semantics, no locks.
+  * ``Host.execute``  — the executor hot path (ref ``:244-314``): admit →
+    meter check-in → pull predecessor outputs over the network fabric
+    (with per-instance input sampling) → barrier → timed compute → release.
+  * ``Cluster``       — the scheduler↔executor broker with the
+    ``dispatch_q`` / ``notify_q`` queue pair (ref ``:40,119-135``) — the
+    plugin boundary of the whole framework.
+
+Redesigns (TPU-first):
+  * **Lazy routes**: the reference pre-creates O(N²) route objects + one
+    SimPy process each (``resources/gen.py:61-73``); here routes materialize
+    on first use from the dense zone matrices.  An idle pair costs nothing.
+  * **Dense state exports**: ``availability_matrix()`` ([H,4] f32) and
+    ``host_zone_vector()`` ([H] i32) feed the placement kernels directly.
+  * ``clone()`` re-derives *all* route bandwidth from zone metadata and
+    meters every route, matching the reference's clone behavior
+    (``resources/__init__.py:110-117`` — note this intentionally replaces
+    generator-assigned self-route bandwidth with the intra-zone value, a
+    reference quirk we preserve since every experiment runs on a clone).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pivot_tpu.des import Environment, Store
+from pivot_tpu.infra.locality import Locality, ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.infra.network import Route
+from pivot_tpu.utils import LogMixin, fresh_id
+from pivot_tpu.workload import Task
+
+__all__ = [
+    "Node",
+    "Host",
+    "HostResource",
+    "Storage",
+    "Cluster",
+    "LOCAL_BW",
+]
+
+#: Same-host loopback bandwidth in Mbps (ref ``resources/gen.py:13``).
+LOCAL_BW = 2e5
+
+RESOURCE_DIMS = ("cpus", "mem", "disk", "gpus")
+
+
+class Node(LogMixin):
+    """A network endpoint with a locality."""
+
+    def __init__(self, env: Environment, locality: Locality, id: Optional[str] = None):
+        self.env = env
+        self.id = str(id) if id is not None else fresh_id(type(self).__name__.lower())
+        self.locality = locality
+        self.cluster: Optional["Cluster"] = None
+
+    def __repr__(self) -> str:
+        return self.id
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Node) and self.id == other.id
+
+
+class HostResource:
+    """Multi-dimensional host capacity with atomic acquire/release."""
+
+    __slots__ = ("totals", "available")
+
+    def __init__(self, cpus: float, mem: float, disk: float, gpus: float):
+        self.totals = np.array([cpus, mem, disk, gpus], dtype=np.float64)
+        self.available = self.totals.copy()
+
+    @property
+    def used(self) -> np.ndarray:
+        return self.totals - self.available
+
+    def try_acquire(self, demand: np.ndarray) -> bool:
+        """All-or-nothing admission (ref ``subscribe``, ``:433-449``)."""
+        if np.any(demand < 0) or np.any(demand > self.available):
+            return False
+        self.available -= demand
+        return True
+
+    def release(self, demand: np.ndarray) -> None:
+        """Refund, clamped per-dimension (ref ``unsubscribe``, ``:451-461``)."""
+        used = self.used
+        refund = np.where((demand > 0) & (demand <= used), demand, 0.0)
+        self.available += refund
+
+
+class Storage(Node):
+    """Zone-local object store — anchor for cost-aware grouping."""
+
+    def clone(self, env: Environment) -> "Storage":
+        return Storage(env, self.locality, id=self.id)
+
+
+class Host(Node):
+    """A simulated machine: admission control, data staging, timed compute."""
+
+    def __init__(
+        self,
+        env: Environment,
+        cpus: float,
+        mem: float,
+        disk: float,
+        gpus: float,
+        locality: Locality,
+        meter: Optional[Meter] = None,
+        id: Optional[str] = None,
+    ):
+        super().__init__(env, locality, id)
+        self.resource = HostResource(cpus, mem, disk, gpus)
+        self.meter = meter
+        self._tasks: set = set()
+
+    @property
+    def tasks(self) -> List[Task]:
+        return list(self._tasks)
+
+    @property
+    def n_tasks(self) -> int:
+        return len(self._tasks)
+
+    @property
+    def in_use(self) -> bool:
+        return bool(self._tasks)
+
+    def clone(self, env: Environment, meter: Optional[Meter]) -> "Host":
+        t = self.resource.totals
+        return Host(env, t[0], t[1], t[2], t[3], self.locality, meter, id=self.id)
+
+    def execute(self, task: Task):
+        """Generator process: run one task on this host (ref ``:244-314``)."""
+        env, meter, cluster = self.env, self.meter, self.cluster
+        demand = task.demand
+        if not self.resource.try_acquire(demand):
+            avail = self.resource.available
+            for dim, name in enumerate(RESOURCE_DIMS):
+                if demand[dim] > avail[dim]:
+                    self.logger.debug(
+                        "[%.3f] %s demand %.3f > available %.3f on %s",
+                        env.now,
+                        name,
+                        demand[dim],
+                        avail[dim],
+                        self.id,
+                    )
+            return False
+
+        self._tasks.add(task)
+        if meter:
+            meter.host_check_in(self)
+        task.set_running()
+
+        # Stage input data from predecessor task outputs.
+        pull_start = env.now
+        preds = self._sample_predecessor_inputs(task)
+        if preds:
+            done_events = []
+            for p in preds:
+                route = cluster.get_route(p.placement, self.id)
+                done_events.append(route.send(p.output_size))
+            yield env.all_of(done_events)
+            if meter:
+                self._record_transfer(task, preds, pull_start)
+
+        # Timed compute.
+        self.logger.debug(
+            "[%.3f] task %s starts on %s, etc %.3f", env.now, task.id, self.id, task.runtime
+        )
+        yield env.timeout(task.runtime)
+
+        self.resource.release(demand)
+        self._tasks.discard(task)
+        if meter:
+            meter.host_check_out(self)
+        return True
+
+    def _sample_predecessor_inputs(self, task: Task) -> List[Task]:
+        """Predecessor tasks to pull from, sampled per instance count.
+
+        A group with n replicas pulls from ~1/n of each predecessor group's
+        tasks (with replacement), mirroring ref ``:263-267``.
+        """
+        group = task.group
+        app = group.application
+        rng = self.cluster.rng
+        sampled: List[Task] = []
+        for pred_group in app.get_predecessors(group.id):
+            if pred_group.output_size <= 0:
+                continue
+            ptasks = pred_group.tasks
+            if not ptasks:
+                continue
+            if group.instances > 1:
+                k = max(round(len(ptasks) / group.instances), 1)
+                idx = rng.integers(0, len(ptasks), size=k)
+                sampled.extend(ptasks[i] for i in idx)
+            else:
+                sampled.extend(ptasks)
+        return sampled
+
+    def _record_transfer(self, task: Task, preds: List[Task], pull_start: float) -> None:
+        env, cluster, meter = self.env, self.cluster, self.meter
+        meta = cluster.meta
+        bws, costs, prop_delays = [], [], []
+        sources = set()
+        for p in preds:
+            p_host = cluster.get_host(p.placement)
+            route = cluster.get_route(p_host.id, self.id)
+            bws.append(route.bw)
+            costs.append(meta.cost(p_host.locality, self.locality))
+            prop_delays.append(p.output_size / route.bw if route.bw > 0 else 0.0)
+            sources.add(p_host.locality)
+        total_amt = sum(p.output_size for p in preds)
+        total_delay = env.now - pull_start
+        if meter:
+            meter.add_data_transfer(
+                env.now,
+                sources,
+                self.locality,
+                total_amt,
+                total_delay,
+                max(prop_delays),
+                float(np.mean(bws)),
+                float(np.mean(costs)),
+            )
+
+
+class Cluster(LogMixin):
+    """The simulated fabric and the scheduler↔executor message broker."""
+
+    def __init__(
+        self,
+        env: Environment,
+        hosts: Sequence[Host] = (),
+        storage: Sequence[Storage] = (),
+        meta: Optional[ResourceMetadata] = None,
+        meter: Optional[Meter] = None,
+        route_mode: str = "local",
+        seed: Optional[int] = None,
+    ):
+        """``route_mode``: 'local' gives same-host loopback routes LOCAL_BW
+        and meters only host↔storage pairs (generator behavior, ref
+        ``resources/gen.py:61-73``); 'meta' derives every route from zone
+        metadata and meters all routes (clone behavior, ref ``:110-117``).
+        """
+        if route_mode not in ("local", "meta"):
+            raise ValueError(f"unknown route_mode {route_mode!r}")
+        self.env = env
+        self.meta = meta if meta is not None else ResourceMetadata()
+        self.meter = meter
+        self.route_mode = route_mode
+        self.seed = seed
+        self.rng = np.random.default_rng(seed)
+        self._hosts: Dict[str, Host] = {}
+        self._host_list: List[Host] = []
+        self._storage: Dict[str, Storage] = {}
+        self._storage_by_locality: Dict[Locality, Storage] = {}
+        self._routes: Dict[Tuple[str, str], Route] = {}
+        for h in hosts:
+            self.add_host(h)
+        for s in storage:
+            self.add_storage(s)
+        self.dispatch_q = Store(env)
+        self.notify_q = Store(env)
+
+    # -- membership ------------------------------------------------------
+    @property
+    def hosts(self) -> List[Host]:
+        return list(self._host_list)
+
+    @property
+    def storage(self) -> List[Storage]:
+        return list(self._storage.values())
+
+    def add_host(self, host: Host) -> None:
+        if host.id in self._hosts:
+            raise ValueError(f"host {host.id!r} already exists")
+        host.cluster = self
+        self._hosts[host.id] = host
+        self._host_list.append(host)
+
+    def add_storage(self, storage: Storage) -> None:
+        storage.cluster = self
+        self._storage[storage.id] = storage
+        self._storage_by_locality[storage.locality] = storage
+
+    def get_host(self, hid: str) -> Optional[Host]:
+        return self._hosts.get(hid)
+
+    def get_storage(self, sid: str) -> Optional[Storage]:
+        return self._storage.get(sid)
+
+    def get_storage_by_locality(self, locality: Locality) -> Optional[Storage]:
+        return self._storage_by_locality.get(locality)
+
+    def _node(self, nid: str) -> Node:
+        node = self._hosts.get(nid) or self._storage.get(nid)
+        if node is None:
+            raise KeyError(f"unknown node {nid!r}")
+        return node
+
+    def get_route(self, src_id: str, dst_id: str) -> Route:
+        """Lazily materialize the directed route between two nodes."""
+        key = (str(src_id), str(dst_id))
+        route = self._routes.get(key)
+        if route is None:
+            src, dst = self._node(key[0]), self._node(key[1])
+            if self.route_mode == "local" and src.id == dst.id:
+                bw = LOCAL_BW
+            else:
+                bw = self.meta.bw(src.locality, dst.locality)
+            if self.route_mode == "meta":
+                metered = self.meter
+            else:
+                host_storage_pair = (
+                    isinstance(src, Host) and isinstance(dst, Storage)
+                ) or (isinstance(src, Storage) and isinstance(dst, Host))
+                metered = self.meter if host_storage_pair else None
+            route = Route(self.env, src, dst, bw, meter=metered)
+            self._routes[key] = route
+        return route
+
+    # -- lifecycle -------------------------------------------------------
+    def clone(
+        self, env: Environment, meter: Optional[Meter], seed: Optional[int] = None
+    ) -> "Cluster":
+        hosts = [h.clone(env, meter) for h in self._host_list]
+        storage = [s.clone(env) for s in self._storage.values()]
+        return Cluster(
+            env,
+            hosts=hosts,
+            storage=storage,
+            meta=self.meta,
+            meter=meter,
+            route_mode="meta",
+            seed=self.seed if seed is None else seed,
+        )
+
+    def start(self) -> None:
+        self.env.process(self._dispatch_loop())
+
+    def _dispatch_loop(self):
+        while True:
+            task = yield self.dispatch_q.get()
+            if not isinstance(task, Task):
+                self.logger.error("dispatched non-task item: %r", task)
+                continue
+            host = self._hosts.get(task.placement)
+            if host is None:
+                self.logger.error("unrecognized host %r", task.placement)
+                continue
+            self.env.process(self._execute_task(task, host))
+
+    def _execute_task(self, task: Task, host: Host):
+        success = yield self.env.process(host.execute(task))
+        yield self.notify_q.put((success, task))
+
+    # -- dense exports for the decision kernels --------------------------
+    def availability_matrix(self, dtype=np.float64) -> np.ndarray:
+        """[H, 4] current per-host availability snapshot."""
+        return np.stack([h.resource.available for h in self._host_list]).astype(
+            dtype, copy=False
+        )
+
+    def totals_matrix(self, dtype=np.float64) -> np.ndarray:
+        return np.stack([h.resource.totals for h in self._host_list]).astype(
+            dtype, copy=False
+        )
+
+    def host_zone_vector(self) -> np.ndarray:
+        """[H] int32 zone index per host."""
+        return self.meta.zone_vector([h.locality for h in self._host_list])
+
+    def storage_zone_vector(self) -> np.ndarray:
+        """[S] int32 zone index per storage node."""
+        return self.meta.zone_vector([s.locality for s in self.storage])

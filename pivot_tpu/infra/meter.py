@@ -1,0 +1,237 @@
+"""Metrics / telemetry subsystem.
+
+Capability parity with the reference ``Meter`` (``resources/meter.py:13-187``):
+host busy-interval tracking with merging, per-route per-chunk service logs,
+per-task data-transfer records, scheduling-op counts, and the derived
+metrics — cumulative instance hours, total network traffic (egress) cost,
+average congestion delay — serialized as the same four JSON files
+(``general.json`` / ``transfers.json`` / ``scheduler.json`` /
+``host_usage.json``, ref ``resources/meter.py:108-133``).
+
+Additions over the reference: wall-clock + decisions/sec counters for the
+BENCH harness, and ``summary()`` returning everything as a dict without
+touching disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List
+
+import numpy as np
+
+from pivot_tpu.utils import LogMixin, ceil_bucket, floor_bucket
+
+__all__ = ["Meter"]
+
+
+class Meter(LogMixin):
+    def __init__(self, env, meta):
+        self.env = env
+        self.meta = meta
+        # host -> list of [start] / [start, end] busy intervals
+        self._host_intervals: Dict[object, List[list]] = defaultdict(list)
+        # route -> transfer_id -> list of [start, end, chunk_mb] service slots
+        self._route_slots: Dict[object, Dict[str, List[list]]] = defaultdict(dict)
+        # resource dim -> host -> [(t, normalized usage)]
+        self._usage: Dict[str, Dict[object, list]] = defaultdict(dict)
+        self._data_transfers: List[dict] = []
+        self._sched_turnovers: List[float] = []
+        self._n_sched_ops = 0
+        self._wall_start = time.perf_counter()
+
+    # -- derived metrics -------------------------------------------------
+    @property
+    def runtime(self) -> float:
+        return self.env.now
+
+    @property
+    def wall_clock(self) -> float:
+        return time.perf_counter() - self._wall_start
+
+    @property
+    def total_scheduling_ops(self) -> int:
+        return self._n_sched_ops
+
+    @property
+    def cumulative_instance_hours(self) -> float:
+        total = 0.0
+        for intervals in self._host_intervals.values():
+            for iv in intervals:
+                if len(iv) == 2:
+                    total += iv[1] - iv[0]
+        return total / 3600.0
+
+    @property
+    def total_network_traffic_cost(self) -> float:
+        """$ egress over all metered routes (ref ``meter.py:34-41``)."""
+        cost = 0.0
+        for route, transfers in self._route_slots.items():
+            size = sum(
+                slot[2]
+                for slots in transfers.values()
+                for slot in slots
+                if len(slot) == 3
+            )
+            cost += self.meta.calc_network_traffic_cost(
+                route.src.locality, route.dst.locality, size
+            )
+        return cost
+
+    @property
+    def average_congestion_delay(self) -> float:
+        """Mean gap between consecutive service slots of a transfer."""
+        delay, n = 0.0, 0
+        for transfers in self._route_slots.values():
+            n += len(transfers)
+            for slots in transfers.values():
+                for i in range(1, len(slots)):
+                    delay += slots[i][0] - slots[i - 1][1]
+        return delay / n if n else 0.0
+
+    # -- recording hooks -------------------------------------------------
+    def host_check_in(self, host) -> None:
+        intervals = self._host_intervals[host]
+        self._track_resource_usage(host)
+        now = self.env.now
+        last = intervals[-1] if intervals else None
+        if last is None:
+            intervals.append([now])
+        elif len(last) == 2:
+            if now > last[-1]:
+                intervals.append([now])
+            else:
+                last.pop()  # reopen the touching interval (merge)
+
+    def host_check_out(self, host) -> None:
+        intervals = self._host_intervals[host]
+        self._track_resource_usage(host)
+        now = self.env.now
+        if not intervals:
+            raise RuntimeError("host check-out before any check-in")
+        last = intervals[-1]
+        if len(last) == 1:
+            last.append(now)
+        elif now > last[-1]:
+            last[-1] = now
+
+    def route_check_in(self, route, transfer_id: str) -> None:
+        self._route_slots[route].setdefault(transfer_id, []).append([self.env.now])
+
+    def route_check_out(self, route, transfer_id: str, chunk_mb: float) -> None:
+        self._route_slots[route][transfer_id][-1] += [self.env.now, chunk_mb]
+
+    def add_data_transfer(
+        self,
+        timepoint: float,
+        sources,
+        dst,
+        data_amt: float,
+        total_delay: float,
+        prop_delay: float,
+        avg_bw: float,
+        avg_egress_cost: float,
+    ) -> None:
+        self._data_transfers.append(
+            {
+                "timestamp": timepoint,
+                "from": [[s.cloud, s.region, s.zone] for s in sources],
+                "to": [dst.cloud, dst.region, dst.zone],
+                "data_amt": data_amt,
+                "total_delay": total_delay,
+                "propagation_delay": prop_delay,
+                "avg_bw": avg_bw,
+                "avg_egress_cost": avg_egress_cost,
+            }
+        )
+
+    def add_scheduling_turnover(self, timepoint: float) -> None:
+        self._sched_turnovers.append(timepoint)
+
+    def increment_scheduling_ops(self, n_ops: int) -> None:
+        self._n_sched_ops += n_ops
+
+    def _track_resource_usage(self, host) -> None:
+        now, res = self.env.now, host.resource
+        used, total = res.used, res.totals
+        names = ("cpus", "mem", "disk", "gpus")
+        for dim, name in enumerate(names):
+            frac = used[dim] / total[dim] if total[dim] > 0 else 0.0
+            self._usage[name].setdefault(host, []).append((now, frac))
+
+    # -- aggregation / persistence ---------------------------------------
+    def host_usage_curve(self, sample_size: float = 100.0):
+        """Time-bucketed count of busy hosts (ref ``plot_host_usage``)."""
+        counter: Dict[tuple, set] = {}
+        for host, intervals in self._host_intervals.items():
+            for iv in intervals:
+                if len(iv) != 2:
+                    continue
+                start = floor_bucket(iv[0], sample_size)
+                end = ceil_bucket(iv[1], sample_size)
+                cur = min(start + sample_size, end)
+                while cur < end:
+                    counter.setdefault((cur - sample_size, cur), set()).add(host)
+                    cur += sample_size
+        x = sorted(counter)
+        return x, [len(counter[k]) for k in x]
+
+    def resource_usage_curve(self, resource: str, sample_size: float = 100.0):
+        """Time-bucketed mean normalized utilization of one dimension."""
+        counter: Dict[float, Dict[object, list]] = {}
+        for host, recs in self._usage.get(resource, {}).items():
+            for t, amt in recs:
+                counter.setdefault(floor_bucket(t, sample_size), {}).setdefault(
+                    host, []
+                ).append(amt)
+        x = sorted(counter)
+        y = [
+            float(np.mean([np.mean(v) for v in counter[t].values()])) for t in x
+        ]
+        return x, y
+
+    def avg_host_usage(self, sample_size: float = 100.0) -> float:
+        _, counts = self.host_usage_curve(sample_size)
+        return float(np.mean(counts)) if counts else 0.0
+
+    def avg_resource_usage(self, resource: str, sample_size: float = 100.0) -> float:
+        _, vals = self.resource_usage_curve(resource, sample_size)
+        return float(np.mean(vals)) if vals else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "egress_cost": self.total_network_traffic_cost,
+            "cum_instance_hours": self.cumulative_instance_hours,
+            "avg_congestion_delay": self.average_congestion_delay,
+            "total_scheduling_ops": self._n_sched_ops,
+            "sim_time": self.runtime,
+            "wall_clock": self.wall_clock,
+        }
+
+    def save(self, data_dir: str) -> None:
+        """Write the reference-compatible four-file JSON layout."""
+        os.makedirs(data_dir, exist_ok=True)
+        with open(os.path.join(data_dir, "general.json"), "w") as f:
+            json.dump(
+                {
+                    "egress_cost": self.total_network_traffic_cost,
+                    "cum_instance_hours": self.cumulative_instance_hours,
+                },
+                f,
+            )
+        with open(os.path.join(data_dir, "transfers.json"), "w") as f:
+            json.dump(self._data_transfers, f)
+        with open(os.path.join(data_dir, "scheduler.json"), "w") as f:
+            json.dump(
+                {
+                    "turnovers": self._sched_turnovers,
+                    "total_scheduling_ops": self._n_sched_ops,
+                },
+                f,
+            )
+        with open(os.path.join(data_dir, "host_usage.json"), "w") as f:
+            x, y = self.host_usage_curve()
+            json.dump({"timestamps": x, "n_hosts": y}, f)

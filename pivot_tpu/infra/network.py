@@ -1,0 +1,105 @@
+"""Simulated network fabric: per-route chunked fair-share packet service.
+
+Behavioral parity with the reference's ``NetworkRoute``/``Packet``
+(``resources/network.py:10-103``):
+
+  * A transfer is served one ``CHUNK_MB``-sized chunk at a time at
+    ``chunk / bw`` sim-seconds per chunk; an unfinished transfer re-enters
+    the tail of the queue after each chunk, so concurrent transfers share
+    the route round-robin and **congestion emerges** from queueing.
+  * ``realtime_bw`` estimates effective bandwidth as ``bw / (queued_mb + 1)``
+    (ref ``resources/network.py:70-73``).
+
+Redesign (the reference spawns one SimPy generator process per route —
+~360k processes for a 600-host all-pairs fabric): a ``Route`` here is a
+**passive service**: it keeps a deque and schedules bare completion
+callbacks on the event kernel only while transfers are in flight.  Routes
+are also created lazily by the cluster, so an idle pair costs nothing.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+from pivot_tpu.des import Environment, Event
+from pivot_tpu.utils import LogMixin, fresh_id
+
+__all__ = ["Route", "Transfer", "CHUNK_MB"]
+
+#: Chunk granularity in MB (ref ``Packet.PACKET_SIZE``, network.py:12).
+CHUNK_MB = 1000.0
+
+
+class Transfer:
+    """An in-flight data transfer on one route."""
+
+    __slots__ = ("id", "remaining_mb", "done")
+
+    def __init__(self, size_mb: float, done: Event):
+        if size_mb <= 0:
+            raise ValueError(f"transfer size must be > 0, got {size_mb}")
+        self.id = fresh_id("xfer")
+        self.remaining_mb = float(size_mb)
+        self.done = done
+
+
+class Route(LogMixin):
+    """A directed (src, dst) link with FIFO round-robin chunk service."""
+
+    __slots__ = ("env", "src", "dst", "bw", "meter", "_queue", "_busy")
+
+    def __init__(self, env: Environment, src, dst, bw: float, meter=None):
+        self.env = env
+        self.src = src
+        self.dst = dst
+        self.bw = float(bw)
+        self.meter = meter
+        self._queue: deque = deque()
+        self._busy = False
+
+    @property
+    def queued_mb(self) -> float:
+        """MB waiting in queue (excludes the chunk currently in service)."""
+        return sum(t.remaining_mb for t in self._queue)
+
+    @property
+    def realtime_bw(self) -> float:
+        """Congestion-discounted bandwidth estimate (ref network.py:70-73)."""
+        return self.bw / (self.queued_mb + 1.0)
+
+    def send(self, size_mb: float, done: Optional[Event] = None) -> Event:
+        """Enqueue a transfer; returns the completion event."""
+        if done is None:
+            done = self.env.event()
+        self._queue.append(Transfer(size_mb, done))
+        if not self._busy:
+            self._serve_next()
+        return done
+
+    def _serve_next(self) -> None:
+        if not self._queue:
+            self._busy = False
+            return
+        self._busy = True
+        transfer = self._queue.popleft()
+        chunk = min(transfer.remaining_mb, CHUNK_MB)
+        if self.meter:
+            self.meter.route_check_in(self, transfer.id)
+        service_time = chunk / self.bw if self.bw > 0 else 0.0
+        self.env.schedule_callback(
+            service_time, lambda: self._finish_chunk(transfer, chunk)
+        )
+
+    def _finish_chunk(self, transfer: Transfer, chunk: float) -> None:
+        if self.meter:
+            self.meter.route_check_out(self, transfer.id, chunk)
+        transfer.remaining_mb -= chunk
+        if transfer.remaining_mb <= 0:
+            transfer.done.succeed()
+        else:
+            self._queue.append(transfer)  # round-robin fairness
+        self._serve_next()
+
+    def __repr__(self) -> str:
+        return f"Route({self.src.id} -> {self.dst.id} @ {self.bw:.0f} Mbps)"

@@ -1,0 +1,25 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any ``import jax`` in test modules (pytest imports conftest
+first).  Multi-chip sharding is validated on these virtual devices; the real
+TPU chip is only used by ``bench.py``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_ids():
+    from pivot_tpu.utils import reset_ids
+
+    reset_ids()
+    yield

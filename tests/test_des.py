@@ -1,0 +1,175 @@
+"""Unit tests for the discrete-event kernel (pivot_tpu.des)."""
+
+import pytest
+
+from pivot_tpu.des import Environment, SimError
+
+
+def test_timeout_ordering():
+    env = Environment()
+    log = []
+
+    def proc(delay, tag):
+        yield env.timeout(delay)
+        log.append((env.now, tag))
+
+    env.process(proc(5, "a"))
+    env.process(proc(1, "b"))
+    env.process(proc(3, "c"))
+    env.run()
+    assert log == [(1, "b"), (3, "c"), (5, "a")]
+
+
+def test_same_time_fifo_order():
+    """Events at equal (time, priority) run in scheduling order."""
+    env = Environment()
+    log = []
+
+    def proc(tag):
+        yield env.timeout(2)
+        log.append(tag)
+
+    for tag in "abcde":
+        env.process(proc(tag))
+    env.run()
+    assert log == list("abcde")
+
+
+def test_process_return_value_and_chaining():
+    env = Environment()
+    result = []
+
+    def child():
+        yield env.timeout(4)
+        return 42
+
+    def parent():
+        value = yield env.process(child())
+        result.append((env.now, value))
+
+    env.process(parent())
+    env.run()
+    assert result == [(4, 42)]
+
+
+def test_store_fifo_blocking_get():
+    env = Environment()
+    store = env.store()
+    got = []
+
+    def consumer():
+        while True:
+            item = yield store.get()
+            got.append((env.now, item))
+            if item == "stop":
+                return
+
+    def producer():
+        store.put("x")
+        yield env.timeout(10)
+        store.put("y")
+        store.put("stop")
+
+    env.process(consumer())
+    env.process(producer())
+    env.run()
+    assert got == [(0, "x"), (10, "y"), (10, "stop")]
+
+
+def test_store_multiple_getters_fifo():
+    env = Environment()
+    store = env.store()
+    got = []
+
+    def consumer(tag):
+        item = yield store.get()
+        got.append((tag, item))
+
+    env.process(consumer("first"))
+    env.process(consumer("second"))
+
+    def producer():
+        yield env.timeout(1)
+        store.put(1)
+        yield env.timeout(1)
+        store.put(2)
+
+    env.process(producer())
+    env.run()
+    assert got == [("first", 1), ("second", 2)]
+
+
+def test_all_of_barrier():
+    env = Environment()
+    done = []
+
+    def waiter():
+        evts = [env.timeout(d, value=d) for d in (3, 1, 7)]
+        values = yield env.all_of(evts)
+        done.append((env.now, values))
+
+    env.process(waiter())
+    env.run()
+    assert done == [(7, [3, 1, 7])]
+
+
+def test_all_of_empty():
+    env = Environment()
+    done = []
+
+    def waiter():
+        yield env.all_of([])
+        done.append(env.now)
+
+    env.process(waiter())
+    env.run()
+    assert done == [0]
+
+
+def test_run_until():
+    env = Environment()
+    log = []
+
+    def ticker():
+        while True:
+            yield env.timeout(10)
+            log.append(env.now)
+
+    env.process(ticker())
+    env.run(until=35)
+    assert log == [10, 20, 30]
+    assert env.now == 35
+
+
+def test_schedule_callback_passive_service():
+    env = Environment()
+    log = []
+    env.schedule_callback(5, lambda: log.append(env.now))
+    env.schedule_callback(2, lambda: log.append(env.now))
+    env.run()
+    assert log == [2, 5]
+
+
+def test_negative_delay_raises():
+    env = Environment()
+    with pytest.raises(SimError):
+        env.timeout(-1)
+
+
+def test_determinism_two_runs():
+    def build_and_run():
+        env = Environment()
+        trace = []
+
+        def worker(tag, delays):
+            for d in delays:
+                yield env.timeout(d)
+                trace.append((env.now, tag))
+
+        env.process(worker("a", [1, 1, 1]))
+        env.process(worker("b", [1, 1, 1]))
+        env.process(worker("c", [2, 1]))
+        env.run()
+        return trace
+
+    assert build_and_run() == build_and_run()

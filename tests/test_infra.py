@@ -1,0 +1,168 @@
+"""Infra layer tests: locality matrices, host admission, cluster wiring,
+random cluster generation."""
+
+import numpy as np
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import LOCAL_BW, Cluster, Host, HostResource, Storage
+from pivot_tpu.infra.gen import RandomClusterGenerator
+from pivot_tpu.infra.locality import Locality, ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+
+
+@pytest.fixture(scope="module")
+def meta():
+    return ResourceMetadata(seed=0)
+
+
+def test_metadata_shape(meta):
+    assert meta.n_zones == 31
+    assert meta.cost_matrix.shape == (31, 31)
+    assert meta.bw_matrix.shape == (31, 31)
+    # Every directed pair is populated (121 region pairs cover all zones).
+    assert np.all(meta.bw_matrix > 0)
+
+
+def test_metadata_intra_region_free(meta):
+    z = Locality("aws", "us-east-1", "a")
+    z2 = Locality("aws", "us-east-1", "b")
+    assert meta.cost(z, z2) == 0
+    # Intra-region bandwidth ~15 Gbps with +-5% jitter.
+    assert 15000 * 0.95 <= meta.bw(z, z2) <= 15000 * 1.05
+
+
+def test_metadata_cross_cloud_cost(meta):
+    aws = Locality("aws", "us-east-1", "a")
+    gcp = Locality("gcp", "us-east1", "b")
+    assert 0.08 <= meta.cost(aws, gcp) <= 0.12
+
+
+def test_metadata_jitter_seeded():
+    a = ResourceMetadata(seed=7)
+    b = ResourceMetadata(seed=7)
+    c = ResourceMetadata(seed=8)
+    assert np.array_equal(a.bw_matrix, b.bw_matrix)
+    assert not np.array_equal(a.bw_matrix, c.bw_matrix)
+    flat = ResourceMetadata(jitter=False)
+    assert flat.bw(
+        Locality("aws", "us-east-1", "a"), Locality("aws", "us-east-1", "b")
+    ) == 15000
+
+
+def test_traffic_cost_units(meta):
+    aws = Locality("aws", "us-east-1", "a")
+    gcp = Locality("gcp", "us-east1", "b")
+    rate = meta.cost(aws, gcp)
+    assert meta.calc_network_traffic_cost(aws, gcp, 8000.0) == pytest.approx(rate)
+
+
+def test_host_resource_admission():
+    r = HostResource(4, 100, 10, 1)
+    assert r.try_acquire(np.array([2.0, 50, 5, 1]))
+    assert not r.try_acquire(np.array([3.0, 10, 1, 0]))  # cpus insufficient
+    assert r.try_acquire(np.array([2.0, 50, 5, 0]))
+    assert np.all(r.available == 0)
+    r.release(np.array([2.0, 50, 5, 1]))
+    assert r.available.tolist() == [2, 50, 5, 1]
+
+
+def test_host_resource_rejects_negative():
+    r = HostResource(4, 100, 10, 1)
+    assert not r.try_acquire(np.array([-1.0, 0, 0, 0]))
+    assert np.all(r.available == r.totals)
+
+
+def test_host_resource_release_clamped():
+    r = HostResource(4, 100, 10, 1)
+    r.try_acquire(np.array([2.0, 0, 0, 0]))
+    # Refund of more than used in a dimension is dropped for that dim.
+    r.release(np.array([3.0, 10, 0, 0]))
+    assert r.available.tolist() == [2, 100, 10, 1]
+
+
+def make_cluster(meta, n_hosts=4, mode="local", meter=None, env=None):
+    env = env or Environment()
+    zones = meta.zones
+    hosts = [
+        Host(env, 16, 1 << 17, 100, 1, locality=zones[i % len(zones)])
+        for i in range(n_hosts)
+    ]
+    storage = [Storage(env, zones[0])]
+    return (
+        Cluster(
+            env,
+            hosts=hosts,
+            storage=storage,
+            meta=meta,
+            meter=meter,
+            route_mode=mode,
+            seed=1,
+        ),
+        env,
+    )
+
+
+def test_cluster_lazy_routes(meta):
+    cluster, _ = make_cluster(meta)
+    h = cluster.hosts
+    assert len(cluster._routes) == 0
+    r = cluster.get_route(h[0].id, h[1].id)
+    assert cluster.get_route(h[0].id, h[1].id) is r
+    assert len(cluster._routes) == 1
+    assert r.bw == meta.bw(h[0].locality, h[1].locality)
+    self_route = cluster.get_route(h[0].id, h[0].id)
+    assert self_route.bw == LOCAL_BW
+
+
+def test_cluster_clone_rederives_routes(meta):
+    cluster, _ = make_cluster(meta)
+    env2 = Environment()
+    meter2 = Meter(env2, meta)
+    clone = cluster.clone(env2, meter2)
+    assert [h.id for h in clone.hosts] == [h.id for h in cluster.hosts]
+    h0 = clone.hosts[0]
+    # Clone quirk preserved: self-routes get zone bandwidth, not LOCAL_BW.
+    self_route = clone.get_route(h0.id, h0.id)
+    assert self_route.bw == meta.bw(h0.locality, h0.locality)
+    # All cloned routes are metered.
+    assert self_route.meter is meter2
+    # Fresh resource state.
+    assert np.all(clone.hosts[0].resource.available == cluster.hosts[0].resource.totals)
+
+
+def test_cluster_dense_exports(meta):
+    cluster, _ = make_cluster(meta, n_hosts=3)
+    avail = cluster.availability_matrix()
+    assert avail.shape == (3, 4)
+    assert avail[0].tolist() == [16, 1 << 17, 100, 1]
+    zones = cluster.host_zone_vector()
+    assert zones.tolist() == [0, 1, 2]
+
+
+def test_random_cluster_generator(meta):
+    env = Environment()
+    gen = RandomClusterGenerator(
+        env, (16, 16), (128 * 1024, 128 * 1024), (100, 100), (1, 1), meta=meta, seed=0
+    )
+    cluster = gen.generate(100)
+    assert len(cluster.hosts) == 100
+    # Round-robin across 31 zones -> 31 distinct localities occupied.
+    occupied = {h.locality for h in cluster.hosts}
+    assert len(occupied) == 31
+    assert len(cluster.storage) == 31
+    assert {s.locality for s in cluster.storage} == occupied
+    shapes = {tuple(h.resource.totals) for h in cluster.hosts}
+    assert shapes == {(16.0, 128 * 1024.0, 100.0, 1.0)}
+
+
+def test_zone_round_robin_balance(meta):
+    env = Environment()
+    gen = RandomClusterGenerator(
+        env, (16, 16), (1024, 1024), (100, 100), (0, 0), meta=meta, seed=0
+    )
+    cluster = gen.generate(62)
+    counts = {}
+    for h in cluster.hosts:
+        counts[h.locality] = counts.get(h.locality, 0) + 1
+    assert set(counts.values()) == {2}  # 62 hosts over 31 zones -> 2 each

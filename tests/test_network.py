@@ -1,0 +1,119 @@
+"""Golden tests of the network fabric: chunked service, round-robin fair
+sharing, and emergent congestion — hand-computed expectations."""
+
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra.locality import Locality, ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.infra.network import CHUNK_MB, Route
+
+
+class FakeNode:
+    def __init__(self, id, locality):
+        self.id = id
+        self.locality = locality
+
+
+def make_route(bw=1000.0, meter=None):
+    env = Environment()
+    a = FakeNode("a", Locality("aws", "us-east-1", "a"))
+    b = FakeNode("b", Locality("aws", "us-east-1", "b"))
+    return Route(env, a, b, bw, meter=meter), env
+
+
+def test_single_transfer_duration():
+    # 2500 MB at 1000 Mbps -> chunks of 1000/1000/500 -> 2.5 sim-seconds.
+    route, env = make_route(bw=1000)
+    done = route.send(2500)
+    times = []
+    done.callbacks.append(lambda _e: times.append(env.now))
+    env.run()
+    assert times == [2.5]
+
+
+def test_small_transfer_single_chunk():
+    route, env = make_route(bw=500)
+    done = route.send(100)
+    times = []
+    done.callbacks.append(lambda _e: times.append(env.now))
+    env.run()
+    assert times == [pytest.approx(0.2)]
+
+
+def test_round_robin_fair_sharing():
+    """Two 2000 MB transfers interleave chunk-by-chunk: both see ~doubled
+    completion time; the first finishes one chunk-service earlier."""
+    route, env = make_route(bw=1000)
+    t1 = route.send(2000)
+    t2 = route.send(2000)
+    finished = {}
+    t1.callbacks.append(lambda _e: finished.setdefault("t1", env.now))
+    t2.callbacks.append(lambda _e: finished.setdefault("t2", env.now))
+    env.run()
+    # Service order: a1 b1 a2 b2 -> t1 done at 3.0, t2 at 4.0.
+    assert finished == {"t1": 3.0, "t2": 4.0}
+
+
+def test_congestion_emerges_vs_isolation():
+    # Solo: 3000 MB @1000 -> 3.0 s. With a competing stream it takes longer.
+    route, env = make_route(bw=1000)
+    solo_done = route.send(3000)
+    route.send(3000)
+    times = []
+    solo_done.callbacks.append(lambda _e: times.append(env.now))
+    env.run()
+    assert times[0] > 3.0
+
+
+def test_realtime_bw_reflects_queue():
+    route, env = make_route(bw=1000)
+    assert route.realtime_bw == 1000
+    route.send(5000)
+    route.send(2000)
+    # First transfer in service (chunk popped); 4000 + 2000 MB queued.
+    env.step()  # process the send completion events
+
+    # Queue holds the second transfer (2000) fully; first has 4000 left but
+    # is re-queued only between chunks.  Just assert monotonic behavior.
+    assert route.realtime_bw < 1000
+
+
+def test_zero_bw_instant():
+    route, env = make_route(bw=0)
+    done = route.send(1000)
+    times = []
+    done.callbacks.append(lambda _e: times.append(env.now))
+    env.run()
+    assert times == [0]
+
+
+def test_meter_records_slots_and_cost():
+    env = Environment()
+    meta = ResourceMetadata(seed=0, jitter=False)
+    meter = Meter(env, meta)
+    aws = FakeNode("h1", Locality("aws", "us-east-1", "a"))
+    gcp = FakeNode("h2", Locality("gcp", "us-east1", "b"))
+    bw = meta.bw(aws.locality, gcp.locality)
+    route = Route(env, aws, gcp, bw, meter=meter)
+    route.send(1600)
+    env.run()
+    rate = meta.cost(aws.locality, gcp.locality)
+    assert meter.total_network_traffic_cost == pytest.approx(rate * 1600 / 8000)
+    # Two service slots (1000 + 600), no gap -> zero congestion delay.
+    assert meter.average_congestion_delay == 0
+
+
+def test_congestion_delay_measured():
+    env = Environment()
+    meta = ResourceMetadata(seed=0, jitter=False)
+    meter = Meter(env, meta)
+    a = FakeNode("x", Locality("aws", "us-east-1", "a"))
+    b = FakeNode("y", Locality("aws", "us-east-1", "b"))
+    route = Route(env, a, b, 1000, meter=meter)
+    route.send(2000)
+    route.send(2000)
+    env.run()
+    # Each transfer's two service slots are separated by the other's chunk
+    # service (1 s each); average gap per transfer = 1 s.
+    assert meter.average_congestion_delay == pytest.approx(1.0)

@@ -1,0 +1,166 @@
+"""Unit tests for the workload DAG model — ports the *intent* of the
+reference's ``test/test_app.py`` (see SURVEY.md §4) plus dense-export checks."""
+
+import numpy as np
+import pytest
+
+from pivot_tpu.workload import Application, DagError, TaskGroup
+from pivot_tpu.workload.gen import (
+    RandomApplicationGenerator,
+    SequentialApplicationGenerator,
+    _RangeSpec,
+    random_dag_edges,
+)
+
+
+def make_chain(n=3, instances=1):
+    groups = [
+        TaskGroup(str(i), cpus=1, mem=1, runtime=10, output_size=5, instances=instances)
+        for i in range(n)
+    ]
+    for i in range(1, n):
+        groups[i].add_dependencies(str(i - 1))
+    return Application("chain", groups)
+
+
+def test_empty_app():
+    app = Application("empty", [])
+    assert app.groups == []
+    assert app.is_finished  # vacuously: no sinks
+
+
+def test_single_group_app():
+    app = Application("one", [TaskGroup("a", cpus=1, mem=1)])
+    assert [g.id for g in app.get_sources()] == ["a"]
+    assert [g.id for g in app.get_sinks()] == ["a"]
+    assert not app.is_finished
+
+
+def test_predecessors_successors():
+    app = make_chain(3)
+    assert [g.id for g in app.get_predecessors("1")] == ["0"]
+    assert [g.id for g in app.get_successors("1")] == ["2"]
+    assert app.get_predecessors("0") == []
+    assert app.get_successors("2") == []
+
+
+def test_cycle_rejected():
+    a = TaskGroup("a", cpus=1, mem=1, dependencies=["b"])
+    b = TaskGroup("b", cpus=1, mem=1, dependencies=["a"])
+    with pytest.raises(DagError):
+        Application("cyclic", [a, b])
+
+
+def test_unknown_dependency_rejected():
+    a = TaskGroup("a", cpus=1, mem=1, dependencies=["ghost"])
+    with pytest.raises(DagError):
+        Application("bad", [a])
+
+
+def test_all_sources_when_no_edges():
+    groups = [TaskGroup(str(i), cpus=1, mem=1) for i in range(4)]
+    app = Application("flat", groups)
+    assert len(app.get_sources()) == 4
+    assert len(app.get_sinks()) == 4
+
+
+def test_readiness_semantics():
+    app = make_chain(3)
+    g0 = app.get_group("0")
+    # Group 1 is not ready until group 0 finishes.
+    assert app.get_unfinished_predecessors("1") == [g0]
+    for t in g0.materialize_tasks():
+        t.set_finished()
+    assert g0.is_finished
+    assert app.get_unfinished_predecessors("1") == []
+    assert [g.id for g in app.get_ready_successors("0")] == ["1"]
+
+
+def test_group_not_finished_without_tasks():
+    g = TaskGroup("g", cpus=1, mem=1)
+    assert not g.is_finished  # no materialized tasks
+
+
+def test_app_finished_only_when_sinks_finish():
+    app = make_chain(2)
+    for gid in ("0", "1"):
+        for t in app.get_group(gid).materialize_tasks():
+            t.set_finished()
+    assert app.is_finished
+
+
+def test_task_identity_and_retry_reset():
+    app = make_chain(1, instances=3)
+    tasks = app.get_group("0").materialize_tasks()
+    assert [t.id for t in tasks] == ["0/0", "0/1", "0/2"]
+    t = tasks[0]
+    t.set_submitted()
+    t.placement = "h1"
+    t.set_nascent()
+    t.placement = None
+    assert t.is_nascent and t.placement is None
+
+
+def test_materialize_idempotent():
+    g = TaskGroup("g", cpus=1, mem=1, instances=4)
+    first = g.materialize_tasks()
+    second = g.materialize_tasks()
+    assert first == second and len(first) == 4
+
+
+def test_clone_is_fresh():
+    app = make_chain(2)
+    for t in app.get_group("0").materialize_tasks():
+        t.set_finished()
+    clone = app.clone()
+    assert clone.id != app.id
+    assert clone.get_group("0").tasks == []  # fresh, no materialized tasks
+    assert [g.id for g in clone.get_sources()] == ["0"]
+
+
+def test_critical_path_runtime():
+    # Diamond: a -> (b, c) -> d, runtimes 1, 5, 2, 10 -> path a,b,d = 16
+    a = TaskGroup("a", cpus=1, mem=1, runtime=1)
+    b = TaskGroup("b", cpus=1, mem=1, runtime=5, dependencies=["a"])
+    c = TaskGroup("c", cpus=1, mem=1, runtime=2, dependencies=["a"])
+    d = TaskGroup("d", cpus=1, mem=1, runtime=10, dependencies=["b", "c"])
+    app = Application("diamond", [a, b, c, d])
+    assert app.critical_path_runtime() == 16
+
+
+def test_dense_exports():
+    app = make_chain(3, instances=2)
+    dm = app.demand_matrix()
+    assert dm.shape == (3, 4) and dm.dtype == np.float32
+    pm = app.pred_matrix()
+    assert pm[1, 0] and pm[2, 1] and not pm[0, 1]
+    vecs = app.group_vectors()
+    assert vecs["instances"].tolist() == [2, 2, 2]
+    assert vecs["runtime"].tolist() == [10, 10, 10]
+
+
+def test_random_dag_edges_acyclic_and_seeded():
+    rng = np.random.default_rng(0)
+    edges = random_dag_edges(rng, 20, 0.3)
+    assert all(u < v for u, v in edges)
+    rng2 = np.random.default_rng(0)
+    assert edges == random_dag_edges(rng2, 20, 0.3)
+
+
+def test_random_application_generator():
+    spec = _RangeSpec(cpus=(1, 4), mem=(64, 256), runtime=(1, 100), output_size=(0, 50))
+    gen = RandomApplicationGenerator((5, 15), (0.2, 0.5), spec, seed=7)
+    app = gen.generate()
+    assert 5 <= len(app.groups) <= 15
+    assert app.get_sources()  # a DAG always has at least one source
+    for g in app.groups:
+        assert 1 <= g.cpus <= 4
+        assert 1 <= g.runtime <= 100
+
+
+def test_sequential_generator_is_chain():
+    spec = _RangeSpec(cpus=(1, 2), mem=(64, 128), runtime=(1, 10))
+    app = SequentialApplicationGenerator((4, 4), spec, seed=3).generate()
+    assert len(app.get_sources()) == 1
+    assert len(app.get_sinks()) == 1
+    assert app.critical_path_runtime() == sum(g.runtime for g in app.groups)
