@@ -168,18 +168,30 @@ class Store:
     def __len__(self) -> int:
         return len(self.items)
 
-    def put(self, item: Any) -> Event:
+    def put(self, item: Any) -> None:
+        """Enqueue an item; never blocks (the store is unbounded).
+
+        Unlike SimPy there is no put-event to wait on — an unbounded FIFO
+        cannot reject a put, so producers just call this and move on.  This
+        removes one heap round-trip per message on the hottest queues.
+        """
         self.items.append(item)
         self._dispatch()
-        done = Event(self.env)
-        done.succeed(priority=URGENT)
-        return done
 
     def get(self) -> StoreGet:
         evt = StoreGet(self.env)
         self._getters.append(evt)
         self._dispatch()
         return evt
+
+    def drain(self) -> list:
+        """Synchronously take every queued item (no events).
+
+        Valid only from the consuming side at a dispatch point; equivalent
+        to get-ing ``len(items)`` times in a row at one instant.
+        """
+        items, self.items = self.items, []
+        return items
 
     def _dispatch(self) -> None:
         while self.items and self._getters:

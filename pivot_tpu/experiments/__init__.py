@@ -1,0 +1,1 @@
+"""Experiment drivers, CLI, and analysis for the Alibaba trace workload."""

@@ -29,6 +29,7 @@ Redesigns (TPU-first):
 
 from __future__ import annotations
 
+import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -75,30 +76,70 @@ class Node(LogMixin):
 
 
 class HostResource:
-    """Multi-dimensional host capacity with atomic acquire/release."""
+    """Multi-dimensional host capacity with atomic acquire/release.
 
-    __slots__ = ("totals", "available")
+    Scalars, not arrays: admission runs once per task execution on the
+    simulator's hottest path, where four float compares beat numpy
+    dispatch overhead by ~10×.  Dense views are built per scheduling tick
+    by ``Cluster.availability_matrix``.
+    """
+
+    __slots__ = ("t_cpus", "t_mem", "t_disk", "t_gpus", "cpus", "mem", "disk", "gpus")
 
     def __init__(self, cpus: float, mem: float, disk: float, gpus: float):
-        self.totals = np.array([cpus, mem, disk, gpus], dtype=np.float64)
-        self.available = self.totals.copy()
+        self.t_cpus, self.t_mem, self.t_disk, self.t_gpus = (
+            float(cpus),
+            float(mem),
+            float(disk),
+            float(gpus),
+        )
+        self.cpus, self.mem, self.disk, self.gpus = self.t_cpus, self.t_mem, self.t_disk, self.t_gpus
+
+    @property
+    def totals(self) -> np.ndarray:
+        return np.array([self.t_cpus, self.t_mem, self.t_disk, self.t_gpus])
+
+    @property
+    def available(self) -> np.ndarray:
+        return np.array([self.cpus, self.mem, self.disk, self.gpus])
 
     @property
     def used(self) -> np.ndarray:
         return self.totals - self.available
 
-    def try_acquire(self, demand: np.ndarray) -> bool:
+    def try_acquire(self, cpus: float, mem: float, disk: float, gpus: float) -> bool:
         """All-or-nothing admission (ref ``subscribe``, ``:433-449``)."""
-        if np.any(demand < 0) or np.any(demand > self.available):
+        if (
+            cpus < 0
+            or mem < 0
+            or disk < 0
+            or gpus < 0
+            or cpus > self.cpus
+            or mem > self.mem
+            or disk > self.disk
+            or gpus > self.gpus
+        ):
             return False
-        self.available -= demand
+        self.cpus -= cpus
+        self.mem -= mem
+        self.disk -= disk
+        self.gpus -= gpus
         return True
 
-    def release(self, demand: np.ndarray) -> None:
-        """Refund, clamped per-dimension (ref ``unsubscribe``, ``:451-461``)."""
-        used = self.used
-        refund = np.where((demand > 0) & (demand <= used), demand, 0.0)
-        self.available += refund
+    def release(self, cpus: float, mem: float, disk: float, gpus: float) -> None:
+        """Refund, clamped per-dimension to what is actually in use (ref
+        ``unsubscribe``, ``:451-461`` — but clamped with ``min`` rather than
+        dropped outright: with fractional trace demands, float rounding can
+        leave used capacity one ULP below the refund, and dropping it would
+        leak host capacity permanently)."""
+        if cpus > 0:
+            self.cpus += min(cpus, max(self.t_cpus - self.cpus, 0.0))
+        if mem > 0:
+            self.mem += min(mem, max(self.t_mem - self.mem, 0.0))
+        if disk > 0:
+            self.disk += min(disk, max(self.t_disk - self.disk, 0.0))
+        if gpus > 0:
+            self.gpus += min(gpus, max(self.t_gpus - self.gpus, 0.0))
 
 
 class Storage(Node):
@@ -144,21 +185,15 @@ class Host(Node):
         return Host(env, t[0], t[1], t[2], t[3], self.locality, meter, id=self.id)
 
     def execute(self, task: Task):
-        """Generator process: run one task on this host (ref ``:244-314``)."""
+        """Generator: run one task on this host (ref ``:244-314``).
+
+        Driven via ``yield from`` inside the cluster's execute process — no
+        separate Process object per task execution.
+        """
         env, meter, cluster = self.env, self.meter, self.cluster
-        demand = task.demand
-        if not self.resource.try_acquire(demand):
-            avail = self.resource.available
-            for dim, name in enumerate(RESOURCE_DIMS):
-                if demand[dim] > avail[dim]:
-                    self.logger.debug(
-                        "[%.3f] %s demand %.3f > available %.3f on %s",
-                        env.now,
-                        name,
-                        demand[dim],
-                        avail[dim],
-                        self.id,
-                    )
+        group = task.group
+        resource = self.resource
+        if not resource.try_acquire(group.cpus, group.mem, group.disk, group.gpus):
             return False
 
         self._tasks.add(task)
@@ -171,20 +206,19 @@ class Host(Node):
         preds = self._sample_predecessor_inputs(task)
         if preds:
             done_events = []
+            routes = []
             for p in preds:
                 route = cluster.get_route(p.placement, self.id)
+                routes.append(route)
                 done_events.append(route.send(p.output_size))
             yield env.all_of(done_events)
             if meter:
-                self._record_transfer(task, preds, pull_start)
+                self._record_transfer(task, preds, routes, pull_start)
 
         # Timed compute.
-        self.logger.debug(
-            "[%.3f] task %s starts on %s, etc %.3f", env.now, task.id, self.id, task.runtime
-        )
         yield env.timeout(task.runtime)
 
-        self.resource.release(demand)
+        resource.release(group.cpus, group.mem, group.disk, group.gpus)
         self._tasks.discard(task)
         if meter:
             meter.host_check_out(self)
@@ -198,7 +232,7 @@ class Host(Node):
         """
         group = task.group
         app = group.application
-        rng = self.cluster.rng
+        rng = self.cluster.pyrng
         sampled: List[Task] = []
         for pred_group in app.get_predecessors(group.id):
             if pred_group.output_size <= 0:
@@ -207,38 +241,40 @@ class Host(Node):
             if not ptasks:
                 continue
             if group.instances > 1:
-                k = max(round(len(ptasks) / group.instances), 1)
-                idx = rng.integers(0, len(ptasks), size=k)
-                sampled.extend(ptasks[i] for i in idx)
+                n = len(ptasks)
+                k = max(round(n / group.instances), 1)
+                sampled.extend(ptasks[rng.randrange(n)] for _ in range(k))
             else:
                 sampled.extend(ptasks)
         return sampled
 
-    def _record_transfer(self, task: Task, preds: List[Task], pull_start: float) -> None:
+    def _record_transfer(
+        self, task: Task, preds: List[Task], routes: List["Route"], pull_start: float
+    ) -> None:
         env, cluster, meter = self.env, self.cluster, self.meter
         meta = cluster.meta
-        bws, costs, prop_delays = [], [], []
+        sum_bw = sum_cost = max_prop = total_amt = 0.0
         sources = set()
-        for p in preds:
-            p_host = cluster.get_host(p.placement)
-            route = cluster.get_route(p_host.id, self.id)
-            bws.append(route.bw)
-            costs.append(meta.cost(p_host.locality, self.locality))
-            prop_delays.append(p.output_size / route.bw if route.bw > 0 else 0.0)
-            sources.add(p_host.locality)
-        total_amt = sum(p.output_size for p in preds)
-        total_delay = env.now - pull_start
-        if meter:
-            meter.add_data_transfer(
-                env.now,
-                sources,
-                self.locality,
-                total_amt,
-                total_delay,
-                max(prop_delays),
-                float(np.mean(bws)),
-                float(np.mean(costs)),
-            )
+        for p, route in zip(preds, routes):
+            sum_bw += route.bw
+            sum_cost += meta.cost(route.src.locality, self.locality)
+            if route.bw > 0:
+                prop = p.output_size / route.bw
+                if prop > max_prop:
+                    max_prop = prop
+            total_amt += p.output_size
+            sources.add(route.src.locality)
+        n = len(preds)
+        meter.add_data_transfer(
+            env.now,
+            sources,
+            self.locality,
+            total_amt,
+            env.now - pull_start,
+            max_prop,
+            sum_bw / n,
+            sum_cost / n,
+        )
 
 
 class Cluster(LogMixin):
@@ -267,6 +303,9 @@ class Cluster(LogMixin):
         self.route_mode = route_mode
         self.seed = seed
         self.rng = np.random.default_rng(seed)
+        # Python RNG for the per-task predecessor sampling hot path (each
+        # draw is a scalar; random.Random beats numpy dispatch ~10×).
+        self.pyrng = random.Random(seed)
         self._hosts: Dict[str, Host] = {}
         self._host_list: List[Host] = []
         self._storage: Dict[str, Storage] = {}
@@ -368,20 +407,34 @@ class Cluster(LogMixin):
             self.env.process(self._execute_task(task, host))
 
     def _execute_task(self, task: Task, host: Host):
-        success = yield self.env.process(host.execute(task))
-        yield self.notify_q.put((success, task))
+        # ``yield from`` runs the host's generator inside this process —
+        # one Process object per execution instead of two.
+        success = yield from host.execute(task)
+        self.notify_q.put((success, task))
 
     # -- dense exports for the decision kernels --------------------------
     def availability_matrix(self, dtype=np.float64) -> np.ndarray:
         """[H, 4] current per-host availability snapshot."""
-        return np.stack([h.resource.available for h in self._host_list]).astype(
-            dtype, copy=False
-        )
+        hosts = self._host_list
+        out = np.empty((len(hosts), 4), dtype=dtype)
+        for i, h in enumerate(hosts):
+            r = h.resource
+            out[i, 0] = r.cpus
+            out[i, 1] = r.mem
+            out[i, 2] = r.disk
+            out[i, 3] = r.gpus
+        return out
 
     def totals_matrix(self, dtype=np.float64) -> np.ndarray:
-        return np.stack([h.resource.totals for h in self._host_list]).astype(
-            dtype, copy=False
-        )
+        hosts = self._host_list
+        out = np.empty((len(hosts), 4), dtype=dtype)
+        for i, h in enumerate(hosts):
+            r = h.resource
+            out[i, 0] = r.t_cpus
+            out[i, 1] = r.t_mem
+            out[i, 2] = r.t_disk
+            out[i, 3] = r.t_gpus
+        return out
 
     def host_zone_vector(self) -> np.ndarray:
         """[H] int32 zone index per host."""

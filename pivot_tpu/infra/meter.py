@@ -36,8 +36,8 @@ class Meter(LogMixin):
         self._host_intervals: Dict[object, List[list]] = defaultdict(list)
         # route -> transfer_id -> list of [start, end, chunk_mb] service slots
         self._route_slots: Dict[object, Dict[str, List[list]]] = defaultdict(dict)
-        # resource dim -> host -> [(t, normalized usage)]
-        self._usage: Dict[str, Dict[object, list]] = defaultdict(dict)
+        # host -> [(t, cpu_frac, mem_frac, disk_frac, gpu_frac)]
+        self._usage: Dict[object, list] = defaultdict(list)
         self._data_transfers: List[dict] = []
         self._sched_turnovers: List[float] = []
         self._n_sched_ops = 0
@@ -154,13 +154,19 @@ class Meter(LogMixin):
     def increment_scheduling_ops(self, n_ops: int) -> None:
         self._n_sched_ops += n_ops
 
+    _USAGE_DIMS = {"cpus": 1, "mem": 2, "disk": 3, "gpus": 4}
+
     def _track_resource_usage(self, host) -> None:
-        now, res = self.env.now, host.resource
-        used, total = res.used, res.totals
-        names = ("cpus", "mem", "disk", "gpus")
-        for dim, name in enumerate(names):
-            frac = used[dim] / total[dim] if total[dim] > 0 else 0.0
-            self._usage[name].setdefault(host, []).append((now, frac))
+        r = host.resource
+        self._usage[host].append(
+            (
+                self.env.now,
+                (r.t_cpus - r.cpus) / r.t_cpus if r.t_cpus else 0.0,
+                (r.t_mem - r.mem) / r.t_mem if r.t_mem else 0.0,
+                (r.t_disk - r.disk) / r.t_disk if r.t_disk else 0.0,
+                (r.t_gpus - r.gpus) / r.t_gpus if r.t_gpus else 0.0,
+            )
+        )
 
     # -- aggregation / persistence ---------------------------------------
     def host_usage_curve(self, sample_size: float = 100.0):
@@ -181,12 +187,13 @@ class Meter(LogMixin):
 
     def resource_usage_curve(self, resource: str, sample_size: float = 100.0):
         """Time-bucketed mean normalized utilization of one dimension."""
+        dim = self._USAGE_DIMS[resource]
         counter: Dict[float, Dict[object, list]] = {}
-        for host, recs in self._usage.get(resource, {}).items():
-            for t, amt in recs:
-                counter.setdefault(floor_bucket(t, sample_size), {}).setdefault(
+        for host, recs in self._usage.items():
+            for rec in recs:
+                counter.setdefault(floor_bucket(rec[0], sample_size), {}).setdefault(
                     host, []
-                ).append(amt)
+                ).append(rec[dim])
         x = sorted(counter)
         y = [
             float(np.mean([np.mean(v) for v in counter[t].values()])) for t in x

@@ -1,0 +1,295 @@
+"""Two-level scheduler runtime: global tick loop + per-application local schedulers.
+
+Capability parity with the reference's ``scheduler/__init__.py``:
+
+  * ``GlobalScheduler``  — tick loop every ``interval`` sim-seconds: drain
+    wait queue (LIFO) and submit queue into the ready batch, snapshot host
+    state, invoke the placement policy, route placed tasks to the cluster's
+    ``dispatch_q`` and unplaced tasks to the wait queue (ref ``:87-118``);
+    completion listener that finishes tasks, releases DAG successors, and
+    resubmits failed tasks — the infinite retry loop (ref ``:120-147``).
+  * ``LocalScheduler``   — per-app: seeds the ready stack with DAG sources,
+    pumps ready tasks (LIFO, matching the reference's OrderedDict.popitem)
+    to the global submit queue every ``interval`` ticks (ref ``:150-222``).
+
+The **policy boundary** is redesigned for the TPU backend: instead of the
+reference's ``schedule(tasks)`` mutating task objects against a dict
+snapshot, a policy receives a :class:`TickContext` — dense ``[T,4]`` demand
+and ``[H,4]`` availability arrays plus zone vectors — and returns an ``[T]``
+array of host indices (−1 = unplaced).  The same context feeds the naive
+Python, vectorized numpy, and fused TPU implementations, which is what makes
+placement-parity testing across backends possible.
+
+Documented deviations from the reference (quirks fixed deliberately, see
+SURVEY.md §4):
+  * The reference caps the number of submit-queue items drained per tick at
+    ``len(submit_q) - len(wait_q)`` (``scheduler/__init__.py:96-99``), so a
+    non-empty wait queue starves fresh submissions; here the ready batch is
+    wait queue + everything currently submitted.
+  * Finished applications are actually removed from the local-scheduler
+    registry (the reference pops by the wrong key, ``:145``, and rescans
+    every app's DAG each tick).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pivot_tpu.des import Environment, Store
+from pivot_tpu.infra import Cluster, Host
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.utils import LogMixin
+from pivot_tpu.workload import Application, Task
+
+__all__ = ["TickContext", "Policy", "GlobalScheduler", "LocalScheduler"]
+
+
+class TickContext:
+    """Dense batch view of one scheduling tick — the policy/kernel feed.
+
+    Arrays are index-aligned with ``tasks`` (rows) and the cluster host
+    order (columns / host indices).
+    """
+
+    def __init__(
+        self,
+        scheduler: "GlobalScheduler",
+        tasks: List[Task],
+        tick_seq: int,
+    ):
+        cluster = scheduler.cluster
+        self.scheduler = scheduler
+        self.cluster = cluster
+        self.meta = cluster.meta
+        self.env_now = scheduler.env.now
+        self.tick_seq = tick_seq
+        self.tasks = tasks
+        self.hosts: List[Host] = cluster.hosts
+        # Mutable working copy: policies decrement as they assign within the
+        # tick (greedy sequential semantics, ref scheduler snapshots).
+        self.avail = cluster.availability_matrix()
+        self.demands = (
+            np.stack([t.demand for t in tasks])
+            if tasks
+            else np.zeros((0, 4), dtype=np.float64)
+        )
+        self._host_zones: Optional[np.ndarray] = None
+        self._host_task_counts: Optional[np.ndarray] = None
+
+    @property
+    def n_tasks(self) -> int:
+        return len(self.tasks)
+
+    @property
+    def n_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def host_zones(self) -> np.ndarray:
+        if self._host_zones is None:
+            self._host_zones = self.cluster.host_zone_vector()
+        return self._host_zones
+
+    @property
+    def host_task_counts(self) -> np.ndarray:
+        """[H] number of tasks currently resident per host (decay factor)."""
+        if self._host_task_counts is None:
+            self._host_task_counts = np.array(
+                [h.n_tasks for h in self.hosts], dtype=np.int32
+            )
+        return self._host_task_counts
+
+
+class Policy(LogMixin):
+    """A placement policy: consumes a TickContext, returns host indices."""
+
+    name = "abstract"
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        """Return [T] int array of host indices; −1 leaves a task unplaced."""
+        raise NotImplementedError
+
+    def bind(self, scheduler: "GlobalScheduler") -> None:
+        """Called once when attached to a scheduler (override to warm up)."""
+
+
+class LocalScheduler(LogMixin):
+    """Per-application scheduler: DAG readiness tracking + submission pump.
+
+    Pump wake-ups land on the reference's tick grid — ``start_time + k·
+    interval`` (ref ``scheduler/__init__.py:185-194``) — but are scheduled
+    *on demand*: when the ready stack is empty nothing ticks, removing the
+    reference's per-app idle polling without changing submission times.
+    """
+
+    def __init__(
+        self,
+        env: Environment,
+        app: Application,
+        submit_q: Store,
+        interval: float = 5,
+    ):
+        self.env = env
+        self.application = app
+        self.submit_q = submit_q
+        self.interval = interval
+        self._ready_stack: List[Task] = []
+        self._start_time = 0.0
+        self._wake_armed = False
+
+    def start(self) -> None:
+        env, app = self.env, self.application
+        app.start_time = env.now
+        self._start_time = env.now
+        for group in app.get_sources():
+            for task in group.materialize_tasks():
+                self._ready_stack.append(task)
+        # First pump fires immediately (grid point k = 0).
+        self._wake_armed = True
+        env.schedule_callback(0.0, self._pump)
+
+    def _pump(self) -> None:
+        self._wake_armed = False
+        submit = self.submit_q.put
+        stack = self._ready_stack
+        while stack:
+            task = stack.pop()  # LIFO, ref popitem()
+            if task.is_nascent:
+                submit(task)
+
+    def _arm_wake(self) -> None:
+        """Schedule the next pump at the first grid point after now."""
+        if self._wake_armed or not self._ready_stack:
+            return
+        elapsed = self.env.now - self._start_time
+        k = int(elapsed // self.interval) + 1
+        delay = self._start_time + k * self.interval - self.env.now
+        self._wake_armed = True
+        self.env.schedule_callback(delay, self._pump)
+
+    def notify(self, task: Task) -> None:
+        """Called by the global listener when one of our tasks finishes.
+
+        Failed tasks never reach here — the listener resubmits them to the
+        global queue directly (the retry loop lives in the global
+        scheduler, not here).
+        """
+        assert task.is_finished
+        group = task.group
+        if group.is_finished:
+            for succ in self.application.get_ready_successors(group.id):
+                for t in succ.materialize_tasks():
+                    self._ready_stack.append(t)
+        self._arm_wake()
+
+
+class GlobalScheduler(LogMixin):
+    """The global tick loop + completion listener around a pluggable policy."""
+
+    def __init__(
+        self,
+        env: Environment,
+        cluster: Cluster,
+        policy: Policy,
+        interval: float = 5,
+        seed: Optional[int] = None,
+        meter: Optional[Meter] = None,
+    ):
+        self.env = env
+        self.cluster = cluster
+        self.policy = policy
+        self.interval = interval
+        self.seed = seed
+        self.meter = meter
+        self.randomizer = np.random.RandomState(seed)
+        self.submit_q = Store(env)
+        self._wait_stack: List[Task] = []
+        self._local: Dict[str, LocalScheduler] = {}
+        self._n_unfinished = 0
+        self._stopped = False
+        self._tick_seq = 0
+        policy.bind(self)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self.env.process(self._dispatch_loop())
+        self.env.process(self._listen_loop())
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    @property
+    def is_active(self) -> bool:
+        return not self._stopped or self._n_unfinished > 0
+
+    def submit(self, app: Application) -> None:
+        if app.id in self._local:
+            self.logger.error("application %s already exists", app.id)
+            return
+        local = LocalScheduler(self.env, app, self.submit_q, self.interval)
+        self._local[app.id] = local
+        self._n_unfinished += 1
+        local.start()
+
+    def get_local(self, app_id: str) -> Optional[LocalScheduler]:
+        return self._local.get(app_id)
+
+    # -- the tick loop ---------------------------------------------------
+    def _dispatch_loop(self):
+        env, cluster = self.env, self.cluster
+        while self.is_active:
+            ready: List[Task] = []
+            while self._wait_stack:
+                ready.append(self._wait_stack.pop())  # LIFO, ref popitem()
+            ready.extend(self.submit_q.drain())
+            if ready:
+                if self.meter:
+                    self.meter.increment_scheduling_ops(len(ready))
+                ctx = TickContext(self, ready, self._tick_seq)
+                placements = self.policy.place(ctx)
+                self._tick_seq += 1
+                for task, h_idx in zip(ready, placements):
+                    if not task.is_nascent:
+                        self.logger.error("task %s not nascent at dispatch", task.id)
+                        continue
+                    if h_idx < 0:
+                        task.placement = None
+                        self._wait_stack.append(task)
+                    else:
+                        task.placement = ctx.hosts[int(h_idx)].id
+                        cluster.dispatch_q.put(task)
+                        task.set_submitted()
+            yield env.timeout(self.interval)
+
+    # -- the completion listener -----------------------------------------
+    def _listen_loop(self):
+        env = self.env
+        while self.is_active:
+            success, task = yield self.cluster.notify_q.get()
+            app = task.application
+            if app is None:
+                self.logger.error("task %s has no application", task.id)
+                continue
+            local = self._local.get(app.id)
+            if local is None:
+                self.logger.error("application %s unknown", app.id)
+                continue
+            if success:
+                task.set_finished()
+                local.notify(task)
+            else:
+                task.set_nascent()
+                task.placement = None
+                self.submit_q.put(task)
+            if app.is_finished:
+                app.end_time = env.now
+                self.logger.debug(
+                    "[%.3f] application %s finished in %.3f s",
+                    env.now,
+                    app.id,
+                    app.end_time - app.start_time,
+                )
+                self._local.pop(app.id, None)
+                self._n_unfinished -= 1

@@ -1,0 +1,354 @@
+"""Placement policies: opportunistic, first-fit, best-fit, cost-aware.
+
+Each policy supports two CPU modes sharing the :class:`TickContext` feed:
+
+  * ``mode='naive'`` — reference-faithful per-task/per-host Python loops,
+    the measured performance baseline (mirrors ``scheduler/opportunistic.py``,
+    ``scheduler/vbp.py``, ``scheduler/cost_aware.py`` in the reference).
+  * ``mode='numpy'`` — vectorized over hosts; bit-identical placements to
+    the TPU kernels in ``pivot_tpu.ops`` (which consume the same Philox
+    uniform stream and the same tie-breaking rules).
+
+Deliberate, documented fixes of reference quirks (SURVEY.md §4):
+  * ``decreasing`` is a real boolean (the reference's ``str(False)`` is
+    always truthy, ``scheduler/vbp.py:9,35`` — so its first-fit *always*
+    sorted; experiments pass ``decreasing=True`` anyway).
+  * Best-fit keeps the reference's strict ``>`` fit test
+    (``scheduler/vbp.py:45``) and cost-aware first-fit its strict ``>``
+    (``scheduler/cost_aware.py:124``) — both preserved since they shape
+    behavior; ties in argmin resolve to the lowest host index (the
+    reference breaks ties by uuid string order, which is unreproducible).
+  * Best-fit + ``host_decay`` works here (the reference's
+    ``_best_fit`` dereferences an uninitialized ``None`` counter,
+    ``scheduler/cost_aware.py:26,67``).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from pivot_tpu.sched import Policy, TickContext
+from pivot_tpu.sched.rand import tick_uniforms
+
+__all__ = [
+    "OpportunisticPolicy",
+    "FirstFitPolicy",
+    "BestFitPolicy",
+    "CostAwarePolicy",
+]
+
+
+def _norms(mat: np.ndarray) -> np.ndarray:
+    return np.sqrt(np.sum(mat * mat, axis=-1))
+
+
+def _sort_decreasing(demands: np.ndarray, idxs: List[int]) -> List[int]:
+    """Stable sort of task indices by descending demand L2 norm."""
+    norms = _norms(demands[idxs])
+    order = np.argsort(-norms, kind="stable")
+    return [idxs[i] for i in order]
+
+
+class OpportunisticPolicy(Policy):
+    """Uniformly random choice among fitting hosts (ref opportunistic.py:11-20)."""
+
+    name = "opportunistic"
+
+    def __init__(self, mode: str = "numpy"):
+        assert mode in ("naive", "numpy")
+        self.mode = mode
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
+        avail, demands = ctx.avail, ctx.demands
+        if self.mode == "naive":
+            rnd = ctx.scheduler.randomizer
+            for i in range(ctx.n_tasks):
+                fits = [
+                    h for h in range(ctx.n_hosts) if np.all(avail[h] >= demands[i])
+                ]
+                if fits:
+                    h = int(rnd.choice(fits))
+                    avail[h] -= demands[i]
+                    placements[i] = h
+        else:
+            u = tick_uniforms(ctx.scheduler.seed or 0, ctx.tick_seq, ctx.n_tasks)
+            for i in range(ctx.n_tasks):
+                mask = np.all(avail >= demands[i], axis=1)
+                n_fit = int(mask.sum())
+                if n_fit:
+                    fits = np.nonzero(mask)[0]
+                    h = int(fits[min(int(u[i] * n_fit), n_fit - 1)])
+                    avail[h] -= demands[i]
+                    placements[i] = h
+        return placements
+
+
+class FirstFitPolicy(Policy):
+    """First host in cluster order that fits (ref vbp.py:6-29)."""
+
+    name = "first_fit"
+
+    def __init__(self, decreasing: bool = False, mode: str = "numpy"):
+        assert mode in ("naive", "numpy")
+        self.decreasing = decreasing
+        self.mode = mode
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
+        avail, demands = ctx.avail, ctx.demands
+        idxs = list(range(ctx.n_tasks))
+        if self.decreasing:
+            idxs = _sort_decreasing(demands, idxs)
+        if self.mode == "naive":
+            for i in idxs:
+                for h in range(ctx.n_hosts):
+                    if np.all(avail[h] >= demands[i]):
+                        avail[h] -= demands[i]
+                        placements[i] = h
+                        break
+        else:
+            for i in idxs:
+                mask = np.all(avail >= demands[i], axis=1)
+                if mask.any():
+                    h = int(np.argmax(mask))
+                    avail[h] -= demands[i]
+                    placements[i] = h
+        return placements
+
+
+class BestFitPolicy(Policy):
+    """Min residual-L2 host among strict fits (ref vbp.py:32-49)."""
+
+    name = "best_fit"
+
+    def __init__(self, decreasing: bool = False, mode: str = "numpy"):
+        assert mode in ("naive", "numpy")
+        self.decreasing = decreasing
+        self.mode = mode
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
+        avail, demands = ctx.avail, ctx.demands
+        idxs = list(range(ctx.n_tasks))
+        if self.decreasing:
+            idxs = _sort_decreasing(demands, idxs)
+        if self.mode == "naive":
+            for i in idxs:
+                best, best_score = -1, np.inf
+                for h in range(ctx.n_hosts):
+                    if np.all(avail[h] > demands[i]):  # strict, ref :45
+                        score = float(np.linalg.norm(avail[h] - demands[i]))
+                        if score < best_score:
+                            best, best_score = h, score
+                if best >= 0:
+                    avail[best] -= demands[i]
+                    placements[i] = best
+        else:
+            for i in idxs:
+                mask = np.all(avail > demands[i], axis=1)  # strict, ref :45
+                if not mask.any():
+                    continue
+                residual = _norms(avail - demands[i])
+                residual[~mask] = np.inf
+                h = int(np.argmin(residual))  # lowest index on ties
+                avail[h] -= demands[i]
+                placements[i] = h
+        return placements
+
+
+class CostAwarePolicy(Policy):
+    """Data-locality / egress-cost-aware placement — the PIVOT policy
+    (ref cost_aware.py:11-127).
+
+    Tasks are grouped by *anchor*: the zone-local storage at the majority
+    predecessor placement locality (``_group_tasks``, ref ``:45-58``); root
+    tasks anchor to a random storage per application.  Within a group,
+    hosts are scored by round-trip egress cost × crowding decay /
+    (residual-capacity norm × round-trip bandwidth) and greedily
+    first-fit in score order (or best-fit per task).
+    """
+
+    name = "cost_aware"
+
+    def __init__(
+        self,
+        bin_pack: str = "first-fit",
+        sort_tasks: bool = False,
+        sort_hosts: bool = False,
+        realtime_bw: bool = False,
+        host_decay: bool = False,
+        mode: str = "numpy",
+    ):
+        assert bin_pack in ("first-fit", "best-fit")
+        assert mode in ("naive", "numpy")
+        self.bin_pack = bin_pack
+        self.sort_tasks = sort_tasks
+        self.sort_hosts = sort_hosts
+        self.realtime_bw = realtime_bw
+        self.host_decay = host_decay
+        self.mode = mode
+
+    # -- grouping --------------------------------------------------------
+    def group_tasks(
+        self, ctx: TickContext
+    ) -> "OrderedDict[object, List[int]]":
+        """Anchor → task indices, in first-seen order (ref ``:45-58``).
+
+        Keys are Storage nodes, or the Application for root task groups
+        (resolved to a random storage at placement time).
+        """
+        cluster = ctx.cluster
+        groups: "OrderedDict[object, List[int]]" = OrderedDict()
+        for i, task in enumerate(ctx.tasks):
+            group = task.group
+            # Anchor memo: once a group is ready its predecessors are all
+            # finished with immutable placements, so the majority vote is a
+            # fixed function — compute it once per group, not per instance
+            # per tick (the reference recomputes it for every task, every
+            # tick: cost_aware.py:45-58).
+            anchor = group.__dict__.get("_anchor_memo")
+            if anchor is None:
+                app = group.application
+                pred_tasks = [
+                    t
+                    for p in app.get_predecessors(group.id)
+                    for t in p.tasks
+                    if t.placement is not None
+                ]
+                if pred_tasks:
+                    # Majority placement; ties resolve to first occurrence,
+                    # matching Counter insertion order in the reference.
+                    counts: "OrderedDict[str, int]" = OrderedDict()
+                    for t in pred_tasks:
+                        counts[t.placement] = counts.get(t.placement, 0) + 1
+                    majority = max(counts.items(), key=lambda kv: kv[1])[0]
+                    locality = cluster.get_host(majority).locality
+                    anchor = cluster.get_storage_by_locality(locality)
+                else:
+                    anchor = app
+                group.__dict__["_anchor_memo"] = anchor
+            groups.setdefault(anchor, []).append(i)
+        return groups
+
+    # -- scoring ---------------------------------------------------------
+    def _roundtrip_vectors(
+        self, ctx: TickContext, anchor
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """([H] roundtrip $ cost, [H] roundtrip bw) anchor↔host."""
+        meta = ctx.meta
+        az = meta.zone_index[anchor.locality]
+        hz = ctx.host_zones
+        cost_rt = meta.cost_matrix[az, hz] + meta.cost_matrix[hz, az]
+        if self.realtime_bw:
+            bw_rt = np.array(
+                [
+                    ctx.cluster.get_route(anchor.id, h.id).realtime_bw
+                    + ctx.cluster.get_route(h.id, anchor.id).realtime_bw
+                    for h in ctx.hosts
+                ]
+            )
+        else:
+            bw_rt = meta.bw_matrix[az, hz] + meta.bw_matrix[hz, az]
+        return cost_rt, bw_rt
+
+    def _decay(self, ctx: TickContext, extra_tasks: np.ndarray) -> np.ndarray:
+        """[H] crowding decay factor (ref ``:81,115``)."""
+        if not self.host_decay:
+            return np.ones(ctx.n_hosts)
+        return np.maximum(ctx.host_task_counts + extra_tasks, 1).astype(np.float64)
+
+    # -- placement -------------------------------------------------------
+    def place(self, ctx: TickContext) -> np.ndarray:
+        placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
+        avail, demands = ctx.avail, ctx.demands
+        storage = ctx.cluster.storage
+        extra_tasks = np.zeros(ctx.n_hosts, dtype=np.int32)  # placed this tick
+        for anchor, idxs in self.group_tasks(ctx).items():
+            if not hasattr(anchor, "locality"):  # root group: random storage
+                anchor = storage[int(ctx.scheduler.randomizer.choice(len(storage)))]
+            if self.sort_tasks:
+                idxs = _sort_decreasing(demands, idxs)
+            cost_rt, bw_rt = self._roundtrip_vectors(ctx, anchor)
+            if self.bin_pack == "first-fit":
+                self._first_fit(
+                    ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
+                )
+            else:
+                self._best_fit(
+                    ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
+                )
+        return placements
+
+    def _first_fit(
+        self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
+    ) -> None:
+        """Hosts sorted once per group by score, then greedy first strict fit
+        (ref ``:99-127``; scores use availability at sort time)."""
+        if self.sort_hosts:
+            with np.errstate(divide="ignore"):
+                score = (
+                    cost_rt
+                    * self._decay(ctx, extra_tasks)
+                    / (_norms(avail) * bw_rt)
+                )
+            order = np.argsort(score, kind="stable")
+        else:
+            order = np.arange(ctx.n_hosts)
+        if self.mode == "naive":
+            for i in idxs:
+                for h in order:
+                    if np.all(avail[h] > demands[i]):  # strict, ref :124
+                        avail[h] -= demands[i]
+                        placements[i] = h
+                        extra_tasks[h] += 1
+                        break
+        else:
+            for i in idxs:
+                mask = np.all(avail[order] > demands[i], axis=1)
+                if mask.any():
+                    h = int(order[np.argmax(mask)])
+                    avail[h] -= demands[i]
+                    placements[i] = h
+                    extra_tasks[h] += 1
+
+    def _best_fit(
+        self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
+    ) -> None:
+        """Per-task min of cost × residual × decay / bw among non-strict fits
+        (ref ``:63-97``)."""
+        if self.mode == "naive":
+            for i in idxs:
+                best, best_score = -1, np.inf
+                for h in range(ctx.n_hosts):
+                    if not np.all(avail[h] >= demands[i]):  # non-strict, ref :87
+                        continue
+                    r = float(np.linalg.norm(avail[h] - demands[i]))
+                    decay = (
+                        max(int(ctx.host_task_counts[h]) + int(extra_tasks[h]), 1)
+                        if self.host_decay
+                        else 1.0
+                    )
+                    score = cost_rt[h] * r * decay / bw_rt[h]
+                    if score < best_score:
+                        best, best_score = h, score
+                if best >= 0:
+                    avail[best] -= demands[i]
+                    placements[i] = best
+                    extra_tasks[best] += 1
+        else:
+            for i in idxs:
+                mask = np.all(avail >= demands[i], axis=1)  # non-strict, ref :87
+                if not mask.any():
+                    continue
+                residual = _norms(avail - demands[i])
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    score = cost_rt * residual * self._decay(ctx, extra_tasks) / bw_rt
+                score[~mask] = np.inf
+                h = int(np.argmin(score))
+                avail[h] -= demands[i]
+                placements[i] = h
+                extra_tasks[h] += 1

@@ -1,0 +1,21 @@
+"""Deterministic counter-based random streams shared across policy backends.
+
+The vectorized-numpy and TPU policy modes must make *identical* random
+choices so placement parity is exact.  Philox is counter-based: the stream
+for tick ``t`` is fully determined by ``(seed, t)`` with no sequential
+state, so the CPU runtime can generate the tick's uniforms once and feed
+the same array to either backend (the TPU kernel takes them as an input —
+no on-device RNG divergence to worry about).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["tick_uniforms"]
+
+
+def tick_uniforms(seed: int, tick_seq: int, n: int) -> np.ndarray:
+    """[n] float64 uniforms in [0, 1) for one scheduling tick."""
+    bitgen = np.random.Philox(key=seed, counter=[0, 0, 0, tick_seq])
+    return np.random.Generator(bitgen).random(n)

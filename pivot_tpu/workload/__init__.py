@@ -91,10 +91,8 @@ class Task:
 
     @property
     def demand(self) -> np.ndarray:
-        return np.array(
-            [self.group.cpus, self.group.mem, self.group.disk, self.group.gpus],
-            dtype=np.float64,
-        )
+        """[4] demand vector (shared with the group; do not mutate)."""
+        return self.group.demand_np
 
     @property
     def is_nascent(self) -> bool:
@@ -104,16 +102,25 @@ class Task:
     def is_finished(self) -> bool:
         return self.state == TaskState.FINISHED
 
+    def _leave_finished(self) -> None:
+        if self.state == TaskState.FINISHED:
+            self.group._n_finished -= 1
+
     def set_nascent(self) -> None:
+        self._leave_finished()
         self.state = TaskState.NASCENT
 
     def set_submitted(self) -> None:
+        self._leave_finished()
         self.state = TaskState.SUBMITTED
 
     def set_running(self) -> None:
+        self._leave_finished()
         self.state = TaskState.RUNNING
 
     def set_finished(self) -> None:
+        if self.state != TaskState.FINISHED:
+            self.group._n_finished += 1
         self.state = TaskState.FINISHED
 
     def __repr__(self) -> str:
@@ -148,6 +155,18 @@ class TaskGroup(LogMixin):
         self.dependencies: List[str] = [str(d) for d in dependencies]
         self.application: Optional["Application"] = None
         self._tasks: List[Task] = []
+        self._demand_np: Optional[np.ndarray] = None
+        self._n_finished = 0  # maintained by Task state setters
+
+    @property
+    def demand_np(self) -> np.ndarray:
+        """Cached [4] demand vector shared by all task instances (treat as
+        immutable — the group's shape never changes after construction)."""
+        if self._demand_np is None:
+            self._demand_np = np.array(
+                [self.cpus, self.mem, self.disk, self.gpus], dtype=np.float64
+            )
+        return self._demand_np
 
     @property
     def tasks(self) -> List[Task]:
@@ -156,8 +175,8 @@ class TaskGroup(LogMixin):
     @property
     def is_finished(self) -> bool:
         # A group with no materialized tasks is NOT finished (ref
-        # ``application/__init__.py:297-299``).
-        return bool(self._tasks) and all(t.is_finished for t in self._tasks)
+        # ``application/__init__.py:297-299``).  O(1) via the counter.
+        return 0 < len(self._tasks) == self._n_finished
 
     def materialize_tasks(self) -> List[Task]:
         """Create (once) and return the group's task replicas."""

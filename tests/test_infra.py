@@ -59,26 +59,35 @@ def test_traffic_cost_units(meta):
 
 def test_host_resource_admission():
     r = HostResource(4, 100, 10, 1)
-    assert r.try_acquire(np.array([2.0, 50, 5, 1]))
-    assert not r.try_acquire(np.array([3.0, 10, 1, 0]))  # cpus insufficient
-    assert r.try_acquire(np.array([2.0, 50, 5, 0]))
+    assert r.try_acquire(2.0, 50, 5, 1)
+    assert not r.try_acquire(3.0, 10, 1, 0)  # cpus insufficient
+    assert r.try_acquire(2.0, 50, 5, 0)
     assert np.all(r.available == 0)
-    r.release(np.array([2.0, 50, 5, 1]))
+    r.release(2.0, 50, 5, 1)
     assert r.available.tolist() == [2, 50, 5, 1]
 
 
 def test_host_resource_rejects_negative():
     r = HostResource(4, 100, 10, 1)
-    assert not r.try_acquire(np.array([-1.0, 0, 0, 0]))
+    assert not r.try_acquire(-1.0, 0, 0, 0)
     assert np.all(r.available == r.totals)
 
 
 def test_host_resource_release_clamped():
     r = HostResource(4, 100, 10, 1)
-    r.try_acquire(np.array([2.0, 0, 0, 0]))
-    # Refund of more than used in a dimension is dropped for that dim.
-    r.release(np.array([3.0, 10, 0, 0]))
-    assert r.available.tolist() == [2, 100, 10, 1]
+    r.try_acquire(2.0, 0, 0, 0)
+    # Refund is clamped to what is in use: never exceeds capacity.
+    r.release(3.0, 10, 0, 0)
+    assert r.available.tolist() == [4, 100, 10, 1]
+
+
+def test_host_resource_release_float_rounding():
+    # Fractional demands must round-trip without leaking capacity.
+    r = HostResource(64, 1024, 100, 1)
+    demand = (28.77, 0.49 * 7864.32, 0, 0)
+    r.try_acquire(*demand)
+    r.release(*demand)
+    assert r.cpus == pytest.approx(64) and r.mem == pytest.approx(1024)
 
 
 def make_cluster(meta, n_hosts=4, mode="local", meter=None, env=None):

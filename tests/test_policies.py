@@ -1,0 +1,177 @@
+"""Policy unit + cross-mode parity tests.
+
+The deterministic policies (first-fit, best-fit, cost-aware) must produce
+*identical placement sequences* in naive and numpy modes — the golden
+criterion that later extends to the TPU kernels (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.sched import GlobalScheduler, TickContext
+from pivot_tpu.sched.policies import (
+    BestFitPolicy,
+    CostAwarePolicy,
+    FirstFitPolicy,
+    OpportunisticPolicy,
+)
+from pivot_tpu.workload import Application, TaskGroup
+
+
+@pytest.fixture(scope="module")
+def meta():
+    return ResourceMetadata(seed=0)
+
+
+def make_ctx(meta, shapes, groups, seed=0, placements=None, zone_idx=None):
+    """Build a TickContext over explicit hosts with all group tasks ready."""
+    env = Environment()
+    zones = meta.zones
+    hosts = [
+        Host(env, *shape, locality=zones[zone_idx[i] if zone_idx else i % len(zones)])
+        for i, shape in enumerate(shapes)
+    ]
+    storage = [Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)]
+    cluster = Cluster(env, hosts=hosts, storage=storage, meta=meta,
+                      route_mode="meta", seed=seed)
+    app = Application("app", groups)
+    tasks = []
+    for g in app.groups:
+        tasks.extend(g.materialize_tasks())
+    if placements:
+        for t in tasks:
+            if t.id in placements:
+                t.placement = placements[t.id]
+                t.set_finished()
+    ready = [t for t in tasks if t.is_nascent]
+    scheduler = GlobalScheduler(env, cluster, FirstFitPolicy(), seed=seed)
+    return TickContext(scheduler, ready, tick_seq=0)
+
+
+def fresh_ctx_pair(meta, shapes, groups_fn, seed=0):
+    return (
+        make_ctx(meta, shapes, groups_fn(), seed),
+        make_ctx(meta, shapes, groups_fn(), seed),
+    )
+
+
+SHAPES = [(4, 4096, 100, 1), (8, 8192, 100, 1), (2, 2048, 100, 1), (16, 16384, 100, 2)]
+
+
+def mixed_groups():
+    return [
+        TaskGroup("a", cpus=2, mem=1024, runtime=5, instances=3),
+        TaskGroup("b", cpus=4, mem=4096, runtime=5, instances=2),
+        TaskGroup("c", cpus=1, mem=512, runtime=5, instances=4),
+    ]
+
+
+def test_first_fit_prefers_first_host(meta):
+    ctx = make_ctx(meta, SHAPES, [TaskGroup("g", cpus=2, mem=1024, runtime=1)])
+    p = FirstFitPolicy(mode="numpy").place(ctx)
+    assert p.tolist() == [0]
+
+
+def test_first_fit_skips_small_host(meta):
+    ctx = make_ctx(meta, SHAPES, [TaskGroup("g", cpus=6, mem=4096, runtime=1)])
+    p = FirstFitPolicy(mode="numpy").place(ctx)
+    assert p.tolist() == [1]  # host 0 (4 cpus) too small
+
+
+def test_best_fit_picks_tightest(meta):
+    # Demand 2 cpus/1024 mem: host 2 (2 cpus, 2048 mem) fails strict >;
+    # tightest strict fit is host 0.
+    ctx = make_ctx(meta, SHAPES, [TaskGroup("g", cpus=2, mem=1024, runtime=1)])
+    p = BestFitPolicy(mode="numpy").place(ctx)
+    assert p.tolist() == [0]
+
+
+def test_best_fit_strict_inequality(meta):
+    # Exact-fit host is rejected by the strict > rule (reference quirk).
+    ctx = make_ctx(meta, [(2, 1024, 100, 1)], [TaskGroup("g", cpus=2, mem=512, runtime=1)])
+    p = BestFitPolicy(mode="numpy").place(ctx)
+    assert p.tolist() == [-1]
+
+
+def test_decreasing_sort_changes_order(meta):
+    # Big task placed first under decreasing => takes the big host.
+    groups = [
+        TaskGroup("small", cpus=1, mem=512, runtime=1),
+        TaskGroup("big", cpus=14, mem=16000, runtime=1),
+    ]
+    ctx = make_ctx(meta, SHAPES, groups)
+    p = FirstFitPolicy(decreasing=True, mode="numpy").place(ctx)
+    assert p.tolist()[1] == 3  # big -> host 3
+
+
+def test_opportunistic_only_places_on_fitting_hosts(meta):
+    for mode in ("naive", "numpy"):
+        ctx = make_ctx(meta, SHAPES, mixed_groups(), seed=3)
+        p = OpportunisticPolicy(mode).place(ctx)
+        avail = make_ctx(meta, SHAPES, mixed_groups(), seed=3).avail
+        demands = ctx.demands
+        # replay: every placement fit at its time (final avail >= 0)
+        for i, h in enumerate(p):
+            if h >= 0:
+                avail[h] -= demands[i]
+        assert np.all(avail >= 0)
+
+
+@pytest.mark.parametrize(
+    "mk",
+    [
+        lambda: FirstFitPolicy(decreasing=False),
+        lambda: FirstFitPolicy(decreasing=True),
+        lambda: BestFitPolicy(decreasing=False),
+        lambda: BestFitPolicy(decreasing=True),
+        lambda: CostAwarePolicy(sort_tasks=True, sort_hosts=True),
+        lambda: CostAwarePolicy(bin_pack="best-fit", sort_tasks=True),
+        lambda: CostAwarePolicy(sort_hosts=True, host_decay=True),
+    ],
+)
+def test_naive_numpy_placement_parity(meta, mk):
+    ctx_naive, ctx_numpy = fresh_ctx_pair(meta, SHAPES * 3, mixed_groups, seed=1)
+    pol_a, pol_b = mk(), mk()
+    pol_a.mode, pol_b.mode = "naive", "numpy"
+    pa = pol_a.place(ctx_naive)
+    pb = pol_b.place(ctx_numpy)
+    assert pa.tolist() == pb.tolist()
+
+
+def test_cost_aware_grouping_anchors_to_majority_pred(meta):
+    groups = [
+        TaskGroup("src", cpus=1, mem=512, runtime=1, output_size=100, instances=3),
+        TaskGroup("dst", cpus=1, mem=512, runtime=1, dependencies=["src"], instances=2),
+    ]
+    # Pin src tasks: two on host-0's zone, one on host-1's zone.
+    ctx = make_ctx(
+        meta, SHAPES, groups,
+        placements={"src/0": "host-0", "src/1": "host-0", "src/2": "host-1"},
+    )
+    pol = CostAwarePolicy()
+    grouping = pol.group_tasks(ctx)
+    anchors = list(grouping.keys())
+    assert len(anchors) == 1
+    anchor = anchors[0]
+    assert anchor.locality == ctx.cluster.get_host("host-0").locality
+
+
+def test_cost_aware_prefers_anchor_zone(meta):
+    """With sort_hosts, a host co-located with the anchor (zero egress cost)
+    wins over remote hosts."""
+    groups = [
+        TaskGroup("src", cpus=1, mem=512, runtime=1, output_size=100),
+        TaskGroup("dst", cpus=1, mem=512, runtime=1, dependencies=["src"]),
+    ]
+    shapes = [(8, 8192, 100, 1)] * 4
+    # Hosts in four distinct regions (zone idx 0/3/6/8: us-east-1, us-east-2,
+    # us-west-1... ) so egress costs differ; anchor at host-2's region.
+    ctx = make_ctx(
+        meta, shapes, groups,
+        placements={"src/0": "host-2"}, zone_idx=[0, 3, 8, 11],
+    )
+    p = CostAwarePolicy(sort_hosts=True, mode="numpy").place(ctx)
+    # host-2 shares the anchor's region: zero egress cost => best score.
+    assert p.tolist() == [2]
