@@ -1,0 +1,241 @@
+"""Benchmark: placement-decision throughput, TPU kernel vs naive Python.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "decisions/sec", "vs_baseline": N, ...}
+
+The measured quantity is the north-star hot loop (BASELINE.md): the
+cost-aware (PIVOT) placement decision over a ready-task × host batch —
+fit mask + score + argmin with greedy within-tick state updates.
+
+  * baseline — the reference-faithful naive Python policy
+    (``CostAwarePolicy(mode='naive')``, mirroring
+    ``scheduler/cost_aware.py:99-127``) on one T×H batch.
+  * device   — the fused ``cost_aware_kernel`` (``lax.scan`` + masked
+    argmin) vmapped over a Monte-Carlo ensemble of R perturbed replicas,
+    i.e. R×T decisions per call — the workload class the reference cannot
+    express at all (it fans out OS processes per run instead,
+    ``alibaba/sim.py:187-195``).
+
+Scale: T=2048 ready tasks, H=512 hosts, R=64 replicas (~64× the reference's
+canonical 100-host experiment's busiest tick).
+
+A watchdog falls back to the CPU backend if accelerator initialization
+stalls (single-tenant tunnel), so the driver always gets its JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def _build_batch(n_hosts: int, n_tasks: int, seed: int):
+    """Realistic tick batch from the framework's own infra + trace stats."""
+    import numpy as np
+
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.sched import GlobalScheduler, TickContext
+    from pivot_tpu.sched.policies import CostAwarePolicy
+    from pivot_tpu.workload import Application, TaskGroup
+
+    rng = np.random.default_rng(seed)
+    meta = ResourceMetadata(seed=seed)
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=seed,
+    )
+    cluster = gen.generate(n_hosts)
+    # Alibaba-trace-like demands: cpus ∈ {0.5, 1, 2, 4}, mem fractional.
+    groups = []
+    remaining = n_tasks
+    gi = 0
+    while remaining > 0:
+        inst = int(min(remaining, rng.integers(1, 64)))
+        groups.append(
+            TaskGroup(
+                str(gi),
+                cpus=float(rng.choice([0.5, 1.0, 2.0, 4.0])),
+                mem=float(rng.uniform(0.05, 0.9)) * 7864.32,
+                runtime=float(rng.integers(1, 300)),
+                output_size=float(rng.uniform(0, 0.9)) * 1000,
+                instances=inst,
+            )
+        )
+        remaining -= inst
+        gi += 1
+    app = Application("bench", groups)
+    tasks = [t for g in app.groups for t in g.materialize_tasks()]
+    # Partially loaded cluster: consume a random slice of each host.
+    for h in cluster.hosts:
+        r = h.resource
+        frac = rng.uniform(0, 0.7)
+        r.cpus -= frac * r.t_cpus
+        r.mem -= frac * r.t_mem
+    scheduler = GlobalScheduler(
+        cluster.env, cluster, CostAwarePolicy(mode="naive"), seed=seed
+    )
+    ctx = TickContext(scheduler, tasks, tick_seq=0)
+    return ctx
+
+
+def _bench_naive(ctx, repeats: int = 3) -> float:
+    """Decisions/sec of the reference-faithful Python loop."""
+    from pivot_tpu.sched.policies import CostAwarePolicy
+
+    best = float("inf")
+    for _ in range(repeats):
+        policy = CostAwarePolicy(sort_tasks=True, sort_hosts=True, mode="naive")
+        avail0 = ctx.avail.copy()
+        t0 = time.perf_counter()
+        policy.place(ctx)
+        best = min(best, time.perf_counter() - t0)
+        ctx.avail[:] = avail0  # restore for the next round
+    return ctx.n_tasks / best
+
+
+def _bench_device(ctx, n_replicas: int, repeats: int = 5):
+    """Decisions/sec of the vmapped fused kernel over a perturbed ensemble."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from pivot_tpu.ops.kernels import DeviceTopology, cost_aware_kernel
+    from pivot_tpu.sched.policies import CostAwarePolicy
+    from pivot_tpu.sched.tpu import pad_bucket
+
+    topo = DeviceTopology.from_cluster(ctx.cluster, jnp.float32)
+    T, H, R = ctx.n_tasks, ctx.n_hosts, n_replicas
+    B = pad_bucket(T)
+
+    grouper = CostAwarePolicy(sort_tasks=True, sort_hosts=True)
+    groups = grouper.group_tasks(ctx)
+    order, anchor_zone, new_group = [], [], []
+    storage_zones = ctx.cluster.storage_zone_vector()
+    rng = np.random.default_rng(0)
+    for anchor, idxs in groups.items():
+        az = (
+            ctx.meta.zone_index[anchor.locality]
+            if hasattr(anchor, "locality")
+            else int(rng.choice(storage_zones))
+        )
+        for j, i in enumerate(idxs):
+            order.append(i)
+            anchor_zone.append(az)
+            new_group.append(j == 0)
+
+    dem = np.zeros((B, 4), dtype=np.float32)
+    dem[:T] = ctx.demands[order]
+    valid = np.zeros(B, dtype=bool)
+    valid[:T] = True
+    az_arr = np.zeros(B, dtype=np.int32)
+    az_arr[:T] = anchor_zone
+    ng_arr = np.zeros(B, dtype=bool)
+    ng_arr[:T] = new_group
+
+    # Monte-Carlo ensemble: perturb availability ±10% per replica.
+    repl_rng = np.random.default_rng(1)
+    avail_r = (
+        ctx.avail[None, :, :] * repl_rng.uniform(0.9, 1.1, size=(R, H, 1))
+    ).astype(np.float32)
+
+    kernel = jax.jit(
+        jax.vmap(
+            lambda a: cost_aware_kernel(
+                a,
+                jnp.asarray(dem),
+                jnp.asarray(valid),
+                jnp.asarray(ng_arr),
+                jnp.asarray(az_arr),
+                topo.cost,
+                topo.bw,
+                topo.host_zone,
+                jnp.zeros(H, dtype=jnp.int32),
+                bin_pack="first-fit",
+                sort_hosts=True,
+                host_decay=False,
+            )
+        )
+    )
+    avail_dev = jnp.asarray(avail_r)
+    placements, _ = kernel(avail_dev)  # compile + warm
+    placements.block_until_ready()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        placements, _ = kernel(avail_dev)
+        placements.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return (R * T) / best, placements
+
+
+def main() -> None:
+    backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
+    if backend_override:
+        import jax
+
+        jax.config.update("jax_platforms", backend_override)
+
+    # Watchdog: if accelerator init stalls (wedged tunnel), restart on CPU;
+    # if even the CPU run stalls, emit an error line rather than dying mute.
+    import signal
+
+    def _stall(_sig, _frm):
+        if os.environ.get("PIVOT_BENCH_BACKEND"):
+            print(
+                json.dumps(
+                    {
+                        "metric": "cost-aware placement decisions/sec",
+                        "value": 0,
+                        "unit": "decisions/sec",
+                        "vs_baseline": 0,
+                        "error": "benchmark timed out",
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(1)
+        os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+
+    if hasattr(signal, "SIGALRM"):
+        signal.signal(signal.SIGALRM, _stall)
+        if not backend_override:
+            signal.alarm(240)
+
+    import jax
+
+    backend = jax.default_backend()
+    if hasattr(signal, "SIGALRM"):
+        signal.alarm(600)
+
+    H, T, R = 512, 2048, 64
+    ctx = _build_batch(H, T, seed=7)
+    naive_dps = _bench_naive(ctx)
+    device_dps, _ = _bench_device(ctx, R)
+    if hasattr(signal, "SIGALRM"):
+        signal.alarm(0)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "cost-aware placement decisions/sec "
+                    f"(T={T} tasks x H={H} hosts, {R}-replica vmapped ensemble)"
+                ),
+                "value": round(device_dps, 1),
+                "unit": "decisions/sec",
+                "vs_baseline": round(device_dps / naive_dps, 2),
+                "baseline_decisions_per_sec": round(naive_dps, 1),
+                "backend": backend,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Fused placement kernels (JAX) — the TPU decision backend."""
+
+from pivot_tpu.ops.kernels import (  # noqa: F401
+    DeviceTopology,
+    best_fit_kernel,
+    cost_aware_kernel,
+    first_fit_kernel,
+    opportunistic_kernel,
+)

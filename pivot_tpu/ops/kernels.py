@@ -1,0 +1,252 @@
+"""Fused fit / score / argmin placement kernels.
+
+This is the TPU decision backend demanded by the north star (BASELINE.md):
+each scheduling tick evaluates all ready-task × host placements in a single
+device call.  The greedy *sequential* semantics of the reference policies
+(each placement decrements availability seen by the next task —
+``scheduler/vbp.py``, ``scheduler/cost_aware.py:99-127``) are preserved by a
+``lax.scan`` over the task axis carrying the ``[H, 4]`` availability matrix;
+everything per-step is a fused mask + argmin over hosts.
+
+Design notes (TPU-first):
+  * **No data-dependent shapes**: the task axis is padded to a bucket size
+    by the caller (``pivot_tpu.sched.tpu``) with ``valid=False`` rows; the
+    kernel is compiled once per (bucket, H) pair.
+  * **No on-device RNG**: the opportunistic policy's random choice consumes
+    a Philox uniform stream generated host-side (``sched/rand.py``), so CPU
+    and TPU backends make bit-identical choices.
+  * **First-fit over a sorted host list ≡ masked argmin**: for a host order
+    sorted by a per-group score (stable), the first fitting host is exactly
+    the fitting host minimizing ``(score, host_index)`` — so the kernel
+    never materializes a sort; it freezes the group's score vector when the
+    scan enters a new group and takes a masked argmin per task
+    (ties → lowest index, matching a stable sort).
+  * ``argmin``/``argmax`` tie-breaking to the lowest index is the shared
+    tie rule across the numpy policies and these kernels.
+
+Dtype: float32 on TPU.  Exact cross-backend placement parity is validated
+on CPU with x64 enabled; on TPU, f32 rounding can flip near-boundary fits
+— accepted, since the acceptance criterion is identical makespan/cost
+*rankings* (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "DeviceTopology",
+    "opportunistic_kernel",
+    "first_fit_kernel",
+    "best_fit_kernel",
+    "cost_aware_kernel",
+]
+
+
+class DeviceTopology(NamedTuple):
+    """Device-resident cluster topology, pushed once per experiment.
+
+    The reference re-derives per-pair route bandwidth from Python dicts on
+    every score evaluation (``scheduler/cost_aware.py:73-79``); here the
+    ``[Z, Z]`` matrices live on the accelerator and are gathered by zone
+    index inside the kernel.
+    """
+
+    cost: jax.Array  # [Z, Z] egress $ / GB
+    bw: jax.Array  # [Z, Z] Mbps
+    host_zone: jax.Array  # [H] i32
+    totals: jax.Array  # [H, 4]
+
+    @classmethod
+    def from_cluster(cls, cluster, dtype=jnp.float32) -> "DeviceTopology":
+        meta = cluster.meta
+        return cls(
+            cost=jnp.asarray(meta.cost_matrix, dtype=dtype),
+            bw=jnp.asarray(meta.bw_matrix, dtype=dtype),
+            host_zone=jnp.asarray(cluster.host_zone_vector(), dtype=jnp.int32),
+            totals=jnp.asarray(cluster.totals_matrix(), dtype=dtype),
+        )
+
+    @property
+    def n_hosts(self) -> int:
+        return self.host_zone.shape[0]
+
+
+def _fits(avail: jax.Array, demand: jax.Array, strict: bool) -> jax.Array:
+    """[H] fit mask: every dimension satisfies avail (>|>=) demand."""
+    if strict:
+        return jnp.all(avail > demand, axis=1)
+    return jnp.all(avail >= demand, axis=1)
+
+
+def _norms(mat: jax.Array) -> jax.Array:
+    return jnp.sqrt(jnp.sum(mat * mat, axis=-1))
+
+
+def _place(avail, demand, h, ok):
+    """Decrement row ``h`` by ``demand`` when ``ok`` (no-op otherwise)."""
+    delta = jnp.where(ok, demand, jnp.zeros_like(demand))
+    return avail.at[h].add(-delta)
+
+
+@jax.jit
+def opportunistic_kernel(avail, demands, valid, uniforms):
+    """Uniformly random fitting host per task (ref opportunistic.py:11-20).
+
+    The k-th fitting host (k = ⌊u·n_fit⌋) is selected via a cumulative-sum
+    rank match — no host list materialization.
+    Returns ([T] int32 placements, [H,4] new availability).
+    """
+
+    def body(avail, x):
+        demand, valid_i, u = x
+        fit = _fits(avail, demand, strict=False) & valid_i
+        n_fit = jnp.sum(fit)
+        k = jnp.minimum((u * n_fit).astype(jnp.int32), n_fit - 1)
+        rank = jnp.cumsum(fit)  # 1-based rank among fitting hosts
+        h = jnp.argmax(fit & (rank == k + 1))
+        ok = n_fit > 0
+        return _place(avail, demand, h, ok), jnp.where(ok, h, -1).astype(jnp.int32)
+
+    return _scan_swap(body, avail, (demands, valid, uniforms))
+
+
+@functools.partial(jax.jit, static_argnames=("strict",))
+def first_fit_kernel(avail, demands, valid, strict=False):
+    """Lowest-index fitting host per task (ref vbp.py:6-29)."""
+
+    def body(avail, x):
+        demand, valid_i = x
+        fit = _fits(avail, demand, strict) & valid_i
+        h = jnp.argmax(fit)
+        ok = jnp.any(fit)
+        return _place(avail, demand, h, ok), jnp.where(ok, h, -1).astype(jnp.int32)
+
+    return _scan_swap(body, avail, (demands, valid))
+
+
+@jax.jit
+def best_fit_kernel(avail, demands, valid):
+    """Min residual-L2 host among strict fits (ref vbp.py:32-49)."""
+    big = jnp.asarray(jnp.inf, avail.dtype)
+
+    def body(avail, x):
+        demand, valid_i = x
+        fit = _fits(avail, demand, strict=True) & valid_i
+        residual = _norms(avail - demand)
+        h = jnp.argmin(jnp.where(fit, residual, big))
+        ok = jnp.any(fit)
+        return _place(avail, demand, h, ok), jnp.where(ok, h, -1).astype(jnp.int32)
+
+    return _scan_swap(body, avail, (demands, valid))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("bin_pack", "sort_hosts", "host_decay"),
+)
+def cost_aware_kernel(
+    avail,
+    demands,
+    valid,
+    new_group,
+    anchor_zone,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    base_task_counts,
+    bin_pack: str = "first-fit",
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+):
+    """The PIVOT cost-aware placement (ref cost_aware.py:28-127), fused.
+
+    Inputs (task axis T padded, host axis H, zone axis Z):
+      demands          [T, 4]  — tasks pre-ordered by the caller: groups in
+                                 first-seen order, optionally sorted
+                                 descending by demand norm within a group
+      valid            [T]     — padding mask
+      new_group        [T]     — True where task i starts a new anchor group
+      anchor_zone      [T] i32 — zone index of each task's anchor storage
+      cost_zz, bw_zz   [Z, Z]  — device-resident egress-cost / bandwidth
+                                 matrices (from :class:`DeviceTopology`)
+      host_zone        [H] i32
+      base_task_counts [H]     — tasks resident per host at tick start
+
+    Round-trip cost/bandwidth per (anchor-zone, host) are precomputed once
+    as ``[Z, H]`` tables outside the scan, so per tick only the ``[T]``
+    anchor-zone vector crosses host→device.
+
+    First-fit: the group's host score ``cost·decay / (‖avail‖·bw)`` is
+    frozen when the scan enters the group (matching the reference's
+    sort-at-group-start, which sees availability mutated by *earlier*
+    groups in the same tick); placement is a masked argmin with strict
+    fits (first-fit over a stably-sorted list ≡ masked argmin).  Best-fit:
+    per-task score ``cost·‖avail−d‖·decay / bw`` over non-strict fits,
+    with a live placement counter in the decay.
+    """
+    H = avail.shape[0]
+    big = jnp.asarray(jnp.inf, avail.dtype)
+    first_fit = bin_pack == "first-fit"
+    base_counts = base_task_counts.astype(avail.dtype)
+    # [Z, H] round-trip tables: anchor-zone z ↔ each host.
+    cost_rt = cost_zz[:, host_zone] + cost_zz[host_zone, :].T
+    bw_rt = bw_zz[:, host_zone] + bw_zz[host_zone, :].T
+
+    def group_score(avail, cost_row, bw_row):
+        if not sort_hosts:
+            return jnp.arange(H, dtype=avail.dtype)  # identity host order
+        decay = jnp.maximum(base_counts, 1.0) if host_decay else 1.0
+        return cost_row * decay / (_norms(avail) * bw_row)
+
+    def body(carry, x):
+        avail, frozen_score, extra = carry
+        demand, valid_i, new_g, az = x
+        cost_row = cost_rt[az]
+        bw_row = bw_rt[az]
+        if first_fit:
+            score = jnp.where(
+                new_g, group_score(avail, cost_row, bw_row), frozen_score
+            )
+            fit = _fits(avail, demand, strict=True) & valid_i
+            h = jnp.argmin(jnp.where(fit, score, big))
+        else:
+            score = frozen_score  # unused carry for best-fit
+            residual = _norms(avail - demand)
+            decay = (
+                jnp.maximum(base_counts + extra.astype(avail.dtype), 1.0)
+                if host_decay
+                else 1.0
+            )
+            per_task = cost_row * residual * decay / bw_row
+            fit = _fits(avail, demand, strict=False) & valid_i
+            h = jnp.argmin(jnp.where(fit, per_task, big))
+        ok = jnp.any(fit)
+        avail = _place(avail, demand, h, ok)
+        if not first_fit:
+            # Only best-fit's live decay reads the within-tick counter
+            # (first-fit decay is frozen at tick start, ref :115).
+            extra = extra.at[h].add(jnp.where(ok, 1, 0))
+        return (avail, score, extra), jnp.where(ok, h, -1).astype(jnp.int32)
+
+    init = (
+        avail,
+        jnp.zeros(H, dtype=avail.dtype),
+        jnp.zeros(H, dtype=jnp.int32),
+    )
+    (avail, _, _), placements = lax.scan(
+        body, init, (demands, valid, new_group, anchor_zone)
+    )
+    return placements, avail
+
+
+def _scan_swap(body, avail, xs):
+    new_avail, placements = lax.scan(body, avail, xs)
+    return placements, new_avail

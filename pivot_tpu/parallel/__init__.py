@@ -1,0 +1,3 @@
+"""Multi-device scaling: meshes, sharded ensemble scheduling, rollouts."""
+
+from pivot_tpu.parallel.mesh import build_mesh  # noqa: F401

@@ -1,0 +1,299 @@
+"""Device-resident Monte-Carlo ensemble rollouts of DAG scheduling.
+
+The capability the reference cannot express: evaluating a placement policy
+under R perturbed what-if scenarios *simultaneously*.  The reference's only
+tool is forking one OS process per experiment run (``alibaba/runner.py:13``,
+``alibaba/sim.py:187-195``); here the whole rollout — readiness tracking,
+anchor voting, cost-aware placement, transfer/compute timing — is a single
+jitted ``lax.while_loop`` over ticks, vmapped over replicas, shardable over
+a device mesh (BASELINE.json configs 4-5: 1024 vmapped replicas with
+perturbed runtimes / arrival times).
+
+Execution model (deliberately simplified vs the event simulator — this is
+the *ensemble estimator*, not the ground-truth DES; use
+``pivot_tpu.experiments.runner`` for exact simulation):
+
+  * Time advances in fixed scheduler ticks (the reference's 5 s grid).
+  * A task becomes ready when its arrival time has passed and every
+    predecessor instance is finished (readiness = one [T, T] bool matmul).
+  * Placement: the same fused cost-aware kernel as the live scheduler
+    (``pivot_tpu.ops.kernels.cost_aware_kernel``), anchors from an
+    on-device majority vote over predecessor placement zones
+    (one-hot matmul + argmax — MXU work, mirroring
+    ``scheduler/cost_aware.py:45-58``).
+  * Transfer time: propagation delay ``size / bw(zone→zone)`` (the same
+    estimate the reference's scheduler uses for scoring;
+    ``resources/__init__.py:327-331``); no packet-level congestion.
+  * Egress cost: Σ over DAG edges of ``cost(zone_src → zone_dst) ×
+    output_mb / 8000`` (``resources/__init__.py:565-569``).
+
+Monte-Carlo axes: per-replica multiplicative jitter on task runtimes and
+arrivals, and independent random root anchors.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pivot_tpu.ops.kernels import DeviceTopology, cost_aware_kernel
+
+__all__ = ["EnsembleWorkload", "RolloutResult", "rollout", "sharded_rollout"]
+
+
+class EnsembleWorkload(NamedTuple):
+    """Dense, instance-level workload description (static across replicas).
+
+    Built from an :class:`pivot_tpu.workload.Application` (or several) via
+    :func:`EnsembleWorkload.from_applications`; every task-group instance
+    becomes one row.
+    """
+
+    demands: jax.Array  # [T, 4]
+    runtime: jax.Array  # [T]
+    output_size: jax.Array  # [T]
+    arrival: jax.Array  # [T] submission time of the owning app
+    pred: jax.Array  # [T, T] f32 — pred[i, p] = 1 iff p precedes i
+
+    @property
+    def n_tasks(self) -> int:
+        return self.runtime.shape[0]
+
+    @classmethod
+    def from_applications(cls, apps, arrivals=None, dtype=jnp.float32):
+        """Flatten applications to instance level.
+
+        Every instance of a group depends on every instance of each
+        predecessor group (the ensemble estimator's conservative stand-in
+        for the DES's sampled 1/n-instance pulls,
+        ``resources/__init__.py:263-267``).
+        """
+        demands, runtime, output, arrival, spans = [], [], [], [], []
+        offset = 0
+        edges = []
+        for ai, app in enumerate(apps):
+            at = float(arrivals[ai]) if arrivals is not None else 0.0
+            index = {}
+            for g in app.groups:
+                index[g.id] = (offset, g.instances)
+                for _ in range(g.instances):
+                    demands.append([g.cpus, g.mem, g.disk, g.gpus])
+                    runtime.append(g.runtime)
+                    output.append(g.output_size)
+                    arrival.append(at)
+                offset += g.instances
+            for g in app.groups:
+                gs, gn = index[g.id]
+                for dep in g.dependencies:
+                    ps, pn = index[dep]
+                    edges.append(((gs, gn), (ps, pn)))
+        T = offset
+        pred = np.zeros((T, T), dtype=np.float32)
+        for (gs, gn), (ps, pn) in edges:
+            pred[gs : gs + gn, ps : ps + pn] = 1.0
+        return cls(
+            demands=jnp.asarray(np.array(demands), dtype=dtype),
+            runtime=jnp.asarray(np.array(runtime), dtype=dtype),
+            output_size=jnp.asarray(np.array(output), dtype=dtype),
+            arrival=jnp.asarray(np.array(arrival), dtype=dtype),
+            pred=jnp.asarray(pred, dtype=dtype),
+        )
+
+
+class RolloutResult(NamedTuple):
+    makespan: jax.Array  # [R]
+    egress_cost: jax.Array  # [R]
+    finish_time: jax.Array  # [R, T]
+    placement: jax.Array  # [R, T] host index
+    n_unfinished: jax.Array  # [R] tasks still pending at the horizon
+
+
+# Task stages.
+_PENDING, _RUNNING, _DONE = 0, 1, 2
+
+
+def _single_rollout(
+    avail0,  # [H, 4]
+    runtime,  # [T] perturbed
+    arrival,  # [T] perturbed
+    root_anchor,  # [T] i32 random storage zone per task (used for roots)
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    max_ticks: int,
+):
+    T = workload.n_tasks
+    H = avail0.shape[0]
+    Z = topo.cost.shape[0]
+    dtype = avail0.dtype
+    has_pred = jnp.sum(workload.pred, axis=1) > 0  # [T]
+
+    def cond(state):
+        t, stage, *_ = state
+        return (t < tick * max_ticks) & jnp.any(stage != _DONE)
+
+    def body(state):
+        t, stage, finish, place, avail = state
+
+        # 1. Retire finished tasks and refund their resources.
+        newly_done = (stage == _RUNNING) & (finish <= t)
+        refund_per_host = jax.ops.segment_sum(
+            workload.demands * newly_done[:, None].astype(dtype),
+            jnp.where(newly_done, place, H),
+            num_segments=H + 1,
+        )[:H]
+        avail = avail + refund_per_host
+        stage = jnp.where(newly_done, _DONE, stage)
+
+        # 2. Readiness: arrival passed ∧ all predecessor instances done.
+        done_f = (stage == _DONE).astype(dtype)
+        unfinished_preds = workload.pred @ (1.0 - done_f)  # [T]
+        ready = (stage == _PENDING) & (arrival <= t) & (unfinished_preds == 0)
+
+        # 3. Anchors: majority vote over predecessor placement zones
+        #    (one-hot matmul, ref cost_aware.py:45-58); roots use their
+        #    pre-drawn random storage zone.
+        place_zone = topo.host_zone[jnp.clip(place, 0, H - 1)]
+        placed_done = (stage == _DONE).astype(dtype)
+        zone_onehot = jax.nn.one_hot(place_zone, Z, dtype=dtype) * placed_done[:, None]
+        votes = workload.pred @ zone_onehot  # [T, Z]
+        majority_zone = jnp.argmax(votes, axis=1).astype(jnp.int32)
+        anchor = jnp.where(has_pred, majority_zone, root_anchor)
+
+        # 4. Placement via the live scheduler's fused kernel.
+        placements, avail = cost_aware_kernel(
+            avail,
+            workload.demands,
+            ready,
+            jnp.ones(T, dtype=bool),  # every task is its own score group
+            anchor,
+            topo.cost,
+            topo.bw,
+            topo.host_zone,
+            jnp.zeros(H, dtype=jnp.int32),
+            bin_pack="first-fit",
+            sort_hosts=True,
+            host_decay=False,
+        )
+        placed = placements >= 0
+
+        # 5. Transfer estimate: max over predecessors of size / bw.
+        new_zone = topo.host_zone[jnp.clip(placements, 0, H - 1)]
+        bw_rows = topo.bw[place_zone[None, :], new_zone[:, None]]  # [T, T]
+        xfer = workload.pred * jnp.where(
+            bw_rows > 0, workload.output_size[None, :] / bw_rows, 0.0
+        )
+        xfer_delay = jnp.max(xfer, axis=1)  # [T]
+
+        stage = jnp.where(placed, _RUNNING, stage)
+        place = jnp.where(placed, placements, place)
+        finish = jnp.where(placed, t + xfer_delay + runtime, finish)
+
+        return (t + tick, stage, finish, place, avail)
+
+    state0 = (
+        jnp.asarray(0.0, dtype),
+        jnp.full((T,), _PENDING, dtype=jnp.int32),
+        jnp.full((T,), jnp.inf, dtype=dtype),
+        jnp.full((T,), -1, dtype=jnp.int32),
+        avail0,
+    )
+    t, stage, finish, place, avail = lax.while_loop(cond, body, state0)
+
+    done = stage == _DONE
+    makespan = jnp.max(jnp.where(done, finish, 0.0))
+    # Egress: Σ_edges cost(zone_p → zone_i) · output_mb(p) / 8000, counting
+    # only edges whose BOTH endpoints were actually placed (an unplaced
+    # consumer at the horizon must not be billed as if on host 0).
+    pz = topo.host_zone[jnp.clip(place, 0, H - 1)]
+    placed = (place >= 0).astype(dtype)
+    edge_cost = topo.cost[pz[None, :], pz[:, None]]  # [T, T] p→i
+    edge_live = workload.pred * placed[:, None] * placed[None, :]
+    egress = jnp.sum(edge_live * edge_cost * workload.output_size[None, :]) / 8000.0
+    return RolloutResult(
+        makespan=makespan,
+        egress_cost=egress,
+        finish_time=finish,
+        placement=place,
+        n_unfinished=jnp.sum(~done),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_replicas", "tick", "max_ticks", "perturb")
+)
+def rollout(
+    key,
+    avail0,  # [H, 4] initial availability (shared base)
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,  # [S] i32 candidate root-anchor zones
+    n_replicas: int = 64,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+) -> RolloutResult:
+    """Vmapped Monte-Carlo rollout: [R]-leading-axis results.
+
+    Replica r perturbs task runtimes and arrivals by ``±perturb`` and draws
+    independent random root anchors — the BASELINE.json ensemble configs.
+    """
+    T = workload.n_tasks
+    k_rt, k_arr, k_anchor = jax.random.split(key, 3)
+    rt = workload.runtime[None, :] * jax.random.uniform(
+        k_rt, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
+        dtype=avail0.dtype,
+    )
+    arr = workload.arrival[None, :] * jax.random.uniform(
+        k_arr, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
+        dtype=avail0.dtype,
+    )
+    anchor_idx = jax.random.randint(
+        k_anchor, (n_replicas, T), 0, storage_zones.shape[0]
+    )
+    root_anchor = storage_zones[anchor_idx].astype(jnp.int32)
+
+    return jax.vmap(
+        lambda r, a, ra: _single_rollout(
+            avail0, r, a, ra, workload, topo, tick, max_ticks
+        )
+    )(rt, arr, root_anchor)
+
+
+def sharded_rollout(
+    mesh,
+    key,
+    avail0,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    n_replicas: int = 64,
+    **kwargs,
+) -> RolloutResult:
+    """Rollout with the replica axis sharded over ``mesh`` ('replica' axis).
+
+    Inputs are replicated; per-replica state and all outputs are sharded
+    ``P('replica')`` — XLA partitions the vmapped while_loop across devices
+    with zero cross-replica traffic (embarrassingly parallel), and any
+    downstream ensemble statistics (means/quantiles over replicas) become
+    psums over ICI.
+    """
+    out_shard = NamedSharding(mesh, P("replica"))
+    fn = jax.jit(
+        functools.partial(rollout, n_replicas=n_replicas, **kwargs),
+        out_shardings=RolloutResult(
+            makespan=out_shard,
+            egress_cost=out_shard,
+            finish_time=NamedSharding(mesh, P("replica", None)),
+            placement=NamedSharding(mesh, P("replica", None)),
+            n_unfinished=out_shard,
+        ),
+    )
+    return fn(key, avail0, workload, topo, storage_zones)

@@ -1,0 +1,51 @@
+"""Device-mesh construction for the ensemble/scale path.
+
+The reference's only parallelism is OS-process fan-out of independent
+experiment runs (``alibaba/sim.py:187-195``, ``alibaba/runner.py:13-52``);
+the TPU-native equivalent shards work across a ``jax.sharding.Mesh``:
+
+  * ``replica`` axis — Monte-Carlo replicas / independent experiment runs
+    (the data-parallel axis of this domain).
+  * ``host`` axis — the simulated-host dimension of the state arrays
+    ([R, H, 4] availability, [T, H] score matrices), the model-parallel
+    axis for clusters too large for one chip's convenient working set.
+
+Collectives (all-gathers for the over-hosts argmin, psums for metric
+reductions) are inserted by XLA from sharding annotations — never written
+by hand.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["build_mesh"]
+
+
+def build_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Tuple[str, str] = ("replica", "host"),
+    host_parallel: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a 2-D (replica × host) mesh over the available devices.
+
+    ``host_parallel`` fixes the host-axis size (must divide the device
+    count); by default the mesh is replica-only (host axis = 1), which is
+    the right layout while per-replica state fits one chip — replicas are
+    embarrassingly parallel, so ICI traffic is zero.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    hp = host_parallel or 1
+    if n % hp != 0:
+        raise ValueError(f"host_parallel={hp} does not divide {n} devices")
+    import numpy as np
+
+    grid = np.array(devs).reshape(n // hp, hp)
+    return Mesh(grid, axis_names)

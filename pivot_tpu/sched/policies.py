@@ -45,6 +45,9 @@ def _norms(mat: np.ndarray) -> np.ndarray:
     return np.sqrt(np.sum(mat * mat, axis=-1))
 
 
+_NO_EXTRA = 0  # broadcast-zero "no placements yet" for frozen decay
+
+
 def _sort_decreasing(demands: np.ndarray, idxs: List[int]) -> List[int]:
     """Stable sort of task indices by descending demand L2 norm."""
     norms = _norms(demands[idxs])
@@ -274,25 +277,27 @@ class CostAwarePolicy(Policy):
                 idxs = _sort_decreasing(demands, idxs)
             cost_rt, bw_rt = self._roundtrip_vectors(ctx, anchor)
             if self.bin_pack == "first-fit":
-                self._first_fit(
-                    ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
-                )
+                self._first_fit(ctx, idxs, avail, demands, cost_rt, bw_rt, placements)
             else:
                 self._best_fit(
                     ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
                 )
         return placements
 
-    def _first_fit(
-        self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
-    ) -> None:
+    def _first_fit(self, ctx, idxs, avail, demands, cost_rt, bw_rt, placements) -> None:
         """Hosts sorted once per group by score, then greedy first strict fit
-        (ref ``:99-127``; scores use availability at sort time)."""
+        (ref ``:99-127``; scores use availability at sort time).
+
+        The decay factor is the host task count at *tick start* — the
+        reference reads ``len(h.tasks)``, which cannot change during a
+        synchronous schedule() call (``cost_aware.py:115``) — unlike
+        best-fit's live within-tick counter.
+        """
         if self.sort_hosts:
             with np.errstate(divide="ignore"):
                 score = (
                     cost_rt
-                    * self._decay(ctx, extra_tasks)
+                    * self._decay(ctx, _NO_EXTRA)
                     / (_norms(avail) * bw_rt)
                 )
             order = np.argsort(score, kind="stable")
@@ -304,7 +309,6 @@ class CostAwarePolicy(Policy):
                     if np.all(avail[h] > demands[i]):  # strict, ref :124
                         avail[h] -= demands[i]
                         placements[i] = h
-                        extra_tasks[h] += 1
                         break
         else:
             for i in idxs:
@@ -313,7 +317,6 @@ class CostAwarePolicy(Policy):
                     h = int(order[np.argmax(mask)])
                     avail[h] -= demands[i]
                     placements[i] = h
-                    extra_tasks[h] += 1
 
     def _best_fit(
         self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements

@@ -1,0 +1,213 @@
+"""Device-backed placement policies: the ``device='tpu'`` policy variants.
+
+Each policy wraps a fused kernel from :mod:`pivot_tpu.ops.kernels`.  Per
+scheduling tick the runtime hands over dense arrays (``TickContext``); the
+wrapper pads the task axis to a bucket size (so XLA compiles one program
+per (bucket, H) pair, never per tick), pushes the small per-tick inputs to
+the device, runs the scan kernel, and pulls back an ``[T] int32`` placement
+vector.  The ``[Z, Z]`` topology matrices are pushed once at bind time
+(:class:`DeviceTopology`).
+
+Cross-backend parity: these wrappers consume the same Philox uniforms and
+the same task pre-ordering as the numpy policies, so on CPU (x64) the
+placements are bit-identical; on TPU (f32) near-boundary fits may round
+differently, which the acceptance criterion tolerates (BASELINE.md —
+identical makespan/cost rankings).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pivot_tpu.ops.kernels import (
+    DeviceTopology,
+    best_fit_kernel,
+    cost_aware_kernel,
+    first_fit_kernel,
+    opportunistic_kernel,
+)
+from pivot_tpu.sched import Policy, TickContext
+from pivot_tpu.sched.policies import CostAwarePolicy, _sort_decreasing
+from pivot_tpu.sched.rand import tick_uniforms
+
+__all__ = [
+    "TpuOpportunisticPolicy",
+    "TpuFirstFitPolicy",
+    "TpuBestFitPolicy",
+    "TpuCostAwarePolicy",
+    "pad_bucket",
+]
+
+_BUCKETS = (8, 32, 128, 512, 2048, 8192)
+
+
+def pad_bucket(n: int) -> int:
+    """Smallest bucket ≥ n (caps XLA program count at len(_BUCKETS))."""
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return ((n + 8191) // 8192) * 8192
+
+
+class _DevicePolicyBase(Policy):
+    """Shared bind/pad machinery for device-backed policies."""
+
+    dtype = jnp.float32
+
+    def __init__(self):
+        self.topology: Optional[DeviceTopology] = None
+        self._scheduler = None
+
+    def bind(self, scheduler) -> None:
+        self._scheduler = scheduler
+        self.topology = DeviceTopology.from_cluster(scheduler.cluster, self.dtype)
+
+    def _padded(self, ctx: TickContext, order: Optional[List[int]] = None):
+        """(avail [H,4], demands [B,4], valid [B]) device-ready, task axis
+        padded to a bucket; ``order`` optionally permutes tasks."""
+        T = ctx.n_tasks
+        B = pad_bucket(T)
+        demands = ctx.demands if order is None else ctx.demands[order]
+        # Stage in the policy dtype — an f32 buffer here would quantize
+        # demands and break the f64 cross-backend parity contract.
+        dem = np.zeros((B, 4), dtype=np.dtype(self.dtype))
+        dem[:T] = demands
+        valid = np.zeros(B, dtype=bool)
+        valid[:T] = True
+        avail = jnp.asarray(ctx.avail, dtype=self.dtype)
+        return avail, jnp.asarray(dem, dtype=self.dtype), jnp.asarray(valid)
+
+    @staticmethod
+    def _unpad(placements, T: int, order: Optional[List[int]] = None) -> np.ndarray:
+        out = np.asarray(placements[:T]).astype(np.int64)
+        if order is None:
+            return out
+        unscrambled = np.full(T, -1, dtype=np.int64)
+        unscrambled[np.asarray(order)] = out
+        return unscrambled
+
+
+class TpuOpportunisticPolicy(_DevicePolicyBase):
+    name = "opportunistic_tpu"
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        T = ctx.n_tasks
+        avail, dem, valid = self._padded(ctx)
+        u = np.zeros(valid.shape[0], dtype=np.float64)
+        u[:T] = tick_uniforms(ctx.scheduler.seed or 0, ctx.tick_seq, T)
+        placements, _ = opportunistic_kernel(
+            avail, dem, valid, jnp.asarray(u, dtype=self.dtype)
+        )
+        return self._unpad(placements, T)
+
+
+class TpuFirstFitPolicy(_DevicePolicyBase):
+    name = "first_fit_tpu"
+
+    def __init__(self, decreasing: bool = False):
+        super().__init__()
+        self.decreasing = decreasing
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        T = ctx.n_tasks
+        order = None
+        if self.decreasing:
+            order = _sort_decreasing(ctx.demands, list(range(T)))
+        avail, dem, valid = self._padded(ctx, order)
+        placements, _ = first_fit_kernel(avail, dem, valid, strict=False)
+        return self._unpad(placements, T, order)
+
+
+class TpuBestFitPolicy(_DevicePolicyBase):
+    name = "best_fit_tpu"
+
+    def __init__(self, decreasing: bool = False):
+        super().__init__()
+        self.decreasing = decreasing
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        T = ctx.n_tasks
+        order = None
+        if self.decreasing:
+            order = _sort_decreasing(ctx.demands, list(range(T)))
+        avail, dem, valid = self._padded(ctx, order)
+        placements, _ = best_fit_kernel(avail, dem, valid)
+        return self._unpad(placements, T, order)
+
+
+class TpuCostAwarePolicy(_DevicePolicyBase):
+    """Cost-aware (PIVOT) placement on the device.
+
+    Anchor grouping stays host-side (it walks the DAG and is memoized per
+    task group — see ``CostAwarePolicy.group_tasks``); everything O(T × H)
+    runs in the fused kernel.
+    """
+
+    name = "cost_aware_tpu"
+
+    def __init__(
+        self,
+        bin_pack: str = "first-fit",
+        sort_tasks: bool = False,
+        sort_hosts: bool = False,
+        host_decay: bool = False,
+    ):
+        super().__init__()
+        assert bin_pack in ("first-fit", "best-fit")
+        self.bin_pack = bin_pack
+        self.sort_tasks = sort_tasks
+        self.sort_hosts = sort_hosts
+        self.host_decay = host_decay
+        # Grouping logic shared verbatim with the CPU policy.
+        self._grouper = CostAwarePolicy(
+            bin_pack=bin_pack,
+            sort_tasks=sort_tasks,
+            sort_hosts=sort_hosts,
+            host_decay=host_decay,
+        )
+
+    def place(self, ctx: TickContext) -> np.ndarray:
+        T = ctx.n_tasks
+        meta = ctx.meta
+        storage = ctx.cluster.storage
+        groups = self._grouper.group_tasks(ctx)
+
+        order: List[int] = []
+        anchor_zone = []
+        new_group = []
+        for anchor, idxs in groups.items():
+            if not hasattr(anchor, "locality"):  # root group → random storage
+                anchor = storage[int(ctx.scheduler.randomizer.choice(len(storage)))]
+            if self.sort_tasks:
+                idxs = _sort_decreasing(ctx.demands, idxs)
+            az = meta.zone_index[anchor.locality]
+            for j, i in enumerate(idxs):
+                order.append(i)
+                anchor_zone.append(az)
+                new_group.append(j == 0)
+
+        B = pad_bucket(T)
+        az_arr = np.zeros(B, dtype=np.int32)
+        az_arr[:T] = anchor_zone
+        ng_arr = np.zeros(B, dtype=bool)
+        ng_arr[:T] = new_group
+        avail, dem, valid = self._padded(ctx, order)
+        placements, _ = cost_aware_kernel(
+            avail,
+            dem,
+            valid,
+            jnp.asarray(ng_arr),
+            jnp.asarray(az_arr),
+            self.topology.cost,
+            self.topology.bw,
+            self.topology.host_zone,
+            jnp.asarray(ctx.host_task_counts, dtype=jnp.int32),
+            bin_pack=self.bin_pack,
+            sort_hosts=self.sort_hosts,
+            host_decay=self.host_decay,
+        )
+        return self._unpad(placements, T, order)

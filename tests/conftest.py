@@ -7,12 +7,25 @@ TPU chip is only used by ``bench.py``.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the session environment pins JAX_PLATFORMS to the real
+# accelerator backend; tests must never initialize it (single-tenant
+# tunnel — a test grabbing it wedges the chip for the benchmark).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The accelerator site package force-updates jax_platforms at interpreter
+# start (beating the env var), so override at the config level too: tests
+# must never dial the single-tenant accelerator tunnel.
+jax.config.update("jax_platforms", "cpu")
+# Exact cross-backend placement parity is validated in f64 on the CPU
+# backend; TPU runs use f32 (see pivot_tpu/ops/kernels.py docstring).
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

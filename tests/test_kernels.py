@@ -1,0 +1,212 @@
+"""Golden parity tests: fused device kernels vs the numpy policies.
+
+The north-star acceptance criterion (BASELINE.md / SURVEY.md §4): the TPU
+decision backend must reproduce the CPU policies' placement sequences.
+Here every kernel runs in f64 on the CPU backend against the numpy-mode
+policy on identical tick contexts — placements must be *bit-identical*,
+including random choices (shared Philox stream) and tie-breaking.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.sched import GlobalScheduler
+from pivot_tpu.sched.policies import (
+    BestFitPolicy,
+    CostAwarePolicy,
+    FirstFitPolicy,
+    OpportunisticPolicy,
+)
+from pivot_tpu.sched.tpu import (
+    TpuBestFitPolicy,
+    TpuCostAwarePolicy,
+    TpuFirstFitPolicy,
+    TpuOpportunisticPolicy,
+    pad_bucket,
+)
+from pivot_tpu.workload import Application, TaskGroup
+from pivot_tpu.workload.gen import RandomApplicationGenerator, _RangeSpec
+
+from tests.test_policies import SHAPES, make_ctx, mixed_groups
+
+
+@pytest.fixture(scope="module")
+def meta():
+    return ResourceMetadata(seed=0)
+
+
+def random_groups(seed, n=24):
+    rng = np.random.default_rng(seed)
+    groups = []
+    for i in range(n):
+        deps = []
+        if i > 2 and rng.random() < 0.4:
+            deps = [str(int(rng.integers(0, i)))]
+        groups.append(
+            TaskGroup(
+                str(i),
+                cpus=float(rng.choice([0.5, 1, 2, 4])),
+                mem=float(rng.choice([256, 512, 1024, 4096])),
+                runtime=float(rng.integers(1, 50)),
+                output_size=float(rng.choice([0, 100, 500])),
+                instances=int(rng.choice([1, 2, 5])),
+                dependencies=deps,
+            )
+        )
+    return lambda: [g.clone() for g in groups]
+
+
+def as_f64(policy):
+    policy.dtype = jnp.float64
+    return policy
+
+
+def pair_place(meta, cpu_policy, dev_policy, groups_fn, seed=0, shapes=None):
+    shapes = shapes or SHAPES * 4
+    ctx_cpu = make_ctx(meta, shapes, groups_fn(), seed)
+    ctx_dev = make_ctx(meta, shapes, groups_fn(), seed)
+    dev_policy = as_f64(dev_policy)
+    dev_policy.bind(ctx_dev.scheduler)
+    p_cpu = cpu_policy.place(ctx_cpu)
+    p_dev = dev_policy.place(ctx_dev)
+    return p_cpu, p_dev, ctx_cpu, ctx_dev
+
+
+def test_pad_bucket():
+    assert pad_bucket(1) == 8
+    assert pad_bucket(8) == 8
+    assert pad_bucket(9) == 32
+    assert pad_bucket(2048) == 2048
+    assert pad_bucket(9000) == 16384
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_opportunistic_parity(meta, seed):
+    p_cpu, p_dev, *_ = pair_place(
+        meta,
+        OpportunisticPolicy("numpy"),
+        TpuOpportunisticPolicy(),
+        random_groups(seed),
+        seed=seed,
+    )
+    assert p_cpu.tolist() == p_dev.tolist()
+
+
+@pytest.mark.parametrize("decreasing", [False, True])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_first_fit_parity(meta, seed, decreasing):
+    p_cpu, p_dev, *_ = pair_place(
+        meta,
+        FirstFitPolicy(decreasing=decreasing, mode="numpy"),
+        TpuFirstFitPolicy(decreasing=decreasing),
+        random_groups(seed),
+        seed=seed,
+    )
+    assert p_cpu.tolist() == p_dev.tolist()
+
+
+@pytest.mark.parametrize("decreasing", [False, True])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_best_fit_parity(meta, seed, decreasing):
+    p_cpu, p_dev, *_ = pair_place(
+        meta,
+        BestFitPolicy(decreasing=decreasing, mode="numpy"),
+        TpuBestFitPolicy(decreasing=decreasing),
+        random_groups(seed),
+        seed=seed,
+    )
+    assert p_cpu.tolist() == p_dev.tolist()
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(sort_tasks=True, sort_hosts=True),
+        dict(sort_tasks=False, sort_hosts=True),
+        dict(sort_tasks=True, sort_hosts=False),
+        dict(bin_pack="best-fit", sort_tasks=True),
+        dict(sort_hosts=True, host_decay=True),
+        dict(bin_pack="best-fit", host_decay=True),
+    ],
+)
+@pytest.mark.parametrize("seed", [0, 5])
+def test_cost_aware_parity(meta, seed, kwargs):
+    p_cpu, p_dev, *_ = pair_place(
+        meta,
+        CostAwarePolicy(mode="numpy", **kwargs),
+        TpuCostAwarePolicy(**kwargs),
+        random_groups(seed),
+        seed=seed,
+    )
+    assert p_cpu.tolist() == p_dev.tolist()
+
+
+def test_cost_aware_parity_with_placed_predecessors(meta):
+    """Parity must also hold when anchors come from majority votes."""
+    groups = [
+        TaskGroup("src", cpus=1, mem=512, runtime=1, output_size=100, instances=5),
+        TaskGroup("mid", cpus=1, mem=512, runtime=1, output_size=50,
+                  dependencies=["src"], instances=3),
+        TaskGroup("dst", cpus=2, mem=1024, runtime=1, dependencies=["mid"]),
+    ]
+    placements = {"src/0": "host-1", "src/1": "host-1", "src/2": "host-2",
+                  "src/3": "host-5", "src/4": "host-1",
+                  "mid/0": "host-2", "mid/1": "host-2", "mid/2": "host-7"}
+
+    def build(idx):
+        from pivot_tpu.utils import reset_ids
+
+        reset_ids()  # same host-N ids for both clusters
+        gs = [g.clone() for g in groups]
+        return make_ctx(meta, SHAPES * 3, gs, seed=2, placements=placements)
+
+    ctx_cpu, ctx_dev = build(0), build(1)
+    cpu = CostAwarePolicy(sort_tasks=True, sort_hosts=True, mode="numpy")
+    dev = as_f64(TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True))
+    dev.bind(ctx_dev.scheduler)
+    assert cpu.place(ctx_cpu).tolist() == dev.place(ctx_dev).tolist()
+
+
+def test_full_sim_parity_cost_aware(meta):
+    """End-to-end: a whole simulation with the device policy must produce
+    the same metrics as the numpy policy (CPU backend, f64)."""
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(20)
+    trace = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def run(policy):
+        s = ExperimentRun("parity", cluster, policy, trace, n_apps=20, seed=9).run()
+        return (s["avg_runtime"], s["egress_cost"], s["cum_instance_hours"])
+
+    m_cpu = run(CostAwarePolicy(sort_tasks=True, sort_hosts=True, mode="numpy"))
+    m_dev = run(as_f64(TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True)))
+    assert m_cpu == m_dev
+
+
+def test_full_sim_parity_opportunistic(meta):
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(20)
+    trace = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def run(policy):
+        s = ExperimentRun("parity", cluster, policy, trace, n_apps=15, seed=4).run()
+        return (s["avg_runtime"], s["egress_cost"], s["cum_instance_hours"])
+
+    assert run(OpportunisticPolicy("numpy")) == run(as_f64(TpuOpportunisticPolicy()))
