@@ -1,0 +1,216 @@
+"""Experiment CLI — the user-facing entry point.
+
+Capability parity with the reference's ``alibaba/sim.py`` (argparse flags
+``:20-52``, experiment drivers ``:168-230``): the ``overall`` and
+``num-apps`` subcommands run the three reference scheduler arms
+(Opportunistic / VBP / Cost-Aware) over every trace file in the job
+directory, write the per-run JSON metric layout, and render the matching
+plots.  Additions: ``--device {naive,numpy,tpu}`` selects the policy
+backend, ``--trace-limit`` bounds the grid, and runs execute sequentially
+by default (fork with ``--workers N`` like the reference's unconditional
+``multiprocessing`` fan-out, ``alibaba/sim.py:187-195``).
+
+Usage:
+  python -m pivot_tpu.experiments.cli --num-hosts 100 overall --num-apps 100
+  python -m pivot_tpu.experiments.cli num-apps --num-apps-list 100 500 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import time
+from typing import List
+
+from pivot_tpu.utils import get_logger
+from pivot_tpu.utils.config import (
+    ClusterConfig,
+    HostShape,
+    PolicyConfig,
+    build_cluster,
+    make_policy,
+    reference_policy_set,
+)
+
+logger = get_logger("cli")
+
+
+@dataclasses.dataclass(frozen=True)
+class RunSpec:
+    """One (policy × trace) run, fully described by picklable values so it
+    can cross a multiprocessing boundary under any start method (the run
+    rebuilds its cluster from the seeded config — cheap with lazy routes,
+    and deterministic, so every run sees the identical fabric)."""
+
+    cluster: ClusterConfig
+    policy: PolicyConfig
+    trace: str
+    data_dir: str
+    n_apps: int
+    scale_factor: float
+    seed: int
+
+
+def _execute_run(spec: RunSpec) -> None:
+    from pivot_tpu.experiments.runner import ExperimentRun
+
+    cluster = build_cluster(spec.cluster)
+    ExperimentRun(
+        spec.policy.display_label,
+        cluster,
+        make_policy(spec.policy),
+        spec.trace,
+        output_size_scale_factor=spec.scale_factor,
+        n_apps=spec.n_apps,
+        data_dir=spec.data_dir,
+        seed=spec.seed,
+    ).run()
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Run cost-aware scheduling simulations on Alibaba traces"
+    )
+    parser.add_argument("--num-hosts", type=int, dest="n_hosts", default=600)
+    parser.add_argument("--cpus", type=int, default=16)
+    parser.add_argument("--mem", type=int, default=128 * 1024, help="MB per host")
+    parser.add_argument("--disk", type=int, default=100, help="GB per host")
+    parser.add_argument("--gpus", type=int, default=1)
+    parser.add_argument(
+        "--job-dir", default=os.environ.get("JOB_DIR", "./data/jobs")
+    )
+    parser.add_argument(
+        "--output-dir", default=os.environ.get("OUTPUT_DIR", "./output")
+    )
+    parser.add_argument(
+        "--task-output-scale-factor", type=float, dest="scale_factor", default=1000
+    )
+    parser.add_argument(
+        "--device",
+        choices=["naive", "numpy", "tpu"],
+        default="numpy",
+        help="policy backend",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--workers", type=int, default=1,
+                        help="process-parallel runs (1 = sequential)")
+    parser.add_argument("--trace-limit", type=int, default=None,
+                        help="use only the first N trace files")
+    sub = parser.add_subparsers(dest="command")
+    overall = sub.add_parser("overall", help="overall comparison experiment")
+    overall.add_argument("--num-apps", type=int, dest="num_apps", default=None)
+    napps = sub.add_parser("num-apps", help="cost vs number of applications")
+    napps.add_argument("--host-hourly-rate", type=float, default=0.932)
+    napps.add_argument("--num-apps-list", nargs="+", type=int, required=True)
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        parser.exit(1)
+    return args
+
+
+def _list_traces(job_dir: str, limit=None) -> List[str]:
+    if not os.path.isdir(job_dir):
+        raise SystemExit(
+            f"error: job directory {job_dir!r} does not exist "
+            "(set --job-dir or the JOB_DIR env var)"
+        )
+    names = sorted(
+        f for f in os.listdir(job_dir) if f.endswith((".npz", ".yaml", ".yml"))
+    )
+    if not names:
+        raise SystemExit(f"error: no .npz/.yaml traces in {job_dir!r}")
+    # Prefer npz when both forms of the same trace exist.
+    stems = {}
+    for n in names:
+        stem = n.rsplit(".", 1)[0]
+        if stem not in stems or n.endswith(".npz"):
+            stems[stem] = n
+    out = [os.path.join(job_dir, n) for n in sorted(stems.values())]
+    return out[:limit] if limit else out
+
+
+def _run_grid(specs: List[RunSpec], workers: int):
+    """Execute runs sequentially or across worker processes."""
+    if workers <= 1:
+        for spec in specs:
+            _execute_run(spec)
+        return
+    import multiprocessing as mp
+
+    active = []
+    for spec in specs:
+        p = mp.Process(
+            target=_execute_run, args=(spec,), name=spec.policy.display_label
+        )
+        p.start()
+        active.append(p)
+        if len(active) >= workers:
+            for q in active:
+                q.join()
+            active = []
+    for q in active:
+        q.join()
+
+
+def _cluster_config(args) -> ClusterConfig:
+    return ClusterConfig(
+        n_hosts=args.n_hosts,
+        shape=HostShape(args.cpus, args.mem, args.disk, args.gpus),
+        seed=args.seed,
+    )
+
+
+def run_overall(args) -> str:
+    exp_dir = os.path.join(args.output_dir, "overall", str(int(time.time())))
+    os.makedirs(exp_dir, exist_ok=True)
+    cluster_cfg = _cluster_config(args)
+    traces = _list_traces(args.job_dir, args.trace_limit)
+    policy_set = reference_policy_set(args.device)
+    specs = [
+        RunSpec(cluster_cfg, pc, trace, os.path.join(exp_dir, "data", str(i)),
+                args.num_apps, args.scale_factor, args.seed)
+        for i, trace in enumerate(traces)
+        for pc in policy_set
+    ]
+    logger.info("overall: %d runs (%d traces × %d policies) → %s",
+                len(specs), len(traces), len(policy_set), exp_dir)
+    _run_grid(specs, args.workers)
+    return exp_dir
+
+
+def run_num_apps(args) -> str:
+    exp_dir = os.path.join(args.output_dir, "n_app", str(int(time.time())))
+    os.makedirs(exp_dir, exist_ok=True)
+    cluster_cfg = _cluster_config(args)
+    traces = _list_traces(args.job_dir, args.trace_limit)
+    policy_set = reference_policy_set(args.device)
+    specs = [
+        RunSpec(cluster_cfg, pc, trace,
+                os.path.join(exp_dir, "data", str(n), str(i)),
+                n, args.scale_factor, args.seed)
+        for n in args.num_apps_list
+        for i, trace in enumerate(traces)
+        for pc in policy_set
+    ]
+    logger.info("num-apps sweep: %d runs → %s", len(specs), exp_dir)
+    _run_grid(specs, args.workers)
+    return exp_dir
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    from pivot_tpu.experiments import plots
+
+    if args.command == "overall":
+        exp_dir = run_overall(args)
+        print(plots.plot_overall(exp_dir))
+        print(plots.plot_transfers(exp_dir))
+    else:
+        exp_dir = run_num_apps(args)
+        print(plots.plot_financial_cost(exp_dir, args.host_hourly_rate))
+
+
+if __name__ == "__main__":
+    main()

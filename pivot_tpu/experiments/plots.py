@@ -1,0 +1,181 @@
+"""Analysis plots over experiment output directories.
+
+Capability parity with the reference's three figures
+(``alibaba/sim.py:55-165``), reading the same on-disk layout
+(``<exp_dir>/data/<iter>/<label>/{general,transfers}.json``):
+
+  * :func:`plot_overall`        — egress cost / host cost / app runtime per
+    scheduler, normalized to the per-metric max (ref ``overall.pdf``).
+  * :func:`plot_transfers`      — per-task data-transfer time split into
+    transmission (propagation) vs congestion (queueing) (ref
+    ``transfer.pdf``).
+  * :func:`plot_financial_cost` — total host + egress $ vs number of apps
+    (ref ``financial-cost.pdf``; host $ = instance-hours × hourly rate).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = [
+    "collect_general",
+    "plot_overall",
+    "plot_transfers",
+    "plot_financial_cost",
+    "POLICY_ORDER",
+]
+
+POLICY_ORDER = ["Opportunistic", "Cost-Aware", "VBP"]
+METRIC_ORDER = ["egress_cost", "cum_instance_hours", "avg_runtime"]
+METRIC_LABELS = ["egress cost", "host cost", "app. runtime"]
+
+
+def _iterdirs(path: str) -> List[str]:
+    return sorted(d for d in os.listdir(path) if os.path.isdir(os.path.join(path, d)))
+
+
+def collect_general(data_dir: str) -> Dict[str, Dict[str, list]]:
+    """label → metric → [value per iteration]."""
+    metrics: Dict[str, Dict[str, list]] = defaultdict(lambda: defaultdict(list))
+    for it in _iterdirs(data_dir):
+        for label in _iterdirs(os.path.join(data_dir, it)):
+            with open(os.path.join(data_dir, it, label, "general.json")) as f:
+                for k, v in json.load(f).items():
+                    metrics[label][k].append(v)
+    return metrics
+
+
+def plot_overall(exp_dir: str) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    data_dir, plot_dir = os.path.join(exp_dir, "data"), os.path.join(exp_dir, "plot")
+    os.makedirs(plot_dir, exist_ok=True)
+    metrics = collect_general(data_dir)
+    labels = [l for l in POLICY_ORDER if l in metrics] + [
+        l for l in sorted(metrics) if l not in POLICY_ORDER
+    ]
+    # Tolerate partial grids (a crashed run): use the common iteration count.
+    n_iter = min(len(metrics[l][METRIC_ORDER[0]]) for l in labels)
+    if n_iter == 0:
+        raise SystemExit(f"no complete iterations under {data_dir}")
+    # Normalize each (metric, iteration) column to its max across labels.
+    norm = {l: [] for l in labels}
+    for key in METRIC_ORDER:
+        per_label = {l: metrics[l][key] for l in labels}
+        vals = np.zeros(len(labels))
+        for i in range(n_iter):
+            col_max = max(per_label[l][i] for l in labels)
+            for j, l in enumerate(labels):
+                vals[j] += per_label[l][i] / col_max if col_max else 0.0
+        for j, l in enumerate(labels):
+            norm[l].append(vals[j] / n_iter)
+
+    width, gap = 0.25, 0.1
+    hatches = ["/", "+", "-", "x", "."]
+    x = np.arange(len(METRIC_ORDER)) * (width + gap) * len(labels)
+    plt.figure(figsize=(7, 4))
+    for j, label in enumerate(labels):
+        plt.bar(x + width * j, norm[label], width=width, label=label,
+                hatch=hatches[j % len(hatches)])
+    plt.xticks(x + width * len(labels) / 2 - gap, METRIC_LABELS, fontsize=13)
+    plt.ylim(0, 1.15)
+    plt.ylabel("Cost/runtime norm. to max.", fontsize=13)
+    plt.legend(ncol=3, frameon=False, fontsize=11)
+    plt.tight_layout()
+    out = os.path.join(plot_dir, "overall.pdf")
+    plt.savefig(out, format="pdf")
+    plt.close()
+    return out
+
+
+def plot_transfers(exp_dir: str) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    data_dir, plot_dir = os.path.join(exp_dir, "data"), os.path.join(exp_dir, "plot")
+    os.makedirs(plot_dir, exist_ok=True)
+    split: Dict[str, list] = defaultdict(list)
+    for it in _iterdirs(data_dir):
+        for label in _iterdirs(os.path.join(data_dir, it)):
+            with open(os.path.join(data_dir, it, label, "transfers.json")) as f:
+                transfers = json.load(f)
+            if not transfers:
+                split[label].append((0.0, 0.0))
+                continue
+            prop = float(np.mean([t["propagation_delay"] for t in transfers]))
+            queue = float(
+                np.mean([t["total_delay"] - t["propagation_delay"] for t in transfers])
+            )
+            split[label].append((prop, queue))
+    labels = [l for l in POLICY_ORDER if l in split] + [
+        l for l in sorted(split) if l not in POLICY_ORDER
+    ]
+    prop = np.array([np.mean([v[0] for v in split[l]]) for l in labels])
+    queue = np.array([np.mean([v[1] for v in split[l]]) for l in labels])
+
+    y = np.arange(len(labels)) * 0.25
+    plt.figure(figsize=(7, 3))
+    plt.barh(y, prop, height=0.2, hatch="/", label="Transmission")
+    plt.barh(y, queue, height=0.2, left=prop, hatch="-", label="Congestion")
+    plt.yticks(y, labels, rotation=45, fontsize=12)
+    plt.xlabel("Data transfer time per task (seconds)", fontsize=12)
+    plt.legend(ncol=2, frameon=False, fontsize=11)
+    plt.tight_layout()
+    out = os.path.join(plot_dir, "transfer.pdf")
+    plt.savefig(out, format="pdf")
+    plt.close()
+    return out
+
+
+def plot_financial_cost(exp_dir: str, host_hourly_rate: float = 0.932) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    data_dir, plot_dir = os.path.join(exp_dir, "data"), os.path.join(exp_dir, "plot")
+    os.makedirs(plot_dir, exist_ok=True)
+    # layout: data/<n_apps>/<iter>/<label>/general.json
+    metrics: Dict[str, Dict[int, list]] = defaultdict(lambda: defaultdict(list))
+    for n_apps in _iterdirs(data_dir):
+        for it in _iterdirs(os.path.join(data_dir, n_apps)):
+            for label in _iterdirs(os.path.join(data_dir, n_apps, it)):
+                with open(
+                    os.path.join(data_dir, n_apps, it, label, "general.json")
+                ) as f:
+                    g = json.load(f)
+                metrics[label][int(n_apps)].append(
+                    (g["egress_cost"], g["cum_instance_hours"] * host_hourly_rate)
+                )
+    markers = ["x", "+", "1", "2", "3"]
+    plt.figure(figsize=(8, 5))
+    colors = {}
+    for i, (label, series) in enumerate(sorted(metrics.items())):
+        xs = sorted(series)
+        egress = [np.mean([v[0] for v in series[n]]) / 1000 for n in xs]
+        (line,) = plt.plot(xs, egress, ls="--", marker=markers[i % len(markers)],
+                           markersize=12, label=f"{label} (egress)")
+        colors[label] = line.get_color()
+    for i, (label, series) in enumerate(sorted(metrics.items())):
+        xs = sorted(series)
+        host = [np.mean([v[1] for v in series[n]]) / 1000 for n in xs]
+        plt.plot(xs, host, color=colors[label], marker=markers[i % len(markers)],
+                 markersize=12, label=f"{label} (host)")
+    plt.xlabel("# of running applications", fontsize=13)
+    plt.ylabel("Total host/egress cost ($1K)", fontsize=13)
+    plt.legend(ncol=2, frameon=False, fontsize=10)
+    plt.tight_layout()
+    out = os.path.join(plot_dir, "cost.pdf")
+    plt.savefig(out, format="pdf")
+    plt.close()
+    return out

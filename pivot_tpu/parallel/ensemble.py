@@ -267,6 +267,29 @@ def rollout(
     )(rt, arr, root_anchor)
 
 
+@functools.lru_cache(maxsize=32)
+def _sharded_rollout_fn(mesh, n_replicas, tick, max_ticks, perturb):
+    """Cached jitted rollout per (mesh, static config) — repeated calls
+    (key sweeps, perturbation sweeps) reuse the compiled program."""
+    out_shard = NamedSharding(mesh, P("replica"))
+    return jax.jit(
+        functools.partial(
+            rollout,
+            n_replicas=n_replicas,
+            tick=tick,
+            max_ticks=max_ticks,
+            perturb=perturb,
+        ),
+        out_shardings=RolloutResult(
+            makespan=out_shard,
+            egress_cost=out_shard,
+            finish_time=NamedSharding(mesh, P("replica", None)),
+            placement=NamedSharding(mesh, P("replica", None)),
+            n_unfinished=out_shard,
+        ),
+    )
+
+
 def sharded_rollout(
     mesh,
     key,
@@ -275,7 +298,9 @@ def sharded_rollout(
     topo: DeviceTopology,
     storage_zones,
     n_replicas: int = 64,
-    **kwargs,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
 ) -> RolloutResult:
     """Rollout with the replica axis sharded over ``mesh`` ('replica' axis).
 
@@ -285,15 +310,5 @@ def sharded_rollout(
     downstream ensemble statistics (means/quantiles over replicas) become
     psums over ICI.
     """
-    out_shard = NamedSharding(mesh, P("replica"))
-    fn = jax.jit(
-        functools.partial(rollout, n_replicas=n_replicas, **kwargs),
-        out_shardings=RolloutResult(
-            makespan=out_shard,
-            egress_cost=out_shard,
-            finish_time=NamedSharding(mesh, P("replica", None)),
-            placement=NamedSharding(mesh, P("replica", None)),
-            n_unfinished=out_shard,
-        ),
-    )
+    fn = _sharded_rollout_fn(mesh, n_replicas, tick, max_ticks, perturb)
     return fn(key, avail0, workload, topo, storage_zones)

@@ -1,0 +1,149 @@
+"""Typed configuration tree for experiments.
+
+The reference scatters configuration across argparse defaults, env vars,
+``**kwargs`` popped in scheduler constructors, and class constants
+(SURVEY.md §5 "Config / flag system"); here one dataclass tree describes a
+whole experiment and every component is constructed from it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+__all__ = ["HostShape", "ClusterConfig", "PolicyConfig", "ExperimentConfig", "make_policy"]
+
+
+@dataclasses.dataclass(frozen=True)
+class HostShape:
+    cpus: int = 16
+    mem: int = 128 * 1024  # MB
+    disk: int = 100  # GB
+    gpus: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    n_hosts: int = 100
+    shape: HostShape = HostShape()
+    uniform: bool = True
+    seed: Optional[int] = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyConfig:
+    """Which placement policy, on which backend.
+
+    ``device``: 'naive' (reference-faithful Python), 'numpy' (vectorized
+    CPU), or 'tpu' (fused device kernels).
+    """
+
+    name: str = "cost-aware"  # opportunistic | first-fit | best-fit | cost-aware
+    device: str = "numpy"
+    decreasing: bool = False  # first/best-fit
+    bin_pack: str = "first-fit"  # cost-aware
+    sort_tasks: bool = False
+    sort_hosts: bool = False
+    realtime_bw: bool = False
+    host_decay: bool = False
+    label: Optional[str] = None
+
+    @property
+    def display_label(self) -> str:
+        return self.label or f"{self.name}-{self.device}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    cluster: ClusterConfig = ClusterConfig()
+    policies: Tuple[PolicyConfig, ...] = ()
+    trace_files: Tuple[str, ...] = ()
+    n_apps: Optional[int] = 100
+    output_size_scale_factor: float = 1000.0
+    interval: float = 5.0
+    seed: Optional[int] = 0
+    data_dir: Optional[str] = None
+
+
+def build_cluster(cfg: ClusterConfig, meta=None):
+    """Construct the cluster described by ``cfg`` (deterministic per seed)."""
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+    from pivot_tpu.infra.locality import ResourceMetadata
+
+    meta = meta if meta is not None else ResourceMetadata(seed=cfg.seed)
+    s = cfg.shape
+    gen = RandomClusterGenerator(
+        Environment(),
+        (s.cpus, s.cpus),
+        (s.mem, s.mem),
+        (s.disk, s.disk),
+        (s.gpus, s.gpus),
+        meta=meta,
+        seed=cfg.seed,
+    )
+    return gen.generate(cfg.n_hosts, uniform=cfg.uniform)
+
+
+#: The reference's three experiment arms with their exact hyperparameters
+#: (``alibaba/sim.py:179-186``), on a chosen device backend.
+def reference_policy_set(device: str = "numpy") -> Tuple[PolicyConfig, ...]:
+    return (
+        PolicyConfig(name="opportunistic", device=device, label="Opportunistic"),
+        PolicyConfig(name="first-fit", device=device, decreasing=True, label="VBP"),
+        PolicyConfig(
+            name="cost-aware",
+            device=device,
+            bin_pack="first-fit",
+            sort_tasks=True,
+            sort_hosts=True,
+            label="Cost-Aware",
+        ),
+    )
+
+
+def make_policy(cfg: PolicyConfig):
+    """Instantiate the policy object described by ``cfg``."""
+    if cfg.device == "tpu":
+        from pivot_tpu.sched import tpu as dev
+
+        if cfg.name == "opportunistic":
+            return dev.TpuOpportunisticPolicy()
+        if cfg.name == "first-fit":
+            return dev.TpuFirstFitPolicy(decreasing=cfg.decreasing)
+        if cfg.name == "best-fit":
+            return dev.TpuBestFitPolicy(decreasing=cfg.decreasing)
+        if cfg.name == "cost-aware":
+            if cfg.realtime_bw:
+                raise ValueError(
+                    "realtime_bw needs the live route queues — CPU backends only"
+                )
+            return dev.TpuCostAwarePolicy(
+                bin_pack=cfg.bin_pack,
+                sort_tasks=cfg.sort_tasks,
+                sort_hosts=cfg.sort_hosts,
+                host_decay=cfg.host_decay,
+            )
+        raise ValueError(f"unknown policy {cfg.name!r}")
+
+    from pivot_tpu.sched import policies as cpu
+
+    mode = cfg.device
+    if mode not in ("naive", "numpy"):
+        raise ValueError(f"unknown device {cfg.device!r}")
+    if cfg.name == "opportunistic":
+        return cpu.OpportunisticPolicy(mode)
+    if cfg.name == "first-fit":
+        return cpu.FirstFitPolicy(decreasing=cfg.decreasing, mode=mode)
+    if cfg.name == "best-fit":
+        return cpu.BestFitPolicy(decreasing=cfg.decreasing, mode=mode)
+    if cfg.name == "cost-aware":
+        return cpu.CostAwarePolicy(
+            bin_pack=cfg.bin_pack,
+            sort_tasks=cfg.sort_tasks,
+            sort_hosts=cfg.sort_hosts,
+            realtime_bw=cfg.realtime_bw,
+            host_decay=cfg.host_decay,
+            mode=mode,
+        )
+    raise ValueError(f"unknown policy {cfg.name!r}")

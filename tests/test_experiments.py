@@ -1,0 +1,136 @@
+"""Experiment-layer tests: trace sampler on synthetic CSVs, config
+factory, CLI end-to-end smoke with plot output."""
+
+import json
+import os
+
+import pytest
+
+from pivot_tpu.experiments.sample import load_job_dags, parse_task_name, sample_windows
+from pivot_tpu.utils.config import PolicyConfig, make_policy, reference_policy_set
+
+
+def test_parse_task_name_dag_encoding():
+    assert parse_task_name("M1_2_3") == (1, [2, 3])
+    assert parse_task_name("M4") == (4, [])
+    assert parse_task_name("task_xyz") == ("task_xyz", [])
+    assert parse_task_name("MergeTask") == ("MergeTask", [])
+    assert parse_task_name("R2_Stg5_1") == (2, [1])  # Stg segments dropped
+
+
+@pytest.fixture
+def csv_pair(tmp_path):
+    # batch_task.csv: t_name, n_inst, j_name, type, status, start, end, cpus, mem
+    batch_task = tmp_path / "batch_task.csv"
+    batch_task.write_text(
+        "\n".join(
+            [
+                "M1,2,j_1,A,Terminated,1000,1100,100,0.5",
+                "M2_1,3,j_1,A,Terminated,1100,1300,200,0.3",
+                "M1,1,j_2,A,Terminated,1500,1600,100,0.2",
+                "M2_1,1,j_2,A,Terminated,1600,1900,100,0.2",
+                "M1,1,j_3,A,Failed,1000,1100,100,0.2",  # failed → dropped
+                "M1,200,j_4,A,Terminated,2000,2100,100,0.2",  # too parallel
+                "M2_1,1,j_4,A,Terminated,2100,2200,100,0.2",
+            ]
+        )
+        + "\n"
+    )
+    # batch_instance.csv: _, t_name, j_name, _, status, start, end, machine, ...
+    inst = [
+        ",".join(["i1", "M1", "j_1", "x", "Terminated", "1000", "1100", "m1"] + ["0"] * 6),
+        ",".join(["i2", "M2_1", "j_1", "x", "Terminated", "1100", "1300", "m2"] + ["0"] * 6),
+        ",".join(["i3", "M1", "j_2", "x", "Terminated", "1500", "1600", "m1"] + ["0"] * 6),
+        ",".join(["i4", "M2_1", "j_2", "x", "Terminated", "1600", "1900", "m3"] + ["0"] * 6),
+        ",".join(["i5", "M1", "j_4", "x", "Terminated", "2000", "2100", "m1"] + ["0"] * 6),
+        ",".join(["i6", "M2_1", "j_4", "x", "Terminated", "2100", "2200", "m1"] + ["0"] * 6),
+    ]
+    batch_inst = tmp_path / "batch_instance.csv"
+    batch_inst.write_text("\n".join(inst) + "\n")
+    return str(batch_task), str(batch_inst)
+
+
+def test_sampler_end_to_end(csv_pair):
+    batch_task, batch_inst = csv_pair
+    jobs = load_job_dags(batch_task)
+    assert set(jobs) == {"j_1", "j_2", "j_4"}  # j_3 dropped (Failed)
+    assert jobs["j_1"]["tasks"][2]["dependencies"] == [1]
+    assert jobs["j_1"]["tasks"][1]["cpus"] == 1.0  # 100 / 100
+
+    windows = sample_windows(
+        batch_inst, jobs, n_jobs=10, start=0, interval=1000,
+        min_runtime=100, max_runtime=1000, min_deps=1, max_parallel=100,
+    )
+    sampled = {j["id"] for w in windows.values() for j in w}
+    assert "j_1" in sampled and "j_2" in sampled
+    assert "j_4" not in sampled  # 200 instances > max_parallel
+    j1 = next(j for w in windows.values() for j in w if j["id"] == "j_1")
+    t2 = next(t for t in j1["tasks"] if t["id"] == 2)
+    assert t2["runtime"] == 200
+    assert t2["dependencies"] == [1]
+    # Window key = first start // interval * interval.
+    assert any(k == 1000 for k in windows)
+
+
+def test_sampler_runtime_filter(csv_pair):
+    batch_task, batch_inst = csv_pair
+    jobs = load_job_dags(batch_task)
+    # max_runtime below j_1's 200s task: excluded.
+    windows = sample_windows(
+        batch_inst, jobs, n_jobs=10, start=0, interval=1000,
+        min_runtime=10, max_runtime=150, min_deps=1, max_parallel=100,
+    )
+    sampled = {j["id"] for w in windows.values() for j in w}
+    assert "j_1" not in sampled
+
+
+def test_make_policy_matrix():
+    for device in ("naive", "numpy", "tpu"):
+        for cfg in reference_policy_set(device):
+            policy = make_policy(cfg)
+            assert policy is not None
+    with pytest.raises(ValueError):
+        make_policy(PolicyConfig(name="cost-aware", device="tpu", realtime_bw=True))
+    with pytest.raises(ValueError):
+        make_policy(PolicyConfig(name="nope"))
+
+
+def test_cli_overall_end_to_end(tmp_path):
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    cli.main(
+        [
+            "--num-hosts", "31",
+            "--trace-limit", "1",
+            "--job-dir", "data/jobs",
+            "--output-dir", str(out),
+            "--seed", "1",
+            "overall", "--num-apps", "12",
+        ]
+    )
+    (exp_dir,) = (out / "overall").iterdir()
+    for label in ("Opportunistic", "VBP", "Cost-Aware"):
+        general = json.loads((exp_dir / "data" / "0" / label / "general.json").read_text())
+        assert {"egress_cost", "cum_instance_hours", "avg_runtime"} <= set(general)
+    assert (exp_dir / "plot" / "overall.pdf").exists()
+    assert (exp_dir / "plot" / "transfer.pdf").exists()
+
+
+def test_cli_num_apps_end_to_end(tmp_path):
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    cli.main(
+        [
+            "--num-hosts", "31",
+            "--trace-limit", "1",
+            "--job-dir", "data/jobs",
+            "--output-dir", str(out),
+            "num-apps", "--num-apps-list", "5", "10",
+        ]
+    )
+    (exp_dir,) = (out / "n_app").iterdir()
+    assert (exp_dir / "plot" / "cost.pdf").exists()
+    assert (exp_dir / "data" / "5").is_dir()
+    assert (exp_dir / "data" / "10").is_dir()
