@@ -229,6 +229,28 @@ class Environment:
         self._schedule(evt, priority, delay)
         return evt
 
+    def schedule_callback_at(
+        self, at: float, fn: Callable[[], None], priority: int = NORMAL
+    ) -> Event:
+        """Run ``fn()`` at absolute sim time ``at`` (must be >= now).
+
+        Unlike ``schedule_callback(at - now, ...)`` this avoids the
+        relative-delay round-trip ``fl(now + fl(at - now))``, which can land
+        one ulp past ``at`` — co-simulators (``pivot_tpu.native``) need
+        their wake to fire at *exactly* the completion instant.
+        """
+        if at < self._now:
+            raise SimError(f"cannot schedule at {at} < now {self._now}")
+        evt = Event(self)
+        evt.callbacks.append(lambda _e: fn())
+        evt._staged = None
+        if evt._scheduled:
+            raise SimError("event already scheduled")
+        evt._scheduled = True
+        heapq.heappush(self._heap, (at, priority, self._seq, evt))
+        self._seq += 1
+        return evt
+
     # -- public factory methods -----------------------------------------
     def process(self, gen: Generator) -> Process:
         return Process(self, gen)

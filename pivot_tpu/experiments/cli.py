@@ -92,6 +92,12 @@ def parse_args(argv=None):
         default="numpy",
         help="policy backend",
     )
+    parser.add_argument(
+        "--network",
+        choices=["python", "native"],
+        default="python",
+        help="network fabric backend (native = C++ co-simulator)",
+    )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--workers", type=int, default=1,
                         help="process-parallel runs (1 = sequential)")
@@ -107,6 +113,15 @@ def parse_args(argv=None):
     if args.command is None:
         parser.print_help()
         parser.exit(1)
+    if args.network == "native":
+        from pivot_tpu import native
+
+        if not native.available():
+            parser.exit(
+                1,
+                "error: --network native needs a working g++ toolchain "
+                "(native build failed); use --network python\n",
+            )
     return args
 
 
@@ -159,6 +174,7 @@ def _cluster_config(args) -> ClusterConfig:
         n_hosts=args.n_hosts,
         shape=HostShape(args.cpus, args.mem, args.disk, args.gpus),
         seed=args.seed,
+        network=args.network,
     )
 
 

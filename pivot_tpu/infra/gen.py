@@ -34,6 +34,7 @@ class RandomClusterGenerator(LogMixin):
         meta: Optional[ResourceMetadata] = None,
         meter: Optional[Meter] = None,
         seed: Optional[int] = None,
+        network_backend: str = "python",
     ):
         assert 0 < cpus[0] <= cpus[1]
         assert 0 < mem[0] <= mem[1]
@@ -43,6 +44,7 @@ class RandomClusterGenerator(LogMixin):
         self.cpus, self.mem, self.disk, self.gpus = cpus, mem, disk, gpus
         self.meta = meta if meta is not None else ResourceMetadata()
         self.meter = meter
+        self.network_backend = network_backend
         self.rng = np.random.default_rng(seed)
 
     def _sample_shape(self) -> Tuple[int, int, int, int]:
@@ -92,4 +94,5 @@ class RandomClusterGenerator(LogMixin):
             meter=meter,
             route_mode="local",
             seed=seed,
+            network_backend=self.network_backend,
         )

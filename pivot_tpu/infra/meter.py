@@ -41,7 +41,17 @@ class Meter(LogMixin):
         self._data_transfers: List[dict] = []
         self._sched_turnovers: List[float] = []
         self._n_sched_ops = 0
+        # Native network engines whose per-route stats replace per-slot
+        # logs (``NativeNetworkEngine.metered_route_stats``).
+        self._native_sources: List[object] = []
         self._wall_start = time.perf_counter()
+
+    def add_native_source(self, engine) -> None:
+        self._native_sources.append(engine)
+
+    def _native_stats(self):
+        for engine in self._native_sources:
+            yield from engine.metered_route_stats()
 
     # -- derived metrics -------------------------------------------------
     @property
@@ -79,6 +89,10 @@ class Meter(LogMixin):
             cost += self.meta.calc_network_traffic_cost(
                 route.src.locality, route.dst.locality, size
             )
+        for route, served_mb, _n, _gap in self._native_stats():
+            cost += self.meta.calc_network_traffic_cost(
+                route.src.locality, route.dst.locality, served_mb
+            )
         return cost
 
     @property
@@ -90,6 +104,9 @@ class Meter(LogMixin):
             for slots in transfers.values():
                 for i in range(1, len(slots)):
                     delay += slots[i][0] - slots[i - 1][1]
+        for _route, _mb, n_transfers, gap_sum in self._native_stats():
+            n += n_transfers
+            delay += gap_sum
         return delay / n if n else 0.0
 
     # -- recording hooks -------------------------------------------------

@@ -25,7 +25,7 @@ from typing import Optional
 from pivot_tpu.des import Environment, Event
 from pivot_tpu.utils import LogMixin, fresh_id
 
-__all__ = ["Route", "Transfer", "CHUNK_MB"]
+__all__ = ["Route", "NativeRoute", "Transfer", "CHUNK_MB"]
 
 #: Chunk granularity in MB (ref ``Packet.PACKET_SIZE``, network.py:12).
 CHUNK_MB = 1000.0
@@ -103,3 +103,34 @@ class Route(LogMixin):
 
     def __repr__(self) -> str:
         return f"Route({self.src.id} -> {self.dst.id} @ {self.bw:.0f} Mbps)"
+
+
+class NativeRoute(Route):
+    """Route facade over the C++ co-simulator (``pivot_tpu.native``).
+
+    Same queueing semantics and bit-identical completion times (the engine
+    uses the same double arithmetic, ``start + chunk/bw``); the chunk
+    service loop lives in native code, so a transfer costs the Python event
+    kernel one wake callback instead of one event per chunk.  Per-slot
+    meter logs are replaced by engine-accumulated per-route stats that the
+    meter reads at summary time (``Meter.add_native_source``).
+    """
+
+    __slots__ = ("engine", "index")
+
+    def __init__(self, env, src, dst, bw: float, engine, meter=None):
+        super().__init__(env, src, dst, bw, meter)
+        self.engine = engine
+        self.index = engine.add_route(self.bw, self)
+
+    @property
+    def queued_mb(self) -> float:
+        return self.engine.queued_mb(self.index)
+
+    def send(self, size_mb: float, done: Optional[Event] = None) -> Event:
+        if size_mb <= 0:
+            raise ValueError(f"transfer size must be > 0, got {size_mb}")
+        if done is None:
+            done = self.env.event()
+        self.engine.send(self.index, size_mb, done)
+        return done

@@ -28,6 +28,9 @@ class ClusterConfig:
     shape: HostShape = HostShape()
     uniform: bool = True
     seed: Optional[int] = 0
+    #: 'python' serves network chunks on the event kernel; 'native' runs the
+    #: chunk-service loop in the C++ co-simulator (pivot_tpu.native).
+    network: str = "python"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +84,7 @@ def build_cluster(cfg: ClusterConfig, meta=None):
         (s.gpus, s.gpus),
         meta=meta,
         seed=cfg.seed,
+        network_backend=cfg.network,
     )
     return gen.generate(cfg.n_hosts, uniform=cfg.uniform)
 

@@ -1,0 +1,299 @@
+"""Parity tests: C++ network co-simulator vs the Python fabric.
+
+The native engine (``pivot_tpu/native/pivot_net.cpp``) must reproduce the
+Python ``Route``'s completion times bit-for-bit (same double arithmetic)
+and the meter's derived metrics (egress cost from served chunks, average
+congestion delay from inter-slot gaps).
+"""
+
+import numpy as np
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra.locality import Locality, ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.infra.network import CHUNK_MB, NativeRoute, Route
+
+native = pytest.importorskip("pivot_tpu.native")
+
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+class FakeNode:
+    def __init__(self, id, locality):
+        self.id = id
+        self.locality = locality
+
+
+ZONE_A = Locality("aws", "us-east-1", "a")
+ZONE_B = Locality("gcp", "us-west1", "a")
+
+
+def completion_times(env, events):
+    out = {}
+    for name, evt in events.items():
+        evt.callbacks.append(lambda _e, n=name: out.setdefault(n, env.now))
+    env.run()
+    return out
+
+
+def build_pair(bws, meter_cls=None, meta=None):
+    """Matching (python, native) route sets over fresh envs."""
+    env_py, env_nat = Environment(), Environment()
+    meter_py = meter_cls(env_py, meta) if meter_cls else None
+    meter_nat = meter_cls(env_nat, meta) if meter_cls else None
+    engine = native.NativeNetworkEngine(env_nat)
+    if meter_nat is not None:
+        meter_nat.add_native_source(engine)
+    py_routes, nat_routes = [], []
+    for i, bw in enumerate(bws):
+        src = FakeNode(f"s{i}", ZONE_A)
+        dst = FakeNode(f"d{i}", ZONE_B)
+        py_routes.append(Route(env_py, src, dst, bw, meter=meter_py))
+        nat_routes.append(
+            NativeRoute(env_nat, src, dst, bw, engine, meter=meter_nat)
+        )
+    return (env_py, py_routes, meter_py), (env_nat, nat_routes, meter_nat)
+
+
+def test_single_transfer_bit_parity():
+    (env_py, [r_py], _), (env_nat, [r_nat], _) = build_pair([777.0])
+    t_py = completion_times(env_py, {"x": r_py.send(2500.0)})
+    t_nat = completion_times(env_nat, {"x": r_nat.send(2500.0)})
+    assert t_py == t_nat  # bit-identical doubles
+    assert t_nat["x"] == 1000.0 / 777.0 + 1000.0 / 777.0 + 500.0 / 777.0
+
+
+def test_round_robin_fair_sharing_parity():
+    # Two concurrent multi-chunk transfers interleave chunks round-robin.
+    (env_py, [r_py], _), (env_nat, [r_nat], _) = build_pair([1000.0])
+    ev_py = {"a": r_py.send(3000.0), "b": r_py.send(2000.0)}
+    ev_nat = {"a": r_nat.send(3000.0), "b": r_nat.send(2000.0)}
+    t_py = completion_times(env_py, ev_py)
+    t_nat = completion_times(env_nat, ev_nat)
+    assert t_py == t_nat
+    # a: chunks at [0,1],[2,3],[4,5]; b: [1,2],[3,4] -> a@5, b@4.
+    assert t_nat == {"a": 5.0, "b": 4.0}
+
+
+def test_staggered_sends_parity():
+    """Sends issued at different sim times through driver processes."""
+
+    def driver(env, routes, record):
+        def proc():
+            e1 = routes[0].send(2500.0)
+            e1.callbacks.append(lambda _e: record.setdefault("e1", env.now))
+            yield env.timeout(0.7)
+            e2 = routes[0].send(1500.0)
+            e2.callbacks.append(lambda _e: record.setdefault("e2", env.now))
+            e3 = routes[1].send(400.0)
+            e3.callbacks.append(lambda _e: record.setdefault("e3", env.now))
+
+        env.process(proc())
+        env.run()
+
+    (env_py, py_routes, _), (env_nat, nat_routes, _) = build_pair([900.0, 333.0])
+    rec_py, rec_nat = {}, {}
+    driver(env_py, py_routes, rec_py)
+    driver(env_nat, nat_routes, rec_nat)
+    assert rec_py == rec_nat
+    assert set(rec_nat) == {"e1", "e2", "e3"}
+
+
+def test_random_schedule_parity():
+    """Fuzz: a random send schedule yields identical completion times."""
+    rng = np.random.default_rng(42)
+    n_routes = 5
+    sends = []  # (delay_before, route_idx, size)
+    for _ in range(60):
+        sends.append(
+            (
+                float(rng.uniform(0, 3)),
+                int(rng.integers(0, n_routes)),
+                float(rng.uniform(1, 4000)),
+            )
+        )
+
+    def run(env, routes):
+        rec = {}
+
+        def proc():
+            for i, (gap, ri, size) in enumerate(sends):
+                yield env.timeout(gap)
+                evt = routes[ri].send(size)
+                evt.callbacks.append(lambda _e, k=i: rec.setdefault(k, env.now))
+
+        env.process(proc())
+        env.run()
+        return rec
+
+    bws = [500.0, 1000.0, 250.0, 4000.0, 50.0]
+    (env_py, py_routes, _), (env_nat, nat_routes, _) = build_pair(bws)
+    assert run(env_py, py_routes) == run(env_nat, nat_routes)
+
+
+def test_queued_mb_and_realtime_bw_parity():
+    (env_py, [r_py], _), (env_nat, [r_nat], _) = build_pair([1000.0])
+    samples_py, samples_nat = [], []
+
+    def probe(env, route, samples):
+        def proc():
+            route.send(3000.0)
+            route.send(2000.0)
+            for _ in range(6):
+                samples.append((env.now, route.queued_mb, route.realtime_bw))
+                yield env.timeout(0.9)
+
+        env.process(proc())
+        env.run()
+
+    probe(env_py, r_py, samples_py)
+    probe(env_nat, r_nat, samples_nat)
+    assert samples_py == samples_nat
+
+
+def test_meter_egress_and_congestion_parity():
+    meta = ResourceMetadata(seed=0)
+    pair = build_pair([800.0, 800.0], meter_cls=Meter, meta=meta)
+    (env_py, py_routes, meter_py), (env_nat, nat_routes, meter_nat) = pair
+    for routes, env in ((py_routes, env_py), (nat_routes, env_nat)):
+        routes[0].send(2500.0)
+        routes[0].send(1200.0)
+        routes[1].send(999.0)
+        env.run()
+    assert meter_py.total_network_traffic_cost > 0
+    assert meter_py.total_network_traffic_cost == pytest.approx(
+        meter_nat.total_network_traffic_cost, rel=1e-12
+    )
+    assert meter_py.average_congestion_delay > 0
+    assert meter_py.average_congestion_delay == pytest.approx(
+        meter_nat.average_congestion_delay, rel=1e-12
+    )
+
+
+def test_unmetered_routes_excluded():
+    meta = ResourceMetadata(seed=0)
+    env = Environment()
+    meter = Meter(env, meta)
+    engine = native.NativeNetworkEngine(env)
+    meter.add_native_source(engine)
+    metered = NativeRoute(
+        env, FakeNode("a", ZONE_A), FakeNode("b", ZONE_B), 500.0, engine, meter=meter
+    )
+    unmetered = NativeRoute(
+        env, FakeNode("c", ZONE_A), FakeNode("d", ZONE_B), 500.0, engine, meter=None
+    )
+    metered.send(1000.0)
+    unmetered.send(9000.0)
+    env.run()
+    stats = engine.metered_route_stats()
+    assert [r for r, *_ in stats] == [metered]
+    cost_metered_only = meta.calc_network_traffic_cost(ZONE_A, ZONE_B, 1000.0)
+    assert meter.total_network_traffic_cost == pytest.approx(cost_metered_only)
+
+
+def test_send_at_exact_completion_instant():
+    """A send landing exactly on a chunk boundary queues AFTER the chunk
+    that completes at that instant (engine drained to `now` first), so the
+    in-flight transfer keeps its round-robin turn.  The pure-Python fabric
+    breaks this exact tie by event-heap seq interleaving instead (either
+    order can win depending on when the sender's wait was scheduled); the
+    native convention is the deterministic one."""
+
+    def run(env, routes):
+        rec = {}
+
+        def proc():
+            e_old = routes[0].send(3000.0)  # chunks end at t=1,2,3
+            e_old.callbacks.append(lambda _e: rec.setdefault("old", env.now))
+            routes[1].send(1500.0)  # re-arms the pump mid-flight
+            yield env.timeout(2.0)  # lands exactly on old's chunk-2 boundary
+            e_new = routes[0].send(1000.0)
+            e_new.callbacks.append(lambda _e: rec.setdefault("new", env.now))
+
+        env.process(proc())
+        env.run()
+        return rec
+
+    (_, _, _), (env_nat, nat_routes, _) = build_pair([1000.0, 1000.0])
+    rec_nat = run(env_nat, nat_routes)
+    # old's chunk 3 is re-enqueued before new -> old@3, new@4.
+    assert rec_nat == {"old": 3.0, "new": 4.0}
+    # (In this construction the Python fabric happens to order the send
+    # first -> {new: 3, old: 4}; totals and all meter metrics agree.)
+
+
+def test_pump_callbacks_bounded():
+    """Superseded wakes die inert: total scheduled callbacks stay O(sends +
+    distinct completion instants), not O(sends x chunks)."""
+    env = Environment()
+    scheduled = [0]
+    orig = env.schedule_callback_at
+
+    def counting(at, fn, priority=1):
+        scheduled[0] += 1
+        return orig(at, fn)
+
+    env.schedule_callback_at = counting
+    engine = native.NativeNetworkEngine(env)
+    slow = NativeRoute(
+        env, FakeNode("a", ZONE_A), FakeNode("b", ZONE_B), 10.0, engine
+    )
+    fast = NativeRoute(
+        env, FakeNode("c", ZONE_A), FakeNode("d", ZONE_B), 1e6, engine
+    )
+
+    def proc():
+        slow.send(50_000.0)  # 50 chunks, 100 s each
+        for _ in range(40):  # fast sends that each preempt the slow wake
+            yield env.timeout(1.0)
+            fast.send(1.0)
+
+    env.process(proc())
+    env.run()
+    chunks = engine.total_chunks
+    assert chunks == 50 + 40
+    # One live wake per completion instant + one per preempting send;
+    # without the arm-seq guard this blows past 1000 (observed ~1538).
+    assert scheduled[0] <= 2 * chunks + 45
+
+
+def test_zero_size_send_rejected():
+    (_, _, _), (env_nat, [r_nat], _) = build_pair([100.0])
+    with pytest.raises(ValueError):
+        r_nat.send(0)
+
+
+def test_full_sim_parity_native_vs_python():
+    """End-to-end: the canonical experiment with both fabrics agrees on
+    every summary metric (identical event trajectories)."""
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        HostShape,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    trace = "data/jobs/jobs-5000-200-172800-259200.npz"
+    summaries = {}
+    for network in ("python", "native"):
+        cfg = ClusterConfig(
+            n_hosts=20, shape=HostShape(16, 128 * 1024, 100, 1), seed=3,
+            network=network,
+        )
+        cluster = build_cluster(cfg)
+        policy = make_policy(PolicyConfig(name="cost-aware", device="numpy"))
+        s = ExperimentRun(
+            f"native-parity-{network}", cluster, policy, trace, n_apps=25, seed=3
+        ).run()
+        summaries[network] = s
+    py, nat = summaries["python"], summaries["native"]
+    assert py["avg_runtime"] == pytest.approx(nat["avg_runtime"], rel=1e-9)
+    assert py["egress_cost"] == pytest.approx(nat["egress_cost"], rel=1e-9)
+    assert py["avg_congestion_delay"] == pytest.approx(
+        nat["avg_congestion_delay"], rel=1e-9
+    )
+    assert py["sim_time"] == pytest.approx(nat["sim_time"], rel=1e-12)
