@@ -105,6 +105,7 @@ def _bench_device(ctx, n_replicas: int, repeats: int = 5):
     import jax.numpy as jnp
 
     from pivot_tpu.ops.kernels import DeviceTopology, cost_aware_kernel
+    from pivot_tpu.ops.pallas_kernels import cost_aware_pallas
     from pivot_tpu.sched.policies import CostAwarePolicy
     from pivot_tpu.sched.tpu import pad_bucket
 
@@ -143,34 +144,46 @@ def _bench_device(ctx, n_replicas: int, repeats: int = 5):
         ctx.avail[None, :, :] * repl_rng.uniform(0.9, 1.1, size=(R, H, 1))
     ).astype(np.float32)
 
-    kernel = jax.jit(
-        jax.vmap(
-            lambda a: cost_aware_kernel(
-                a,
-                jnp.asarray(dem),
-                jnp.asarray(valid),
-                jnp.asarray(ng_arr),
-                jnp.asarray(az_arr),
-                topo.cost,
-                topo.bw,
-                topo.host_zone,
-                jnp.zeros(H, dtype=jnp.int32),
-                bin_pack="first-fit",
-                sort_hosts=True,
-                host_decay=False,
+    def make(base_kernel):
+        return jax.jit(
+            jax.vmap(
+                lambda a: base_kernel(
+                    a,
+                    jnp.asarray(dem),
+                    jnp.asarray(valid),
+                    jnp.asarray(ng_arr),
+                    jnp.asarray(az_arr),
+                    topo.cost,
+                    topo.bw,
+                    topo.host_zone,
+                    jnp.zeros(H, dtype=jnp.int32),
+                    bin_pack="first-fit",
+                    sort_hosts=True,
+                    host_decay=False,
+                )
             )
         )
-    )
+
     avail_dev = jnp.asarray(avail_r)
-    placements, _ = kernel(avail_dev)  # compile + warm
-    placements.block_until_ready()
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        placements, _ = kernel(avail_dev)
+    # Race the two device implementations — the lax.scan kernel and the
+    # Pallas VMEM-resident greedy kernel — and report the winner.
+    variants = {"scan": make(cost_aware_kernel)}
+    if jax.default_backend() == "tpu":
+        variants["pallas"] = make(cost_aware_pallas)
+    results, outputs = {}, {}
+    for name, kernel in variants.items():
+        placements, _ = kernel(avail_dev)  # compile + warm
         placements.block_until_ready()
-        best = min(best, time.perf_counter() - t0)
-    return (R * T) / best, placements
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            placements, _ = kernel(avail_dev)
+            placements.block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        results[name] = (R * T) / best
+        outputs[name] = placements
+    winner = max(results, key=results.get)
+    return results[winner], outputs[winner], winner, results
 
 
 def main() -> None:
@@ -216,7 +229,7 @@ def main() -> None:
     H, T, R = 512, 2048, 64
     ctx = _build_batch(H, T, seed=7)
     naive_dps = _bench_naive(ctx)
-    device_dps, _ = _bench_device(ctx, R)
+    device_dps, _, winner, results = _bench_device(ctx, R)
     if hasattr(signal, "SIGALRM"):
         signal.alarm(0)
 
@@ -232,6 +245,8 @@ def main() -> None:
                 "vs_baseline": round(device_dps / naive_dps, 2),
                 "baseline_decisions_per_sec": round(naive_dps, 1),
                 "backend": backend,
+                "kernel": winner,
+                "per_kernel": {k: round(v, 1) for k, v in results.items()},
             }
         )
     )

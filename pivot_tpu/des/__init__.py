@@ -212,11 +212,18 @@ class Environment:
         return self._now
 
     # -- scheduling ------------------------------------------------------
-    def _schedule(self, event: Event, priority: int = NORMAL, delay: float = 0.0) -> None:
+    def _schedule(
+        self,
+        event: Event,
+        priority: int = NORMAL,
+        delay: float = 0.0,
+        at: Optional[float] = None,
+    ) -> None:
         if event._scheduled:
             raise SimError("event already scheduled")
         event._scheduled = True
-        heapq.heappush(self._heap, (self._now + delay, priority, self._seq, event))
+        when = self._now + delay if at is None else at
+        heapq.heappush(self._heap, (when, priority, self._seq, event))
         self._seq += 1
 
     def schedule_callback(
@@ -244,11 +251,7 @@ class Environment:
         evt = Event(self)
         evt.callbacks.append(lambda _e: fn())
         evt._staged = None
-        if evt._scheduled:
-            raise SimError("event already scheduled")
-        evt._scheduled = True
-        heapq.heappush(self._heap, (at, priority, self._seq, evt))
-        self._seq += 1
+        self._schedule(evt, priority, at=at)
         return evt
 
     # -- public factory methods -----------------------------------------

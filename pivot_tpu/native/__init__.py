@@ -20,8 +20,6 @@ import subprocess
 from math import inf
 from typing import Dict, List, Optional, Tuple
 
-import numpy as np
-
 __all__ = ["available", "load_library", "NativeNetworkEngine", "BuildError"]
 
 _SRC = os.path.join(os.path.dirname(__file__), "pivot_net.cpp")

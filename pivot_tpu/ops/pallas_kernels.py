@@ -1,0 +1,245 @@
+"""Pallas TPU kernel for the fused greedy placement pass.
+
+The ``lax.scan`` kernels in :mod:`pivot_tpu.ops.kernels` express the
+greedy within-tick semantics as T sequential HLO loop iterations, each a
+masked argmin over hosts.  This module collapses the *entire* tick into a
+single Pallas program: the ``[4, H]`` availability matrix, the frozen
+group-score vector, and the best-fit decay counter stay resident in VMEM
+scratch for the whole pass, per-task scalars (demands, anchor zone, flags)
+stream through SMEM in chunks, and each step is a handful of VPU ops over
+the lane (=host) axis — no per-iteration HBM traffic at all.
+
+Semantics are identical to :func:`pivot_tpu.ops.kernels.cost_aware_kernel`
+(the PIVOT cost-aware policy, ref ``scheduler/cost_aware.py:28-127``):
+  * first-fit: strict fits, group score ``cost·decay/(‖avail‖·bw)`` frozen
+    at group entry, masked argmin with ties → lowest host index;
+  * best-fit: non-strict fits, live per-task score
+    ``cost·‖avail−d‖·decay/bw`` with a within-tick placement counter.
+
+Layout (TPU-first):
+  * hosts on the **lane** axis, padded to a multiple of 128; padding hosts
+    carry ``avail = -1e30`` so no fit test can ever select them;
+  * the four resource dimensions are unrolled (four ``[1, Hp]`` rows), so
+    fit masks and norms are plain VPU vector ops — no cross-lane work
+    except the final min-reductions;
+  * ``[Z, H]`` round-trip cost/bw tables are precomputed outside and read
+    per task by a dynamic-sublane gather on the anchor zone.
+
+Batching: ``jax.vmap`` over the wrapper maps to an extra grid dimension
+(one greedy pass per replica per program instance) — this is how the
+Monte-Carlo ensemble (``pivot_tpu.parallel.ensemble``) runs R replicas'
+ticks concurrently on one chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["cost_aware_pallas"]
+
+_BIG = 1e30
+_NEG = -1e30
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _greedy_body(
+    first_fit: bool,
+    sort_hosts: bool,
+    host_decay: bool,
+    chunk: int,
+    Hp: int,
+):
+    """Kernel body factory; all mode flags are Python-static."""
+
+    def kernel(
+        demands_s,  # [4, chunk] f32 SMEM (task axis on lanes — SMEM blocks
+        valid_s,  # [1, chunk] i32 SMEM    are lane-padded to 128, so the
+        ng_s,  # [1, chunk] i32 SMEM       narrow axis must be the leading one)
+        az_s,  # [1, chunk] i32 SMEM
+        cost_rt,  # [Zp, Hp] f32 VMEM
+        bw_rt,  # [Zp, Hp] f32 VMEM
+        base_row,  # [1, Hp] f32 VMEM  (host task counts at tick start)
+        avail_in,  # [8, Hp] f32 VMEM  (rows 0-3 = avail.T)
+        place_out,  # [1, chunk] i32 SMEM out
+        avail_out,  # [8, Hp] f32 VMEM out (revisited across grid steps)
+        score_ref,  # [1, Hp] f32 VMEM scratch (frozen group score)
+        extra_ref,  # [1, Hp] f32 VMEM scratch (best-fit live counter)
+    ):
+        c = pl.program_id(0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, Hp), 1)
+        lane_f = lane.astype(jnp.float32)
+
+        @pl.when(c == 0)
+        def _():
+            avail_out[:] = avail_in[:]
+            score_ref[:] = jnp.zeros_like(score_ref)
+            extra_ref[:] = jnp.zeros_like(extra_ref)
+
+        def step(i, _):
+            valid_i = valid_s[0, i] > 0
+            az = az_s[0, i]
+            d = [demands_s[r, i] for r in range(4)]
+            a = [avail_out[r : r + 1, :] for r in range(4)]
+            cost_row = cost_rt[pl.ds(az, 1), :]
+            bw_row = bw_rt[pl.ds(az, 1), :]
+
+            if first_fit:
+                # Freeze the group's host score on group entry (the
+                # reference sorts hosts once per anchor group).
+                @pl.when(ng_s[0, i] > 0)
+                def _():
+                    if sort_hosts:
+                        norms = jnp.sqrt(
+                            a[0] * a[0] + a[1] * a[1] + a[2] * a[2] + a[3] * a[3]
+                        )
+                        decay = (
+                            jnp.maximum(base_row[:], 1.0) if host_decay else 1.0
+                        )
+                        score_ref[:] = cost_row * decay / (norms * bw_row)
+                    else:
+                        score_ref[:] = lane_f
+                fit = (a[0] > d[0]) & (a[1] > d[1]) & (a[2] > d[2]) & (a[3] > d[3])
+                cand = jnp.where(fit & valid_i, score_ref[:], _BIG)
+            else:
+                r_ = [a[r] - d[r] for r in range(4)]
+                residual = jnp.sqrt(
+                    r_[0] * r_[0] + r_[1] * r_[1] + r_[2] * r_[2] + r_[3] * r_[3]
+                )
+                decay = (
+                    jnp.maximum(base_row[:] + extra_ref[:], 1.0)
+                    if host_decay
+                    else 1.0
+                )
+                per_task = cost_row * residual * decay / bw_row
+                fit = (
+                    (a[0] >= d[0]) & (a[1] >= d[1]) & (a[2] >= d[2]) & (a[3] >= d[3])
+                )
+                cand = jnp.where(fit & valid_i, per_task, _BIG)
+
+            m = jnp.min(cand)
+            ok = m < _BIG
+            h = jnp.min(jnp.where(cand == m, lane, Hp))  # ties → lowest index
+            onehot = ((lane == h) & ok).astype(jnp.float32)
+            for r in range(4):
+                avail_out[r : r + 1, :] = a[r] - d[r] * onehot
+            if not first_fit:
+                extra_ref[:] = extra_ref[:] + onehot
+            place_out[0, i] = jnp.where(ok, h, -1)
+            return 0
+
+        jax.lax.fori_loop(0, chunk, step, 0)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("bin_pack", "sort_hosts", "host_decay", "interpret"),
+)
+def cost_aware_pallas(
+    avail,  # [H, 4]
+    demands,  # [T, 4]
+    valid,  # [T] bool
+    new_group,  # [T] bool
+    anchor_zone,  # [T] i32
+    cost_zz,  # [Z, Z]
+    bw_zz,  # [Z, Z]
+    host_zone,  # [H] i32
+    base_task_counts,  # [H] i32
+    bin_pack: str = "first-fit",
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    interpret: bool = False,
+):
+    """Drop-in Pallas replacement for ``kernels.cost_aware_kernel``.
+
+    Returns ``([T] int32 placements, [H, 4] new availability)`` with the
+    same greedy semantics; ``interpret=True`` runs the Mosaic interpreter
+    (CPU parity tests).
+    """
+    H, T = avail.shape[0], demands.shape[0]
+    Hp = _round_up(max(H, 128), 128)
+    chunk = min(256, _round_up(T, 8))
+    Tp = _round_up(T, chunk)
+    f32 = jnp.float32
+
+    # [8, Hp] transposed availability; padding hosts can never fit.
+    availT = jnp.transpose(avail.astype(f32))  # [4, H]
+    avail8 = jnp.concatenate([availT, jnp.ones((4, H), f32)], axis=0)
+    avail8 = jnp.pad(avail8, ((0, 0), (0, Hp - H)), constant_values=_NEG)
+
+    def pad_t(x, fill, dt):
+        x = x.astype(dt).reshape(T, -1).T  # [w, T] — task axis on lanes
+        return jnp.pad(x, ((0, 0), (0, Tp - T)), constant_values=fill)
+
+    dem = pad_t(demands, 0.0, f32)  # [4, Tp]
+    val = pad_t(valid, 0, jnp.int32)
+    ng = pad_t(new_group, 0, jnp.int32)
+    az = pad_t(anchor_zone, 0, jnp.int32)
+
+    # Round-trip anchor-zone ↔ host tables, host-lane padded (bw pad = 1
+    # avoids div-by-zero; those lanes are unreachable via the fit mask).
+    hz = host_zone.astype(jnp.int32)
+    cost_rt = (cost_zz[:, hz] + cost_zz[hz, :].T).astype(f32)
+    bw_rt = (bw_zz[:, hz] + bw_zz[hz, :].T).astype(f32)
+    Z = cost_rt.shape[0]
+    Zp = _round_up(Z, 8)
+    cost_rt = jnp.pad(cost_rt, ((0, Zp - Z), (0, Hp - H)))
+    bw_rt = jnp.pad(bw_rt, ((0, Zp - Z), (0, Hp - H)), constant_values=1.0)
+    base_row = jnp.pad(
+        base_task_counts.astype(f32).reshape(1, H), ((0, 0), (0, Hp - H))
+    )
+
+    grid = (Tp // chunk,)
+    smem_chunk = lambda w: pl.BlockSpec(  # noqa: E731
+        (w, chunk), lambda c: (0, c), memory_space=pltpu.SMEM
+    )
+    whole = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda c: tuple(0 for _ in shape), memory_space=pltpu.VMEM
+    )
+    placements, avail_out = pl.pallas_call(
+        _greedy_body(
+            first_fit=bin_pack == "first-fit",
+            sort_hosts=sort_hosts,
+            host_decay=host_decay,
+            chunk=chunk,
+            Hp=Hp,
+        ),
+        grid=grid,
+        in_specs=[
+            smem_chunk(4),  # demands
+            smem_chunk(1),  # valid
+            smem_chunk(1),  # new_group
+            smem_chunk(1),  # anchor zone
+            whole((Zp, Hp)),  # cost_rt
+            whole((Zp, Hp)),  # bw_rt
+            whole((1, Hp)),  # base counts
+            whole((8, Hp)),  # avail in
+        ],
+        out_specs=(
+            smem_chunk(1),
+            whole((8, Hp)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Tp), jnp.int32),
+            jax.ShapeDtypeStruct((8, Hp), f32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((1, Hp), f32),  # frozen group score
+            pltpu.VMEM((1, Hp), f32),  # best-fit live counter
+        ],
+        interpret=interpret,
+    )(dem, val, ng, az, cost_rt, bw_rt, base_row, avail8)
+
+    return (
+        placements[0, :T],
+        jnp.transpose(avail_out[:4, :H]).astype(avail.dtype),
+    )

@@ -30,6 +30,7 @@ from pivot_tpu.ops.kernels import (
     first_fit_kernel,
     opportunistic_kernel,
 )
+from pivot_tpu.ops.pallas_kernels import cost_aware_pallas
 from pivot_tpu.sched import Policy, TickContext
 from pivot_tpu.sched.policies import CostAwarePolicy, _sort_decreasing
 from pivot_tpu.sched.rand import tick_uniforms
@@ -155,6 +156,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         sort_tasks: bool = False,
         sort_hosts: bool = False,
         host_decay: bool = False,
+        use_pallas: Optional[bool] = None,
     ):
         super().__init__()
         assert bin_pack in ("first-fit", "best-fit")
@@ -162,6 +164,10 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         self.sort_tasks = sort_tasks
         self.sort_hosts = sort_hosts
         self.host_decay = host_decay
+        # The Pallas greedy kernel keeps the whole tick in VMEM (~5× the
+        # scan kernel per tick on a v5e) but is f32-only; auto-enable on
+        # the TPU backend, keep the scan kernel for CPU/f64 parity runs.
+        self.use_pallas = use_pallas
         # Grouping logic shared verbatim with the CPU policy.
         self._grouper = CostAwarePolicy(
             bin_pack=bin_pack,
@@ -196,7 +202,15 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         ng_arr = np.zeros(B, dtype=bool)
         ng_arr[:T] = new_group
         avail, dem, valid = self._padded(ctx, order)
-        placements, _ = cost_aware_kernel(
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            import jax
+
+            use_pallas = (
+                jax.default_backend() == "tpu" and self.dtype == jnp.float32
+            )
+        kernel = cost_aware_pallas if use_pallas else cost_aware_kernel
+        placements, _ = kernel(
             avail,
             dem,
             valid,
