@@ -50,13 +50,16 @@ class RunSpec:
     n_apps: int
     scale_factor: float
     seed: int
+    trace_events: bool = False
+    profile_dir: str = ""
 
 
 def _execute_run(spec: RunSpec) -> None:
     from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.utils.trace import device_profile
 
     cluster = build_cluster(spec.cluster)
-    ExperimentRun(
+    run = ExperimentRun(
         spec.policy.display_label,
         cluster,
         make_policy(spec.policy),
@@ -65,7 +68,19 @@ def _execute_run(spec: RunSpec) -> None:
         n_apps=spec.n_apps,
         data_dir=spec.data_dir,
         seed=spec.seed,
-    ).run()
+        trace_events=spec.trace_events,
+    )
+    # Per-run profile dir: jax.profiler names sessions by wall-clock second
+    # and hostname, so concurrent/sub-second runs sharing one dir collide.
+    # Reuse the run's unique data-dir tail (".../data/<...>/<i>") as the key.
+    profile_dir = ""
+    if spec.profile_dir:
+        tail = spec.data_dir.split(os.sep + "data" + os.sep, 1)[-1]
+        profile_dir = os.path.join(
+            spec.profile_dir, tail, spec.policy.display_label
+        )
+    with device_profile(profile_dir):
+        run.run()
 
 
 def parse_args(argv=None):
@@ -99,6 +114,12 @@ def parse_args(argv=None):
         help="network fabric backend (native = C++ co-simulator)",
     )
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--trace-events", action="store_true",
+                        help="write structured event traces (events.jsonl + "
+                             "Chrome/Perfetto events.chrome.json) per run")
+    parser.add_argument("--profile-dir", default="",
+                        help="capture a jax.profiler device trace into this "
+                             "directory (TensorBoard-loadable)")
     parser.add_argument("--workers", type=int, default=1,
                         help="process-parallel runs (1 = sequential)")
     parser.add_argument("--trace-limit", type=int, default=None,
@@ -186,7 +207,8 @@ def run_overall(args) -> str:
     policy_set = reference_policy_set(args.device)
     specs = [
         RunSpec(cluster_cfg, pc, trace, os.path.join(exp_dir, "data", str(i)),
-                args.num_apps, args.scale_factor, args.seed)
+                args.num_apps, args.scale_factor, args.seed,
+                args.trace_events, args.profile_dir)
         for i, trace in enumerate(traces)
         for pc in policy_set
     ]
@@ -205,7 +227,8 @@ def run_num_apps(args) -> str:
     specs = [
         RunSpec(cluster_cfg, pc, trace,
                 os.path.join(exp_dir, "data", str(n), str(i)),
-                n, args.scale_factor, args.seed)
+                n, args.scale_factor, args.seed,
+                args.trace_events, args.profile_dir)
         for n in args.num_apps_list
         for i, trace in enumerate(traces)
         for pc in policy_set

@@ -23,6 +23,7 @@ from pivot_tpu.infra import Cluster
 from pivot_tpu.infra.meter import Meter
 from pivot_tpu.sched import GlobalScheduler, Policy
 from pivot_tpu.utils import LogMixin
+from pivot_tpu.utils.trace import Tracer
 from pivot_tpu.workload.trace import TraceSchedule, load_trace_jobs
 
 __all__ = ["ExperimentRun", "replay_schedule"]
@@ -68,6 +69,7 @@ class ExperimentRun(LogMixin):
         data_dir: Optional[str] = None,
         seed: Optional[int] = None,
         interval: float = 5,
+        trace_events: bool = False,
     ):
         self.label = label
         self.cluster = cluster
@@ -78,11 +80,16 @@ class ExperimentRun(LogMixin):
         self.data_dir = data_dir
         self.seed = seed
         self.interval = interval
+        # Structured event tracing (utils.trace); written next to the
+        # meter's JSON when data_dir is set, kept on .tracer otherwise.
+        self.trace_events = trace_events
+        self.tracer: Optional[Tracer] = None
 
     def run(self) -> dict:
         env = Environment()
         meter = Meter(env, self.cluster.meta)
         cluster = self.cluster.clone(env, meter)
+        self.tracer = Tracer(enabled=self.trace_events)
         scheduler = GlobalScheduler(
             env,
             cluster,
@@ -90,6 +97,7 @@ class ExperimentRun(LogMixin):
             interval=self.interval,
             seed=self.seed,
             meter=meter,
+            tracer=self.tracer,
         )
         schedule = load_trace_jobs(self.trace_file, self.output_size_scale_factor)
         if self.n_apps:
@@ -119,6 +127,9 @@ class ExperimentRun(LogMixin):
             general["avg_runtime"] = avg_runtime
             with open(general_path, "w") as f:
                 json.dump(general, f)
+            if self.trace_events:
+                self.tracer.save_jsonl(os.path.join(out, "events.jsonl"))
+                self.tracer.save_chrome(os.path.join(out, "events.chrome.json"))
         self.logger.info(
             "finished %s: avg_runtime=%.1f egress=$%.2f wall=%.2fs",
             self.label,

@@ -166,6 +166,8 @@ def cost_aware_pallas(
     (CPU parity tests).
     """
     H, T = avail.shape[0], demands.shape[0]
+    if T == 0:  # empty tick — the scan kernel's length-0 scan equivalent
+        return jnp.zeros((0,), jnp.int32), avail
     Hp = _round_up(max(H, 128), 128)
     chunk = min(256, _round_up(T, 8))
     Tp = _round_up(T, chunk)

@@ -41,6 +41,7 @@ from pivot_tpu.des import Environment, Store
 from pivot_tpu.infra import Cluster, Host
 from pivot_tpu.infra.meter import Meter
 from pivot_tpu.utils import LogMixin
+from pivot_tpu.utils.trace import NULL_TRACER, Tracer
 from pivot_tpu.workload import Application, Task
 
 __all__ = ["TickContext", "Policy", "GlobalScheduler", "LocalScheduler"]
@@ -196,6 +197,7 @@ class GlobalScheduler(LogMixin):
         interval: float = 5,
         seed: Optional[int] = None,
         meter: Optional[Meter] = None,
+        tracer: Optional[Tracer] = None,
     ):
         self.env = env
         self.cluster = cluster
@@ -203,6 +205,7 @@ class GlobalScheduler(LogMixin):
         self.interval = interval
         self.seed = seed
         self.meter = meter
+        self.tracer = tracer or NULL_TRACER
         self.randomizer = np.random.RandomState(seed)
         self.submit_q = Store(env)
         self._wait_stack: List[Task] = []
@@ -248,7 +251,14 @@ class GlobalScheduler(LogMixin):
                 if self.meter:
                     self.meter.increment_scheduling_ops(len(ready))
                 ctx = TickContext(self, ready, self._tick_seq)
-                placements = self.policy.place(ctx)
+                with self.tracer.span(
+                    "scheduler", "tick", env.now, n_ready=len(ready)
+                ) as span_args:
+                    placements = self.policy.place(ctx)
+                    if self.tracer.enabled:
+                        span_args["n_placed"] = int(
+                            sum(1 for h in placements if h >= 0)
+                        )
                 self._tick_seq += 1
                 for task, h_idx in zip(ready, placements):
                     if not task.is_nascent:
@@ -278,13 +288,18 @@ class GlobalScheduler(LogMixin):
                 continue
             if success:
                 task.set_finished()
+                self.tracer.emit(
+                    "task", "finished", env.now, id=task.id, host=task.placement
+                )
                 local.notify(task)
             else:
                 task.set_nascent()
                 task.placement = None
+                self.tracer.emit("task", "retry", env.now, id=task.id)
                 self.submit_q.put(task)
             if app.is_finished:
                 app.end_time = env.now
+                self.tracer.emit("app", "finished", env.now, id=app.id)
                 self.logger.debug(
                     "[%.3f] application %s finished in %.3f s",
                     env.now,

@@ -101,6 +101,15 @@ def test_pallas_vmap_batched():
         assert p_ref.tolist() == batched[0][r].tolist()
 
 
+def test_pallas_empty_tick():
+    """T == 0 mirrors the scan kernel's length-0 scan (no device call)."""
+    args = make_inputs(0, 0, 8)
+    mode = dict(bin_pack="first-fit", sort_hosts=True)
+    p, out = cost_aware_pallas(*args, **mode, interpret=True)
+    assert p.shape == (0,)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(args[0]))
+
+
 def test_pallas_no_fit_and_invalid():
     """Unplaceable and padded-invalid tasks yield -1 and leave avail alone."""
     avail = jnp.asarray(np.full((6, 4), 0.5, np.float32))

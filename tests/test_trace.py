@@ -1,0 +1,159 @@
+"""Tests for the structured tracing subsystem (utils.trace)."""
+
+import json
+import os
+
+import pytest
+
+from pivot_tpu.utils.trace import NULL_TRACER, Tracer, device_profile
+
+
+def test_emit_and_span():
+    tr = Tracer()
+    tr.emit("task", "finished", sim=10.0, id="t/0")
+    with tr.span("scheduler", "tick", sim=5.0, n_ready=3) as args:
+        args["n_placed"] = 2
+    assert len(tr.events) == 2
+    inst, span = tr.events
+    assert inst["cat"] == "task" and inst["sim"] == 10.0
+    assert "dur" not in inst
+    assert span["args"] == {"n_ready": 3, "n_placed": 2}
+    assert span["dur"] >= 0
+
+
+def test_null_tracer_records_nothing():
+    NULL_TRACER.emit("x", "y", 0.0)
+    with NULL_TRACER.span("x", "y", 0.0):
+        pass
+    assert NULL_TRACER.events == []
+
+
+def test_serialization(tmp_path):
+    tr = Tracer()
+    tr.emit("task", "finished", sim=1.0)
+    with tr.span("scheduler", "tick", sim=2.0):
+        pass
+    jl = tmp_path / "events.jsonl"
+    ch = tmp_path / "events.chrome.json"
+    tr.save_jsonl(str(jl))
+    tr.save_chrome(str(ch))
+    lines = [json.loads(l) for l in jl.read_text().splitlines()]
+    assert len(lines) == 2 and lines[0]["name"] == "finished"
+    chrome = json.loads(ch.read_text())
+    evts = chrome["traceEvents"]
+    assert {e["ph"] for e in evts} == {"i", "X"}
+    assert evts[0]["ts"] == 1.0 * 1e6  # sim timeline in µs
+    # wall timeline variant
+    tr.save_chrome(str(ch), timeline="wall")
+    assert json.loads(ch.read_text())["traceEvents"]
+
+
+def test_analysis_helpers():
+    tr = Tracer()
+    with tr.span("scheduler", "tick", sim=0.0):
+        pass
+    with tr.span("scheduler", "tick", sim=5.0):
+        pass
+    tr.emit("task", "finished", sim=6.0)
+    assert len(tr.by_category("scheduler")) == 2
+    assert tr.total_dur("scheduler", "tick") > 0
+    assert tr.total_dur("task") == 0.0
+
+
+def test_device_profile_noop():
+    with device_profile(None):
+        pass
+    with device_profile(""):
+        pass
+
+
+def test_device_profile_captures(tmp_path):
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "prof")
+    with device_profile(logdir):
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    # jax writes plugins/profile/<ts>/*.xplane.pb under the logdir
+    found = [
+        os.path.join(r, f)
+        for r, _d, fs in os.walk(logdir)
+        for f in fs
+        if f.endswith(".xplane.pb")
+    ]
+    assert found
+
+
+def test_scheduler_emits_trace_events():
+    """End-to-end: a tiny simulation populates tick + task + app events."""
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra import Cluster, Host, Storage
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.sched import GlobalScheduler
+    from pivot_tpu.sched.policies import FirstFitPolicy
+    from pivot_tpu.workload import Application, TaskGroup
+
+    meta = ResourceMetadata(seed=0)
+    env = Environment()
+    zones = meta.zones
+    hosts = [Host(env, 4, 4096, 100, 0, locality=zones[0]) for _ in range(2)]
+    cluster = Cluster(
+        env,
+        hosts=hosts,
+        storage=[Storage(env, zones[0])],
+        meta=meta,
+        route_mode="meta",
+        seed=0,
+    )
+    tracer = Tracer()
+    sched = GlobalScheduler(env, cluster, FirstFitPolicy(), tracer=tracer)
+    app = Application(
+        "a",
+        [
+            TaskGroup("g1", cpus=1, mem=128, runtime=3, output_size=10, instances=2),
+            TaskGroup("g2", cpus=1, mem=128, runtime=2, dependencies=["g1"]),
+        ],
+    )
+    cluster.start()
+    sched.start()
+    sched.submit(app)
+    sched.stop()
+    env.run()
+
+    cats = {e["cat"] for e in tracer.events}
+    assert {"scheduler", "task", "app"} <= cats
+    ticks = [e for e in tracer.events if e["name"] == "tick"]
+    assert ticks and ticks[0]["args"]["n_ready"] == 2
+    assert ticks[0]["args"]["n_placed"] == 2
+    finished = [e for e in tracer.events if e["name"] == "finished"]
+    assert len(finished) == 4  # 3 tasks + 1 app
+    assert app.is_finished
+
+
+def test_experiment_run_writes_trace_files(tmp_path):
+    from pivot_tpu.des import Environment
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.sched.policies import CostAwarePolicy
+
+    meta = ResourceMetadata(seed=0)
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(10)
+    run = ExperimentRun(
+        "traced",
+        cluster,
+        CostAwarePolicy(mode="numpy"),
+        "data/jobs/jobs-5000-200-86400-172800.npz",
+        n_apps=5,
+        seed=1,
+        data_dir=str(tmp_path),
+        trace_events=True,
+    )
+    run.run()
+    out = tmp_path / "traced"
+    assert (out / "events.jsonl").exists()
+    assert (out / "events.chrome.json").exists()
+    assert run.tracer.total_dur("scheduler", "tick") > 0
