@@ -55,6 +55,8 @@ class RunSpec:
 
 
 def _execute_run(spec: RunSpec) -> None:
+    import json
+
     from pivot_tpu.experiments.runner import ExperimentRun
     from pivot_tpu.utils.trace import device_profile
 
@@ -70,6 +72,20 @@ def _execute_run(spec: RunSpec) -> None:
         seed=spec.seed,
         trace_events=spec.trace_events,
     )
+    # Grid-level resume: skip only when the completion sentinel — written
+    # as the run's LAST artifact — exists AND describes this exact run
+    # (same trace/label/config; a reshuffled trace list or changed flags
+    # must re-run, not silently inherit a stale directory).
+    marker = os.path.join(spec.data_dir, spec.policy.display_label, "complete.json")
+    if os.path.exists(marker):
+        with open(marker) as f:
+            recorded = json.load(f)
+        if recorded == run.run_identity():
+            logger.info("skipping completed run %s (%s)",
+                        spec.policy.display_label, spec.data_dir)
+            return
+        logger.warning("stale results in %s (different run spec) — rerunning",
+                       spec.data_dir)
     # Per-run profile dir: jax.profiler names sessions by wall-clock second
     # and hostname, so concurrent/sub-second runs sharing one dir collide.
     # Reuse the run's unique data-dir tail (".../data/<...>/<i>") as the key.
@@ -122,6 +138,9 @@ def parse_args(argv=None):
                              "directory (TensorBoard-loadable)")
     parser.add_argument("--workers", type=int, default=1,
                         help="process-parallel runs (1 = sequential)")
+    parser.add_argument("--resume", default=None, metavar="EXP_DIR",
+                        help="resume an interrupted sweep: reuse this "
+                             "experiment directory and skip completed runs")
     parser.add_argument("--trace-limit", type=int, default=None,
                         help="use only the first N trace files")
     sub = parser.add_subparsers(dest="command")
@@ -200,7 +219,9 @@ def _cluster_config(args) -> ClusterConfig:
 
 
 def run_overall(args) -> str:
-    exp_dir = os.path.join(args.output_dir, "overall", str(int(time.time())))
+    exp_dir = args.resume or os.path.join(
+        args.output_dir, "overall", str(int(time.time()))
+    )
     os.makedirs(exp_dir, exist_ok=True)
     cluster_cfg = _cluster_config(args)
     traces = _list_traces(args.job_dir, args.trace_limit)
@@ -219,7 +240,9 @@ def run_overall(args) -> str:
 
 
 def run_num_apps(args) -> str:
-    exp_dir = os.path.join(args.output_dir, "n_app", str(int(time.time())))
+    exp_dir = args.resume or os.path.join(
+        args.output_dir, "n_app", str(int(time.time()))
+    )
     os.makedirs(exp_dir, exist_ok=True)
     cluster_cfg = _cluster_config(args)
     traces = _list_traces(args.job_dir, args.trace_limit)

@@ -85,6 +85,16 @@ class ExperimentRun(LogMixin):
         self.trace_events = trace_events
         self.tracer: Optional[Tracer] = None
 
+    def run_identity(self) -> dict:
+        """What makes this run *this* run — compared on grid resume."""
+        return {
+            "label": self.label,
+            "trace_file": os.path.abspath(self.trace_file),
+            "n_apps": self.n_apps,
+            "seed": self.seed,
+            "scale_factor": self.output_size_scale_factor,
+        }
+
     def run(self) -> dict:
         env = Environment()
         meter = Meter(env, self.cluster.meta)
@@ -130,6 +140,11 @@ class ExperimentRun(LogMixin):
             if self.trace_events:
                 self.tracer.save_jsonl(os.path.join(out, "events.jsonl"))
                 self.tracer.save_chrome(os.path.join(out, "events.chrome.json"))
+            # Completion sentinel — written LAST, carrying the run identity,
+            # so grid resume can (a) trust every other artifact exists and
+            # (b) refuse to skip when the spec behind this dir changed.
+            with open(os.path.join(out, "complete.json"), "w") as f:
+                json.dump(self.run_identity(), f)
         self.logger.info(
             "finished %s: avg_runtime=%.1f egress=$%.2f wall=%.2fs",
             self.label,

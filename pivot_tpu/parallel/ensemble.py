@@ -45,7 +45,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pivot_tpu.ops.kernels import DeviceTopology, cost_aware_kernel
 
-__all__ = ["EnsembleWorkload", "RolloutResult", "rollout", "sharded_rollout"]
+__all__ = [
+    "EnsembleWorkload",
+    "RolloutResult",
+    "RolloutState",
+    "rollout",
+    "rollout_checkpointed",
+    "sharded_rollout",
+]
 
 
 class EnsembleWorkload(NamedTuple):
@@ -115,32 +122,57 @@ class RolloutResult(NamedTuple):
     n_unfinished: jax.Array  # [R] tasks still pending at the horizon
 
 
+class RolloutState(NamedTuple):
+    """The full mutable state of one replica's rollout — pure arrays, which
+    is what makes mid-flight checkpoint/resume trivial (something the
+    reference's generator-based processes could never serialize)."""
+
+    t: jax.Array  # scalar sim time
+    stage: jax.Array  # [T] i32
+    finish: jax.Array  # [T]
+    place: jax.Array  # [T] i32
+    avail: jax.Array  # [H, 4]
+
+
 # Task stages.
 _PENDING, _RUNNING, _DONE = 0, 1, 2
 
 
-def _single_rollout(
-    avail0,  # [H, 4]
+def _init_state(avail0, T) -> RolloutState:
+    dtype = avail0.dtype
+    return RolloutState(
+        t=jnp.asarray(0.0, dtype),
+        stage=jnp.full((T,), _PENDING, dtype=jnp.int32),
+        finish=jnp.full((T,), jnp.inf, dtype=dtype),
+        place=jnp.full((T,), -1, dtype=jnp.int32),
+        avail=avail0,
+    )
+
+
+def _rollout_segment(
+    state: RolloutState,
     runtime,  # [T] perturbed
     arrival,  # [T] perturbed
     root_anchor,  # [T] i32 random storage zone per task (used for roots)
     workload: EnsembleWorkload,
     topo: DeviceTopology,
     tick: float,
-    max_ticks: int,
-):
+    n_ticks: int,
+) -> RolloutState:
+    """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
+    (stops early once every task is done)."""
     T = workload.n_tasks
-    H = avail0.shape[0]
+    H = state.avail.shape[0]
     Z = topo.cost.shape[0]
-    dtype = avail0.dtype
+    dtype = state.avail.dtype
     has_pred = jnp.sum(workload.pred, axis=1) > 0  # [T]
 
-    def cond(state):
-        t, stage, *_ = state
-        return (t < tick * max_ticks) & jnp.any(stage != _DONE)
+    def cond(carry):
+        i, state = carry
+        return (i < n_ticks) & jnp.any(state.stage != _DONE)
 
-    def body(state):
-        t, stage, finish, place, avail = state
+    def body(carry):
+        i, (t, stage, finish, place, avail) = carry
 
         # 1. Retire finished tasks and refund their resources.
         newly_done = (stage == _RUNNING) & (finish <= t)
@@ -196,17 +228,18 @@ def _single_rollout(
         place = jnp.where(placed, placements, place)
         finish = jnp.where(placed, t + xfer_delay + runtime, finish)
 
-        return (t + tick, stage, finish, place, avail)
+        return (i + 1, RolloutState(t + tick, stage, finish, place, avail))
 
-    state0 = (
-        jnp.asarray(0.0, dtype),
-        jnp.full((T,), _PENDING, dtype=jnp.int32),
-        jnp.full((T,), jnp.inf, dtype=dtype),
-        jnp.full((T,), -1, dtype=jnp.int32),
-        avail0,
-    )
-    t, stage, finish, place, avail = lax.while_loop(cond, body, state0)
+    _, out = lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), state))
+    return out
 
+
+def _finalize(
+    state: RolloutState, workload: EnsembleWorkload, topo: DeviceTopology
+) -> RolloutResult:
+    H = state.avail.shape[0]
+    dtype = state.avail.dtype
+    finish, place, stage = state.finish, state.place, state.stage
     done = stage == _DONE
     makespan = jnp.max(jnp.where(done, finish, 0.0))
     # Egress: Σ_edges cost(zone_p → zone_i) · output_mb(p) / 8000, counting
@@ -224,6 +257,43 @@ def _single_rollout(
         placement=place,
         n_unfinished=jnp.sum(~done),
     )
+
+
+def _single_rollout(
+    avail0,  # [H, 4]
+    runtime,  # [T] perturbed
+    arrival,  # [T] perturbed
+    root_anchor,  # [T] i32 random storage zone per task (used for roots)
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    max_ticks: int,
+) -> RolloutResult:
+    state = _init_state(avail0, workload.n_tasks)
+    state = _rollout_segment(
+        state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks
+    )
+    return _finalize(state, workload, topo)
+
+
+def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
+    """Deterministic per-replica Monte-Carlo draws — regenerated (not
+    stored) on checkpoint resume, since they are a pure function of key."""
+    T = workload.n_tasks
+    k_rt, k_arr, k_anchor = jax.random.split(key, 3)
+    rt = workload.runtime[None, :] * jax.random.uniform(
+        k_rt, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
+        dtype=dtype,
+    )
+    arr = workload.arrival[None, :] * jax.random.uniform(
+        k_arr, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
+        dtype=dtype,
+    )
+    anchor_idx = jax.random.randint(
+        k_anchor, (n_replicas, T), 0, storage_zones.shape[0]
+    )
+    root_anchor = storage_zones[anchor_idx].astype(jnp.int32)
+    return rt, arr, root_anchor
 
 
 @functools.partial(
@@ -245,21 +315,9 @@ def rollout(
     Replica r perturbs task runtimes and arrivals by ``±perturb`` and draws
     independent random root anchors — the BASELINE.json ensemble configs.
     """
-    T = workload.n_tasks
-    k_rt, k_arr, k_anchor = jax.random.split(key, 3)
-    rt = workload.runtime[None, :] * jax.random.uniform(
-        k_rt, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
-        dtype=avail0.dtype,
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
-    arr = workload.arrival[None, :] * jax.random.uniform(
-        k_arr, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
-        dtype=avail0.dtype,
-    )
-    anchor_idx = jax.random.randint(
-        k_anchor, (n_replicas, T), 0, storage_zones.shape[0]
-    )
-    root_anchor = storage_zones[anchor_idx].astype(jnp.int32)
-
     return jax.vmap(
         lambda r, a, ra: _single_rollout(
             avail0, r, a, ra, workload, topo, tick, max_ticks
@@ -312,3 +370,139 @@ def sharded_rollout(
     """
     fn = _sharded_rollout_fn(mesh, n_replicas, tick, max_ticks, perturb)
     return fn(key, avail0, workload, topo, storage_zones)
+
+
+# -- checkpoint / resume -----------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_replicas", "tick", "perturb")
+)
+def _segment_step(
+    key,
+    state: RolloutState,
+    avail0,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    n_replicas: int,
+    tick: float,
+    segment_ticks,  # traced i32 scalar — the final partial segment must
+    perturb: float,  # not trigger an XLA recompile of the whole rollout
+) -> RolloutState:
+    """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
+    return jax.vmap(
+        lambda s, r, a, ra: _rollout_segment(
+            s, r, a, ra, workload, topo, tick, segment_ticks
+        )
+    )(state, rt, arr, root_anchor)
+
+
+def _fingerprint(
+    key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
+    storage_zones,
+) -> str:
+    """Hash of every input that determines the rollout trajectory —
+    including array *contents*, so a checkpoint can never be resumed
+    against edited workload data that merely kept its shapes."""
+    import hashlib
+
+    h = hashlib.sha256(
+        repr((np.asarray(key).tolist(), n_replicas, tick, max_ticks, perturb)).encode()
+    )
+    for tree in (workload, topo, (avail0, storage_zones)):
+        for arr in jax.tree_util.tree_leaves(tree):
+            a = np.ascontiguousarray(np.asarray(arr))
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def rollout_checkpointed(
+    key,
+    avail0,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    checkpoint_path: str,
+    n_replicas: int = 64,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+    segment_ticks: int = 64,
+    resume: bool = True,
+) -> RolloutResult:
+    """:func:`rollout` with mid-flight checkpoint/resume.
+
+    The rollout runs in jitted segments of ``segment_ticks``; after each
+    segment the ``[R]``-stacked :class:`RolloutState` (pure arrays) is
+    written atomically (tmp + rename) to ``checkpoint_path`` (``.npz``).
+    If the process dies, rerunning with ``resume=True`` loads the last
+    state and continues — the final result is bit-identical to an
+    uninterrupted :func:`rollout` with the same arguments, because the
+    Monte-Carlo draws are a pure function of ``key`` (regenerated, not
+    stored) and segmentation does not change the tick sequence.
+
+    A config fingerprint stored alongside the state refuses to resume a
+    checkpoint produced by different arguments.  The reference has no
+    analog: its runs are one-shot to event exhaustion
+    (``alibaba/runner.py:44``), and its process state (generator frames)
+    could not be serialized anyway.
+    """
+    import os
+
+    T, H = workload.n_tasks, avail0.shape[0]
+    fp = _fingerprint(
+        key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
+        storage_zones,
+    )
+
+    ticks_done = 0
+    state = None
+    if resume and os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path, allow_pickle=False) as ckpt:
+            if str(ckpt["fingerprint"]) == fp:
+                state = RolloutState(
+                    t=jnp.asarray(ckpt["t"]),
+                    stage=jnp.asarray(ckpt["stage"]),
+                    finish=jnp.asarray(ckpt["finish"]),
+                    place=jnp.asarray(ckpt["place"]),
+                    avail=jnp.asarray(ckpt["avail"]),
+                )
+                ticks_done = int(ckpt["ticks_done"])
+    if state is None:
+        state = jax.vmap(lambda _: _init_state(avail0, T))(jnp.arange(n_replicas))
+
+    while ticks_done < max_ticks and bool(jnp.any(state.stage != _DONE)):
+        seg = min(segment_ticks, max_ticks - ticks_done)
+        state = _segment_step(
+            key,
+            state,
+            avail0,
+            workload,
+            topo,
+            storage_zones,
+            n_replicas=n_replicas,
+            tick=tick,
+            segment_ticks=jnp.asarray(seg, jnp.int32),
+            perturb=perturb,
+        )
+        jax.block_until_ready(state)
+        ticks_done += seg
+        tmp = checkpoint_path + ".tmp.npz"  # np.savez keeps an .npz suffix
+        np.savez(
+            tmp,
+            fingerprint=fp,
+            ticks_done=ticks_done,
+            t=np.asarray(state.t),
+            stage=np.asarray(state.stage),
+            finish=np.asarray(state.finish),
+            place=np.asarray(state.place),
+            avail=np.asarray(state.avail),
+        )
+        os.replace(tmp, checkpoint_path)
+
+    return jax.vmap(lambda s: _finalize(s, workload, topo))(state)
