@@ -54,11 +54,57 @@ class RunSpec:
     profile_dir: str = ""
 
 
-def _execute_run(spec: RunSpec) -> None:
+def _spec_identity(spec: RunSpec) -> dict:
+    """The full identity of a run, derived from the spec alone — EVERY knob
+    that can change results is included (cluster shape, policy backend and
+    hyperparameters, flags), so ``--resume`` re-runs rather than silently
+    inheriting a directory produced under different configuration."""
+    return {
+        "label": spec.policy.display_label,
+        "trace_file": os.path.abspath(spec.trace),
+        "n_apps": spec.n_apps,
+        "seed": spec.seed,
+        "scale_factor": spec.scale_factor,
+        "cluster": dataclasses.asdict(spec.cluster),
+        "policy": dataclasses.asdict(spec.policy),
+        "trace_events": spec.trace_events,
+    }
+
+
+def _is_complete(spec: RunSpec) -> bool:
+    """True iff the run's completion sentinel — written atomically as its
+    LAST artifact — exists, parses, and describes this exact run.  An
+    unreadable/truncated sentinel counts as incomplete."""
     import json
 
+    from pivot_tpu.experiments.runner import sentinel_path
+
+    marker = sentinel_path(spec.data_dir, spec.policy.display_label)
+    if not os.path.exists(marker):
+        return False
+    try:
+        with open(marker) as f:
+            recorded = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return False
+    if recorded == _spec_identity(spec):
+        return True
+    logger.warning("stale results in %s (different run spec) — rerunning",
+                   spec.data_dir)
+    return False
+
+
+def _execute_run(spec: RunSpec) -> None:
     from pivot_tpu.experiments.runner import ExperimentRun
     from pivot_tpu.utils.trace import device_profile
+
+    # Grid-level resume.  _run_grid also pre-filters in the parent (so a
+    # worker process is never forked for a skip); this in-run check covers
+    # sequential execution and direct callers, before any construction.
+    if _is_complete(spec):
+        logger.info("skipping completed run %s (%s)",
+                    spec.policy.display_label, spec.data_dir)
+        return
 
     cluster = build_cluster(spec.cluster)
     run = ExperimentRun(
@@ -71,21 +117,8 @@ def _execute_run(spec: RunSpec) -> None:
         data_dir=spec.data_dir,
         seed=spec.seed,
         trace_events=spec.trace_events,
+        identity=_spec_identity(spec),
     )
-    # Grid-level resume: skip only when the completion sentinel — written
-    # as the run's LAST artifact — exists AND describes this exact run
-    # (same trace/label/config; a reshuffled trace list or changed flags
-    # must re-run, not silently inherit a stale directory).
-    marker = os.path.join(spec.data_dir, spec.policy.display_label, "complete.json")
-    if os.path.exists(marker):
-        with open(marker) as f:
-            recorded = json.load(f)
-        if recorded == run.run_identity():
-            logger.info("skipping completed run %s (%s)",
-                        spec.policy.display_label, spec.data_dir)
-            return
-        logger.warning("stale results in %s (different run spec) — rerunning",
-                       spec.data_dir)
     # Per-run profile dir: jax.profiler names sessions by wall-clock second
     # and hostname, so concurrent/sub-second runs sharing one dir collide.
     # Reuse the run's unique data-dir tail (".../data/<...>/<i>") as the key.
@@ -122,6 +155,14 @@ def parse_args(argv=None):
         choices=["naive", "numpy", "tpu"],
         default="numpy",
         help="policy backend",
+    )
+    parser.add_argument(
+        "--no-adaptive",
+        action="store_false",
+        dest="adaptive",
+        help="tpu backend: always call the device, even for ticks too small "
+             "to amortize the per-call link latency (default: adaptive "
+             "routing between device and in-process numpy twin)",
     )
     parser.add_argument(
         "--network",
@@ -192,6 +233,16 @@ def _run_grid(specs: List[RunSpec], workers: int):
         for spec in specs:
             _execute_run(spec)
         return
+    # Pre-filter completed runs in the parent: forking a fresh interpreter
+    # (full package + jax import) just to read one sentinel is not free.
+    pending = []
+    for spec in specs:
+        if _is_complete(spec):
+            logger.info("skipping completed run %s (%s)",
+                        spec.policy.display_label, spec.data_dir)
+        else:
+            pending.append(spec)
+    specs = pending
     import multiprocessing as mp
 
     active = []
@@ -225,7 +276,7 @@ def run_overall(args) -> str:
     os.makedirs(exp_dir, exist_ok=True)
     cluster_cfg = _cluster_config(args)
     traces = _list_traces(args.job_dir, args.trace_limit)
-    policy_set = reference_policy_set(args.device)
+    policy_set = reference_policy_set(args.device, adaptive=args.adaptive)
     specs = [
         RunSpec(cluster_cfg, pc, trace, os.path.join(exp_dir, "data", str(i)),
                 args.num_apps, args.scale_factor, args.seed,
@@ -246,7 +297,7 @@ def run_num_apps(args) -> str:
     os.makedirs(exp_dir, exist_ok=True)
     cluster_cfg = _cluster_config(args)
     traces = _list_traces(args.job_dir, args.trace_limit)
-    policy_set = reference_policy_set(args.device)
+    policy_set = reference_policy_set(args.device, adaptive=args.adaptive)
     specs = [
         RunSpec(cluster_cfg, pc, trace,
                 os.path.join(exp_dir, "data", str(n), str(i)),

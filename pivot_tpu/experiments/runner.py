@@ -26,7 +26,14 @@ from pivot_tpu.utils import LogMixin
 from pivot_tpu.utils.trace import Tracer
 from pivot_tpu.workload.trace import TraceSchedule, load_trace_jobs
 
-__all__ = ["ExperimentRun", "replay_schedule"]
+__all__ = ["ExperimentRun", "replay_schedule", "sentinel_path"]
+
+
+def sentinel_path(data_dir: str, label: str) -> str:
+    """Completion-sentinel location for a run — the single definition shared
+    by the writer (``ExperimentRun.run``) and the resume check
+    (``experiments.cli``)."""
+    return os.path.join(data_dir, label, "complete.json")
 
 
 def replay_schedule(
@@ -70,6 +77,7 @@ class ExperimentRun(LogMixin):
         seed: Optional[int] = None,
         interval: float = 5,
         trace_events: bool = False,
+        identity: Optional[dict] = None,
     ):
         self.label = label
         self.cluster = cluster
@@ -84,9 +92,16 @@ class ExperimentRun(LogMixin):
         # meter's JSON when data_dir is set, kept on .tracer otherwise.
         self.trace_events = trace_events
         self.tracer: Optional[Tracer] = None
+        self.identity = identity
 
     def run_identity(self) -> dict:
-        """What makes this run *this* run — compared on grid resume."""
+        """What makes this run *this* run — compared on grid resume.
+
+        The grid driver passes the full spec identity (cluster config,
+        policy config including device/adaptive, flags) via ``identity``;
+        the fallback fields cover direct ``ExperimentRun`` users."""
+        if self.identity is not None:
+            return self.identity
         return {
             "label": self.label,
             "trace_file": os.path.abspath(self.trace_file),
@@ -140,11 +155,16 @@ class ExperimentRun(LogMixin):
             if self.trace_events:
                 self.tracer.save_jsonl(os.path.join(out, "events.jsonl"))
                 self.tracer.save_chrome(os.path.join(out, "events.chrome.json"))
-            # Completion sentinel — written LAST, carrying the run identity,
-            # so grid resume can (a) trust every other artifact exists and
-            # (b) refuse to skip when the spec behind this dir changed.
-            with open(os.path.join(out, "complete.json"), "w") as f:
+            # Completion sentinel — written LAST and atomically (a truncated
+            # sentinel after a mid-write kill must read as "incomplete", not
+            # crash the resumed sweep), carrying the run identity so grid
+            # resume can (a) trust every other artifact exists and (b)
+            # refuse to skip when the spec behind this dir changed.
+            marker = sentinel_path(self.data_dir, self.label)
+            tmp = marker + ".tmp"
+            with open(tmp, "w") as f:
                 json.dump(self.run_identity(), f)
+            os.replace(tmp, marker)
         self.logger.info(
             "finished %s: avg_runtime=%.1f egress=$%.2f wall=%.2fs",
             self.label,

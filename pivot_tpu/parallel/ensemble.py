@@ -375,25 +375,18 @@ def sharded_rollout(
 # -- checkpoint / resume -----------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, static_argnames=("n_replicas", "tick", "perturb")
-)
+@functools.partial(jax.jit, static_argnames=("tick",))
 def _segment_step(
-    key,
     state: RolloutState,
-    avail0,
+    rt,  # [R, T] perturbed runtimes (constant for the run — computed once)
+    arr,  # [R, T] perturbed arrivals
+    root_anchor,  # [R, T] i32
     workload: EnsembleWorkload,
     topo: DeviceTopology,
-    storage_zones,
-    n_replicas: int,
     tick: float,
     segment_ticks,  # traced i32 scalar — the final partial segment must
-    perturb: float,  # not trigger an XLA recompile of the whole rollout
-) -> RolloutState:
+) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
-    rt, arr, root_anchor = _perturbations(
-        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
-    )
     return jax.vmap(
         lambda s, r, a, ra: _rollout_segment(
             s, r, a, ra, workload, topo, tick, segment_ticks
@@ -454,7 +447,6 @@ def rollout_checkpointed(
     """
     import os
 
-    T, H = workload.n_tasks, avail0.shape[0]
     fp = _fingerprint(
         key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
         storage_zones,
@@ -474,21 +466,28 @@ def rollout_checkpointed(
                 )
                 ticks_done = int(ckpt["ticks_done"])
     if state is None:
-        state = jax.vmap(lambda _: _init_state(avail0, T))(jnp.arange(n_replicas))
+        state = jax.vmap(lambda _: _init_state(avail0, workload.n_tasks))(
+            jnp.arange(n_replicas)
+        )
+
+    # Monte-Carlo draws are a pure function of ``key`` and constant for the
+    # whole run: generated once here (and regenerated once on resume), not
+    # per segment.
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
 
     while ticks_done < max_ticks and bool(jnp.any(state.stage != _DONE)):
         seg = min(segment_ticks, max_ticks - ticks_done)
         state = _segment_step(
-            key,
             state,
-            avail0,
+            rt,
+            arr,
+            root_anchor,
             workload,
             topo,
-            storage_zones,
-            n_replicas=n_replicas,
             tick=tick,
             segment_ticks=jnp.asarray(seg, jnp.int32),
-            perturb=perturb,
         )
         jax.block_until_ready(state)
         ticks_done += seg

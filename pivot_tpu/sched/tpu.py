@@ -13,10 +13,34 @@ the same task pre-ordering as the numpy policies, so on CPU (x64) the
 placements are bit-identical; on TPU (f32) near-boundary fits may round
 differently, which the acceptance criterion tolerates (BASELINE.md —
 identical makespan/cost rankings).
+
+Adaptive dispatch (``adaptive=True``): a remote accelerator has a fixed
+per-call latency floor (dispatch + execution + result fetch — ~70 ms over
+this image's tunnel, measured) that dwarfs small ticks, while the
+in-process numpy twin costs ~50 ns per task×host cell.  The wrapper keeps
+an online latency model of both (a trivial-kernel probe of the link floor
+at bind time; an EMA of observed per-cell cost for the twin — the floor is
+deliberately NOT updated from real device calls, whose duration includes
+size-dependent compute and would inflate the floor until the device path
+permanently starved) and routes each tick to whichever backend the model
+predicts faster.  The numpy twins consume the
+same RNG draws per tick as the kernels, so the stream stays aligned no
+matter which side serves a given tick.
+
+Reproducibility tradeoff: routing depends on measured latencies, so on
+the TPU backend (f32 kernels vs f64 twins) two seeded runs of the same
+command may round a near-boundary fit differently if machine load shifts
+a tick across the crossover.  RNG streams stay aligned either way, and
+metric *rankings* are unaffected (the acceptance criterion, BASELINE.md);
+when exact bitwise repeatability matters, use ``--device numpy`` /
+``naive`` or ``--no-adaptive``, all of which route deterministically.  This is SURVEY.md §7 hard part
+(d) — host↔device latency at 5-sim-second ticks — resolved by *not*
+paying the link when the tick cannot amortize it.
 """
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -32,7 +56,13 @@ from pivot_tpu.ops.kernels import (
 )
 from pivot_tpu.ops.pallas_kernels import cost_aware_pallas
 from pivot_tpu.sched import Policy, TickContext
-from pivot_tpu.sched.policies import CostAwarePolicy, _sort_decreasing
+from pivot_tpu.sched.policies import (
+    BestFitPolicy,
+    CostAwarePolicy,
+    FirstFitPolicy,
+    OpportunisticPolicy,
+    _sort_decreasing,
+)
 from pivot_tpu.sched.rand import tick_uniforms
 
 __all__ = [
@@ -54,18 +84,92 @@ def pad_bucket(n: int) -> int:
     return ((n + 8191) // 8192) * 8192
 
 
+def _probe_device_floor() -> float:
+    """Measure the fixed per-call device latency: dispatch + execution of a
+    trivial kernel + result fetch (the fetch is what actually waits on the
+    remote execution — async dispatch returns immediately)."""
+    import jax
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = np.zeros((8,), np.float32)
+    np.asarray(f(x))  # compile outside the timed reps
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 class _DevicePolicyBase(Policy):
-    """Shared bind/pad machinery for device-backed policies."""
+    """Shared bind/pad/adaptive-dispatch machinery for device policies."""
 
     dtype = jnp.float32
 
-    def __init__(self):
+    #: Seed for the numpy-twin cost model: seconds per task×host cell
+    #: (refined online from observed twin calls).
+    _CELL_COST_SEED = 5e-8
+    #: Only ticks at least this many cells update the cell-cost EMA —
+    #: below it, Python constant overhead dominates the per-cell term.
+    _CELL_COST_MIN_SAMPLE = 4096
+    #: Every Nth device-routed tick is served by the twin instead, so the
+    #: cell-cost model keeps getting samples even when it (possibly
+    #: wrongly) predicts the device is faster — without exploration an
+    #: overestimating seed would starve the twin for mid-size ticks with
+    #: no recovery path (the mirror of device-floor starvation).
+    _EXPLORE_EVERY = 16
+    #: Exploration only happens in the uncertain region — predicted twin
+    #: time within this factor of the device floor.  Far past the
+    #: crossover the verdict cannot flip for any plausible model error,
+    #: and an unconditional sample there would cost O(cells) for nothing;
+    #: this bounds each exploration sample to ~margin × floor seconds.
+    _EXPLORE_MARGIN = 8.0
+
+    def __init__(self, adaptive: bool = False):
         self.topology: Optional[DeviceTopology] = None
         self._scheduler = None
+        self.adaptive = adaptive
+        self._cpu_twin: Optional[Policy] = None  # set by subclasses
+        self._cpu_cell_cost = self._CELL_COST_SEED
+        self._device_floor = 0.0  # per-call latency floor, seconds
+        self._device_routed = 0
 
     def bind(self, scheduler) -> None:
         self._scheduler = scheduler
         self.topology = DeviceTopology.from_cluster(scheduler.cluster, self.dtype)
+        if self._cpu_twin is not None:
+            self._cpu_twin.bind(scheduler)
+        if self.adaptive:
+            self._device_floor = _probe_device_floor()
+
+    # -- adaptive dispatch ------------------------------------------------
+    def place(self, ctx: TickContext) -> np.ndarray:
+        if self.adaptive and self._cpu_twin is not None:
+            cells = ctx.n_tasks * ctx.n_hosts
+            twin_predicted = cells * self._cpu_cell_cost <= self._device_floor
+            explore = (
+                not twin_predicted
+                and cells >= self._CELL_COST_MIN_SAMPLE
+                and cells * self._cpu_cell_cost
+                <= self._EXPLORE_MARGIN * self._device_floor
+                and self._device_routed % self._EXPLORE_EVERY
+                == self._EXPLORE_EVERY - 1
+            )
+            if twin_predicted or explore:
+                t0 = time.perf_counter()
+                out = self._cpu_twin.place(ctx)
+                dt = time.perf_counter() - t0
+                if cells >= self._CELL_COST_MIN_SAMPLE:
+                    self._cpu_cell_cost = 0.5 * (self._cpu_cell_cost + dt / cells)
+                if explore:
+                    self._device_routed += 1
+                return out
+            self._device_routed += 1
+            return self._device_place(ctx)
+        return self._device_place(ctx)
+
+    def _device_place(self, ctx: TickContext) -> np.ndarray:
+        raise NotImplementedError
 
     def _padded(self, ctx: TickContext, order: Optional[List[int]] = None):
         """(avail [H,4], demands [B,4], valid [B]) device-ready, task axis
@@ -95,7 +199,11 @@ class _DevicePolicyBase(Policy):
 class TpuOpportunisticPolicy(_DevicePolicyBase):
     name = "opportunistic_tpu"
 
-    def place(self, ctx: TickContext) -> np.ndarray:
+    def __init__(self, adaptive: bool = False):
+        super().__init__(adaptive)
+        self._cpu_twin = OpportunisticPolicy(mode="numpy")
+
+    def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
         avail, dem, valid = self._padded(ctx)
         u = np.zeros(valid.shape[0], dtype=np.float64)
@@ -109,11 +217,12 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
 class TpuFirstFitPolicy(_DevicePolicyBase):
     name = "first_fit_tpu"
 
-    def __init__(self, decreasing: bool = False):
-        super().__init__()
+    def __init__(self, decreasing: bool = False, adaptive: bool = False):
+        super().__init__(adaptive)
         self.decreasing = decreasing
+        self._cpu_twin = FirstFitPolicy(decreasing=decreasing, mode="numpy")
 
-    def place(self, ctx: TickContext) -> np.ndarray:
+    def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
         order = None
         if self.decreasing:
@@ -126,11 +235,12 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
 class TpuBestFitPolicy(_DevicePolicyBase):
     name = "best_fit_tpu"
 
-    def __init__(self, decreasing: bool = False):
-        super().__init__()
+    def __init__(self, decreasing: bool = False, adaptive: bool = False):
+        super().__init__(adaptive)
         self.decreasing = decreasing
+        self._cpu_twin = BestFitPolicy(decreasing=decreasing, mode="numpy")
 
-    def place(self, ctx: TickContext) -> np.ndarray:
+    def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
         order = None
         if self.decreasing:
@@ -157,8 +267,9 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         sort_hosts: bool = False,
         host_decay: bool = False,
         use_pallas: Optional[bool] = None,
+        adaptive: bool = False,
     ):
-        super().__init__()
+        super().__init__(adaptive)
         assert bin_pack in ("first-fit", "best-fit")
         self.bin_pack = bin_pack
         self.sort_tasks = sort_tasks
@@ -168,15 +279,18 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         # scan kernel per tick on a v5e) but is f32-only; auto-enable on
         # the TPU backend, keep the scan kernel for CPU/f64 parity runs.
         self.use_pallas = use_pallas
-        # Grouping logic shared verbatim with the CPU policy.
+        # Grouping logic shared verbatim with the CPU policy; the same
+        # object doubles as the adaptive numpy twin (its place() draws the
+        # identical RNG sequence — one randomizer.choice per root group).
         self._grouper = CostAwarePolicy(
             bin_pack=bin_pack,
             sort_tasks=sort_tasks,
             sort_hosts=sort_hosts,
             host_decay=host_decay,
         )
+        self._cpu_twin = self._grouper
 
-    def place(self, ctx: TickContext) -> np.ndarray:
+    def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
         meta = ctx.meta
         storage = ctx.cluster.storage

@@ -49,6 +49,10 @@ class PolicyConfig:
     sort_hosts: bool = False
     realtime_bw: bool = False
     host_decay: bool = False
+    #: tpu backend only: route each tick to the device or the in-process
+    #: numpy twin, whichever an online latency model predicts faster
+    #: (small ticks cannot amortize the fixed per-call device latency).
+    adaptive: bool = True
     label: Optional[str] = None
 
     @property
@@ -91,16 +95,25 @@ def build_cluster(cfg: ClusterConfig, meta=None):
 
 #: The reference's three experiment arms with their exact hyperparameters
 #: (``alibaba/sim.py:179-186``), on a chosen device backend.
-def reference_policy_set(device: str = "numpy") -> Tuple[PolicyConfig, ...]:
+def reference_policy_set(
+    device: str = "numpy", adaptive: bool = True
+) -> Tuple[PolicyConfig, ...]:
     return (
-        PolicyConfig(name="opportunistic", device=device, label="Opportunistic"),
-        PolicyConfig(name="first-fit", device=device, decreasing=True, label="VBP"),
+        PolicyConfig(
+            name="opportunistic", device=device, adaptive=adaptive,
+            label="Opportunistic",
+        ),
+        PolicyConfig(
+            name="first-fit", device=device, decreasing=True, adaptive=adaptive,
+            label="VBP",
+        ),
         PolicyConfig(
             name="cost-aware",
             device=device,
             bin_pack="first-fit",
             sort_tasks=True,
             sort_hosts=True,
+            adaptive=adaptive,
             label="Cost-Aware",
         ),
     )
@@ -112,11 +125,15 @@ def make_policy(cfg: PolicyConfig):
         from pivot_tpu.sched import tpu as dev
 
         if cfg.name == "opportunistic":
-            return dev.TpuOpportunisticPolicy()
+            return dev.TpuOpportunisticPolicy(adaptive=cfg.adaptive)
         if cfg.name == "first-fit":
-            return dev.TpuFirstFitPolicy(decreasing=cfg.decreasing)
+            return dev.TpuFirstFitPolicy(
+                decreasing=cfg.decreasing, adaptive=cfg.adaptive
+            )
         if cfg.name == "best-fit":
-            return dev.TpuBestFitPolicy(decreasing=cfg.decreasing)
+            return dev.TpuBestFitPolicy(
+                decreasing=cfg.decreasing, adaptive=cfg.adaptive
+            )
         if cfg.name == "cost-aware":
             if cfg.realtime_bw:
                 raise ValueError(
@@ -127,6 +144,7 @@ def make_policy(cfg: PolicyConfig):
                 sort_tasks=cfg.sort_tasks,
                 sort_hosts=cfg.sort_hosts,
                 host_decay=cfg.host_decay,
+                adaptive=cfg.adaptive,
             )
         raise ValueError(f"unknown policy {cfg.name!r}")
 

@@ -72,31 +72,44 @@ def test_checkpointed_matches_plain(setup, tmp_path):
     assert os.path.exists(ckpt)
 
 
-def test_resume_after_interrupt(setup, tmp_path):
-    """Killing the run mid-way and re-invoking yields identical results."""
+def test_resume_after_interrupt(setup, tmp_path, monkeypatch):
+    """The headline guarantee: a run killed mid-flight, resumed from its
+    partial checkpoint with identical arguments, produces results
+    bit-identical to an uninterrupted run."""
+    import pivot_tpu.parallel.ensemble as ens
+
     avail0, workload, topo, storage_zones = setup
     key = jax.random.PRNGKey(4)
     plain = rollout(key, avail0, workload, topo, storage_zones, **CFG)
     ckpt = str(tmp_path / "roll.npz")
 
-    # "Interrupted" run: only the first two segments execute.
-    cfg_short = dict(CFG, max_ticks=10)
-    rollout_checkpointed(
-        key, avail0, workload, topo, storage_zones, ckpt,
-        segment_ticks=5, **cfg_short,
-    )
-    with np.load(ckpt) as f:
-        assert int(f["ticks_done"]) == 10
+    # Interrupted run: the process "dies" during the second segment, after
+    # the first segment's state hit disk.
+    orig = ens._segment_step
+    calls = []
 
-    # Resume with the full horizon — same fingerprint inputs except
-    # max_ticks is not part of segment state, so use the full config and a
-    # fresh fingerprint: simulate by re-running the full config from the
-    # partial state written under the same config.
-    full = rollout_checkpointed(
-        key, avail0, workload, topo, storage_zones, str(tmp_path / "full.npz"),
+    def dying(*args, **kw):
+        if len(calls) >= 1:
+            raise KeyboardInterrupt("killed mid-run")
+        calls.append(1)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(ens, "_segment_step", dying)
+    with pytest.raises(KeyboardInterrupt):
+        rollout_checkpointed(
+            key, avail0, workload, topo, storage_zones, ckpt,
+            segment_ticks=5, **CFG,
+        )
+    with np.load(ckpt) as f:
+        assert 0 < int(f["ticks_done"]) < CFG["max_ticks"]  # genuinely partial
+
+    # Resume with the same arguments from the partial state.
+    monkeypatch.setattr(ens, "_segment_step", orig)
+    res = rollout_checkpointed(
+        key, avail0, workload, topo, storage_zones, ckpt,
         segment_ticks=5, **CFG,
     )
-    _assert_same(plain, full)
+    _assert_same(plain, res)
 
 
 def test_resume_continues_not_restarts(setup, tmp_path, monkeypatch):
@@ -184,6 +197,32 @@ def test_cli_grid_resume(tmp_path):
     cli.run_overall(args3)
     changed = {m: os.path.getmtime(m) for m in stamps}
     assert changed != stamps
+
+    # A changed cluster shape (same subcommand args) must also re-run.
+    args4 = cli.parse_args(
+        ["--cpus", "32"] + argv + ["--resume", exp_dir, "overall", "--num-apps", "2"]
+    )
+    before_shape = {m: os.path.getmtime(m) for m in stamps}
+    cli.run_overall(args4)
+    assert {m: os.path.getmtime(m) for m in stamps} != before_shape
+
+    # A truncated/corrupt sentinel (kill during write) counts as incomplete:
+    # the sweep re-runs that run instead of crashing.
+    sentinel0 = next(
+        os.path.join(r, f)
+        for r, _d, fs in os.walk(exp_dir)
+        for f in fs
+        if f == "complete.json"
+    )
+    with open(sentinel0, "w") as f:
+        f.write('{"label": "Oppor')  # truncated JSON
+    cli.run_overall(cli.parse_args(
+        ["--cpus", "32"] + argv + ["--resume", exp_dir, "overall", "--num-apps", "2"]
+    ))
+    import json as _json
+
+    with open(sentinel0) as f:
+        _json.load(f)  # rewritten, parseable again
 
     # A run killed before its completion sentinel must also re-run.
     sentinel = next(

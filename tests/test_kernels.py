@@ -210,3 +210,63 @@ def test_full_sim_parity_opportunistic(meta):
         return (s["avg_runtime"], s["egress_cost"], s["cum_instance_hours"])
 
     assert run(OpportunisticPolicy("numpy")) == run(as_f64(TpuOpportunisticPolicy()))
+
+
+# -- adaptive dispatch -------------------------------------------------------
+
+
+def test_adaptive_small_tick_routes_to_numpy_twin(meta):
+    """With a high measured device floor, a small tick must be served by the
+    in-process twin — and match the plain numpy policy exactly."""
+    ctx_a = make_ctx(meta, SHAPES * 4, random_groups(1)(), seed=1)
+    ctx_b = make_ctx(meta, SHAPES * 4, random_groups(1)(), seed=1)
+    pol = TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True, adaptive=True)
+    pol.bind(ctx_a.scheduler)
+    pol._device_floor = 10.0  # pretend the link costs 10 s per call
+    pol._device_place = None  # any device call would crash
+    expect = CostAwarePolicy(sort_tasks=True, sort_hosts=True, mode="numpy")
+    assert pol.place(ctx_a).tolist() == expect.place(ctx_b).tolist()
+
+
+def test_adaptive_large_tick_routes_to_device(meta):
+    """With a zero device floor every tick goes to the device path."""
+    ctx_a = make_ctx(meta, SHAPES * 4, random_groups(2)(), seed=2)
+    ctx_b = make_ctx(meta, SHAPES * 4, random_groups(2)(), seed=2)
+    pol = as_f64(TpuFirstFitPolicy(decreasing=True, adaptive=True))
+    pol.bind(ctx_a.scheduler)
+    pol._device_floor = 0.0
+    pol._cpu_twin.place = None  # any twin call would crash
+    ref = as_f64(TpuFirstFitPolicy(decreasing=True))
+    ref.bind(ctx_b.scheduler)
+    assert pol.place(ctx_a).tolist() == ref.place(ctx_b).tolist()
+
+
+def test_adaptive_probe_measures_positive_floor(meta):
+    ctx = make_ctx(meta, SHAPES, random_groups(0)(), seed=0)
+    pol = TpuOpportunisticPolicy(adaptive=True)
+    pol.bind(ctx.scheduler)
+    assert 0 < pol._device_floor < 5.0
+
+
+def test_adaptive_full_sim_matches_numpy(meta):
+    """End-to-end f64 run with adaptive routing — whichever side serves a
+    tick, metrics must equal the pure numpy run (RNG streams aligned)."""
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(20)
+    trace = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def run(policy):
+        s = ExperimentRun("parity", cluster, policy, trace, n_apps=15, seed=6).run()
+        return (s["avg_runtime"], s["egress_cost"], s["cum_instance_hours"])
+
+    m_np = run(CostAwarePolicy(sort_tasks=True, sort_hosts=True, mode="numpy"))
+    m_ad = run(
+        as_f64(TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True, adaptive=True))
+    )
+    assert m_np == m_ad
