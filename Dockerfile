@@ -1,0 +1,21 @@
+# Packaging parity with the reference's Dockerfile (reference Dockerfile:1-16,
+# alpine + pip + sim.py entrypoint), updated for this framework's stack.
+# CPU-only by default: jax[cpu] runs every policy backend bit-identically in
+# f64; on TPU hosts install the matching jax[tpu] wheel instead.
+FROM python:3.12-slim
+
+WORKDIR /opt/pivot_tpu
+COPY pyproject.toml README.md ./
+COPY pivot_tpu ./pivot_tpu
+COPY data ./data
+COPY bench.py ./
+
+RUN pip install --no-cache-dir "jax[cpu]" numpy pyyaml matplotlib && \
+    pip install --no-cache-dir -e .
+
+ENV JOB_DIR=/opt/pivot_tpu/data/jobs \
+    OUTPUT_DIR=/output
+
+ENTRYPOINT ["python", "-m", "pivot_tpu.experiments.cli"]
+# Reference-canonical invocation (reference README.md:22-27):
+#   docker run <image> --num-hosts 100 overall --num-apps 100

@@ -27,6 +27,8 @@ __all__ = [
     "plot_overall",
     "plot_transfers",
     "plot_financial_cost",
+    "plot_host_usage",
+    "plot_resource_usage",
     "POLICY_ORDER",
 ]
 
@@ -176,6 +178,55 @@ def plot_financial_cost(exp_dir: str, host_hourly_rate: float = 0.932) -> str:
     plt.legend(ncol=2, frameon=False, fontsize=10)
     plt.tight_layout()
     out = os.path.join(plot_dir, "cost.pdf")
+    plt.savefig(out, format="pdf")
+    plt.close()
+    return out
+
+
+def plot_host_usage(run_dir: str, out: str = None) -> str:
+    """Busy-host count over time for one run — renders the curve the meter
+    serializes as ``host_usage.json`` (ref ``resources/meter.py:135-148``).
+
+    ``run_dir`` is a ``data/<iter>/<label>`` directory.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    with open(os.path.join(run_dir, "host_usage.json")) as f:
+        usage = json.load(f)
+    xs = [end for _start, end in usage["timestamps"]]
+    plt.figure(figsize=(8, 4))
+    plt.step(xs, usage["n_hosts"], where="pre")
+    plt.xlabel("Simulation time (s)", fontsize=13)
+    plt.ylabel("# of busy hosts", fontsize=13)
+    plt.tight_layout()
+    out = out or os.path.join(run_dir, "host_usage.pdf")
+    plt.savefig(out, format="pdf")
+    plt.close()
+    return out
+
+
+def plot_resource_usage(meter, resources=("cpus", "mem"), out: str = "resource_usage.pdf") -> str:
+    """Mean normalized per-dimension host utilization over time, from a live
+    :class:`~pivot_tpu.infra.meter.Meter` (ref ``resources/meter.py:150-159``
+    — the reference likewise plots this from the in-memory meter; it is not
+    part of the serialized four-file layout)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    plt.figure(figsize=(8, 4))
+    for res in resources:
+        xs, ys = meter.resource_usage_curve(res)
+        plt.plot(xs, ys, label=res)
+    plt.xlabel("Simulation time (s)", fontsize=13)
+    plt.ylabel("Mean normalized utilization", fontsize=13)
+    plt.ylim(0, 1)
+    plt.legend(frameon=False)
+    plt.tight_layout()
     plt.savefig(out, format="pdf")
     plt.close()
     return out

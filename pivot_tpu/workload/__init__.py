@@ -29,7 +29,7 @@ import numpy as np
 
 from pivot_tpu.utils import LogMixin, fresh_id
 
-__all__ = ["TaskState", "Task", "TaskGroup", "Application", "DagError"]
+__all__ = ["TaskState", "Task", "TaskGroup", "Application", "DagError", "Dataflow"]
 
 
 class DagError(ValueError):
@@ -365,3 +365,35 @@ class Application(LogMixin):
 
 # Reference-familiar alias.
 Container = TaskGroup
+
+
+class Dataflow:
+    """A (source group, destination group, data size) edge record.
+
+    API-parity shim for the reference's ``Dataflow``
+    (``application/__init__.py:329-352``), which is dead code there — never
+    instantiated; edge weight is carried by ``Container.output_size``
+    instead.  Kept here (equally unused by the framework) so code written
+    against the reference's full surface imports cleanly; prefer
+    ``TaskGroup.output_size``.
+    """
+
+    __slots__ = ("src", "dst", "data_size")
+
+    def __init__(self, src: str, dst: str, data_size: float = 0.0):
+        self.src = src
+        self.dst = dst
+        self.data_size = data_size
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Dataflow)
+            and (self.src, self.dst, self.data_size)
+            == (other.src, other.dst, other.data_size)
+        )
+
+    def __hash__(self):
+        return hash((self.src, self.dst, self.data_size))
+
+    def __repr__(self):
+        return f"Dataflow({self.src} -> {self.dst}, {self.data_size} MB)"

@@ -224,18 +224,29 @@ def test_cli_grid_resume(tmp_path):
     with open(sentinel0) as f:
         _json.load(f)  # rewritten, parseable again
 
-    # A run killed before its completion sentinel must also re-run.
-    sentinel = next(
+    # A run killed before its completion sentinel must also re-run — and
+    # ONLY that run.  The invocation matches the sentinels' recorded config
+    # (--cpus 32 from the sections above) so the identity check cannot mask
+    # a regression in the missing-sentinel path.
+    sentinels = sorted(
         os.path.join(r, f)
         for r, _d, fs in os.walk(exp_dir)
         for f in fs
         if f == "complete.json"
     )
-    os.remove(sentinel)
-    run_dir = os.path.dirname(sentinel)
-    before = os.path.getmtime(os.path.join(run_dir, "general.json"))
+    assert len(sentinels) == 3
+    removed, intact = sentinels[0], sentinels[1:]
+    os.remove(removed)
+    mtimes = {
+        s: os.path.getmtime(os.path.join(os.path.dirname(s), "general.json"))
+        for s in sentinels
+    }
     cli.run_overall(cli.parse_args(
-        argv + ["--resume", exp_dir, "overall", "--num-apps", "2"]
+        ["--cpus", "32"] + argv + ["--resume", exp_dir, "overall", "--num-apps", "2"]
     ))
-    assert os.path.exists(sentinel)
-    assert os.path.getmtime(os.path.join(run_dir, "general.json")) >= before
+    assert os.path.exists(removed)  # re-ran, sentinel recreated
+    removed_general = os.path.join(os.path.dirname(removed), "general.json")
+    assert os.path.getmtime(removed_general) > mtimes[removed]
+    for s in intact:  # sentinel present + matching identity → skipped
+        g = os.path.join(os.path.dirname(s), "general.json")
+        assert os.path.getmtime(g) == mtimes[s]

@@ -134,3 +134,55 @@ def test_cli_num_apps_end_to_end(tmp_path):
     assert (exp_dir / "plot" / "cost.pdf").exists()
     assert (exp_dir / "data" / "5").is_dir()
     assert (exp_dir / "data" / "10").is_dir()
+
+
+def test_plot_host_and_resource_usage(tmp_path):
+    """The usage-curve renderers (ref meter.py:135-159) produce files from a
+    real run's meter and serialized host_usage.json."""
+    from pivot_tpu.des import Environment
+    from pivot_tpu.experiments.plots import plot_host_usage, plot_resource_usage
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.sched.policies import FirstFitPolicy
+
+    meta = ResourceMetadata(seed=0)
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(10)
+    run = ExperimentRun(
+        "usage", cluster, FirstFitPolicy(decreasing=True),
+        "data/jobs/jobs-5000-200-86400-172800.npz",
+        n_apps=8, seed=0, data_dir=str(tmp_path),
+    )
+    run.run()
+    out1 = plot_host_usage(str(tmp_path / "usage"))
+    assert os.path.exists(out1) and os.path.getsize(out1) > 0
+    # resource curves render from the live meter, as in the reference
+    env = Environment()
+    from pivot_tpu.infra.meter import Meter
+
+    meter = Meter(env, meta)
+    c2 = cluster.clone(env, meter)
+    from pivot_tpu.sched import GlobalScheduler
+    from pivot_tpu.workload import Application, TaskGroup
+
+    sched = GlobalScheduler(env, c2, FirstFitPolicy(), seed=0, meter=meter)
+    c2.start(); sched.start()
+    sched.submit(Application("a", [TaskGroup("g", cpus=1, mem=512, runtime=20, instances=4)]))
+    sched.stop(); env.run()
+    out2 = plot_resource_usage(meter, out=str(tmp_path / "res.pdf"))
+    assert os.path.exists(out2) and os.path.getsize(out2) > 0
+
+
+def test_dataflow_record():
+    """API-parity shim for the reference's (dead) Dataflow class."""
+    from pivot_tpu.workload import Dataflow
+
+    d = Dataflow("a", "b", 128.0)
+    assert d == Dataflow("a", "b", 128.0)
+    assert hash(d) == hash(Dataflow("a", "b", 128.0))
+    assert d != Dataflow("a", "b", 64.0)
+    assert "a -> b" in repr(d)
