@@ -293,6 +293,35 @@ class Environment:
             _arm(e)
         return barrier
 
+    def any_of(self, events: Iterable[Event]) -> Event:
+        """Race: succeeds when the FIRST of ``events`` fires, with that
+        event as its value.  Later finishers are ignored (their own
+        callbacks still run).  The primitive behind abortable waits —
+        e.g. compute racing a host-failure abort
+        (``pivot_tpu.infra.faults``)."""
+        events = list(events)
+        race = Event(self)
+        if not events:
+            raise SimError("any_of of no events")
+
+        def _settle(fired: Event) -> None:
+            if race.triggered:
+                return
+            if fired._ok:
+                race.succeed(fired)
+            else:  # propagate the loser-less failure, don't swallow it
+                race.fail(fired._value)
+
+        def _arm(evt: Event) -> None:
+            if evt.callbacks is None:  # already processed
+                _settle(evt)
+            else:
+                evt.callbacks.append(_settle)
+
+        for e in events:
+            _arm(e)
+        return race
+
     # -- execution -------------------------------------------------------
     def step(self) -> None:
         t, _prio, _seq, event = heapq.heappop(self._heap)

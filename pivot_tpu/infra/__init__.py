@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from pivot_tpu.des import Environment, Store
+from pivot_tpu.des import Environment, Event, Store
 from pivot_tpu.infra.locality import Locality, ResourceMetadata
 from pivot_tpu.infra.meter import Meter
 from pivot_tpu.infra.network import NativeRoute, Route
@@ -126,6 +126,12 @@ class HostResource:
         self.gpus -= gpus
         return True
 
+    def reset(self) -> None:
+        """Restore full capacity (fresh machine after fault recovery)."""
+        self.cpus, self.mem, self.disk, self.gpus = (
+            self.t_cpus, self.t_mem, self.t_disk, self.t_gpus,
+        )
+
     def release(self, cpus: float, mem: float, disk: float, gpus: float) -> None:
         """Refund, clamped per-dimension to what is actually in use (ref
         ``unsubscribe``, ``:451-461`` — but clamped with ``min`` rather than
@@ -167,6 +173,11 @@ class Host(Node):
         self.resource = HostResource(cpus, mem, disk, gpus)
         self.meter = meter
         self._tasks: set = set()
+        #: Liveness flag — flipped by fault injection (``infra.faults``).
+        #: A down host admits nothing and reports zero availability.
+        self.up = True
+        # task -> abort Event raced against its compute/staging waits.
+        self._aborts: Dict[Task, Event] = {}
 
     @property
     def tasks(self) -> List[Task]:
@@ -193,36 +204,100 @@ class Host(Node):
         env, meter, cluster = self.env, self.meter, self.cluster
         group = task.group
         resource = self.resource
+        if not self.up:
+            return False
         if not resource.try_acquire(group.cpus, group.mem, group.disk, group.gpus):
             return False
 
         self._tasks.add(task)
+        abort = self._aborts[task] = env.event()
         if meter:
             meter.host_check_in(self)
         task.set_running()
 
-        # Stage input data from predecessor task outputs.
+        # Stage input data from predecessor task outputs.  Both the staging
+        # barrier and the compute timeout race the abort event so a host
+        # failure fails the task *now*, not at its original finish time.
         pull_start = env.now
         preds = self._sample_predecessor_inputs(task)
         if preds:
             done_events = []
             routes = []
             for p in preds:
-                route = cluster.get_route(p.placement, self.id)
+                route = cluster.get_route(
+                    self._output_source(p, cluster), self.id
+                )
                 routes.append(route)
                 done_events.append(route.send(p.output_size))
-            yield env.all_of(done_events)
+            fired = yield env.any_of([env.all_of(done_events), abort])
+            if fired is abort:
+                # Cancel orphaned pulls so they stop round-robin-stealing
+                # bandwidth from live transfers on shared routes.
+                for route, evt in zip(routes, done_events):
+                    route.cancel(evt)
+                return self._conclude_aborted(task)
             if meter:
                 self._record_transfer(task, preds, routes, pull_start)
 
         # Timed compute.
-        yield env.timeout(task.runtime)
+        fired = yield env.any_of([env.timeout(task.runtime), abort])
+        if fired is abort:
+            return self._conclude_aborted(task)
 
         resource.release(group.cpus, group.mem, group.disk, group.gpus)
         self._tasks.discard(task)
+        self._aborts.pop(task, None)
         if meter:
             meter.host_check_out(self)
         return True
+
+    @staticmethod
+    def _output_source(pred: Task, cluster: "Cluster") -> str:
+        """Node serving ``pred``'s output: its host, or — if that host has
+        crashed — the producing zone's storage node.
+
+        Task outputs are durably staged to zone-local storage (the
+        reference's intended storage-mediated pull path,
+        ``resources/__init__.py:137-149`` — dead code there), so a finished
+        predecessor's data survives its host.  Zone bw/cost matrices make
+        the transfer parameters identical either way; only the metering
+        source differs."""
+        src = cluster.get_host(pred.placement)
+        if src is not None and not src.up:
+            store = cluster.get_storage_by_locality(src.locality)
+            if store is not None:
+                return store.id
+        return pred.placement
+
+    def _conclude_aborted(self, task: Task) -> bool:
+        """Host died under this task: no resource refund (the machine is
+        gone; ``recover`` resets capacity wholesale), but the meter interval
+        closes so instance-hours stay correct."""
+        self._tasks.discard(task)
+        self._aborts.pop(task, None)
+        if self.meter:
+            self.meter.host_check_out(self)
+        return False
+
+    def fail(self) -> None:
+        """Take the host down, aborting every resident task (they surface as
+        ``(False, task)`` on ``notify_q`` — the scheduler's existing retry
+        path reschedules them elsewhere)."""
+        if not self.up:
+            return
+        self.up = False
+        for abort in list(self._aborts.values()):
+            if not abort.triggered:
+                abort.succeed()
+
+    def recover(self) -> None:
+        """Bring the host back as a fresh machine: full capacity, no tasks."""
+        if self.up:
+            return
+        self.up = True
+        self.resource.reset()
+        self._tasks.clear()
+        self._aborts.clear()
 
     def _sample_predecessor_inputs(self, task: Task) -> List[Task]:
         """Predecessor tasks to pull from, sampled per instance count.
@@ -439,10 +514,19 @@ class Cluster(LogMixin):
 
     # -- dense exports for the decision kernels --------------------------
     def availability_matrix(self, dtype=np.float64) -> np.ndarray:
-        """[H, 4] current per-host availability snapshot."""
+        """[H, 4] current per-host availability snapshot.
+
+        Down hosts report −1 per dimension: every demand is ≥ 0, so no fit
+        test (strict or non-strict) can select them — including zero-demand
+        tasks, which a zero row would admit and livelock on a dead host.
+        The sentinel is finite so downstream residual/norm arithmetic in
+        the f32 kernels stays finite."""
         hosts = self._host_list
         out = np.empty((len(hosts), 4), dtype=dtype)
         for i, h in enumerate(hosts):
+            if not h.up:
+                out[i] = -1.0
+                continue
             r = h.resource
             out[i, 0] = r.cpus
             out[i, 1] = r.mem

@@ -1,0 +1,181 @@
+"""Fault injection: host crash/recovery and bandwidth fluctuation.
+
+The reference has **no fault model** (SURVEY.md §5): its only "failure" is
+admission rejection, its ``NetworkRoute._fluctuate`` is an empty stub
+(``resources/network.py:102-103``), and no host or link ever goes down.
+It does, however, ship a complete failure-handling path — failed tasks are
+reset to NASCENT and resubmitted forever (``scheduler/__init__.py:136-139``).
+This module supplies the missing fault *sources* so that path (mirrored by
+``GlobalScheduler._listen_loop``) is exercised as elastic recovery:
+
+  * **Host crash** — ``Host.fail()`` aborts every resident task mid-flight
+    (staging or compute) via abort events raced inside ``Host.execute``;
+    each surfaces as ``(False, task)`` on ``notify_q`` and is rescheduled
+    elsewhere by the existing retry loop.  Down hosts report zero
+    availability, so no fit mask can select them.  ``Host.recover()``
+    returns a fresh machine.
+  * **Bandwidth fluctuation** — periodic multiplicative resampling of live
+    route bandwidth (the reference's intended-but-unimplemented
+    ``_fluctuate``), applied between chunks so in-flight transfers see the
+    new rate from their next chunk on.
+
+All draws come from a dedicated seeded RNG, so fault schedules are
+deterministic and independent of workload/cluster RNG streams.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from pivot_tpu.des import Environment
+from pivot_tpu.utils import LogMixin
+from pivot_tpu.utils.trace import NULL_TRACER, Tracer
+
+__all__ = ["FaultInjector"]
+
+
+class FaultInjector(LogMixin):
+    """Schedules host crashes, recoveries, and bandwidth fluctuation on a
+    cluster's event kernel.
+
+    Create it after the cluster, before ``env.run()``; faults fire at their
+    scheduled sim times.  ``tracer`` (optional) records structured
+    ``host.failed`` / ``host.recovered`` events.
+    """
+
+    def __init__(
+        self,
+        cluster,
+        seed: Optional[int] = None,
+        tracer: Optional[Tracer] = None,
+    ):
+        self.cluster = cluster
+        self.env: Environment = cluster.env
+        self.rng = np.random.default_rng(seed)
+        self.tracer = tracer or NULL_TRACER
+        #: (sim_time, host_id, event) log of injected faults.
+        self.log: List[Tuple[float, str, str]] = []
+        # host_id -> sim time until which the host must stay down.
+        # Overlapping outages extend to the union (max end), never truncate.
+        self._down_until: dict = {}
+
+    # -- host faults -----------------------------------------------------
+    def fail_host(self, host_id: str, at: float, duration: Optional[float] = None):
+        """Crash ``host_id`` at sim time ``at``; recover it ``duration``
+        seconds later (never, if ``duration`` is None)."""
+        host = self.cluster.get_host(host_id)
+        if host is None:
+            raise KeyError(f"unknown host {host_id!r}")
+
+        recover_at = at + duration if duration is not None else float("inf")
+
+        def _fail():
+            self._down_until[host.id] = max(
+                self._down_until.get(host.id, 0.0), recover_at
+            )
+            if not host.up:  # already down: outage extended, no new event
+                return
+            n_resident = host.n_tasks
+            host.fail()
+            self.log.append((self.env.now, host.id, "failed"))
+            self.tracer.emit(
+                "host", "failed", self.env.now, id=host.id, n_aborted=n_resident
+            )
+            self.logger.debug(
+                "[%.3f] host %s failed (%d tasks aborted)",
+                self.env.now, host.id, n_resident,
+            )
+
+        def _recover():
+            # Only the recovery matching the *latest* outage end fires —
+            # overlapping outages union (a shorter second outage must not
+            # resurrect the host mid-way through a longer first one).
+            if self.env.now < self._down_until.get(host.id, 0.0):
+                return
+            if host.up:
+                return
+            host.recover()
+            self.log.append((self.env.now, host.id, "recovered"))
+            self.tracer.emit("host", "recovered", self.env.now, id=host.id)
+
+        self.env.schedule_callback_at(at, _fail)
+        if duration is not None:
+            self.env.schedule_callback_at(recover_at, _recover)
+
+    def random_host_failures(
+        self,
+        n_failures: int,
+        horizon: float,
+        mttr: Optional[float] = None,
+        start: float = 0.0,
+    ) -> List[Tuple[float, str]]:
+        """Schedule ``n_failures`` crashes at uniform times in
+        ``[start, horizon)`` on uniformly drawn hosts; each recovers after
+        an Exp(mean=``mttr``) outage (never, if ``mttr`` is None).
+        Returns the (time, host_id) schedule for assertions/reporting."""
+        hosts = self.cluster.hosts
+        times = np.sort(self.rng.uniform(start, horizon, size=n_failures))
+        picks = self.rng.integers(0, len(hosts), size=n_failures)
+        schedule = []
+        for t, hi in zip(times, picks):
+            duration = (
+                float(self.rng.exponential(mttr)) if mttr is not None else None
+            )
+            self.fail_host(hosts[int(hi)].id, float(t), duration)
+            schedule.append((float(t), hosts[int(hi)].id))
+        return schedule
+
+    # -- network faults --------------------------------------------------
+    def fluctuate_bandwidth(
+        self,
+        period: float,
+        amplitude: float = 0.05,
+        until: Optional[float] = None,
+    ) -> None:
+        """Every ``period`` sim-seconds, resample every *materialized*
+        route's bandwidth as ``base × U(1−amplitude, 1+amplitude)``
+        (the reference's empty ``_fluctuate`` stub, made real).
+
+        Python network backend only: native routes pin their rate in the
+        C++ engine at creation.
+        """
+        if self.cluster.network_backend != "python":
+            raise ValueError(
+                "bandwidth fluctuation requires network_backend='python' "
+                "(native routes pin their rate in the C++ engine)"
+            )
+        if period <= 0:
+            raise ValueError(f"period must be > 0, got {period}")
+        if not 0.0 <= amplitude < 1.0:
+            raise ValueError(
+                f"amplitude must be in [0, 1), got {amplitude} "
+                "(>= 1 could resample a route to non-positive bandwidth)"
+            )
+        base: dict = {}
+
+        def _tick():
+            # The window is half-open [start, until): a tick landing ON the
+            # horizon must not resample (it could race the restore below).
+            if until is not None and self.env.now >= until:
+                return
+            for key, route in self.cluster._routes.items():
+                b = base.setdefault(key, route.bw)
+                route.bw = b * float(
+                    self.rng.uniform(1.0 - amplitude, 1.0 + amplitude)
+                )
+            if until is None or self.env.now + period <= until:
+                self.env.schedule_callback(period, _tick)
+
+        def _restore():
+            # Bound the perturbation to the configured window: without the
+            # restore, the final random draw would persist as a permanent
+            # bias for the rest of the simulation.
+            for key, b in base.items():
+                self.cluster._routes[key].bw = b
+
+        if until is None or period <= until:
+            self.env.schedule_callback(period, _tick)
+            if until is not None:
+                self.env.schedule_callback_at(until, _restore)

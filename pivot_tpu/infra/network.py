@@ -34,7 +34,7 @@ CHUNK_MB = 1000.0
 class Transfer:
     """An in-flight data transfer on one route."""
 
-    __slots__ = ("id", "remaining_mb", "done")
+    __slots__ = ("id", "remaining_mb", "done", "cancelled")
 
     def __init__(self, size_mb: float, done: Event):
         if size_mb <= 0:
@@ -42,12 +42,13 @@ class Transfer:
         self.id = fresh_id("xfer")
         self.remaining_mb = float(size_mb)
         self.done = done
+        self.cancelled = False
 
 
 class Route(LogMixin):
     """A directed (src, dst) link with FIFO round-robin chunk service."""
 
-    __slots__ = ("env", "src", "dst", "bw", "meter", "_queue", "_busy")
+    __slots__ = ("env", "src", "dst", "bw", "meter", "_queue", "_busy", "_in_service")
 
     def __init__(self, env: Environment, src, dst, bw: float, meter=None):
         self.env = env
@@ -57,6 +58,7 @@ class Route(LogMixin):
         self.meter = meter
         self._queue: deque = deque()
         self._busy = False
+        self._in_service: Optional[Transfer] = None
 
     @property
     def queued_mb(self) -> float:
@@ -77,12 +79,35 @@ class Route(LogMixin):
             self._serve_next()
         return done
 
+    def cancel(self, done: Event) -> None:
+        """Drop the queued transfer whose completion event is ``done``.
+
+        Used when a consumer dies mid-staging (host crash,
+        ``pivot_tpu.infra.faults``): without cancellation the orphaned
+        transfer would keep round-robin-stealing bandwidth from live
+        transfers until served to completion.  The chunk currently in
+        service (if any) finishes — data already on the wire — but nothing
+        further is served and ``done`` never fires."""
+        # Eager removal keeps queued_mb / realtime_bw exact immediately —
+        # a lazily flagged dead transfer would inflate congestion estimates
+        # (and steer bandwidth-aware placement) until it rotated to the
+        # queue front.
+        survivors = [t for t in self._queue if t.done is not done]
+        if len(survivors) != len(self._queue):
+            self._queue = deque(survivors)
+        # The in-service transfer is not in the queue; its current chunk
+        # (data already on the wire) finishes, then it is dropped.
+        if self._in_service is not None and self._in_service.done is done:
+            self._in_service.cancelled = True
+
     def _serve_next(self) -> None:
         if not self._queue:
             self._busy = False
+            self._in_service = None
             return
         self._busy = True
         transfer = self._queue.popleft()
+        self._in_service = transfer
         chunk = min(transfer.remaining_mb, CHUNK_MB)
         if self.meter:
             self.meter.route_check_in(self, transfer.id)
@@ -95,7 +120,9 @@ class Route(LogMixin):
         if self.meter:
             self.meter.route_check_out(self, transfer.id, chunk)
         transfer.remaining_mb -= chunk
-        if transfer.remaining_mb <= 0:
+        if transfer.cancelled:
+            pass  # dropped: no completion, no re-enqueue
+        elif transfer.remaining_mb <= 0:
             transfer.done.succeed()
         else:
             self._queue.append(transfer)  # round-robin fairness
@@ -134,3 +161,9 @@ class NativeRoute(Route):
             done = self.env.event()
         self.engine.send(self.index, size_mb, done)
         return done
+
+    def cancel(self, done: Event) -> None:
+        """No-op: the native engine owns the queue and has no cancel path —
+        an orphaned transfer is served to completion (bounded bandwidth
+        skew after a host crash).  Fault-heavy experiments should prefer
+        ``network_backend='python'``."""

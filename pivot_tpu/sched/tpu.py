@@ -18,12 +18,13 @@ Adaptive dispatch (``adaptive=True``): a remote accelerator has a fixed
 per-call latency floor (dispatch + execution + result fetch — ~70 ms over
 this image's tunnel, measured) that dwarfs small ticks, while the
 in-process numpy twin costs ~50 ns per task×host cell.  The wrapper keeps
-an online latency model of both (a trivial-kernel probe of the link floor
-at bind time; an EMA of observed per-cell cost for the twin — the floor is
-deliberately NOT updated from real device calls, whose duration includes
-size-dependent compute and would inflate the floor until the device path
-permanently starved) and routes each tick to whichever backend the model
-predicts faster.  The numpy twins consume the
+an online affine latency model of both sides — twin: cells × per-cell
+cost; device: probed link floor + cells × per-cell cost (the scan kernels
+are sequential over tasks, so device time grows with the batch too).
+Per-cell terms are EMAs of observed calls at meaningful sizes; the floor
+is probe-only (folding full call times into it would starve the device
+path permanently).  Each tick routes to whichever side the model predicts
+decisively faster.  The numpy twins consume the
 same RNG draws per tick as the kernels, so the stream stays aligned no
 matter which side serves a given tick.
 
@@ -84,6 +85,38 @@ def pad_bucket(n: int) -> int:
     return ((n + 8191) // 8192) * 8192
 
 
+_cache_enabled = False
+
+
+def _enable_compilation_cache() -> None:
+    """Persist XLA executables across processes (``~/.cache/pivot_tpu_xla``).
+
+    Each (bucket, H) program costs seconds to compile on a TPU; without a
+    persistent cache every fresh experiment process pays it again, which
+    can exceed the device's entire per-tick win at moderate scale."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    import jax
+
+    try:
+        cache_dir = os.environ.get(
+            "PIVOT_XLA_CACHE", os.path.expanduser("~/.cache/pivot_tpu_xla")
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    except Exception as exc:  # never let caching break scheduling
+        import logging
+
+        logging.getLogger("pivot_tpu").warning(
+            "persistent compilation cache unavailable: %s", exc
+        )
+
+
 def _probe_device_floor() -> float:
     """Measure the fixed per-call device latency: dispatch + execution of a
     trivial kernel + result fetch (the fetch is what actually waits on the
@@ -109,9 +142,24 @@ class _DevicePolicyBase(Policy):
     #: Seed for the numpy-twin cost model: seconds per task×host cell
     #: (refined online from observed twin calls).
     _CELL_COST_SEED = 5e-8
-    #: Only ticks at least this many cells update the cell-cost EMA —
-    #: below it, Python constant overhead dominates the per-cell term.
-    _CELL_COST_MIN_SAMPLE = 4096
+    #: Only ticks at least this many cells update the cell-cost EMA.  The
+    #: twin's real cost is affine (constant dispatch overhead + per-cell
+    #: work); fitting the linear model on small ticks folds the constant
+    #: into the slope and overestimates big ticks several-fold, which made
+    #: the device engage in the marginal region where it cannot win.  At
+    #: 256k cells the constant (~0.3 ms) is noise against ~12 ms of
+    #: per-cell work.
+    _CELL_COST_MIN_SAMPLE = 1 << 18
+    #: Engage the device only when the predicted twin time beats the
+    #: predicted device time by this factor.  Marginal wins cannot repay
+    #: the one-time XLA compile of each (bucket, H) program, and prediction
+    #: error near the crossover flips the verdict tick to tick.
+    _DEVICE_ADVANTAGE = 2.0
+    #: Seed for the device per-cell cost (s/cell) — the scan kernel is
+    #: sequential over tasks, so device time is floor + cells × this, NOT
+    #: a constant.  Measured ~7e-9 on a v5e via tunnel at B=2048, H=600;
+    #: refined online from observed device calls.
+    _DEVICE_CELL_COST_SEED = 1e-8
     #: Every Nth device-routed tick is served by the twin instead, so the
     #: cell-cost model keeps getting samples even when it (possibly
     #: wrongly) predicts the device is faster — without exploration an
@@ -132,10 +180,18 @@ class _DevicePolicyBase(Policy):
         self._cpu_twin: Optional[Policy] = None  # set by subclasses
         self._cpu_cell_cost = self._CELL_COST_SEED
         self._device_floor = 0.0  # per-call latency floor, seconds
+        self._device_cell_cost = self._DEVICE_CELL_COST_SEED
         self._device_routed = 0
+        self._twin_routed = 0
+        # Buckets whose program has already run once: the first call per
+        # (bucket) includes XLA compile time, which must not poison the
+        # per-cell EMA (one 5 s compile read as per-cell work would starve
+        # the device path for the rest of the process).
+        self._warm_buckets: set = set()
 
     def bind(self, scheduler) -> None:
         self._scheduler = scheduler
+        _enable_compilation_cache()
         self.topology = DeviceTopology.from_cluster(scheduler.cluster, self.dtype)
         if self._cpu_twin is not None:
             self._cpu_twin.bind(scheduler)
@@ -146,26 +202,73 @@ class _DevicePolicyBase(Policy):
     def place(self, ctx: TickContext) -> np.ndarray:
         if self.adaptive and self._cpu_twin is not None:
             cells = ctx.n_tasks * ctx.n_hosts
-            twin_predicted = cells * self._cpu_cell_cost <= self._device_floor
-            explore = (
+            bucket = pad_bucket(ctx.n_tasks)
+            # The twin loops over the true T; the kernels scan the PADDED
+            # bucket, so the two sides' cell counts differ — mixing them
+            # would put predictions and EMA samples in inconsistent units.
+            dev_cells = bucket * ctx.n_hosts
+            pred_twin = cells * self._cpu_cell_cost
+            pred_device = self._device_floor + dev_cells * self._device_cell_cost
+            twin_predicted = pred_twin <= self._DEVICE_ADVANTAGE * pred_device
+            big = cells >= self._CELL_COST_MIN_SAMPLE
+            # Symmetric exploration: each side occasionally serves a big
+            # tick the model assigned to the other, so BOTH per-cell EMAs
+            # keep receiving samples — otherwise a single bad estimate
+            # (either direction) would be self-sealing.
+            explore_twin = (
                 not twin_predicted
-                and cells >= self._CELL_COST_MIN_SAMPLE
-                and cells * self._cpu_cell_cost
-                <= self._EXPLORE_MARGIN * self._device_floor
+                and big
+                # Absolute bound (margin × probed floor), NOT margin ×
+                # pred_device: the affine device prediction grows with the
+                # batch, and a relative gate would let one exploration
+                # sample cost 8× a large device tick.  Past this bound the
+                # verdict is clear anyway (the cost ratio approaches the
+                # slope ratio).
+                and pred_twin <= self._EXPLORE_MARGIN * self._device_floor
                 and self._device_routed % self._EXPLORE_EVERY
                 == self._EXPLORE_EVERY - 1
             )
-            if twin_predicted or explore:
+            explore_device = (
+                twin_predicted
+                and big
+                # Only warm buckets: an exploration sample must cost
+                # ~margin × floor, not a multi-second cold XLA compile.
+                # (Cold buckets get warmed by predicted device wins, whose
+                # sustained use amortizes the compile.)
+                and bucket in self._warm_buckets
+                and pred_device <= self._EXPLORE_MARGIN * pred_twin
+                and self._twin_routed % self._EXPLORE_EVERY
+                == self._EXPLORE_EVERY - 1
+            )
+            if (twin_predicted and not explore_device) or explore_twin:
                 t0 = time.perf_counter()
                 out = self._cpu_twin.place(ctx)
                 dt = time.perf_counter() - t0
-                if cells >= self._CELL_COST_MIN_SAMPLE:
+                if big:
                     self._cpu_cell_cost = 0.5 * (self._cpu_cell_cost + dt / cells)
-                if explore:
+                if explore_twin:
                     self._device_routed += 1
+                else:
+                    self._twin_routed += 1
                 return out
-            self._device_routed += 1
-            return self._device_place(ctx)
+            t0 = time.perf_counter()
+            out = self._device_place(ctx)
+            dt = time.perf_counter() - t0
+            # Attribute time beyond the probed floor to per-padded-cell
+            # work — but never from a bucket's first call, which includes
+            # XLA compile.  (The floor itself stays probe-only for the
+            # same reason.)
+            if big and bucket in self._warm_buckets:
+                self._device_cell_cost = 0.5 * (
+                    self._device_cell_cost
+                    + max(dt - self._device_floor, 0.0) / dev_cells
+                )
+            self._warm_buckets.add(bucket)
+            if explore_device:
+                self._twin_routed += 1
+            else:
+                self._device_routed += 1
+            return out
         return self._device_place(ctx)
 
     def _device_place(self, ctx: TickContext) -> np.ndarray:

@@ -173,3 +173,50 @@ def test_determinism_two_runs():
         return trace
 
     assert build_and_run() == build_and_run()
+
+
+def test_any_of_returns_first_and_ignores_late():
+    env = Environment()
+    results = []
+
+    def proc():
+        t_fast = env.timeout(1, "fast")
+        t_slow = env.timeout(5, "slow")
+        fired = yield env.any_of([t_fast, t_slow])
+        results.append((fired is t_fast, env.now))
+
+    env.process(proc())
+    env.run()
+    assert results == [(True, 1)]
+
+
+def test_any_of_propagates_failure():
+    env = Environment()
+    caught = []
+
+    def proc():
+        evt = env.event()
+        env.schedule_callback(2, lambda: evt.fail(RuntimeError("boom")))
+        try:
+            yield env.any_of([evt, env.timeout(5)])
+        except RuntimeError as e:
+            caught.append(str(e))
+
+    env.process(proc())
+    env.run()
+    assert caught == ["boom"]
+
+
+def test_any_of_already_processed_event():
+    env = Environment()
+    results = []
+
+    def proc():
+        early = env.timeout(1)
+        yield env.timeout(3)  # early is long processed by now
+        fired = yield env.any_of([early, env.timeout(10)])
+        results.append((fired is early, env.now))
+
+    env.process(proc())
+    env.run()
+    assert results == [(True, 3)]

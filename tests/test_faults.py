@@ -1,0 +1,263 @@
+"""Fault injection: host crashes abort resident tasks, the scheduler's
+retry loop reschedules them elsewhere (elastic recovery), recovered hosts
+rejoin placement, and bandwidth fluctuation perturbs live routes.
+
+The reference has no fault sources at all (SURVEY.md §5) — only the retry
+path these tests exercise end to end."""
+
+import numpy as np
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.faults import FaultInjector
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.sched import GlobalScheduler
+from pivot_tpu.sched.policies import FirstFitPolicy
+from pivot_tpu.workload import Application, TaskGroup
+
+INTERVAL = 5
+
+
+@pytest.fixture(scope="module")
+def meta():
+    return ResourceMetadata(seed=0)
+
+
+def build(meta, host_shapes, seed=0):
+    env = Environment()
+    meter = Meter(env, meta)
+    zones = meta.zones
+    hosts = [
+        Host(env, *shape, locality=zones[i % len(zones)], meter=meter)
+        for i, shape in enumerate(host_shapes)
+    ]
+    storage = [Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)]
+    cluster = Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, meter=meter,
+        route_mode="meta", seed=seed,
+    )
+    scheduler = GlobalScheduler(
+        env, cluster, FirstFitPolicy(), interval=INTERVAL, seed=seed, meter=meter
+    )
+    cluster.start()
+    scheduler.start()
+    return env, cluster, scheduler
+
+
+def test_host_failure_aborts_and_reschedules(meta):
+    """A crash mid-compute fails the task immediately; the retry loop lands
+    it on the surviving host and the app still completes."""
+    env, cluster, scheduler = build(meta, [(1, 1024, 10, 0)] * 2)
+    app = Application("f", [TaskGroup("g", cpus=1, mem=512, runtime=100)])
+    injector = FaultInjector(cluster, seed=0)
+    victim = cluster.hosts[0].id  # first-fit places on host 0
+    injector.fail_host(victim, at=20.0)
+
+    scheduler.submit(app)
+    scheduler.stop()
+    env.run()
+
+    assert app.is_finished
+    task = app.groups[0].tasks[0]
+    assert task.placement == cluster.hosts[1].id  # rescheduled elsewhere
+    # Aborted at 20, re-placed on a tick ≥ 20, full 100 s re-run.
+    assert 120 <= app.end_time <= 120 + 2 * INTERVAL
+    assert not cluster.hosts[0].up
+    assert cluster.hosts[0].n_tasks == 0
+    assert injector.log == [(20.0, victim, "failed")]
+
+
+def test_down_host_gets_no_placements(meta):
+    """Zero availability on a down host keeps every fit mask off it."""
+    env, cluster, scheduler = build(meta, [(4, 4096, 10, 0)] * 2)
+    injector = FaultInjector(cluster, seed=0)
+    injector.fail_host(cluster.hosts[0].id, at=0.0)
+    app = Application(
+        "g", [TaskGroup("g", cpus=1, mem=256, runtime=10, instances=6)]
+    )
+    scheduler.submit(app)
+    scheduler.stop()
+    env.run()
+    assert app.is_finished
+    assert {t.placement for t in app.groups[0].tasks} == {cluster.hosts[1].id}
+
+
+def test_recovery_rejoins_placement(meta):
+    """An outage with a recovery: the task waits out the outage, then the
+    recovered (fresh-capacity) host runs it."""
+    env, cluster, scheduler = build(meta, [(1, 1024, 10, 0)])
+    app = Application("r", [TaskGroup("g", cpus=1, mem=512, runtime=10)])
+    injector = FaultInjector(cluster, seed=0)
+    host = cluster.hosts[0]
+    injector.fail_host(host.id, at=2.0, duration=5.0)  # down [2, 7)
+
+    scheduler.submit(app)
+    scheduler.stop()
+    env.run()
+
+    assert app.is_finished
+    assert host.up
+    assert host.resource.cpus == host.resource.t_cpus  # fresh machine
+    # Aborted at 2, host up again at 7, re-placed on the tick at 10.
+    assert app.end_time == pytest.approx(20.0)
+    assert [e for _, _, e in injector.log] == ["failed", "recovered"]
+
+
+def test_random_failures_deterministic(meta):
+    """Same seed → identical (time, host) fault schedule."""
+    def schedule(seed):
+        from pivot_tpu.utils import reset_ids
+
+        reset_ids()  # same host-N ids across builds
+        env, cluster, _sched = build(meta, [(4, 4096, 10, 0)] * 8)
+        return FaultInjector(cluster, seed=seed).random_host_failures(
+            5, horizon=1000.0, mttr=50.0
+        )
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)
+
+
+def test_bandwidth_fluctuation(meta):
+    """Fluctuation resamples live route bw within ±amplitude of base, is
+    seed-deterministic, and restores base at the `until` horizon."""
+    env, cluster, _sched = build(meta, [(4, 4096, 10, 0)] * 2)
+    h0, h1 = cluster.hosts
+    route = cluster.get_route(h0.id, h1.id)
+    base = route.bw
+    injector = FaultInjector(cluster, seed=3)
+    injector.fluctuate_bandwidth(period=10.0, amplitude=0.2, until=100.0)
+    env.run(until=99.0)  # inside the fault window
+    assert route.bw != base
+    assert 0.8 * base <= route.bw <= 1.2 * base
+    perturbed = route.bw
+
+    env2, cluster2, _ = build(meta, [(4, 4096, 10, 0)] * 2)
+    r2 = cluster2.get_route(cluster2.hosts[0].id, cluster2.hosts[1].id)
+    FaultInjector(cluster2, seed=3).fluctuate_bandwidth(
+        period=10.0, amplitude=0.2, until=100.0
+    )
+    env2.run(until=99.0)
+    assert np.isclose(r2.bw, perturbed)  # same seed → same resample sequence
+
+    # Past the horizon the final draw must not persist as permanent bias.
+    env.run(until=150.0)
+    assert route.bw == base
+
+
+def test_fluctuation_requires_python_backend(meta):
+    from pivot_tpu import native
+
+    if not native.available():
+        pytest.skip("native backend unavailable")
+    env = Environment()
+    zones = meta.zones
+    hosts = [Host(env, 4, 4096, 10, 0, locality=zones[0])]
+    cluster = Cluster(
+        env, hosts=hosts, storage=[Storage(env, zones[0])], meta=meta,
+        route_mode="meta", seed=0, network_backend="native",
+    )
+    with pytest.raises(ValueError, match="fluctuation"):
+        FaultInjector(cluster, seed=0).fluctuate_bandwidth(period=5.0)
+
+
+def test_elastic_recovery_full_trace(meta):
+    """End to end: a trace replay survives random crash/recovery cycles —
+    every app completes via the retry loop."""
+    from pivot_tpu.experiments.runner import replay_schedule
+    from pivot_tpu.workload.trace import load_trace_jobs
+
+    env, cluster, scheduler = build(meta, [(16, 128 * 1024, 100, 1)] * 12)
+    schedule = load_trace_jobs(
+        "data/jobs/jobs-5000-200-86400-172800.npz", 1000.0
+    ).take(10)
+    injector = FaultInjector(cluster, seed=1)
+    injector.random_host_failures(6, horizon=2000.0, mttr=100.0)
+    env.process(replay_schedule(env, scheduler, schedule, 10))
+    env.run()
+    assert all(a.is_finished for a in schedule.apps)
+
+
+def test_overlapping_outages_union(meta):
+    """A short second outage inside a longer first one must not resurrect
+    the host early — downtime is the union, not the min."""
+    env, cluster, _sched = build(meta, [(4, 4096, 10, 0)])
+    host = cluster.hosts[0]
+    inj = FaultInjector(cluster, seed=0)
+    inj.fail_host(host.id, at=10.0, duration=100.0)  # down [10, 110)
+    inj.fail_host(host.id, at=20.0, duration=5.0)    # ends inside the first
+    env.run(until=50.0)
+    assert not host.up  # the 25 s recovery must NOT have fired
+    env.run(until=120.0)
+    assert host.up
+    assert [e for _, _, e in inj.log] == ["failed", "recovered"]
+    assert inj.log[-1][0] == pytest.approx(110.0)
+
+
+def test_staging_survives_source_host_crash(meta):
+    """A successor pulls a finished predecessor's output from the zone's
+    storage when the producing host is dead — the app still completes with
+    the transfer accounted (durable outputs; ref's storage-mediated pull)."""
+    from pivot_tpu.workload import Application, TaskGroup
+
+    env, cluster, scheduler = build(meta, [(1, 1024, 10, 0)] * 2)
+    app = Application(
+        "d",
+        [
+            TaskGroup("src", cpus=1, mem=256, runtime=10, output_size=500),
+            TaskGroup("dst", cpus=1, mem=256, runtime=10, dependencies=["src"]),
+        ],
+    )
+    inj = FaultInjector(cluster, seed=0)
+    # Timeline: src placed at the t=5 tick on host 0 (first-fit), finishes
+    # at 15; dst placed at the t=15 tick on host 0.  The crash at t=16
+    # aborts dst mid-compute; its retry (t=20 tick) lands on host 1 and
+    # must stage src's output from the dead host's zone storage.
+    inj.fail_host(cluster.hosts[0].id, at=16.0)
+    scheduler.submit(app)
+    scheduler.stop()
+    env.run()
+    assert app.is_finished
+    src_task = app.groups[0].tasks[0]
+    dst_task = app.groups[1].tasks[0]
+    assert src_task.placement == cluster.hosts[0].id  # data on the dead host
+    assert dst_task.placement == cluster.hosts[1].id
+    # The staging route originated at the dead host's zone storage.
+    store = cluster.get_storage_by_locality(cluster.hosts[0].locality)
+    assert (store.id, cluster.hosts[1].id) in cluster._routes
+
+
+def test_fluctuation_until_before_first_period(meta):
+    """until < period ⇒ no resample may ever fire."""
+    env, cluster, _sched = build(meta, [(4, 4096, 10, 0)] * 2)
+    route = cluster.get_route(cluster.hosts[0].id, cluster.hosts[1].id)
+    base = route.bw
+    FaultInjector(cluster, seed=3).fluctuate_bandwidth(
+        period=200.0, amplitude=0.5, until=100.0
+    )
+    env.run(until=500.0)
+    assert route.bw == base
+
+
+def test_fluctuation_rejects_bad_params(meta):
+    env, cluster, _sched = build(meta, [(4, 4096, 10, 0)])
+    inj = FaultInjector(cluster, seed=0)
+    with pytest.raises(ValueError, match="period"):
+        inj.fluctuate_bandwidth(period=0.0)
+    with pytest.raises(ValueError, match="amplitude"):
+        inj.fluctuate_bandwidth(period=5.0, amplitude=1.2)
+
+
+def test_zero_demand_task_never_lands_on_down_host(meta):
+    """A down host's −1 availability sentinel excludes even zero-demand
+    tasks (a zero row would admit them and livelock the retry loop)."""
+    env, cluster, scheduler = build(meta, [(4, 4096, 10, 0)] * 2)
+    FaultInjector(cluster, seed=0).fail_host(cluster.hosts[0].id, at=0.0)
+    app = Application("z", [TaskGroup("g", cpus=0, mem=0, runtime=5)])
+    scheduler.submit(app)
+    scheduler.stop()
+    env.run()  # must terminate
+    assert app.is_finished
+    assert app.groups[0].tasks[0].placement == cluster.hosts[1].id

@@ -117,3 +117,32 @@ def test_congestion_delay_measured():
     # Each transfer's two service slots are separated by the other's chunk
     # service (1 s each); average gap per transfer = 1 s.
     assert meter.average_congestion_delay == pytest.approx(1.0)
+
+
+def test_cancel_frees_route_bandwidth():
+    """A cancelled transfer stops stealing round-robin bandwidth: the
+    surviving transfer finishes as if alone (after the in-service chunk)."""
+    route, env = make_route(bw=100.0)  # 10 s per 1000-MB chunk
+    ghost = route.send(10 * CHUNK_MB)   # would run 100 s alone
+    live = route.send(2 * CHUNK_MB)     # 20 s alone
+    done_at = []
+    live.callbacks.append(lambda _e: done_at.append(env.now))
+    # Cancel the ghost immediately: only its in-service first chunk (10 s)
+    # may still serve; then the live transfer runs back-to-back.
+    route.cancel(ghost)
+    env.run()
+    assert done_at == [30.0]  # 10 (ghost chunk) + 20 (live alone)
+    assert not ghost.triggered  # cancelled transfers never complete
+
+
+def test_cancel_updates_queue_estimates_immediately():
+    """cancel() removes queued transfers eagerly: queued_mb / realtime_bw
+    must not keep counting a dead transfer until it rotates to the front."""
+    route, env = make_route(bw=100.0)
+    live = route.send(3 * CHUNK_MB)
+    ghost = route.send(10 * CHUNK_MB)  # queued behind live's first chunk
+    assert route.queued_mb == 10 * CHUNK_MB
+    route.cancel(ghost)
+    assert route.queued_mb == 0.0  # exact immediately, not after rotation
+    env.run()
+    assert live.triggered and not ghost.triggered
