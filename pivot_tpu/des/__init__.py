@@ -206,6 +206,7 @@ class Environment:
         self._now = float(initial_time)
         self._heap: list = []
         self._seq = 0
+        self._observers: list = []
 
     @property
     def now(self) -> float:
@@ -323,6 +324,13 @@ class Environment:
         return race
 
     # -- execution -------------------------------------------------------
+    def add_step_observer(self, fn: Callable[[], None]) -> None:
+        """Register ``fn`` to run after every processed event (the
+        hookable-dispatch-point design goal): zero heap traffic, never
+        advances sim time, sees state only at event boundaries — which is
+        where state can change.  Used by the invariant auditor."""
+        self._observers.append(fn)
+
     def step(self) -> None:
         t, _prio, _seq, event = heapq.heappop(self._heap)
         self._now = t
@@ -331,6 +339,9 @@ class Environment:
         callbacks, event.callbacks = event.callbacks, None
         for cb in callbacks:
             cb(event)
+        if self._observers:
+            for ob in self._observers:
+                ob()
 
     def run(self, until: Optional[float] = None) -> None:
         """Run to event exhaustion, or until sim time reaches ``until``."""

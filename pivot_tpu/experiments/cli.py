@@ -52,6 +52,7 @@ class RunSpec:
     seed: int
     trace_events: bool = False
     profile_dir: str = ""
+    audit: bool = False
 
 
 def _spec_identity(spec: RunSpec) -> dict:
@@ -68,6 +69,9 @@ def _spec_identity(spec: RunSpec) -> dict:
         "cluster": dataclasses.asdict(spec.cluster),
         "policy": dataclasses.asdict(spec.policy),
         "trace_events": spec.trace_events,
+        # --audit is deliberately NOT part of the identity: it is a pure
+        # observer (asserted by test_audit_does_not_perturb_metrics) with
+        # no artifacts, so toggling it must not invalidate completed runs.
     }
 
 
@@ -118,6 +122,7 @@ def _execute_run(spec: RunSpec) -> None:
         seed=spec.seed,
         trace_events=spec.trace_events,
         identity=_spec_identity(spec),
+        audit=spec.audit,
     )
     # Per-run profile dir: jax.profiler names sessions by wall-clock second
     # and hostname, so concurrent/sub-second runs sharing one dir collide.
@@ -171,6 +176,10 @@ def parse_args(argv=None):
         help="network fabric backend (native = C++ co-simulator)",
     )
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--audit", action="store_true",
+                        help="audit simulation-state invariants (resource "
+                             "accounting, down-host emptiness, route "
+                             "consistency) every tick; abort on violation")
     parser.add_argument("--trace-events", action="store_true",
                         help="write structured event traces (events.jsonl + "
                              "Chrome/Perfetto events.chrome.json) per run")
@@ -280,7 +289,7 @@ def run_overall(args) -> str:
     specs = [
         RunSpec(cluster_cfg, pc, trace, os.path.join(exp_dir, "data", str(i)),
                 args.num_apps, args.scale_factor, args.seed,
-                args.trace_events, args.profile_dir)
+                args.trace_events, args.profile_dir, args.audit)
         for i, trace in enumerate(traces)
         for pc in policy_set
     ]
@@ -302,7 +311,7 @@ def run_num_apps(args) -> str:
         RunSpec(cluster_cfg, pc, trace,
                 os.path.join(exp_dir, "data", str(n), str(i)),
                 n, args.scale_factor, args.seed,
-                args.trace_events, args.profile_dir)
+                args.trace_events, args.profile_dir, args.audit)
         for n in args.num_apps_list
         for i, trace in enumerate(traces)
         for pc in policy_set

@@ -78,6 +78,7 @@ class ExperimentRun(LogMixin):
         interval: float = 5,
         trace_events: bool = False,
         identity: Optional[dict] = None,
+        audit: bool = False,
     ):
         self.label = label
         self.cluster = cluster
@@ -93,6 +94,7 @@ class ExperimentRun(LogMixin):
         self.trace_events = trace_events
         self.tracer: Optional[Tracer] = None
         self.identity = identity
+        self.audit = audit
 
     def run_identity(self) -> dict:
         """What makes this run *this* run — compared on grid resume.
@@ -130,10 +132,26 @@ class ExperimentRun(LogMixin):
 
         cluster.start()
         scheduler.start()
+        if self.audit:
+            from pivot_tpu.infra.audit import start_periodic_audit
+
+            start_periodic_audit(cluster, period=self.interval)
         env.process(replay_schedule(env, scheduler, schedule, self.n_apps))
 
         self.logger.info("running %s on %s", self.label, self.trace_file)
         env.run()
+        if self.audit:
+            # The periodic observer throttles to one audit per interval;
+            # a final full check closes the last window so corruption
+            # arising near event exhaustion cannot ship silently.
+            from pivot_tpu.infra.audit import AuditError, audit_cluster
+
+            violations = audit_cluster(cluster)
+            if violations:
+                raise AuditError(
+                    f"final state corrupted after {self.label}:\n  "
+                    + "\n  ".join(violations)
+                )
 
         apps = schedule.apps
         runtimes = [a.end_time - a.start_time for a in apps]

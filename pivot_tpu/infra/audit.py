@@ -1,0 +1,127 @@
+"""Simulation-state invariant auditor — the framework's sanitizer.
+
+The reference has **no race detection or sanitizers** (SURVEY.md §5); its
+concurrency safety rests on SimPy's cooperative scheduling plus a mutex.
+This framework's cooperative kernel gives the same atomicity, but resource
+accounting bugs (double release, leaked admission, negative capacity,
+ghost tasks on dead hosts) would corrupt results *silently* — placements
+still happen, metrics still print.  The auditor makes the invariants
+explicit and checkable at any dispatch point:
+
+  * per host, per dimension: ``0 ≤ available ≤ total`` (up hosts);
+  * the sum of resident tasks' demands equals the capacity in use;
+  * down hosts hold no tasks (tasks whose abort has fired but not yet
+    been delivered are tolerated — a legitimate transient between the
+    failure event and the aborted process resuming);
+  * down hosts report the −1 availability sentinel in
+    ``availability_matrix`` (what keeps fit masks off them);
+  * a Python-backend route is busy iff it has a transfer in service
+    (native routes keep their queue in the C++ engine and are skipped).
+
+Run it ad hoc (``violations = audit_cluster(cluster)``), or install it as
+a kernel step observer (``start_periodic_audit``) to fail fast at the
+first corrupted state — the DES analog of running under a sanitizer.
+The observer never schedules events, so it cannot advance sim time or
+change any metric.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+__all__ = ["AuditError", "audit_cluster", "start_periodic_audit"]
+
+#: Relative tolerance for float accounting (fractional trace demands
+#: accumulate rounding on acquire/release).
+_RTOL = 1e-6
+
+
+class AuditError(AssertionError):
+    """Raised by the periodic auditor on the first invariant violation."""
+
+
+def _close(a: float, b: float, scale: float) -> bool:
+    return abs(a - b) <= _RTOL * max(scale, 1.0)
+
+
+def audit_cluster(cluster) -> List[str]:
+    """Check every invariant; return human-readable violations (empty = ok)."""
+    from pivot_tpu.infra.network import NativeRoute
+
+    violations: List[str] = []
+    dims = ("cpus", "mem", "disk", "gpus")
+    avail_mat = None
+    for hi, host in enumerate(cluster.hosts):
+        r = host.resource
+        if not host.up:
+            stuck = [
+                t for t in host._tasks
+                if not (t in host._aborts and host._aborts[t].triggered)
+            ]
+            if stuck:
+                violations.append(
+                    f"{host.id}: down but holds {len(stuck)} task(s) with "
+                    "no abort in flight"
+                )
+            if avail_mat is None:
+                avail_mat = cluster.availability_matrix()
+            if not (avail_mat[hi] == -1.0).all():
+                violations.append(
+                    f"{host.id}: down but availability row is "
+                    f"{avail_mat[hi].tolist()}, not the -1 sentinel"
+                )
+            continue
+        in_use = [0.0, 0.0, 0.0, 0.0]
+        for task in host._tasks:
+            g = task.group
+            for i, d in enumerate((g.cpus, g.mem, g.disk, g.gpus)):
+                in_use[i] += d
+        for i, dim in enumerate(dims):
+            avail = getattr(r, dim)
+            total = getattr(r, "t_" + dim)
+            if avail < -_RTOL * max(total, 1.0):
+                violations.append(f"{host.id}: negative {dim} ({avail})")
+            if avail > total * (1 + _RTOL):
+                violations.append(
+                    f"{host.id}: {dim} available {avail} exceeds total {total}"
+                )
+            if not _close(total - avail, in_use[i], total):
+                violations.append(
+                    f"{host.id}: {dim} in use {total - avail:.6g} != "
+                    f"Σ resident demands {in_use[i]:.6g}"
+                )
+    for key, route in cluster._routes.items():
+        if isinstance(route, NativeRoute):
+            continue  # queue state lives in the C++ engine
+        if route._busy != (route._in_service is not None):
+            violations.append(
+                f"route {key}: busy={route._busy} but "
+                f"in_service={route._in_service!r}"
+            )
+    return violations
+
+
+def start_periodic_audit(cluster, period: float = 5.0) -> None:
+    """Audit at event boundaries, at most once per ``period`` sim-seconds;
+    raise :class:`AuditError` with the full violation list the first time
+    any invariant breaks.
+
+    Installed as a kernel step observer (``Environment.add_step_observer``)
+    rather than as heap events: the audit piggybacks on real events, so it
+    cannot advance sim time past the last workload event, keep ``run()``
+    alive, or perturb event ordering."""
+    env = cluster.env
+    last = [env.now]
+
+    def _observe():
+        if env.now - last[0] < period:
+            return
+        last[0] = env.now
+        violations = audit_cluster(cluster)
+        if violations:
+            raise AuditError(
+                f"[t={env.now:.3f}] simulation state corrupted:\n  "
+                + "\n  ".join(violations)
+            )
+
+    env.add_step_observer(_observe)

@@ -1,0 +1,144 @@
+"""Invariant auditor: clean runs audit clean (including under faults);
+corrupted resource accounting is detected and aborts the run."""
+
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.audit import AuditError, audit_cluster, start_periodic_audit
+from pivot_tpu.infra.faults import FaultInjector
+from pivot_tpu.infra.gen import RandomClusterGenerator
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.sched import GlobalScheduler
+from pivot_tpu.sched.policies import FirstFitPolicy
+from pivot_tpu.workload import Application, TaskGroup
+
+
+@pytest.fixture(scope="module")
+def meta():
+    return ResourceMetadata(seed=0)
+
+
+def test_full_trace_run_audits_clean_under_faults(meta):
+    """A real trace replay with crashes/recoveries passes every periodic
+    audit and still terminates."""
+    from pivot_tpu.experiments.runner import replay_schedule
+    from pivot_tpu.workload.trace import load_trace_jobs
+
+    env = Environment()
+    meter = Meter(env, meta)
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(12).clone(env, meter)
+    scheduler = GlobalScheduler(env, cluster, FirstFitPolicy(), seed=0, meter=meter)
+    cluster.start()
+    scheduler.start()
+    start_periodic_audit(cluster, period=5.0)
+    FaultInjector(cluster, seed=2).random_host_failures(4, horizon=1500.0, mttr=80.0)
+    schedule = load_trace_jobs(
+        "data/jobs/jobs-5000-200-86400-172800.npz", 1000.0
+    ).take(8)
+    env.process(replay_schedule(env, scheduler, schedule, 8))
+    env.run()  # an AuditError would propagate out of step()
+    assert all(a.is_finished for a in schedule.apps)
+    assert audit_cluster(cluster) == []
+
+
+def test_leaked_admission_detected(meta):
+    env = Environment()
+    z = meta.zones[0]
+    host = Host(env, 8, 8192, 100, 1, locality=z)
+    cluster = Cluster(env, hosts=[host], storage=[Storage(env, z)], meta=meta,
+                      route_mode="meta", seed=0)
+    assert audit_cluster(cluster) == []
+    host.resource.cpus -= 2  # capacity in use with no resident task
+    assert any("in use" in v for v in audit_cluster(cluster))
+
+
+def test_over_release_detected(meta):
+    env = Environment()
+    z = meta.zones[0]
+    host = Host(env, 8, 8192, 100, 1, locality=z)
+    cluster = Cluster(env, hosts=[host], storage=[Storage(env, z)], meta=meta,
+                      route_mode="meta", seed=0)
+    host.resource.cpus = 9.0  # more available than the machine has
+    assert any("exceeds total" in v for v in audit_cluster(cluster))
+
+
+def test_ghost_task_on_down_host_detected(meta):
+    env = Environment()
+    z = meta.zones[0]
+    host = Host(env, 8, 8192, 100, 1, locality=z)
+    cluster = Cluster(env, hosts=[host], storage=[Storage(env, z)], meta=meta,
+                      route_mode="meta", seed=0)
+    app = Application("a", [TaskGroup("g", cpus=1, mem=64, runtime=5)])
+    task = app.groups[0].materialize_tasks()[0]
+    host._tasks.add(task)
+    host.up = False
+    assert any("down but holds" in v for v in audit_cluster(cluster))
+
+
+def test_periodic_audit_aborts_on_violation(meta):
+    env = Environment()
+    z = meta.zones[0]
+    host = Host(env, 8, 8192, 100, 1, locality=z)
+    cluster = Cluster(env, hosts=[host], storage=[Storage(env, z)], meta=meta,
+                      route_mode="meta", seed=0)
+    start_periodic_audit(cluster, period=1.0)
+    env.schedule_callback(2.5, lambda: setattr(host.resource, "mem", -5.0))
+    env.timeout(10)  # keep events pending past the corruption
+    with pytest.raises(AuditError, match="negative mem|in use"):
+        env.run()
+
+
+def test_cli_audit_flag(tmp_path):
+    from pivot_tpu.experiments import cli
+
+    cli.main([
+        "--num-hosts", "8", "--trace-limit", "1", "--audit",
+        "--job-dir", "./data/jobs", "--output-dir", str(tmp_path / "out"),
+        "overall", "--num-apps", "3",
+    ])
+
+
+def test_audit_does_not_perturb_metrics(meta):
+    """The observer-based audit is a pure observer: identical sim_time and
+    metrics with and without --audit."""
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.sched.policies import CostAwarePolicy
+
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(10)
+    trace = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def run(audit):
+        s = ExperimentRun(
+            "aud", cluster, CostAwarePolicy(sort_tasks=True, sort_hosts=True),
+            trace, n_apps=10, seed=3, audit=audit,
+        ).run()
+        return (s["sim_time"], s["avg_runtime"], s["egress_cost"])
+
+    assert run(False) == run(True)
+
+
+def test_audit_tolerates_in_flight_aborts(meta):
+    """Between a host failure and the abort delivery, resident tasks with a
+    triggered abort are a legitimate transient, not a violation."""
+    env = Environment()
+    z = meta.zones[0]
+    host = Host(env, 8, 8192, 100, 1, locality=z)
+    cluster = Cluster(env, hosts=[host], storage=[Storage(env, z)], meta=meta,
+                      route_mode="meta", seed=0)
+    app = Application("a", [TaskGroup("g", cpus=1, mem=64, runtime=5)])
+    task = app.groups[0].materialize_tasks()[0]
+    host._tasks.add(task)
+    host._aborts[task] = env.event()
+    host._aborts[task].succeed()  # abort fired, delivery pending
+    host.up = False
+    assert audit_cluster(cluster) == []
