@@ -254,6 +254,18 @@ def _run_grid(specs: List[RunSpec], workers: int):
     specs = pending
     import multiprocessing as mp
 
+    def _join(procs):
+        failed = []
+        for q in procs:
+            q.join()
+            if q.exitcode != 0:
+                failed.append(f"{q.name} (exit {q.exitcode})")
+        if failed:
+            # A worker died — e.g. an --audit invariant violation.  The
+            # abort contract must hold for parallel sweeps exactly as it
+            # does sequentially, not vanish into an ignored exitcode.
+            raise RuntimeError("worker run(s) failed: " + ", ".join(failed))
+
     active = []
     for spec in specs:
         p = mp.Process(
@@ -262,11 +274,9 @@ def _run_grid(specs: List[RunSpec], workers: int):
         p.start()
         active.append(p)
         if len(active) >= workers:
-            for q in active:
-                q.join()
+            _join(active)
             active = []
-    for q in active:
-        q.join()
+    _join(active)
 
 
 def _cluster_config(args) -> ClusterConfig:

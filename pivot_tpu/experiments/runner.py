@@ -144,14 +144,9 @@ class ExperimentRun(LogMixin):
             # The periodic observer throttles to one audit per interval;
             # a final full check closes the last window so corruption
             # arising near event exhaustion cannot ship silently.
-            from pivot_tpu.infra.audit import AuditError, audit_cluster
+            from pivot_tpu.infra import audit
 
-            violations = audit_cluster(cluster)
-            if violations:
-                raise AuditError(
-                    f"final state corrupted after {self.label}:\n  "
-                    + "\n  ".join(violations)
-                )
+            audit.check(cluster, f"final state after {self.label}")
 
         apps = schedule.apps
         runtimes = [a.end_time - a.start_time for a in apps]

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import List
 
-__all__ = ["AuditError", "audit_cluster", "start_periodic_audit"]
+__all__ = ["AuditError", "audit_cluster", "check", "start_periodic_audit"]
 
 #: Relative tolerance for float accounting (fractional trace demands
 #: accumulate rounding on acquire/release).
@@ -42,6 +42,18 @@ class AuditError(AssertionError):
 
 def _close(a: float, b: float, scale: float) -> bool:
     return abs(a - b) <= _RTOL * max(scale, 1.0)
+
+
+def check(cluster, context: str) -> None:
+    """Audit and raise :class:`AuditError` (with ``context`` in the
+    message) on any violation — the single raise path shared by the
+    periodic observer and end-of-run checks."""
+    violations = audit_cluster(cluster)
+    if violations:
+        raise AuditError(
+            f"simulation state corrupted ({context}):\n  "
+            + "\n  ".join(violations)
+        )
 
 
 def audit_cluster(cluster) -> List[str]:
@@ -117,11 +129,6 @@ def start_periodic_audit(cluster, period: float = 5.0) -> None:
         if env.now - last[0] < period:
             return
         last[0] = env.now
-        violations = audit_cluster(cluster)
-        if violations:
-            raise AuditError(
-                f"[t={env.now:.3f}] simulation state corrupted:\n  "
-                + "\n  ".join(violations)
-            )
+        check(cluster, f"t={env.now:.3f}")
 
     env.add_step_observer(_observe)

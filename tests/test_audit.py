@@ -142,3 +142,18 @@ def test_audit_tolerates_in_flight_aborts(meta):
     host._aborts[task].succeed()  # abort fired, delivery pending
     host.up = False
     assert audit_cluster(cluster) == []
+
+
+def test_worker_failure_propagates(tmp_path):
+    """A worker process that dies (e.g. audit abort) must fail the sweep,
+    not vanish into an ignored exitcode."""
+    from pivot_tpu.experiments import cli
+    from pivot_tpu.utils.config import ClusterConfig, PolicyConfig
+
+    bad = cli.RunSpec(
+        ClusterConfig(n_hosts=4), PolicyConfig(name="first-fit"),
+        trace="/nonexistent/trace.npz", data_dir=str(tmp_path / "d"),
+        n_apps=2, scale_factor=1000.0, seed=0,
+    )
+    with pytest.raises(RuntimeError, match="worker run\\(s\\) failed"):
+        cli._run_grid([bad], workers=2)
