@@ -61,6 +61,14 @@ class EnsembleWorkload(NamedTuple):
     Built from an :class:`pivot_tpu.workload.Application` (or several) via
     :func:`EnsembleWorkload.from_applications`; every task-group instance
     becomes one row.
+
+    Alongside the instance-level ``pred`` matrix (used for the [T]-vector
+    readiness matvec), the workload carries its **group structure** —
+    instances of a group share output size and predecessor groups, so
+    transfer delays, anchor votes, and egress cost all reduce *exactly*
+    to [G, Z]-sized tensors via matmuls.  Without this, those quantities
+    need per-replica [T, T] products: at T≈3.6k and 1024 replicas that is
+    a 55 GB allocation — 3× the chip's HBM.
     """
 
     demands: jax.Array  # [T, 4]
@@ -68,10 +76,18 @@ class EnsembleWorkload(NamedTuple):
     output_size: jax.Array  # [T]
     arrival: jax.Array  # [T] submission time of the owning app
     pred: jax.Array  # [T, T] f32 — pred[i, p] = 1 iff p precedes i
+    group_of: jax.Array  # [T] i32 — owning group index per instance
+    group_onehot: jax.Array  # [T, G] f32 — one_hot(group_of)
+    pred_group: jax.Array  # [G, G] f32 — group-level adjacency
+    out_group: jax.Array  # [G] per-group output size (MB)
 
     @property
     def n_tasks(self) -> int:
         return self.runtime.shape[0]
+
+    @property
+    def n_groups(self) -> int:
+        return self.out_group.shape[0]
 
     @classmethod
     def from_applications(cls, apps, arrivals=None, dtype=jnp.float32):
@@ -82,35 +98,52 @@ class EnsembleWorkload(NamedTuple):
         for the DES's sampled 1/n-instance pulls,
         ``resources/__init__.py:263-267``).
         """
-        demands, runtime, output, arrival, spans = [], [], [], [], []
+        demands, runtime, output, arrival = [], [], [], []
+        group_of, out_group = [], []
         offset = 0
+        gi = 0
         edges = []
+        group_edges = []
         for ai, app in enumerate(apps):
             at = float(arrivals[ai]) if arrivals is not None else 0.0
             index = {}
             for g in app.groups:
-                index[g.id] = (offset, g.instances)
+                index[g.id] = (offset, g.instances, gi)
+                out_group.append(g.output_size)
                 for _ in range(g.instances):
                     demands.append([g.cpus, g.mem, g.disk, g.gpus])
                     runtime.append(g.runtime)
                     output.append(g.output_size)
                     arrival.append(at)
+                    group_of.append(gi)
                 offset += g.instances
+                gi += 1
             for g in app.groups:
-                gs, gn = index[g.id]
+                gs, gn, gg = index[g.id]
                 for dep in g.dependencies:
-                    ps, pn = index[dep]
+                    ps, pn, pg = index[dep]
                     edges.append(((gs, gn), (ps, pn)))
-        T = offset
+                    group_edges.append((gg, pg))
+        T, G = offset, gi
         pred = np.zeros((T, T), dtype=np.float32)
         for (gs, gn), (ps, pn) in edges:
             pred[gs : gs + gn, ps : ps + pn] = 1.0
+        pred_group = np.zeros((G, G), dtype=np.float32)
+        for gg, pg in group_edges:
+            pred_group[gg, pg] = 1.0
+        group_of_arr = np.asarray(group_of, dtype=np.int32)
+        group_onehot = np.zeros((T, G), dtype=np.float32)
+        group_onehot[np.arange(T), group_of_arr] = 1.0
         return cls(
             demands=jnp.asarray(np.array(demands), dtype=dtype),
             runtime=jnp.asarray(np.array(runtime), dtype=dtype),
             output_size=jnp.asarray(np.array(output), dtype=dtype),
             arrival=jnp.asarray(np.array(arrival), dtype=dtype),
             pred=jnp.asarray(pred, dtype=dtype),
+            group_of=jnp.asarray(group_of_arr),
+            group_onehot=jnp.asarray(group_onehot, dtype=dtype),
+            pred_group=jnp.asarray(pred_group, dtype=dtype),
+            out_group=jnp.asarray(np.array(out_group), dtype=dtype),
         )
 
 
@@ -166,6 +199,10 @@ def _rollout_segment(
     Z = topo.cost.shape[0]
     dtype = state.avail.dtype
     has_pred = jnp.sum(workload.pred, axis=1) > 0  # [T]
+    # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
+    cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
+    bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
+    inf = jnp.asarray(jnp.inf, dtype)
 
     def cond(carry):
         i, state = carry
@@ -190,39 +227,94 @@ def _rollout_segment(
         ready = (stage == _PENDING) & (arrival <= t) & (unfinished_preds == 0)
 
         # 3. Anchors: majority vote over predecessor placement zones
-        #    (one-hot matmul, ref cost_aware.py:45-58); roots use their
-        #    pre-drawn random storage zone.
+        #    (ref cost_aware.py:45-58); roots use their pre-drawn random
+        #    storage zone.  Group-wise: zc[g, z] counts group g's done
+        #    instances in zone z ([T,G]ᵀ@[T,Z] — MXU), and summing zc over
+        #    predecessor groups gives exactly the instance-level vote
+        #    counts without any per-replica [T, T] product.
         place_zone = topo.host_zone[jnp.clip(place, 0, H - 1)]
         placed_done = (stage == _DONE).astype(dtype)
         zone_onehot = jax.nn.one_hot(place_zone, Z, dtype=dtype) * placed_done[:, None]
-        votes = workload.pred @ zone_onehot  # [T, Z]
-        majority_zone = jnp.argmax(votes, axis=1).astype(jnp.int32)
+        zc = workload.group_onehot.T @ zone_onehot  # [G, Z] done-instance counts
+        votes_g = workload.pred_group @ zc  # [G, Z]
+        majority_zone = jnp.argmax(votes_g, axis=1).astype(jnp.int32)[
+            workload.group_of
+        ]
         anchor = jnp.where(has_pred, majority_zone, root_anchor)
 
-        # 4. Placement via the live scheduler's fused kernel.
-        placements, avail = cost_aware_kernel(
-            avail,
-            workload.demands,
-            ready,
-            jnp.ones(T, dtype=bool),  # every task is its own score group
-            anchor,
-            topo.cost,
-            topo.bw,
-            topo.host_zone,
-            jnp.zeros(H, dtype=jnp.int32),
-            bin_pack="first-fit",
-            sort_hosts=True,
-            host_decay=False,
+        # 4. Placement — same greedy cost-aware decision as the live
+        #    scheduler's fused kernel (first-fit, sorted hosts, per-task
+        #    score group), but the sequential chain is cut to the tasks
+        #    that can actually place this tick:
+        #      * availability only DECREASES within a tick (releases land
+        #        at tick boundaries), so a ready task with no strictly
+        #        fitting host at tick start can never place this tick —
+        #        it is excluded from the chain with placement −1, exactly
+        #        what its in-chain step would produce.  This is what keeps
+        #        saturated phases cheap, where thousands of tasks wait but
+        #        only a handful can land.
+        #      * the eligible tasks are compacted to the front (stable, so
+        #        index order — and therefore every placement — is
+        #        bit-identical to the full scan) and a bounded while_loop
+        #        runs max-over-replicas(n_eligible) steps instead of T.
+        fits_at_start = jnp.any(
+            jnp.all(avail[None, :, :] > workload.demands[:, None, :], axis=2),
+            axis=1,
+        )  # [T]
+        eligible = ready & fits_at_start
+        order = jnp.argsort(~eligible, stable=True)  # eligible first
+        n_ready = jnp.sum(eligible)
+        dem_p = workload.demands[order]
+        az_p = anchor[order]
+
+        def place_cond(c):
+            j, _avail, _pl = c
+            return j < n_ready
+
+        def place_body(c):
+            j, avail, pl = c
+            demand = dem_p[j]
+            score = cost_rt[az_p[j]] / (
+                jnp.sqrt(jnp.sum(avail * avail, axis=1)) * bw_rt[az_p[j]]
+            )
+            fit = jnp.all(avail > demand[None, :], axis=1)  # strict, ref :124
+            h = jnp.argmin(jnp.where(fit, score, inf))
+            ok = jnp.any(fit)
+            delta = jnp.where(ok, demand, jnp.zeros_like(demand))
+            avail = avail.at[h].add(-delta)
+            pl = pl.at[order[j]].set(jnp.where(ok, h, -1).astype(jnp.int32))
+            return j + 1, avail, pl
+
+        _, avail, placements = lax.while_loop(
+            place_cond,
+            place_body,
+            (
+                jnp.asarray(0, jnp.int32),
+                avail,
+                jnp.full((T,), -1, dtype=jnp.int32),
+            ),
         )
         placed = placements >= 0
 
-        # 5. Transfer estimate: max over predecessors of size / bw.
+        # 5. Transfer estimate: max over predecessor instances of
+        #    size / bw(src zone → dst zone).  All instances of a producer
+        #    group share one output size, so the max reduces exactly to
+        #    zone *presence* per group: GD[g, z] = out_g × max over source
+        #    zones s with a done g-instance of 1/bw[s, z]  ([G, Z]), then
+        #    CD[c, z] = max over c's predecessor groups of GD ([G, Z] via
+        #    a short lax.map over the Z≈31 zones), gathered per task.
+        inv_bw = jnp.where(topo.bw > 0, 1.0 / topo.bw, 0.0)  # [Z, Z]
+        presence = (zc > 0).astype(dtype)  # [G, Z]
+        GD = (
+            jnp.max(presence[:, :, None] * inv_bw[None, :, :], axis=1)
+            * workload.out_group[:, None]
+        )  # [G, Z]
+        CD = lax.map(
+            lambda col: jnp.max(workload.pred_group * col[None, :], axis=1),
+            GD.T,
+        ).T  # [G, Z] max over predecessor groups, zone column at a time
         new_zone = topo.host_zone[jnp.clip(placements, 0, H - 1)]
-        bw_rows = topo.bw[place_zone[None, :], new_zone[:, None]]  # [T, T]
-        xfer = workload.pred * jnp.where(
-            bw_rows > 0, workload.output_size[None, :] / bw_rows, 0.0
-        )
-        xfer_delay = jnp.max(xfer, axis=1)  # [T]
+        xfer_delay = CD[workload.group_of, new_zone]  # [T]
 
         stage = jnp.where(placed, _RUNNING, stage)
         place = jnp.where(placed, placements, place)
@@ -245,11 +337,21 @@ def _finalize(
     # Egress: Σ_edges cost(zone_p → zone_i) · output_mb(p) / 8000, counting
     # only edges whose BOTH endpoints were actually placed (an unplaced
     # consumer at the horizon must not be billed as if on host 0).
+    # Group-wise: with zcp[g, s] = placed instances of g in zone s, the sum
+    # over instance pairs of one group edge (g → c) is exactly
+    # (zcp @ cost @ zcpᵀ)[g, c] — three small matmuls instead of a
+    # per-replica [T, T] edge tensor.
     pz = topo.host_zone[jnp.clip(place, 0, H - 1)]
     placed = (place >= 0).astype(dtype)
-    edge_cost = topo.cost[pz[None, :], pz[:, None]]  # [T, T] p→i
-    edge_live = workload.pred * placed[:, None] * placed[None, :]
-    egress = jnp.sum(edge_live * edge_cost * workload.output_size[None, :]) / 8000.0
+    Z = topo.cost.shape[0]
+    zcp = workload.group_onehot.T @ (
+        jax.nn.one_hot(pz, Z, dtype=dtype) * placed[:, None]
+    )  # [G, Z] placed-instance counts
+    pair_cost = zcp @ topo.cost @ zcp.T  # [G, G]: (producer g, consumer c)
+    egress = (
+        jnp.sum(workload.pred_group.T * pair_cost * workload.out_group[:, None])
+        / 8000.0
+    )
     return RolloutResult(
         makespan=makespan,
         egress_cost=egress,
@@ -420,7 +522,7 @@ def rollout_checkpointed(
     workload: EnsembleWorkload,
     topo: DeviceTopology,
     storage_zones,
-    checkpoint_path: str,
+    checkpoint_path: Optional[str],
     n_replicas: int = 64,
     tick: float = 5.0,
     max_ticks: int = 512,
@@ -439,6 +541,12 @@ def rollout_checkpointed(
     Monte-Carlo draws are a pure function of ``key`` (regenerated, not
     stored) and segmentation does not change the tick sequence.
 
+    ``checkpoint_path=None`` runs the same segmented schedule without
+    touching disk — useful in its own right because each segment is one
+    bounded device execution (a monolithic multi-thousand-tick while_loop
+    is a minutes-long single execution, which remote-device transports
+    may kill).
+
     A config fingerprint stored alongside the state refuses to resume a
     checkpoint produced by different arguments.  The reference has no
     analog: its runs are one-shot to event exhaustion
@@ -454,7 +562,7 @@ def rollout_checkpointed(
 
     ticks_done = 0
     state = None
-    if resume and os.path.exists(checkpoint_path):
+    if checkpoint_path and resume and os.path.exists(checkpoint_path):
         with np.load(checkpoint_path, allow_pickle=False) as ckpt:
             if str(ckpt["fingerprint"]) == fp:
                 state = RolloutState(
@@ -491,17 +599,18 @@ def rollout_checkpointed(
         )
         jax.block_until_ready(state)
         ticks_done += seg
-        tmp = checkpoint_path + ".tmp.npz"  # np.savez keeps an .npz suffix
-        np.savez(
-            tmp,
-            fingerprint=fp,
-            ticks_done=ticks_done,
-            t=np.asarray(state.t),
-            stage=np.asarray(state.stage),
-            finish=np.asarray(state.finish),
-            place=np.asarray(state.place),
-            avail=np.asarray(state.avail),
-        )
-        os.replace(tmp, checkpoint_path)
+        if checkpoint_path:
+            tmp = checkpoint_path + ".tmp.npz"  # np.savez keeps an .npz suffix
+            np.savez(
+                tmp,
+                fingerprint=fp,
+                ticks_done=ticks_done,
+                t=np.asarray(state.t),
+                stage=np.asarray(state.stage),
+                finish=np.asarray(state.finish),
+                place=np.asarray(state.place),
+                avail=np.asarray(state.avail),
+            )
+            os.replace(tmp, checkpoint_path)
 
     return jax.vmap(lambda s: _finalize(s, workload, topo))(state)

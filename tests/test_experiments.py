@@ -186,3 +186,43 @@ def test_dataflow_record():
     assert hash(d) == hash(Dataflow("a", "b", 128.0))
     assert d != Dataflow("a", "b", 64.0)
     assert "a -> b" in repr(d)
+
+
+def test_cli_ensemble_end_to_end(tmp_path):
+    """The ensemble subcommand runs a trace workload as a sharded
+    Monte-Carlo rollout and writes summary + arrays."""
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    summary = cli.run_ensemble(cli.parse_args([
+        "--num-hosts", "16", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "--seed", "2",
+        "ensemble", "--num-apps", "4", "--replicas", "16",
+        "--max-ticks", "512",
+    ]))
+    assert summary["replicas"] == 16
+    assert summary["unfinished_max"] == 0
+    assert summary["makespan_p5"] <= summary["makespan_p95"]
+    (run_dir,) = (out / "ensemble").iterdir()
+    import numpy as np
+
+    arrs = np.load(run_dir / "rollout.npz")
+    assert arrs["makespan"].shape == (16,)
+    assert (arrs["placement"] >= 0).all()
+
+
+def test_cli_ensemble_checkpoint(tmp_path):
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    ckpt = str(tmp_path / "roll.npz")
+    s1 = cli.run_ensemble(cli.parse_args([
+        "--num-hosts", "16", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "--seed", "2",
+        "ensemble", "--num-apps", "3", "--replicas", "8",
+        "--max-ticks", "256", "--checkpoint", ckpt,
+    ]))
+    assert s1["unfinished_max"] == 0
+    import os
+
+    assert os.path.exists(ckpt)
