@@ -286,6 +286,11 @@ class Host(Node):
         if not self.up:
             return
         self.up = False
+        # Fast-executor residents abort synchronously; process-executor
+        # residents abort via their raced events.  At most one path has
+        # live entries — they are mutually exclusive per cluster.
+        if self.cluster is not None and self.cluster.executor is not None:
+            self.cluster.executor.abort_host(self)
         for abort in list(self._aborts.values()):
             if not abort.triggered:
                 abort.succeed()
@@ -365,6 +370,7 @@ class Cluster(LogMixin):
         route_mode: str = "local",
         seed: Optional[int] = None,
         network_backend: str = "python",
+        executor_backend: str = "fast",
     ):
         """``route_mode``: 'local' gives same-host loopback routes LOCAL_BW
         and meters only host↔storage pairs (generator behavior, ref
@@ -374,11 +380,19 @@ class Cluster(LogMixin):
         ``network_backend``: 'python' serves chunks on the event kernel;
         'native' runs the whole chunk-service loop in the C++ co-simulator
         (``pivot_tpu.native``) — same completion times, far fewer events.
+
+        ``executor_backend``: 'fast' drives each task execution with bare
+        callbacks (``infra.executor.FastExecutor``); 'process' mirrors the
+        reference's one-process-per-execution shape (``Host.execute``
+        driven by ``_execute_task``).  Bit-identical trajectories — the
+        parity suite in ``tests/test_executor.py`` holds both to it.
         """
         if route_mode not in ("local", "meta"):
             raise ValueError(f"unknown route_mode {route_mode!r}")
         if network_backend not in ("python", "native"):
             raise ValueError(f"unknown network_backend {network_backend!r}")
+        if executor_backend not in ("process", "fast"):
+            raise ValueError(f"unknown executor_backend {executor_backend!r}")
         self.env = env
         self.meta = meta if meta is not None else ResourceMetadata()
         self.meter = meter
@@ -391,6 +405,12 @@ class Cluster(LogMixin):
             self.net_engine = NativeNetworkEngine(env)
             if meter is not None:
                 meter.add_native_source(self.net_engine)
+        self.executor_backend = executor_backend
+        self.executor = None
+        if executor_backend == "fast":
+            from pivot_tpu.infra.executor import FastExecutor
+
+            self.executor = FastExecutor(self)
         self.seed = seed
         self.rng = np.random.default_rng(seed)
         # Python RNG for the per-task predecessor sampling hot path (each
@@ -477,6 +497,7 @@ class Cluster(LogMixin):
         meter: Optional[Meter],
         seed: Optional[int] = None,
         network_backend: Optional[str] = None,
+        executor_backend: Optional[str] = None,
     ) -> "Cluster":
         hosts = [h.clone(env, meter) for h in self._host_list]
         storage = [s.clone(env) for s in self._storage.values()]
@@ -489,6 +510,7 @@ class Cluster(LogMixin):
             route_mode="meta",
             seed=self.seed if seed is None else seed,
             network_backend=network_backend or self.network_backend,
+            executor_backend=executor_backend or self.executor_backend,
         )
 
     def start(self) -> None:
@@ -497,13 +519,28 @@ class Cluster(LogMixin):
     def _dispatch_loop(self):
         while True:
             task = yield self.dispatch_q.get()
-            if not isinstance(task, Task):
-                self.logger.error("dispatched non-task item: %r", task)
-                continue
-            host = self._hosts.get(task.placement)
-            if host is None:
-                self.logger.error("unrecognized host %r", task.placement)
-                continue
+            self._dispatch_one(task)
+            # Same-instant batching: items put synchronously with the one
+            # just handed off start in FIFO order without paying one
+            # get-event round-trip each.
+            for item in self.dispatch_q.drain():
+                self._dispatch_one(item)
+
+    def _dispatch_one(self, task) -> None:
+        if not isinstance(task, Task):
+            self.logger.error("dispatched non-task item: %r", task)
+            return
+        host = self._hosts.get(task.placement)
+        if host is None:
+            self.logger.error("unrecognized host %r", task.placement)
+            return
+        if self.executor is not None:
+            # One-hop deferral mirroring the process executor's bootstrap
+            # event: admission/check-in must get a fresh seq here so
+            # same-instant conclusions (older-seq events) release first.
+            executor = self.executor
+            self.env.schedule_callback(0.0, lambda: executor.dispatch(task, host))
+        else:
             self.env.process(self._execute_task(task, host))
 
     def _execute_task(self, task: Task, host: Host):

@@ -66,9 +66,19 @@ def audit_cluster(cluster) -> List[str]:
     for hi, host in enumerate(cluster.hosts):
         r = host.resource
         if not host.up:
+            # In-flight completions that legitimately outlive the crash:
+            # process executor — aborts already triggered in Host._aborts;
+            # fast executor — due-completion tie-breaks kept resident by
+            # abort_host for their one-hop conclusion (executor.py).
+            fast_live = (
+                {t for t, _staged in cluster.executor.resident(host)}
+                if cluster.executor is not None
+                else set()
+            )
             stuck = [
                 t for t in host._tasks
-                if not (t in host._aborts and host._aborts[t].triggered)
+                if t not in fast_live
+                and not (t in host._aborts and host._aborts[t].triggered)
             ]
             if stuck:
                 violations.append(

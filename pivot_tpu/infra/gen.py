@@ -35,6 +35,7 @@ class RandomClusterGenerator(LogMixin):
         meter: Optional[Meter] = None,
         seed: Optional[int] = None,
         network_backend: str = "python",
+        executor_backend: str = "fast",
     ):
         assert 0 < cpus[0] <= cpus[1]
         assert 0 < mem[0] <= mem[1]
@@ -45,6 +46,7 @@ class RandomClusterGenerator(LogMixin):
         self.meta = meta if meta is not None else ResourceMetadata()
         self.meter = meter
         self.network_backend = network_backend
+        self.executor_backend = executor_backend
         self.rng = np.random.default_rng(seed)
 
     def _sample_shape(self) -> Tuple[int, int, int, int]:
@@ -95,4 +97,5 @@ class RandomClusterGenerator(LogMixin):
             route_mode="local",
             seed=seed,
             network_backend=self.network_backend,
+            executor_backend=self.executor_backend,
         )

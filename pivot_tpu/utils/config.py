@@ -31,6 +31,9 @@ class ClusterConfig:
     #: 'python' serves network chunks on the event kernel; 'native' runs the
     #: chunk-service loop in the C++ co-simulator (pivot_tpu.native).
     network: str = "python"
+    #: 'fast' drives executions with bare callbacks; 'process' mirrors the
+    #: reference's one-process-per-execution shape.  Bit-identical runs.
+    executor: str = "fast"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,6 +92,7 @@ def build_cluster(cfg: ClusterConfig, meta=None):
         meta=meta,
         seed=cfg.seed,
         network_backend=cfg.network,
+        executor_backend=cfg.executor,
     )
     return gen.generate(cfg.n_hosts, uniform=cfg.uniform)
 

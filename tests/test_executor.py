@@ -1,0 +1,247 @@
+"""Parity + fault tests: FastExecutor (callback executor) vs process executor.
+
+The fast executor (``pivot_tpu/infra/executor.py``) must reproduce the
+process executor's trajectories **bit-for-bit** on fault-free runs — same
+completion times, same RNG draw order, same meter metrics — while driving
+each execution with bare callbacks instead of a generator process.
+"""
+
+import numpy as np
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.experiments.runner import ExperimentRun
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.faults import FaultInjector
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.sched import GlobalScheduler
+from pivot_tpu.sched.policies import CostAwarePolicy, OpportunisticPolicy
+from pivot_tpu.utils.config import (
+    ClusterConfig,
+    HostShape,
+    PolicyConfig,
+    build_cluster,
+    make_policy,
+)
+from pivot_tpu.workload import Application, TaskGroup
+
+TRACE = "data/jobs/jobs-5000-200-172800-259200.npz"
+
+
+def run_trace(executor, policy_cfg, *, network="python", n_apps=25, seed=3):
+    cfg = ClusterConfig(
+        n_hosts=20,
+        shape=HostShape(16, 128 * 1024, 100, 1),
+        seed=seed,
+        network=network,
+        executor=executor,
+    )
+    cluster = build_cluster(cfg)
+    policy = make_policy(policy_cfg)
+    return ExperimentRun(
+        f"exec-parity-{executor}-{network}", cluster, policy, TRACE,
+        n_apps=n_apps, seed=seed,
+    ).run()
+
+
+METRICS = ("avg_runtime", "egress_cost", "cum_instance_hours",
+           "avg_congestion_delay", "sim_time")
+
+
+@pytest.mark.parametrize(
+    "policy_cfg",
+    [
+        PolicyConfig(name="opportunistic", device="numpy"),
+        PolicyConfig(name="first-fit", device="numpy", decreasing=True),
+        PolicyConfig(
+            name="cost-aware", device="numpy",
+            bin_pack="first-fit", sort_tasks=True, sort_hosts=True,
+        ),
+    ],
+    ids=["opportunistic", "vbp", "cost-aware"],
+)
+def test_full_sim_bit_parity(policy_cfg):
+    """Every summary metric is bit-identical across executors: identical
+    event trajectories, identical RNG draw order, identical float ops."""
+    s_proc = run_trace("process", policy_cfg)
+    s_fast = run_trace("fast", policy_cfg)
+    for m in METRICS:
+        assert s_proc[m] == s_fast[m], (m, s_proc[m], s_fast[m])
+
+
+def test_full_sim_bit_parity_native_network():
+    """fast executor composes with the C++ network engine."""
+    pytest.importorskip("pivot_tpu.native")
+    from pivot_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    cfg = PolicyConfig(
+        name="cost-aware", device="numpy",
+        bin_pack="first-fit", sort_tasks=True, sort_hosts=True,
+    )
+    s_proc = run_trace("process", cfg, network="native")
+    s_fast = run_trace("fast", cfg, network="native")
+    for m in METRICS:
+        assert s_proc[m] == s_fast[m], (m, s_proc[m], s_fast[m])
+
+
+def _tiny_cluster(env, meter=None, n_hosts=2, cpus=2.0, executor="fast"):
+    meta = ResourceMetadata(seed=0)
+    zones = meta.zones
+    hosts = [
+        Host(env, cpus, 1024, 100, 1, locality=zones[i % 2], meter=meter, id=f"h{i}")
+        for i in range(n_hosts)
+    ]
+    storage = [Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)]
+    return Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, meter=meter,
+        route_mode="meta", seed=0, executor_backend=executor,
+    )
+
+
+def _chain_app(runtime=10.0, output=500.0, instances=2):
+    return Application(
+        "app",
+        [
+            TaskGroup("a", cpus=1, mem=64, runtime=runtime,
+                      output_size=output, instances=instances),
+            TaskGroup("b", cpus=1, mem=64, runtime=runtime,
+                      dependencies=["a"], instances=instances),
+        ],
+    )
+
+
+def _run_sched(env, cluster, app, seed=0):
+    meter = cluster.meter
+    sched = GlobalScheduler(
+        env, cluster, OpportunisticPolicy(mode="naive"), seed=seed, meter=meter
+    )
+    cluster.start()
+    sched.start()
+    sched.submit(app)
+    sched.stop()
+    env.run()
+    return sched
+
+
+def test_admission_failure_retries_until_capacity():
+    """More replicas than CPU slots: rejected tasks retry and all finish."""
+    env = Environment()
+    meta = ResourceMetadata(seed=0)
+    meter = Meter(env, meta)
+    cluster = _tiny_cluster(env, meter, n_hosts=1, cpus=2.0)
+    app = Application(
+        "burst", [TaskGroup("a", cpus=1, mem=1, runtime=5.0, instances=6)]
+    )
+    _run_sched(env, cluster, app)
+    assert app.is_finished
+    # 6 one-cpu tasks on a 2-cpu host: three full waves.
+    assert app.end_time - app.start_time >= 3 * 5.0
+    h = cluster.hosts[0]
+    assert h.n_tasks == 0
+    assert h.resource.cpus == h.resource.t_cpus
+
+
+def test_fault_mid_compute_retries_elsewhere():
+    env = Environment()
+    meta = ResourceMetadata(seed=0)
+    meter = Meter(env, meta)
+    cluster = _tiny_cluster(env, meter, n_hosts=2, cpus=8.0)
+    app = Application(
+        "faulty", [TaskGroup("a", cpus=1, mem=1, runtime=50.0, instances=4)]
+    )
+    inj = FaultInjector(cluster, seed=1)
+    inj.fail_host("h0", at=10.0)  # mid-compute, never recovers
+    _run_sched(env, cluster, app)
+    assert app.is_finished
+    assert not cluster.get_host("h0").up
+    # Survivor host is clean.
+    h1 = cluster.get_host("h1")
+    assert h1.n_tasks == 0 and h1.resource.cpus == h1.resource.t_cpus
+    # Fast executor has no residue for the dead host.
+    assert cluster.executor.resident(cluster.get_host("h0")) == []
+    # Meter intervals all closed (instance-hours finite and positive).
+    assert meter.cumulative_instance_hours > 0
+
+
+def test_fault_mid_staging_cancels_transfers():
+    """Crash while pulling inputs: queued transfers are cancelled so the
+    route drains, and the task reschedules after recovery."""
+    env = Environment()
+    meta = ResourceMetadata(seed=0)
+    meter = Meter(env, meta)
+    cluster = _tiny_cluster(env, meter, n_hosts=2, cpus=8.0)
+    app = _chain_app(runtime=5.0, output=50_000.0, instances=1)  # slow pull
+    inj = FaultInjector(cluster, seed=1)
+    # Stage "b" starts after "a" (~>=5s); crash both-capable host later,
+    # recover quickly so the retry has somewhere to land.
+    inj.fail_host("h0", at=12.0, duration=20.0)
+    inj.fail_host("h1", at=12.0, duration=20.0)
+    _run_sched(env, cluster, app)
+    assert app.is_finished
+    for h in cluster.hosts:
+        assert cluster.executor.resident(h) == []
+        assert h.n_tasks == 0
+
+
+def test_crash_at_exact_completion_instant_with_audit():
+    """A host failing at the exact instant a resident task's completion is
+    due: the completion wins the tie (matching the process executor's
+    timeout-vs-abort race), and the periodic invariant auditor accepts the
+    one-hop window where the due task is still resident on the down host."""
+    from pivot_tpu.infra.audit import start_periodic_audit
+
+    env = Environment()
+    meta = ResourceMetadata(seed=0)
+    meter = Meter(env, meta)
+    cluster = _tiny_cluster(env, meter, n_hosts=1, cpus=4.0)
+    app = Application("tie", [TaskGroup("a", cpus=1, mem=1, runtime=10.0)])
+    inj = FaultInjector(cluster, seed=0)
+    # First dispatch lands at the t=5 tick (the t=0 tick precedes the
+    # local pump), so the completion is due at exactly 15.0 — the crash
+    # instant.  Recovery bounds the run if the tie were resolved wrong.
+    # Audit every event (period=0 throttles nothing).
+    inj.fail_host("h0", at=15.0, duration=30.0)
+    start_periodic_audit(cluster, period=0.0)
+    _run_sched(env, cluster, app)
+    assert app.is_finished
+    # Completion won the tie: finished at the crash instant, no retry
+    # (a retry could land no earlier than recovery at 45 + runtime).
+    assert app.end_time == 15.0
+
+
+def test_resident_introspection():
+    env = Environment()
+    cluster = _tiny_cluster(env, None, n_hosts=1, cpus=4.0)
+    app = Application("r", [TaskGroup("a", cpus=1, mem=1, runtime=30.0, instances=2)])
+    sched = GlobalScheduler(env, cluster, OpportunisticPolicy(mode="naive"), seed=0)
+    cluster.start()
+    sched.start()
+    sched.submit(app)
+    sched.stop()
+    env.run(until=10.0)
+    h = cluster.hosts[0]
+    live = cluster.executor.resident(h)
+    assert len(live) == 2
+    assert all(staged for _t, staged in live)  # sources have no preds
+    assert h.n_tasks == 2
+    env.run()
+    assert cluster.executor.resident(h) == []
+
+
+def test_cluster_rejects_unknown_executor():
+    with pytest.raises(ValueError):
+        Cluster(Environment(), executor_backend="bogus")
+
+
+def test_clone_preserves_executor_backend():
+    env = Environment()
+    c = _tiny_cluster(env, None, executor="process")
+    assert c.executor is None
+    env2 = Environment()
+    c2 = c.clone(env2, None)
+    assert c2.executor is None and c2.executor_backend == "process"
+    c3 = c.clone(Environment(), None, executor_backend="fast")
+    assert c3.executor is not None
