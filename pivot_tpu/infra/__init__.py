@@ -84,7 +84,11 @@ class HostResource:
     by ``Cluster.availability_matrix``.
     """
 
-    __slots__ = ("t_cpus", "t_mem", "t_disk", "t_gpus", "cpus", "mem", "disk", "gpus")
+    __slots__ = (
+        "t_cpus", "t_mem", "t_disk", "t_gpus",
+        "cpus", "mem", "disk", "gpus",
+        "_cache",
+    )
 
     def __init__(self, cpus: float, mem: float, disk: float, gpus: float):
         self.t_cpus, self.t_mem, self.t_disk, self.t_gpus = (
@@ -94,6 +98,18 @@ class HostResource:
             float(gpus),
         )
         self.cpus, self.mem, self.disk, self.gpus = self.t_cpus, self.t_mem, self.t_disk, self.t_gpus
+        # Optional write-through row of the owning cluster's [H,4]
+        # availability cache (``Cluster.availability_matrix``): scalars
+        # stay authoritative, the row mirrors them after every mutation.
+        self._cache = None
+
+    def _sync_cache(self) -> None:
+        c = self._cache
+        if c is not None:
+            c[0] = self.cpus
+            c[1] = self.mem
+            c[2] = self.disk
+            c[3] = self.gpus
 
     @property
     def totals(self) -> np.ndarray:
@@ -124,6 +140,7 @@ class HostResource:
         self.mem -= mem
         self.disk -= disk
         self.gpus -= gpus
+        self._sync_cache()
         return True
 
     def reset(self) -> None:
@@ -131,6 +148,7 @@ class HostResource:
         self.cpus, self.mem, self.disk, self.gpus = (
             self.t_cpus, self.t_mem, self.t_disk, self.t_gpus,
         )
+        self._sync_cache()
 
     def release(self, cpus: float, mem: float, disk: float, gpus: float) -> None:
         """Refund, clamped per-dimension to what is actually in use (ref
@@ -146,6 +164,7 @@ class HostResource:
             self.disk += min(disk, max(self.t_disk - self.disk, 0.0))
         if gpus > 0:
             self.gpus += min(gpus, max(self.t_gpus - self.gpus, 0.0))
+        self._sync_cache()
 
 
 class Storage(Node):
@@ -418,6 +437,9 @@ class Cluster(LogMixin):
         self.pyrng = random.Random(seed)
         self._hosts: Dict[str, Host] = {}
         self._host_list: List[Host] = []
+        # Write-through [H,4] f64 availability mirror; (re)built lazily by
+        # ``availability_matrix`` and invalidated when membership changes.
+        self._avail_cache: Optional[np.ndarray] = None
         self._storage: Dict[str, Storage] = {}
         self._storage_by_locality: Dict[Locality, Storage] = {}
         self._routes: Dict[Tuple[str, str], Route] = {}
@@ -443,6 +465,7 @@ class Cluster(LogMixin):
         host.cluster = self
         self._hosts[host.id] = host
         self._host_list.append(host)
+        self._avail_cache = None  # membership changed; rebuild lazily
 
     def add_storage(self, storage: Storage) -> None:
         storage.cluster = self
@@ -559,16 +582,16 @@ class Cluster(LogMixin):
         The sentinel is finite so downstream residual/norm arithmetic in
         the f32 kernels stays finite."""
         hosts = self._host_list
-        out = np.empty((len(hosts), 4), dtype=dtype)
+        if self._avail_cache is None or len(self._avail_cache) != len(hosts):
+            cache = np.empty((len(hosts), 4), dtype=np.float64)
+            for i, h in enumerate(hosts):
+                h.resource._cache = cache[i]
+                h.resource._sync_cache()
+            self._avail_cache = cache
+        out = self._avail_cache.astype(dtype, copy=True)
         for i, h in enumerate(hosts):
             if not h.up:
                 out[i] = -1.0
-                continue
-            r = h.resource
-            out[i, 0] = r.cpus
-            out[i, 1] = r.mem
-            out[i, 2] = r.disk
-            out[i, 3] = r.gpus
         return out
 
     def totals_matrix(self, dtype=np.float64) -> np.ndarray:

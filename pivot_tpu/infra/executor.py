@@ -185,10 +185,16 @@ class FastExecutor(LogMixin):
         host.resource.release(group.cpus, group.mem, group.disk, group.gpus)
         host._tasks.discard(task)
         live = self._resident.get(host.id)
-        if live:
+        if live is not None:
             live.pop(task, None)
+            if not live:
+                del self._resident[host.id]
         if host.meter:
             host.meter.host_check_out(host)
+        # Drop the staging graph: metered Transfers are retained as meter
+        # keys for the whole run and reach this _Exec via their done hooks;
+        # clearing the lists keeps the retained residue per transfer small.
+        ex.preds = ex.routes = ex.dones = ()
         self.cluster.notify_q.put((True, task))
 
     # -- faults ------------------------------------------------------------
@@ -210,6 +216,7 @@ class FastExecutor(LogMixin):
             host._tasks.discard(task)
             if host.meter:
                 host.meter.host_check_out(host)
+            ex.preds = ex.routes = ex.dones = ()
             self.cluster.notify_q.put((False, task))
 
     # -- introspection -----------------------------------------------------

@@ -34,8 +34,10 @@ class Meter(LogMixin):
         self.meta = meta
         # host -> list of [start] / [start, end] busy intervals
         self._host_intervals: Dict[object, List[list]] = defaultdict(list)
-        # route -> transfer_id -> list of [start, end, chunk_mb] service slots
-        self._route_slots: Dict[object, Dict[str, List[list]]] = defaultdict(dict)
+        # route -> transfer key -> list of [start, end, chunk_mb] service
+        # slots; keys are whatever ``route_check_in`` was handed (the Python
+        # fabric passes Transfer objects, identity-keyed).
+        self._route_slots: Dict[object, Dict[object, List[list]]] = defaultdict(dict)
         # host -> [(t, cpu_frac, mem_frac, disk_frac, gpu_frac)]
         self._usage: Dict[object, list] = defaultdict(list)
         self._data_transfers: List[dict] = []
@@ -135,11 +137,14 @@ class Meter(LogMixin):
         elif now > last[-1]:
             last[-1] = now
 
-    def route_check_in(self, route, transfer_id: str) -> None:
-        self._route_slots[route].setdefault(transfer_id, []).append([self.env.now])
+    def route_check_in(self, route, transfer) -> None:
+        """``transfer`` is any per-transfer key — the Python fabric passes
+        the Transfer object itself (identity-keyed: cheaper than minting
+        id strings on the chunk-service hot path)."""
+        self._route_slots[route].setdefault(transfer, []).append([self.env.now])
 
-    def route_check_out(self, route, transfer_id: str, chunk_mb: float) -> None:
-        self._route_slots[route][transfer_id][-1] += [self.env.now, chunk_mb]
+    def route_check_out(self, route, transfer, chunk_mb: float) -> None:
+        self._route_slots[route][transfer][-1] += [self.env.now, chunk_mb]
 
     def add_data_transfer(
         self,

@@ -23,7 +23,7 @@ from collections import deque
 from typing import Optional
 
 from pivot_tpu.des import Environment, Event
-from pivot_tpu.utils import LogMixin, fresh_id
+from pivot_tpu.utils import LogMixin
 
 __all__ = ["Route", "NativeRoute", "Transfer", "CHUNK_MB"]
 
@@ -34,12 +34,11 @@ CHUNK_MB = 1000.0
 class Transfer:
     """An in-flight data transfer on one route."""
 
-    __slots__ = ("id", "remaining_mb", "done", "cancelled")
+    __slots__ = ("remaining_mb", "done", "cancelled")
 
     def __init__(self, size_mb: float, done: Event):
         if size_mb <= 0:
             raise ValueError(f"transfer size must be > 0, got {size_mb}")
-        self.id = fresh_id("xfer")
         self.remaining_mb = float(size_mb)
         self.done = done
         self.cancelled = False
@@ -110,7 +109,7 @@ class Route(LogMixin):
         self._in_service = transfer
         chunk = min(transfer.remaining_mb, CHUNK_MB)
         if self.meter:
-            self.meter.route_check_in(self, transfer.id)
+            self.meter.route_check_in(self, transfer)
         service_time = chunk / self.bw if self.bw > 0 else 0.0
         self.env.schedule_callback(
             service_time, lambda: self._finish_chunk(transfer, chunk)
@@ -118,7 +117,7 @@ class Route(LogMixin):
 
     def _finish_chunk(self, transfer: Transfer, chunk: float) -> None:
         if self.meter:
-            self.meter.route_check_out(self, transfer.id, chunk)
+            self.meter.route_check_out(self, transfer, chunk)
         transfer.remaining_mb -= chunk
         if transfer.cancelled:
             pass  # dropped: no completion, no re-enqueue

@@ -311,12 +311,38 @@ class CostAwarePolicy(Policy):
                         placements[i] = h
                         break
         else:
+            # Gather once; placing at sorted position p only mutates row p,
+            # so the working copy stays exact with one row write per task.
+            avail_sorted = avail[order]
+            # Start-pointer for runs of identical demand vectors (instances
+            # of one task group, adjacent after the stable decreasing
+            # sort): rows before the previous hit were rejected against the
+            # same demand and have not changed since, so the scan resumes
+            # there — bit-identical placements, O(remaining) per task.
+            prev_d = None
+            start = 0
             for i in idxs:
-                mask = np.all(avail[order] > demands[i], axis=1)
+                d = demands[i]
+                if prev_d is None or not (
+                    d[0] == prev_d[0]
+                    and d[1] == prev_d[1]
+                    and d[2] == prev_d[2]
+                    and d[3] == prev_d[3]
+                ):
+                    start = 0
+                    prev_d = d
+                if start < 0:  # previous identical demand found no fit
+                    continue
+                mask = (avail_sorted[start:] > d).all(axis=1)
                 if mask.any():
-                    h = int(order[np.argmax(mask)])
-                    avail[h] -= demands[i]
+                    p = start + int(np.argmax(mask))
+                    h = int(order[p])
+                    avail[h] -= d
+                    avail_sorted[p] = avail[h]
                     placements[i] = h
+                    start = p
+                else:
+                    start = -1
 
     def _best_fit(
         self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
