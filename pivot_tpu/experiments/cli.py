@@ -215,6 +215,16 @@ def parse_args(argv=None):
     ens.add_argument("--checkpoint", default=None, metavar="NPZ",
                      help="segmented rollout with mid-flight "
                           "checkpoint/resume at this path")
+    ens.add_argument("--faults", type=int, default=0, metavar="N",
+                     help="per-replica random host crashes: each replica "
+                          "draws an independent N-crash schedule "
+                          "(resilience what-if ensemble)")
+    ens.add_argument("--fault-horizon", type=float, default=None,
+                     help="crash times drawn uniform in [0, horizon) "
+                          "(default: tick x max-ticks)")
+    ens.add_argument("--fault-mttr", type=float, default=None,
+                     help="mean outage duration (Exp-distributed); "
+                          "omit for permanent crashes")
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -384,6 +394,9 @@ def run_ensemble(args) -> dict:
         tick=args.tick,
         max_ticks=args.max_ticks,
         perturb=args.perturb,
+        n_faults=args.faults,
+        fault_horizon=args.fault_horizon,
+        mttr=args.fault_mttr,
     )
 
     wall0 = time.perf_counter()
@@ -413,6 +426,9 @@ def run_ensemble(args) -> dict:
         "n_hosts": args.n_hosts,
         "replicas": args.replicas,
         "perturb": args.perturb,
+        "faults": args.faults,
+        "fault_horizon": args.fault_horizon,
+        "fault_mttr": args.fault_mttr,
         "devices": len(jax.devices()),
         "makespan_mean": float(mk.mean()),
         "makespan_p5": float(np.percentile(mk, 5)),

@@ -28,7 +28,10 @@ the *ensemble estimator*, not the ground-truth DES; use
     output_mb / 8000`` (``resources/__init__.py:565-569``).
 
 Monte-Carlo axes: per-replica multiplicative jitter on task runtimes and
-arrivals, and independent random root anchors.
+arrivals, independent random root anchors, and — with ``n_faults > 0`` —
+independent per-replica host-crash/recovery schedules (resilience what-if
+ensembles; tick-resolution mirror of the DES fault model in
+``infra.faults``).
 """
 
 from __future__ import annotations
@@ -191,14 +194,32 @@ def _rollout_segment(
     topo: DeviceTopology,
     tick: float,
     n_ticks: int,
+    faults=None,  # optional ([F] i32 host, [F] fail_at, [F] recover_at)
+    totals=None,  # [H, 4] full capacity (fault recovery resets to this)
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
-    (stops early once every task is done)."""
+    (stops early once every task is done).
+
+    With ``faults``, each tick applies the crash/recovery schedule at tick
+    resolution, mirroring the DES fault semantics (``infra.faults`` +
+    ``FastExecutor.abort_host``): a crash in the window aborts the host's
+    running tasks back to PENDING with no capacity refund (they re-enter
+    the placement pass like the DES retry loop), a down host's rows carry
+    the −1 sentinel so no fit can select it, and recovery restores full
+    capacity.  Completions in the same tick window as the crash retire
+    first — the tick-resolution analog of the DES completion-wins tie.
+    """
     T = workload.n_tasks
     H = state.avail.shape[0]
     Z = topo.cost.shape[0]
     dtype = state.avail.dtype
     has_pred = jnp.sum(workload.pred, axis=1) > 0  # [T]
+    if faults is not None:
+        fault_host, fail_at, recover_at = faults
+        fault_idx = jnp.where(fault_host >= 0, fault_host, H)  # pad → drop
+
+        def _scatter_hosts(active):  # [F] bool -> [H] bool
+            return jnp.zeros((H + 1,), bool).at[fault_idx].max(active)[:H]
     # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
     cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
     bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
@@ -220,6 +241,30 @@ def _rollout_segment(
         )[:H]
         avail = avail + refund_per_host
         stage = jnp.where(newly_done, _DONE, stage)
+
+        # 1b. Faults: crashes strike after this window's completions
+        #     retire (completion-wins tie at tick resolution).
+        if faults is not None:
+            struck = _scatter_hosts((fail_at > t - tick) & (fail_at <= t))
+            down = _scatter_hosts((fail_at <= t) & (t < recover_at))
+            prev_down = _scatter_hosts(
+                (fail_at <= t - tick) & (t - tick < recover_at)
+            )
+            aborted = (
+                (stage == _RUNNING)
+                & (place >= 0)
+                & struck[jnp.clip(place, 0, H - 1)]
+            )
+            stage = jnp.where(aborted, _PENDING, stage)
+            place = jnp.where(aborted, -1, place)
+            finish = jnp.where(aborted, inf, finish)
+            # Recovery hands back a fresh machine (DES Host.recover);
+            # covers both outages ending this window and sub-tick ones.
+            recovered = (prev_down | struck) & ~down
+            avail = jnp.where(recovered[:, None], totals, avail)
+            # Down rows carry the −1 sentinel (no refund for lost work —
+            # reapplied every tick so stray refunds cannot resurrect one).
+            avail = jnp.where(down[:, None], jnp.asarray(-1.0, dtype), avail)
 
         # 2. Readiness: arrival passed ∧ all predecessor instances done.
         done_f = (stage == _DONE).astype(dtype)
@@ -370,12 +415,34 @@ def _single_rollout(
     topo: DeviceTopology,
     tick: float,
     max_ticks: int,
+    faults=None,
 ) -> RolloutResult:
     state = _init_state(avail0, workload.n_tasks)
     state = _rollout_segment(
-        state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks
+        state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks,
+        faults=faults, totals=avail0,
     )
     return _finalize(state, workload, topo)
+
+
+def _fault_schedule(key, n_replicas, n_faults, n_hosts, horizon, mttr, dtype):
+    """Per-replica random crash schedules, mirroring
+    ``FaultInjector.random_host_failures``: ``n_faults`` crashes at uniform
+    times in ``[0, horizon)`` on uniformly drawn hosts, each recovering
+    after an Exp(mean=``mttr``) outage (never, if ``mttr`` is None)."""
+    k_t, k_h, k_d = jax.random.split(key, 3)
+    fail_at = jax.random.uniform(
+        k_t, (n_replicas, n_faults), minval=0.0, maxval=horizon, dtype=dtype
+    )
+    host = jax.random.randint(k_h, (n_replicas, n_faults), 0, n_hosts).astype(
+        jnp.int32
+    )
+    if mttr is None:
+        recover_at = jnp.full((n_replicas, n_faults), jnp.inf, dtype=dtype)
+    else:
+        outage = jax.random.exponential(k_d, (n_replicas, n_faults), dtype=dtype)
+        recover_at = fail_at + mttr * outage
+    return host, fail_at, recover_at
 
 
 def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
@@ -399,7 +466,11 @@ def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_replicas", "tick", "max_ticks", "perturb")
+    jax.jit,
+    static_argnames=(
+        "n_replicas", "tick", "max_ticks", "perturb",
+        "n_faults", "fault_horizon", "mttr",
+    ),
 )
 def rollout(
     key,
@@ -411,15 +482,40 @@ def rollout(
     tick: float = 5.0,
     max_ticks: int = 512,
     perturb: float = 0.1,
+    n_faults: int = 0,
+    fault_horizon: Optional[float] = None,
+    mttr: Optional[float] = None,
 ) -> RolloutResult:
     """Vmapped Monte-Carlo rollout: [R]-leading-axis results.
 
     Replica r perturbs task runtimes and arrivals by ``±perturb`` and draws
     independent random root anchors — the BASELINE.json ensemble configs.
+
+    With ``n_faults > 0`` each replica additionally draws an independent
+    random host-crash schedule (``n_faults`` crashes uniform in
+    ``[0, fault_horizon)``, Exp(``mttr``) outages; see ``_fault_schedule``)
+    — resilience-under-failures what-if analysis as one device program,
+    where the DES needs one full simulation per fault scenario.
+    ``fault_horizon`` defaults to the nominal ``tick × max_ticks`` span.
+    ``avail0`` must be full host capacity (recovery resets to it).
     """
     rt, arr, root_anchor = _perturbations(
         key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
+    if n_faults:
+        # fold_in (not split) so the fault-free path's draws — and thus
+        # every existing result and checkpoint — are unchanged.
+        horizon = fault_horizon if fault_horizon is not None else tick * max_ticks
+        fh, fa, ra_t = _fault_schedule(
+            jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
+            avail0.shape[0], horizon, mttr, avail0.dtype,
+        )
+        return jax.vmap(
+            lambda r, a, ranc, h, t0, t1: _single_rollout(
+                avail0, r, a, ranc, workload, topo, tick, max_ticks,
+                faults=(h, t0, t1),
+            )
+        )(rt, arr, root_anchor, fh, fa, ra_t)
     return jax.vmap(
         lambda r, a, ra: _single_rollout(
             avail0, r, a, ra, workload, topo, tick, max_ticks
@@ -428,7 +524,9 @@ def rollout(
 
 
 @functools.lru_cache(maxsize=32)
-def _sharded_rollout_fn(mesh, n_replicas, tick, max_ticks, perturb):
+def _sharded_rollout_fn(
+    mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon, mttr
+):
     """Cached jitted rollout per (mesh, static config) — repeated calls
     (key sweeps, perturbation sweeps) reuse the compiled program."""
     out_shard = NamedSharding(mesh, P("replica"))
@@ -439,6 +537,9 @@ def _sharded_rollout_fn(mesh, n_replicas, tick, max_ticks, perturb):
             tick=tick,
             max_ticks=max_ticks,
             perturb=perturb,
+            n_faults=n_faults,
+            fault_horizon=fault_horizon,
+            mttr=mttr,
         ),
         out_shardings=RolloutResult(
             makespan=out_shard,
@@ -461,6 +562,9 @@ def sharded_rollout(
     tick: float = 5.0,
     max_ticks: int = 512,
     perturb: float = 0.1,
+    n_faults: int = 0,
+    fault_horizon: Optional[float] = None,
+    mttr: Optional[float] = None,
 ) -> RolloutResult:
     """Rollout with the replica axis sharded over ``mesh`` ('replica' axis).
 
@@ -468,9 +572,12 @@ def sharded_rollout(
     ``P('replica')`` — XLA partitions the vmapped while_loop across devices
     with zero cross-replica traffic (embarrassingly parallel), and any
     downstream ensemble statistics (means/quantiles over replicas) become
-    psums over ICI.
+    psums over ICI.  Fault parameters as in :func:`rollout`.
     """
-    fn = _sharded_rollout_fn(mesh, n_replicas, tick, max_ticks, perturb)
+    fn = _sharded_rollout_fn(
+        mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
+        mttr,
+    )
     return fn(key, avail0, workload, topo, storage_zones)
 
 
@@ -487,8 +594,17 @@ def _segment_step(
     topo: DeviceTopology,
     tick: float,
     segment_ticks,  # traced i32 scalar — the final partial segment must
+    faults=None,  # optional ([R, F] i32, [R, F], [R, F]) crash schedules
+    totals=None,  # [H, 4]
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
+    if faults is not None:
+        return jax.vmap(
+            lambda s, r, a, ra, fh, fa, rc: _rollout_segment(
+                s, r, a, ra, workload, topo, tick, segment_ticks,
+                faults=(fh, fa, rc), totals=totals,
+            )
+        )(state, rt, arr, root_anchor, *faults)
     return jax.vmap(
         lambda s, r, a, ra: _rollout_segment(
             s, r, a, ra, workload, topo, tick, segment_ticks
@@ -498,16 +614,19 @@ def _segment_step(
 
 def _fingerprint(
     key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
-    storage_zones,
+    storage_zones, fault_cfg=(0, None, None),
 ) -> str:
     """Hash of every input that determines the rollout trajectory —
     including array *contents*, so a checkpoint can never be resumed
     against edited workload data that merely kept its shapes."""
     import hashlib
 
-    h = hashlib.sha256(
-        repr((np.asarray(key).tolist(), n_replicas, tick, max_ticks, perturb)).encode()
-    )
+    base = (np.asarray(key).tolist(), n_replicas, tick, max_ticks, perturb)
+    if fault_cfg[0]:
+        # Appended only for fault runs so fault-free fingerprints — and
+        # therefore every pre-existing checkpoint — are unchanged.
+        base = base + (fault_cfg,)
+    h = hashlib.sha256(repr(base).encode())
     for tree in (workload, topo, (avail0, storage_zones)):
         for arr in jax.tree_util.tree_leaves(tree):
             a = np.ascontiguousarray(np.asarray(arr))
@@ -529,6 +648,9 @@ def rollout_checkpointed(
     perturb: float = 0.1,
     segment_ticks: int = 64,
     resume: bool = True,
+    n_faults: int = 0,
+    fault_horizon: Optional[float] = None,
+    mttr: Optional[float] = None,
 ) -> RolloutResult:
     """:func:`rollout` with mid-flight checkpoint/resume.
 
@@ -557,7 +679,7 @@ def rollout_checkpointed(
 
     fp = _fingerprint(
         key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
-        storage_zones,
+        storage_zones, fault_cfg=(n_faults, fault_horizon, mttr),
     )
 
     ticks_done = 0
@@ -584,6 +706,13 @@ def rollout_checkpointed(
     rt, arr, root_anchor = _perturbations(
         key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
+    faults = None
+    if n_faults:
+        horizon = fault_horizon if fault_horizon is not None else tick * max_ticks
+        faults = _fault_schedule(
+            jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
+            avail0.shape[0], horizon, mttr, avail0.dtype,
+        )
 
     while ticks_done < max_ticks and bool(jnp.any(state.stage != _DONE)):
         seg = min(segment_ticks, max_ticks - ticks_done)
@@ -596,6 +725,8 @@ def rollout_checkpointed(
             topo,
             tick=tick,
             segment_ticks=jnp.asarray(seg, jnp.int32),
+            faults=faults,
+            totals=avail0,
         )
         jax.block_until_ready(state)
         ticks_done += seg

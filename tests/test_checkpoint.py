@@ -250,3 +250,27 @@ def test_cli_grid_resume(tmp_path):
     for s in intact:  # sentinel present + matching identity → skipped
         g = os.path.join(os.path.dirname(s), "general.json")
         assert os.path.getmtime(g) == mtimes[s]
+
+
+def test_checkpointed_fault_rollout_matches_plain(setup, tmp_path):
+    """Fault schedules thread through segmented execution bit-identically,
+    and the fingerprint separates fault configs from fault-free runs."""
+    avail0, workload, topo, storage_zones = setup
+    fcfg = dict(n_faults=3, fault_horizon=100.0, mttr=40.0)
+    plain = rollout(
+        jax.random.PRNGKey(5), avail0, workload, topo, storage_zones,
+        **CFG, **fcfg,
+    )
+    path = str(tmp_path / "fault.npz")
+    seg = rollout_checkpointed(
+        jax.random.PRNGKey(5), avail0, workload, topo, storage_zones,
+        checkpoint_path=path, segment_ticks=7, **CFG, **fcfg,
+    )
+    _assert_same(plain, seg)
+    # Faults actually engaged: some replica diverges from fault-free.
+    base = rollout(
+        jax.random.PRNGKey(5), avail0, workload, topo, storage_zones, **CFG
+    )
+    assert not np.array_equal(
+        np.asarray(base.makespan), np.asarray(plain.makespan)
+    )
