@@ -54,6 +54,7 @@ __all__ = [
     "RolloutState",
     "rollout",
     "rollout_checkpointed",
+    "score_param_sweep",
     "sharded_rollout",
 ]
 
@@ -196,6 +197,7 @@ def _rollout_segment(
     n_ticks: int,
     faults=None,  # optional ([F] i32 host, [F] fail_at, [F] recover_at)
     totals=None,  # [H, 4] full capacity (fault recovery resets to this)
+    score_params=None,  # optional [3] exponents (w_cost, w_bw, w_norm)
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
     (stops early once every task is done).
@@ -223,6 +225,16 @@ def _rollout_segment(
     # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
     cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
     bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
+    if score_params is not None:
+        # Parameterized scoring for on-device policy autotuning: exponents
+        # (1, 1, 1) recover the reference score shape (modulo
+        # pow-vs-identity float paths — the unparameterized branch in
+        # place_body stays THE bit-exact default program).  The cost/bw
+        # pow tables are pure (topology × params) — hoisted like
+        # cost_rt/bw_rt; only norm ** w_norm depends on loop state.
+        w_norm = score_params[2]
+        cost_pow = cost_rt ** score_params[0]
+        bw_pow = bw_rt ** score_params[1]
     inf = jnp.asarray(jnp.inf, dtype)
 
     def cond(carry):
@@ -319,9 +331,11 @@ def _rollout_segment(
         def place_body(c):
             j, avail, pl = c
             demand = dem_p[j]
-            score = cost_rt[az_p[j]] / (
-                jnp.sqrt(jnp.sum(avail * avail, axis=1)) * bw_rt[az_p[j]]
-            )
+            norm = jnp.sqrt(jnp.sum(avail * avail, axis=1))
+            if score_params is None:
+                score = cost_rt[az_p[j]] / (norm * bw_rt[az_p[j]])
+            else:
+                score = cost_pow[az_p[j]] / (norm ** w_norm * bw_pow[az_p[j]])
             fit = jnp.all(avail > demand[None, :], axis=1)  # strict, ref :124
             h = jnp.argmin(jnp.where(fit, score, inf))
             ok = jnp.any(fit)
@@ -416,11 +430,12 @@ def _single_rollout(
     tick: float,
     max_ticks: int,
     faults=None,
+    score_params=None,
 ) -> RolloutResult:
     state = _init_state(avail0, workload.n_tasks)
     state = _rollout_segment(
         state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks,
-        faults=faults, totals=avail0,
+        faults=faults, totals=avail0, score_params=score_params,
     )
     return _finalize(state, workload, topo)
 
@@ -443,6 +458,20 @@ def _fault_schedule(key, n_replicas, n_faults, n_hosts, horizon, mttr, dtype):
         outage = jax.random.exponential(k_d, (n_replicas, n_faults), dtype=dtype)
         recover_at = fail_at + mttr * outage
     return host, fail_at, recover_at
+
+
+def _make_fault_schedule(
+    key, n_replicas, n_faults, avail0, tick, max_ticks, fault_horizon, mttr
+):
+    """The one place fault draws derive from the rollout key: fold_in (not
+    split) so the fault-free path's draws — and thus every existing result
+    and checkpoint — are unchanged; shared by :func:`rollout` and
+    :func:`rollout_checkpointed` so segmented runs stay bit-identical."""
+    horizon = fault_horizon if fault_horizon is not None else tick * max_ticks
+    return _fault_schedule(
+        jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
+        avail0.shape[0], horizon, mttr, avail0.dtype,
+    )
 
 
 def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
@@ -503,12 +532,9 @@ def rollout(
         key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
     if n_faults:
-        # fold_in (not split) so the fault-free path's draws — and thus
-        # every existing result and checkpoint — are unchanged.
-        horizon = fault_horizon if fault_horizon is not None else tick * max_ticks
-        fh, fa, ra_t = _fault_schedule(
-            jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
-            avail0.shape[0], horizon, mttr, avail0.dtype,
+        fh, fa, ra_t = _make_fault_schedule(
+            key, n_replicas, n_faults, avail0, tick, max_ticks,
+            fault_horizon, mttr,
         )
         return jax.vmap(
             lambda r, a, ranc, h, t0, t1: _single_rollout(
@@ -579,6 +605,55 @@ def sharded_rollout(
         mttr,
     )
     return fn(key, avail0, workload, topo, storage_zones)
+
+
+# -- policy autotuning --------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_replicas", "tick", "max_ticks", "perturb"),
+)
+def score_param_sweep(
+    key,
+    avail0,  # [H, 4] full host capacity
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,  # [S] i32
+    param_grid,  # [K, 3] exponents (w_cost, w_bw, w_norm) per candidate
+    n_replicas: int = 32,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+) -> RolloutResult:
+    """On-device policy autotuning: sweep the cost-aware score exponents.
+
+    The candidate scoring function is ``cost^w_cost / (norm^w_norm ×
+    bw^w_bw)`` — ``(1, 1, 1)`` is the reference's score shape
+    (``scheduler/cost_aware.py:104-119``).  Every candidate × replica pair
+    rolls out in ONE device program (double vmap, [K, R] leading axes), so
+    a K-point scheduler-hyperparameter grid search under R Monte-Carlo
+    scenarios costs one dispatch — the reference would need K × R full OS
+    processes.  All candidates share the same perturbation/anchor draws,
+    so candidate comparisons are paired (common random numbers: the
+    between-candidate variance excludes scenario noise).
+
+    Pick a winner downstream, e.g.
+    ``param_grid[jnp.argmin(res.makespan.mean(axis=1))]`` or any
+    makespan/egress trade-off.
+    """
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
+    per_param = jax.vmap(
+        lambda sp: jax.vmap(
+            lambda r, a, ra: _single_rollout(
+                avail0, r, a, ra, workload, topo, tick, max_ticks,
+                score_params=sp,
+            )
+        )(rt, arr, root_anchor)
+    )
+    return per_param(jnp.asarray(param_grid, avail0.dtype))
 
 
 # -- checkpoint / resume -----------------------------------------------------
@@ -708,10 +783,9 @@ def rollout_checkpointed(
     )
     faults = None
     if n_faults:
-        horizon = fault_horizon if fault_horizon is not None else tick * max_ticks
-        faults = _fault_schedule(
-            jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
-            avail0.shape[0], horizon, mttr, avail0.dtype,
+        faults = _make_fault_schedule(
+            key, n_replicas, n_faults, avail0, tick, max_ticks,
+            fault_horizon, mttr,
         )
 
     while ticks_done < max_ticks and bool(jnp.any(state.stage != _DONE)):
