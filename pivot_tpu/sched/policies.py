@@ -48,6 +48,30 @@ def _norms(mat: np.ndarray) -> np.ndarray:
 _NO_EXTRA = 0  # broadcast-zero "no placements yet" for frozen decay
 
 
+def _same_demand(d, prev_d) -> bool:
+    """Scalar 4-component equality for the identical-demand run fast paths
+    (``prev_d`` may be None).  One definition so the strict/non-strict fit
+    helpers below stay visually distinct from it."""
+    return prev_d is not None and (
+        d[0] == prev_d[0]
+        and d[1] == prev_d[1]
+        and d[2] == prev_d[2]
+        and d[3] == prev_d[3]
+    )
+
+
+def _row_fits(row, d) -> bool:
+    """Non-strict scalar fit (FirstFit/Opportunistic mask semantics)."""
+    return row[0] >= d[0] and row[1] >= d[1] and row[2] >= d[2] and row[3] >= d[3]
+
+
+def _row_fits_strict(row, d) -> bool:
+    """Strict scalar fit (BestFit/CostAware mask semantics, ref :124/:45)."""
+    return row[0] > d[0] and row[1] > d[1] and row[2] > d[2] and row[3] > d[3]
+
+
+
+
 def _sort_decreasing(demands: np.ndarray, idxs: List[int]) -> List[int]:
     """Stable sort of task indices by descending demand L2 norm."""
     norms = _norms(demands[idxs])
@@ -79,13 +103,24 @@ class OpportunisticPolicy(Policy):
                     placements[i] = h
         else:
             u = tick_uniforms(ctx.scheduler.seed or 0, ctx.tick_seq, ctx.n_tasks)
+            # Incremental fit mask over runs of identical demand vectors
+            # (instances of one group are adjacent in submission order):
+            # placing a task only mutates one host row, so only that mask
+            # entry can change for the next identical demand.
+            prev_d = None
+            mask = None
             for i in range(ctx.n_tasks):
-                mask = np.all(avail >= demands[i], axis=1)
+                d = demands[i]
+                if not _same_demand(d, prev_d):
+                    mask = np.all(avail >= d, axis=1)
+                    prev_d = d
                 n_fit = int(mask.sum())
                 if n_fit:
                     fits = np.nonzero(mask)[0]
                     h = int(fits[min(int(u[i] * n_fit), n_fit - 1)])
-                    avail[h] -= demands[i]
+                    avail[h] -= d
+                    row = avail[h]
+                    mask[h] = _row_fits(row, d)
                     placements[i] = h
         return placements
 
@@ -114,12 +149,30 @@ class FirstFitPolicy(Policy):
                         placements[i] = h
                         break
         else:
+            # Scan-resume over runs of identical demands (see CostAware
+            # ``_first_fit``): rows before the previous hit were rejected
+            # against the same demand and are unmutated.
+            prev_d = None
+            start = 0
             for i in idxs:
-                mask = np.all(avail >= demands[i], axis=1)
-                if mask.any():
-                    h = int(np.argmax(mask))
-                    avail[h] -= demands[i]
-                    placements[i] = h
+                d = demands[i]
+                if not _same_demand(d, prev_d):
+                    start = 0
+                    prev_d = d
+                if start < 0:
+                    continue
+                row = avail[start]
+                if _row_fits(row, d):
+                    h = start
+                else:
+                    mask = np.all(avail[start:] >= d, axis=1)
+                    if not mask.any():
+                        start = -1
+                        continue
+                    h = start + int(np.argmax(mask))
+                avail[h] -= d
+                placements[i] = h
+                start = h
         return placements
 
 
@@ -151,14 +204,27 @@ class BestFitPolicy(Policy):
                     avail[best] -= demands[i]
                     placements[i] = best
         else:
+            # Incremental residual vector over runs of identical demands:
+            # placing mutates one host row, so one residual entry updates.
+            prev_d = None
+            residual = None
             for i in idxs:
-                mask = np.all(avail > demands[i], axis=1)  # strict, ref :45
-                if not mask.any():
-                    continue
-                residual = _norms(avail - demands[i])
-                residual[~mask] = np.inf
+                d = demands[i]
+                if not _same_demand(d, prev_d):
+                    mask = np.all(avail > d, axis=1)  # strict, ref :45
+                    residual = _norms(avail - d)
+                    residual[~mask] = np.inf
+                    prev_d = d
                 h = int(np.argmin(residual))  # lowest index on ties
-                avail[h] -= demands[i]
+                if residual[h] == np.inf:
+                    continue
+                avail[h] -= d
+                row = avail[h]
+                if _row_fits_strict(row, d):
+                    r = row - d  # same ops as _norms(avail - d) row-wise
+                    residual[h] = np.sqrt(r[0] * r[0] + r[1] * r[1] + r[2] * r[2] + r[3] * r[3])
+                else:
+                    residual[h] = np.inf
                 placements[i] = h
         return placements
 
@@ -323,26 +389,28 @@ class CostAwarePolicy(Policy):
             start = 0
             for i in idxs:
                 d = demands[i]
-                if prev_d is None or not (
-                    d[0] == prev_d[0]
-                    and d[1] == prev_d[1]
-                    and d[2] == prev_d[2]
-                    and d[3] == prev_d[3]
-                ):
+                if not _same_demand(d, prev_d):
                     start = 0
                     prev_d = d
                 if start < 0:  # previous identical demand found no fit
                     continue
-                mask = (avail_sorted[start:] > d).all(axis=1)
-                if mask.any():
-                    p = start + int(np.argmax(mask))
-                    h = int(order[p])
-                    avail[h] -= d
-                    avail_sorted[p] = avail[h]
-                    placements[i] = h
-                    start = p
+                # Constant-time fast path: the run's previous hit row still
+                # fits — rows before it were rejected against this same
+                # demand and are unmutated, so it IS the first fit.
+                row = avail_sorted[start]
+                if _row_fits_strict(row, d):
+                    p = start
                 else:
-                    start = -1
+                    mask = (avail_sorted[start:] > d).all(axis=1)
+                    if not mask.any():
+                        start = -1
+                        continue
+                    p = start + int(np.argmax(mask))
+                h = int(order[p])
+                avail[h] -= d
+                avail_sorted[p] = avail[h]
+                placements[i] = h
+                start = p
 
     def _best_fit(
         self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
