@@ -66,7 +66,15 @@ def _spec_identity(spec: RunSpec) -> dict:
         "n_apps": spec.n_apps,
         "seed": spec.seed,
         "scale_factor": spec.scale_factor,
-        "cluster": dataclasses.asdict(spec.cluster),
+        "cluster": {
+            k: v
+            for k, v in dataclasses.asdict(spec.cluster).items()
+            # --executor is result-neutral (bit-identical trajectories,
+            # tests/test_executor.py), so like --audit it must not
+            # invalidate completed runs — nor may its absence from
+            # sentinels written before the knob existed.
+            if k != "executor"
+        },
         "policy": dataclasses.asdict(spec.policy),
         "trace_events": spec.trace_events,
         # --audit is deliberately NOT part of the identity: it is a pure
@@ -168,6 +176,14 @@ def parse_args(argv=None):
         help="tpu backend: always call the device, even for ticks too small "
              "to amortize the per-call link latency (default: adaptive "
              "routing between device and in-process numpy twin)",
+    )
+    parser.add_argument(
+        "--executor",
+        choices=["fast", "process"],
+        default="fast",
+        help="task executor: 'fast' callback executor (default) or the "
+             "reference-shaped one-process-per-execution 'process'; "
+             "bit-identical trajectories",
     )
     parser.add_argument(
         "--network",
@@ -311,6 +327,7 @@ def _cluster_config(args) -> ClusterConfig:
         shape=HostShape(args.cpus, args.mem, args.disk, args.gpus),
         seed=args.seed,
         network=args.network,
+        executor=args.executor,
     )
 
 
