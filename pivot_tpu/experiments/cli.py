@@ -99,6 +99,10 @@ def _is_complete(spec: RunSpec) -> bool:
             recorded = json.load(f)
     except (json.JSONDecodeError, OSError):
         return False
+    if isinstance(recorded.get("cluster"), dict):
+        # Sentinels written while the executor knob briefly lived in the
+        # identity carry it; strip before comparing (it is result-neutral).
+        recorded["cluster"].pop("executor", None)
     if recorded == _spec_identity(spec):
         return True
     logger.warning("stale results in %s (different run spec) — rerunning",

@@ -226,3 +226,56 @@ def test_cli_ensemble_checkpoint(tmp_path):
     import os
 
     assert os.path.exists(ckpt)
+
+
+def test_executor_knob_excluded_from_resume_identity():
+    """--executor is result-neutral: old sentinels (written before the knob
+    existed) and cross-executor sentinels must both stay valid."""
+    import dataclasses
+
+    from pivot_tpu.experiments.cli import RunSpec, _spec_identity
+    from pivot_tpu.utils.config import ClusterConfig, PolicyConfig
+
+    def spec(executor):
+        return RunSpec(
+            policy=PolicyConfig(name="cost-aware"),
+            cluster=ClusterConfig(n_hosts=10, executor=executor),
+            trace="data/jobs/jobs-5000-200-172800-259200.npz",
+            n_apps=5,
+            seed=0,
+            scale_factor=1000.0,
+            data_dir="/tmp/x",
+        )
+
+    a = _spec_identity(spec("fast"))
+    b = _spec_identity(spec("process"))
+    assert a == b
+    assert "executor" not in a["cluster"]
+
+
+def test_resume_tolerates_executor_in_recorded_sentinel(tmp_path):
+    """Sentinels written while the executor knob briefly lived in the run
+    identity must still count as complete."""
+    import json
+    import os
+
+    from pivot_tpu.experiments.cli import RunSpec, _is_complete, _spec_identity
+    from pivot_tpu.experiments.runner import sentinel_path
+    from pivot_tpu.utils.config import ClusterConfig, PolicyConfig
+
+    spec = RunSpec(
+        policy=PolicyConfig(name="cost-aware"),
+        cluster=ClusterConfig(n_hosts=10),
+        trace="data/jobs/jobs-5000-200-172800-259200.npz",
+        n_apps=5,
+        seed=0,
+        scale_factor=1000.0,
+        data_dir=str(tmp_path),
+    )
+    ident = _spec_identity(spec)
+    ident["cluster"] = dict(ident["cluster"], executor="fast")  # old format
+    marker = sentinel_path(str(tmp_path), ident["label"])
+    os.makedirs(os.path.dirname(marker), exist_ok=True)
+    with open(marker, "w") as f:
+        json.dump(ident, f)
+    assert _is_complete(spec)
