@@ -542,29 +542,48 @@ class Cluster(LogMixin):
     def _dispatch_loop(self):
         while True:
             task = yield self.dispatch_q.get()
-            self._dispatch_one(task)
             # Same-instant batching: items put synchronously with the one
             # just handed off start in FIFO order without paying one
             # get-event round-trip each.
-            for item in self.dispatch_q.drain():
-                self._dispatch_one(item)
+            batch = [task]
+            batch.extend(self.dispatch_q.drain())
+            if self.executor is not None:
+                # One-hop deferral mirroring the process executor's
+                # bootstrap events: admission/check-in must get a fresh seq
+                # here so same-instant conclusions (older-seq events)
+                # release first.  One callback covers the whole batch: the
+                # only work that could interleave between per-task
+                # bootstraps (URGENT listener resumes on admission failure)
+                # touches no state dispatch reads, so batching is exact.
+                executor = self.executor
+                self.env.schedule_callback(
+                    0.0, lambda b=batch: self._dispatch_batch(executor, b)
+                )
+            else:
+                for item in batch:
+                    self._dispatch_one(item)
 
-    def _dispatch_one(self, task) -> None:
+    def _dispatch_batch(self, executor, batch) -> None:
+        for task in batch:
+            host = self._validate(task)
+            if host is not None:
+                executor.dispatch(task, host)
+
+    def _validate(self, task) -> Optional[Host]:
         if not isinstance(task, Task):
             self.logger.error("dispatched non-task item: %r", task)
-            return
+            return None
         host = self._hosts.get(task.placement)
         if host is None:
             self.logger.error("unrecognized host %r", task.placement)
+            return None
+        return host
+
+    def _dispatch_one(self, task) -> None:
+        host = self._validate(task)
+        if host is None:
             return
-        if self.executor is not None:
-            # One-hop deferral mirroring the process executor's bootstrap
-            # event: admission/check-in must get a fresh seq here so
-            # same-instant conclusions (older-seq events) release first.
-            executor = self.executor
-            self.env.schedule_callback(0.0, lambda: executor.dispatch(task, host))
-        else:
-            self.env.process(self._execute_task(task, host))
+        self.env.process(self._execute_task(task, host))
 
     def _execute_task(self, task: Task, host: Host):
         # ``yield from`` runs the host's generator inside this process —

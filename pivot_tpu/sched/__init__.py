@@ -276,35 +276,46 @@ class GlobalScheduler(LogMixin):
     # -- the completion listener -----------------------------------------
     def _listen_loop(self):
         env = self.env
+        notify_q = self.cluster.notify_q
         while self.is_active:
-            success, task = yield self.cluster.notify_q.get()
-            app = task.application
-            if app is None:
-                self.logger.error("task %s has no application", task.id)
-                continue
-            local = self._local.get(app.id)
-            if local is None:
-                self.logger.error("application %s unknown", app.id)
-                continue
-            if success:
-                task.set_finished()
-                self.tracer.emit(
-                    "task", "finished", env.now, id=task.id, host=task.placement
-                )
-                local.notify(task)
-            else:
-                task.set_nascent()
-                task.placement = None
-                self.tracer.emit("task", "retry", env.now, id=task.id)
-                self.submit_q.put(task)
-            if app.is_finished:
-                app.end_time = env.now
-                self.tracer.emit("app", "finished", env.now, id=app.id)
-                self.logger.debug(
-                    "[%.3f] application %s finished in %.3f s",
-                    env.now,
-                    app.id,
-                    app.end_time - app.start_time,
-                )
-                self._local.pop(app.id, None)
-                self._n_unfinished -= 1
+            item = yield notify_q.get()
+            self._handle_notification(item)
+            # Same-instant batching: notifications already queued (e.g. a
+            # whole admission-failure batch) are handled in FIFO order
+            # without one get-event round-trip each.
+            for queued in notify_q.drain():
+                self._handle_notification(queued)
+
+    def _handle_notification(self, item):
+        env = self.env
+        success, task = item
+        app = task.application
+        if app is None:
+            self.logger.error("task %s has no application", task.id)
+            return
+        local = self._local.get(app.id)
+        if local is None:
+            self.logger.error("application %s unknown", app.id)
+            return
+        if success:
+            task.set_finished()
+            self.tracer.emit(
+                "task", "finished", env.now, id=task.id, host=task.placement
+            )
+            local.notify(task)
+        else:
+            task.set_nascent()
+            task.placement = None
+            self.tracer.emit("task", "retry", env.now, id=task.id)
+            self.submit_q.put(task)
+        if app.is_finished:
+            app.end_time = env.now
+            self.tracer.emit("app", "finished", env.now, id=app.id)
+            self.logger.debug(
+                "[%.3f] application %s finished in %.3f s",
+                env.now,
+                app.id,
+                app.end_time - app.start_time,
+            )
+            self._local.pop(app.id, None)
+            self._n_unfinished -= 1
