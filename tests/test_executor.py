@@ -245,3 +245,63 @@ def test_clone_preserves_executor_backend():
     assert c2.executor is None and c2.executor_backend == "process"
     c3 = c.clone(Environment(), None, executor_backend="fast")
     assert c3.executor is not None
+
+
+@pytest.mark.parametrize("seed", [11, 29, 47])
+def test_fuzz_random_dag_full_parity(seed):
+    """Randomized DAG workloads (random topology, fractional demands,
+    replicated groups) through the whole stack: fast and process executors
+    agree bit-for-bit on every summary metric."""
+    from pivot_tpu.workload.gen import RandomApplicationGenerator, _RangeSpec
+
+    def build_apps(s):
+        rng = np.random.default_rng(s)
+        # Bounds stay within one host's capacity (8 cpus, 1024 MB mem in
+        # _tiny_cluster) — an unplaceable task retries forever by design
+        # (the reference's infinite retry loop) and would hang the test.
+        spec = _RangeSpec(
+            cpus=(0.25, 4.0), mem=(16, 512), runtime=(1, 120),
+            output_size=(0, 3000),
+        )
+        gen = RandomApplicationGenerator((3, 10), (0.2, 0.6), spec, seed=s)
+        apps = []
+        for _ in range(6):
+            app = gen.generate()
+            for g in app.groups:  # replicate some groups (instance runs)
+                g.instances = int(rng.integers(1, 6))
+            apps.append(app)
+        return apps
+
+    results = {}
+    for executor in ("process", "fast"):
+        env = Environment()
+        meta = ResourceMetadata(seed=0)
+        meter = Meter(env, meta)
+        cluster = _tiny_cluster(env, meter, n_hosts=6, cpus=8.0,
+                                executor=executor)
+        sched = GlobalScheduler(
+            env, cluster,
+            CostAwarePolicy(mode="numpy", bin_pack="first-fit",
+                            sort_tasks=True, sort_hosts=True),
+            seed=seed, meter=meter,
+        )
+        cluster.start()
+        sched.start()
+        apps = build_apps(seed)
+
+        def submitter():
+            for app in apps:
+                sched.submit(app)
+                yield env.timeout(7.0)
+            sched.stop()
+
+        env.process(submitter())
+        env.run()
+        assert all(a.is_finished for a in apps)
+        s = meter.summary()
+        results[executor] = (
+            s["egress_cost"], s["cum_instance_hours"],
+            s["avg_congestion_delay"], s["sim_time"],
+            s["total_scheduling_ops"],  # every deterministic summary key
+        )
+    assert results["process"] == results["fast"], results
