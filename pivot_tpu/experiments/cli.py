@@ -227,6 +227,11 @@ def parse_args(argv=None):
     )
     ens.add_argument("--num-apps", type=int, dest="num_apps", default=50)
     ens.add_argument("--replicas", type=int, default=1024)
+    ens.add_argument("--policy", default="cost-aware",
+                     choices=["cost-aware", "first-fit", "best-fit",
+                              "opportunistic"],
+                     help="placement arm simulated by the rollout (the "
+                          "reference's three comparison arms + cost-aware)")
     ens.add_argument("--perturb", type=float, default=0.1,
                      help="± multiplicative jitter on task runtimes and "
                           "arrival times per replica")
@@ -418,6 +423,7 @@ def run_ensemble(args) -> dict:
         n_faults=args.faults,
         fault_horizon=args.fault_horizon,
         mttr=args.fault_mttr,
+        policy=args.policy,
     )
 
     wall0 = time.perf_counter()
@@ -447,6 +453,7 @@ def run_ensemble(args) -> dict:
         "n_hosts": args.n_hosts,
         "replicas": args.replicas,
         "perturb": args.perturb,
+        "policy": args.policy,
         "faults": args.faults,
         "fault_horizon": args.fault_horizon,
         "fault_mttr": args.fault_mttr,

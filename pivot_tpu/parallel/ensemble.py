@@ -198,6 +198,8 @@ def _rollout_segment(
     faults=None,  # optional ([F] i32 host, [F] fail_at, [F] recover_at)
     totals=None,  # [H, 4] full capacity (fault recovery resets to this)
     score_params=None,  # optional [3] exponents (w_cost, w_bw, w_norm)
+    policy: str = "cost-aware",  # | first-fit | best-fit | opportunistic
+    task_u=None,  # [T] uniforms (opportunistic draws, one per task)
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
     (stops early once every task is done).
@@ -288,16 +290,21 @@ def _rollout_segment(
         #    storage zone.  Group-wise: zc[g, z] counts group g's done
         #    instances in zone z ([T,G]ᵀ@[T,Z] — MXU), and summing zc over
         #    predecessor groups gives exactly the instance-level vote
-        #    counts without any per-replica [T, T] product.
+        #    counts without any per-replica [T, T] product.  (zc also
+        #    feeds the transfer estimate, so it is computed for every
+        #    policy; the vote itself only matters to cost-aware.)
         place_zone = topo.host_zone[jnp.clip(place, 0, H - 1)]
         placed_done = (stage == _DONE).astype(dtype)
         zone_onehot = jax.nn.one_hot(place_zone, Z, dtype=dtype) * placed_done[:, None]
         zc = workload.group_onehot.T @ zone_onehot  # [G, Z] done-instance counts
-        votes_g = workload.pred_group @ zc  # [G, Z]
-        majority_zone = jnp.argmax(votes_g, axis=1).astype(jnp.int32)[
-            workload.group_of
-        ]
-        anchor = jnp.where(has_pred, majority_zone, root_anchor)
+        if policy == "cost-aware":
+            votes_g = workload.pred_group @ zc  # [G, Z]
+            majority_zone = jnp.argmax(votes_g, axis=1).astype(jnp.int32)[
+                workload.group_of
+            ]
+            anchor = jnp.where(has_pred, majority_zone, root_anchor)
+        else:
+            anchor = root_anchor  # unused by the other arms
 
         # 4. Placement — same greedy cost-aware decision as the live
         #    scheduler's fused kernel (first-fit, sorted hosts, per-task
@@ -314,15 +321,22 @@ def _rollout_segment(
         #        index order — and therefore every placement — is
         #        bit-identical to the full scan) and a bounded while_loop
         #        runs max-over-replicas(n_eligible) steps instead of T.
-        fits_at_start = jnp.any(
-            jnp.all(avail[None, :, :] > workload.demands[:, None, :], axis=2),
-            axis=1,
-        )  # [T]
+        strict = policy in ("cost-aware", "best-fit")  # ref :124 / vbp :45
+        if strict:
+            fits_any = jnp.all(
+                avail[None, :, :] > workload.demands[:, None, :], axis=2
+            )
+        else:
+            fits_any = jnp.all(
+                avail[None, :, :] >= workload.demands[:, None, :], axis=2
+            )
+        fits_at_start = jnp.any(fits_any, axis=1)  # [T]
         eligible = ready & fits_at_start
         order = jnp.argsort(~eligible, stable=True)  # eligible first
         n_ready = jnp.sum(eligible)
         dem_p = workload.demands[order]
         az_p = anchor[order]
+        u_p = task_u[order] if task_u is not None else None
 
         def place_cond(c):
             j, _avail, _pl = c
@@ -331,13 +345,40 @@ def _rollout_segment(
         def place_body(c):
             j, avail, pl = c
             demand = dem_p[j]
-            norm = jnp.sqrt(jnp.sum(avail * avail, axis=1))
-            if score_params is None:
-                score = cost_rt[az_p[j]] / (norm * bw_rt[az_p[j]])
+            if strict:
+                fit = jnp.all(avail > demand[None, :], axis=1)
             else:
-                score = cost_pow[az_p[j]] / (norm ** w_norm * bw_pow[az_p[j]])
-            fit = jnp.all(avail > demand[None, :], axis=1)  # strict, ref :124
-            h = jnp.argmin(jnp.where(fit, score, inf))
+                fit = jnp.all(avail >= demand[None, :], axis=1)
+            if policy == "cost-aware":
+                norm = jnp.sqrt(jnp.sum(avail * avail, axis=1))
+                if score_params is None:
+                    score = cost_rt[az_p[j]] / (norm * bw_rt[az_p[j]])
+                else:
+                    score = cost_pow[az_p[j]] / (
+                        norm ** w_norm * bw_pow[az_p[j]]
+                    )
+                h = jnp.argmin(jnp.where(fit, score, inf))
+            elif policy == "first-fit":
+                h = jnp.argmax(fit)  # lowest-index fit (ref vbp.py:6-29)
+            elif policy == "best-fit":
+                resid = avail - demand[None, :]
+                score = jnp.sqrt(jnp.sum(resid * resid, axis=1))
+                h = jnp.argmin(jnp.where(fit, score, inf))
+            else:  # opportunistic: uniform among fits (ref opportunistic.py)
+                # Per-tick redraw via a Weyl rotation of the task's base
+                # uniform (the DES redraws per tick, policies.py:105; a
+                # retrying task must not deterministically re-target the
+                # same rank every tick).  Keyed on absolute time, so
+                # checkpoint segmentation cannot shift the sequence.
+                tick_idx = (t / tick).astype(jnp.int32)
+                u_eff = jnp.mod(
+                    u_p[j] + tick_idx.astype(u_p.dtype) * 0.6180339887498949,
+                    1.0,
+                )
+                n_fit = jnp.sum(fit)
+                k = jnp.minimum((u_eff * n_fit).astype(jnp.int32), n_fit - 1)
+                rank = jnp.cumsum(fit) - 1  # rank among fitting hosts
+                h = jnp.argmax(fit & (rank == k))
             ok = jnp.any(fit)
             delta = jnp.where(ok, demand, jnp.zeros_like(demand))
             avail = avail.at[h].add(-delta)
@@ -431,11 +472,14 @@ def _single_rollout(
     max_ticks: int,
     faults=None,
     score_params=None,
+    policy: str = "cost-aware",
+    task_u=None,
 ) -> RolloutResult:
     state = _init_state(avail0, workload.n_tasks)
     state = _rollout_segment(
         state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks,
         faults=faults, totals=avail0, score_params=score_params,
+        policy=policy, task_u=task_u,
     )
     return _finalize(state, workload, topo)
 
@@ -474,6 +518,18 @@ def _make_fault_schedule(
     )
 
 
+def _opportunistic_uniforms(key, n_replicas, n_tasks, dtype):
+    """Base uniform per (replica, task) for the opportunistic arm; the
+    placement step rotates it by the golden ratio per tick (Weyl
+    sequence), approximating the DES's independent per-tick redraws
+    (``tick_uniforms``, policies.py:105) without materializing a
+    [ticks, T] draw tensor.  fold_in keeps the other arms' streams
+    untouched."""
+    return jax.random.uniform(
+        jax.random.fold_in(key, 0x09901), (n_replicas, n_tasks), dtype=dtype
+    )
+
+
 def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
     """Deterministic per-replica Monte-Carlo draws — regenerated (not
     stored) on checkpoint resume, since they are a pure function of key."""
@@ -498,7 +554,7 @@ def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
     jax.jit,
     static_argnames=(
         "n_replicas", "tick", "max_ticks", "perturb",
-        "n_faults", "fault_horizon", "mttr",
+        "n_faults", "fault_horizon", "mttr", "policy",
     ),
 )
 def rollout(
@@ -514,6 +570,7 @@ def rollout(
     n_faults: int = 0,
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
+    policy: str = "cost-aware",
 ) -> RolloutResult:
     """Vmapped Monte-Carlo rollout: [R]-leading-axis results.
 
@@ -531,27 +588,42 @@ def rollout(
     rt, arr, root_anchor = _perturbations(
         key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
-    if n_faults:
-        fh, fa, ra_t = _make_fault_schedule(
-            key, n_replicas, n_faults, avail0, tick, max_ticks,
-            fault_horizon, mttr,
-        )
-        return jax.vmap(
-            lambda r, a, ranc, h, t0, t1: _single_rollout(
-                avail0, r, a, ranc, workload, topo, tick, max_ticks,
-                faults=(h, t0, t1),
+    task_u = _opportunistic_uniforms(
+        key, n_replicas, workload.n_tasks, avail0.dtype
+    ) if policy == "opportunistic" else None
+    # Optional per-replica axes pack into one *extras tuple so a single
+    # vmap body covers every (faults × task_u) combination.
+    have_faults = bool(n_faults)
+    extras = []
+    if have_faults:
+        extras.extend(
+            _make_fault_schedule(
+                key, n_replicas, n_faults, avail0, tick, max_ticks,
+                fault_horizon, mttr,
             )
-        )(rt, arr, root_anchor, fh, fa, ra_t)
-    return jax.vmap(
-        lambda r, a, ra: _single_rollout(
-            avail0, r, a, ra, workload, topo, tick, max_ticks
         )
-    )(rt, arr, root_anchor)
+    if task_u is not None:
+        extras.append(task_u)
+
+    def one(r, a, ra, *ex):
+        i = 0
+        f = None
+        if have_faults:
+            f = (ex[0], ex[1], ex[2])
+            i = 3
+        u = ex[i] if task_u is not None else None
+        return _single_rollout(
+            avail0, r, a, ra, workload, topo, tick, max_ticks,
+            faults=f, policy=policy, task_u=u,
+        )
+
+    return jax.vmap(one)(rt, arr, root_anchor, *extras)
 
 
 @functools.lru_cache(maxsize=32)
 def _sharded_rollout_fn(
-    mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon, mttr
+    mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
+    mttr, policy,
 ):
     """Cached jitted rollout per (mesh, static config) — repeated calls
     (key sweeps, perturbation sweeps) reuse the compiled program."""
@@ -566,6 +638,7 @@ def _sharded_rollout_fn(
             n_faults=n_faults,
             fault_horizon=fault_horizon,
             mttr=mttr,
+            policy=policy,
         ),
         out_shardings=RolloutResult(
             makespan=out_shard,
@@ -591,6 +664,7 @@ def sharded_rollout(
     n_faults: int = 0,
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
+    policy: str = "cost-aware",
 ) -> RolloutResult:
     """Rollout with the replica axis sharded over ``mesh`` ('replica' axis).
 
@@ -602,7 +676,7 @@ def sharded_rollout(
     """
     fn = _sharded_rollout_fn(
         mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
-        mttr,
+        mttr, policy,
     )
     return fn(key, avail0, workload, topo, storage_zones)
 
@@ -659,7 +733,7 @@ def score_param_sweep(
 # -- checkpoint / resume -----------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("tick",))
+@functools.partial(jax.jit, static_argnames=("tick", "policy"))
 def _segment_step(
     state: RolloutState,
     rt,  # [R, T] perturbed runtimes (constant for the run — computed once)
@@ -671,25 +745,36 @@ def _segment_step(
     segment_ticks,  # traced i32 scalar — the final partial segment must
     faults=None,  # optional ([R, F] i32, [R, F], [R, F]) crash schedules
     totals=None,  # [H, 4]
+    policy: str = "cost-aware",
+    task_u=None,  # [R, T] opportunistic uniforms
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
+    # Optional per-replica axes are packed into one tuple so a single vmap
+    # body covers every (faults × policy) combination.
+    extras = []
     if faults is not None:
-        return jax.vmap(
-            lambda s, r, a, ra, fh, fa, rc: _rollout_segment(
-                s, r, a, ra, workload, topo, tick, segment_ticks,
-                faults=(fh, fa, rc), totals=totals,
-            )
-        )(state, rt, arr, root_anchor, *faults)
-    return jax.vmap(
-        lambda s, r, a, ra: _rollout_segment(
-            s, r, a, ra, workload, topo, tick, segment_ticks
+        extras.extend(faults)
+    if task_u is not None:
+        extras.append(task_u)
+
+    def seg(s, r, a, ra, *ex):
+        i = 0
+        f = None
+        if faults is not None:
+            f = (ex[0], ex[1], ex[2])
+            i = 3
+        u = ex[i] if task_u is not None else None
+        return _rollout_segment(
+            s, r, a, ra, workload, topo, tick, segment_ticks,
+            faults=f, totals=totals, policy=policy, task_u=u,
         )
-    )(state, rt, arr, root_anchor)
+
+    return jax.vmap(seg)(state, rt, arr, root_anchor, *extras)
 
 
 def _fingerprint(
     key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
-    storage_zones, fault_cfg=(0, None, None),
+    storage_zones, fault_cfg=(0, None, None), policy="cost-aware",
 ) -> str:
     """Hash of every input that determines the rollout trajectory —
     including array *contents*, so a checkpoint can never be resumed
@@ -697,6 +782,10 @@ def _fingerprint(
     import hashlib
 
     base = (np.asarray(key).tolist(), n_replicas, tick, max_ticks, perturb)
+    if policy != "cost-aware":
+        # Appended only for non-default arms so cost-aware fingerprints —
+        # and therefore every pre-existing checkpoint — are unchanged.
+        base = base + (policy,)
     if fault_cfg[0]:
         # Appended only for fault runs so fault-free fingerprints — and
         # therefore every pre-existing checkpoint — are unchanged.
@@ -726,6 +815,7 @@ def rollout_checkpointed(
     n_faults: int = 0,
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
+    policy: str = "cost-aware",
 ) -> RolloutResult:
     """:func:`rollout` with mid-flight checkpoint/resume.
 
@@ -755,6 +845,7 @@ def rollout_checkpointed(
     fp = _fingerprint(
         key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
         storage_zones, fault_cfg=(n_faults, fault_horizon, mttr),
+        policy=policy,
     )
 
     ticks_done = 0
@@ -787,6 +878,9 @@ def rollout_checkpointed(
             key, n_replicas, n_faults, avail0, tick, max_ticks,
             fault_horizon, mttr,
         )
+    task_u = _opportunistic_uniforms(
+        key, n_replicas, workload.n_tasks, avail0.dtype
+    ) if policy == "opportunistic" else None
 
     while ticks_done < max_ticks and bool(jnp.any(state.stage != _DONE)):
         seg = min(segment_ticks, max_ticks - ticks_done)
@@ -801,6 +895,8 @@ def rollout_checkpointed(
             segment_ticks=jnp.asarray(seg, jnp.int32),
             faults=faults,
             totals=avail0,
+            policy=policy,
+            task_u=task_u,
         )
         jax.block_until_ready(state)
         ticks_done += seg

@@ -274,3 +274,36 @@ def test_checkpointed_fault_rollout_matches_plain(setup, tmp_path):
     assert not np.array_equal(
         np.asarray(base.makespan), np.asarray(plain.makespan)
     )
+
+
+def test_checkpointed_policy_arm_matches_plain(setup, tmp_path):
+    """Non-default policy arms thread through segmented execution
+    bit-identically (and fingerprint separately from cost-aware)."""
+    avail0, workload, topo, storage_zones = setup
+    for policy in ("first-fit", "opportunistic"):
+        plain = rollout(
+            jax.random.PRNGKey(9), avail0, workload, topo, storage_zones,
+            policy=policy, **CFG,
+        )
+        seg = rollout_checkpointed(
+            jax.random.PRNGKey(9), avail0, workload, topo, storage_zones,
+            checkpoint_path=str(tmp_path / f"{policy}.npz"),
+            segment_ticks=9, policy=policy, **CFG,
+        )
+        # Trajectories are exact; egress is compared with a 1-ulp
+        # tolerance — the plain path fuses _finalize into the rollout
+        # vmap while the segmented path vmaps it standalone, and XLA may
+        # order the small [G,Z] egress matmuls differently (f32).
+        np.testing.assert_array_equal(
+            np.asarray(plain.placement), np.asarray(seg.placement)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.finish_time), np.asarray(seg.finish_time)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.makespan), np.asarray(seg.makespan)
+        )
+        np.testing.assert_allclose(
+            np.asarray(plain.egress_cost), np.asarray(seg.egress_cost),
+            rtol=1e-6,
+        )

@@ -374,3 +374,92 @@ def test_score_param_sweep_shapes_and_pairing(setup):
     assert not np.array_equal(
         np.asarray(res.placement[0]), np.asarray(res.placement[1])
     )
+
+
+# -- policy-comparison ensembles ----------------------------------------------
+
+
+def _ens_inputs(cluster):
+    avail0 = jnp.asarray(cluster.availability_matrix(), dtype=jnp.float32)
+    sz = jnp.asarray(cluster.storage_zone_vector())
+    return avail0, sz
+
+
+def test_first_fit_rollout_packs_lowest_index(setup):
+    cluster, topo = setup
+    app = Application(
+        "ff", [TaskGroup("g", cpus=1, mem=256, runtime=10, instances=4)]
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    res = rollout(
+        jax.random.PRNGKey(0), avail0, w, topo, sz,
+        n_replicas=2, tick=5.0, max_ticks=32, perturb=0.0, policy="first-fit",
+    )
+    # 4 one-cpu tasks all first-fit onto host 0 (16 cpus).
+    assert np.all(np.asarray(res.placement) == 0)
+    assert res.n_unfinished.tolist() == [0, 0]
+
+
+def test_best_fit_rollout_picks_tightest(setup):
+    """With one host pre-loaded, best-fit picks it (smallest residual)."""
+    cluster, topo = setup
+    app = Application("bf", [TaskGroup("g", cpus=1, mem=256, runtime=10)])
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    avail0 = avail0.at[3, 0].set(2.0).at[3, 1].set(512.0)  # nearly full host
+    res = rollout(
+        jax.random.PRNGKey(0), avail0, w, topo, sz,
+        n_replicas=2, tick=5.0, max_ticks=32, perturb=0.0, policy="best-fit",
+    )
+    assert np.all(np.asarray(res.placement) == 3)
+
+
+def test_opportunistic_rollout_spreads_and_is_deterministic(setup):
+    cluster, topo = setup
+    app = Application(
+        "op", [TaskGroup("g", cpus=1, mem=256, runtime=10, instances=24)]
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    kw = dict(n_replicas=4, tick=5.0, max_ticks=64, perturb=0.0,
+              policy="opportunistic")
+    a = rollout(jax.random.PRNGKey(5), avail0, w, topo, sz, **kw)
+    b = rollout(jax.random.PRNGKey(5), avail0, w, topo, sz, **kw)
+    np.testing.assert_array_equal(np.asarray(a.placement), np.asarray(b.placement))
+    pl = np.asarray(a.placement)
+    assert len(np.unique(pl[0])) > 2  # random choice spreads across hosts
+    assert not np.array_equal(pl[0], pl[1])  # replicas draw independently
+    assert int(np.asarray(a.n_unfinished).max()) == 0
+
+
+def test_policy_comparison_cost_aware_wins_egress(setup):
+    """The reference's three-arm comparison as paired on-device ensembles:
+    cost-aware pays no more egress than locality-oblivious arms."""
+    cluster, topo = setup
+    app = Application(
+        "cmp",
+        [
+            TaskGroup("s", cpus=2, mem=512, runtime=5, output_size=4000,
+                      instances=6),
+            TaskGroup("t", cpus=2, mem=512, runtime=5, dependencies=["s"],
+                      instances=6),
+        ],
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    kw = dict(n_replicas=8, tick=5.0, max_ticks=64, perturb=0.1)
+    eg = {}
+    for policy in ("cost-aware", "opportunistic", "first-fit"):
+        res = rollout(jax.random.PRNGKey(2), avail0, w, topo, sz,
+                      policy=policy, **kw)
+        assert int(np.asarray(res.n_unfinished).max()) == 0
+        eg[policy] = float(np.asarray(res.egress_cost).mean())
+    # Opportunistic scatters uniformly and pays cross-zone egress; the
+    # locality-aware arm beats it.  (First-fit trivially packs this small
+    # workload onto one host — zero egress by degeneracy — so it is not a
+    # meaningful egress comparison here; the full-scale DES matrices in
+    # RESULTS.md carry that comparison.)
+    assert eg["opportunistic"] > 0
+    assert eg["cost-aware"] <= eg["opportunistic"]
+    assert eg["first-fit"] <= eg["opportunistic"]
