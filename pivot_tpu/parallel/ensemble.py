@@ -518,6 +518,33 @@ def _make_fault_schedule(
     )
 
 
+
+def _pack_extras(faults, task_u):
+    """Flatten the optional per-replica axes for a single vmap body.
+
+    Returns (extras_list, unpack) where ``unpack(*ex)`` rebuilds
+    ``(faults_tuple_or_None, task_u_or_None)`` — the ONE place the
+    positional bookkeeping lives, shared by :func:`rollout` and
+    :func:`_segment_step` so the two execution paths cannot drift.
+    """
+    extras = []
+    if faults is not None:
+        extras.extend(faults)
+    if task_u is not None:
+        extras.append(task_u)
+
+    def unpack(*ex):
+        i = 0
+        f = None
+        if faults is not None:
+            f = (ex[0], ex[1], ex[2])
+            i = 3
+        u = ex[i] if task_u is not None else None
+        return f, u
+
+    return extras, unpack
+
+
 def _opportunistic_uniforms(key, n_replicas, n_tasks, dtype):
     """Base uniform per (replica, task) for the opportunistic arm; the
     placement step rotates it by the golden ratio per tick (Weyl
@@ -591,27 +618,18 @@ def rollout(
     task_u = _opportunistic_uniforms(
         key, n_replicas, workload.n_tasks, avail0.dtype
     ) if policy == "opportunistic" else None
-    # Optional per-replica axes pack into one *extras tuple so a single
-    # vmap body covers every (faults × task_u) combination.
-    have_faults = bool(n_faults)
-    extras = []
-    if have_faults:
-        extras.extend(
-            _make_fault_schedule(
-                key, n_replicas, n_faults, avail0, tick, max_ticks,
-                fault_horizon, mttr,
-            )
+    faults = (
+        _make_fault_schedule(
+            key, n_replicas, n_faults, avail0, tick, max_ticks,
+            fault_horizon, mttr,
         )
-    if task_u is not None:
-        extras.append(task_u)
+        if n_faults
+        else None
+    )
+    extras, unpack = _pack_extras(faults, task_u)
 
     def one(r, a, ra, *ex):
-        i = 0
-        f = None
-        if have_faults:
-            f = (ex[0], ex[1], ex[2])
-            i = 3
-        u = ex[i] if task_u is not None else None
+        f, u = unpack(*ex)
         return _single_rollout(
             avail0, r, a, ra, workload, topo, tick, max_ticks,
             faults=f, policy=policy, task_u=u,
@@ -749,21 +767,10 @@ def _segment_step(
     task_u=None,  # [R, T] opportunistic uniforms
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
-    # Optional per-replica axes are packed into one tuple so a single vmap
-    # body covers every (faults × policy) combination.
-    extras = []
-    if faults is not None:
-        extras.extend(faults)
-    if task_u is not None:
-        extras.append(task_u)
+    extras, unpack = _pack_extras(faults, task_u)
 
     def seg(s, r, a, ra, *ex):
-        i = 0
-        f = None
-        if faults is not None:
-            f = (ex[0], ex[1], ex[2])
-            i = 3
-        u = ex[i] if task_u is not None else None
+        f, u = unpack(*ex)
         return _rollout_segment(
             s, r, a, ra, workload, topo, tick, segment_ticks,
             faults=f, totals=totals, policy=policy, task_u=u,

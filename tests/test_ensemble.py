@@ -463,3 +463,24 @@ def test_policy_comparison_cost_aware_wins_egress(setup):
     assert eg["opportunistic"] > 0
     assert eg["cost-aware"] <= eg["opportunistic"]
     assert eg["first-fit"] <= eg["opportunistic"]
+
+
+def test_sharded_policy_arm_8_devices(setup):
+    """Non-default arms shard over the mesh (task_u rides the replica axis)."""
+    cluster, topo = setup
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = build_mesh(8, ("replica", "host"))
+    app = Application(
+        "sp", [TaskGroup("g", cpus=1, mem=256, runtime=10, instances=8)]
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    res = sharded_rollout(
+        mesh, jax.random.PRNGKey(1), avail0, w, topo, sz,
+        n_replicas=16, tick=5.0, max_ticks=32, perturb=0.0,
+        policy="opportunistic",
+    )
+    res.makespan.block_until_ready()
+    assert len(res.makespan.sharding.device_set) == 8
+    assert int(np.asarray(res.n_unfinished).max()) == 0
