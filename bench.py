@@ -190,10 +190,6 @@ def _bench_device(ctx, n_replicas: int, repeats: int = 5):
 
 def main() -> None:
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
-    if backend_override:
-        import jax
-
-        jax.config.update("jax_platforms", backend_override)
 
     # Watchdog: if accelerator init stalls (wedged tunnel), restart on CPU;
     # if even the CPU run stalls, emit an error line rather than dying mute.
@@ -219,10 +215,38 @@ def main() -> None:
 
     if hasattr(signal, "SIGALRM"):
         signal.signal(signal.SIGALRM, _stall)
-        if not backend_override:
+
+    # SIGALRM only fires between Python bytecodes — a PJRT client init
+    # hanging inside a blocking C++ RPC would never return to let the
+    # handler run.  Probe accelerator liveness in a disposable child
+    # process first (killable regardless of where it blocks); on a stalled
+    # or failing probe, fall back to CPU before this process ever touches
+    # the device runtime.
+    if not backend_override:
+        import subprocess
+
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+                timeout=150,
+                capture_output=True,
+                text=True,
+            )
+            alive = probe.returncode == 0 and "ok" in probe.stdout
+        except subprocess.TimeoutExpired:
+            alive = False
+        if not alive:
+            os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+            backend_override = "cpu"
+        elif hasattr(signal, "SIGALRM"):
+            # Armed only now, so the parent's own init gets the full
+            # budget — the probe must not eat into it.
             signal.alarm(240)
 
     import jax
+
+    if backend_override:
+        jax.config.update("jax_platforms", backend_override)
 
     backend = jax.default_backend()
     if hasattr(signal, "SIGALRM"):
