@@ -1,0 +1,143 @@
+"""Meter unit tests with hand-computed values.
+
+The busy-interval merge semantics mirror the reference
+(``resources/meter.py:59-81``), including its quirk: a check-in landing
+after the last interval already closed opens a NEW interval — the gap is
+not back-filled even if another task ran through it.
+"""
+
+import pytest
+
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+
+class _FakeResource:
+    def __init__(self):
+        self.t_cpus, self.t_mem, self.t_disk, self.t_gpus = 8.0, 100.0, 10.0, 2.0
+        self.cpus, self.mem, self.disk, self.gpus = 4.0, 50.0, 10.0, 2.0
+
+
+class _FakeHost:
+    def __init__(self, hid="h"):
+        self.id = hid
+        self.resource = _FakeResource()
+
+
+@pytest.fixture
+def meter():
+    return Meter(_Clock(), ResourceMetadata(seed=0))
+
+
+def _at(meter, t):
+    meter.env.now = t
+
+
+def test_single_interval_instance_hours(meter):
+    h = _FakeHost()
+    _at(meter, 100.0)
+    meter.host_check_in(h)
+    _at(meter, 1900.0)
+    meter.host_check_out(h)
+    assert meter.cumulative_instance_hours == pytest.approx(1800.0 / 3600.0)
+    assert meter._host_intervals[h] == [[100.0, 1900.0]]
+
+
+def test_overlapping_tasks_extend_interval(meter):
+    """Second check-out past the closed end extends it (ref meter.py:77-81)."""
+    h = _FakeHost()
+    _at(meter, 0.0)
+    meter.host_check_in(h)   # task A
+    _at(meter, 5.0)
+    meter.host_check_in(h)   # task B while open: no-op
+    _at(meter, 10.0)
+    meter.host_check_out(h)  # A done: closes [0, 10]
+    _at(meter, 20.0)
+    meter.host_check_out(h)  # B done: extends to [0, 20]
+    assert meter._host_intervals[h] == [[0.0, 20.0]]
+
+
+def test_reference_gap_quirk(meter):
+    """A check-in after the close opens a new interval; the idle gap stays."""
+    h = _FakeHost()
+    _at(meter, 0.0)
+    meter.host_check_in(h)
+    _at(meter, 10.0)
+    meter.host_check_out(h)
+    _at(meter, 15.0)
+    meter.host_check_in(h)
+    _at(meter, 20.0)
+    meter.host_check_out(h)
+    assert meter._host_intervals[h] == [[0.0, 10.0], [15.0, 20.0]]
+    assert meter.cumulative_instance_hours == pytest.approx(15.0 / 3600.0)
+
+
+def test_touching_checkin_reopens(meter):
+    """check-in at exactly the closed end merges (ref ``last.pop()``)."""
+    h = _FakeHost()
+    _at(meter, 0.0)
+    meter.host_check_in(h)
+    _at(meter, 10.0)
+    meter.host_check_out(h)
+    _at(meter, 10.0)
+    meter.host_check_in(h)
+    _at(meter, 25.0)
+    meter.host_check_out(h)
+    assert meter._host_intervals[h] == [[0.0, 25.0]]
+
+
+def test_check_out_before_check_in_raises(meter):
+    with pytest.raises(RuntimeError):
+        meter.host_check_out(_FakeHost())
+
+
+def test_host_usage_curve_buckets(meter):
+    """Bucketing mirrors the reference loop (``plot_host_usage``,
+    meter.py:135-148): windows advance while ``cur < end``, so the final
+    window ending at ceil(interval end) is excluded — [0, 150] with bucket
+    100 yields only (0, 100); [0, 250] yields (0, 100) and (100, 200)."""
+    h = _FakeHost()
+    _at(meter, 0.0)
+    meter.host_check_in(h)
+    _at(meter, 150.0)
+    meter.host_check_out(h)
+    x, counts = meter.host_usage_curve(sample_size=100.0)
+    assert x == [(0.0, 100.0)]
+    assert counts == [1]
+
+    h2 = _FakeHost("h2")
+    _at(meter, 0.0)
+    meter.host_check_in(h2)
+    _at(meter, 250.0)
+    meter.host_check_out(h2)
+    x, counts = meter.host_usage_curve(sample_size=100.0)
+    assert x == [(0.0, 100.0), (100.0, 200.0)]
+    assert counts == [2, 1]
+
+
+def test_resource_usage_fractions(meter):
+    """Samples record (total - available) / total per dimension."""
+    h = _FakeHost()
+    _at(meter, 0.0)
+    meter.host_check_in(h)  # snapshots usage: cpus 4/8, mem 50/100, disk 0
+    x, y = meter.resource_usage_curve("cpus", sample_size=100.0)
+    assert x == [0.0]
+    assert y == [pytest.approx(0.5)]
+    _, ym = meter.resource_usage_curve("mem", sample_size=100.0)
+    assert ym == [pytest.approx(0.5)]
+    _, yd = meter.resource_usage_curve("disk", sample_size=100.0)
+    assert yd == [pytest.approx(0.0)]
+
+
+def test_summary_counts_ops_and_turnovers(meter):
+    meter.increment_scheduling_ops(7)
+    meter.increment_scheduling_ops(5)
+    meter.add_scheduling_turnover(42.0)
+    s = meter.summary()
+    assert s["total_scheduling_ops"] == 12
+    assert meter._sched_turnovers == [42.0]
