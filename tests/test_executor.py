@@ -58,8 +58,15 @@ METRICS = ("avg_runtime", "egress_cost", "cum_instance_hours",
             name="cost-aware", device="numpy",
             bin_pack="first-fit", sort_tasks=True, sort_hosts=True,
         ),
+        # realtime_bw reads live route queue state at tick instants — the
+        # sharpest cross-executor coupling between scheduling and the
+        # in-flight network state.
+        PolicyConfig(
+            name="cost-aware", device="numpy",
+            bin_pack="best-fit", realtime_bw=True, host_decay=True,
+        ),
     ],
-    ids=["opportunistic", "vbp", "cost-aware"],
+    ids=["opportunistic", "vbp", "cost-aware", "cost-aware-rtbw"],
 )
 def test_full_sim_bit_parity(policy_cfg):
     """Every summary metric is bit-identical across executors: identical
