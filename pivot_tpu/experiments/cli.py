@@ -250,6 +250,11 @@ def parse_args(argv=None):
     ens.add_argument("--fault-mttr", type=float, default=None,
                      help="mean outage duration (Exp-distributed); "
                           "omit for permanent crashes")
+    ens.add_argument("--congestion", action="store_true",
+                     help="tick-resolution link-contention model: transfer "
+                          "delays account for queued backlog on each "
+                          "(src zone → dst host) pipe instead of assuming "
+                          "uncontended bandwidth")
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -424,6 +429,7 @@ def run_ensemble(args) -> dict:
         fault_horizon=args.fault_horizon,
         mttr=args.fault_mttr,
         policy=args.policy,
+        congestion=args.congestion,
     )
 
     wall0 = time.perf_counter()
@@ -446,6 +452,7 @@ def run_ensemble(args) -> dict:
 
     mk = np.asarray(res.makespan)
     eg = np.asarray(res.egress_cost)
+    ih = np.asarray(res.instance_hours)
     summary = {
         "trace": os.path.basename(trace),
         "n_apps": len(apps),
@@ -457,12 +464,15 @@ def run_ensemble(args) -> dict:
         "faults": args.faults,
         "fault_horizon": args.fault_horizon,
         "fault_mttr": args.fault_mttr,
+        "congestion": args.congestion,
         "devices": len(jax.devices()),
         "makespan_mean": float(mk.mean()),
         "makespan_p5": float(np.percentile(mk, 5)),
         "makespan_p95": float(np.percentile(mk, 95)),
         "egress_mean": float(eg.mean()),
         "egress_p95": float(np.percentile(eg, 95)),
+        "instance_hours_mean": float(ih.mean()),
+        "instance_hours_p95": float(np.percentile(ih, 95)),
         "unfinished_max": int(np.asarray(res.n_unfinished).max()),
         "wall_s": round(wall, 3),
         "replica_rollouts_per_sec": round(args.replicas / wall, 2),
@@ -473,6 +483,7 @@ def run_ensemble(args) -> dict:
         os.path.join(out_dir, "rollout.npz"),
         makespan=mk,
         egress_cost=eg,
+        instance_hours=ih,
         finish_time=np.asarray(res.finish_time),
         placement=np.asarray(res.placement),
     )
@@ -483,6 +494,15 @@ def run_ensemble(args) -> dict:
 
 
 def main(argv=None) -> None:
+    # Respect an explicit JAX_PLATFORMS pin at the config level too: the
+    # accelerator site package force-updates jax_platforms at interpreter
+    # start (beating the env var), which would make a CPU-pinned CLI run
+    # dial — and hang on — the single-tenant accelerator tunnel anyway.
+    # Same hard override as tests/conftest.py.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     args = parse_args(argv)
     from pivot_tpu.experiments import plots
 

@@ -23,9 +23,19 @@ the *ensemble estimator*, not the ground-truth DES; use
     ``scheduler/cost_aware.py:45-58``).
   * Transfer time: propagation delay ``size / bw(zone→zone)`` (the same
     estimate the reference's scheduler uses for scoring;
-    ``resources/__init__.py:327-331``); no packet-level congestion.
-  * Egress cost: Σ over DAG edges of ``cost(zone_src → zone_dst) ×
-    output_mb / 8000`` (``resources/__init__.py:565-569``).
+    ``resources/__init__.py:327-331``).  By default no packet-level
+    congestion; ``congestion=True`` adds a tick-resolution backlog model —
+    every (source zone → destination host) aggregate is one FIFO pipe with
+    a queued-MB state that new pulls join and bandwidth drains, the
+    ensemble analog of the DES's per-route round-robin chunk service
+    (``infra.network.Route``; ref ``resources/network.py:86-100``).
+  * Egress cost: one bill of ``cost(zone_src → zone_dst) × output_mb /
+    8000`` (``resources/__init__.py:565-569``) per *sampled* pull, with
+    the DES's ``max(round(n_producers / n_consumers), 1)``-instance
+    sampling rule and sources distributed like the producer's placements.
+  * Instance-hours: tick-resolution busy-host integral (a host is busy in a
+    window iff a task runs on it), the estimator analog of the DES meter's
+    merged busy intervals (``infra.meter.Meter.cumulative_instance_hours``).
 
 Monte-Carlo axes: per-replica multiplicative jitter on task runtimes and
 arrivals, independent random root anchors, and — with ``n_faults > 0`` —
@@ -157,6 +167,7 @@ class RolloutResult(NamedTuple):
     finish_time: jax.Array  # [R, T]
     placement: jax.Array  # [R, T] host index
     n_unfinished: jax.Array  # [R] tasks still pending at the horizon
+    instance_hours: jax.Array  # [R] busy host-hours (tick-resolution)
 
 
 class RolloutState(NamedTuple):
@@ -169,20 +180,25 @@ class RolloutState(NamedTuple):
     finish: jax.Array  # [T]
     place: jax.Array  # [T] i32
     avail: jax.Array  # [H, 4]
+    busy: jax.Array  # scalar busy host-seconds accumulator
+    q: jax.Array  # [Z, H] queued MB per (src zone → dst host) pipe
 
 
 # Task stages.
 _PENDING, _RUNNING, _DONE = 0, 1, 2
 
 
-def _init_state(avail0, T) -> RolloutState:
+def _init_state(avail0, T, Z) -> RolloutState:
     dtype = avail0.dtype
+    H = avail0.shape[0]
     return RolloutState(
         t=jnp.asarray(0.0, dtype),
         stage=jnp.full((T,), _PENDING, dtype=jnp.int32),
         finish=jnp.full((T,), jnp.inf, dtype=dtype),
         place=jnp.full((T,), -1, dtype=jnp.int32),
         avail=avail0,
+        busy=jnp.asarray(0.0, dtype),
+        q=jnp.zeros((Z, H), dtype=dtype),
     )
 
 
@@ -200,6 +216,7 @@ def _rollout_segment(
     score_params=None,  # optional [3] exponents (w_cost, w_bw, w_norm)
     policy: str = "cost-aware",  # | first-fit | best-fit | opportunistic
     task_u=None,  # [T] uniforms (opportunistic draws, one per task)
+    congestion: bool = False,
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
     (stops early once every task is done).
@@ -212,6 +229,11 @@ def _rollout_segment(
     the −1 sentinel so no fit can select it, and recovery restores full
     capacity.  Completions in the same tick window as the crash retire
     first — the tick-resolution analog of the DES completion-wins tie.
+
+    With ``congestion``, transfer delays account for link contention via
+    the per-replica ``state.q`` backlog tensor (see the placement step for
+    the exact pipe model); without it ``q`` is carried untouched, so the
+    flag cannot perturb the default path.
     """
     T = workload.n_tasks
     H = state.avail.shape[0]
@@ -227,6 +249,21 @@ def _rollout_segment(
     # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
     cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
     bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
+    if congestion:
+        # Pipe tables for the backlog model: bandwidth of the (src zone →
+        # dst host) aggregate and its reciprocal, plus per-group instance
+        # counts (the DES pulls a ~1/n_instances sample of predecessor
+        # instances per consumer, ``resources/__init__.py:263-267`` — pull
+        # volumes are scaled by the same fraction).
+        bw_zh = topo.bw[:, topo.host_zone]  # [Z, H]
+        inv_bw_zh = jnp.where(bw_zh > 0, 1.0 / bw_zh, 0.0)
+        # Static pull-volume table: pull_frac[c, g] is a consumer
+        # instance's pulled MB from group g per done g-instance, so this
+        # tick's zone-resolved volume is just ``pull_frac @ zc``.
+        inst, samp = _sampling_table(workload)
+        pull_frac = (
+            workload.pred_group * samp * (workload.out_group / inst)[None, :]
+        )  # [G, G] consumer × producer
     if score_params is not None:
         # Parameterized scoring for on-device policy autotuning: exponents
         # (1, 1, 1) recover the reference score shape (modulo
@@ -244,7 +281,7 @@ def _rollout_segment(
         return (i < n_ticks) & jnp.any(state.stage != _DONE)
 
     def body(carry):
-        i, (t, stage, finish, place, avail) = carry
+        i, (t, stage, finish, place, avail, busy, q) = carry
 
         # 1. Retire finished tasks and refund their resources.
         newly_done = (stage == _RUNNING) & (finish <= t)
@@ -279,6 +316,10 @@ def _rollout_segment(
             # Down rows carry the −1 sentinel (no refund for lost work —
             # reapplied every tick so stray refunds cannot resurrect one).
             avail = jnp.where(down[:, None], jnp.asarray(-1.0, dtype), avail)
+            if congestion:
+                # A crash cancels the host's pending inbound staging
+                # (FastExecutor.abort_host cancels queued transfers).
+                q = jnp.where(struck[None, :], jnp.asarray(0.0, dtype), q)
 
         # 2. Readiness: arrival passed ∧ all predecessor instances done.
         done_f = (stage == _DONE).astype(dtype)
@@ -416,14 +457,91 @@ def _rollout_segment(
         new_zone = topo.host_zone[jnp.clip(placements, 0, H - 1)]
         xfer_delay = CD[workload.group_of, new_zone]  # [T]
 
+        if congestion:
+            # Backlog pipe model: every (src zone s → dst host h) aggregate
+            # is one FIFO pipe with queued-MB state q[s, h]; a pull joins
+            # the backlog and completes when the pipe has drained it, so
+            # its delay is (backlog + this tick's volume) / bw — the
+            # tick-resolution analog of the DES's per-route round-robin
+            # chunk service, where concurrent transfers on one route all
+            # finish together at backlog-drain time.  Pull volumes follow
+            # the DES sampling rule via the hoisted ``pull_frac`` table;
+            # aggregation is one matmul + one segment sum — nothing bigger
+            # than [T, Z] is materialized.
+            pull_gz = pull_frac @ zc  # [G, Z] pulled MB per consumer instance
+            vol_tz = pull_gz[workload.group_of] * placed[:, None]  # [T, Z]
+            v_new = jax.ops.segment_sum(
+                vol_tz, jnp.where(placed, placements, H), num_segments=H + 1
+            )[:H].T  # [Z, H] new queued MB per pipe
+            q_now = q + v_new
+            # Per-task congested delay: max over source zones this task
+            # pulls NONZERO volume from of backlog/bw at its destination
+            # host (a zero-output predecessor transfers nothing — the DES
+            # skips it, ``resources/__init__.py:263-267`` — so backlog
+            # from other tasks must not delay this one through it).
+            pulls_from = vol_tz > 0
+            ratio_t = (q_now * inv_bw_zh)[:, jnp.clip(placements, 0, H - 1)].T
+            cong_delay = jnp.max(
+                jnp.where(pulls_from, ratio_t, 0.0), axis=1
+            )  # [T]
+            # Never undercut the uncongested bound: an empty pipe with one
+            # puller reduces to the static size/bw estimate or below (the
+            # sampled volume is a 1/n fraction), so take the max.
+            xfer_delay = jnp.maximum(xfer_delay, cong_delay)
+            # Drain the pipes over the coming window.
+            q = jnp.maximum(q_now - bw_zh * tick, 0.0)
+
         stage = jnp.where(placed, _RUNNING, stage)
         place = jnp.where(placed, placements, place)
         finish = jnp.where(placed, t + xfer_delay + runtime, finish)
 
-        return (i + 1, RolloutState(t + tick, stage, finish, place, avail))
+        # 6. Busy-host integral (instance-hours estimator): a host is busy
+        #    over this window iff a task is running on it after placement.
+        busy_host = jnp.zeros((H + 1,), bool).at[
+            jnp.where(stage == _RUNNING, place, H)
+        ].max(True)[:H]
+        busy = busy + tick * jnp.sum(busy_host.astype(dtype))
+
+        return (
+            i + 1,
+            RolloutState(t + tick, stage, finish, place, avail, busy, q),
+        )
 
     _, out = lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), state))
     return out
+
+
+def _sampling_table(workload: EnsembleWorkload):
+    """(inst, samp): per-group instance counts and the DES pull-sample
+    table — each consumer instance of group c pulls ``samp[c, g] =
+    max(round(inst[g] / inst[c]), 1)`` predecessor instances of group g
+    (``resources/__init__.py:263-267``; ``jnp.round`` matches Python's
+    banker's rounding).  The ONE definition shared by the congestion
+    timing model and the egress bill, so the two cannot desynchronize."""
+    inst = jnp.maximum(jnp.sum(workload.group_onehot, axis=0), 1.0)  # [G]
+    samp = jnp.maximum(jnp.round(inst[None, :] / inst[:, None]), 1.0)
+    return inst, samp
+
+
+def _sampled_egress(workload, topo, zcp, pz, placed):
+    """DES-faithful egress estimate in three small matmuls.
+
+    The DES bills one transfer per *sampled* pull (see
+    :func:`_sampling_table`) — totalling ≈ max(n_p, n_c) transfers per
+    group edge, NOT the n_p × n_c of naive all-pairs counting (which
+    would inflate fan-out egress ~16× on the Alibaba traces).  Expected
+    cost per pull = Σ_s P(source in zone s) × cost[s, consumer zone],
+    with the source distributed like the producer's placed instances
+    (zcp row, normalized).
+    """
+    n_placed_g = jnp.sum(zcp, axis=1, keepdims=True)  # [G, 1]
+    src_frac = jnp.where(n_placed_g > 0, zcp / jnp.maximum(n_placed_g, 1.0), 0.0)
+    _, samp = _sampling_table(workload)
+    # d[g, i]: expected $/8000·MB⁻¹-weighted cost of one pull from group g
+    # into task i's zone, scaled by g's output size.
+    d = (src_frac * workload.out_group[:, None]) @ topo.cost[:, pz]  # [G, T]
+    pulls = (workload.pred_group * samp)[workload.group_of]  # [T, G]
+    return jnp.sum(placed * jnp.sum(pulls * d.T, axis=1)) / 8000.0
 
 
 def _finalize(
@@ -434,30 +552,23 @@ def _finalize(
     finish, place, stage = state.finish, state.place, state.stage
     done = stage == _DONE
     makespan = jnp.max(jnp.where(done, finish, 0.0))
-    # Egress: Σ_edges cost(zone_p → zone_i) · output_mb(p) / 8000, counting
-    # only edges whose BOTH endpoints were actually placed (an unplaced
-    # consumer at the horizon must not be billed as if on host 0).
-    # Group-wise: with zcp[g, s] = placed instances of g in zone s, the sum
-    # over instance pairs of one group edge (g → c) is exactly
-    # (zcp @ cost @ zcpᵀ)[g, c] — three small matmuls instead of a
-    # per-replica [T, T] edge tensor.
+    # Egress: one bill per DES-sampled pull (see _sampled_egress), counting
+    # only pulls whose consumer was actually placed (an unplaced consumer
+    # at the horizon must not be billed as if on host 0).
     pz = topo.host_zone[jnp.clip(place, 0, H - 1)]
     placed = (place >= 0).astype(dtype)
     Z = topo.cost.shape[0]
     zcp = workload.group_onehot.T @ (
         jax.nn.one_hot(pz, Z, dtype=dtype) * placed[:, None]
     )  # [G, Z] placed-instance counts
-    pair_cost = zcp @ topo.cost @ zcp.T  # [G, G]: (producer g, consumer c)
-    egress = (
-        jnp.sum(workload.pred_group.T * pair_cost * workload.out_group[:, None])
-        / 8000.0
-    )
+    egress = _sampled_egress(workload, topo, zcp, pz, placed)
     return RolloutResult(
         makespan=makespan,
         egress_cost=egress,
         finish_time=finish,
         placement=place,
         n_unfinished=jnp.sum(~done),
+        instance_hours=state.busy / 3600.0,
     )
 
 
@@ -474,12 +585,13 @@ def _single_rollout(
     score_params=None,
     policy: str = "cost-aware",
     task_u=None,
+    congestion: bool = False,
 ) -> RolloutResult:
-    state = _init_state(avail0, workload.n_tasks)
+    state = _init_state(avail0, workload.n_tasks, topo.cost.shape[0])
     state = _rollout_segment(
         state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks,
         faults=faults, totals=avail0, score_params=score_params,
-        policy=policy, task_u=task_u,
+        policy=policy, task_u=task_u, congestion=congestion,
     )
     return _finalize(state, workload, topo)
 
@@ -581,37 +693,26 @@ def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
     jax.jit,
     static_argnames=(
         "n_replicas", "tick", "max_ticks", "perturb",
-        "n_faults", "fault_horizon", "mttr", "policy",
+        "n_faults", "fault_horizon", "mttr", "policy", "congestion",
     ),
 )
-def rollout(
+def _rollout_states(
     key,
-    avail0,  # [H, 4] initial availability (shared base)
+    avail0,
     workload: EnsembleWorkload,
     topo: DeviceTopology,
-    storage_zones,  # [S] i32 candidate root-anchor zones
-    n_replicas: int = 64,
-    tick: float = 5.0,
-    max_ticks: int = 512,
-    perturb: float = 0.1,
-    n_faults: int = 0,
-    fault_horizon: Optional[float] = None,
-    mttr: Optional[float] = None,
-    policy: str = "cost-aware",
-) -> RolloutResult:
-    """Vmapped Monte-Carlo rollout: [R]-leading-axis results.
-
-    Replica r perturbs task runtimes and arrivals by ``±perturb`` and draws
-    independent random root anchors — the BASELINE.json ensemble configs.
-
-    With ``n_faults > 0`` each replica additionally draws an independent
-    random host-crash schedule (``n_faults`` crashes uniform in
-    ``[0, fault_horizon)``, Exp(``mttr``) outages; see ``_fault_schedule``)
-    — resilience-under-failures what-if analysis as one device program,
-    where the DES needs one full simulation per fault scenario.
-    ``fault_horizon`` defaults to the nominal ``tick × max_ticks`` span.
-    ``avail0`` must be full host capacity (recovery resets to it).
-    """
+    storage_zones,
+    n_replicas: int,
+    tick: float,
+    max_ticks: int,
+    perturb: float,
+    n_faults: int,
+    fault_horizon: Optional[float],
+    mttr: Optional[float],
+    policy: str,
+    congestion: bool,
+) -> RolloutState:
+    """The jitted rollout body: [R]-stacked final states (no finalize)."""
     rt, arr, root_anchor = _perturbations(
         key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
@@ -627,21 +728,75 @@ def rollout(
         else None
     )
     extras, unpack = _pack_extras(faults, task_u)
+    Z = topo.cost.shape[0]
 
     def one(r, a, ra, *ex):
         f, u = unpack(*ex)
-        return _single_rollout(
-            avail0, r, a, ra, workload, topo, tick, max_ticks,
-            faults=f, policy=policy, task_u=u,
+        state = _init_state(avail0, workload.n_tasks, Z)
+        return _rollout_segment(
+            state, r, a, ra, workload, topo, tick, max_ticks,
+            faults=f, totals=avail0, policy=policy, task_u=u,
+            congestion=congestion,
         )
 
     return jax.vmap(one)(rt, arr, root_anchor, *extras)
 
 
+@jax.jit
+def _finalize_batch(
+    states: RolloutState, workload: EnsembleWorkload, topo: DeviceTopology
+) -> RolloutResult:
+    """The ONE finalize program shared by every execution path — plain,
+    sharded, and checkpointed rollouts all derive result metrics from
+    final states through this exact compiled computation, so segmented
+    runs are bit-identical to monolithic ones (XLA reduction order would
+    otherwise differ between a fused rollout+finalize program and a
+    standalone finalize)."""
+    return jax.vmap(lambda s: _finalize(s, workload, topo))(states)
+
+
+def rollout(
+    key,
+    avail0,  # [H, 4] initial availability (shared base)
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,  # [S] i32 candidate root-anchor zones
+    n_replicas: int = 64,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+    n_faults: int = 0,
+    fault_horizon: Optional[float] = None,
+    mttr: Optional[float] = None,
+    policy: str = "cost-aware",
+    congestion: bool = False,
+) -> RolloutResult:
+    """Vmapped Monte-Carlo rollout: [R]-leading-axis results.
+
+    Replica r perturbs task runtimes and arrivals by ``±perturb`` and draws
+    independent random root anchors — the BASELINE.json ensemble configs.
+
+    With ``n_faults > 0`` each replica additionally draws an independent
+    random host-crash schedule (``n_faults`` crashes uniform in
+    ``[0, fault_horizon)``, Exp(``mttr``) outages; see ``_fault_schedule``)
+    — resilience-under-failures what-if analysis as one device program,
+    where the DES needs one full simulation per fault scenario.
+    ``fault_horizon`` defaults to the nominal ``tick × max_ticks`` span.
+    ``avail0`` must be full host capacity (recovery resets to it).
+    """
+    states = _rollout_states(
+        key, avail0, workload, topo, storage_zones,
+        n_replicas=n_replicas, tick=tick, max_ticks=max_ticks,
+        perturb=perturb, n_faults=n_faults, fault_horizon=fault_horizon,
+        mttr=mttr, policy=policy, congestion=congestion,
+    )
+    return _finalize_batch(states, workload, topo)
+
+
 @functools.lru_cache(maxsize=32)
 def _sharded_rollout_fn(
     mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
-    mttr, policy,
+    mttr, policy, congestion,
 ):
     """Cached jitted rollout per (mesh, static config) — repeated calls
     (key sweeps, perturbation sweeps) reuse the compiled program."""
@@ -657,6 +812,7 @@ def _sharded_rollout_fn(
             fault_horizon=fault_horizon,
             mttr=mttr,
             policy=policy,
+            congestion=congestion,
         ),
         out_shardings=RolloutResult(
             makespan=out_shard,
@@ -664,6 +820,7 @@ def _sharded_rollout_fn(
             finish_time=NamedSharding(mesh, P("replica", None)),
             placement=NamedSharding(mesh, P("replica", None)),
             n_unfinished=out_shard,
+            instance_hours=out_shard,
         ),
     )
 
@@ -683,6 +840,7 @@ def sharded_rollout(
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
     policy: str = "cost-aware",
+    congestion: bool = False,
 ) -> RolloutResult:
     """Rollout with the replica axis sharded over ``mesh`` ('replica' axis).
 
@@ -694,7 +852,7 @@ def sharded_rollout(
     """
     fn = _sharded_rollout_fn(
         mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
-        mttr, policy,
+        mttr, policy, congestion,
     )
     return fn(key, avail0, workload, topo, storage_zones)
 
@@ -704,7 +862,7 @@ def sharded_rollout(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_replicas", "tick", "max_ticks", "perturb"),
+    static_argnames=("n_replicas", "tick", "max_ticks", "perturb", "congestion"),
 )
 def score_param_sweep(
     key,
@@ -717,6 +875,7 @@ def score_param_sweep(
     tick: float = 5.0,
     max_ticks: int = 512,
     perturb: float = 0.1,
+    congestion: bool = False,
 ) -> RolloutResult:
     """On-device policy autotuning: sweep the cost-aware score exponents.
 
@@ -741,7 +900,7 @@ def score_param_sweep(
         lambda sp: jax.vmap(
             lambda r, a, ra: _single_rollout(
                 avail0, r, a, ra, workload, topo, tick, max_ticks,
-                score_params=sp,
+                score_params=sp, congestion=congestion,
             )
         )(rt, arr, root_anchor)
     )
@@ -751,7 +910,7 @@ def score_param_sweep(
 # -- checkpoint / resume -----------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("tick", "policy"))
+@functools.partial(jax.jit, static_argnames=("tick", "policy", "congestion"))
 def _segment_step(
     state: RolloutState,
     rt,  # [R, T] perturbed runtimes (constant for the run — computed once)
@@ -765,6 +924,7 @@ def _segment_step(
     totals=None,  # [H, 4]
     policy: str = "cost-aware",
     task_u=None,  # [R, T] opportunistic uniforms
+    congestion: bool = False,
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
     extras, unpack = _pack_extras(faults, task_u)
@@ -774,6 +934,7 @@ def _segment_step(
         return _rollout_segment(
             s, r, a, ra, workload, topo, tick, segment_ticks,
             faults=f, totals=totals, policy=policy, task_u=u,
+            congestion=congestion,
         )
 
     return jax.vmap(seg)(state, rt, arr, root_anchor, *extras)
@@ -782,6 +943,7 @@ def _segment_step(
 def _fingerprint(
     key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
     storage_zones, fault_cfg=(0, None, None), policy="cost-aware",
+    congestion=False,
 ) -> str:
     """Hash of every input that determines the rollout trajectory —
     including array *contents*, so a checkpoint can never be resumed
@@ -797,6 +959,9 @@ def _fingerprint(
         # Appended only for fault runs so fault-free fingerprints — and
         # therefore every pre-existing checkpoint — are unchanged.
         base = base + (fault_cfg,)
+    if congestion:
+        # Appended only when the backlog model is on (same compat rule).
+        base = base + ("congestion",)
     h = hashlib.sha256(repr(base).encode())
     for tree in (workload, topo, (avail0, storage_zones)):
         for arr in jax.tree_util.tree_leaves(tree):
@@ -823,6 +988,7 @@ def rollout_checkpointed(
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
     policy: str = "cost-aware",
+    congestion: bool = False,
 ) -> RolloutResult:
     """:func:`rollout` with mid-flight checkpoint/resume.
 
@@ -852,26 +1018,27 @@ def rollout_checkpointed(
     fp = _fingerprint(
         key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
         storage_zones, fault_cfg=(n_faults, fault_horizon, mttr),
-        policy=policy,
+        policy=policy, congestion=congestion,
     )
 
     ticks_done = 0
     state = None
     if checkpoint_path and resume and os.path.exists(checkpoint_path):
         with np.load(checkpoint_path, allow_pickle=False) as ckpt:
-            if str(ckpt["fingerprint"]) == fp:
+            fields = set(RolloutState._fields)
+            if str(ckpt["fingerprint"]) == fp and fields <= set(ckpt.files):
+                # A checkpoint missing state fields (written by an older
+                # layout) is ignored rather than resumed partial — resume
+                # must be bit-identical or not happen at all.
                 state = RolloutState(
-                    t=jnp.asarray(ckpt["t"]),
-                    stage=jnp.asarray(ckpt["stage"]),
-                    finish=jnp.asarray(ckpt["finish"]),
-                    place=jnp.asarray(ckpt["place"]),
-                    avail=jnp.asarray(ckpt["avail"]),
+                    **{f: jnp.asarray(ckpt[f]) for f in RolloutState._fields}
                 )
                 ticks_done = int(ckpt["ticks_done"])
     if state is None:
-        state = jax.vmap(lambda _: _init_state(avail0, workload.n_tasks))(
-            jnp.arange(n_replicas)
-        )
+        Z = topo.cost.shape[0]
+        state = jax.vmap(
+            lambda _: _init_state(avail0, workload.n_tasks, Z)
+        )(jnp.arange(n_replicas))
 
     # Monte-Carlo draws are a pure function of ``key`` and constant for the
     # whole run: generated once here (and regenerated once on resume), not
@@ -904,6 +1071,7 @@ def rollout_checkpointed(
             totals=avail0,
             policy=policy,
             task_u=task_u,
+            congestion=congestion,
         )
         jax.block_until_ready(state)
         ticks_done += seg
@@ -913,12 +1081,8 @@ def rollout_checkpointed(
                 tmp,
                 fingerprint=fp,
                 ticks_done=ticks_done,
-                t=np.asarray(state.t),
-                stage=np.asarray(state.stage),
-                finish=np.asarray(state.finish),
-                place=np.asarray(state.place),
-                avail=np.asarray(state.avail),
+                **{f: np.asarray(v) for f, v in zip(RolloutState._fields, state)},
             )
             os.replace(tmp, checkpoint_path)
 
-    return jax.vmap(lambda s: _finalize(s, workload, topo))(state)
+    return _finalize_batch(state, workload, topo)

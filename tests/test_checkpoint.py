@@ -307,3 +307,21 @@ def test_checkpointed_policy_arm_matches_plain(setup, tmp_path):
             np.asarray(plain.egress_cost), np.asarray(seg.egress_cost),
             rtol=1e-6,
         )
+
+
+def test_checkpointed_congestion_rollout_matches_plain(setup, tmp_path):
+    """Segmented + checkpointed congestion rollout is bit-identical to the
+    monolithic one: the backlog pipe state q rides the checkpoint."""
+    avail0, w, topo, sz = setup
+    kw = dict(n_replicas=4, tick=5.0, max_ticks=64, perturb=0.1,
+              congestion=True)
+    plain = rollout(jax.random.PRNGKey(3), avail0, w, topo, sz, **kw)
+    ck = rollout_checkpointed(
+        jax.random.PRNGKey(3), avail0, w, topo, sz,
+        str(tmp_path / "cong.npz"), segment_ticks=7, **kw
+    )
+    assert np.array_equal(np.asarray(plain.makespan), np.asarray(ck.makespan))
+    assert np.array_equal(np.asarray(plain.placement), np.asarray(ck.placement))
+    assert np.array_equal(
+        np.asarray(plain.instance_hours), np.asarray(ck.instance_hours)
+    )
