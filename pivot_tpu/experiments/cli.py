@@ -255,6 +255,23 @@ def parse_args(argv=None):
                           "delays account for queued backlog on each "
                           "(src zone → dst host) pipe instead of assuming "
                           "uncontended bandwidth")
+    cal = sub.add_parser(
+        "calibrate",
+        help="quantify the ensemble estimator against DES ground truth: "
+             "same (trace, cluster, policy) through both engines, "
+             "side-by-side metrics with relative errors for the static "
+             "and congestion-aware transfer models",
+    )
+    cal.add_argument("--num-apps", type=int, dest="num_apps", default=50)
+    cal.add_argument("--policy", default="cost-aware",
+                     choices=["cost-aware", "first-fit", "best-fit",
+                              "opportunistic"])
+    cal.add_argument("--replicas", type=int, default=1,
+                     help="ensemble replicas (1 + --perturb 0 = nominal "
+                          "scenario; more = Monte-Carlo mean)")
+    cal.add_argument("--perturb", type=float, default=0.0)
+    cal.add_argument("--tick", type=float, default=5.0)
+    cal.add_argument("--max-ticks", type=int, default=4096)
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -396,29 +413,19 @@ def run_ensemble(args) -> dict:
     import numpy as np
 
     import jax
-    import jax.numpy as jnp
 
-    from pivot_tpu.ops.kernels import DeviceTopology
-    from pivot_tpu.parallel.ensemble import (
-        EnsembleWorkload,
-        rollout_checkpointed,
-        sharded_rollout,
-    )
+    from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
+    from pivot_tpu.parallel.ensemble import rollout_checkpointed, sharded_rollout
     from pivot_tpu.parallel.mesh import build_mesh
     from pivot_tpu.workload.trace import load_trace_jobs
 
     trace = _list_traces(args.job_dir, 1)[0]
     schedule = load_trace_jobs(trace, args.scale_factor).take(args.num_apps)
     apps = schedule.apps
-    arrivals = [ts for ts, bin_apps in schedule.bins for _ in bin_apps]
-    t0_arrival = arrivals[0] if arrivals else 0.0
-    arrivals = [a - t0_arrival for a in arrivals]  # rollout time starts at 0
-    workload = EnsembleWorkload.from_applications(apps, arrivals=arrivals)
-
     cluster = build_cluster(_cluster_config(args))
-    topo = DeviceTopology.from_cluster(cluster, jnp.float32)
-    avail0 = jnp.asarray(cluster.availability_matrix(), dtype=jnp.float32)
-    storage_zones = jnp.asarray(cluster.storage_zone_vector())
+    workload, _slices, _arrivals, topo, avail0, storage_zones = (
+        ensemble_inputs_from_schedule(schedule, cluster)
+    )
     key = jax.random.PRNGKey(args.seed)
     kw = dict(
         n_replicas=args.replicas,
@@ -493,6 +500,33 @@ def run_ensemble(args) -> dict:
     return summary
 
 
+def run_calibrate(args) -> dict:
+    """Estimator-fidelity report: DES vs ensemble on one (trace, policy)."""
+    import json
+
+    from pivot_tpu.experiments.calibrate import calibrate
+
+    trace = _list_traces(args.job_dir, 1)[0]
+    report = calibrate(
+        trace,
+        cluster=build_cluster(_cluster_config(args)),
+        n_apps=args.num_apps,
+        policy=args.policy,
+        scale_factor=args.scale_factor,
+        seed=args.seed,
+        tick=args.tick,
+        max_ticks=args.max_ticks,
+        replicas=args.replicas,
+        perturb=args.perturb,
+    )
+    out_dir = os.path.join(args.output_dir, "calibrate", str(int(time.time())))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    return report
+
+
 def main(argv=None) -> None:
     # Respect an explicit JAX_PLATFORMS pin at the config level too: the
     # accelerator site package force-updates jax_platforms at interpreter
@@ -512,6 +546,8 @@ def main(argv=None) -> None:
         print(plots.plot_transfers(exp_dir))
     elif args.command == "ensemble":
         run_ensemble(args)
+    elif args.command == "calibrate":
+        run_calibrate(args)
     else:
         exp_dir = run_num_apps(args)
         print(plots.plot_financial_cost(exp_dir, args.host_hourly_rate))

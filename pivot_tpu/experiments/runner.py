@@ -129,6 +129,9 @@ class ExperimentRun(LogMixin):
         schedule = load_trace_jobs(self.trace_file, self.output_size_scale_factor)
         if self.n_apps:
             schedule = schedule.take(self.n_apps)
+        # Kept for post-run inspection (app start/end times carry the
+        # simulated timestamps) — the calibration harness reads these.
+        self.schedule = schedule
 
         cluster.start()
         scheduler.start()

@@ -94,6 +94,7 @@ class EnsembleWorkload(NamedTuple):
     group_onehot: jax.Array  # [T, G] f32 — one_hot(group_of)
     pred_group: jax.Array  # [G, G] f32 — group-level adjacency
     out_group: jax.Array  # [G] per-group output size (MB)
+    app_of: jax.Array  # [T] i32 — owning application index per instance
 
     @property
     def n_tasks(self) -> int:
@@ -113,7 +114,7 @@ class EnsembleWorkload(NamedTuple):
         ``resources/__init__.py:263-267``).
         """
         demands, runtime, output, arrival = [], [], [], []
-        group_of, out_group = [], []
+        group_of, out_group, app_of = [], [], []
         offset = 0
         gi = 0
         edges = []
@@ -130,6 +131,7 @@ class EnsembleWorkload(NamedTuple):
                     output.append(g.output_size)
                     arrival.append(at)
                     group_of.append(gi)
+                    app_of.append(ai)
                 offset += g.instances
                 gi += 1
             for g in app.groups:
@@ -158,6 +160,7 @@ class EnsembleWorkload(NamedTuple):
             group_onehot=jnp.asarray(group_onehot, dtype=dtype),
             pred_group=jnp.asarray(pred_group, dtype=dtype),
             out_group=jnp.asarray(np.array(out_group), dtype=dtype),
+            app_of=jnp.asarray(np.asarray(app_of, dtype=np.int32)),
         )
 
 
@@ -495,12 +498,20 @@ def _rollout_segment(
         place = jnp.where(placed, placements, place)
         finish = jnp.where(placed, t + xfer_delay + runtime, finish)
 
-        # 6. Busy-host integral (instance-hours estimator): a host is busy
-        #    over this window iff a task is running on it after placement.
-        busy_host = jnp.zeros((H + 1,), bool).at[
-            jnp.where(stage == _RUNNING, place, H)
-        ].max(True)[:H]
-        busy = busy + tick * jnp.sum(busy_host.astype(dtype))
+        # 6. Busy-host integral (instance-hours estimator).  Tasks only
+        #    start at tick boundaries, so a host's busy interval inside
+        #    this window always begins at t and ends at the latest
+        #    resident finish (capped at the window) — the per-window
+        #    integral max_tasks(min(finish − t, tick)) is exact within
+        #    the rollout's own timing model, not a whole-tick rounding.
+        contrib = jnp.where(
+            stage == _RUNNING, jnp.clip(finish - t, 0.0, tick), 0.0
+        )
+        busy_host = jax.ops.segment_max(
+            contrib, jnp.where(stage == _RUNNING, place, H),
+            num_segments=H + 1,
+        )[:H]
+        busy = busy + jnp.sum(jnp.maximum(busy_host, 0.0))
 
         return (
             i + 1,
@@ -682,9 +693,16 @@ def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
         k_arr, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
         dtype=dtype,
     )
+    # Root anchors are shared PER APPLICATION, mirroring the DES cost-aware
+    # policy: all root task groups of one app bucket under the app and draw
+    # ONE random storage anchor (``sched/policies.py`` group_tasks; ref
+    # ``scheduler/cost_aware.py:38-39``).  Drawn as a [R, T] table indexed
+    # by app id (columns ≥ n_apps unused) so no static app count is needed,
+    # then gathered per task.
     anchor_idx = jax.random.randint(
         k_anchor, (n_replicas, T), 0, storage_zones.shape[0]
     )
+    anchor_idx = jnp.take(anchor_idx, workload.app_of, axis=1)
     root_anchor = storage_zones[anchor_idx].astype(jnp.int32)
     return rt, arr, root_anchor
 

@@ -624,3 +624,18 @@ def test_congestion_ignores_zero_output_predecessors(setup):
     assert np.array_equal(ft_b[:, -1], ft_c[:, -1])
     # ...while the contended b fan-in really was delayed by the backlog.
     assert (ft_c[:, 1:9] > ft_b[:, 1:9]).any()
+
+
+def test_instance_hours_subtick_runtime(setup):
+    """A 7 s task must bill 7 busy seconds, not two whole 5 s ticks."""
+    cluster, topo = setup
+    app = Application(
+        "sub", [TaskGroup("g", cpus=1, mem=256, runtime=7, output_size=0)]
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    res = rollout(
+        jax.random.PRNGKey(13), avail0, w, topo, sz,
+        n_replicas=2, tick=5.0, max_ticks=16, perturb=0.0,
+    )
+    assert np.allclose(np.asarray(res.instance_hours), 7.0 / 3600.0)

@@ -279,3 +279,29 @@ def test_resume_tolerates_executor_in_recorded_sentinel(tmp_path):
     with open(marker, "w") as f:
         json.dump(ident, f)
     assert _is_complete(spec)
+
+
+def test_calibrate_report_structure(tmp_path):
+    """DES-vs-ensemble calibration on a tiny slice: report carries both
+    engines' metrics with relative errors, and the nominal estimator gets
+    the makespan within the tick grid."""
+    from pivot_tpu.experiments.calibrate import calibrate
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    trace = "data/jobs/jobs-5000-200-172800-259200.npz"
+    report = calibrate(
+        trace,
+        cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        n_apps=2,
+        max_ticks=256,
+    )
+    assert report["n_apps"] == 2
+    for mode in ("static", "congested"):
+        est = report[mode]
+        assert est["unfinished_max"] == 0
+        err = est["rel_err"]
+        assert set(err) == {"avg_runtime", "egress_cost", "instance_hours",
+                            "makespan"}
+        # The estimator must land the nominal makespan within a few ticks
+        # of the exact simulation at this scale.
+        assert abs(err["makespan"]) < 0.05
