@@ -272,6 +272,32 @@ def parse_args(argv=None):
     cal.add_argument("--perturb", type=float, default=0.0)
     cal.add_argument("--tick", type=float, default=5.0)
     cal.add_argument("--max-ticks", type=int, default=4096)
+    at = sub.add_parser(
+        "autotune",
+        help="on-device scheduler-hyperparameter search: sweep the "
+             "cost-aware score exponents cost^a / (norm^c × bw^b) over a "
+             "grid, every candidate × Monte-Carlo replica in ONE device "
+             "program with paired draws (the reference would need one OS "
+             "process per cell)",
+    )
+    at.add_argument("--num-apps", type=int, dest="num_apps", default=50)
+    at.add_argument("--replicas", type=int, default=32,
+                    help="Monte-Carlo replicas per candidate")
+    at.add_argument("--perturb", type=float, default=0.1)
+    at.add_argument("--tick", type=float, default=5.0)
+    at.add_argument("--max-ticks", type=int, default=2048)
+    at.add_argument("--exponents", nargs="+", type=float,
+                    default=[0.5, 1.0, 2.0],
+                    help="candidate values for each of the three "
+                         "exponents; the grid is their cube (default "
+                         "3^3 = 27 candidates) plus the reference shape "
+                         "(1,1,1) if absent")
+    at.add_argument("--objective", choices=["makespan", "egress"],
+                    default="makespan",
+                    help="winner selection: mean makespan or mean egress")
+    at.add_argument("--congestion", action="store_true",
+                    help="score candidates under the link-contention "
+                         "transfer model")
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -405,6 +431,22 @@ def run_num_apps(args) -> str:
     return exp_dir
 
 
+def _ensemble_setup(args):
+    """(trace, schedule, workload, topo, avail0, storage_zones) — the one
+    trace→device-inputs preamble shared by the ``ensemble`` and
+    ``autotune`` subcommands."""
+    from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
+    from pivot_tpu.workload.trace import load_trace_jobs
+
+    trace = _list_traces(args.job_dir, 1)[0]
+    schedule = load_trace_jobs(trace, args.scale_factor).take(args.num_apps)
+    cluster = build_cluster(_cluster_config(args))
+    workload, _slices, _arrivals, topo, avail0, storage_zones = (
+        ensemble_inputs_from_schedule(schedule, cluster)
+    )
+    return trace, schedule, workload, topo, avail0, storage_zones
+
+
 def run_ensemble(args) -> dict:
     """BASELINE config 5: N perturbed what-if replicas of a trace workload,
     scheduled entirely on-device, sharded over every available chip."""
@@ -414,18 +456,13 @@ def run_ensemble(args) -> dict:
 
     import jax
 
-    from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
     from pivot_tpu.parallel.ensemble import rollout_checkpointed, sharded_rollout
     from pivot_tpu.parallel.mesh import build_mesh
-    from pivot_tpu.workload.trace import load_trace_jobs
 
-    trace = _list_traces(args.job_dir, 1)[0]
-    schedule = load_trace_jobs(trace, args.scale_factor).take(args.num_apps)
-    apps = schedule.apps
-    cluster = build_cluster(_cluster_config(args))
-    workload, _slices, _arrivals, topo, avail0, storage_zones = (
-        ensemble_inputs_from_schedule(schedule, cluster)
+    trace, schedule, workload, topo, avail0, storage_zones = (
+        _ensemble_setup(args)
     )
+    apps = schedule.apps
     key = jax.random.PRNGKey(args.seed)
     kw = dict(
         n_replicas=args.replicas,
@@ -527,6 +564,98 @@ def run_calibrate(args) -> dict:
     return report
 
 
+def run_autotune(args) -> dict:
+    """K-candidate × R-replica scheduler-hyperparameter grid search as one
+    device program (``score_param_sweep``); candidates share the same
+    Monte-Carlo draws, so comparisons are paired."""
+    import itertools
+    import json
+
+    import numpy as np
+
+    import jax
+
+    from pivot_tpu.parallel.ensemble import score_param_sweep
+
+    trace, schedule, workload, topo, avail0, storage_zones = (
+        _ensemble_setup(args)
+    )
+    grid = list(itertools.product(args.exponents, repeat=3))
+    if (1.0, 1.0, 1.0) not in grid:
+        # The reference shape is always evaluated so summary["reference"]
+        # reports measured scores, never a nearest-neighbor stand-in.
+        grid.append((1.0, 1.0, 1.0))
+    grid = np.array(grid, dtype=np.float32)  # [K, 3] (w_cost, w_bw, w_norm)
+
+    wall0 = time.perf_counter()
+    res = score_param_sweep(
+        jax.random.PRNGKey(args.seed), avail0, workload, topo, storage_zones,
+        grid, n_replicas=args.replicas, tick=args.tick,
+        max_ticks=args.max_ticks, perturb=args.perturb,
+        congestion=args.congestion,
+    )
+    jax.block_until_ready(res)
+    wall = time.perf_counter() - wall0
+
+    mk = np.asarray(res.makespan).mean(axis=1)  # [K]
+    eg = np.asarray(res.egress_cost).mean(axis=1)
+    unfinished = np.asarray(res.n_unfinished).max(axis=1)
+    objective = mk if args.objective == "makespan" else eg
+    # A candidate that cannot finish the workload inside the horizon is
+    # not a winner no matter its (understated) objective.
+    objective = np.where(unfinished > 0, np.inf, objective)
+    order = np.argsort(objective, kind="stable")
+    ref_idx = int(np.where((grid == 1.0).all(axis=1))[0][0])
+
+    candidates = [
+        {
+            "exponents": [float(x) for x in grid[k]],
+            "makespan_mean": float(mk[k]),
+            "egress_mean": float(eg[k]),
+            "unfinished_max": int(unfinished[k]),
+        }
+        for k in order
+    ]
+    if np.isfinite(objective).any():
+        best = candidates[0]
+    else:
+        # Every candidate hit the horizon: the means are truncated-rollout
+        # understatements and no winner exists.
+        logger.warning(
+            "all %d candidates left tasks unfinished at the horizon — "
+            "no winner; raise --max-ticks", len(grid),
+        )
+        best = None
+    summary = {
+        "trace": os.path.basename(trace),
+        "n_apps": len(schedule.apps),
+        "n_tasks": workload.n_tasks,
+        "n_hosts": args.n_hosts,
+        "replicas": args.replicas,
+        "perturb": args.perturb,
+        "congestion": args.congestion,
+        "objective": args.objective,
+        "grid_size": len(grid),
+        "rollouts": len(grid) * args.replicas,
+        "wall_s": round(wall, 3),
+        "best": best,
+        "reference": {
+            "exponents": [float(x) for x in grid[ref_idx]],
+            "makespan_mean": float(mk[ref_idx]),
+            "egress_mean": float(eg[ref_idx]),
+            "unfinished_max": int(unfinished[ref_idx]),
+        },
+        "candidates": candidates,
+    }
+    out_dir = os.path.join(args.output_dir, "autotune", str(int(time.time())))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    # The full table is in summary.json; print everything but it.
+    print(json.dumps({k: v for k, v in summary.items() if k != "candidates"}))
+    return summary
+
+
 def main(argv=None) -> None:
     # Respect an explicit JAX_PLATFORMS pin at the config level too: the
     # accelerator site package force-updates jax_platforms at interpreter
@@ -548,6 +677,8 @@ def main(argv=None) -> None:
         run_ensemble(args)
     elif args.command == "calibrate":
         run_calibrate(args)
+    elif args.command == "autotune":
+        run_autotune(args)
     else:
         exp_dir = run_num_apps(args)
         print(plots.plot_financial_cost(exp_dir, args.host_hourly_rate))

@@ -305,3 +305,32 @@ def test_calibrate_report_structure(tmp_path):
         # The estimator must land the nominal makespan within a few ticks
         # of the exact simulation at this scale.
         assert abs(err["makespan"]) < 0.05
+
+
+def test_cli_autotune_end_to_end(tmp_path):
+    """The autotune subcommand sweeps the score-exponent grid in one
+    device program and reports a finished winner plus the reference
+    shape's (1,1,1) paired scores."""
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    summary = cli.run_autotune(cli.parse_args([
+        "--num-hosts", "8", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "--seed", "3",
+        "autotune", "--num-apps", "2", "--replicas", "4",
+        "--max-ticks", "256", "--exponents", "0.5", "1.0",
+    ]))
+    assert summary["grid_size"] == 8
+    assert summary["rollouts"] == 32
+    assert summary["best"]["unfinished_max"] == 0
+    assert summary["reference"]["exponents"] == [1.0, 1.0, 1.0]
+    # Winner is by the chosen objective over finished candidates.
+    finished = [c for c in summary["candidates"] if c["unfinished_max"] == 0]
+    assert summary["best"]["makespan_mean"] == min(
+        c["makespan_mean"] for c in finished
+    )
+    import json
+
+    (run_dir,) = (out / "autotune").iterdir()
+    with open(run_dir / "summary.json") as f:
+        assert len(json.load(f)["candidates"]) == 8
