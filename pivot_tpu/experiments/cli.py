@@ -298,6 +298,35 @@ def parse_args(argv=None):
     at.add_argument("--congestion", action="store_true",
                     help="score candidates under the link-contention "
                          "transfer model")
+    cap = sub.add_parser(
+        "capacity",
+        help="on-device capacity planning: roll the workload out on K "
+             "candidate cluster sizes × R Monte-Carlo replicas in ONE "
+             "device program (paired draws) and report the cost/makespan "
+             "trade-off per size — the reference re-forks a full "
+             "experiment per cluster configuration",
+    )
+    cap.add_argument("--num-apps", type=int, dest="num_apps", default=50)
+    cap.add_argument("--host-counts", nargs="+", type=int, required=True,
+                     help="candidate cluster sizes (each ≤ --num-hosts); "
+                          "prefixes of the generated cluster, so zone "
+                          "balance is preserved")
+    cap.add_argument("--replicas", type=int, default=32)
+    cap.add_argument("--perturb", type=float, default=0.1)
+    cap.add_argument("--tick", type=float, default=5.0)
+    cap.add_argument("--max-ticks", type=int, default=2048)
+    cap.add_argument("--host-hourly-rate", type=float, default=0.932,
+                     help="$/host-hour for the total-cost column (ref "
+                          "alibaba/sim.py:44-45)")
+    cap.add_argument("--slo-makespan", type=float, default=None,
+                     help="pick the cheapest size whose MEAN makespan "
+                          "meets this bound (default: cheapest that "
+                          "finishes the workload)")
+    cap.add_argument("--policy", default="cost-aware",
+                     choices=["cost-aware", "first-fit", "best-fit",
+                              "opportunistic"])
+    cap.add_argument("--congestion", action="store_true",
+                     help="roll out under the link-contention model")
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -656,6 +685,105 @@ def run_autotune(args) -> dict:
     return summary
 
 
+def run_capacity(args) -> dict:
+    """K cluster sizes × R replicas in one device program; report the
+    financial cost per size and pick the cheapest candidate that meets
+    the makespan SLO (if any).
+
+    Two cost columns: ``busy_cost_mean`` bills busy instance-hours — the
+    reference's financial model (``alibaba/sim.py:132-165``), but nearly
+    invariant to cluster size since busy-hours ≈ total task work — and
+    ``total_cost_mean`` bills PROVISIONED capacity (hosts × makespan ×
+    rate + egress), the quantity a capacity decision actually trades
+    against the SLO.  Selection uses the provisioned cost.
+    """
+    import json
+
+    import numpy as np
+
+    import jax
+
+    from pivot_tpu.parallel.ensemble import capacity_grid, capacity_sweep
+
+    if max(args.host_counts) > args.n_hosts:
+        raise SystemExit(
+            f"error: --host-counts max {max(args.host_counts)} exceeds "
+            f"--num-hosts {args.n_hosts}"
+        )
+    trace, schedule, workload, topo, avail0, storage_zones = (
+        _ensemble_setup(args)
+    )
+    grid = capacity_grid(avail0, args.host_counts)
+
+    wall0 = time.perf_counter()
+    res = capacity_sweep(
+        jax.random.PRNGKey(args.seed), grid, workload, topo, storage_zones,
+        n_replicas=args.replicas, tick=args.tick, max_ticks=args.max_ticks,
+        perturb=args.perturb, policy=args.policy,
+        congestion=args.congestion,
+    )
+    jax.block_until_ready(res)
+    wall = time.perf_counter() - wall0
+
+    mk = np.asarray(res.makespan)  # [K, R]
+    eg = np.asarray(res.egress_cost)
+    ih = np.asarray(res.instance_hours)
+    unfinished = np.asarray(res.n_unfinished).max(axis=1)
+    hosts = np.asarray(args.host_counts, dtype=np.float64)
+    busy_cost = ih.mean(axis=1) * args.host_hourly_rate + eg.mean(axis=1)
+    provisioned_hours = hosts * mk.mean(axis=1) / 3600.0
+    total_cost = provisioned_hours * args.host_hourly_rate + eg.mean(axis=1)
+
+    candidates = [
+        {
+            "hosts": int(n),
+            "makespan_mean": float(mk[k].mean()),
+            "makespan_p95": float(np.percentile(mk[k], 95)),
+            "egress_mean": float(eg[k].mean()),
+            "instance_hours_mean": float(ih[k].mean()),
+            "busy_cost_mean": float(busy_cost[k]),
+            "provisioned_hours_mean": float(provisioned_hours[k]),
+            "total_cost_mean": float(total_cost[k]),
+            "unfinished_max": int(unfinished[k]),
+        }
+        for k, n in enumerate(args.host_counts)
+    ]
+    feasible = [
+        c for c in candidates
+        if c["unfinished_max"] == 0
+        and (args.slo_makespan is None
+             or c["makespan_mean"] <= args.slo_makespan)
+    ]
+    best = min(feasible, key=lambda c: c["total_cost_mean"], default=None)
+    if best is None:
+        logger.warning(
+            "no candidate size finishes the workload%s — raise "
+            "--host-counts or --max-ticks",
+            "" if args.slo_makespan is None else " within the SLO",
+        )
+    summary = {
+        "trace": os.path.basename(trace),
+        "n_apps": len(schedule.apps),
+        "n_tasks": workload.n_tasks,
+        "policy": args.policy,
+        "replicas": args.replicas,
+        "perturb": args.perturb,
+        "congestion": args.congestion,
+        "host_hourly_rate": args.host_hourly_rate,
+        "slo_makespan": args.slo_makespan,
+        "rollouts": len(args.host_counts) * args.replicas,
+        "wall_s": round(wall, 3),
+        "best": best,
+        "candidates": candidates,
+    }
+    out_dir = os.path.join(args.output_dir, "capacity", str(int(time.time())))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+    return summary
+
+
 def main(argv=None) -> None:
     # Respect an explicit JAX_PLATFORMS pin at the config level too: the
     # accelerator site package force-updates jax_platforms at interpreter
@@ -679,6 +807,8 @@ def main(argv=None) -> None:
         run_calibrate(args)
     elif args.command == "autotune":
         run_autotune(args)
+    elif args.command == "capacity":
+        run_capacity(args)
     else:
         exp_dir = run_num_apps(args)
         print(plots.plot_financial_cost(exp_dir, args.host_hourly_rate))

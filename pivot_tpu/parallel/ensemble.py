@@ -62,6 +62,8 @@ __all__ = [
     "EnsembleWorkload",
     "RolloutResult",
     "RolloutState",
+    "capacity_grid",
+    "capacity_sweep",
     "rollout",
     "rollout_checkpointed",
     "score_param_sweep",
@@ -923,6 +925,79 @@ def score_param_sweep(
         )(rt, arr, root_anchor)
     )
     return per_param(jnp.asarray(param_grid, avail0.dtype))
+
+
+# -- capacity planning --------------------------------------------------------
+
+
+def capacity_grid(avail0, host_counts) -> jax.Array:
+    """[K, H, 4] candidate capacity matrices: candidate k keeps the first
+    ``host_counts[k]`` hosts and masks the rest with the −1 down-host
+    sentinel (no fit can select them; they never accrue busy time).
+
+    Keeping a prefix preserves the generator's round-robin zone balance
+    (``infra/gen.py``), so every candidate is a smaller but equally
+    balanced cluster.
+    """
+    H = avail0.shape[0]
+    counts = jnp.asarray(host_counts, jnp.int32)
+    keep = jnp.arange(H)[None, :] < counts[:, None]  # [K, H]
+    return jnp.where(
+        keep[:, :, None], avail0[None, :, :], jnp.asarray(-1.0, avail0.dtype)
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_replicas", "tick", "max_ticks", "perturb", "policy", "congestion",
+    ),
+)
+def capacity_sweep(
+    key,
+    avail_grid,  # [K, H, 4] candidate capacity matrices (capacity_grid)
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    n_replicas: int = 32,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+    policy: str = "cost-aware",
+    congestion: bool = False,
+) -> RolloutResult:
+    """On-device capacity planning: how does the workload behave on K
+    candidate cluster sizes?  Every candidate × replica pair rolls out in
+    ONE device program ([K, R] leading axes) with shared Monte-Carlo
+    draws, so candidate comparisons are paired — "how many hosts do I
+    need?" costs one dispatch where the reference needs a full OS-process
+    experiment per cluster size (``alibaba/sim.py:168-196`` regenerates
+    the cluster and re-forks per configuration).
+
+    Downstream, combine ``instance_hours × hourly_rate + egress_cost``
+    for the cost/makespan trade-off (the reference's financial-cost
+    analysis, ``alibaba/sim.py:132-165``); candidates with
+    ``n_unfinished > 0`` are undersized for the horizon.
+    """
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail_grid.dtype
+    )
+    task_u = _opportunistic_uniforms(
+        key, n_replicas, workload.n_tasks, avail_grid.dtype
+    ) if policy == "opportunistic" else None
+    extras, unpack = _pack_extras(None, task_u)
+
+    def one_candidate(av):
+        def one(r, a, ra, *ex):
+            _f, u = unpack(*ex)
+            return _single_rollout(
+                av, r, a, ra, workload, topo, tick, max_ticks,
+                policy=policy, task_u=u, congestion=congestion,
+            )
+
+        return jax.vmap(one)(rt, arr, root_anchor, *extras)
+
+    return jax.vmap(one_candidate)(avail_grid)
 
 
 # -- checkpoint / resume -----------------------------------------------------

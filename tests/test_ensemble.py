@@ -639,3 +639,49 @@ def test_instance_hours_subtick_runtime(setup):
         n_replicas=2, tick=5.0, max_ticks=16, perturb=0.0,
     )
     assert np.allclose(np.asarray(res.instance_hours), 7.0 / 3600.0)
+
+
+# -- capacity planning --------------------------------------------------------
+
+
+def test_capacity_grid_masks_suffix(setup):
+    from pivot_tpu.parallel.ensemble import capacity_grid
+
+    cluster, topo = setup
+    avail0, _ = _ens_inputs(cluster)
+    grid = capacity_grid(avail0, [2, 8])
+    g = np.asarray(grid)
+    assert g.shape == (2, 8, 4)
+    assert np.array_equal(g[0, :2], np.asarray(avail0)[:2])
+    assert (g[0, 2:] == -1.0).all()
+    assert np.array_equal(g[1], np.asarray(avail0))
+
+
+def test_capacity_sweep_tradeoff(setup):
+    """More hosts can only help the makespan (paired draws), and masked
+    hosts never run tasks or accrue busy time."""
+    from pivot_tpu.parallel.ensemble import capacity_grid, capacity_sweep
+
+    cluster, topo = setup
+    app = Application(
+        "cap", [TaskGroup("g", cpus=8, mem=256, runtime=10, instances=16)]
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    grid = capacity_grid(avail0, [2, 8])
+    res = capacity_sweep(
+        jax.random.PRNGKey(14), grid, w, topo, sz,
+        n_replicas=4, tick=5.0, max_ticks=128, perturb=0.0,
+        policy="first-fit",
+    )
+    mk = np.asarray(res.makespan)  # [2, 4]
+    assert mk.shape == (2, 4)
+    assert int(np.asarray(res.n_unfinished).max()) == 0
+    # 16 8-cpu tasks: 2 hosts run 2/wave x 2 per host -> 4 waves; 8 hosts
+    # finish in 1 wave.
+    assert (mk[0] > mk[1]).all()
+    place = np.asarray(res.placement)
+    assert place[0].max() < 2  # masked hosts never selected
+    ih = np.asarray(res.instance_hours)
+    # 8-host candidate: 8 hosts x 10 s each = 80 host-seconds.
+    assert np.allclose(ih[1], 8 * 10.0 / 3600.0)

@@ -334,3 +334,32 @@ def test_cli_autotune_end_to_end(tmp_path):
     (run_dir,) = (out / "autotune").iterdir()
     with open(run_dir / "summary.json") as f:
         assert len(json.load(f)["candidates"]) == 8
+
+
+def test_cli_capacity_end_to_end(tmp_path):
+    """The capacity subcommand sweeps cluster sizes in one program and
+    picks the cheapest feasible size."""
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    summary = cli.run_capacity(cli.parse_args([
+        "--num-hosts", "16", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "--seed", "4",
+        "capacity", "--num-apps", "2", "--host-counts", "2", "8",
+        "--replicas", "4", "--max-ticks", "256",
+    ]))
+    assert summary["rollouts"] == 8
+    assert len(summary["candidates"]) == 2
+    assert summary["best"] is not None
+    feasible = [c for c in summary["candidates"] if c["unfinished_max"] == 0]
+    assert summary["best"]["total_cost_mean"] == min(
+        c["total_cost_mean"] for c in feasible
+    )
+    # SLO none of the sizes can meet -> no winner, explicit.
+    summary2 = cli.run_capacity(cli.parse_args([
+        "--num-hosts", "16", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "--seed", "4",
+        "capacity", "--num-apps", "2", "--host-counts", "2", "8",
+        "--replicas", "4", "--max-ticks", "256", "--slo-makespan", "1.0",
+    ]))
+    assert summary2["best"] is None
