@@ -29,6 +29,8 @@ __all__ = [
     "plot_financial_cost",
     "plot_host_usage",
     "plot_resource_usage",
+    "plot_ensemble_distribution",
+    "plot_capacity_frontier",
     "POLICY_ORDER",
 ]
 
@@ -179,6 +181,89 @@ def plot_financial_cost(exp_dir: str, host_hourly_rate: float = 0.932) -> str:
     plt.tight_layout()
     out = os.path.join(plot_dir, "cost.pdf")
     plt.savefig(out, format="pdf")
+    plt.close()
+    return out
+
+
+def plot_ensemble_distribution(run_dir: str, out: str = None) -> str:
+    """Replica-distribution figure for one ensemble run: the empirical CDF
+    of makespan across Monte-Carlo replicas, with the p5/p50/p95 quantiles
+    marked.  Reads the ``rollout.npz`` the ``ensemble`` subcommand writes.
+
+    No reference analog: the reference has one trajectory per (seeded) run
+    and nothing to take a distribution over.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    with np.load(os.path.join(run_dir, "rollout.npz")) as arrs:
+        mk = np.sort(np.asarray(arrs["makespan"], dtype=np.float64))
+    frac = np.arange(1, len(mk) + 1) / len(mk)
+    plt.figure(figsize=(7, 4))
+    plt.step(mk, frac, where="post", linewidth=2)
+    for q, name in ((5, "p5"), (50, "p50"), (95, "p95")):
+        v = float(np.percentile(mk, q))
+        plt.axvline(v, color="0.6", linewidth=1, linestyle=":")
+        plt.text(v, 0.03, f" {name}={v:.0f}s", fontsize=10, color="0.35",
+                 rotation=90, va="bottom")
+    plt.xlabel(f"Makespan (s) across {len(mk)} replicas", fontsize=13)
+    plt.ylabel("Fraction of replicas", fontsize=13)
+    plt.ylim(0, 1.02)
+    plt.grid(axis="y", color="0.9", linewidth=0.8)
+    plt.tight_layout()
+    out = out or os.path.join(run_dir, "makespan_cdf.pdf")
+    plt.savefig(out)
+    plt.close()
+    return out
+
+
+def plot_capacity_frontier(run_dir: str, out: str = None) -> str:
+    """Cost/makespan frontier over candidate cluster sizes: provisioned
+    total cost vs mean makespan, one point per size (direct-labeled),
+    connected in host-count order.  Reads the ``summary.json`` the
+    ``capacity`` subcommand writes.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    with open(os.path.join(run_dir, "summary.json")) as f:
+        summary = json.load(f)
+    cands = sorted(summary["candidates"], key=lambda c: c["hosts"])
+    done = [c for c in cands if c["unfinished_max"] == 0]
+    trunc = [c for c in cands if c["unfinished_max"] > 0]
+    plt.figure(figsize=(7, 4))
+    # Only finished candidates form the frontier line; horizon-truncated
+    # sizes (clamped lower bounds, not measurements) sit apart as ×.
+    plt.plot([c["makespan_mean"] for c in done],
+             [c["total_cost_mean"] for c in done],
+             marker="o", markersize=8, linewidth=2)
+    if trunc:
+        plt.scatter([c["makespan_mean"] for c in trunc],
+                    [c["total_cost_mean"] for c in trunc],
+                    marker="x", s=80, color="0.45", zorder=3)
+    plt.margins(x=0.15, y=0.15)  # keep point annotations inside the axes
+    for c in cands:
+        suffix = "" if c["unfinished_max"] == 0 else " (unfinished ≥)"
+        plt.annotate(f'{c["hosts"]} hosts{suffix}',
+                     (c["makespan_mean"], c["total_cost_mean"]), fontsize=10,
+                     textcoords="offset points", xytext=(8, 6))
+    best = summary.get("best")
+    if best:
+        plt.scatter([best["makespan_mean"]], [best["total_cost_mean"]],
+                    s=160, facecolors="none", edgecolors="0.2", linewidths=1.5,
+                    zorder=3)
+    plt.xlabel("Mean makespan (s)", fontsize=13)
+    plt.ylabel("Provisioned cost ($)", fontsize=13)
+    plt.title("hosts × makespan × hourly rate + egress", fontsize=10,
+              color="0.35")
+    plt.grid(color="0.9", linewidth=0.8)
+    plt.tight_layout()
+    out = out or os.path.join(run_dir, "capacity_frontier.pdf")
+    plt.savefig(out)
     plt.close()
     return out
 

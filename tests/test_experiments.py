@@ -363,3 +363,40 @@ def test_cli_capacity_end_to_end(tmp_path):
         "--replicas", "4", "--max-ticks", "256", "--slo-makespan", "1.0",
     ]))
     assert summary2["best"] is None
+
+
+def test_ensemble_and_capacity_figures(tmp_path):
+    """The ensemble and capacity subcommands render their figures."""
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    cli.run_ensemble(cli.parse_args([
+        "--num-hosts", "8", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "ensemble", "--num-apps", "2",
+        "--replicas", "8", "--max-ticks", "256",
+    ]))
+    (ens_dir,) = (out / "ensemble").iterdir()
+    assert (ens_dir / "makespan_cdf.pdf").stat().st_size > 0
+    cli.run_capacity(cli.parse_args([
+        "--num-hosts", "8", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "capacity", "--num-apps", "2",
+        "--host-counts", "2", "8", "--replicas", "4", "--max-ticks", "256",
+    ]))
+    (cap_dir,) = (out / "capacity").iterdir()
+    assert (cap_dir / "capacity_frontier.pdf").stat().st_size > 0
+
+
+def test_capacity_unfinished_candidate_clamped(tmp_path):
+    """A size that can't finish within the horizon reports makespan clamped
+    to the horizon (an honest lower bound), never an understated value."""
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    summary = cli.run_capacity(cli.parse_args([
+        "--num-hosts", "8", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "capacity", "--num-apps", "2",
+        "--host-counts", "1", "8", "--replicas", "2", "--max-ticks", "16",
+    ]))
+    by_hosts = {c["hosts"]: c for c in summary["candidates"]}
+    assert by_hosts[1]["unfinished_max"] > 0
+    assert by_hosts[1]["makespan_mean"] >= 5.0 * 16
