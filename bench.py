@@ -223,19 +223,9 @@ def main() -> None:
     # or failing probe, fall back to CPU before this process ever touches
     # the device runtime.
     if not backend_override:
-        import subprocess
+        from pivot_tpu.utils import probe_backend_alive
 
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-                timeout=150,
-                capture_output=True,
-                text=True,
-            )
-            alive = probe.returncode == 0 and "ok" in probe.stdout
-        except subprocess.TimeoutExpired:
-            alive = False
-        if not alive:
+        if not probe_backend_alive():
             os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
             backend_override = "cpu"
         elif hasattr(signal, "SIGALRM"):

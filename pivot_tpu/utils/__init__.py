@@ -65,3 +65,28 @@ def fresh_id(prefix: str) -> str:
 def reset_ids() -> None:
     """Reset id counters (used by tests for reproducibility)."""
     _id_counters.clear()
+
+
+def probe_backend_alive(timeout: float = 150.0) -> bool:
+    """True iff ``import jax; jax.devices()`` completes in a child process.
+
+    The first device touch blocks inside a PJRT client init that no signal
+    handler can interrupt when a remote accelerator backend is
+    unresponsive, so liveness must be probed in a disposable child
+    (killable regardless of where it blocks).  Any probe failure —
+    timeout, spawn error, nonzero exit — reads as "not alive"; the caller
+    decides the fallback.  Shared by ``bench.py`` and the device policy
+    backend (``pivot_tpu.sched.tpu``).
+    """
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+        return probe.returncode == 0 and "ok" in probe.stdout
+    except Exception:
+        return False

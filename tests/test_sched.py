@@ -175,3 +175,74 @@ def test_placement_respects_capacity(meta):
     env.run(until=200)
     assert app.is_finished
     assert not violations
+
+
+def test_ensure_live_backend_falls_back_on_dead_tunnel(monkeypatch):
+    """A wedged accelerator probe pins the CPU backend instead of letting
+    the first device touch hang the simulation.  Uses the deployment
+    default platform list 'axon,cpu' — cpu merely APPEARING in the list
+    must not skip the probe (the accelerator still initializes first)."""
+    import jax
+
+    import pivot_tpu.utils as utils
+    from pivot_tpu.sched import tpu as devmod
+
+    calls = {}
+
+    def fake_probe(*a, **kw):
+        calls["probed"] = True
+        return False
+
+    updates = {}
+    monkeypatch.setattr(devmod, "_live_backend_checked", False)
+    monkeypatch.setattr(utils, "probe_backend_alive", fake_probe)
+    monkeypatch.setattr(
+        jax.config, "update",
+        lambda k, v: updates.__setitem__(k, v),
+    )
+    # _ensure_live_backend reads jax.config.jax_platforms directly; shadow it.
+    monkeypatch.setattr(
+        type(jax.config), "jax_platforms",
+        property(lambda self: "axon,cpu"), raising=False,
+    )
+    devmod._ensure_live_backend()
+    assert calls.get("probed")
+    assert updates.get("jax_platforms") == "cpu"
+    # Second call is memoized: no second probe.
+    calls.clear()
+    devmod._ensure_live_backend()
+    assert "probed" not in calls
+
+
+def test_probe_backend_alive_failure_modes(monkeypatch):
+    """Spawn errors and timeouts both read as 'not alive' — never raise."""
+    import subprocess
+
+    from pivot_tpu.utils import probe_backend_alive
+
+    def spawn_error(*a, **kw):
+        raise OSError("fork failed")
+
+    monkeypatch.setattr(subprocess, "run", spawn_error)
+    assert probe_backend_alive() is False
+
+    def timed_out(*a, **kw):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=kw.get("timeout"))
+
+    monkeypatch.setattr(subprocess, "run", timed_out)
+    assert probe_backend_alive() is False
+
+
+def test_ensure_live_backend_skips_when_cpu_pinned(monkeypatch):
+    """Explicit CPU pin (tests, JAX_PLATFORMS=cpu) skips the probe."""
+    import subprocess
+
+    from pivot_tpu.sched import tpu as devmod
+
+    monkeypatch.setattr(devmod, "_live_backend_checked", False)
+
+    def boom(*a, **kw):
+        raise AssertionError("must not probe under an explicit cpu pin")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    devmod._ensure_live_backend()  # conftest pins jax_platforms to cpu
