@@ -165,6 +165,8 @@ def cost_aware_kernel(
     bin_pack: str = "first-fit",
     sort_hosts: bool = True,
     host_decay: bool = False,
+    rt_bw_rows=None,
+    rt_bw_idx=None,
 ):
     """The PIVOT cost-aware placement (ref cost_aware.py:28-127), fused.
 
@@ -183,6 +185,15 @@ def cost_aware_kernel(
     Round-trip cost/bandwidth per (anchor-zone, host) are precomputed once
     as ``[Z, H]`` tables outside the scan, so per tick only the ``[T]``
     anchor-zone vector crosses host→device.
+
+    ``rt_bw_rows`` ([G, H]) + ``rt_bw_idx`` ([T] i32, row per task)
+    together override the static bandwidth table with caller-supplied
+    round-trip bandwidths — the ``realtime_bw`` scoring mode, where the
+    anchor↔host values come from live route queue state
+    (``infra.network.Route.realtime_bw``, ref ``resources/network.py:
+    70-73``) sampled host-side at the tick instant.  One row per anchor
+    GROUP plus a per-task index keeps the per-tick host→device transfer
+    at G × H + T values instead of a dense task-replicated [T, H].
 
     First-fit: the group's host score ``cost·decay / (‖avail‖·bw)`` is
     frozen when the scan enters the group (matching the reference's
@@ -208,9 +219,13 @@ def cost_aware_kernel(
 
     def body(carry, x):
         avail, frozen_score, extra = carry
-        demand, valid_i, new_g, az = x
+        if rt_bw_rows is None:
+            demand, valid_i, new_g, az = x
+            bw_row = bw_rt[az]
+        else:
+            demand, valid_i, new_g, az, row_idx = x
+            bw_row = rt_bw_rows[row_idx]
         cost_row = cost_rt[az]
-        bw_row = bw_rt[az]
         if first_fit:
             score = jnp.where(
                 new_g, group_score(avail, cost_row, bw_row), frozen_score
@@ -241,9 +256,10 @@ def cost_aware_kernel(
         jnp.zeros(H, dtype=avail.dtype),
         jnp.zeros(H, dtype=jnp.int32),
     )
-    (avail, _, _), placements = lax.scan(
-        body, init, (demands, valid, new_group, anchor_zone)
-    )
+    xs = (demands, valid, new_group, anchor_zone)
+    if rt_bw_rows is not None:
+        xs = xs + (rt_bw_idx,)
+    (avail, _, _), placements = lax.scan(body, init, xs)
     return placements, avail
 
 

@@ -411,27 +411,43 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         sort_tasks: bool = False,
         sort_hosts: bool = False,
         host_decay: bool = False,
+        realtime_bw: bool = False,
         use_pallas: Optional[bool] = None,
         adaptive: bool = False,
     ):
         super().__init__(adaptive)
         assert bin_pack in ("first-fit", "best-fit")
+        if realtime_bw and use_pallas:
+            raise ValueError(
+                "realtime_bw is served by the scan kernel only — the "
+                "Pallas kernel has no live-bandwidth input; drop "
+                "use_pallas=True"
+            )
         self.bin_pack = bin_pack
         self.sort_tasks = sort_tasks
         self.sort_hosts = sort_hosts
         self.host_decay = host_decay
+        #: Score with live route-queue bandwidth instead of the static
+        #: table: the anchor↔host realtime values are sampled host-side at
+        #: the tick instant (the queues live on the event kernel, not the
+        #: device) and fed to the kernel as one [H] row per anchor group
+        #: plus a per-task row index.
+        self.realtime_bw = realtime_bw
         # The Pallas greedy kernel keeps the whole tick in VMEM (~5× the
         # scan kernel per tick on a v5e) but is f32-only; auto-enable on
         # the TPU backend, keep the scan kernel for CPU/f64 parity runs.
         self.use_pallas = use_pallas
         # Grouping logic shared verbatim with the CPU policy; the same
         # object doubles as the adaptive numpy twin (its place() draws the
-        # identical RNG sequence — one randomizer.choice per root group).
+        # identical RNG sequence — one randomizer.choice per root group)
+        # AND as the realtime-bandwidth sampler, so the kernel scores with
+        # bit-identical inputs to the twin.
         self._grouper = CostAwarePolicy(
             bin_pack=bin_pack,
             sort_tasks=sort_tasks,
             sort_hosts=sort_hosts,
             host_decay=host_decay,
+            realtime_bw=realtime_bw,
         )
         self._cpu_twin = self._grouper
 
@@ -444,16 +460,27 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         order: List[int] = []
         anchor_zone = []
         new_group = []
+        group_rows = [] if self.realtime_bw else None
+        row_idx = [] if self.realtime_bw else None
         for anchor, idxs in groups.items():
             if not hasattr(anchor, "locality"):  # root group → random storage
                 anchor = storage[int(ctx.scheduler.randomizer.choice(len(storage)))]
             if self.sort_tasks:
                 idxs = _sort_decreasing(ctx.demands, idxs)
             az = meta.zone_index[anchor.locality]
+            if group_rows is not None:
+                # Live anchor↔host round-trip bandwidth at the tick
+                # instant, via the SAME sampler the numpy twin scores
+                # with (CostAwarePolicy._roundtrip_vectors) — one row per
+                # anchor group, indexed per task below.
+                _, bw_rt = self._grouper._roundtrip_vectors(ctx, anchor)
+                group_rows.append(bw_rt)
             for j, i in enumerate(idxs):
                 order.append(i)
                 anchor_zone.append(az)
                 new_group.append(j == 0)
+                if row_idx is not None:
+                    row_idx.append(len(group_rows) - 1)
 
         B = pad_bucket(T)
         az_arr = np.zeros(B, dtype=np.int32)
@@ -466,8 +493,28 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             import jax
 
             use_pallas = (
-                jax.default_backend() == "tpu" and self.dtype == jnp.float32
+                jax.default_backend() == "tpu"
+                and self.dtype == jnp.float32
+                # The Pallas kernel has no realtime input; the scan
+                # kernel serves that mode on every backend (explicit
+                # use_pallas=True + realtime_bw is rejected in __init__).
+                and not self.realtime_bw
             )
+        kw = {}
+        if group_rows is not None:
+            # One [H] row per anchor group + a per-task row index: the
+            # per-tick host→device transfer is G × H + B values, not a
+            # dense task-replicated [B, H].  The group axis pads to a
+            # small bucket so XLA compiles one program per (G-bucket, B,
+            # H) shape, not per group count.
+            G = pad_bucket(max(len(group_rows), 1))
+            rows = np.ones((G, ctx.n_hosts), dtype=np.float64)
+            if group_rows:
+                rows[: len(group_rows)] = np.stack(group_rows)
+            idx = np.zeros(B, dtype=np.int32)
+            idx[:T] = row_idx
+            kw["rt_bw_rows"] = jnp.asarray(rows, dtype=self.dtype)
+            kw["rt_bw_idx"] = jnp.asarray(idx)
         kernel = cost_aware_pallas if use_pallas else cost_aware_kernel
         placements, _ = kernel(
             avail,
@@ -482,5 +529,6 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             bin_pack=self.bin_pack,
             sort_hosts=self.sort_hosts,
             host_decay=self.host_decay,
+            **kw,
         )
         return self._unpad(placements, T, order)

@@ -139,15 +139,12 @@ def make_policy(cfg: PolicyConfig):
                 decreasing=cfg.decreasing, adaptive=cfg.adaptive
             )
         if cfg.name == "cost-aware":
-            if cfg.realtime_bw:
-                raise ValueError(
-                    "realtime_bw needs the live route queues — CPU backends only"
-                )
             return dev.TpuCostAwarePolicy(
                 bin_pack=cfg.bin_pack,
                 sort_tasks=cfg.sort_tasks,
                 sort_hosts=cfg.sort_hosts,
                 host_decay=cfg.host_decay,
+                realtime_bw=cfg.realtime_bw,
                 adaptive=cfg.adaptive,
             )
         raise ValueError(f"unknown policy {cfg.name!r}")

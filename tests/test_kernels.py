@@ -270,3 +270,67 @@ def test_adaptive_full_sim_matches_numpy(meta):
         as_f64(TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True, adaptive=True))
     )
     assert m_np == m_ad
+
+
+def test_full_sim_parity_cost_aware_realtime_bw(meta):
+    """End-to-end realtime-bw scoring: the device policy samples live
+    anchor<->host route bandwidth at tick instants and must reproduce the
+    numpy policy's metrics exactly (CPU backend, f64)."""
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(20)
+    trace = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def run(policy):
+        s = ExperimentRun("parity", cluster, policy, trace, n_apps=20, seed=9).run()
+        return (s["avg_runtime"], s["egress_cost"], s["cum_instance_hours"])
+
+    m_cpu = run(CostAwarePolicy(sort_tasks=True, sort_hosts=True,
+                                realtime_bw=True, mode="numpy"))
+    m_dev = run(as_f64(TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True,
+                                          realtime_bw=True)))
+    assert m_cpu == m_dev
+
+
+def test_tick_parity_cost_aware_realtime_bw_with_queued_routes(meta):
+    """With data actually queued on a route at the tick instant, realtime
+    scoring diverges from static — and numpy and device agree on the
+    realtime result."""
+    ctx_np = make_ctx(meta, SHAPES * 4, random_groups(3)(), seed=5)
+    ctx_dev = make_ctx(meta, SHAPES * 4, random_groups(3)(), seed=5)
+    ctx_static = make_ctx(meta, SHAPES * 4, random_groups(3)(), seed=5)
+    for ctx in (ctx_np, ctx_dev):
+        # Congest the storage routes of every SECOND host: non-uniform
+        # queued MB slashes those hosts' realtime_bw (uniform congestion
+        # would rescale all scores equally and change nothing).
+        for s in ctx.cluster.storage:
+            for h in ctx.cluster.hosts[::2]:
+                route = ctx.cluster.get_route(s.id, h.id)
+                # Two sends: the first goes straight into service (and out
+                # of the queue), only the second counts as queued MB.
+                route.send(50 * route.bw, ctx.cluster.env.event())
+                route.send(50 * route.bw, ctx.cluster.env.event())
+
+    rt_np = CostAwarePolicy(sort_tasks=True, sort_hosts=True,
+                            realtime_bw=True, mode="numpy")
+    rt_dev = as_f64(TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True,
+                                       realtime_bw=True))
+    rt_dev.bind(ctx_dev.scheduler)
+    p_np = rt_np.place(ctx_np)
+    p_dev = rt_dev.place(ctx_dev)
+    assert p_np.tolist() == p_dev.tolist()
+    # The live queue state must actually steer the kernel: the same tick
+    # without congestion places differently.
+    p_static = CostAwarePolicy(sort_tasks=True, sort_hosts=True,
+                               realtime_bw=True, mode="numpy").place(ctx_static)
+    assert p_np.tolist() != p_static.tolist()
+
+
+def test_realtime_bw_rejects_explicit_pallas():
+    with pytest.raises(ValueError):
+        TpuCostAwarePolicy(realtime_bw=True, use_pallas=True)
