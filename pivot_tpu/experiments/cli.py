@@ -327,6 +327,28 @@ def parse_args(argv=None):
                               "opportunistic"])
     cap.add_argument("--congestion", action="store_true",
                      help="roll out under the link-contention model")
+    aps = sub.add_parser(
+        "apps",
+        help="on-device num-apps sweep: cost vs workload size for the "
+             "three reference policy arms, each arm one device program "
+             "over K app-counts × R Monte-Carlo replicas (paired draws) — "
+             "the estimator analog of the DES num-apps experiment",
+    )
+    aps.add_argument("--app-counts", nargs="+", type=int, required=True,
+                     help="candidate workload sizes (first N apps of the "
+                          "trace, in submission order)")
+    aps.add_argument("--replicas", type=int, default=32)
+    aps.add_argument("--perturb", type=float, default=0.1)
+    aps.add_argument("--tick", type=float, default=5.0)
+    aps.add_argument("--max-ticks", type=int, default=4096)
+    aps.add_argument("--host-hourly-rate", type=float, default=0.932)
+    aps.add_argument("--policies", nargs="+",
+                     default=["opportunistic", "first-fit", "cost-aware"],
+                     choices=["cost-aware", "first-fit", "best-fit",
+                              "opportunistic"],
+                     help="arms to sweep (default: the reference's three)")
+    aps.add_argument("--congestion", action="store_true",
+                     help="roll out under the link-contention model")
     args = parser.parse_args(argv)
     if args.command is None:
         parser.print_help()
@@ -799,6 +821,91 @@ def run_capacity(args) -> dict:
     return summary
 
 
+def run_apps(args) -> dict:
+    """Workload-size sweep per policy arm: one device program per arm over
+    K app-counts × R replicas; writes per-(arm, count) metrics and the
+    financial-cost figure (the reference's num-apps analysis,
+    ``alibaba/sim.py:132-165,199-230``, as an on-device estimate)."""
+    import json
+
+    import numpy as np
+
+    import jax
+
+    from pivot_tpu.parallel.ensemble import workload_sweep
+
+    args.num_apps = max(args.app_counts)
+    trace, schedule, workload, topo, avail0, storage_zones = (
+        _ensemble_setup(args)
+    )
+    n_loaded = len(schedule.apps)
+    # Sorted + deduped: the cost-vs-#apps lines connect points in row
+    # order, so unsorted user input would zigzag the figure.
+    counts = sorted({n for n in args.app_counts if n <= n_loaded})
+    if len(counts) < len(set(args.app_counts)):
+        logger.warning("trace has only %d apps — dropping larger counts",
+                       n_loaded)
+
+    wall0 = time.perf_counter()
+    arms = {}
+    for policy in args.policies:
+        res = workload_sweep(
+            jax.random.PRNGKey(args.seed), avail0, workload, topo,
+            storage_zones, counts, n_replicas=args.replicas,
+            tick=args.tick, max_ticks=args.max_ticks, perturb=args.perturb,
+            policy=policy, congestion=args.congestion,
+        )
+        jax.block_until_ready(res)
+        eg = np.asarray(res.egress_cost)  # [K, R]
+        ih = np.asarray(res.instance_hours)
+        mk = np.asarray(res.makespan)
+        unfinished = np.asarray(res.n_unfinished).max(axis=1)
+        # Same truncation clamp as run_capacity: an arm that strands tasks
+        # at the horizon reports max-finish-over-DONE only, which would
+        # make the WORST arm look fastest in the cross-arm comparison.
+        mk_mean = np.where(
+            unfinished > 0,
+            np.maximum(mk.mean(axis=1), args.tick * args.max_ticks),
+            mk.mean(axis=1),
+        )
+        arms[policy] = [
+            {
+                "n_apps": int(n),
+                "makespan_mean": float(mk_mean[k]),
+                "egress_mean": float(eg[k].mean()),
+                "instance_hours_mean": float(ih[k].mean()),
+                "host_cost_mean": float(
+                    ih[k].mean() * args.host_hourly_rate
+                ),
+                "unfinished_max": int(unfinished[k]),
+            }
+            for k, n in enumerate(counts)
+        ]
+    wall = time.perf_counter() - wall0
+
+    summary = {
+        "trace": os.path.basename(trace),
+        "n_hosts": args.n_hosts,
+        "app_counts": counts,
+        "replicas": args.replicas,
+        "perturb": args.perturb,
+        "congestion": args.congestion,
+        "host_hourly_rate": args.host_hourly_rate,
+        "rollouts": len(counts) * args.replicas * len(args.policies),
+        "wall_s": round(wall, 3),
+        "arms": arms,
+    }
+    out_dir = os.path.join(args.output_dir, "apps", str(int(time.time())))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    from pivot_tpu.experiments.plots import plot_apps_cost
+
+    plot_apps_cost(out_dir)
+    print(json.dumps(summary))
+    return summary
+
+
 def main(argv=None) -> None:
     # Respect an explicit JAX_PLATFORMS pin at the config level too: the
     # accelerator site package force-updates jax_platforms at interpreter
@@ -824,6 +931,8 @@ def main(argv=None) -> None:
         run_autotune(args)
     elif args.command == "capacity":
         run_capacity(args)
+    elif args.command == "apps":
+        run_apps(args)
     else:
         exp_dir = run_num_apps(args)
         print(plots.plot_financial_cost(exp_dir, args.host_hourly_rate))

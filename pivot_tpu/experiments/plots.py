@@ -31,12 +31,60 @@ __all__ = [
     "plot_resource_usage",
     "plot_ensemble_distribution",
     "plot_capacity_frontier",
+    "plot_apps_cost",
     "POLICY_ORDER",
 ]
 
 POLICY_ORDER = ["Opportunistic", "Cost-Aware", "VBP"]
 METRIC_ORDER = ["egress_cost", "cum_instance_hours", "avg_runtime"]
 METRIC_LABELS = ["egress cost", "host cost", "app. runtime"]
+
+#: Fixed per-policy colors (entity-stable: the same arm keeps its color no
+#: matter which subset of arms a figure shows), covering both the display
+#: labels the DES experiments use and the policy names the estimator uses.
+ENTITY_COLORS = {
+    "Opportunistic": "C0", "opportunistic": "C0",
+    "Cost-Aware": "C1", "cost-aware": "C1",
+    "VBP": "C2", "first-fit": "C2",
+    "best-fit": "C3",
+}
+
+
+def _plot_cost_lines(series, ylabel: str, out: str) -> str:
+    """Shared cost-vs-#apps renderer (solid = host $, dashed = egress $).
+
+    ``series``: label → list of (n_apps, egress, host) rows, any order —
+    rows are sorted by n_apps here.  Used by :func:`plot_financial_cost`
+    (DES results) and :func:`plot_apps_cost` (estimator results) so the
+    two analog figures cannot drift.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    markers = ["x", "+", "1", "2", "3"]
+    plt.figure(figsize=(8, 5))
+    items = sorted(series.items())
+    for solid in (True, False):
+        for i, (label, rows) in enumerate(items):
+            rows = sorted(rows)
+            xs = [r[0] for r in rows]
+            ys = [r[2] if solid else r[1] for r in rows]
+            plt.plot(
+                xs, ys,
+                ls="-" if solid else "--",
+                color=ENTITY_COLORS.get(label),
+                marker=markers[i % len(markers)], markersize=11,
+                label=f"{label} ({'host' if solid else 'egress'})",
+            )
+    plt.xlabel("# of running applications", fontsize=13)
+    plt.ylabel(ylabel, fontsize=13)
+    plt.legend(ncol=2, frameon=False, fontsize=10)
+    plt.tight_layout()
+    plt.savefig(out)
+    plt.close()
+    return out
 
 
 def _iterdirs(path: str) -> List[str]:
@@ -142,11 +190,6 @@ def plot_transfers(exp_dir: str) -> str:
 
 
 def plot_financial_cost(exp_dir: str, host_hourly_rate: float = 0.932) -> str:
-    import matplotlib
-
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
-
     data_dir, plot_dir = os.path.join(exp_dir, "data"), os.path.join(exp_dir, "plot")
     os.makedirs(plot_dir, exist_ok=True)
     # layout: data/<n_apps>/<iter>/<label>/general.json
@@ -161,28 +204,21 @@ def plot_financial_cost(exp_dir: str, host_hourly_rate: float = 0.932) -> str:
                 metrics[label][int(n_apps)].append(
                     (g["egress_cost"], g["cum_instance_hours"] * host_hourly_rate)
                 )
-    markers = ["x", "+", "1", "2", "3"]
-    plt.figure(figsize=(8, 5))
-    colors = {}
-    for i, (label, series) in enumerate(sorted(metrics.items())):
-        xs = sorted(series)
-        egress = [np.mean([v[0] for v in series[n]]) / 1000 for n in xs]
-        (line,) = plt.plot(xs, egress, ls="--", marker=markers[i % len(markers)],
-                           markersize=12, label=f"{label} (egress)")
-        colors[label] = line.get_color()
-    for i, (label, series) in enumerate(sorted(metrics.items())):
-        xs = sorted(series)
-        host = [np.mean([v[1] for v in series[n]]) / 1000 for n in xs]
-        plt.plot(xs, host, color=colors[label], marker=markers[i % len(markers)],
-                 markersize=12, label=f"{label} (host)")
-    plt.xlabel("# of running applications", fontsize=13)
-    plt.ylabel("Total host/egress cost ($1K)", fontsize=13)
-    plt.legend(ncol=2, frameon=False, fontsize=10)
-    plt.tight_layout()
-    out = os.path.join(plot_dir, "cost.pdf")
-    plt.savefig(out, format="pdf")
-    plt.close()
-    return out
+    series = {
+        label: [
+            (
+                n,
+                float(np.mean([v[0] for v in vals])) / 1000,
+                float(np.mean([v[1] for v in vals])) / 1000,
+            )
+            for n, vals in per_n.items()
+        ]
+        for label, per_n in metrics.items()
+    }
+    return _plot_cost_lines(
+        series, "Total host/egress cost ($1K)",
+        os.path.join(plot_dir, "cost.pdf"),
+    )
 
 
 def plot_ensemble_distribution(run_dir: str, out: str = None) -> str:
@@ -266,6 +302,28 @@ def plot_capacity_frontier(run_dir: str, out: str = None) -> str:
     plt.savefig(out)
     plt.close()
     return out
+
+
+def plot_apps_cost(run_dir: str, out: str = None) -> str:
+    """Estimator analog of the reference's financial-cost figure
+    (``alibaba/sim.py:132-165``): host/egress $ vs workload size per
+    policy arm, from the ``apps`` subcommand's ``summary.json`` —
+    rendered through the same :func:`_plot_cost_lines` body as the DES
+    figure, with entity-stable per-policy colors.
+    """
+    with open(os.path.join(run_dir, "summary.json")) as f:
+        summary = json.load(f)
+    series = {
+        policy: [
+            (r["n_apps"], r["egress_mean"], r["host_cost_mean"])
+            for r in rows
+        ]
+        for policy, rows in summary["arms"].items()
+    }
+    return _plot_cost_lines(
+        series, "Mean host/egress cost ($)",
+        out or os.path.join(run_dir, "apps_cost.pdf"),
+    )
 
 
 def plot_host_usage(run_dir: str, out: str = None) -> str:

@@ -68,6 +68,7 @@ __all__ = [
     "rollout_checkpointed",
     "score_param_sweep",
     "sharded_rollout",
+    "workload_sweep",
 ]
 
 
@@ -222,6 +223,7 @@ def _rollout_segment(
     policy: str = "cost-aware",  # | first-fit | best-fit | opportunistic
     task_u=None,  # [T] uniforms (opportunistic draws, one per task)
     congestion: bool = False,
+    active=None,  # optional [T] bool: early-exit ignores inactive tasks
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
     (stops early once every task is done).
@@ -249,8 +251,8 @@ def _rollout_segment(
         fault_host, fail_at, recover_at = faults
         fault_idx = jnp.where(fault_host >= 0, fault_host, H)  # pad → drop
 
-        def _scatter_hosts(active):  # [F] bool -> [H] bool
-            return jnp.zeros((H + 1,), bool).at[fault_idx].max(active)[:H]
+        def _scatter_hosts(hit):  # [F] bool fault mask -> [H] bool host mask
+            return jnp.zeros((H + 1,), bool).at[fault_idx].max(hit)[:H]
     # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
     cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
     bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
@@ -283,7 +285,12 @@ def _rollout_segment(
 
     def cond(carry):
         i, state = carry
-        return (i < n_ticks) & jnp.any(state.stage != _DONE)
+        pending = state.stage != _DONE
+        if active is not None:
+            # Masked-out tasks (workload-size sweeps) stay PENDING forever
+            # with arrival = inf; they must not keep the loop alive.
+            pending = pending & active
+        return (i < n_ticks) & jnp.any(pending)
 
     def body(carry):
         i, (t, stage, finish, place, avail, busy, q) = carry
@@ -558,7 +565,10 @@ def _sampled_egress(workload, topo, zcp, pz, placed):
 
 
 def _finalize(
-    state: RolloutState, workload: EnsembleWorkload, topo: DeviceTopology
+    state: RolloutState,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    active=None,  # optional [T] bool — inactive tasks don't count unfinished
 ) -> RolloutResult:
     H = state.avail.shape[0]
     dtype = state.avail.dtype
@@ -580,7 +590,7 @@ def _finalize(
         egress_cost=egress,
         finish_time=finish,
         placement=place,
-        n_unfinished=jnp.sum(~done),
+        n_unfinished=jnp.sum(~done if active is None else (~done & active)),
         instance_hours=state.busy / 3600.0,
     )
 
@@ -599,14 +609,15 @@ def _single_rollout(
     policy: str = "cost-aware",
     task_u=None,
     congestion: bool = False,
+    active=None,  # optional [T] bool — tasks outside the mask never run
 ) -> RolloutResult:
     state = _init_state(avail0, workload.n_tasks, topo.cost.shape[0])
     state = _rollout_segment(
         state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks,
         faults=faults, totals=avail0, score_params=score_params,
-        policy=policy, task_u=task_u, congestion=congestion,
+        policy=policy, task_u=task_u, congestion=congestion, active=active,
     )
-    return _finalize(state, workload, topo)
+    return _finalize(state, workload, topo, active=active)
 
 
 def _fault_schedule(key, n_replicas, n_faults, n_hosts, horizon, mttr, dtype):
@@ -998,6 +1009,65 @@ def capacity_sweep(
         return jax.vmap(one)(rt, arr, root_anchor, *extras)
 
     return jax.vmap(one_candidate)(avail_grid)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_replicas", "tick", "max_ticks", "perturb", "policy", "congestion",
+    ),
+)
+def workload_sweep(
+    key,
+    avail0,  # [H, 4]
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    app_counts,  # [K] i32 — candidate k runs the first app_counts[k] apps
+    n_replicas: int = 32,
+    tick: float = 5.0,
+    max_ticks: int = 2048,
+    perturb: float = 0.1,
+    policy: str = "cost-aware",
+    congestion: bool = False,
+) -> RolloutResult:
+    """On-device workload-size sweep: how do cost and makespan scale with
+    the number of applications?  Candidate k activates the first
+    ``app_counts[k]`` apps (later apps' tasks get arrival = ∞ and are
+    excluded from the unfinished count); every candidate × replica pair
+    rolls out in ONE device program with shared Monte-Carlo draws, so the
+    cost-vs-#apps curve (the reference's ``num-apps`` experiment,
+    ``alibaba/sim.py:199-230``) comes from one dispatch per policy arm
+    instead of one OS process per (arm, count, trace).
+
+    ``workload`` must carry the FULL app set; since DAG edges never cross
+    applications, masked tasks can neither gate readiness nor bill
+    egress.
+    """
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
+    task_u = _opportunistic_uniforms(
+        key, n_replicas, workload.n_tasks, avail0.dtype
+    ) if policy == "opportunistic" else None
+    extras, unpack = _pack_extras(None, task_u)
+    counts = jnp.asarray(app_counts, jnp.int32)
+    inf = jnp.asarray(jnp.inf, avail0.dtype)
+
+    def one_candidate(n_apps_k):
+        act = workload.app_of < n_apps_k  # [T]
+
+        def one(r, a, ra, *ex):
+            _f, u = unpack(*ex)
+            return _single_rollout(
+                avail0, r, jnp.where(act, a, inf), ra, workload, topo,
+                tick, max_ticks, policy=policy, task_u=u,
+                congestion=congestion, active=act,
+            )
+
+        return jax.vmap(one)(rt, arr, root_anchor, *extras)
+
+    return jax.vmap(one_candidate)(counts)
 
 
 # -- checkpoint / resume -----------------------------------------------------

@@ -685,3 +685,48 @@ def test_capacity_sweep_tradeoff(setup):
     ih = np.asarray(res.instance_hours)
     # 8-host candidate: 8 hosts x 10 s each = 80 host-seconds.
     assert np.allclose(ih[1], 8 * 10.0 / 3600.0)
+
+
+# -- workload-size sweep ------------------------------------------------------
+
+
+def test_workload_sweep_scales_with_app_count(setup):
+    """K app-count candidates in one program: masked apps never run, the
+    full-count candidate matches a plain rollout bit-for-bit, and egress
+    grows with workload size."""
+    from pivot_tpu.parallel.ensemble import workload_sweep
+
+    cluster, topo = setup
+    apps = [
+        Application(
+            f"a{i}",
+            [
+                TaskGroup("p", cpus=1, mem=256, runtime=5, output_size=4000),
+                TaskGroup("c", cpus=1, mem=256, runtime=5, instances=2,
+                          dependencies=["p"]),
+            ],
+        )
+        for i in range(4)
+    ]
+    w = EnsembleWorkload.from_applications(apps, arrivals=[0.0, 10.0, 20.0, 30.0])
+    avail0, sz = _ens_inputs(cluster)
+    kw = dict(n_replicas=2, tick=5.0, max_ticks=128, perturb=0.0,
+              policy="first-fit")
+    res = workload_sweep(
+        jax.random.PRNGKey(15), avail0, w, topo, sz, [1, 2, 4], **kw
+    )
+    assert np.asarray(res.makespan).shape == (3, 2)
+    assert int(np.asarray(res.n_unfinished).max()) == 0
+    place = np.asarray(res.placement)
+    # Candidate 0 runs only app 0's three tasks; the rest stay unplaced.
+    assert (place[0, :, 3:] == -1).all()
+    assert (place[0, :, :3] >= 0).all()
+    # Egress is monotone in workload size (same placements per prefix).
+    eg = np.asarray(res.egress_cost)
+    assert (eg[0] <= eg[1] + 1e-9).all() and (eg[1] <= eg[2] + 1e-9).all()
+    # Full-count candidate == plain rollout on the same draws.
+    full = rollout(jax.random.PRNGKey(15), avail0, w, topo, sz, **kw)
+    assert np.array_equal(place[2], np.asarray(full.placement))
+    assert np.array_equal(
+        np.asarray(res.makespan)[2], np.asarray(full.makespan)
+    )

@@ -403,3 +403,28 @@ def test_capacity_unfinished_candidate_clamped(tmp_path):
     by_hosts = {c["hosts"]: c for c in summary["candidates"]}
     assert by_hosts[1]["unfinished_max"] > 0
     assert by_hosts[1]["makespan_mean"] >= 5.0 * 16
+
+
+def test_cli_apps_sweep_end_to_end(tmp_path):
+    """The apps subcommand sweeps workload sizes per policy arm on-device
+    and renders the financial-cost figure."""
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    summary = cli.run_apps(cli.parse_args([
+        "--num-hosts", "8", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "--seed", "5",
+        "apps", "--app-counts", "1", "2", "--replicas", "2",
+        "--max-ticks", "512", "--policies", "cost-aware", "first-fit",
+    ]))
+    assert summary["rollouts"] == 8
+    assert set(summary["arms"]) == {"cost-aware", "first-fit"}
+    for rows in summary["arms"].values():
+        assert [r["n_apps"] for r in rows] == [1, 2]
+        assert all(r["unfinished_max"] == 0 for r in rows)
+        # Bigger workloads cannot shrink busy host-hours.
+        assert rows[0]["instance_hours_mean"] <= (
+            rows[1]["instance_hours_mean"] + 1e-6
+        )
+    (run_dir,) = (out / "apps").iterdir()
+    assert (run_dir / "apps_cost.pdf").stat().st_size > 0
