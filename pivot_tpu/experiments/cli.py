@@ -327,6 +327,18 @@ def parse_args(argv=None):
                               "opportunistic"])
     cap.add_argument("--congestion", action="store_true",
                      help="roll out under the link-contention model")
+    cap.add_argument("--faults", type=int, default=0, metavar="N",
+                     help="resilience-aware sizing: each replica draws an "
+                          "independent N-crash schedule, applied as the "
+                          "SAME physical failure trace to every candidate "
+                          "size (a crash on a host a small candidate "
+                          "doesn't have is a no-op there)")
+    cap.add_argument("--fault-horizon", type=float, default=None,
+                     help="crash times drawn uniform in [0, horizon) "
+                          "(default: tick x max-ticks)")
+    cap.add_argument("--fault-mttr", type=float, default=None,
+                     help="mean outage duration (Exp-distributed); "
+                          "omit for permanent crashes")
     aps = sub.add_parser(
         "apps",
         help="on-device num-apps sweep: cost vs workload size for the "
@@ -745,7 +757,8 @@ def run_capacity(args) -> dict:
         jax.random.PRNGKey(args.seed), grid, workload, topo, storage_zones,
         n_replicas=args.replicas, tick=args.tick, max_ticks=args.max_ticks,
         perturb=args.perturb, policy=args.policy,
-        congestion=args.congestion,
+        congestion=args.congestion, n_faults=args.faults,
+        fault_horizon=args.fault_horizon, mttr=args.fault_mttr,
     )
     jax.block_until_ready(res)
     wall = time.perf_counter() - wall0
@@ -803,6 +816,9 @@ def run_capacity(args) -> dict:
         "replicas": args.replicas,
         "perturb": args.perturb,
         "congestion": args.congestion,
+        "faults": args.faults,
+        "fault_horizon": args.fault_horizon,
+        "fault_mttr": args.fault_mttr,
         "host_hourly_rate": args.host_hourly_rate,
         "slo_makespan": args.slo_makespan,
         "rollouts": len(args.host_counts) * args.replicas,

@@ -962,6 +962,7 @@ def capacity_grid(avail0, host_counts) -> jax.Array:
     jax.jit,
     static_argnames=(
         "n_replicas", "tick", "max_ticks", "perturb", "policy", "congestion",
+        "n_faults", "fault_horizon", "mttr",
     ),
 )
 def capacity_sweep(
@@ -976,6 +977,9 @@ def capacity_sweep(
     perturb: float = 0.1,
     policy: str = "cost-aware",
     congestion: bool = False,
+    n_faults: int = 0,
+    fault_horizon: Optional[float] = None,
+    mttr: Optional[float] = None,
 ) -> RolloutResult:
     """On-device capacity planning: how does the workload behave on K
     candidate cluster sizes?  Every candidate × replica pair rolls out in
@@ -984,6 +988,17 @@ def capacity_sweep(
     need?" costs one dispatch where the reference needs a full OS-process
     experiment per cluster size (``alibaba/sim.py:168-196`` regenerates
     the cluster and re-forks per configuration).
+
+    With ``n_faults > 0`` each replica draws an independent random
+    host-crash schedule (shared across candidates — paired scenarios):
+    resilience-aware sizing, "how many hosts do I need *given* N crashes".
+    Crash hosts are drawn over the LARGEST candidate's host range (the
+    union of all candidates — drawing over the full base cluster would
+    silently dilute the fault count whenever the base is bigger than
+    every candidate); a crash landing on a host a smaller candidate
+    masked out is a no-op there, while the same crash hits the larger
+    candidates — the SAME physical failure trace applied to each
+    provisioning choice.
 
     Downstream, combine ``instance_hours × hourly_rate + egress_cost``
     for the cost/makespan trade-off (the reference's financial-cost
@@ -996,14 +1011,27 @@ def capacity_sweep(
     task_u = _opportunistic_uniforms(
         key, n_replicas, workload.n_tasks, avail_grid.dtype
     ) if policy == "opportunistic" else None
-    extras, unpack = _pack_extras(None, task_u)
+    faults = None
+    if n_faults:
+        # Hosts alive in ANY candidate (capacity_grid keeps prefixes, so
+        # this is the largest candidate's range).  jax.random.randint
+        # accepts a traced bound, so no static host count is needed.
+        n_alive = jnp.sum(jnp.any(avail_grid[:, :, 0] >= 0, axis=0))
+        horizon = (
+            fault_horizon if fault_horizon is not None else tick * max_ticks
+        )
+        faults = _fault_schedule(
+            jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
+            n_alive, horizon, mttr, avail_grid.dtype,
+        )
+    extras, unpack = _pack_extras(faults, task_u)
 
     def one_candidate(av):
         def one(r, a, ra, *ex):
-            _f, u = unpack(*ex)
+            f, u = unpack(*ex)
             return _single_rollout(
                 av, r, a, ra, workload, topo, tick, max_ticks,
-                policy=policy, task_u=u, congestion=congestion,
+                faults=f, policy=policy, task_u=u, congestion=congestion,
             )
 
         return jax.vmap(one)(rt, arr, root_anchor, *extras)
