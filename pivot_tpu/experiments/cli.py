@@ -494,6 +494,22 @@ def run_num_apps(args) -> str:
     return exp_dir
 
 
+def _maybe_shard_sweep(sweep_fn, **static_kw):
+    """Shard a what-if sweep over the devices (``ensemble.shard_sweep``),
+    logging when an indivisible replica count forces the unsharded path."""
+    import jax
+
+    from pivot_tpu.parallel.ensemble import shard_sweep
+
+    n_dev = len(jax.devices())
+    if n_dev > 1 and static_kw.get("n_replicas", 0) % n_dev:
+        logger.info(
+            "replicas (%s) not divisible by %d devices — running the "
+            "sweep unsharded", static_kw.get("n_replicas"), n_dev,
+        )
+    return shard_sweep(sweep_fn, **static_kw)
+
+
 def _ensemble_setup(args):
     """(trace, schedule, workload, topo, avail0, storage_zones) — the one
     trace→device-inputs preamble shared by the ``ensemble`` and
@@ -654,11 +670,14 @@ def run_autotune(args) -> dict:
     grid = np.array(grid, dtype=np.float32)  # [K, 3] (w_cost, w_bw, w_norm)
 
     wall0 = time.perf_counter()
-    res = score_param_sweep(
-        jax.random.PRNGKey(args.seed), avail0, workload, topo, storage_zones,
-        grid, n_replicas=args.replicas, tick=args.tick,
+    sweep = _maybe_shard_sweep(
+        score_param_sweep, n_replicas=args.replicas, tick=args.tick,
         max_ticks=args.max_ticks, perturb=args.perturb,
         congestion=args.congestion,
+    )
+    res = sweep(
+        jax.random.PRNGKey(args.seed), avail0, workload, topo, storage_zones,
+        grid,
     )
     jax.block_until_ready(res)
     wall = time.perf_counter() - wall0
@@ -753,12 +772,15 @@ def run_capacity(args) -> dict:
     grid = capacity_grid(avail0, args.host_counts)
 
     wall0 = time.perf_counter()
-    res = capacity_sweep(
-        jax.random.PRNGKey(args.seed), grid, workload, topo, storage_zones,
+    sweep = _maybe_shard_sweep(
+        capacity_sweep,
         n_replicas=args.replicas, tick=args.tick, max_ticks=args.max_ticks,
         perturb=args.perturb, policy=args.policy,
         congestion=args.congestion, n_faults=args.faults,
         fault_horizon=args.fault_horizon, mttr=args.fault_mttr,
+    )
+    res = sweep(
+        jax.random.PRNGKey(args.seed), grid, workload, topo, storage_zones,
     )
     jax.block_until_ready(res)
     wall = time.perf_counter() - wall0
@@ -865,11 +887,14 @@ def run_apps(args) -> dict:
     wall0 = time.perf_counter()
     arms = {}
     for policy in args.policies:
-        res = workload_sweep(
-            jax.random.PRNGKey(args.seed), avail0, workload, topo,
-            storage_zones, counts, n_replicas=args.replicas,
+        sweep = _maybe_shard_sweep(
+            workload_sweep, n_replicas=args.replicas,
             tick=args.tick, max_ticks=args.max_ticks, perturb=args.perturb,
             policy=policy, congestion=args.congestion,
+        )
+        res = sweep(
+            jax.random.PRNGKey(args.seed), avail0, workload, topo,
+            storage_zones, counts,
         )
         jax.block_until_ready(res)
         eg = np.asarray(res.egress_cost)  # [K, R]

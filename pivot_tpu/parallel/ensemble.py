@@ -67,7 +67,9 @@ __all__ = [
     "rollout",
     "rollout_checkpointed",
     "score_param_sweep",
+    "shard_sweep",
     "sharded_rollout",
+    "sweep_out_shardings",
     "workload_sweep",
 ]
 
@@ -886,6 +888,44 @@ def sharded_rollout(
         mttr, policy, congestion,
     )
     return fn(key, avail0, workload, topo, storage_zones)
+
+
+def sweep_out_shardings(mesh) -> RolloutResult:
+    """Output shardings for the [K, R, ...] what-if sweeps
+    (:func:`score_param_sweep`, :func:`capacity_sweep`,
+    :func:`workload_sweep`): the replica axis (axis 1) shards over the
+    mesh, candidates and task axes stay unsharded.  Most callers want
+    :func:`shard_sweep` instead.
+    """
+    two = NamedSharding(mesh, P(None, "replica"))
+    three = NamedSharding(mesh, P(None, "replica", None))
+    return RolloutResult(
+        makespan=two,
+        egress_cost=two,
+        finish_time=three,
+        placement=three,
+        n_unfinished=two,
+        instance_hours=two,
+    )
+
+
+def shard_sweep(sweep_fn, **static_kw):
+    """Bind a what-if sweep's static config and shard it over the
+    available devices ('replica' axis, like :func:`sharded_rollout`) —
+    XLA partitions the vmapped while_loops with zero cross-replica
+    traffic.  Falls back to the unsharded call on a single device or
+    when the replica count does not divide the mesh.
+    """
+    from pivot_tpu.parallel.mesh import build_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev <= 1 or static_kw.get("n_replicas", 0) % n_dev:
+        return functools.partial(sweep_fn, **static_kw)
+    mesh = build_mesh(n_dev, ("replica", "host"))
+    return jax.jit(
+        functools.partial(sweep_fn, **static_kw),
+        out_shardings=sweep_out_shardings(mesh),
+    )
 
 
 # -- policy autotuning --------------------------------------------------------

@@ -771,3 +771,61 @@ def test_capacity_sweep_with_faults_paired_across_sizes(setup):
         n_faults=3, fault_horizon=100.0, mttr=50.0, **kw
     )
     assert np.array_equal(np.asarray(solo.makespan)[0], mk_f[1])
+
+
+def test_sharded_sweeps_8_devices(setup):
+    """shard_sweep fans every what-if sweep's replica axis over the mesh,
+    with values identical to the unsharded run — and falls back to the
+    plain call when the replica count does not divide the devices."""
+    import functools
+
+    from pivot_tpu.parallel.ensemble import (
+        capacity_grid,
+        capacity_sweep,
+        score_param_sweep,
+        shard_sweep,
+        workload_sweep,
+    )
+
+    cluster, topo = setup
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    app = Application(
+        "sh", [TaskGroup("g", cpus=1, mem=256, runtime=10, instances=8)]
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    kw = dict(n_replicas=16, tick=5.0, max_ticks=64, perturb=0.1)
+
+    grid = capacity_grid(avail0, [2, 8])
+    plain = capacity_sweep(jax.random.PRNGKey(17), grid, w, topo, sz, **kw)
+    sharded = shard_sweep(capacity_sweep, **kw)(
+        jax.random.PRNGKey(17), grid, w, topo, sz
+    )
+    sharded.makespan.block_until_ready()
+    assert len(sharded.makespan.sharding.device_set) == 8
+    assert np.array_equal(
+        np.asarray(plain.makespan), np.asarray(sharded.makespan)
+    )
+
+    sharded_ws = shard_sweep(workload_sweep, **kw)(
+        jax.random.PRNGKey(17), avail0, w, topo, sz, [1]
+    )
+    sharded_ws.makespan.block_until_ready()
+    assert len(sharded_ws.makespan.sharding.device_set) == 8
+    assert int(np.asarray(sharded_ws.n_unfinished).max()) == 0
+
+    sharded_sp = shard_sweep(score_param_sweep, **kw)(
+        jax.random.PRNGKey(17), avail0, w, topo, sz,
+        np.array([[1.0, 1.0, 1.0], [2.0, 1.0, 0.5]], np.float32),
+    )
+    sharded_sp.makespan.block_until_ready()
+    assert sharded_sp.makespan.shape == (2, 16)
+    assert len(sharded_sp.makespan.sharding.device_set) == 8
+
+    # Indivisible replica count -> unsharded fallback, same values.
+    fb = shard_sweep(capacity_sweep, n_replicas=6, tick=5.0, max_ticks=64,
+                     perturb=0.1)
+    assert isinstance(fb, functools.partial)
+    res_fb = fb(jax.random.PRNGKey(17), grid, w, topo, sz)
+    assert np.asarray(res_fb.makespan).shape == (2, 6)
