@@ -255,6 +255,11 @@ def parse_args(argv=None):
                           "delays account for queued backlog on each "
                           "(src zone → dst host) pipe instead of assuming "
                           "uncontended bandwidth")
+    ens.add_argument("--realtime-score", action="store_true",
+                     dest="realtime_scoring",
+                     help="cost-aware scoring reads the backlog-discounted "
+                          "inbound bandwidth (the DES realtime_bw arm's "
+                          "estimator analog; implies --congestion)")
     cal = sub.add_parser(
         "calibrate",
         help="quantify the ensemble estimator against DES ground truth: "
@@ -327,6 +332,11 @@ def parse_args(argv=None):
                               "opportunistic"])
     cap.add_argument("--congestion", action="store_true",
                      help="roll out under the link-contention model")
+    cap.add_argument("--realtime-score", action="store_true",
+                     dest="realtime_scoring",
+                     help="cost-aware scoring reads the backlog-discounted "
+                          "inbound bandwidth (implies --congestion; "
+                          "cost-aware arm only)")
     cap.add_argument("--faults", type=int, default=0, metavar="N",
                      help="resilience-aware sizing: each replica draws an "
                           "independent N-crash schedule, applied as the "
@@ -365,6 +375,11 @@ def parse_args(argv=None):
     if args.command is None:
         parser.print_help()
         parser.exit(1)
+    if getattr(args, "realtime_scoring", False) and args.policy != "cost-aware":
+        parser.error(
+            "--realtime-score applies to the cost-aware arm only — no "
+            "other policy scores on bandwidth"
+        )
     if args.network == "native":
         from pivot_tpu import native
 
@@ -552,7 +567,8 @@ def run_ensemble(args) -> dict:
         fault_horizon=args.fault_horizon,
         mttr=args.fault_mttr,
         policy=args.policy,
-        congestion=args.congestion,
+        congestion=args.congestion or args.realtime_scoring,
+        realtime_scoring=args.realtime_scoring,
     )
 
     wall0 = time.perf_counter()
@@ -587,7 +603,8 @@ def run_ensemble(args) -> dict:
         "faults": args.faults,
         "fault_horizon": args.fault_horizon,
         "fault_mttr": args.fault_mttr,
-        "congestion": args.congestion,
+        "congestion": args.congestion or args.realtime_scoring,
+        "realtime_scoring": args.realtime_scoring,
         "devices": len(jax.devices()),
         "makespan_mean": float(mk.mean()),
         "makespan_p5": float(np.percentile(mk, 5)),
@@ -776,7 +793,8 @@ def run_capacity(args) -> dict:
         capacity_sweep,
         n_replicas=args.replicas, tick=args.tick, max_ticks=args.max_ticks,
         perturb=args.perturb, policy=args.policy,
-        congestion=args.congestion, n_faults=args.faults,
+        congestion=args.congestion or args.realtime_scoring,
+        realtime_scoring=args.realtime_scoring, n_faults=args.faults,
         fault_horizon=args.fault_horizon, mttr=args.fault_mttr,
     )
     res = sweep(
@@ -837,7 +855,8 @@ def run_capacity(args) -> dict:
         "policy": args.policy,
         "replicas": args.replicas,
         "perturb": args.perturb,
-        "congestion": args.congestion,
+        "congestion": args.congestion or args.realtime_scoring,
+        "realtime_scoring": args.realtime_scoring,
         "faults": args.faults,
         "fault_horizon": args.fault_horizon,
         "fault_mttr": args.fault_mttr,

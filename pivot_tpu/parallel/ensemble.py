@@ -225,6 +225,7 @@ def _rollout_segment(
     policy: str = "cost-aware",  # | first-fit | best-fit | opportunistic
     task_u=None,  # [T] uniforms (opportunistic draws, one per task)
     congestion: bool = False,
+    realtime_scoring: bool = False,
     active=None,  # optional [T] bool: early-exit ignores inactive tasks
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
@@ -243,7 +244,23 @@ def _rollout_segment(
     the per-replica ``state.q`` backlog tensor (see the placement step for
     the exact pipe model); without it ``q`` is carried untouched, so the
     flag cannot perturb the default path.
+
+    With ``realtime_scoring`` (requires ``congestion``), the cost-aware
+    score's inbound-bandwidth term is discounted by the tick-start pipe
+    backlog — ``bw_in / (queued_mb + 1)``, the estimator analog of the
+    DES ``realtime_bw`` arm (``Route.realtime_bw``, ref
+    ``resources/network.py:70-73``): placement actively steers AROUND
+    congested links instead of merely paying for them.
     """
+    if realtime_scoring and not congestion:
+        raise ValueError("realtime_scoring needs congestion=True (the "
+                         "backlog state is the bandwidth signal)")
+    if realtime_scoring and policy != "cost-aware":
+        raise ValueError("realtime_scoring applies to the cost-aware arm "
+                         "only — no other policy scores on bandwidth")
+    if realtime_scoring and score_params is not None:
+        raise ValueError("realtime_scoring and parameterized score "
+                         "exponents are mutually exclusive")
     T = workload.n_tasks
     H = state.avail.shape[0]
     Z = topo.cost.shape[0]
@@ -392,6 +409,19 @@ def _rollout_segment(
         dem_p = workload.demands[order]
         az_p = anchor[order]
         u_p = task_u[order] if task_u is not None else None
+        if realtime_scoring and policy == "cost-aware":
+            # Discount the inbound leg of the round-trip bandwidth by the
+            # tick-start backlog on each (anchor zone → host) pipe — the
+            # outbound leg has no tracked queue and stays static.  This is
+            # the signal the DES realtime_bw arm reads from live route
+            # queues (ref ``resources/network.py:70-73``).  The where
+            # keeps empty pipes BIT-identical to the static table (the
+            # algebraic form bw_rt − bw_zh + bw_zh can round 1 ulp off).
+            score_bw_rt = jnp.where(
+                q > 0, bw_rt - bw_zh + bw_zh / (q + 1.0), bw_rt
+            )
+        else:
+            score_bw_rt = bw_rt
 
         def place_cond(c):
             j, _avail, _pl = c
@@ -407,7 +437,7 @@ def _rollout_segment(
             if policy == "cost-aware":
                 norm = jnp.sqrt(jnp.sum(avail * avail, axis=1))
                 if score_params is None:
-                    score = cost_rt[az_p[j]] / (norm * bw_rt[az_p[j]])
+                    score = cost_rt[az_p[j]] / (norm * score_bw_rt[az_p[j]])
                 else:
                     score = cost_pow[az_p[j]] / (
                         norm ** w_norm * bw_pow[az_p[j]]
@@ -611,13 +641,15 @@ def _single_rollout(
     policy: str = "cost-aware",
     task_u=None,
     congestion: bool = False,
+    realtime_scoring: bool = False,
     active=None,  # optional [T] bool — tasks outside the mask never run
 ) -> RolloutResult:
     state = _init_state(avail0, workload.n_tasks, topo.cost.shape[0])
     state = _rollout_segment(
         state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks,
         faults=faults, totals=avail0, score_params=score_params,
-        policy=policy, task_u=task_u, congestion=congestion, active=active,
+        policy=policy, task_u=task_u, congestion=congestion,
+        realtime_scoring=realtime_scoring, active=active,
     )
     return _finalize(state, workload, topo, active=active)
 
@@ -727,6 +759,7 @@ def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
     static_argnames=(
         "n_replicas", "tick", "max_ticks", "perturb",
         "n_faults", "fault_horizon", "mttr", "policy", "congestion",
+        "realtime_scoring",
     ),
 )
 def _rollout_states(
@@ -744,6 +777,7 @@ def _rollout_states(
     mttr: Optional[float],
     policy: str,
     congestion: bool,
+    realtime_scoring: bool,
 ) -> RolloutState:
     """The jitted rollout body: [R]-stacked final states (no finalize)."""
     rt, arr, root_anchor = _perturbations(
@@ -769,7 +803,7 @@ def _rollout_states(
         return _rollout_segment(
             state, r, a, ra, workload, topo, tick, max_ticks,
             faults=f, totals=avail0, policy=policy, task_u=u,
-            congestion=congestion,
+            congestion=congestion, realtime_scoring=realtime_scoring,
         )
 
     return jax.vmap(one)(rt, arr, root_anchor, *extras)
@@ -803,6 +837,7 @@ def rollout(
     mttr: Optional[float] = None,
     policy: str = "cost-aware",
     congestion: bool = False,
+    realtime_scoring: bool = False,
 ) -> RolloutResult:
     """Vmapped Monte-Carlo rollout: [R]-leading-axis results.
 
@@ -822,6 +857,7 @@ def rollout(
         n_replicas=n_replicas, tick=tick, max_ticks=max_ticks,
         perturb=perturb, n_faults=n_faults, fault_horizon=fault_horizon,
         mttr=mttr, policy=policy, congestion=congestion,
+        realtime_scoring=realtime_scoring,
     )
     return _finalize_batch(states, workload, topo)
 
@@ -829,7 +865,7 @@ def rollout(
 @functools.lru_cache(maxsize=32)
 def _sharded_rollout_fn(
     mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
-    mttr, policy, congestion,
+    mttr, policy, congestion, realtime_scoring,
 ):
     """Cached jitted rollout per (mesh, static config) — repeated calls
     (key sweeps, perturbation sweeps) reuse the compiled program."""
@@ -846,6 +882,7 @@ def _sharded_rollout_fn(
             mttr=mttr,
             policy=policy,
             congestion=congestion,
+            realtime_scoring=realtime_scoring,
         ),
         out_shardings=RolloutResult(
             makespan=out_shard,
@@ -874,6 +911,7 @@ def sharded_rollout(
     mttr: Optional[float] = None,
     policy: str = "cost-aware",
     congestion: bool = False,
+    realtime_scoring: bool = False,
 ) -> RolloutResult:
     """Rollout with the replica axis sharded over ``mesh`` ('replica' axis).
 
@@ -885,7 +923,7 @@ def sharded_rollout(
     """
     fn = _sharded_rollout_fn(
         mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
-        mttr, policy, congestion,
+        mttr, policy, congestion, realtime_scoring,
     )
     return fn(key, avail0, workload, topo, storage_zones)
 
@@ -1002,7 +1040,7 @@ def capacity_grid(avail0, host_counts) -> jax.Array:
     jax.jit,
     static_argnames=(
         "n_replicas", "tick", "max_ticks", "perturb", "policy", "congestion",
-        "n_faults", "fault_horizon", "mttr",
+        "realtime_scoring", "n_faults", "fault_horizon", "mttr",
     ),
 )
 def capacity_sweep(
@@ -1017,6 +1055,7 @@ def capacity_sweep(
     perturb: float = 0.1,
     policy: str = "cost-aware",
     congestion: bool = False,
+    realtime_scoring: bool = False,
     n_faults: int = 0,
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
@@ -1072,6 +1111,7 @@ def capacity_sweep(
             return _single_rollout(
                 av, r, a, ra, workload, topo, tick, max_ticks,
                 faults=f, policy=policy, task_u=u, congestion=congestion,
+                realtime_scoring=realtime_scoring,
             )
 
         return jax.vmap(one)(rt, arr, root_anchor, *extras)
@@ -1083,6 +1123,7 @@ def capacity_sweep(
     jax.jit,
     static_argnames=(
         "n_replicas", "tick", "max_ticks", "perturb", "policy", "congestion",
+        "realtime_scoring",
     ),
 )
 def workload_sweep(
@@ -1098,6 +1139,7 @@ def workload_sweep(
     perturb: float = 0.1,
     policy: str = "cost-aware",
     congestion: bool = False,
+    realtime_scoring: bool = False,
 ) -> RolloutResult:
     """On-device workload-size sweep: how do cost and makespan scale with
     the number of applications?  Candidate k activates the first
@@ -1130,7 +1172,8 @@ def workload_sweep(
             return _single_rollout(
                 avail0, r, jnp.where(act, a, inf), ra, workload, topo,
                 tick, max_ticks, policy=policy, task_u=u,
-                congestion=congestion, active=act,
+                congestion=congestion, realtime_scoring=realtime_scoring,
+                active=act,
             )
 
         return jax.vmap(one)(rt, arr, root_anchor, *extras)
@@ -1141,7 +1184,10 @@ def workload_sweep(
 # -- checkpoint / resume -----------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("tick", "policy", "congestion"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("tick", "policy", "congestion", "realtime_scoring"),
+)
 def _segment_step(
     state: RolloutState,
     rt,  # [R, T] perturbed runtimes (constant for the run — computed once)
@@ -1156,6 +1202,7 @@ def _segment_step(
     policy: str = "cost-aware",
     task_u=None,  # [R, T] opportunistic uniforms
     congestion: bool = False,
+    realtime_scoring: bool = False,
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
     extras, unpack = _pack_extras(faults, task_u)
@@ -1165,7 +1212,7 @@ def _segment_step(
         return _rollout_segment(
             s, r, a, ra, workload, topo, tick, segment_ticks,
             faults=f, totals=totals, policy=policy, task_u=u,
-            congestion=congestion,
+            congestion=congestion, realtime_scoring=realtime_scoring,
         )
 
     return jax.vmap(seg)(state, rt, arr, root_anchor, *extras)
@@ -1174,7 +1221,7 @@ def _segment_step(
 def _fingerprint(
     key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
     storage_zones, fault_cfg=(0, None, None), policy="cost-aware",
-    congestion=False,
+    congestion=False, realtime_scoring=False,
 ) -> str:
     """Hash of every input that determines the rollout trajectory —
     including array *contents*, so a checkpoint can never be resumed
@@ -1193,6 +1240,8 @@ def _fingerprint(
     if congestion:
         # Appended only when the backlog model is on (same compat rule).
         base = base + ("congestion",)
+    if realtime_scoring:
+        base = base + ("realtime_scoring",)
     h = hashlib.sha256(repr(base).encode())
     for tree in (workload, topo, (avail0, storage_zones)):
         for arr in jax.tree_util.tree_leaves(tree):
@@ -1220,6 +1269,7 @@ def rollout_checkpointed(
     mttr: Optional[float] = None,
     policy: str = "cost-aware",
     congestion: bool = False,
+    realtime_scoring: bool = False,
 ) -> RolloutResult:
     """:func:`rollout` with mid-flight checkpoint/resume.
 
@@ -1250,6 +1300,7 @@ def rollout_checkpointed(
         key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
         storage_zones, fault_cfg=(n_faults, fault_horizon, mttr),
         policy=policy, congestion=congestion,
+        realtime_scoring=realtime_scoring,
     )
 
     ticks_done = 0
@@ -1303,6 +1354,7 @@ def rollout_checkpointed(
             policy=policy,
             task_u=task_u,
             congestion=congestion,
+            realtime_scoring=realtime_scoring,
         )
         jax.block_until_ready(state)
         ticks_done += seg

@@ -829,3 +829,91 @@ def test_sharded_sweeps_8_devices(setup):
     assert isinstance(fb, functools.partial)
     res_fb = fb(jax.random.PRNGKey(17), grid, w, topo, sz)
     assert np.asarray(res_fb.makespan).shape == (2, 6)
+
+
+def test_realtime_scoring_steers_around_backlog(setup):
+    """Backlog on the best host's inbound pipe must flip the cost-aware
+    choice to another host (steering), be a no-op on empty pipes, and
+    refuse to run without the congestion state."""
+    from pivot_tpu.parallel.ensemble import _init_state, _rollout_segment
+
+    cluster, topo = setup
+    app = Application(
+        "rts", [TaskGroup("g", cpus=1, mem=256, runtime=5, output_size=10)]
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    Z = topo.cost.shape[0]
+    rt = jnp.asarray([5.0], jnp.float32)
+    arr = jnp.asarray([0.0], jnp.float32)
+    # Anchor in a zone with no hosts: every candidate is cross-zone, so
+    # cost > 0 and the bandwidth term actually discriminates.
+    ra = jnp.asarray([10], jnp.int32)
+
+    def one_tick(state):
+        return _rollout_segment(
+            state, rt, arr, ra, w, topo, 5.0, 1,
+            policy="cost-aware", congestion=True, realtime_scoring=True,
+        )
+
+    state0 = _init_state(avail0, 1, Z)
+    h_free = int(one_tick(state0).place[0])
+    assert h_free >= 0
+    # Pile backlog onto the winner's inbound pipe from the anchor zone.
+    loaded = state0._replace(q=state0.q.at[10, h_free].set(1e9))
+    h_steered = int(one_tick(loaded).place[0])
+    assert h_steered >= 0
+    assert h_steered != h_free
+
+    # Empty pipes -> identical behavior to plain congestion mode.
+    kw = dict(n_replicas=2, tick=5.0, max_ticks=64, perturb=0.0)
+    w0 = EnsembleWorkload.from_applications([chain_app()])
+    a = rollout(jax.random.PRNGKey(18), avail0, w0, topo, sz,
+                congestion=True, **kw)
+    b = rollout(jax.random.PRNGKey(18), avail0, w0, topo, sz,
+                congestion=True, realtime_scoring=True, **kw)
+    assert np.array_equal(np.asarray(a.placement), np.asarray(b.placement))
+
+    with pytest.raises(ValueError):
+        rollout(jax.random.PRNGKey(18), avail0, w0, topo, sz,
+                realtime_scoring=True, **kw)
+
+
+def test_realtime_scoring_checkpoint_bit_identical(setup, tmp_path):
+    from pivot_tpu.parallel.ensemble import rollout_checkpointed
+
+    cluster, topo = setup
+    app = Application(
+        "rtck",
+        [
+            TaskGroup("src", cpus=1, mem=256, runtime=5, output_size=20000),
+            TaskGroup("snk", cpus=1, mem=256, runtime=5, instances=8,
+                      dependencies=["src"]),
+        ],
+    )
+    w = EnsembleWorkload.from_applications([app])
+    avail0, sz = _ens_inputs(cluster)
+    kw = dict(n_replicas=2, tick=5.0, max_ticks=128, perturb=0.1,
+              congestion=True, realtime_scoring=True)
+    plain = rollout(jax.random.PRNGKey(19), avail0, w, topo, sz, **kw)
+    ck = rollout_checkpointed(
+        jax.random.PRNGKey(19), avail0, w, topo, sz,
+        str(tmp_path / "rt.npz"), segment_ticks=5, **kw
+    )
+    assert np.array_equal(np.asarray(plain.makespan), np.asarray(ck.makespan))
+    assert np.array_equal(
+        np.asarray(plain.placement), np.asarray(ck.placement)
+    )
+
+
+def test_realtime_scoring_guards(setup):
+    """Non-cost-aware arms and parameterized scores reject the flag."""
+    from pivot_tpu.parallel.ensemble import score_param_sweep
+
+    cluster, topo = setup
+    w = EnsembleWorkload.from_applications([chain_app()])
+    avail0, sz = _ens_inputs(cluster)
+    with pytest.raises(ValueError):
+        rollout(jax.random.PRNGKey(0), avail0, w, topo, sz,
+                n_replicas=2, max_ticks=16, policy="first-fit",
+                congestion=True, realtime_scoring=True)

@@ -428,3 +428,14 @@ def test_cli_apps_sweep_end_to_end(tmp_path):
         )
     (run_dir,) = (out / "apps").iterdir()
     assert (run_dir / "apps_cost.pdf").stat().st_size > 0
+
+
+def test_realtime_score_flag_rejects_non_cost_aware():
+    from pivot_tpu.experiments import cli
+
+    with pytest.raises(SystemExit):
+        cli.parse_args([
+            "ensemble", "--policy", "first-fit", "--realtime-score",
+        ])
+    args = cli.parse_args(["ensemble", "--realtime-score"])
+    assert args.realtime_scoring and args.policy == "cost-aware"
