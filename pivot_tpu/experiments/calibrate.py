@@ -69,8 +69,10 @@ def ensemble_inputs_from_schedule(schedule, cluster):
 
 
 def _des_ground_truth(cluster, policy_name, trace_file, n_apps, scale_factor,
-                      seed, interval):
+                      seed, interval, realtime=False):
     """Run the exact simulation; return its metric dict."""
+    import dataclasses
+
     from pivot_tpu.experiments.runner import ExperimentRun
     from pivot_tpu.utils.config import (
         PolicyConfig,
@@ -86,6 +88,8 @@ def _des_ground_truth(cluster, policy_name, trace_file, n_apps, scale_factor,
         (c for c in reference_policy_set("numpy") if c.name == policy_name),
         PolicyConfig(name=policy_name, device="numpy"),
     )
+    if realtime:
+        pc = dataclasses.replace(pc, realtime_bw=True)
     run = ExperimentRun(
         f"calibrate-{policy_name}", cluster, make_policy(pc), trace_file,
         output_size_scale_factor=scale_factor, n_apps=n_apps, seed=seed,
@@ -108,7 +112,7 @@ def _des_ground_truth(cluster, policy_name, trace_file, n_apps, scale_factor,
 
 def _estimate(workload, app_slices, arrivals, topo, avail0, storage_zones,
               policy_name, seed, tick, max_ticks, replicas, perturb,
-              congestion):
+              congestion, realtime_scoring=False):
     """One ensemble rollout → metric dict (means over replicas)."""
     import jax
 
@@ -118,6 +122,7 @@ def _estimate(workload, app_slices, arrivals, topo, avail0, storage_zones,
         jax.random.PRNGKey(seed), avail0, workload, topo, storage_zones,
         n_replicas=replicas, tick=tick, max_ticks=max_ticks,
         perturb=perturb, policy=policy_name, congestion=congestion,
+        realtime_scoring=realtime_scoring,
     )
     finish = np.asarray(res.finish_time)  # [R, T]
     app_runtimes = np.stack(
@@ -176,23 +181,41 @@ def calibrate(
     max_ticks: int = 4096,
     replicas: int = 1,
     perturb: float = 0.0,
-    modes: Sequence[str] = ("static", "congested"),
+    modes: Optional[Sequence[str]] = None,
+    realtime: bool = False,
 ) -> dict:
     """DES ground truth vs ensemble estimates for one (trace, policy) pair.
 
     With the default ``replicas=1, perturb=0.0`` the estimator runs the
     nominal scenario; larger replica counts with perturbation report the
-    Monte-Carlo mean instead.  Returns::
+    Monte-Carlo mean instead.  With ``realtime`` (cost-aware only), BOTH
+    engines switch to their bandwidth-aware variants — the DES scores on
+    live route queues (``realtime_bw``) and the estimator on the
+    backlog-discounted pipes (``congestion + realtime_scoring``) — and
+    the single reported mode is ``"realtime"``.  Returns::
 
       {"des": {...}, "static": {..., "rel_err": {...}},
        "congested": {..., "rel_err": {...}}, ...config keys...}
     """
     from pivot_tpu.utils.config import ClusterConfig, build_cluster
 
+    if realtime and policy != "cost-aware":
+        raise ValueError("realtime calibration applies to the cost-aware "
+                         "arm only")
+    if realtime and modes is not None:
+        raise ValueError("realtime=True fixes the mode to ('realtime',) — "
+                         "don't pass modes explicitly")
+    if not realtime and modes is not None and "realtime" in modes:
+        raise ValueError("mode 'realtime' needs realtime=True (otherwise "
+                         "the DES side would not be the realtime_bw arm — "
+                         "a mismatched comparison)")
+    if modes is None:
+        modes = ("static", "congested")
     if cluster is None:
         cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed))
     des, schedule = _des_ground_truth(
-        cluster, policy, trace_file, n_apps, scale_factor, seed, tick
+        cluster, policy, trace_file, n_apps, scale_factor, seed, tick,
+        realtime=realtime,
     )
     inputs = ensemble_inputs_from_schedule(schedule, cluster)
 
@@ -204,12 +227,16 @@ def calibrate(
         "policy": policy,
         "replicas": replicas,
         "perturb": perturb,
+        "realtime_variant": realtime,
         "des": des,
     }
+    if realtime:
+        modes = ("realtime",)
     for mode in modes:
         est = _estimate(
             *inputs, policy, seed, tick, max_ticks, replicas, perturb,
-            congestion=(mode == "congested"),
+            congestion=(mode in ("congested", "realtime")),
+            realtime_scoring=(mode == "realtime"),
         )
         report[mode] = _with_errors(est, des)
         if report[mode].get("horizon_exceeded"):

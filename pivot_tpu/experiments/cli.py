@@ -277,6 +277,10 @@ def parse_args(argv=None):
     cal.add_argument("--perturb", type=float, default=0.0)
     cal.add_argument("--tick", type=float, default=5.0)
     cal.add_argument("--max-ticks", type=int, default=4096)
+    cal.add_argument("--realtime", action="store_true",
+                     help="calibrate the bandwidth-aware variants against "
+                          "each other: DES realtime_bw arm vs estimator "
+                          "congestion + realtime scoring (cost-aware only)")
     at = sub.add_parser(
         "autotune",
         help="on-device scheduler-hyperparameter search: sweep the "
@@ -375,10 +379,13 @@ def parse_args(argv=None):
     if args.command is None:
         parser.print_help()
         parser.exit(1)
-    if getattr(args, "realtime_scoring", False) and args.policy != "cost-aware":
+    if (
+        getattr(args, "realtime_scoring", False)
+        or getattr(args, "realtime", False)
+    ) and args.policy != "cost-aware":
         parser.error(
-            "--realtime-score applies to the cost-aware arm only — no "
-            "other policy scores on bandwidth"
+            "--realtime-score/--realtime apply to the cost-aware arm only "
+            "— no other policy scores on bandwidth"
         )
     if args.network == "native":
         from pivot_tpu import native
@@ -654,6 +661,7 @@ def run_calibrate(args) -> dict:
         max_ticks=args.max_ticks,
         replicas=args.replicas,
         perturb=args.perturb,
+        realtime=args.realtime,
     )
     out_dir = os.path.join(args.output_dir, "calibrate", str(int(time.time())))
     os.makedirs(out_dir, exist_ok=True)

@@ -439,3 +439,26 @@ def test_realtime_score_flag_rejects_non_cost_aware():
         ])
     args = cli.parse_args(["ensemble", "--realtime-score"])
     assert args.realtime_scoring and args.policy == "cost-aware"
+
+
+def test_calibrate_realtime_mode():
+    """Realtime calibration compares the two bandwidth-aware variants and
+    reports a single 'realtime' mode."""
+    from pivot_tpu.experiments.calibrate import calibrate
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    report = calibrate(
+        "data/jobs/jobs-5000-200-172800-259200.npz",
+        cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        n_apps=2, max_ticks=256, realtime=True,
+    )
+    assert report["realtime_variant"] is True
+    assert "realtime" in report and "static" not in report
+    assert report["realtime"]["unfinished_max"] == 0
+    assert abs(report["realtime"]["rel_err"]["makespan"]) < 0.05
+    with pytest.raises(ValueError):
+        calibrate(
+            "data/jobs/jobs-5000-200-172800-259200.npz",
+            cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            n_apps=2, policy="first-fit", realtime=True,
+        )
