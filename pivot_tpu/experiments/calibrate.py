@@ -209,8 +209,10 @@ def calibrate(
         raise ValueError("mode 'realtime' needs realtime=True (otherwise "
                          "the DES side would not be the realtime_bw arm — "
                          "a mismatched comparison)")
-    if modes is None:
-        modes = ("static", "congested")
+    modes = (
+        ("realtime",) if realtime
+        else ("static", "congested") if modes is None else tuple(modes)
+    )
     if cluster is None:
         cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed))
     des, schedule = _des_ground_truth(
@@ -230,8 +232,6 @@ def calibrate(
         "realtime_variant": realtime,
         "des": des,
     }
-    if realtime:
-        modes = ("realtime",)
     for mode in modes:
         est = _estimate(
             *inputs, policy, seed, tick, max_ticks, replicas, perturb,

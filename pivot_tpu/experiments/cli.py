@@ -529,7 +529,10 @@ def _maybe_shard_sweep(sweep_fn, **static_kw):
             "replicas (%s) not divisible by %d devices — running the "
             "sweep unsharded", static_kw.get("n_replicas"), n_dev,
         )
-    return shard_sweep(sweep_fn, **static_kw)
+    # Unsharded fallback runs in bounded 64-tick device calls (the
+    # rollout_checkpointed rationale — remote-transport friendly);
+    # shard_sweep owns the fallback decision.
+    return shard_sweep(sweep_fn, fallback_segment_ticks=64, **static_kw)
 
 
 def _ensemble_setup(args):

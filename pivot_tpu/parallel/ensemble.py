@@ -689,30 +689,52 @@ def _make_fault_schedule(
 
 
 
-def _pack_extras(faults, task_u):
-    """Flatten the optional per-replica axes for a single vmap body.
+def _pack_extras(faults=None, task_u=None, totals=None, score_params=None,
+                 active=None):
+    """Flatten the optional per-replica/per-row axes for a vmap body.
 
-    Returns (extras_list, unpack) where ``unpack(*ex)`` rebuilds
-    ``(faults_tuple_or_None, task_u_or_None)`` — the ONE place the
-    positional bookkeeping lives, shared by :func:`rollout` and
-    :func:`_segment_step` so the two execution paths cannot drift.
+    Returns ``(spec, extras_list)``; ``spec`` is the static presence
+    tuple consumed by :func:`_unpack_extras` — together they are the ONE
+    place the positional bookkeeping lives, shared by :func:`rollout`,
+    :func:`_segment_step`, and the row-based sweep runner so the
+    execution paths cannot drift.  ``spec`` is hashable, so it can cross
+    a jit boundary as a static argument.
     """
+    spec = (
+        faults is not None, task_u is not None, totals is not None,
+        score_params is not None, active is not None,
+    )
     extras = []
     if faults is not None:
         extras.extend(faults)
-    if task_u is not None:
-        extras.append(task_u)
+    for x in (task_u, totals, score_params, active):
+        if x is not None:
+            extras.append(x)
+    return spec, extras
 
-    def unpack(*ex):
-        i = 0
-        f = None
-        if faults is not None:
-            f = (ex[0], ex[1], ex[2])
-            i = 3
-        u = ex[i] if task_u is not None else None
-        return f, u
 
-    return extras, unpack
+def _unpack_extras(spec, ex):
+    """Rebuild ``(faults, task_u, totals, score_params, active)`` from a
+    flat extras tuple, per the presence ``spec`` from :func:`_pack_extras`."""
+    has_f, has_u, has_tot, has_sp, has_act = spec
+    i = 0
+    f = u = tot = sp = act = None
+    if has_f:
+        f = (ex[0], ex[1], ex[2])
+        i = 3
+    if has_u:
+        u = ex[i]
+        i += 1
+    if has_tot:
+        tot = ex[i]
+        i += 1
+    if has_sp:
+        sp = ex[i]
+        i += 1
+    if has_act:
+        act = ex[i]
+        i += 1
+    return f, u, tot, sp, act
 
 
 def _opportunistic_uniforms(key, n_replicas, n_tasks, dtype):
@@ -794,11 +816,11 @@ def _rollout_states(
         if n_faults
         else None
     )
-    extras, unpack = _pack_extras(faults, task_u)
+    spec, extras = _pack_extras(faults, task_u)
     Z = topo.cost.shape[0]
 
     def one(r, a, ra, *ex):
-        f, u = unpack(*ex)
+        f, u, _tot, _sp, _act = _unpack_extras(spec, ex)
         state = _init_state(avail0, workload.n_tasks, Z)
         return _rollout_segment(
             state, r, a, ra, workload, topo, tick, max_ticks,
@@ -811,15 +833,22 @@ def _rollout_states(
 
 @jax.jit
 def _finalize_batch(
-    states: RolloutState, workload: EnsembleWorkload, topo: DeviceTopology
+    states: RolloutState,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    active=None,  # optional [B, T] bool, one mask per state row
 ) -> RolloutResult:
     """The ONE finalize program shared by every execution path — plain,
-    sharded, and checkpointed rollouts all derive result metrics from
-    final states through this exact compiled computation, so segmented
-    runs are bit-identical to monolithic ones (XLA reduction order would
-    otherwise differ between a fused rollout+finalize program and a
-    standalone finalize)."""
-    return jax.vmap(lambda s: _finalize(s, workload, topo))(states)
+    sharded, checkpointed rollouts and the row-based sweeps all derive
+    result metrics from final states through this exact compiled
+    computation, so segmented runs are bit-identical to monolithic ones
+    (XLA reduction order would otherwise differ between a fused
+    rollout+finalize program and a standalone finalize)."""
+    if active is None:
+        return jax.vmap(lambda s: _finalize(s, workload, topo))(states)
+    return jax.vmap(
+        lambda s, a: _finalize(s, workload, topo, active=a)
+    )(states, active)
 
 
 def rollout(
@@ -947,17 +976,23 @@ def sweep_out_shardings(mesh) -> RolloutResult:
     )
 
 
-def shard_sweep(sweep_fn, **static_kw):
+def shard_sweep(sweep_fn, fallback_segment_ticks=None, **static_kw):
     """Bind a what-if sweep's static config and shard it over the
     available devices ('replica' axis, like :func:`sharded_rollout`) —
     XLA partitions the vmapped while_loops with zero cross-replica
     traffic.  Falls back to the unsharded call on a single device or
-    when the replica count does not divide the mesh.
+    when the replica count does not divide the mesh; on that fallback,
+    ``fallback_segment_ticks`` (if set and not already in the config)
+    runs the sweep in bounded device calls — the decision lives HERE
+    because the segmented host loop is untraceable and must never reach
+    the jitted sharded path.
     """
     from pivot_tpu.parallel.mesh import build_mesh
 
     n_dev = len(jax.devices())
     if n_dev <= 1 or static_kw.get("n_replicas", 0) % n_dev:
+        if fallback_segment_ticks is not None:
+            static_kw.setdefault("segment_ticks", fallback_segment_ticks)
         return functools.partial(sweep_fn, **static_kw)
     mesh = build_mesh(n_dev, ("replica", "host"))
     return jax.jit(
@@ -966,13 +1001,122 @@ def shard_sweep(sweep_fn, **static_kw):
     )
 
 
-# -- policy autotuning --------------------------------------------------------
+# -- row-based sweep runner ---------------------------------------------------
+#
+# Every what-if sweep is K candidates × R replicas of the same rollout with
+# per-cell inputs.  Flattening (K, R) to B = K·R *rows* lets one vmapped
+# segment program serve all three sweeps — and makes segmented execution
+# (bounded device calls, like ``rollout_checkpointed``) structural instead
+# of per-sweep surgery.  Finalization always goes through the ONE shared
+# ``_finalize_batch`` program, the same bit-consistency discipline as the
+# plain rollout.
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_replicas", "tick", "max_ticks", "perturb", "congestion"),
+    static_argnames=(
+        "tick", "policy", "congestion", "realtime_scoring", "spec",
+    ),
 )
+def _row_segment_step(
+    states,  # [B]-stacked RolloutState
+    rt,  # [B, T]
+    arr,  # [B, T]
+    ra,  # [B, T] i32
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    segment_ticks,  # traced i32 — partial segments must not recompile
+    spec,  # static (has_faults, has_task_u, has_totals, has_sp, has_active)
+    *extras,  # the present per-row arrays, in spec order
+    policy: str = "cost-aware",
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+):
+    """Advance every row by at most ``segment_ticks`` scheduler ticks."""
+
+    def seg(s, r, a, ra_, *ex):
+        f, u, tot, sp, act = _unpack_extras(spec, ex)
+        return _rollout_segment(
+            s, r, a, ra_, workload, topo, tick, segment_ticks,
+            faults=f, totals=tot, score_params=sp, policy=policy,
+            task_u=u, congestion=congestion,
+            realtime_scoring=realtime_scoring, active=act,
+        )
+
+    return jax.vmap(seg)(states, rt, arr, ra, *extras)
+
+
+def _run_rows(
+    avail_rows,  # [B, H, 4] initial availability per row
+    rt, arr, ra,  # [B, T] perturbed inputs per row
+    workload, topo, tick, max_ticks, segment_ticks,
+    policy, congestion, realtime_scoring,
+    faults=None,  # optional ([B,F] i32, [B,F], [B,F])
+    task_u=None,  # optional [B, T]
+    totals=None,  # optional [B, H, 4] (fault recovery target)
+    score_params=None,  # optional [B, 3]
+    active=None,  # optional [B, T] bool
+) -> RolloutResult:
+    """Run B rows to the horizon and finalize through the shared program.
+
+    ``segment_ticks=None`` issues ONE bounded device call of ``max_ticks``
+    (the while_loop still early-exits) — fully traceable, so
+    :func:`shard_sweep` can jit over it.  An integer runs the rollout in
+    that many device calls per ``segment_ticks`` ticks with host-side
+    early exit between segments — the remote-transport-friendly mode
+    (``rollout_checkpointed``'s rationale): a monolithic multi-thousand-
+    tick program is one minutes-long execution some transports kill.
+    """
+    Z = topo.cost.shape[0]
+    spec, extras = _pack_extras(faults, task_u, totals, score_params, active)
+
+    states = jax.vmap(lambda av: _init_state(av, workload.n_tasks, Z))(
+        avail_rows
+    )
+    if segment_ticks is None:
+        states = _row_segment_step(
+            states, rt, arr, ra, workload, topo, tick,
+            jnp.asarray(max_ticks, jnp.int32), spec, *extras,
+            policy=policy, congestion=congestion,
+            realtime_scoring=realtime_scoring,
+        )
+    else:
+        ticks = 0
+        while ticks < max_ticks:
+            seg = min(segment_ticks, max_ticks - ticks)
+            states = _row_segment_step(
+                states, rt, arr, ra, workload, topo, tick,
+                jnp.asarray(seg, jnp.int32), spec, *extras,
+                policy=policy, congestion=congestion,
+                realtime_scoring=realtime_scoring,
+            )
+            jax.block_until_ready(states)
+            ticks += seg
+            pending = states.stage != _DONE
+            if active is not None:
+                pending = pending & active
+            if not bool(jnp.any(pending)):
+                break
+    return _finalize_batch(states, workload, topo, active)
+
+
+def _reshape_rows(res: RolloutResult, K: int, R: int) -> RolloutResult:
+    """[B, ...] row results back to [K, R, ...]."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((K, R) + x.shape[1:]), res
+    )
+
+
+def _tile_rows(x, K):
+    """Tile a per-replica array to per-row (candidate-major: row b =
+    candidate b // R, replica b % R)."""
+    return jnp.tile(x, (K,) + (1,) * (x.ndim - 1))
+
+
+# -- policy autotuning --------------------------------------------------------
+
+
 def score_param_sweep(
     key,
     avail0,  # [H, 4] full host capacity
@@ -985,6 +1129,7 @@ def score_param_sweep(
     max_ticks: int = 512,
     perturb: float = 0.1,
     congestion: bool = False,
+    segment_ticks: Optional[int] = None,
 ) -> RolloutResult:
     """On-device policy autotuning: sweep the cost-aware score exponents.
 
@@ -1002,18 +1147,19 @@ def score_param_sweep(
     ``param_grid[jnp.argmin(res.makespan.mean(axis=1))]`` or any
     makespan/egress trade-off.
     """
+    grid = jnp.asarray(param_grid, avail0.dtype)
+    K, R = grid.shape[0], n_replicas
     rt, arr, root_anchor = _perturbations(
         key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
-    per_param = jax.vmap(
-        lambda sp: jax.vmap(
-            lambda r, a, ra: _single_rollout(
-                avail0, r, a, ra, workload, topo, tick, max_ticks,
-                score_params=sp, congestion=congestion,
-            )
-        )(rt, arr, root_anchor)
+    res = _run_rows(
+        jnp.broadcast_to(avail0, (K * R,) + avail0.shape),
+        _tile_rows(rt, K), _tile_rows(arr, K), _tile_rows(root_anchor, K),
+        workload, topo, tick, max_ticks, segment_ticks,
+        policy="cost-aware", congestion=congestion, realtime_scoring=False,
+        score_params=jnp.repeat(grid, R, axis=0),
     )
-    return per_param(jnp.asarray(param_grid, avail0.dtype))
+    return _reshape_rows(res, K, R)
 
 
 # -- capacity planning --------------------------------------------------------
@@ -1036,13 +1182,6 @@ def capacity_grid(avail0, host_counts) -> jax.Array:
     )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "n_replicas", "tick", "max_ticks", "perturb", "policy", "congestion",
-        "realtime_scoring", "n_faults", "fault_horizon", "mttr",
-    ),
-)
 def capacity_sweep(
     key,
     avail_grid,  # [K, H, 4] candidate capacity matrices (capacity_grid)
@@ -1059,6 +1198,7 @@ def capacity_sweep(
     n_faults: int = 0,
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
+    segment_ticks: Optional[int] = None,
 ) -> RolloutResult:
     """On-device capacity planning: how does the workload behave on K
     candidate cluster sizes?  Every candidate × replica pair rolls out in
@@ -1084,6 +1224,7 @@ def capacity_sweep(
     analysis, ``alibaba/sim.py:132-165``); candidates with
     ``n_unfinished > 0`` are undersized for the horizon.
     """
+    K, R = avail_grid.shape[0], n_replicas
     rt, arr, root_anchor = _perturbations(
         key, workload, storage_zones, n_replicas, perturb, avail_grid.dtype
     )
@@ -1103,29 +1244,23 @@ def capacity_sweep(
             jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
             n_alive, horizon, mttr, avail_grid.dtype,
         )
-    extras, unpack = _pack_extras(faults, task_u)
+    avail_rows = jnp.repeat(avail_grid, R, axis=0)  # [B, H, 4]
+    res = _run_rows(
+        avail_rows,
+        _tile_rows(rt, K), _tile_rows(arr, K), _tile_rows(root_anchor, K),
+        workload, topo, tick, max_ticks, segment_ticks,
+        policy=policy, congestion=congestion,
+        realtime_scoring=realtime_scoring,
+        faults=(
+            tuple(_tile_rows(f, K) for f in faults)
+            if faults is not None else None
+        ),
+        task_u=_tile_rows(task_u, K) if task_u is not None else None,
+        totals=avail_rows if faults is not None else None,
+    )
+    return _reshape_rows(res, K, R)
 
-    def one_candidate(av):
-        def one(r, a, ra, *ex):
-            f, u = unpack(*ex)
-            return _single_rollout(
-                av, r, a, ra, workload, topo, tick, max_ticks,
-                faults=f, policy=policy, task_u=u, congestion=congestion,
-                realtime_scoring=realtime_scoring,
-            )
 
-        return jax.vmap(one)(rt, arr, root_anchor, *extras)
-
-    return jax.vmap(one_candidate)(avail_grid)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "n_replicas", "tick", "max_ticks", "perturb", "policy", "congestion",
-        "realtime_scoring",
-    ),
-)
 def workload_sweep(
     key,
     avail0,  # [H, 4]
@@ -1140,6 +1275,7 @@ def workload_sweep(
     policy: str = "cost-aware",
     congestion: bool = False,
     realtime_scoring: bool = False,
+    segment_ticks: Optional[int] = None,
 ) -> RolloutResult:
     """On-device workload-size sweep: how do cost and makespan scale with
     the number of applications?  Candidate k activates the first
@@ -1154,31 +1290,29 @@ def workload_sweep(
     applications, masked tasks can neither gate readiness nor bill
     egress.
     """
+    counts = jnp.asarray(app_counts, jnp.int32)
+    K, R = counts.shape[0], n_replicas
     rt, arr, root_anchor = _perturbations(
         key, workload, storage_zones, n_replicas, perturb, avail0.dtype
     )
     task_u = _opportunistic_uniforms(
         key, n_replicas, workload.n_tasks, avail0.dtype
     ) if policy == "opportunistic" else None
-    extras, unpack = _pack_extras(None, task_u)
-    counts = jnp.asarray(app_counts, jnp.int32)
-    inf = jnp.asarray(jnp.inf, avail0.dtype)
-
-    def one_candidate(n_apps_k):
-        act = workload.app_of < n_apps_k  # [T]
-
-        def one(r, a, ra, *ex):
-            _f, u = unpack(*ex)
-            return _single_rollout(
-                avail0, r, jnp.where(act, a, inf), ra, workload, topo,
-                tick, max_ticks, policy=policy, task_u=u,
-                congestion=congestion, realtime_scoring=realtime_scoring,
-                active=act,
-            )
-
-        return jax.vmap(one)(rt, arr, root_anchor, *extras)
-
-    return jax.vmap(one_candidate)(counts)
+    act = workload.app_of[None, :] < counts[:, None]  # [K, T]
+    act_rows = jnp.repeat(act, R, axis=0)  # [B, T]
+    arr_rows = jnp.where(
+        act_rows, _tile_rows(arr, K), jnp.asarray(jnp.inf, avail0.dtype)
+    )
+    res = _run_rows(
+        jnp.broadcast_to(avail0, (K * R,) + avail0.shape),
+        _tile_rows(rt, K), arr_rows, _tile_rows(root_anchor, K),
+        workload, topo, tick, max_ticks, segment_ticks,
+        policy=policy, congestion=congestion,
+        realtime_scoring=realtime_scoring,
+        task_u=_tile_rows(task_u, K) if task_u is not None else None,
+        active=act_rows,
+    )
+    return _reshape_rows(res, K, R)
 
 
 # -- checkpoint / resume -----------------------------------------------------
@@ -1205,10 +1339,10 @@ def _segment_step(
     realtime_scoring: bool = False,
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
-    extras, unpack = _pack_extras(faults, task_u)
+    spec, extras = _pack_extras(faults, task_u)
 
     def seg(s, r, a, ra, *ex):
-        f, u = unpack(*ex)
+        f, u, _tot, _sp, _act = _unpack_extras(spec, ex)
         return _rollout_segment(
             s, r, a, ra, workload, topo, tick, segment_ticks,
             faults=f, totals=totals, policy=policy, task_u=u,

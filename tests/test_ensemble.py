@@ -917,3 +917,57 @@ def test_realtime_scoring_guards(setup):
         rollout(jax.random.PRNGKey(0), avail0, w, topo, sz,
                 n_replicas=2, max_ticks=16, policy="first-fit",
                 congestion=True, realtime_scoring=True)
+
+
+def test_segmented_sweeps_bit_identical(setup):
+    """segment_ticks splits a sweep into bounded device calls with
+    host-side early exit — results bit-identical to the one-call run,
+    for all three sweeps."""
+    from pivot_tpu.parallel.ensemble import (
+        capacity_grid,
+        capacity_sweep,
+        score_param_sweep,
+        workload_sweep,
+    )
+
+    cluster, topo = setup
+    apps = [
+        Application(
+            f"sg{i}",
+            [
+                TaskGroup("p", cpus=1, mem=256, runtime=7, output_size=2000),
+                TaskGroup("c", cpus=1, mem=256, runtime=9, instances=3,
+                          dependencies=["p"]),
+            ],
+        )
+        for i in range(3)
+    ]
+    w = EnsembleWorkload.from_applications(apps, arrivals=[0.0, 15.0, 30.0])
+    avail0, sz = _ens_inputs(cluster)
+    kw = dict(n_replicas=4, tick=5.0, max_ticks=64, perturb=0.1)
+
+    def same(a, b):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    grid = capacity_grid(avail0, [2, 8])
+    same(
+        capacity_sweep(jax.random.PRNGKey(20), grid, w, topo, sz,
+                       n_faults=2, fault_horizon=50.0, mttr=25.0, **kw),
+        capacity_sweep(jax.random.PRNGKey(20), grid, w, topo, sz,
+                       n_faults=2, fault_horizon=50.0, mttr=25.0,
+                       segment_ticks=7, **kw),
+    )
+    same(
+        workload_sweep(jax.random.PRNGKey(20), avail0, w, topo, sz,
+                       [1, 3], congestion=True, **kw),
+        workload_sweep(jax.random.PRNGKey(20), avail0, w, topo, sz,
+                       [1, 3], congestion=True, segment_ticks=7, **kw),
+    )
+    sp = np.array([[1, 1, 1], [2, 1, 0.5]], np.float32)
+    same(
+        score_param_sweep(jax.random.PRNGKey(20), avail0, w, topo, sz, sp,
+                          **kw),
+        score_param_sweep(jax.random.PRNGKey(20), avail0, w, topo, sz, sp,
+                          segment_ticks=7, **kw),
+    )
