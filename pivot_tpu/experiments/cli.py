@@ -523,15 +523,9 @@ def _maybe_shard_sweep(sweep_fn, **static_kw):
 
     from pivot_tpu.parallel.ensemble import shard_sweep
 
-    n_dev = len(jax.devices())
-    if n_dev > 1 and static_kw.get("n_replicas", 0) % n_dev:
-        logger.info(
-            "replicas (%s) not divisible by %d devices — running the "
-            "sweep unsharded", static_kw.get("n_replicas"), n_dev,
-        )
     # Unsharded fallback runs in bounded 64-tick device calls (the
     # rollout_checkpointed rationale — remote-transport friendly);
-    # shard_sweep owns the fallback decision.
+    # shard_sweep owns — and logs — the fallback decision.
     return shard_sweep(sweep_fn, fallback_segment_ticks=64, **static_kw)
 
 
@@ -582,7 +576,14 @@ def run_ensemble(args) -> dict:
     )
 
     wall0 = time.perf_counter()
-    if args.checkpoint or len(jax.devices()) == 1:
+    if (
+        args.checkpoint
+        or len(jax.devices()) == 1
+        # Same rationale as shard_sweep's CPU fallback: a forced-host-
+        # device "mesh" shares the physical cores — sharding over it
+        # costs, not saves.
+        or jax.default_backend() == "cpu"
+    ):
         # Segmented execution: one bounded device call per 64 ticks.  A
         # monolithic while_loop over thousands of ticks is one minutes-long
         # execution, which remote single-chip transports may kill; on a

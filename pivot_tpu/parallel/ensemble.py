@@ -976,21 +976,41 @@ def sweep_out_shardings(mesh) -> RolloutResult:
     )
 
 
-def shard_sweep(sweep_fn, fallback_segment_ticks=None, **static_kw):
+def shard_sweep(sweep_fn, fallback_segment_ticks=None, force_mesh=False,
+                **static_kw):
     """Bind a what-if sweep's static config and shard it over the
     available devices ('replica' axis, like :func:`sharded_rollout`) —
     XLA partitions the vmapped while_loops with zero cross-replica
-    traffic.  Falls back to the unsharded call on a single device or
-    when the replica count does not divide the mesh; on that fallback,
+    traffic.  Falls back to the unsharded call on a single device, when
+    the replica count does not divide the mesh, or on the CPU backend
+    (a forced-host-device "mesh" shares the physical cores — measured
+    >5× slower than unsharded at scale; it exists to VALIDATE sharding,
+    which tests opt into via ``force_mesh=True``).  On the fallback,
     ``fallback_segment_ticks`` (if set and not already in the config)
     runs the sweep in bounded device calls — the decision lives HERE
     because the segmented host loop is untraceable and must never reach
     the jitted sharded path.
     """
     from pivot_tpu.parallel.mesh import build_mesh
+    from pivot_tpu.utils import get_logger
 
     n_dev = len(jax.devices())
-    if n_dev <= 1 or static_kw.get("n_replicas", 0) % n_dev:
+    reason = None
+    if n_dev <= 1:
+        pass  # nothing to shard over — not worth a log line
+    elif static_kw.get("n_replicas", 0) % n_dev:
+        reason = (
+            f"replicas ({static_kw.get('n_replicas')}) not divisible by "
+            f"{n_dev} devices"
+        )
+    elif jax.default_backend() == "cpu" and not force_mesh:
+        reason = (
+            "CPU backend (forced-host-device meshes share the physical "
+            "cores; pass force_mesh=True to shard anyway)"
+        )
+    if n_dev <= 1 or reason is not None:
+        if reason is not None:
+            get_logger("ensemble").info("sweep runs unsharded: %s", reason)
         if fallback_segment_ticks is not None:
             static_kw.setdefault("segment_ticks", fallback_segment_ticks)
         return functools.partial(sweep_fn, **static_kw)

@@ -799,7 +799,7 @@ def test_sharded_sweeps_8_devices(setup):
 
     grid = capacity_grid(avail0, [2, 8])
     plain = capacity_sweep(jax.random.PRNGKey(17), grid, w, topo, sz, **kw)
-    sharded = shard_sweep(capacity_sweep, **kw)(
+    sharded = shard_sweep(capacity_sweep, force_mesh=True, **kw)(
         jax.random.PRNGKey(17), grid, w, topo, sz
     )
     sharded.makespan.block_until_ready()
@@ -808,14 +808,14 @@ def test_sharded_sweeps_8_devices(setup):
         np.asarray(plain.makespan), np.asarray(sharded.makespan)
     )
 
-    sharded_ws = shard_sweep(workload_sweep, **kw)(
+    sharded_ws = shard_sweep(workload_sweep, force_mesh=True, **kw)(
         jax.random.PRNGKey(17), avail0, w, topo, sz, [1]
     )
     sharded_ws.makespan.block_until_ready()
     assert len(sharded_ws.makespan.sharding.device_set) == 8
     assert int(np.asarray(sharded_ws.n_unfinished).max()) == 0
 
-    sharded_sp = shard_sweep(score_param_sweep, **kw)(
+    sharded_sp = shard_sweep(score_param_sweep, force_mesh=True, **kw)(
         jax.random.PRNGKey(17), avail0, w, topo, sz,
         np.array([[1.0, 1.0, 1.0], [2.0, 1.0, 0.5]], np.float32),
     )
@@ -823,9 +823,10 @@ def test_sharded_sweeps_8_devices(setup):
     assert sharded_sp.makespan.shape == (2, 16)
     assert len(sharded_sp.makespan.sharding.device_set) == 8
 
-    # Indivisible replica count -> unsharded fallback, same values.
-    fb = shard_sweep(capacity_sweep, n_replicas=6, tick=5.0, max_ticks=64,
-                     perturb=0.1)
+    # Indivisible replica count -> unsharded fallback even when the mesh
+    # is forced (6 % 8 != 0 decides, not the CPU-backend clause).
+    fb = shard_sweep(capacity_sweep, force_mesh=True, n_replicas=6,
+                     tick=5.0, max_ticks=64, perturb=0.1)
     assert isinstance(fb, functools.partial)
     res_fb = fb(jax.random.PRNGKey(17), grid, w, topo, sz)
     assert np.asarray(res_fb.makespan).shape == (2, 6)
