@@ -972,3 +972,43 @@ def test_segmented_sweeps_bit_identical(setup):
         score_param_sweep(jax.random.PRNGKey(20), avail0, w, topo, sz, sp,
                           segment_ticks=7, **kw),
     )
+
+
+@pytest.mark.parametrize("seed", [21, 22, 23])
+def test_segmented_rollout_fuzz(setup, seed):
+    """Randomized workloads: segmented row execution stays bit-identical
+    to the one-call run across DAG shapes, fan-outs, and policies."""
+    from pivot_tpu.parallel.ensemble import workload_sweep
+
+    cluster, topo = setup
+    rng = np.random.default_rng(seed)
+    apps = []
+    for a in range(int(rng.integers(2, 4))):
+        groups = []
+        for i in range(int(rng.integers(2, 5))):
+            deps = [str(int(rng.integers(0, i)))] if i and rng.random() < 0.6 else []
+            groups.append(TaskGroup(
+                str(i),
+                cpus=float(rng.choice([0.5, 1, 2])),
+                mem=float(rng.choice([128, 512])),
+                runtime=float(rng.integers(3, 40)),
+                output_size=float(rng.choice([0, 300, 4000])),
+                instances=int(rng.integers(1, 5)),
+                dependencies=deps,
+            ))
+        apps.append(Application(f"f{a}", groups))
+    w = EnsembleWorkload.from_applications(
+        apps, arrivals=[float(10 * i) for i in range(len(apps))]
+    )
+    avail0, sz = _ens_inputs(cluster)
+    policy = ["cost-aware", "first-fit", "opportunistic"][seed % 3]
+    kw = dict(n_replicas=3, tick=5.0, max_ticks=128, perturb=0.15,
+              policy=policy, congestion=bool(seed % 2))
+    counts = [1, len(apps)]
+    mono = workload_sweep(jax.random.PRNGKey(seed), avail0, w, topo, sz,
+                          counts, **kw)
+    segd = workload_sweep(jax.random.PRNGKey(seed), avail0, w, topo, sz,
+                          counts, segment_ticks=int(rng.integers(3, 11)),
+                          **kw)
+    for x, y in zip(mono, segd):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
