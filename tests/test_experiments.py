@@ -462,3 +462,27 @@ def test_calibrate_realtime_mode():
             cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
             n_apps=2, policy="first-fit", realtime=True,
         )
+
+
+def test_entity_colors_are_stable_pairs():
+    """The fixed per-policy figure colors pair DES display labels with
+    estimator policy names — entity-stable across figure variants."""
+    from pivot_tpu.experiments.plots import ENTITY_COLORS
+
+    assert ENTITY_COLORS["Opportunistic"] == ENTITY_COLORS["opportunistic"]
+    assert ENTITY_COLORS["Cost-Aware"] == ENTITY_COLORS["cost-aware"]
+    assert ENTITY_COLORS["VBP"] == ENTITY_COLORS["first-fit"]
+    # Distinct arms never share a color.
+    arms = ["opportunistic", "cost-aware", "first-fit", "best-fit"]
+    assert len({ENTITY_COLORS[a] for a in arms}) == len(arms)
+
+
+def test_calibrate_mode_combination_validation():
+    from pivot_tpu.experiments.calibrate import calibrate
+
+    with pytest.raises(ValueError):
+        calibrate("data/jobs/jobs-5000-200-172800-259200.npz",
+                  realtime=True, modes=("static",))
+    with pytest.raises(ValueError):
+        calibrate("data/jobs/jobs-5000-200-172800-259200.npz",
+                  modes=("realtime",))
