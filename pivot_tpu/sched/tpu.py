@@ -65,6 +65,7 @@ from pivot_tpu.sched.policies import (
     _sort_decreasing,
 )
 from pivot_tpu.sched.rand import tick_uniforms
+from pivot_tpu.utils import enable_compilation_cache as _enable_compilation_cache
 
 __all__ = [
     "TpuOpportunisticPolicy",
@@ -83,38 +84,6 @@ def pad_bucket(n: int) -> int:
         if n <= b:
             return b
     return ((n + 8191) // 8192) * 8192
-
-
-_cache_enabled = False
-
-
-def _enable_compilation_cache() -> None:
-    """Persist XLA executables across processes (``~/.cache/pivot_tpu_xla``).
-
-    Each (bucket, H) program costs seconds to compile on a TPU; without a
-    persistent cache every fresh experiment process pays it again, which
-    can exceed the device's entire per-tick win at moderate scale."""
-    global _cache_enabled
-    if _cache_enabled:
-        return
-    _cache_enabled = True
-    import os
-
-    import jax
-
-    try:
-        cache_dir = os.environ.get(
-            "PIVOT_XLA_CACHE", os.path.expanduser("~/.cache/pivot_tpu_xla")
-        )
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
-    except Exception as exc:  # never let caching break scheduling
-        import logging
-
-        logging.getLogger("pivot_tpu").warning(
-            "persistent compilation cache unavailable: %s", exc
-        )
 
 
 _live_backend_checked = False

@@ -67,6 +67,82 @@ def reset_ids() -> None:
     _id_counters.clear()
 
 
+_cache_enabled = False
+
+
+def enable_compilation_cache() -> None:
+    """Persist XLA executables across processes (``~/.cache/pivot_tpu_xla``).
+
+    Each (bucket, H) program costs seconds to compile; without a persistent
+    cache every fresh experiment process pays full compiles again, which can
+    exceed the device's entire per-tick win at moderate scale.  Called from
+    every device entry point: the policy backend (``pivot_tpu.sched.tpu``),
+    the ensemble/autotune/capacity/apps CLI paths, ``bench.py``, and the
+    driver's ``dryrun_multichip``.  Safe to call repeatedly; never lets a
+    caching failure break scheduling.
+    """
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    import jax
+
+    try:
+        cache_dir = os.environ.get(
+            "PIVOT_XLA_CACHE", os.path.expanduser("~/.cache/pivot_tpu_xla")
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    except Exception as exc:  # never let caching break scheduling
+        get_logger("utils").warning(
+            "persistent compilation cache unavailable: %s", exc
+        )
+
+
+def pin_virtual_cpu_mesh(n_devices: int) -> bool:
+    """Pin this process to an ``n_devices`` virtual-CPU JAX backend.
+
+    Must run before the first device touch.  Two layers are required
+    (``tests/conftest.py`` recipe): the ``XLA_FLAGS`` device count is read
+    once at backend init, and the config-level platform pin is the only
+    override that beats the accelerator site package, which force-registers
+    the remote (single-tenant, possibly wedged) backend over ``JAX_PLATFORMS``
+    env vars at interpreter start.
+
+    Returns True iff the pin is effective in this process — i.e. JAX
+    backends were not yet initialized (or already satisfy the request).
+    Returns False when it is too late (backends already up with the wrong
+    platform or too few devices; XLA parses the device-count flag only
+    once per process, so the caller must re-exec in a child to recover).
+    """
+    import os
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    match = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if match is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    elif int(match.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            match.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # Whether backends were already up or init just now under the pin,
+    # the postcondition is the same: enough CPU devices in this process.
+    devs = jax.devices()
+    return devs[0].platform == "cpu" and len(devs) >= n_devices
+
+
 def probe_backend_alive(timeout: float = 150.0) -> bool:
     """True iff ``import jax; jax.devices()`` completes in a child process.
 

@@ -5,24 +5,16 @@ first).  Multi-chip sharding is validated on these virtual devices; the real
 TPU chip is only used by ``bench.py``.
 """
 
-import os
-
 # Hard override: the session environment pins JAX_PLATFORMS to the real
 # accelerator backend; tests must never initialize it (single-tenant
 # tunnel — a test grabbing it wedges the chip for the benchmark).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# pivot_tpu.utils does not import jax at module scope, so the shared pin
+# helper is safe to use here before any device touch.
+from pivot_tpu.utils import pin_virtual_cpu_mesh
+
+assert pin_virtual_cpu_mesh(8), "virtual CPU mesh pin failed in conftest"
 
 import jax  # noqa: E402
-
-# The accelerator site package force-updates jax_platforms at interpreter
-# start (beating the env var), so override at the config level too: tests
-# must never dial the single-tenant accelerator tunnel.
-jax.config.update("jax_platforms", "cpu")
 # Exact cross-backend placement parity is validated in f64 on the CPU
 # backend; TPU runs use f32 (see pivot_tpu/ops/kernels.py docstring).
 jax.config.update("jax_enable_x64", True)
