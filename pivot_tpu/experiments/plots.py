@@ -66,18 +66,24 @@ def _plot_cost_lines(series, ylabel: str, out: str) -> str:
     markers = ["x", "+", "1", "2", "3"]
     plt.figure(figsize=(8, 5))
     items = sorted(series.items())
+    # Solid (host) and dashed (egress) twins of one arm must share a
+    # color; a label outside ENTITY_COLORS would otherwise get two
+    # different auto-cycle colors (separate plot calls), so capture the
+    # solid line's assigned color and reuse it for the dashed twin.
+    label_colors = dict(ENTITY_COLORS)
     for solid in (True, False):
         for i, (label, rows) in enumerate(items):
             rows = sorted(rows)
             xs = [r[0] for r in rows]
             ys = [r[2] if solid else r[1] for r in rows]
-            plt.plot(
+            (line,) = plt.plot(
                 xs, ys,
                 ls="-" if solid else "--",
-                color=ENTITY_COLORS.get(label),
+                color=label_colors.get(label),
                 marker=markers[i % len(markers)], markersize=11,
                 label=f"{label} ({'host' if solid else 'egress'})",
             )
+            label_colors.setdefault(label, line.get_color())
     plt.xlabel("# of running applications", fontsize=13)
     plt.ylabel(ylabel, fontsize=13)
     plt.legend(ncol=2, frameon=False, fontsize=10)

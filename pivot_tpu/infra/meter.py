@@ -170,8 +170,14 @@ class Meter(LogMixin):
             }
         )
 
-    def add_scheduling_turnover(self, timepoint: float) -> None:
-        self._sched_turnovers.append(timepoint)
+    def add_scheduling_turnover(self, latency: float) -> None:
+        """Submit→placement latency of one task, in sim-seconds.
+
+        The reference declares this hook but never calls it
+        (``resources/meter.py:102-103``); here the global scheduler feeds
+        it on every successful placement (wait-queue residency included),
+        making it a live scheduling-latency / starvation metric."""
+        self._sched_turnovers.append(latency)
 
     def increment_scheduling_ops(self, n_ops: int) -> None:
         self._n_sched_ops += n_ops
@@ -236,9 +242,17 @@ class Meter(LogMixin):
             "cum_instance_hours": self.cumulative_instance_hours,
             "avg_congestion_delay": self.average_congestion_delay,
             "total_scheduling_ops": self._n_sched_ops,
+            "avg_scheduling_turnover": self.average_scheduling_turnover,
             "sim_time": self.runtime,
             "wall_clock": self.wall_clock,
         }
+
+    @property
+    def average_scheduling_turnover(self) -> float:
+        """Mean submit→placement latency (sim-seconds) across placements."""
+        if not self._sched_turnovers:
+            return 0.0
+        return float(np.mean(self._sched_turnovers))
 
     def save(self, data_dir: str) -> None:
         """Write the reference-compatible four-file JSON layout."""
@@ -248,6 +262,7 @@ class Meter(LogMixin):
                 {
                     "egress_cost": self.total_network_traffic_cost,
                     "cum_instance_hours": self.cumulative_instance_hours,
+                    "avg_scheduling_turnover": self.average_scheduling_turnover,
                 },
                 f,
             )

@@ -162,7 +162,11 @@ class NativeRoute(Route):
         return done
 
     def cancel(self, done: Event) -> None:
-        """No-op: the native engine owns the queue and has no cancel path —
-        an orphaned transfer is served to completion (bounded bandwidth
-        skew after a host crash).  Fault-heavy experiments should prefer
-        ``network_backend='python'``."""
+        """Drop the queued transfer whose completion event is ``done``.
+
+        Same semantics as :meth:`Route.cancel`: a waiting transfer leaves
+        the queue eagerly (``queued_mb`` stays exact), the in-service
+        chunk — data already on the wire — finishes and the transfer is
+        then dropped, and ``done`` never fires.  The queue surgery happens
+        inside the engine (``net_cancel``)."""
+        self.engine.cancel(done)

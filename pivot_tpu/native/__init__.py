@@ -89,6 +89,7 @@ def load_library() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_double),
         ctypes.c_int64,
     ]
+    lib.net_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.net_queued_mb.restype = ctypes.c_double
     lib.net_queued_mb.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.net_route_stats.argtypes = [
@@ -136,6 +137,7 @@ class NativeNetworkEngine:
         self._h = ctypes.c_void_p(self._lib.net_create())
         self.env = env
         self._done_events: Dict[int, object] = {}
+        self._tid_by_event: Dict[object, int] = {}  # reverse map for cancel
         self._routes: List[object] = []  # route facade per native index
         self._armed_time: float = inf  # completion instant of the live wake
         self._arm_seq = 0  # tag of the live wake; older tags are inert
@@ -164,8 +166,24 @@ class NativeNetworkEngine:
             self._h, route_idx, float(size_mb), float(self.env.now)
         )
         self._done_events[tid] = done_event
+        self._tid_by_event[done_event] = tid
         self._sync_wake()
         return tid
+
+    def cancel(self, done_event) -> None:
+        """Cancel the live transfer whose completion event is ``done_event``.
+
+        Drains first, so a completion due at exactly ``now`` fires rather
+        than being cancelled — the same completions-before-caller tie
+        policy ``send`` documents.  A transfer that already completed is a
+        no-op, matching the Python fabric's cancel scan finding nothing.
+        """
+        self._drain()
+        tid = self._tid_by_event.pop(done_event, None)
+        if tid is None:
+            return
+        self._done_events.pop(tid, None)
+        self._lib.net_cancel(self._h, tid)
 
     def queued_mb(self, route_idx: int) -> float:
         return self._lib.net_queued_mb(self._h, route_idx)
@@ -184,6 +202,7 @@ class NativeNetworkEngine:
             )
             for i in range(got):
                 evt = self._done_events.pop(self._ids_buf[i])
+                self._tid_by_event.pop(evt, None)
                 evt.succeed()
             n -= got
 

@@ -37,6 +37,7 @@ struct Transfer {
   double last_end = -1.0;  // end time of this transfer's previous chunk
   int32_t route;
   bool started = false;    // counted in the route's n_transfers yet?
+  bool cancelled = false;  // dropped after its in-service chunk completes
 };
 
 struct HeapEntry {
@@ -109,7 +110,12 @@ struct Engine {
     r.served_mb += r.cur_chunk;
     t.last_end = tc;
     total_chunks += 1;
-    if (t.remaining <= 0.0) {
+    // Cancelled wins over completed (Route._finish_chunk order,
+    // network.py:118-127): even a fully transferred cancelled transfer
+    // never reports done.
+    if (t.cancelled) {
+      free_ids.push_back(id);  // dropped: no completion, no re-enqueue
+    } else if (t.remaining <= 0.0) {
       done_ids.push_back(id);
       done_times.push_back(tc);
     } else {
@@ -184,6 +190,30 @@ int64_t net_collect_done(void* h, int64_t* ids, double* times, int64_t cap) {
     e->done_cursor = 0;
   }
   return n;
+}
+
+// Cancel a live transfer (parity with Route.cancel, network.py:81-100):
+// a waiting transfer is removed from its route's queue eagerly, so
+// queued_mb / realtime_bw stay exact immediately; the in-service transfer
+// has its current chunk (data already on the wire) finish normally and is
+// then dropped by complete_chunk.  An id that is neither queued nor in
+// service already completed — no-op, matching the Python fabric's scan
+// finding nothing.
+void net_cancel(void* h, int64_t id) {
+  Engine* e = static_cast<Engine*>(h);
+  Transfer& t = e->transfers[id];
+  RouteState& r = e->routes[t.route];
+  if (r.current == id) {
+    t.cancelled = true;
+    return;
+  }
+  for (auto it = r.queue.begin(); it != r.queue.end(); ++it) {
+    if (*it == id) {
+      r.queue.erase(it);
+      e->free_ids.push_back(id);
+      return;
+    }
+  }
 }
 
 // Exact FIFO-order sum over waiting transfers (excludes the in-service

@@ -991,17 +991,34 @@ def shard_sweep(sweep_fn, fallback_segment_ticks=None, force_mesh=False,
     because the segmented host loop is untraceable and must never reach
     the jitted sharded path.
     """
+    import inspect
+
     from pivot_tpu.parallel.mesh import build_mesh
     from pivot_tpu.utils import get_logger
 
     n_dev = len(jax.devices())
+    # The divisibility guard must judge the replica count the sweep will
+    # actually run with — a caller relying on the sweep's own default
+    # would otherwise bypass the check (0 % n_dev == 0) and fail at run
+    # time inside the sharded program.
+    n_replicas = static_kw.get("n_replicas")
+    if n_replicas is None:
+        try:
+            default = inspect.signature(sweep_fn).parameters["n_replicas"].default
+        except (KeyError, TypeError, ValueError):
+            default = inspect.Parameter.empty
+        n_replicas = None if default is inspect.Parameter.empty else default
     reason = None
     if n_dev <= 1:
         pass  # nothing to shard over — not worth a log line
-    elif static_kw.get("n_replicas", 0) % n_dev:
+    elif static_kw.get("segment_ticks") is not None:
+        # The segmented runner is a host-side loop (block_until_ready +
+        # data-dependent early exit) — untraceable under jit, so an
+        # explicit segment request always takes the unsharded path.
+        reason = "explicit segment_ticks requests the host-side segmented loop"
+    elif n_replicas is None or n_replicas % n_dev:
         reason = (
-            f"replicas ({static_kw.get('n_replicas')}) not divisible by "
-            f"{n_dev} devices"
+            f"replicas ({n_replicas}) not divisible by {n_dev} devices"
         )
     elif jax.default_backend() == "cpu" and not force_mesh:
         reason = (
@@ -1253,17 +1270,28 @@ def capacity_sweep(
     ) if policy == "opportunistic" else None
     faults = None
     if n_faults:
-        # Hosts alive in ANY candidate (capacity_grid keeps prefixes, so
-        # this is the largest candidate's range).  jax.random.randint
-        # accepts a traced bound, so no static host count is needed.
-        n_alive = jnp.sum(jnp.any(avail_grid[:, :, 0] >= 0, axis=0))
+        # Hosts alive in ANY candidate — the union of all candidates'
+        # ranges.  jax.random.randint accepts a traced bound, so no
+        # static host count is needed.
+        alive = jnp.any(avail_grid[:, :, 0] >= 0, axis=0)  # [H]
+        n_alive = jnp.sum(alive)
         horizon = (
             fault_horizon if fault_horizon is not None else tick * max_ticks
         )
-        faults = _fault_schedule(
+        host_rank, fail_at, recover_at = _fault_schedule(
             jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
             n_alive, horizon, mttr, avail_grid.dtype,
         )
+        # The draw is a *rank* in [0, n_alive); map it to the actual host
+        # index so crashes land on alive hosts for ANY candidate grid.
+        # For capacity_grid's prefix-shaped grids this is the identity
+        # (bit-stable with the pre-mapping draws); for a caller-supplied
+        # non-prefix grid it fixes crashes silently hitting masked hosts
+        # and missing alive ones.
+        host = jnp.searchsorted(
+            jnp.cumsum(alive.astype(jnp.int32)), host_rank + 1
+        ).astype(jnp.int32)
+        faults = (host, fail_at, recover_at)
     avail_rows = jnp.repeat(avail_grid, R, axis=0)  # [B, H, 4]
     res = _run_rows(
         avail_rows,

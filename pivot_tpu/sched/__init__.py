@@ -209,6 +209,9 @@ class GlobalScheduler(LogMixin):
         self.randomizer = np.random.RandomState(seed)
         self.submit_q = Store(env)
         self._wait_stack: List[Task] = []
+        # First dispatch tick that saw each still-unplaced task — the
+        # submit→placement turnover clock (see _dispatch_loop).
+        self._pending_since: Dict[Task, float] = {}
         self._local: Dict[str, LocalScheduler] = {}
         self._n_unfinished = 0
         self._stopped = False
@@ -250,6 +253,14 @@ class GlobalScheduler(LogMixin):
             if ready:
                 if self.meter:
                     self.meter.increment_scheduling_ops(len(ready))
+                    # Turnover clock starts at the first dispatch tick that
+                    # sees a task (≤1 tick after its Store put) and runs
+                    # across wait-queue residency; a retry after an
+                    # execution failure restarts it (the placement decision
+                    # being timed is the new one).
+                    now = env.now
+                    for task in ready:
+                        self._pending_since.setdefault(task, now)
                 ctx = TickContext(self, ready, self._tick_seq)
                 with self.tracer.span(
                     "scheduler", "tick", env.now, n_ready=len(ready)
@@ -271,6 +282,10 @@ class GlobalScheduler(LogMixin):
                         task.placement = ctx.hosts[int(h_idx)].id
                         cluster.dispatch_q.put(task)
                         task.set_submitted()
+                        if self.meter:
+                            self.meter.add_scheduling_turnover(
+                                env.now - self._pending_since.pop(task, env.now)
+                            )
             yield env.timeout(self.interval)
 
     # -- the completion listener -----------------------------------------

@@ -116,22 +116,16 @@ def pin_virtual_cpu_mesh(n_devices: int) -> bool:
     backends were not yet initialized (or already satisfy the request).
     Returns False when it is too late (backends already up with the wrong
     platform or too few devices; XLA parses the device-count flag only
-    once per process, so the caller must re-exec in a child to recover).
+    once per process, so the caller must re-exec in a child to recover) —
+    and restores the caller's environment, so a long-lived process that
+    keeps running after a failed pin does not leak ``JAX_PLATFORMS=cpu``
+    into every subprocess it spawns later (which would silently turn its
+    accelerator benchmarks into CPU runs).
     """
     import os
-    import re
 
-    flags = os.environ.get("XLA_FLAGS", "")
-    match = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
-    if match is None:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
-    elif int(match.group(1)) < n_devices:
-        os.environ["XLA_FLAGS"] = flags.replace(
-            match.group(0), f"--xla_force_host_platform_device_count={n_devices}"
-        )
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    saved = {k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    os.environ.update(virtual_cpu_env(n_devices))
 
     import jax
 
@@ -140,7 +134,38 @@ def pin_virtual_cpu_mesh(n_devices: int) -> bool:
     # Whether backends were already up or init just now under the pin,
     # the postcondition is the same: enough CPU devices in this process.
     devs = jax.devices()
-    return devs[0].platform == "cpu" and len(devs) >= n_devices
+    ok = devs[0].platform == "cpu" and len(devs) >= n_devices
+    if not ok:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return ok
+
+
+def virtual_cpu_env(n_devices: int, base=None) -> dict:
+    """The env-var pins for an ``n_devices`` virtual-CPU JAX process.
+
+    Returns only the two keys to overlay (``JAX_PLATFORMS``,
+    ``XLA_FLAGS``), preserving unrelated flags in the base ``XLA_FLAGS``
+    and upgrading an existing smaller device count.  ``base`` defaults to
+    ``os.environ``.
+    """
+    import os
+    import re
+
+    if base is None:
+        base = os.environ
+    flags = base.get("XLA_FLAGS", "")
+    match = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if match is None:
+        flags = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    elif int(match.group(1)) < n_devices:
+        flags = flags.replace(
+            match.group(0), f"--xla_force_host_platform_device_count={n_devices}"
+        )
+    return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
 
 
 def probe_backend_alive(timeout: float = 150.0) -> bool:

@@ -12,7 +12,10 @@ TPU chip is only used by ``bench.py``.
 # helper is safe to use here before any device touch.
 from pivot_tpu.utils import pin_virtual_cpu_mesh
 
-assert pin_virtual_cpu_mesh(8), "virtual CPU mesh pin failed in conftest"
+# Call outside the assert: under ``python -O`` an assert body vanishes,
+# and this call's side effect is the whole point.
+_pinned = pin_virtual_cpu_mesh(8)
+assert _pinned, "virtual CPU mesh pin failed in conftest"
 
 import jax  # noqa: E402
 # Exact cross-backend placement parity is validated in f64 on the CPU

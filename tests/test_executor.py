@@ -94,7 +94,8 @@ def test_full_sim_bit_parity_native_network():
         assert s_proc[m] == s_fast[m], (m, s_proc[m], s_fast[m])
 
 
-def _tiny_cluster(env, meter=None, n_hosts=2, cpus=2.0, executor="fast"):
+def _tiny_cluster(env, meter=None, n_hosts=2, cpus=2.0, executor="fast",
+                  network="python"):
     meta = ResourceMetadata(seed=0)
     zones = meta.zones
     hosts = [
@@ -105,6 +106,7 @@ def _tiny_cluster(env, meter=None, n_hosts=2, cpus=2.0, executor="fast"):
     return Cluster(
         env, hosts=hosts, storage=storage, meta=meta, meter=meter,
         route_mode="meta", seed=0, executor_backend=executor,
+        network_backend=network,
     )
 
 
@@ -173,13 +175,20 @@ def test_fault_mid_compute_retries_elsewhere():
     assert meter.cumulative_instance_hours > 0
 
 
-def test_fault_mid_staging_cancels_transfers():
+@pytest.mark.parametrize("network", ["python", "native"])
+def test_fault_mid_staging_cancels_transfers(network):
     """Crash while pulling inputs: queued transfers are cancelled so the
-    route drains, and the task reschedules after recovery."""
+    route drains, and the task reschedules after recovery — on both the
+    event-kernel fabric and the C++ co-simulator (``net_cancel``)."""
+    if network == "native":
+        from pivot_tpu import native
+
+        if not native.available():
+            pytest.skip("native toolchain unavailable")
     env = Environment()
     meta = ResourceMetadata(seed=0)
     meter = Meter(env, meta)
-    cluster = _tiny_cluster(env, meter, n_hosts=2, cpus=8.0)
+    cluster = _tiny_cluster(env, meter, n_hosts=2, cpus=8.0, network=network)
     app = _chain_app(runtime=5.0, output=50_000.0, instances=1)  # slow pull
     inj = FaultInjector(cluster, seed=1)
     # Stage "b" starts after "a" (~>=5s); crash both-capable host later,

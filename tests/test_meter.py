@@ -138,6 +138,18 @@ def test_summary_counts_ops_and_turnovers(meter):
     meter.increment_scheduling_ops(7)
     meter.increment_scheduling_ops(5)
     meter.add_scheduling_turnover(42.0)
+    meter.add_scheduling_turnover(0.0)
     s = meter.summary()
     assert s["total_scheduling_ops"] == 12
-    assert meter._sched_turnovers == [42.0]
+    assert s["avg_scheduling_turnover"] == pytest.approx(21.0)
+    assert meter._sched_turnovers == [42.0, 0.0]
+
+
+def test_turnover_in_general_json(meter, tmp_path):
+    meter.add_scheduling_turnover(10.0)
+    meter.save(str(tmp_path))
+    import json
+
+    with open(tmp_path / "general.json") as f:
+        general = json.load(f)
+    assert general["avg_scheduling_turnover"] == pytest.approx(10.0)

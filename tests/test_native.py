@@ -259,6 +259,103 @@ def test_pump_callbacks_bounded():
     assert scheduled[0] <= 2 * chunks + 45
 
 
+def test_cancel_queued_transfer_parity():
+    """Cancelling a waiting transfer removes it eagerly on both fabrics:
+    queued_mb drops immediately, the survivor speeds up, done never fires."""
+
+    def run(env, routes):
+        rec = {}
+
+        def proc():
+            e_live = routes[0].send(3000.0)
+            e_live.callbacks.append(lambda _e: rec.setdefault("live", env.now))
+            e_dead = routes[0].send(5000.0)
+            e_dead.callbacks.append(lambda _e: rec.setdefault("dead", env.now))
+            yield env.timeout(0.5)  # mid-chunk-1 of live
+            routes[0].cancel(e_dead)
+            rec["queued_after"] = routes[0].queued_mb
+            rec["rt_bw_after"] = routes[0].realtime_bw
+
+        env.process(proc())
+        env.run()
+        return rec
+
+    (env_py, py_routes, _), (env_nat, nat_routes, _) = build_pair([1000.0])
+    rec_py = run(env_py, py_routes)
+    rec_nat = run(env_nat, nat_routes)
+    assert rec_py == rec_nat
+    # dead cancelled while waiting: zero of its chunks served, live runs
+    # uncontended -> 3 chunks back-to-back.
+    assert rec_nat["live"] == 3.0
+    assert "dead" not in rec_nat
+    # Queue is empty the instant dead is cancelled (live is *in service*,
+    # and in-service MB is excluded from queued_mb on both fabrics), so
+    # realtime_bw recovers to the full link rate immediately.
+    assert rec_nat["queued_after"] == 0.0
+    assert rec_nat["rt_bw_after"] == 1000.0
+
+
+def test_cancel_in_service_transfer_parity():
+    """Cancelling the in-service transfer: its current chunk (data on the
+    wire) finishes and is metered, nothing further is served."""
+    from pivot_tpu.infra.meter import Meter
+    from pivot_tpu.infra.locality import ResourceMetadata
+
+    meta = ResourceMetadata(seed=0, jitter=False)
+    (env_py, [r_py], m_py), (env_nat, [r_nat], m_nat) = build_pair(
+        [1000.0], meter_cls=Meter, meta=meta
+    )
+
+    def run(env, route):
+        rec = {}
+
+        def proc():
+            e_dead = route.send(3000.0)  # in service from t=0
+            e_dead.callbacks.append(lambda _e: rec.setdefault("dead", env.now))
+            e_live = route.send(2000.0)
+            e_live.callbacks.append(lambda _e: rec.setdefault("live", env.now))
+            yield env.timeout(0.5)  # mid dead's chunk 1
+            route.cancel(e_dead)
+
+        env.process(proc())
+        env.run()
+        return rec
+
+    rec_py = run(env_py, r_py)
+    rec_nat = run(env_nat, r_nat)
+    assert rec_py == rec_nat
+    # dead's chunk 1 finishes at t=1 (on the wire), then live's two chunks.
+    assert rec_nat == {"live": 3.0}
+    # Served-MB metering identical: 3000 MB (1 dead + 2 live chunks) hit
+    # the wire on both fabrics, so the billed egress matches exactly.
+    s_py = m_py.summary()
+    s_nat = m_nat.summary()
+    assert s_py["egress_cost"] == s_nat["egress_cost"] > 0.0
+
+
+def test_cancel_completed_transfer_noop():
+    """Cancel after completion is a no-op on both fabrics (done fired)."""
+
+    def run(env, route):
+        rec = {}
+
+        def proc():
+            evt = route.send(500.0)
+            evt.callbacks.append(lambda _e: rec.setdefault("done", env.now))
+            yield env.timeout(2.0)  # completes at 0.5
+            route.cancel(evt)
+            rec["queued_after"] = route.queued_mb
+
+        env.process(proc())
+        env.run()
+        return rec
+
+    (env_py, [r_py], _), (env_nat, [r_nat], _) = build_pair([1000.0])
+    rec_py = run(env_py, r_py)
+    rec_nat = run(env_nat, r_nat)
+    assert rec_py == rec_nat == {"done": 0.5, "queued_after": 0.0}
+
+
 def test_zero_size_send_rejected():
     (_, _, _), (env_nat, [r_nat], _) = build_pair([100.0])
     with pytest.raises(ValueError):

@@ -95,6 +95,16 @@ def test_oversubscription_waits_then_retries(meta):
     makespan = app.end_time - app.start_time
     assert makespan >= 20  # serialized
     assert makespan <= 20 + 4 * INTERVAL
+    # Turnover metric (submit→placement latency): the first replica places
+    # at its first dispatch tick (0 s); the second waits out the first's
+    # 10 s runtime in the wait queue, so its turnover covers ≥2 ticks.
+    turnovers = sorted(meter._sched_turnovers)
+    assert len(turnovers) == 2
+    assert turnovers[0] == 0.0
+    assert turnovers[1] >= 2 * INTERVAL
+    assert meter.summary()["avg_scheduling_turnover"] == pytest.approx(
+        sum(turnovers) / 2
+    )
 
 
 def test_all_policies_drain_a_dag(meta):
