@@ -43,6 +43,13 @@ def ensemble_inputs_from_schedule(schedule, cluster):
     ``app_slices[i]`` is the ``slice`` of task rows owned by app ``i`` in
     the flattened workload (``EnsembleWorkload.from_applications`` lays
     instances out app by app, group by group).
+
+    Rebasing to the first submission is phase-exact, not an
+    approximation: the DES trace replay submits its first bin at env time
+    0 (``TraceBasedApplicationGenerator`` waits only *inter*-arrival
+    gaps), so the live scheduler's tick grid — absolute multiples of the
+    interval — hits the first submission exactly at a grid point, and the
+    rollout's clock-from-0 grid matches it tick for tick.
     """
     import jax.numpy as jnp
 
@@ -52,7 +59,7 @@ def ensemble_inputs_from_schedule(schedule, cluster):
     apps = schedule.apps
     arrivals = [ts for ts, bin_apps in schedule.bins for _ in bin_apps]
     t0 = arrivals[0] if arrivals else 0.0
-    arrivals = [a - t0 for a in arrivals]  # rollout time starts at 0
+    arrivals = [a - t0 for a in arrivals]  # rollout clock starts at 0
     workload = EnsembleWorkload.from_applications(apps, arrivals=arrivals)
 
     app_slices: List[slice] = []

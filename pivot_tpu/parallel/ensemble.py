@@ -275,6 +275,12 @@ def _rollout_segment(
     # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
     cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
     bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
+    # Static within-tick task order (see the placement step).
+    if policy in ("first-fit", "cost-aware"):
+        dem_norms = jnp.sqrt(jnp.sum(workload.demands**2, axis=1))
+        task_order = jnp.argsort(-dem_norms, stable=True)
+    else:
+        task_order = jnp.arange(T)
     if congestion:
         # Pipe tables for the backlog model: bandwidth of the (src zone →
         # dst host) aggregate and its reciprocal, plus per-group instance
@@ -352,10 +358,36 @@ def _rollout_segment(
                 # (FastExecutor.abort_host cancels queued transfers).
                 q = jnp.where(struck[None, :], jnp.asarray(0.0, dtype), q)
 
-        # 2. Readiness: arrival passed ∧ all predecessor instances done.
+        # 2. Readiness: the DES dispatch pipeline at tick resolution
+        #    (measured on the live scheduler, tests/test_sched.py):
+        #      * roots enter the global submit queue at submission time
+        #        and dispatch at the first global tick STRICTLY after it
+        #        (the t=0 tick precedes the local pump);
+        #      * a successor's readiness event is its last predecessor
+        #        instance's finish τ; the app-local pump (period = tick,
+        #        phase = the app's submission time) picks it up at the
+        #        first boundary STRICTLY after τ (a boundary coinciding
+        #        with τ fires before the completion notification lands),
+        #        and the global tick dispatches STRICTLY after the pump.
+        #    Round 1 dispatched successors at the first tick ≥ τ — one to
+        #    two ticks early — which shifted tick-batch composition off
+        #    the DES's at capacity boundaries and was a dominant source
+        #    of packing-arm placement divergence.
         done_f = (stage == _DONE).astype(dtype)
         unfinished_preds = workload.pred @ (1.0 - done_f)  # [T]
-        ready = (stage == _PENDING) & (arrival <= t) & (unfinished_preds == 0)
+        G = workload.pred_group.shape[0]
+        fin_done = jnp.where(stage == _DONE, finish, -inf)
+        gf = jax.ops.segment_max(
+            fin_done, workload.group_of, num_segments=G
+        )  # [G] latest finish among a group's done instances
+        tau = jnp.max(
+            jnp.where(workload.pred_group > 0, gf[None, :], -inf), axis=1
+        )[workload.group_of]  # [T] readiness event time (−inf for roots)
+        pump = arrival + (jnp.floor((tau - arrival) / tick) + 1.0) * tick
+        ready_time = jnp.where(has_pred, pump, arrival)
+        ready = (
+            (stage == _PENDING) & (ready_time < t) & (unfinished_preds == 0)
+        )
 
         # 3. Anchors: majority vote over predecessor placement zones
         #    (ref cost_aware.py:45-58); roots use their pre-drawn random
@@ -370,10 +402,23 @@ def _rollout_segment(
         zone_onehot = jax.nn.one_hot(place_zone, Z, dtype=dtype) * placed_done[:, None]
         zc = workload.group_onehot.T @ zone_onehot  # [G, Z] done-instance counts
         if policy == "cost-aware":
-            votes_g = workload.pred_group @ zc  # [G, Z]
-            majority_zone = jnp.argmax(votes_g, axis=1).astype(jnp.int32)[
-                workload.group_of
-            ]
+            # The DES/reference vote is per HOST, not per zone (Counter
+            # over predecessor task *placements*, cost_aware.py:52-55):
+            # the anchor is the single most-loaded host's zone.  A
+            # zone-level vote (round 1) aggregates same-zone hosts and
+            # can crown a different zone whenever an app's instances
+            # spread across several hosts of one zone — measured as a
+            # successor-anchor drift between the engines.  Ties resolve
+            # to the lowest host index (the DES's first-seen insertion
+            # order fills best-scored — lowest — hosts first).
+            host_onehot = (
+                jax.nn.one_hot(jnp.clip(place, 0, H - 1), H, dtype=dtype)
+                * placed_done[:, None]
+            )
+            hv = workload.group_onehot.T @ host_onehot  # [G, H]
+            votes_h = workload.pred_group @ hv  # [G, H] pred-instance votes
+            majority_host = jnp.argmax(votes_h, axis=1)
+            majority_zone = topo.host_zone[majority_host][workload.group_of]
             anchor = jnp.where(has_pred, majority_zone, root_anchor)
         else:
             anchor = root_anchor  # unused by the other arms
@@ -404,7 +449,32 @@ def _rollout_segment(
             )
         fits_at_start = jnp.any(fits_any, axis=1)  # [T]
         eligible = ready & fits_at_start
-        order = jnp.argsort(~eligible, stable=True)  # eligible first
+        # Within-tick order mirrors the canonical DES arms.  Cost-aware
+        # processes anchor *buckets* group-major (the DES groups the
+        # batch by anchor — Storage node for successors, the Application
+        # for roots — and places one bucket at a time), with tasks inside
+        # a bucket demand-norm-decreasing (sort_tasks).  VBP first-fit
+        # runs one global decreasing sort; best-fit/opportunistic place
+        # in batch order.
+        if policy == "cost-aware":
+            # Bucket code: successor groups merge by anchor zone
+            # (Storage identity), root groups stay per-app (Application
+            # identity) — Z + app_of keeps the two key spaces disjoint.
+            bucket = jnp.where(
+                has_pred, anchor, Z + workload.app_of.astype(jnp.int32)
+            )
+            first_in_bucket = jax.ops.segment_min(
+                jnp.where(eligible, jnp.arange(T), T).astype(jnp.int32),
+                bucket, num_segments=Z + T,
+            )
+            bfirst = first_in_bucket[bucket]  # [T] bucket order ≈ first-seen
+            order = jnp.lexsort(
+                (jnp.arange(T), -dem_norms, bfirst, ~eligible)
+            )
+        else:
+            order = task_order[jnp.argsort(~eligible[task_order], stable=True)]
+            bfirst = jnp.zeros((T,), jnp.int32)
+        bf_p = bfirst[order]
         n_ready = jnp.sum(eligible)
         dem_p = workload.demands[order]
         az_p = anchor[order]
@@ -424,23 +494,36 @@ def _rollout_segment(
             score_bw_rt = bw_rt
 
         def place_cond(c):
-            j, _avail, _pl = c
+            j, _avail, _pl, _ns, _bf = c
             return j < n_ready
 
         def place_body(c):
-            j, avail, pl = c
+            j, avail, pl, norm_snap, prev_bf = c
             demand = dem_p[j]
             if strict:
                 fit = jnp.all(avail > demand[None, :], axis=1)
             else:
                 fit = jnp.all(avail >= demand[None, :], axis=1)
             if policy == "cost-aware":
-                norm = jnp.sqrt(jnp.sum(avail * avail, axis=1))
+                # Stale-score semantics (ref cost_aware.py:104-119, DES
+                # CostAwarePolicy._first_fit): host scores are computed
+                # ONCE per anchor bucket from availability at bucket
+                # start, then tasks first-fit in that frozen order with
+                # LIVE fit checks.  Re-scoring per task (live norms) was
+                # round 1's model — it spreads load as a host's residual
+                # shrinks, where the DES keeps concentrating on it;
+                # measured as the dominant cost-aware egress/IH bias.
+                live_norm = jnp.sqrt(jnp.sum(avail * avail, axis=1))
+                new_bucket = bf_p[j] != prev_bf
+                norm_snap = jnp.where(new_bucket, live_norm, norm_snap)
+                prev_bf = bf_p[j]
                 if score_params is None:
-                    score = cost_rt[az_p[j]] / (norm * score_bw_rt[az_p[j]])
+                    score = cost_rt[az_p[j]] / (
+                        norm_snap * score_bw_rt[az_p[j]]
+                    )
                 else:
                     score = cost_pow[az_p[j]] / (
-                        norm ** w_norm * bw_pow[az_p[j]]
+                        norm_snap ** w_norm * bw_pow[az_p[j]]
                     )
                 h = jnp.argmin(jnp.where(fit, score, inf))
             elif policy == "first-fit":
@@ -468,15 +551,17 @@ def _rollout_segment(
             delta = jnp.where(ok, demand, jnp.zeros_like(demand))
             avail = avail.at[h].add(-delta)
             pl = pl.at[order[j]].set(jnp.where(ok, h, -1).astype(jnp.int32))
-            return j + 1, avail, pl
+            return j + 1, avail, pl, norm_snap, prev_bf
 
-        _, avail, placements = lax.while_loop(
+        _, avail, placements, _, _ = lax.while_loop(
             place_cond,
             place_body,
             (
                 jnp.asarray(0, jnp.int32),
                 avail,
                 jnp.full((T,), -1, dtype=jnp.int32),
+                jnp.sqrt(jnp.sum(avail * avail, axis=1)),
+                jnp.asarray(-1, jnp.int32),
             ),
         )
         placed = placements >= 0
@@ -749,11 +834,43 @@ def _opportunistic_uniforms(key, n_replicas, n_tasks, dtype):
     )
 
 
+def _seed_bits(key):
+    """uint32 seed word of a PRNG key: for ``jax.random.PRNGKey(s)`` this
+    is exactly ``s`` (key data ``[0, s]``), which is what pairs the
+    estimator's keyed root-anchor draws with a DES run seeded ``s``."""
+    try:
+        data = jax.random.key_data(key)
+    except TypeError:  # already a raw uint32 key array
+        data = key
+    return data.reshape(-1)[-1].astype(jnp.uint32)
+
+
+def _keyed_storage_index_jax(seed_bits, app_ids, n_storage, salt):
+    """JAX twin of :func:`pivot_tpu.sched.rand.keyed_storage_index` —
+    identical uint32 math (tested bit-equal), so estimator replica 0
+    anchors exactly match the DES policies' keyed draws."""
+    A = jnp.uint32(0x9E3779B9)
+    B = jnp.uint32(0x85EBCA6B)
+    C = jnp.uint32(0xC2B2AE35)
+    x = seed_bits.astype(jnp.uint32) * A + salt.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * B + app_ids.astype(jnp.uint32) * A
+    x = x ^ (x >> 13)
+    x = x * C
+    x = x ^ (x >> 16)
+    return (x % jnp.uint32(n_storage)).astype(jnp.int32)
+
+
 def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
     """Deterministic per-replica Monte-Carlo draws — regenerated (not
     stored) on checkpoint resume, since they are a pure function of key."""
     T = workload.n_tasks
-    k_rt, k_arr, k_anchor = jax.random.split(key, 3)
+    # Still split in 3: threefry subkeys depend on the total split count
+    # (counters pair by halves), so dropping to split(key, 2) would
+    # silently change every rt/arr draw — breaking bit-stability with
+    # existing results and regenerated-on-resume checkpoints.  The third
+    # key (the retired jax.random anchor draw) is simply unused.
+    k_rt, k_arr, _k_retired = jax.random.split(key, 3)
     rt = workload.runtime[None, :] * jax.random.uniform(
         k_rt, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
         dtype=dtype,
@@ -764,14 +881,18 @@ def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
     )
     # Root anchors are shared PER APPLICATION, mirroring the DES cost-aware
     # policy: all root task groups of one app bucket under the app and draw
-    # ONE random storage anchor (``sched/policies.py`` group_tasks; ref
-    # ``scheduler/cost_aware.py:38-39``).  Drawn as a [R, T] table indexed
-    # by app id (columns ≥ n_apps unused) so no static app count is needed,
-    # then gathered per task.
-    anchor_idx = jax.random.randint(
-        k_anchor, (n_replicas, T), 0, storage_zones.shape[0]
+    # ONE storage anchor (``sched/policies.py`` group_tasks; ref
+    # ``scheduler/cost_aware.py:38-39``).  The draw is the entity-keyed
+    # function shared with the DES (replica salt r; r = 0 IS the DES's
+    # draw for a scheduler seeded with this key's seed word), so nominal
+    # calibration runs see identical anchors in both engines.
+    salts = jnp.arange(n_replicas, dtype=jnp.uint32)
+    anchor_idx = _keyed_storage_index_jax(
+        _seed_bits(key),
+        workload.app_of[None, :],
+        storage_zones.shape[0],
+        salts[:, None],
     )
-    anchor_idx = jnp.take(anchor_idx, workload.app_of, axis=1)
     root_anchor = storage_zones[anchor_idx].astype(jnp.int32)
     return rt, arr, root_anchor
 

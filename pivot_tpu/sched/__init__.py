@@ -213,6 +213,7 @@ class GlobalScheduler(LogMixin):
         # submit→placement turnover clock (see _dispatch_loop).
         self._pending_since: Dict[Task, float] = {}
         self._local: Dict[str, LocalScheduler] = {}
+        self._n_submitted = 0  # monotone; feeds keyed root-anchor ordinals
         self._n_unfinished = 0
         self._stopped = False
         self._tick_seq = 0
@@ -234,6 +235,12 @@ class GlobalScheduler(LogMixin):
         if app.id in self._local:
             self.logger.error("application %s already exists", app.id)
             return
+        # Submission ordinal: the stable identity the keyed root-anchor
+        # draw uses (policies.resolve_root_anchor); equals the app's row
+        # index in EnsembleWorkload, so DES and estimator key identically.
+        # Monotone — ``_local`` drops finished apps, so its size recycles.
+        app._submit_ordinal = self._n_submitted
+        self._n_submitted += 1
         local = LocalScheduler(self.env, app, self.submit_q, self.interval)
         self._local[app.id] = local
         self._n_unfinished += 1

@@ -31,7 +31,19 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from pivot_tpu.sched import Policy, TickContext
-from pivot_tpu.sched.rand import tick_uniforms
+from pivot_tpu.sched.rand import keyed_storage_index, tick_uniforms
+
+
+def resolve_root_anchor(ctx: TickContext, app, n_storage: int) -> int:
+    """Storage index anchoring ``app``'s root task groups — the keyed
+    draw (:func:`pivot_tpu.sched.rand.keyed_storage_index`) shared by
+    every policy backend AND the ensemble estimator, keyed on the app's
+    submission ordinal.  An app that never went through
+    ``GlobalScheduler.submit`` (direct-policy unit harnesses) keys as
+    ordinal 0."""
+    seed = ctx.scheduler.seed or 0
+    ordinal = getattr(app, "_submit_ordinal", 0)
+    return int(keyed_storage_index(seed, ordinal, n_storage))
 
 __all__ = [
     "OpportunisticPolicy",
@@ -337,8 +349,8 @@ class CostAwarePolicy(Policy):
         storage = ctx.cluster.storage
         extra_tasks = np.zeros(ctx.n_hosts, dtype=np.int32)  # placed this tick
         for anchor, idxs in self.group_tasks(ctx).items():
-            if not hasattr(anchor, "locality"):  # root group: random storage
-                anchor = storage[int(ctx.scheduler.randomizer.choice(len(storage)))]
+            if not hasattr(anchor, "locality"):  # root group: keyed storage
+                anchor = storage[resolve_root_anchor(ctx, anchor, len(storage))]
             if self.sort_tasks:
                 idxs = _sort_decreasing(demands, idxs)
             cost_rt, bw_rt = self._roundtrip_vectors(ctx, anchor)

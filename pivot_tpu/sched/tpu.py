@@ -63,6 +63,7 @@ from pivot_tpu.sched.policies import (
     FirstFitPolicy,
     OpportunisticPolicy,
     _sort_decreasing,
+    resolve_root_anchor,
 )
 from pivot_tpu.sched.rand import tick_uniforms
 from pivot_tpu.utils import enable_compilation_cache as _enable_compilation_cache
@@ -407,8 +408,9 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         # the TPU backend, keep the scan kernel for CPU/f64 parity runs.
         self.use_pallas = use_pallas
         # Grouping logic shared verbatim with the CPU policy; the same
-        # object doubles as the adaptive numpy twin (its place() draws the
-        # identical RNG sequence — one randomizer.choice per root group)
+        # object doubles as the adaptive numpy twin (root anchors come
+        # from the entity-keyed draw — no stream state — so the twin and
+        # the kernel agree no matter which side served earlier ticks)
         # AND as the realtime-bandwidth sampler, so the kernel scores with
         # bit-identical inputs to the twin.
         self._grouper = CostAwarePolicy(
@@ -432,8 +434,8 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         group_rows = [] if self.realtime_bw else None
         row_idx = [] if self.realtime_bw else None
         for anchor, idxs in groups.items():
-            if not hasattr(anchor, "locality"):  # root group → random storage
-                anchor = storage[int(ctx.scheduler.randomizer.choice(len(storage)))]
+            if not hasattr(anchor, "locality"):  # root group → keyed storage
+                anchor = storage[resolve_root_anchor(ctx, anchor, len(storage))]
             if self.sort_tasks:
                 idxs = _sort_decreasing(ctx.demands, idxs)
             az = meta.zone_index[anchor.locality]
