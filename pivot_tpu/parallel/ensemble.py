@@ -409,8 +409,11 @@ def _rollout_segment(
             # can crown a different zone whenever an app's instances
             # spread across several hosts of one zone — measured as a
             # successor-anchor drift between the engines.  Ties resolve
-            # to the lowest host index (the DES's first-seen insertion
-            # order fills best-scored — lowest — hosts first).
+            # to the lowest host index — an approximation of the DES's
+            # first-seen insertion order (exact only while host score
+            # order is static over the vote window; a vectorized
+            # first-seen tie-break would need per-instance placement
+            # timestamps).
             host_onehot = (
                 jax.nn.one_hot(jnp.clip(place, 0, H - 1), H, dtype=dtype)
                 * placed_done[:, None]
@@ -463,8 +466,11 @@ def _rollout_segment(
             bucket = jnp.where(
                 has_pred, anchor, Z + workload.app_of.astype(jnp.int32)
             )
+            # Bucket order keys on the min READY index — the DES buckets
+            # first-seen over the full ready batch, including tasks with
+            # no fitting host (they still pin their bucket's position).
             first_in_bucket = jax.ops.segment_min(
-                jnp.where(eligible, jnp.arange(T), T).astype(jnp.int32),
+                jnp.where(ready, jnp.arange(T), T).astype(jnp.int32),
                 bucket, num_segments=Z + T,
             )
             bfirst = first_in_bucket[bucket]  # [T] bucket order ≈ first-seen
