@@ -1,0 +1,73 @@
+"""Subprocess worker for the 2-process hybrid-mesh test.
+
+Launched by ``tests/test_ensemble.py::test_build_hybrid_mesh_two_processes``
+as ``python _hybrid_mesh_worker.py <pid> <nproc> <coordinator>``.  Each
+process pins itself to 4 virtual CPU devices, joins the JAX distributed
+runtime, builds the 3-D hybrid mesh, and runs a psum over the
+``replica_dcn`` (cross-process) axis — proving the DCN axis carries a
+real cross-process collective, not just a unit dimension.
+"""
+
+import sys
+
+
+def main() -> None:
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    # Pin env + config WITHOUT pin_virtual_cpu_mesh: its jax.devices()
+    # postcondition check would initialize the backend, which must not
+    # happen before jax.distributed.initialize().
+    import os
+
+    from pivot_tpu.utils import virtual_cpu_env
+
+    os.environ.update(virtual_cpu_env(4))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coord, num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc
+    assert jax.local_device_count() == 4
+    assert len(jax.devices()) == 4 * nproc
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pivot_tpu.parallel.mesh import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh(host_parallel=2)
+    assert mesh.axis_names == ("replica_dcn", "replica", "host")
+    assert mesh.devices.shape == (nproc, 2, 2)
+    # DCN granularity: each outer-axis slab is one process's devices.
+    for i in range(nproc):
+        assert {d.process_index for d in mesh.devices[i].flat} == {i}
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older layout
+        from jax.experimental.shard_map import shard_map
+
+    # Each process contributes pid+1 on its replica_dcn shard; the psum
+    # crosses the process boundary, so the result (1+2+...) is only
+    # correct if the DCN-axis collective really ran.
+    local = np.full((1, 2, 2), float(pid + 1), dtype=np.float32)
+    sharding = NamedSharding(mesh, P("replica_dcn", "replica", "host"))
+    garr = jax.make_array_from_process_local_data(sharding, local, (nproc, 2, 2))
+    f = jax.jit(
+        shard_map(
+            lambda x: jax.lax.psum(x, "replica_dcn"),
+            mesh=mesh,
+            in_specs=P("replica_dcn", "replica", "host"),
+            out_specs=P(None, "replica", "host"),
+        )
+    )
+    out = f(garr)
+    expect = sum(range(1, nproc + 1))
+    local_out = np.asarray(out.addressable_data(0))
+    assert np.all(local_out == expect), local_out
+    print(f"HYBRID_OK pid={pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

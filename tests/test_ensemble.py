@@ -278,6 +278,64 @@ def test_fault_rollout_all_hosts_down_forever(setup):
     assert np.all(np.asarray(res.placement) == -1)
 
 
+def test_build_hybrid_mesh_two_processes():
+    """The hybrid mesh's DCN axis on REAL process boundaries: two OS
+    processes join via ``jax.distributed``, build the (2, 2, 2) mesh, and
+    run a psum across ``replica_dcn`` — the collective-aware equivalent
+    of the reference's multi-machine story (one OS process per machine,
+    ``alibaba/sim.py:187-195``).  Complements
+    ``test_build_hybrid_mesh_single_process`` (degenerate unit axis)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # pick a free coordinator port
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    coord = f"localhost:{port}"
+    worker = os.path.join(os.path.dirname(__file__), "_hybrid_mesh_worker.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        # python <script> puts the script's dir on sys.path, not the cwd.
+        PYTHONPATH=os.pathsep.join(
+            p for p in (repo_root, os.environ.get("PYTHONPATH")) if p
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), "2", coord],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            # A fast-failing peer leaves this worker blocked in
+            # distributed init; surface the collected diagnostics
+            # instead of a bare timeout, and reap the killed children.
+            for q in procs:
+                q.kill()
+                q.wait()
+            collected = "\n".join(
+                f"worker rc={rc}:\n{o}" for rc, o in outs
+            )
+            raise AssertionError(
+                f"hybrid-mesh worker timed out; outputs so far:\n{collected}"
+            ) from None
+        outs.append((p.returncode, out))
+    for pid, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {pid} failed:\n{out}"
+        assert f"HYBRID_OK pid={pid}" in out, out
+
+
 def test_fault_rollout_crash_and_recover_extends_makespan(setup):
     """Deterministic single-host scenario: the chain's middle task is
     aborted by a crash and re-placed after recovery, extending the
