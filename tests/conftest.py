@@ -25,6 +25,19 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def load_root_module(name: str):
+    """Import a repo-root module (``bench``, ``__graft_entry__``) by file
+    path — they live outside the package, so tests load them explicitly."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 @pytest.fixture(autouse=True)
 def _reset_ids():
     from pivot_tpu.utils import reset_ids

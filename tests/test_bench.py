@@ -1,0 +1,43 @@
+"""Unit coverage for bench.py's tunnel-resilience machinery.
+
+The benchmark is executed by the external driver, so regressions here
+surface only as a failed round artifact; the probe-backoff schedule and
+its breadcrumbs are cheap to pin down in CI (the full CPU-fallback
+benchmark path is exercised manually — it takes minutes).
+"""
+
+from conftest import load_root_module
+
+
+def test_probe_backoff_records_history_and_gives_up(monkeypatch):
+    bench = load_root_module("bench")
+    calls = []
+    monkeypatch.setattr(
+        "pivot_tpu.utils.probe_backend_alive",
+        lambda timeout: calls.append(timeout) or False,
+    )
+    slept = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: slept.append(s))
+    history = []
+    assert bench._probe_with_backoff(history) is False
+    # The probes must RECEIVE the scheduled timeouts, not merely record
+    # them in the breadcrumb dicts.
+    assert calls == [t for t, _ in bench._PROBE_SCHEDULE]
+    assert [h["timeout_s"] for h in history] == [
+        t for t, _ in bench._PROBE_SCHEDULE
+    ]
+    assert all(h["alive"] is False for h in history)
+    assert slept == [s for _, s in bench._PROBE_SCHEDULE if s]
+
+
+def test_probe_backoff_stops_at_first_success(monkeypatch):
+    bench = load_root_module("bench")
+    outcomes = iter([False, True, False])
+    monkeypatch.setattr(
+        "pivot_tpu.utils.probe_backend_alive",
+        lambda timeout: next(outcomes),
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    history = []
+    assert bench._probe_with_backoff(history) is True
+    assert [h["alive"] for h in history] == [False, True]

@@ -4,7 +4,6 @@ these are run by the external driver, so a regression here fails silently
 until the next driver round if not covered in CI.
 """
 
-import importlib.util
 import os
 
 import jax
@@ -13,11 +12,9 @@ import pytest
 
 @pytest.fixture(scope="module")
 def graft():
-    path = os.path.join(os.path.dirname(__file__), "..", "__graft_entry__.py")
-    spec = importlib.util.spec_from_file_location("__graft_entry__", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    from conftest import load_root_module
+
+    return load_root_module("__graft_entry__")
 
 
 def test_entry_compiles_and_runs(graft):
@@ -32,3 +29,44 @@ def test_dryrun_multichip_8(graft):
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices (tests/conftest.py sets them)")
     graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_reexec_fallback():
+    """When JAX backends are already initialized with too few devices,
+    dryrun_multichip must recover by re-executing in a pinned child —
+    the exact situation of a driver that touched devices before calling
+    it (round-1 failure mode).  Run in a subprocess so this test's own
+    8-device backend is not the one being recovered from."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "PIVOT_PINNED_CHILD")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            # Initialize a 1-device CPU backend first, then call the
+            # dryrun: the in-process pin must fail and the child re-exec
+            # must succeed.
+            "import jax; jax.config.update('jax_platforms', 'cpu');\n"
+            "assert len(jax.devices()) == 1\n"
+            "import __graft_entry__\n"
+            "__graft_entry__.dryrun_multichip(4)\n"
+            "print('FALLBACK_OK')",
+        ],
+        cwd=repo_root,
+        env=env,
+        capture_output=True,
+        text=True,
+        # Must exceed the 600 s budget dryrun_multichip grants its own
+        # pinned re-exec child, or a legitimately slow fallback errors
+        # here with a raw TimeoutExpired and leaks the grandchild.
+        timeout=660,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "FALLBACK_OK" in res.stdout
