@@ -277,6 +277,10 @@ def parse_args(argv=None):
     cal.add_argument("--perturb", type=float, default=0.0)
     cal.add_argument("--tick", type=float, default=5.0)
     cal.add_argument("--max-ticks", type=int, default=4096)
+    cal.add_argument("--x64", action="store_true",
+                     help="run the estimator in float64 like the DES "
+                          "(CPU-side harness; tightens the static packing "
+                          "arms' fidelity — see RESULTS.md)")
     cal.add_argument("--realtime", action="store_true",
                      help="calibrate the bandwidth-aware variants against "
                           "each other: DES realtime_bw arm vs estimator "
@@ -672,6 +676,7 @@ def run_calibrate(args) -> dict:
         replicas=args.replicas,
         perturb=args.perturb,
         realtime=args.realtime,
+        x64=args.x64,
     )
     out_dir = os.path.join(args.output_dir, "calibrate", str(int(time.time())))
     os.makedirs(out_dir, exist_ok=True)

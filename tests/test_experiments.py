@@ -310,6 +310,27 @@ def test_calibrate_report_structure(tmp_path):
         assert abs(err["makespan"]) < 0.05
 
 
+def test_calibrate_x64_mode():
+    """x64 calibration runs the estimator in f64 (flagged in the report,
+    finite errors) — the mode that removes f32 strict-fit boundary flips
+    on the static packing arms."""
+    from pivot_tpu.experiments.calibrate import calibrate
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    report = calibrate(
+        "data/jobs/jobs-5000-200-172800-259200.npz",
+        cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        n_apps=2,
+        policy="best-fit",
+        max_ticks=256,
+        modes=("static",),
+        x64=True,
+    )
+    assert report["x64"] is True
+    err = report["static"]["rel_err"]["egress_cost"]
+    assert err is None or abs(err) < 10  # finite, parsed, sane
+
+
 def test_cli_autotune_end_to_end(tmp_path):
     """The autotune subcommand sweeps the score-exponent grid in one
     device program and reports a finished winner plus the reference
