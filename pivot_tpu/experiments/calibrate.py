@@ -206,9 +206,10 @@ def calibrate(
       {"des": {...}, "static": {..., "rel_err": {...}},
        "congested": {..., "rel_err": {...}}, ...config keys...}
 
-    ``x64`` runs the estimator in float64 like the DES (enables JAX x64
-    for the whole process — calibration is a CPU-side harness, where f64
-    is native).  Measured effect: the *static* packing arms track the
+    ``x64`` runs the estimator in float64 like the DES (JAX x64 is
+    enabled only for the scope of this calibration run and restored on
+    return — calibration is a CPU-side harness, where f64 is native).
+    Measured effect: the *static* packing arms track the
     DES markedly closer (best-fit egress +70% → +35% at 100×50, seed 0 —
     strict-fit boundaries and residual-norm near-ties stop flipping on
     f32 rounding), the cost-aware arm is unchanged, and the congested

@@ -15,8 +15,9 @@ differently, which the acceptance criterion tolerates (BASELINE.md —
 identical makespan/cost rankings).
 
 Adaptive dispatch (``adaptive=True``): a remote accelerator has a fixed
-per-call latency floor (dispatch + execution + result fetch — ~70 ms over
-this image's tunnel, measured) that dwarfs small ticks, while the
+per-call latency floor (dispatch + execution + result fetch — 76–86 ms
+over this image's tunnel, median 78.5 ms, re-measured on the live chip in
+round 2: ``figures/tpu_validate_r02.json``) that dwarfs small ticks, while the
 in-process numpy twin costs ~50 ns per task×host cell.  The wrapper keeps
 an online affine latency model of both sides — twin: cells × per-cell
 cost; device: probed link floor + cells × per-cell cost (the scan kernels
@@ -168,9 +169,10 @@ class _DevicePolicyBase(Policy):
     _DEVICE_ADVANTAGE = 2.0
     #: Seed for the device per-cell cost (s/cell) — the scan kernel is
     #: sequential over tasks, so device time is floor + cells × this, NOT
-    #: a constant.  Measured ~7e-9 on a v5e via tunnel at B=2048, H=600;
-    #: refined online from observed device calls.
-    _DEVICE_CELL_COST_SEED = 1e-8
+    #: a constant.  Measured 1.47e-8 s/cell on the live v5e tunnel
+    #: (affine fit over T∈{8..8192}×H=600, round-2 real-chip campaign,
+    #: figures/tpu_validate_r02.json); refined online from observed calls.
+    _DEVICE_CELL_COST_SEED = 1.5e-8
     #: Every Nth device-routed tick is served by the twin instead, so the
     #: cell-cost model keeps getting samples even when it (possibly
     #: wrongly) predicts the device is faster — without exploration an
