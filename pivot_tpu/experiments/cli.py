@@ -277,6 +277,19 @@ def parse_args(argv=None):
     cal.add_argument("--perturb", type=float, default=0.0)
     cal.add_argument("--tick", type=float, default=5.0)
     cal.add_argument("--max-ticks", type=int, default=4096)
+    cal.add_argument("--des-seeds", type=int, default=1,
+                     help="run the DES ground truth at this many "
+                          "consecutive seeds and calibrate against the "
+                          "seed MEAN (records des_per_seed/des_spread; "
+                          "the right comparison for the order-chaotic "
+                          "packing arms — pair with --replicas > 1)")
+    cal.add_argument("--cluster-seeds", type=int, default=1,
+                     help="repeat the paired DES-vs-estimator comparison "
+                          "on this many independently generated clusters "
+                          "(fresh zone layout + bandwidth jitter) and "
+                          "report mean/std rel err per metric — the "
+                          "distributional fidelity mode for the "
+                          "policy-seed-deterministic packing arms")
     cal.add_argument("--x64", action="store_true",
                      help="run the estimator in float64 like the DES "
                           "(CPU-side harness; tightens the static packing "
@@ -664,9 +677,11 @@ def run_calibrate(args) -> dict:
     from pivot_tpu.experiments.calibrate import calibrate
 
     trace = _list_traces(args.job_dir, 1)[0]
+    multi_cluster = args.cluster_seeds > 1
     report = calibrate(
         trace,
-        cluster=build_cluster(_cluster_config(args)),
+        cluster=None if multi_cluster else build_cluster(_cluster_config(args)),
+        cluster_config=_cluster_config(args) if multi_cluster else None,
         n_apps=args.num_apps,
         policy=args.policy,
         scale_factor=args.scale_factor,
@@ -677,12 +692,20 @@ def run_calibrate(args) -> dict:
         perturb=args.perturb,
         realtime=args.realtime,
         x64=args.x64,
+        des_seeds=args.des_seeds,
+        cluster_seeds=args.cluster_seeds,
     )
     out_dir = os.path.join(args.output_dir, "calibrate", str(int(time.time())))
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "report.json"), "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report))
+    # Plot path AFTER the JSON document — the first stdout line is the
+    # report (pipe-to-jq contract, same as every other subcommand).
+    if "clusters" in report or "des_per_seed" in report:
+        from pivot_tpu.experiments.plots import plot_calibration_spread
+
+        print(plot_calibration_spread(out_dir))
     return report
 
 

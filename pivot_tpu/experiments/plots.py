@@ -32,6 +32,7 @@ __all__ = [
     "plot_ensemble_distribution",
     "plot_capacity_frontier",
     "plot_apps_cost",
+    "plot_calibration_spread",
     "POLICY_ORDER",
 ]
 
@@ -307,6 +308,87 @@ def plot_capacity_frontier(run_dir: str, out: str = None) -> str:
     out = out or os.path.join(run_dir, "capacity_frontier.pdf")
     plt.savefig(out)
     plt.close()
+    return out
+
+
+def plot_calibration_spread(run_dir: str, out: str = None) -> str:
+    """Distributional-calibration figure: DES vs estimator per sample.
+
+    Reads the ``report.json`` a distributional ``calibrate`` run writes
+    (``--cluster-seeds N``: one sample per generated cluster;
+    ``--des-seeds N`` on one cluster: one sample per DES policy seed) and
+    plots, per metric, the DES's per-sample values against the
+    estimator's — making the bias-vs-chaos separation visible: a stable
+    estimator line through a scattered DES cloud is bias; tracking
+    scatter is fidelity.  No reference analog (single engine, no
+    estimator to calibrate).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    with open(os.path.join(run_dir, "report.json")) as f:
+        report = json.load(f)
+
+    metrics = ["egress_cost", "instance_hours", "avg_runtime"]
+    labels = ["egress cost ($)", "instance hours", "app. runtime (s)"]
+    if "clusters" in report:
+        samples = report["clusters"]
+        xlabel = "cluster seed sample"
+        modes = [m for m in ("static", "congested", "realtime") if m in samples[0]]
+        des_pts = {k: [c["des"][k] for c in samples] for k in metrics}
+        est_pts = {
+            (m, k): [c[m][k] for c in samples] for m in modes for k in metrics
+        }
+        summary = report.get("cluster_summary", {})
+    elif "des_per_seed" in report:
+        samples = report["des_per_seed"]
+        xlabel = "DES policy seed sample"
+        modes = [m for m in ("static", "congested", "realtime") if m in report]
+        des_pts = {k: [d[k] for d in samples] for k in metrics}
+        # One estimator run vs N DES seeds: a flat line per mode.
+        est_pts = {
+            (m, k): [report[m][k]] * len(samples)
+            for m in modes
+            for k in metrics
+        }
+        summary = {}
+    else:
+        raise ValueError(
+            "report has neither 'clusters' nor 'des_per_seed' — run "
+            "calibrate with --cluster-seeds or --des-seeds > 1"
+        )
+
+    x = np.arange(len(samples))
+    mode_marks = {"static": "s", "congested": "^", "realtime": "v"}
+    fig, axes = plt.subplots(1, len(metrics), figsize=(4 * len(metrics), 3.6))
+    for ax, k, lab in zip(axes, metrics, labels):
+        ax.plot(x, des_pts[k], marker="o", linewidth=1.5, color="0.25",
+                label="DES")
+        for m in modes:
+            ax.plot(x, est_pts[(m, k)], marker=mode_marks[m], linewidth=1.2,
+                    linestyle="--", label=f"estimator ({m})")
+        title = lab
+        s = summary.get(modes[0], {}).get(k) if summary else None
+        if s and s.get("mean_rel_err") is not None:
+            title += (
+                f"\n{modes[0]} rel err {100 * s['mean_rel_err']:+.0f}%"
+                f" ± {100 * s['std_rel_err']:.0f}%"
+            )
+        ax.set_title(title, fontsize=11)
+        ax.set_xlabel(xlabel, fontsize=11)
+        ax.set_xticks(x)
+        ax.grid(color="0.9", linewidth=0.8)
+    axes[0].legend(fontsize=9, frameon=False)
+    fig.suptitle(
+        f"{report['policy']} @ {report['n_hosts']} hosts — DES spread vs "
+        "estimator", fontsize=12,
+    )
+    fig.tight_layout()
+    out = out or os.path.join(run_dir, "calibration_spread.pdf")
+    fig.savefig(out)
+    plt.close(fig)
     return out
 
 

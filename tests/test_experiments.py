@@ -331,6 +331,130 @@ def test_calibrate_x64_mode():
     assert err is None or abs(err) < 10  # finite, parsed, sane
 
 
+def test_calibrate_distributional_des_seeds():
+    """des_seeds > 1: the report's DES target is the per-seed mean, with
+    the per-seed paths and spread attached — the distributional fidelity
+    mode for the order-chaotic packing arms."""
+    from pivot_tpu.experiments.calibrate import calibrate
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    report = calibrate(
+        "data/jobs/jobs-5000-200-172800-259200.npz",
+        cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        n_apps=2,
+        policy="first-fit",
+        max_ticks=256,
+        modes=("static",),
+        replicas=4,
+        des_seeds=3,
+    )
+    assert report["des_seeds"] == 3
+    assert len(report["des_per_seed"]) == 3
+    keys = ("avg_runtime", "egress_cost", "instance_hours", "makespan")
+    for k in keys:
+        vals = [d[k] for d in report["des_per_seed"]]
+        assert report["des"][k] == pytest.approx(sum(vals) / 3)
+        sp = report["des_spread"][k]
+        eps = 1e-9 * max(abs(sp["min"]), abs(sp["max"]), 1.0)
+        assert sp["min"] - eps <= report["des"][k] <= sp["max"] + eps
+        assert sp["std"] >= 0
+    # rel_err is computed against the seed mean.
+    est = report["static"]
+    assert est["rel_err"]["makespan"] == pytest.approx(
+        (est["makespan"] - report["des"]["makespan"])
+        / report["des"]["makespan"]
+    )
+    # Single-seed reports keep the old shape (no spread keys).
+    single = calibrate(
+        "data/jobs/jobs-5000-200-172800-259200.npz",
+        cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        n_apps=2,
+        policy="first-fit",
+        max_ticks=256,
+        modes=("static",),
+    )
+    assert "des_spread" not in single and "des_per_seed" not in single
+
+
+def test_calibrate_distributional_cluster_seeds():
+    """cluster_seeds > 1: the paired comparison repeats on independently
+    generated clusters, with per-metric mean/std rel err summarized —
+    bias vs environment-chaos separation for the deterministic packing
+    arms.  A prebuilt cluster is rejected (the seeds must drive the
+    build)."""
+    from pivot_tpu.experiments.calibrate import calibrate
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    trace = "data/jobs/jobs-5000-200-172800-259200.npz"
+    report = calibrate(
+        trace,
+        n_hosts=8,
+        n_apps=2,
+        policy="first-fit",
+        max_ticks=256,
+        modes=("static",),
+        cluster_seeds=2,
+    )
+    assert report["cluster_seeds"] == 2
+    assert len(report["clusters"]) == 2
+    # Different cluster seeds → genuinely different environments.
+    assert (report["clusters"][0]["des"] != report["clusters"][1]["des"])
+    summ = report["cluster_summary"]["static"]
+    for k in ("avg_runtime", "egress_cost", "instance_hours", "makespan"):
+        errs = [r["static"]["rel_err"][k] for r in report["clusters"]]
+        errs = [e for e in errs if e is not None]
+        if errs:
+            assert summ[k]["mean_rel_err"] == pytest.approx(
+                sum(errs) / len(errs)
+            )
+            assert summ[k]["n"] == len(errs)
+    with pytest.raises(ValueError):
+        calibrate(
+            trace,
+            cluster=build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            n_apps=2,
+            cluster_seeds=2,
+        )
+
+
+def test_plot_calibration_spread(tmp_path):
+    """The distributional-calibration figure renders from both report
+    shapes (cluster_seeds and des_seeds) and rejects a plain report."""
+    import json
+
+    from pivot_tpu.experiments.plots import plot_calibration_spread
+
+    base = {"policy": "first-fit", "n_hosts": 8, "replicas": 4}
+    des = lambda e: {"avg_runtime": 100.0 + e, "egress_cost": 1.0 + e,  # noqa: E731
+                     "instance_hours": 5.0 + e, "makespan": 400.0}
+    est = lambda e: {**des(e), "rel_err": {}}  # noqa: E731
+
+    multi = dict(base, clusters=[
+        {"des": des(i), "static": est(i * 0.5)} for i in range(3)
+    ], cluster_summary={"static": {
+        k: {"mean_rel_err": 0.1, "std_rel_err": 0.02, "n": 3}
+        for k in ("avg_runtime", "egress_cost", "instance_hours", "makespan")
+    }})
+    d1 = tmp_path / "multi"
+    d1.mkdir()
+    (d1 / "report.json").write_text(json.dumps(multi))
+    out = plot_calibration_spread(str(d1))
+    assert os.path.exists(out)
+
+    seeds = dict(base, des_per_seed=[des(i) for i in range(3)],
+                 static=est(0.2))
+    d2 = tmp_path / "seeds"
+    d2.mkdir()
+    (d2 / "report.json").write_text(json.dumps(seeds))
+    assert os.path.exists(plot_calibration_spread(str(d2)))
+
+    d3 = tmp_path / "plain"
+    d3.mkdir()
+    (d3 / "report.json").write_text(json.dumps(dict(base, des=des(0))))
+    with pytest.raises(ValueError):
+        plot_calibration_spread(str(d3))
+
+
 def test_cli_autotune_end_to_end(tmp_path):
     """The autotune subcommand sweeps the score-exponent grid in one
     device program and reports a finished winner plus the reference

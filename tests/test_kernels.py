@@ -229,12 +229,15 @@ def test_adaptive_small_tick_routes_to_numpy_twin(meta):
 
 
 def test_adaptive_large_tick_routes_to_device(meta):
-    """With a zero device floor every tick goes to the device path."""
+    """With the device latency model zeroed (floor AND per-cell slope —
+    under the measured slope alone, bucket padding can still tip marginal
+    ticks to the twin) every tick goes to the device path."""
     ctx_a = make_ctx(meta, SHAPES * 4, random_groups(2)(), seed=2)
     ctx_b = make_ctx(meta, SHAPES * 4, random_groups(2)(), seed=2)
     pol = as_f64(TpuFirstFitPolicy(decreasing=True, adaptive=True))
     pol.bind(ctx_a.scheduler)
     pol._device_floor = 0.0
+    pol._device_cell_cost = 0.0
     pol._cpu_twin.place = None  # any twin call would crash
     ref = as_f64(TpuFirstFitPolicy(decreasing=True))
     ref.bind(ctx_b.scheduler)
