@@ -33,7 +33,7 @@ import sys
 import time
 
 
-def _timed_calls(call, fetch, n: int = 3) -> float:
+def _timed_calls(call, fetch, n: int = 3) -> "tuple[float, object]":
     """(seconds per call, last output) over ``n`` serialized device
     calls, forced complete by a scalar value fetch of the LAST output.
 

@@ -29,11 +29,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _time_best(fn, repeats=5):
-    fn()  # warm (compile)
+    """Best-of wall time of ``fn``, which must RETURN a device array (it
+    is fetched to force completion — ``block_until_ready`` can under-wait
+    on the tunnel backend, see RESULTS.md "Measurement integrity", so a
+    value fetch is the only trustworthy barrier).  Includes one link RTT
+    per call, like every per-call figure in this campaign (the floor
+    measurements are themselves RTT-inclusive by definition)."""
+    np.asarray(fn())  # warm (compile)
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        fn()
+        np.asarray(fn())
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -93,6 +99,8 @@ def floor_and_slope() -> dict:
     """Re-measure the adaptive router's device latency model on the live
     link: per-call floor (trivial kernel round trip) and the scan
     kernel's per-padded-cell slope at several bucket sizes."""
+    import jax.numpy as jnp
+
     from pivot_tpu.ops.kernels import cost_aware_kernel
     from pivot_tpu.sched.tpu import _DevicePolicyBase, _probe_device_floor
 
@@ -105,12 +113,9 @@ def floor_and_slope() -> dict:
     for T in (8, 128, 512, 2048, 8192):
         args = make_inputs(0, T, H)
         mode = dict(bin_pack="first-fit", sort_hosts=True, host_decay=False)
-
-        def run():
-            p, _ = cost_aware_kernel(*args, **mode)
-            p.block_until_ready()
-
-        best = _time_best(run)
+        best = _time_best(
+            lambda: jnp.sum(cost_aware_kernel(*args, **mode)[0])
+        )
         cells_and_times.append((T * H, best))
     # Affine fit: time = floor + cells * slope
     cells = np.array([c for c, _ in cells_and_times], dtype=np.float64)
@@ -165,11 +170,7 @@ def crossover(quick: bool) -> dict:
 
             def make(kernel):
                 f = jax.jit(jax.vmap(lambda a: kernel(a, *rest, **mode)[0]))
-
-                def run():
-                    f(avail_r).block_until_ready()
-
-                return run
+                return lambda: jnp.sum(f(avail_r))
 
             rec = {"T": T, "H": H, "R": R}
             for name, kern in (("scan", cost_aware_kernel), ("pallas", cost_aware_pallas)):
