@@ -281,6 +281,7 @@ def _rollout_segment(
         task_order = jnp.argsort(-dem_norms, stable=True)
     else:
         task_order = jnp.arange(T)
+    task_rank = jnp.argsort(task_order)  # static inverse permutation
     if congestion:
         # Pipe tables for the backlog model: bandwidth of the (src zone →
         # dst host) aggregate and its reciprocal, plus per-group instance
@@ -307,6 +308,17 @@ def _rollout_segment(
         cost_pow = cost_rt ** score_params[0]
         bw_pow = bw_rt ** score_params[1]
     inf = jnp.asarray(jnp.inf, dtype)
+    G = workload.pred_group.shape[0]
+    # Static one-hot expansion tables, hoisted out of the tick loop.
+    # They replace per-tick [R, T] gathers (group→task and host→zone
+    # expansions), which lower to scalar-memory gathers inside the
+    # vmapped while loop — serialized on the scalar core, measured as
+    # the dominant per-tick cost.  Select-reduces over them are exact:
+    # each row has exactly one hit, and adding zeros is IEEE-exact.
+    g_oh = workload.group_of[:, None] == jnp.arange(G)[None, :]  # [T, G]
+    zone_onehot = (
+        topo.host_zone[:, None] == jnp.arange(Z)[None, :]
+    ).astype(dtype)  # [H, Z] — integer counts matmul (bf16-exact < 256)
 
     def cond(carry):
         i, state = carry
@@ -375,14 +387,16 @@ def _rollout_segment(
         #    of packing-arm placement divergence.
         done_f = (stage == _DONE).astype(dtype)
         unfinished_preds = workload.pred @ (1.0 - done_f)  # [T]
-        G = workload.pred_group.shape[0]
         fin_done = jnp.where(stage == _DONE, finish, -inf)
         gf = jax.ops.segment_max(
             fin_done, workload.group_of, num_segments=G
         )  # [G] latest finish among a group's done instances
-        tau = jnp.max(
+        tau_g = jnp.max(
             jnp.where(workload.pred_group > 0, gf[None, :], -inf), axis=1
-        )[workload.group_of]  # [T] readiness event time (−inf for roots)
+        )  # [G] readiness event time (−inf for root groups)
+        tau = jnp.sum(
+            jnp.where(g_oh, tau_g[None, :], jnp.zeros((), dtype)), axis=1
+        )  # [T] — select-reduce, not the former [R, T] gather
         pump = arrival + (jnp.floor((tau - arrival) / tick) + 1.0) * tick
         ready_time = jnp.where(has_pred, pump, arrival)
         ready = (
@@ -397,19 +411,23 @@ def _rollout_segment(
         #    any per-replica [T, T] product.  (zc also feeds the
         #    transfer estimate, so it is computed for every policy; the
         #    vote itself only matters to cost-aware.)
-        place_zone = topo.host_zone[jnp.clip(place, 0, H - 1)]
         done_mask = stage == _DONE
         placed_done = done_mask.astype(dtype)
-        # Done-instance counts per (group, zone) via one segment-sum pass
-        # over tasks — a [T, Z] one-hot matmul here (and its [T, H] host
-        # twin below) would materialize R × T × H scratch per tick, which
-        # measured ~2.7× slower end to end on the 256-replica bench.
-        gz_idx = jnp.where(
-            done_mask, workload.group_of * Z + place_zone, G * Z
+        # Done-instance counts per (group, host) via one segment-sum pass
+        # over tasks, then zone counts as hv @ zone_onehot.  The former
+        # [R, T] ``host_zone[place]`` gather lowered to a scalar-memory
+        # gather (serialized on the scalar core, ~1 ms/tick measured);
+        # the one-hot matmul stays on the MXU and is integer-exact
+        # (counts ≤ max instances < 256 are exact in bf16, one-hot
+        # factors are 0/1, accumulation is f32).
+        gh_idx = jnp.where(
+            done_mask, workload.group_of * H + jnp.clip(place, 0, H - 1),
+            G * H,
         )
-        zc = jax.ops.segment_sum(
-            placed_done, gz_idx, num_segments=G * Z + 1
-        )[: G * Z].reshape(G, Z)  # [G, Z]
+        hv = jax.ops.segment_sum(
+            placed_done, gh_idx, num_segments=G * H + 1
+        )[: G * H].reshape(G, H)  # [G, H] done counts per host
+        zc = hv @ zone_onehot  # [G, Z]
         if policy == "cost-aware":
             # The DES/reference vote is per HOST, not per zone (Counter
             # over predecessor task *placements*, cost_aware.py:52-55):
@@ -423,17 +441,19 @@ def _rollout_segment(
             # order is static over the vote window; a vectorized
             # first-seen tie-break would need per-instance placement
             # timestamps).
-            gh_idx = jnp.where(
-                done_mask,
-                workload.group_of * H + jnp.clip(place, 0, H - 1),
-                G * H,
-            )
-            hv = jax.ops.segment_sum(
-                placed_done, gh_idx, num_segments=G * H + 1
-            )[: G * H].reshape(G, H)
             votes_h = workload.pred_group @ hv  # [G, H] pred-instance votes
-            majority_host = jnp.argmax(votes_h, axis=1)
-            majority_zone = topo.host_zone[majority_host][workload.group_of]
+            majority_host = jnp.argmax(votes_h, axis=1)  # [G]
+            # Zone of each group's majority host, then group → task
+            # expansion — both as integer select-reduces on the VPU (the
+            # former ``host_zone[majority_host][group_of]`` double gather
+            # ran on the scalar core; sums of one non-zero int are exact).
+            mh_oh = jnp.arange(H)[None, :] == majority_host[:, None]
+            mz_g = jnp.sum(
+                jnp.where(mh_oh, topo.host_zone[None, :], 0), axis=1
+            )  # [G]
+            majority_zone = jnp.sum(
+                jnp.where(g_oh, mz_g[None, :], 0), axis=1
+            )  # [T]
             anchor = jnp.where(has_pred, majority_zone, root_anchor)
         else:
             anchor = root_anchor  # unused by the other arms
@@ -481,22 +501,49 @@ def _rollout_segment(
             # Bucket order keys on the min READY index — the DES buckets
             # first-seen over the full ready batch, including tasks with
             # no fitting host (they still pin their bucket's position).
-            first_in_bucket = jax.ops.segment_min(
-                jnp.where(ready, jnp.arange(T), T).astype(jnp.int32),
-                bucket, num_segments=Z + T,
-            )
-            bfirst = first_in_bucket[bucket]  # [T] bucket order ≈ first-seen
-            order = jnp.lexsort(
-                (jnp.arange(T), -dem_norms, bfirst, ~eligible)
-            )
+            # Computed as a [T, T] compare/min-reduce on the VPU: the
+            # former segment_min + ``first_in_bucket[bucket]`` pair both
+            # lowered to scalar-memory scatter/gather inside the loop.
+            ready_idx = jnp.where(ready, jnp.arange(T), T).astype(jnp.int32)
+            same_bucket = bucket[:, None] == bucket[None, :]
+            bfirst = jnp.min(
+                jnp.where(same_bucket, ready_idx[None, :], T), axis=1
+            ).astype(jnp.int32)
+            key3 = -dem_norms  # norm-decreasing inside a bucket
         else:
-            order = task_order[jnp.argsort(~eligible[task_order], stable=True)]
             bfirst = jnp.zeros((T,), jnp.int32)
-        bf_p = bfirst[order]
+            # Static rank in task_order: sorting by it reproduces
+            # ``task_order[argsort(~eligible[task_order], stable)]``.
+            key3 = task_rank
+        # ONE multi-operand sort carrying every per-task payload through,
+        # replacing lexsort + four ``x[order]`` gathers (each a batched
+        # gather with scalar-memory indices — the dominant per-tick cost
+        # before this rewrite).  Keys (major → minor): ineligible-last,
+        # bucket first-seen, in-bucket order, task index (unique, so the
+        # permutation — and every payload — is exactly the old one).
+        iota_t = jnp.arange(T, dtype=jnp.int32)
+        operands = [
+            (~eligible).astype(jnp.int32),
+            bfirst,
+            key3,
+            iota_t,
+            workload.demands[:, 0],
+            workload.demands[:, 1],
+            workload.demands[:, 2],
+            workload.demands[:, 3],
+            anchor,
+            workload.group_of.astype(jnp.int32),
+        ]
+        if task_u is not None:
+            operands.append(task_u)
+        sorted_ops = lax.sort(tuple(operands), num_keys=4)
+        order = sorted_ops[3]
+        bf_p = sorted_ops[1]
+        dem_p = jnp.stack(sorted_ops[4:8], axis=1)
+        az_p = sorted_ops[8]
+        g_p = sorted_ops[9]
+        u_p = sorted_ops[10] if task_u is not None else None
         n_ready = jnp.sum(eligible)
-        dem_p = workload.demands[order]
-        az_p = anchor[order]
-        u_p = task_u[order] if task_u is not None else None
         if realtime_scoring and policy == "cost-aware":
             # Discount the inbound leg of the round-trip bandwidth by the
             # tick-start backlog on each (anchor zone → host) pipe — the
@@ -511,12 +558,34 @@ def _rollout_segment(
         else:
             score_bw_rt = bw_rt
 
+        # 5a. Transfer-delay table — BEFORE the placement loop (it only
+        #     reads zc, which predates placement): max over predecessor
+        #     instances of size / bw(src zone → dst zone).  All instances
+        #     of a producer group share one output size, so the max
+        #     reduces exactly to zone *presence* per group: GD[g, z] =
+        #     out_g × max over source zones s with a done g-instance of
+        #     1/bw[s, z] ([G, Z]), then CD[c, z] = max over c's
+        #     predecessor groups of GD.  Each placement selects its
+        #     CD[g, zone(h)] entry inside the loop (tiny VPU selects);
+        #     the former post-loop path gathered [R, T] ``new_zone`` and
+        #     ``CD[group_of, new_zone]`` through scalar memory.
+        inv_bw = jnp.where(topo.bw > 0, 1.0 / topo.bw, 0.0)  # [Z, Z]
+        presence = (zc > 0).astype(dtype)  # [G, Z]
+        GD = (
+            jnp.max(presence[:, :, None] * inv_bw[None, :, :], axis=1)
+            * workload.out_group[:, None]
+        )  # [G, Z]
+        CD = lax.map(
+            lambda col: jnp.max(workload.pred_group * col[None, :], axis=1),
+            GD.T,
+        ).T  # [G, Z] max over predecessor groups, zone column at a time
+
         def place_cond(c):
-            j, _avail, _pl, _ns, _bf = c
+            j, _avail, _pl, _dl, _ns, _bf = c
             return j < n_ready
 
         def place_body(c):
-            j, avail, pl, norm_snap, prev_bf = c
+            j, avail, pl, delay, norm_snap, prev_bf = c
             demand = dem_p[j]
             if strict:
                 fit = jnp.all(avail > demand[None, :], axis=1)
@@ -535,14 +604,26 @@ def _rollout_segment(
                 new_bucket = bf_p[j] != prev_bf
                 norm_snap = jnp.where(new_bucket, live_norm, norm_snap)
                 prev_bf = bf_p[j]
+                # Anchor-zone row selection via one-hot select-reduce,
+                # NOT ``table[az_p[j]]``: under vmap the indexed form
+                # lowers to a batched gather whose [R] index vector
+                # lives in scalar memory — serialized on the scalar
+                # core, measured as a dominant rollout cost.  The
+                # select-reduce stays on the VPU and is bit-exact (the
+                # sum has exactly one non-zero term; adding zeros is
+                # IEEE-exact for finite table entries).
+                zoh = (jnp.arange(Z) == az_p[j])[:, None]  # [Z, 1]
+                zero = jnp.zeros((), dtype)
                 if score_params is None:
-                    score = cost_rt[az_p[j]] / (
-                        norm_snap * score_bw_rt[az_p[j]]
+                    cost_row = jnp.sum(jnp.where(zoh, cost_rt, zero), axis=0)
+                    bw_row = jnp.sum(
+                        jnp.where(zoh, score_bw_rt, zero), axis=0
                     )
+                    score = cost_row / (norm_snap * bw_row)
                 else:
-                    score = cost_pow[az_p[j]] / (
-                        norm_snap ** w_norm * bw_pow[az_p[j]]
-                    )
+                    cost_row = jnp.sum(jnp.where(zoh, cost_pow, zero), axis=0)
+                    bw_row = jnp.sum(jnp.where(zoh, bw_pow, zero), axis=0)
+                    score = cost_row / (norm_snap ** w_norm * bw_row)
                 h = jnp.argmin(jnp.where(fit, score, inf))
             elif policy == "first-fit":
                 h = jnp.argmax(fit)  # lowest-index fit (ref vbp.py:6-29)
@@ -566,43 +647,49 @@ def _rollout_segment(
                 rank = jnp.cumsum(fit) - 1  # rank among fitting hosts
                 h = jnp.argmax(fit & (rank == k))
             ok = jnp.any(fit)
-            delta = jnp.where(ok, demand, jnp.zeros_like(demand))
-            avail = avail.at[h].add(-delta)
-            pl = pl.at[order[j]].set(jnp.where(ok, h, -1).astype(jnp.int32))
-            return j + 1, avail, pl, norm_snap, prev_bf
+            # One-hot state updates, NOT ``.at[h].add`` / ``.at[...].set``:
+            # under vmap those lower to batched scatters with scalar-
+            # memory index vectors (serialized on the scalar core — with
+            # the row gathers above, ~85% of rollout wall before this
+            # rewrite).  Bit-exact: x − d·1 ≡ x + (−d), x − d·0 ≡ x.
+            host_hit = (jnp.arange(avail.shape[0]) == h)[:, None]  # [H, 1]
+            avail = avail - jnp.where(
+                host_hit & ok, demand[None, :], jnp.zeros((), avail.dtype)
+            )
+            task_hit = jnp.arange(T) == order[j]
+            pl = jnp.where(
+                task_hit, jnp.where(ok, h, -1).astype(jnp.int32), pl
+            )
+            # Transfer delay CD[group, zone(h)] for this placement via
+            # three tiny VPU selects (zone of h, CD group row, zone
+            # entry); unplaced tasks keep 0, masked by ``placed`` below.
+            z_h = jnp.sum(jnp.where(jnp.arange(H) == h, topo.host_zone, 0))
+            cd_row = jnp.sum(
+                jnp.where(
+                    (jnp.arange(G) == g_p[j])[:, None], CD,
+                    jnp.zeros((), dtype),
+                ),
+                axis=0,
+            )  # [Z]
+            d_j = jnp.sum(
+                jnp.where(jnp.arange(Z) == z_h, cd_row, jnp.zeros((), dtype))
+            )
+            delay = jnp.where(task_hit & ok, d_j, delay)
+            return j + 1, avail, pl, delay, norm_snap, prev_bf
 
-        _, avail, placements, _, _ = lax.while_loop(
+        _, avail, placements, xfer_delay, _, _ = lax.while_loop(
             place_cond,
             place_body,
             (
                 jnp.asarray(0, jnp.int32),
                 avail,
                 jnp.full((T,), -1, dtype=jnp.int32),
+                jnp.zeros((T,), dtype),
                 jnp.sqrt(jnp.sum(avail * avail, axis=1)),
                 jnp.asarray(-1, jnp.int32),
             ),
         )
         placed = placements >= 0
-
-        # 5. Transfer estimate: max over predecessor instances of
-        #    size / bw(src zone → dst zone).  All instances of a producer
-        #    group share one output size, so the max reduces exactly to
-        #    zone *presence* per group: GD[g, z] = out_g × max over source
-        #    zones s with a done g-instance of 1/bw[s, z]  ([G, Z]), then
-        #    CD[c, z] = max over c's predecessor groups of GD ([G, Z] via
-        #    a short lax.map over the Z≈31 zones), gathered per task.
-        inv_bw = jnp.where(topo.bw > 0, 1.0 / topo.bw, 0.0)  # [Z, Z]
-        presence = (zc > 0).astype(dtype)  # [G, Z]
-        GD = (
-            jnp.max(presence[:, :, None] * inv_bw[None, :, :], axis=1)
-            * workload.out_group[:, None]
-        )  # [G, Z]
-        CD = lax.map(
-            lambda col: jnp.max(workload.pred_group * col[None, :], axis=1),
-            GD.T,
-        ).T  # [G, Z] max over predecessor groups, zone column at a time
-        new_zone = topo.host_zone[jnp.clip(placements, 0, H - 1)]
-        xfer_delay = CD[workload.group_of, new_zone]  # [T]
 
         if congestion:
             # Backlog pipe model: every (src zone s → dst host h) aggregate
@@ -616,6 +703,14 @@ def _rollout_segment(
             # aggregation is one matmul + one segment sum — nothing bigger
             # than [T, Z] is materialized.
             pull_gz = pull_frac @ zc  # [G, Z] pulled MB per consumer instance
+            # Group → task expansion kept as a shared-index gather: a
+            # g_oh one-hot MATMUL here would not be bit-exact (pull_gz
+            # carries real f32 values, which the MXU truncates to bf16 —
+            # unlike the integer-count ``hv @ zone_onehot`` above), and a
+            # where/reduce select would build an [R, T, G, Z] broadcast.
+            # The index vector (group_of) is shared across replicas, so
+            # this lowers to a constant-index gather, not the batched
+            # scalar-memory form the placement-loop rewrite eliminated.
             vol_tz = pull_gz[workload.group_of] * placed[:, None]  # [T, Z]
             v_new = jax.ops.segment_sum(
                 vol_tz, jnp.where(placed, placements, H), num_segments=H + 1
@@ -627,6 +722,11 @@ def _rollout_segment(
             # skips it, ``resources/__init__.py:263-267`` — so backlog
             # from other tasks must not delay this one through it).
             pulls_from = vol_tz > 0
+            # This batched gather (per-replica placements index) is the
+            # one the placement-loop rewrite CANNOT eliminate: q_now
+            # depends on all of this tick's placements, so the per-pipe
+            # ratio cannot be selected during placement.  Congestion
+            # rollouts keep this one scalar-memory gather per tick.
             ratio_t = (q_now * inv_bw_zh)[:, jnp.clip(placements, 0, H - 1)].T
             cong_delay = jnp.max(
                 jnp.where(pulls_from, ratio_t, 0.0), axis=1
