@@ -271,7 +271,17 @@ def _rollout_segment(
         fault_idx = jnp.where(fault_host >= 0, fault_host, H)  # pad → drop
 
         def _scatter_hosts(hit):  # [F] bool fault mask -> [H] bool host mask
-            return jnp.zeros((H + 1,), bool).at[fault_idx].max(hit)[:H]
+            # One-hot any-reduce, not ``.at[fault_idx].max``: under vmap
+            # the scatter's per-replica index vector lands in scalar
+            # memory and serializes on the scalar core (three calls per
+            # tick in fault ensembles — see ARCHITECTURE.md, "the
+            # scalar-core lesson").  Padded entries (idx == H) hit no
+            # host, exactly like the old scatter-then-slice.
+            return jnp.any(
+                (fault_idx[:, None] == jnp.arange(H)[None, :])
+                & hit[:, None],
+                axis=0,
+            )
     # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
     cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
     bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
