@@ -511,13 +511,22 @@ def _rollout_segment(
             # Bucket order keys on the min READY index — the DES buckets
             # first-seen over the full ready batch, including tasks with
             # no fitting host (they still pin their bucket's position).
-            # Computed as a [T, T] compare/min-reduce on the VPU: the
-            # former segment_min + ``first_in_bucket[bucket]`` pair both
-            # lowered to scalar-memory scatter/gather inside the loop.
+            # Computed as [T, B] one-hot min/select-reduces on the VPU
+            # (the former segment_min + ``first_in_bucket[bucket]`` pair
+            # both lowered to scalar-memory scatter/gather inside the
+            # loop).  B = Z + G bounds the bucket key space statically:
+            # successor buckets are zones (< Z) and root buckets are
+            # Z + app index, with #apps ≤ G (every app owns ≥ 1 group) —
+            # linear in T, unlike a [T, T] same-bucket compare, which is
+            # 13M cells/replica at the calibrate scale (T≈3.6k).
+            B = Z + G
             ready_idx = jnp.where(ready, jnp.arange(T), T).astype(jnp.int32)
-            same_bucket = bucket[:, None] == bucket[None, :]
-            bfirst = jnp.min(
-                jnp.where(same_bucket, ready_idx[None, :], T), axis=1
+            b_oh = bucket[:, None] == jnp.arange(B)[None, :]  # [T, B]
+            fib = jnp.min(
+                jnp.where(b_oh, ready_idx[:, None], T), axis=0
+            )  # [B] first ready index per bucket
+            bfirst = jnp.sum(
+                jnp.where(b_oh, fib[None, :], 0), axis=1
             ).astype(jnp.int32)
             key3 = -dem_norms  # norm-decreasing inside a bucket
         else:
