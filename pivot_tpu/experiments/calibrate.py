@@ -238,9 +238,10 @@ def calibrate(
     arms can move either way (the backlog model's sample path shifts);
     see RESULTS.md.
     """
-    from pivot_tpu.utils import enable_compilation_cache
+    from pivot_tpu.utils import enable_compilation_cache, ensure_live_backend
     from pivot_tpu.utils.config import ClusterConfig, build_cluster
 
+    ensure_live_backend()  # degrade to CPU on a wedged tunnel, never hang
     enable_compilation_cache()
 
     if realtime and policy != "cost-aware":

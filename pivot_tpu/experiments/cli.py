@@ -551,12 +551,14 @@ def _ensemble_setup(args):
     trace→device-inputs preamble shared by the ``ensemble`` and
     ``autotune`` subcommands."""
     from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
-    from pivot_tpu.utils import enable_compilation_cache
+    from pivot_tpu.utils import enable_compilation_cache, ensure_live_backend
     from pivot_tpu.workload.trace import load_trace_jobs
 
     # Every caller is about to jit large ensemble programs; make compiles
     # survive the process (VERDICT r1: only the policy path cached before,
-    # so each fresh CLI run repaid a full compile, e.g. the 362 s apps sweep).
+    # so each fresh CLI run repaid a full compile, e.g. the 362 s apps sweep),
+    # and refuse to hang on a wedged tunnel (degrade to CPU instead).
+    ensure_live_backend()
     enable_compilation_cache()
 
     trace = _list_traces(args.job_dir, 1)[0]

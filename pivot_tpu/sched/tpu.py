@@ -88,45 +88,9 @@ def pad_bucket(n: int) -> int:
     return ((n + 8191) // 8192) * 8192
 
 
-_live_backend_checked = False
-
-
-def _ensure_live_backend() -> None:
-    """Refuse to hang a simulation on a wedged accelerator tunnel.
-
-    The first device touch (topology push, floor probe) blocks inside a
-    PJRT client init that no signal handler can interrupt if the remote
-    backend is unresponsive — ``jax.devices()`` itself hangs.  Probe
-    liveness in a disposable child process first (killable regardless of
-    where it blocks, same technique as ``bench.py``); on a stalled or
-    failing probe, pin the CPU backend — the kernels are bit-compatible
-    there — and warn, so ``--device tpu`` degrades instead of wedging.
-
-    Skipped when the platform is already explicitly pinned to CPU (tests,
-    ``JAX_PLATFORMS=cpu`` runs): nothing remote to probe.
-    """
-    global _live_backend_checked
-    if _live_backend_checked:
-        return
-    _live_backend_checked = True
-    import jax
-
-    # Skip only when the PRIMARY platform is cpu: the deployment default
-    # is a list like "axon,cpu", where the accelerator still initializes
-    # first — "cpu" merely appearing in the list must not skip the probe.
-    pinned = jax.config.jax_platforms
-    if pinned and str(pinned).split(",")[0] == "cpu":
-        return
-    import logging
-
-    from pivot_tpu.utils import probe_backend_alive
-
-    if not probe_backend_alive():
-        logging.getLogger("pivot_tpu").warning(
-            "accelerator backend unresponsive — device policies fall back "
-            "to the CPU backend for this process"
-        )
-        jax.config.update("jax_platforms", "cpu")
+# Shared wedged-tunnel guard (moved to utils in round 2 so the estimator
+# CLI flows get the same protection as the policy path).
+from pivot_tpu.utils import ensure_live_backend as _ensure_live_backend  # noqa: E402
 
 
 def _probe_device_floor() -> float:

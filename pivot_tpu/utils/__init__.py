@@ -168,6 +168,47 @@ def virtual_cpu_env(n_devices: int, base=None) -> dict:
     return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
 
 
+_live_backend_checked = False
+
+
+def ensure_live_backend() -> None:
+    """Refuse to hang this process on a wedged accelerator tunnel.
+
+    The first device touch blocks inside a PJRT client init that no
+    signal handler can interrupt if the remote backend is unresponsive —
+    ``jax.devices()`` itself hangs.  Probe liveness in a disposable child
+    process first; on a stalled or failing probe, config-pin the CPU
+    backend (the kernels are bit-compatible there) and warn, so every
+    device entry point degrades instead of wedging.  Checked once per
+    process; skipped when the PRIMARY platform is already explicitly cpu
+    (tests, ``pin_virtual_cpu_mesh`` runs — nothing remote to probe).
+
+    Call this before the FIRST device touch of any user-facing device
+    path: the policy ``bind`` (``sched.tpu``), the ensemble/calibrate/
+    autotune/capacity/apps CLI preambles.  (Round-1 carried the guard on
+    the policy path only; a wedged tunnel could still hang the estimator
+    CLI flows un-interruptibly.)
+    """
+    global _live_backend_checked
+    if _live_backend_checked:
+        return
+    _live_backend_checked = True
+    import jax
+
+    # Skip only when the PRIMARY platform is cpu: the deployment default
+    # is a list like "axon,cpu", where the accelerator still initializes
+    # first — "cpu" merely appearing in the list must not skip the probe.
+    pinned = jax.config.jax_platforms
+    if pinned and str(pinned).split(",")[0] == "cpu":
+        return
+    if not probe_backend_alive():
+        get_logger("pivot_tpu").warning(
+            "accelerator backend unresponsive — device programs fall back "
+            "to the CPU backend for this process"
+        )
+        jax.config.update("jax_platforms", "cpu")
+
+
 def probe_backend_alive(timeout: float = 150.0) -> bool:
     """True iff ``import jax; jax.devices()`` completes in a child process.
 

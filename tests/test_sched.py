@@ -204,7 +204,7 @@ def test_ensure_live_backend_falls_back_on_dead_tunnel(monkeypatch):
         return False
 
     updates = {}
-    monkeypatch.setattr(devmod, "_live_backend_checked", False)
+    monkeypatch.setattr(utils, "_live_backend_checked", False)
     monkeypatch.setattr(utils, "probe_backend_alive", fake_probe)
     monkeypatch.setattr(
         jax.config, "update",
@@ -247,9 +247,11 @@ def test_ensure_live_backend_skips_when_cpu_pinned(monkeypatch):
     """Explicit CPU pin (tests, JAX_PLATFORMS=cpu) skips the probe."""
     import subprocess
 
+    import pivot_tpu.utils as utils
     from pivot_tpu.sched import tpu as devmod
 
-    monkeypatch.setattr(devmod, "_live_backend_checked", False)
+    # The guard (and its memo flag) live in utils since the round-2 move.
+    monkeypatch.setattr(utils, "_live_backend_checked", False)
 
     def boom(*a, **kw):
         raise AssertionError("must not probe under an explicit cpu pin")
