@@ -91,9 +91,16 @@ def _norms(mat: jax.Array) -> jax.Array:
 
 
 def _place(avail, demand, h, ok):
-    """Decrement row ``h`` by ``demand`` when ``ok`` (no-op otherwise)."""
-    delta = jnp.where(ok, demand, jnp.zeros_like(demand))
-    return avail.at[h].add(-delta)
+    """Decrement row ``h`` by ``demand`` when ``ok`` (no-op otherwise).
+
+    One-hot arithmetic, not ``avail.at[h].add``: under ``vmap`` (the
+    Monte-Carlo replica axis) the indexed form lowers to a batched
+    scatter whose per-replica index vector lands in TPU scalar memory
+    and serializes on the scalar core (see ARCHITECTURE.md, "the
+    scalar-core lesson").  Bit-exact: x − d·1 ≡ x + (−d), x − d·0 ≡ x.
+    """
+    hit = (jnp.arange(avail.shape[0]) == h)[:, None] & ok
+    return avail - jnp.where(hit, demand[None, :], jnp.zeros((), avail.dtype))
 
 
 @jax.jit
@@ -247,8 +254,11 @@ def cost_aware_kernel(
         avail = _place(avail, demand, h, ok)
         if not first_fit:
             # Only best-fit's live decay reads the within-tick counter
-            # (first-fit decay is frozen at tick start, ref :115).
-            extra = extra.at[h].add(jnp.where(ok, 1, 0))
+            # (first-fit decay is frozen at tick start, ref :115) — one-hot
+            # increment for the same scalar-core reason as _place.
+            extra = extra + (
+                (jnp.arange(extra.shape[0]) == h) & ok
+            ).astype(extra.dtype)
         return (avail, score, extra), jnp.where(ok, h, -1).astype(jnp.int32)
 
     init = (
