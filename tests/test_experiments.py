@@ -231,6 +231,28 @@ def test_cli_ensemble_checkpoint(tmp_path):
     assert os.path.exists(ckpt)
 
 
+def test_cli_ensemble_replica_chunk(tmp_path):
+    """--replica-chunk runs the ensemble in per-chunk device calls and
+    still delivers the full replica set (summary + arrays)."""
+    from pivot_tpu.experiments import cli
+
+    out = tmp_path / "out"
+    summary = cli.run_ensemble(cli.parse_args([
+        "--num-hosts", "16", "--job-dir", "data/jobs",
+        "--output-dir", str(out), "--seed", "2",
+        "ensemble", "--num-apps", "3", "--replicas", "12",
+        "--max-ticks", "256", "--replica-chunk", "5",
+    ]))
+    assert summary["replicas"] == 12
+    assert summary["replica_chunk"] == 5
+    assert summary["unfinished_max"] == 0
+    (run_dir,) = (out / "ensemble").iterdir()
+    import numpy as np
+
+    arrs = np.load(run_dir / "rollout.npz")
+    assert arrs["makespan"].shape == (12,)
+
+
 def test_executor_knob_excluded_from_resume_identity():
     """--executor is result-neutral: old sentinels (written before the knob
     existed) and cross-executor sentinels must both stay valid."""
