@@ -242,19 +242,19 @@ def parse_args(argv=None):
                           "checkpoint/resume at this path")
     ens.add_argument("--replica-chunk", type=int, default=0, metavar="R",
                      help="run the ensemble in replica chunks of R per "
-                          "device call (0 = off).  Single-chip remedy: "
-                          "on the v5e, R>512 calls go superlinear "
-                          "(RESULTS.md scaling table) — chunking at 512 "
-                          "runs a 1024-replica ensemble ~1.6x faster; "
-                          "ignored (with a warning) on a multi-chip mesh "
-                          "where the sharded path already splits "
-                          "replicas.  Without --checkpoint each chunk is "
-                          "one monolithic unbounded device call (that "
-                          "shape IS the speedup; add --checkpoint to "
-                          "bound calls per 64-tick segment).  Opt-in "
-                          "because chunking draws a different (equally "
-                          "i.i.d.) Monte-Carlo sample set than one "
-                          "monolithic call")
+                          "device call (0 = off) — the pressure valve "
+                          "for replica counts beyond HBM's comfortable "
+                          "working set, and a way to keep each device "
+                          "call short on remote transports (RESULTS.md "
+                          "scaling tables).  Ignored (with a warning) on "
+                          "a multi-chip mesh where the sharded path "
+                          "already splits replicas.  Without "
+                          "--checkpoint each chunk is one monolithic "
+                          "device call; add --checkpoint to bound calls "
+                          "per 64-tick segment.  Opt-in because chunking "
+                          "draws a different (equally i.i.d.) "
+                          "Monte-Carlo sample set than one monolithic "
+                          "call")
     ens.add_argument("--faults", type=int, default=0, metavar="N",
                      help="per-replica random host crashes: each replica "
                           "draws an independent N-crash schedule "

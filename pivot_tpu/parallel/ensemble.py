@@ -343,12 +343,37 @@ def _rollout_segment(
         i, (t, stage, finish, place, avail, busy, q) = carry
 
         # 1. Retire finished tasks and refund their resources.
+        #    Select-reduce over a [T, H] membership mask, NOT a
+        #    segment_sum: under vmap the segment form lowers to a
+        #    scatter-add whose [R, T] index vector lives in scalar
+        #    memory — profiled at ~1 ms/tick serialized on the scalar
+        #    core, 28% of the whole rollout (the same class the
+        #    placement-loop rewrite eliminated; ARCHITECTURE.md, "the
+        #    scalar-core lesson").  A one-hot MATMUL would be faster
+        #    still but is not exact for real-valued f32 demands (MXU
+        #    truncates operands to bf16); the select-reduce stays on the
+        #    VPU with full f32 adds.  Summation is XLA's tree order
+        #    rather than the scatter's index order — refunds of several
+        #    tasks on one host can differ by ULPs from the old path
+        #    (both deterministic; the DES is the semantic referee and
+        #    sums per-event anyway).
         newly_done = (stage == _RUNNING) & (finish <= t)
-        refund_per_host = jax.ops.segment_sum(
-            workload.demands * newly_done[:, None].astype(dtype),
-            jnp.where(newly_done, place, H),
-            num_segments=H + 1,
-        )[:H]
+        # ONE [T, H] placement one-hot shared by the refund sum and the
+        # done-count einsum (their masks differ only in the stage
+        # predicate ANDed on; fault aborts between them only touch
+        # RUNNING rows, which the done predicate excludes).  The busy
+        # max below rebuilds it because placements land in ``place``
+        # first.  Unplaced rows carry the -1 sentinel and match no host
+        # column.
+        place_oh = place[:, None] == jnp.arange(H)[None, :]
+        refund_per_host = jnp.sum(
+            jnp.where(
+                (place_oh & newly_done[:, None])[:, :, None],
+                workload.demands[:, None, :],
+                jnp.zeros((), dtype),
+            ),
+            axis=0,
+        )  # [H, 4]
         avail = avail + refund_per_host
         stage = jnp.where(newly_done, _DONE, stage)
 
@@ -422,21 +447,24 @@ def _rollout_segment(
         #    transfer estimate, so it is computed for every policy; the
         #    vote itself only matters to cost-aware.)
         done_mask = stage == _DONE
-        placed_done = done_mask.astype(dtype)
-        # Done-instance counts per (group, host) via one segment-sum pass
-        # over tasks, then zone counts as hv @ zone_onehot.  The former
-        # [R, T] ``host_zone[place]`` gather lowered to a scalar-memory
-        # gather (serialized on the scalar core, ~1 ms/tick measured);
-        # the one-hot matmul stays on the MXU and is integer-exact
-        # (counts ≤ max instances < 256 are exact in bf16, one-hot
-        # factors are 0/1, accumulation is f32).
-        gh_idx = jnp.where(
-            done_mask, workload.group_of * H + jnp.clip(place, 0, H - 1),
-            G * H,
-        )
-        hv = jax.ops.segment_sum(
-            placed_done, gh_idx, num_segments=G * H + 1
-        )[: G * H].reshape(G, H)  # [G, H] done counts per host
+        # Done-instance counts per (group, host) as ONE bf16 one-hot
+        # contraction over tasks: hv[g, h] = Σ_t 1[group_of[t]=g] ·
+        # 1[place[t]=h, done].  The former segment-sum over a flattened
+        # (group × host) id lowered to a scatter-add with a per-replica
+        # [R, T] scalar-memory index vector — profiled at ~1 ms/tick
+        # serialized on the scalar core, 22% of the whole rollout.  The
+        # matmul form is integer-EXACT: one-hot factors are 0/1 (exact
+        # in bf16), counts ≤ max instances < 256, and the MXU
+        # accumulates in f32 — same argument as ``hv @ zone_onehot``
+        # below.  (The former [R, T] ``host_zone[place]`` gather was
+        # removed by the round-2 rewrite for the same reason.)
+        place_done_oh = place_oh & done_mask[:, None]  # [T, H]
+        hv = jnp.einsum(
+            "tg,th->gh",
+            g_oh.astype(jnp.bfloat16),
+            place_done_oh.astype(jnp.bfloat16),
+            preferred_element_type=dtype,
+        )  # [G, H] done counts per host
         zc = hv @ zone_onehot  # [G, Z]
         if policy == "cost-aware":
             # The DES/reference vote is per HOST, not per zone (Counter
@@ -767,14 +795,27 @@ def _rollout_segment(
         #    resident finish (capped at the window) — the per-window
         #    integral max_tasks(min(finish − t, tick)) is exact within
         #    the rollout's own timing model, not a whole-tick rounding.
+        #    Select-max over a [T, H] membership mask, NOT a segment_max
+        #    (the vmapped segment form is a scalar-memory scatter like
+        #    the refund above — profiled at ~1 ms/tick, 22% of the
+        #    rollout).  Max is order-independent, so this is bit-exact
+        #    vs the old path; empty hosts reduce to the 0 identity the
+        #    old ``maximum(·, 0)`` clamp produced.  The mask is rebuilt
+        #    rather than shared with the tick-start ``place_oh``: this
+        #    tick's placements have landed in ``place`` by now and must
+        #    count toward busy time.
         contrib = jnp.where(
             stage == _RUNNING, jnp.clip(finish - t, 0.0, tick), 0.0
         )
-        busy_host = jax.ops.segment_max(
-            contrib, jnp.where(stage == _RUNNING, place, H),
-            num_segments=H + 1,
-        )[:H]
-        busy = busy + jnp.sum(jnp.maximum(busy_host, 0.0))
+        run_at = (
+            (place[:, None] == jnp.arange(H)[None, :])
+            & (stage == _RUNNING)[:, None]
+        )  # [T, H]
+        busy_host = jnp.max(
+            jnp.where(run_at, contrib[:, None], jnp.zeros((), dtype)),
+            axis=0,
+        )  # [H]
+        busy = busy + jnp.sum(busy_host)
 
         return (
             i + 1,
@@ -1668,14 +1709,18 @@ def _fingerprint(
     against edited workload data that merely kept its shapes."""
     import hashlib
 
-    base = (np.asarray(key).tolist(), n_replicas, tick, max_ticks, perturb)
+    # "v2": the tick body's refund select-reduce (round-2 scatter purge)
+    # sums in tree order — ULP-different from the old scatter order for
+    # multiple same-host refunds — so checkpoints written by the old body
+    # must restart, not resume into a mixed-order trajectory.
+    base = ("v2", np.asarray(key).tolist(), n_replicas, tick, max_ticks,
+            perturb)
     if policy != "cost-aware":
-        # Appended only for non-default arms so cost-aware fingerprints —
-        # and therefore every pre-existing checkpoint — are unchanged.
+        # Appended only for non-default arms so cost-aware fingerprints
+        # within a body version are unchanged by this field's existence.
         base = base + (policy,)
     if fault_cfg[0]:
-        # Appended only for fault runs so fault-free fingerprints — and
-        # therefore every pre-existing checkpoint — are unchanged.
+        # Appended only for fault runs (same compat-within-version rule).
         base = base + (fault_cfg,)
     if congestion:
         # Appended only when the backlog model is on (same compat rule).
@@ -1826,18 +1871,20 @@ def rollout_chunked(
 ) -> RolloutResult:
     """Ensemble rollout in replica chunks of ``replica_chunk``.
 
-    Why chunk: per-call rollout cost on the single v5e goes superlinear
-    past the chip's comfortable working set — measured at the bench
-    workload (24 groups, 600 hosts, 128 ticks), R=256→512 scales
-    near-linearly (981→903 rollouts/s) but R=1024 drops to 566/s
-    (1.81 s vs the ~1.05 s linear expectation): the [R, T, H]
-    intermediates start spilling (RESULTS.md, round-2 scaling table).
+    Why chunk: bound the per-call working set and duration.  When the
+    tick body still carried vmapped scatters, R=1024 went superlinear
+    (scalar-memory scatter operands spilled; chunking at 512 measured
+    1.65×).  After the segment-op purge removed those scatters the
+    R-axis scales near-linearly (R=1024 ≈ 4.5× the R=256 wall) and
+    chunking is ~neutral at bench scale (2,520 vs 2,475 rollouts/s) —
+    it remains the pressure valve for replica counts beyond what HBM
+    comfortably holds, and keeps each device call short on remote
+    transports that kill long executions (RESULTS.md, round-2 scaling
+    tables before/after the purge).
 
     Execution shape per chunk: WITHOUT a ``checkpoint_path``, each chunk
-    is one monolithic :func:`rollout` call — that is where the win
-    lives: 2×R=512 plain calls measured 949 rollouts/s vs 576 monolithic
-    R=1024 (**1.65×**), while routing chunks through the segmented
-    executor *loses* (466/s — per-segment host round-trips).  WITH a
+    is one monolithic :func:`rollout` call (routing chunks through the
+    segmented executor pays per-segment host round-trips).  WITH a
     ``checkpoint_path``, each chunk runs segmented via
     :func:`rollout_checkpointed`, checkpointing (and resuming) at
     ``<root>.c<c><ext>``; finished chunks resume straight to finalize.
