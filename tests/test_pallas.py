@@ -12,7 +12,10 @@ import jax
 import jax.numpy as jnp
 
 from pivot_tpu.ops.kernels import cost_aware_kernel
-from pivot_tpu.ops.pallas_kernels import cost_aware_pallas
+from pivot_tpu.ops.pallas_kernels import (
+    cost_aware_pallas,
+    cost_aware_pallas_batched,
+)
 
 Z = 31
 
@@ -99,6 +102,66 @@ def test_pallas_vmap_batched():
     for r in range(R):
         p_ref, _ = cost_aware_kernel(*base[r][:5], *shared, **mode)
         assert p_ref.tolist() == batched[0][r].tolist()
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("block_replicas", [8, 3])
+def test_pallas_batched_matches_scan(mode, block_replicas):
+    """Replica-batched kernel ≡ per-replica scan kernel, shared task stream.
+
+    R=5 deliberately not a multiple of block_replicas to cover the
+    replica-padding lanes.
+    """
+    R, T, H = 5, 70, 40
+    args = make_inputs(3, T, H)
+    rng = np.random.default_rng(9)
+    avail_r = jnp.asarray(
+        np.asarray(args[0])[None] * rng.uniform(0.5, 1.5, (R, H, 1)),
+        jnp.float32,
+    )
+    p_bat, a_bat = cost_aware_pallas_batched(
+        avail_r, *args[1:], **mode, block_replicas=block_replicas,
+        interpret=True,
+    )
+    assert p_bat.shape == (R, T) and a_bat.shape == (R, H, 4)
+    for r in range(R):
+        p_ref, a_ref = cost_aware_kernel(avail_r[r], *args[1:], **mode)
+        assert p_ref.tolist() == p_bat[r].tolist(), f"replica {r}"
+        np.testing.assert_allclose(
+            np.asarray(a_ref), np.asarray(a_bat[r]), rtol=1e-6, atol=1e-5
+        )
+
+
+def test_pallas_batched_chunk_boundary():
+    """Carried per-replica state survives SMEM chunk boundaries."""
+    R, T, H = 4, 700, 24
+    args = make_inputs(11, T, H, frac_new_group=0.02)
+    rng = np.random.default_rng(2)
+    avail_r = jnp.asarray(
+        np.asarray(args[0])[None] * rng.uniform(0.6, 1.4, (R, H, 1)),
+        jnp.float32,
+    )
+    mode = dict(bin_pack="first-fit", sort_hosts=True, host_decay=False)
+    p_bat, _ = cost_aware_pallas_batched(
+        avail_r, *args[1:], **mode, block_replicas=4, interpret=True
+    )
+    placed = 0
+    for r in range(R):
+        p_ref, _ = cost_aware_kernel(avail_r[r], *args[1:], **mode)
+        assert p_ref.tolist() == p_bat[r].tolist(), f"replica {r}"
+        placed += int(jnp.sum(p_bat[r] >= 0))
+    assert placed > 0
+
+
+def test_pallas_batched_empty():
+    args = make_inputs(0, 0, 8)
+    avail_r = jnp.stack([args[0]] * 2)
+    p, out = cost_aware_pallas_batched(
+        avail_r, *args[1:], bin_pack="first-fit", sort_hosts=True,
+        interpret=True,
+    )
+    assert p.shape == (2, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(avail_r))
 
 
 def test_pallas_empty_tick():

@@ -152,7 +152,10 @@ def crossover(quick: bool) -> dict:
     from tests.test_pallas import make_inputs
 
     from pivot_tpu.ops.kernels import cost_aware_kernel
-    from pivot_tpu.ops.pallas_kernels import cost_aware_pallas
+    from pivot_tpu.ops.pallas_kernels import (
+        cost_aware_pallas,
+        cost_aware_pallas_batched,
+    )
 
     mode = dict(bin_pack="first-fit", sort_hosts=True, host_decay=False)
     grid = []
@@ -172,16 +175,28 @@ def crossover(quick: bool) -> dict:
                 f = jax.jit(jax.vmap(lambda a: kernel(a, *rest, **mode)[0]))
                 return lambda: jnp.sum(f(avail_r))
 
+            def make_batched():
+                f = jax.jit(
+                    lambda a: cost_aware_pallas_batched(a, *rest, **mode)[0]
+                )
+                return lambda: jnp.sum(f(avail_r))
+
             rec = {"T": T, "H": H, "R": R}
-            for name, kern in (("scan", cost_aware_kernel), ("pallas", cost_aware_pallas)):
+            variants = (
+                ("scan", make(cost_aware_kernel)),
+                ("pallas", make(cost_aware_pallas)),
+                ("pallas_rb", make_batched()),
+            )
+            for name, run in variants:
                 try:
-                    best = _time_best(make(kern), repeats=3)
+                    best = _time_best(run, repeats=3)
                     rec[f"{name}_s"] = round(best, 6)
                     rec[f"{name}_decisions_per_s"] = round(R * T / best, 1)
                 except Exception as exc:  # noqa: BLE001
                     rec[f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
-            if "scan_s" in rec and "pallas_s" in rec:
-                rec["winner"] = "pallas" if rec["pallas_s"] < rec["scan_s"] else "scan"
+            timed = {n: rec[f"{n}_s"] for n, _ in variants if f"{n}_s" in rec}
+            if timed:
+                rec["winner"] = min(timed, key=timed.get)
             grid.append(rec)
     return {"mode": mode, "grid": grid}
 
