@@ -254,17 +254,33 @@ def cost_aware_pallas_batched(
     multiple)``; placements are bit-identical to the scan kernel at
     every block size (hardware-verified 64/128/256/512, both bin-pack
     modes).
+
+    Sharp edge for callers at large RB: keep BOTH returned arrays live
+    through ``jit``.  If the availability output is dead code, XLA
+    allocates the unused pallas result on the scoped-VMEM stack instead
+    of HBM — measured +4 MB at (RB=512, Hp=512), pushing the 16 MB
+    scoped limit over and failing the compile, while the both-outputs
+    form compiles and runs (see tools/tpu_validate.py).
     """
     R, H = avail_r.shape[0], avail_r.shape[1]
     T = demands.shape[0]
     if T == 0 or R == 0:
         return jnp.zeros((R, T), jnp.int32), avail_r
     if block_replicas is None:
-        # Fewest VMEM-safe blocks, sized to split R evenly: picking the
-        # max block outright would round R up to a multiple of 512 (e.g.
+        # VMEM budget first: the block's working set is dominated by the
+        # two [4·RB, Hp] avail blocks plus two [RB, Hp] scratches
+        # (~40·RB·Hp bytes) and the [RB, chunk] placement block; cap RB
+        # so it stays ~12 MB of the 16 MB scoped-VMEM limit at ANY host
+        # count (the fixed 512 cap is only proven at Hp ≤ 512).
+        Hp_est = _round_up(max(H, 128), 128)
+        chunk_est = min(256, _round_up(T, 8))
+        vmem_cap = int(12e6 // (40 * Hp_est + 8 * chunk_est))
+        rb_max = max(8, min(_MAX_BLOCK_REPLICAS, vmem_cap // 8 * 8))
+        # Then fewest blocks, sized to split R evenly: picking the max
+        # block outright would round R up to a multiple of it (e.g.
         # R=520 → Rp=1024, ~2× padded work); even splitting keeps
         # replica padding under one sublane tile per block.
-        n_blocks = -(-R // _MAX_BLOCK_REPLICAS)
+        n_blocks = -(-R // rb_max)
         block_replicas = _round_up(-(-R // n_blocks), 8)
     RB = block_replicas
     Hp = _round_up(max(H, 128), 128)

@@ -54,10 +54,16 @@ def parity_sweep() -> dict:
     lowerings round the score arithmetic differently, which we record
     rather than hide).
     """
+    import jax
+    import jax.numpy as jnp
+
     from tests.test_pallas import MODES, make_inputs
 
     from pivot_tpu.ops.kernels import cost_aware_kernel
-    from pivot_tpu.ops.pallas_kernels import cost_aware_pallas
+    from pivot_tpu.ops.pallas_kernels import (
+        cost_aware_pallas,
+        cost_aware_pallas_batched,
+    )
 
     out = []
     for seed, T, H in [(0, 37, 13), (1, 300, 50), (2, 5, 200), (7, 700, 40)]:
@@ -71,6 +77,28 @@ def parity_sweep() -> dict:
                     np.asarray(a_ref), np.asarray(a_pal), rtol=1e-6, atol=1e-4
                 )
             )
+            # Replica-batched form (R=5, non-multiple of the sublane
+            # block) against per-replica scan placements.
+            R = 5
+            rng = np.random.default_rng(seed + 100)
+            avail_r = jnp.asarray(
+                np.asarray(args[0])[None] * rng.uniform(0.8, 1.2, (R, H, 1)),
+                jnp.float32,
+            )
+            p_bat = cost_aware_pallas_batched(
+                avail_r, *args[1:], **mode, interpret=False
+            )[0]
+            p_scan_r = jax.vmap(
+                lambda a: cost_aware_kernel(a, *args[1:], **mode)[0]
+            )(avail_r)
+            batched_match = bool(jnp.all(p_bat == p_scan_r))
+            batched_mism = []
+            if not batched_match:
+                bad = np.argwhere(np.asarray(p_bat != p_scan_r))
+                batched_mism = [
+                    (int(r_), int(t_), int(p_bat[r_, t_]), int(p_scan_r[r_, t_]))
+                    for r_, t_ in bad[:5]
+                ]
             rec = {
                 "seed": seed,
                 "T": T,
@@ -78,6 +106,12 @@ def parity_sweep() -> dict:
                 **{k: v for k, v in mode.items()},
                 "placements_match": match,
                 "avail_close": avail_close,
+                "batched_match": batched_match,
+                **(
+                    {"batched_first_mismatches_rthw": batched_mism}
+                    if batched_mism
+                    else {}
+                ),
             }
             if not match:
                 mism = [
@@ -88,10 +122,13 @@ def parity_sweep() -> dict:
                 rec["n_mismatch"] = len(mism)
                 rec["first_mismatches"] = mism[:5]
             out.append(rec)
+    def _ok(r):
+        return r["placements_match"] and r["avail_close"] and r["batched_match"]
+
     return {
         "cases": len(out),
-        "all_match": all(r["placements_match"] and r["avail_close"] for r in out),
-        "failures": [r for r in out if not (r["placements_match"] and r["avail_close"])],
+        "all_match": all(_ok(r) for r in out),
+        "failures": [r for r in out if not _ok(r)],
     }
 
 
@@ -176,10 +213,16 @@ def crossover(quick: bool) -> dict:
                 return lambda: jnp.sum(f(avail_r))
 
             def make_batched():
+                # Keep BOTH kernel outputs live through jit: dropping the
+                # availability output makes XLA allocate the unused pallas
+                # result on the scoped-VMEM stack instead of HBM, which
+                # OOMs the compile at large replica blocks (16.72M vs the
+                # 16M scoped limit at RB=512, Hp=512 — reproduced; the
+                # both-outputs form compiles and runs).
                 f = jax.jit(
-                    lambda a: cost_aware_pallas_batched(a, *rest, **mode)[0]
+                    lambda a: cost_aware_pallas_batched(a, *rest, **mode)
                 )
-                return lambda: jnp.sum(f(avail_r))
+                return lambda: jnp.sum(f(avail_r)[0])
 
             rec = {"T": T, "H": H, "R": R}
             variants = (
@@ -188,12 +231,19 @@ def crossover(quick: bool) -> dict:
                 ("pallas_rb", make_batched()),
             )
             for name, run in variants:
-                try:
-                    best = _time_best(run, repeats=3)
-                    rec[f"{name}_s"] = round(best, 6)
-                    rec[f"{name}_decisions_per_s"] = round(R * T / best, 1)
-                except Exception as exc:  # noqa: BLE001
-                    rec[f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
+                # One retry: the tunnel's remote-compile helper can 500
+                # transiently on programs the cache has not seen (observed
+                # on a config that compiled fine in three sibling
+                # processes); only a repeated failure is a real finding.
+                for attempt in (0, 1):
+                    try:
+                        best = _time_best(run, repeats=3)
+                        rec[f"{name}_s"] = round(best, 6)
+                        rec[f"{name}_decisions_per_s"] = round(R * T / best, 1)
+                        rec.pop(f"{name}_error", None)
+                        break
+                    except Exception as exc:  # noqa: BLE001
+                        rec[f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
             timed = {n: rec[f"{n}_s"] for n, _ in variants if f"{n}_s" in rec}
             if timed:
                 rec["winner"] = min(timed, key=timed.get)
