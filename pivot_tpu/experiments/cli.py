@@ -555,10 +555,11 @@ def _maybe_shard_sweep(sweep_fn, **static_kw):
 
     from pivot_tpu.parallel.ensemble import shard_sweep
 
-    # Unsharded fallback runs in bounded 64-tick device calls (the
-    # rollout_checkpointed rationale — remote-transport friendly);
+    # Unsharded fallback runs in bounded 256-tick device calls (the
+    # rollout_checkpointed default's rationale — remote-transport
+    # friendly at +14 % over monolithic, vs +49 % for 64-tick segments);
     # shard_sweep owns — and logs — the fallback decision.
-    return shard_sweep(sweep_fn, fallback_segment_ticks=64, **static_kw)
+    return shard_sweep(sweep_fn, fallback_segment_ticks=256, **static_kw)
 
 
 def _ensemble_setup(args):
@@ -638,12 +639,14 @@ def run_ensemble(args) -> dict:
         replica_chunk = 0
     if single_device:
         # Without --replica-chunk: segmented execution, one bounded
-        # device call per 64 ticks (a monolithic while_loop over
+        # device call per 256 ticks (a monolithic while_loop over
         # thousands of ticks is one minutes-long execution, which remote
-        # single-chip transports may kill).  With --replica-chunk and no
-        # --checkpoint: one MONOLITHIC call per chunk — that execution
-        # shape is where the chunking win lives (RESULTS.md), at the
-        # cost of unbounded per-call duration; see the flag's help text.
+        # single-chip transports may kill; 256 keeps calls ~1.4 s at the
+        # canonical scale at +14 % over monolithic, vs +49 % for the old
+        # 64-tick segments).  With --replica-chunk and no --checkpoint:
+        # one MONOLITHIC call per chunk — that execution shape is where
+        # the chunking win lives (RESULTS.md), at the cost of unbounded
+        # per-call duration; see the flag's help text.
         res = rollout_chunked(
             key, avail0, workload, topo, storage_zones, args.checkpoint,
             replica_chunk, **kw

@@ -1747,7 +1747,7 @@ def rollout_checkpointed(
     tick: float = 5.0,
     max_ticks: int = 512,
     perturb: float = 0.1,
-    segment_ticks: int = 64,
+    segment_ticks: int = 256,
     resume: bool = True,
     n_faults: int = 0,
     fault_horizon: Optional[float] = None,
@@ -1761,6 +1761,12 @@ def rollout_checkpointed(
     The rollout runs in jitted segments of ``segment_ticks``; after each
     segment the ``[R]``-stacked :class:`RolloutState` (pure arrays) is
     written atomically (tmp + rename) to ``checkpoint_path`` (``.npz``).
+    The 256-tick default balances per-segment host round-trips against
+    call duration (measured at the canonical 25-app × 256-replica
+    scale: 64-tick segments cost +49 % over one monolithic call,
+    256-tick +14 %, each call ~1.4 s); callers wanting a finer
+    checkpoint cadence or shorter calls on a flaky transport pass a
+    smaller ``segment_ticks`` — results are bit-identical at any value.
     If the process dies, rerunning with ``resume=True`` loads the last
     state and continues — the final result is bit-identical to an
     uninterrupted :func:`rollout` with the same arguments, because the
@@ -1865,7 +1871,7 @@ def rollout_chunked(
     checkpoint_path: Optional[str],
     replica_chunk: int,
     n_replicas: int = 64,
-    segment_ticks: int = 64,
+    segment_ticks: int = 256,
     resume: bool = True,
     **kw,
 ) -> RolloutResult:
