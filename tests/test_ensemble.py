@@ -93,6 +93,22 @@ def test_workload_flattening():
     assert pred[:3].sum() == 0
 
 
+def test_group_demand_invariant_guard():
+    """Per-instance demand variation must be rejected loudly: the rollout's
+    group-level fit test relies on group-constant demands."""
+    app = Application(
+        "w",
+        [TaskGroup("a", cpus=1, mem=1, runtime=1, instances=3)],
+    )
+    w = EnsembleWorkload.from_applications([app])
+    w.check_group_demands()  # constructor invariant holds
+    dem = np.asarray(w.demands).copy()
+    dem[1, 0] = 2.0  # instance 1 of group a diverges
+    bad = w._replace(demands=jnp.asarray(dem))
+    with pytest.raises(ValueError, match="vary within a group"):
+        bad.check_group_demands()
+
+
 def test_rollout_chain_makespan(setup):
     """Chain with zero transfers and no perturbation: makespan = Σ runtime
     + the DES dispatch pipeline's per-stage latency.  Derivation, matching
