@@ -150,6 +150,9 @@ class EnsembleWorkload(NamedTuple):
                 "group-level fit test requires group-constant demands — "
                 "build workloads via EnsembleWorkload.from_applications"
             )
+        if len(_checked_demands) > 256:  # prune dead refs, bound growth
+            for k in [k for k, r in _checked_demands.items() if r() is None]:
+                del _checked_demands[k]
         _checked_demands[key] = weakref.ref(self.demands)
 
     @classmethod
