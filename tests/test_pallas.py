@@ -153,6 +153,46 @@ def test_pallas_batched_chunk_boundary():
     assert placed > 0
 
 
+@pytest.mark.parametrize(
+    "seed,h_lo,h_hi",
+    [(21, 2, 12), (22, 2, 12), (23, 100, 300), (24, 100, 300), (25, 12, 100)],
+)
+def test_pallas_batched_fuzz(seed, h_lo, h_hi):
+    """Randomized shapes: batched kernel ≡ per-replica scan kernel.
+
+    Regression surface for the headline kernel beyond the deterministic
+    cases: random (T, H, R, block size) draws spanning tiny host counts
+    (H as low as 2) through lane-tile-sized ones, with ``seed % 5``
+    cycling through ALL five policy modes (both bin-pack algorithms,
+    host_decay on/off, unsorted hosts).  Placements must match exactly
+    and availability within float tolerance, like the deterministic
+    parity cases.
+    """
+    rng = np.random.default_rng(seed)
+    T = int(rng.integers(1, 400))
+    H = int(rng.integers(h_lo, h_hi))
+    R = int(rng.integers(1, 9))
+    mode = MODES[seed % len(MODES)]
+    rb = int(rng.choice([1, 3, 8, 0], p=[0.2, 0.2, 0.3, 0.3])) or None
+    args = make_inputs(seed, T, H)
+    avail_r = jnp.asarray(
+        np.asarray(args[0])[None] * rng.uniform(0.4, 1.6, (R, H, 1)),
+        jnp.float32,
+    )
+    p_bat, a_bat = cost_aware_pallas_batched(
+        avail_r, *args[1:], **mode, block_replicas=rb, interpret=True
+    )
+    p_ref, a_ref = jax.vmap(
+        lambda a: cost_aware_kernel(a, *args[1:], **mode)
+    )(avail_r)
+    ctx = f"T={T} H={H} R={R} rb={rb} mode={mode}"
+    assert bool(jnp.all(p_bat == p_ref)), ctx
+    np.testing.assert_allclose(
+        np.asarray(a_ref), np.asarray(a_bat), rtol=1e-6, atol=1e-5,
+        err_msg=ctx,
+    )
+
+
 def test_pallas_batched_empty():
     args = make_inputs(0, 0, 8)
     avail_r = jnp.stack([args[0]] * 2)
