@@ -1,7 +1,13 @@
 """Benchmark: placement-decision throughput, TPU kernel vs naive Python.
 
-Prints ONE JSON line:
+Prints ONE JSON line (the LAST line of stdout is authoritative):
   {"metric": ..., "value": N, "unit": "decisions/sec", "vs_baseline": N, ...}
+
+One exception to "one line": when a run falls back to CPU because the
+accelerator tunnel was dead at start but the end-of-run re-probe finds it
+alive, the process re-executes on the TPU and prints a second, TPU-backed
+line after the CPU one — the superseding record.  Consumers must parse
+the final JSON line, not the whole stream.
 
 The measured quantity is the north-star hot loop (BASELINE.md): the
 cost-aware (PIVOT) placement decision over a ready-task × host batch —
@@ -297,6 +303,49 @@ def _probe_with_backoff(history: list) -> bool:
     return False
 
 
+def _write_tpu_record(line: dict, probe_history: list) -> None:
+    """Refresh the canonical hardware-bench artifact ``BENCH_TPU.json``.
+
+    The driver's ``BENCH_r{N}.json`` records whatever backend answered at
+    driver time — two rounds running, that was a dead tunnel and a CPU
+    fallback even though the chip was reached (and measured) in-session
+    both times.  This file is the tunnel-proof record: every TPU-backed
+    ``bench.py`` run rewrites it with the JSON line verbatim plus an ISO
+    timestamp, the git revision, and the probe history, so a dead-tunnel
+    driver round still leaves a dated, machine-readable hardware figure
+    in the tree (VERDICT r02 item 2).
+    """
+    import datetime
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(
+            ["git", "-C", here, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 — the record matters more than the rev
+        rev = "unknown"
+    rec = {
+        "recorded_at_utc": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "git_rev": rev,
+        "bench_line": line,
+        "probe_history": probe_history,
+        "note": (
+            "Latest live-tunnel bench.py line, refreshed automatically by "
+            "every TPU-backed run; see RESULTS.md for the measurement "
+            "methodology (batch-fetch timing)."
+        ),
+    }
+    path = os.path.join(here, "BENCH_TPU.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
 def main() -> None:
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
@@ -326,6 +375,7 @@ def main() -> None:
             )
             os._exit(1)
         os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+        os.environ["PIVOT_BENCH_AUTOFALLBACK"] = "1"
         os.environ["PIVOT_BENCH_PROBES"] = json.dumps(probe_history)
         os.environ["PIVOT_BENCH_TPU_ATTEMPTED"] = "1" if tpu_attempted else "0"
         os.execv(sys.executable, [sys.executable] + sys.argv)
@@ -347,6 +397,9 @@ def main() -> None:
                 signal.alarm(240)
         else:
             os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+            # Our fallback, not a user request: the end-of-run re-probe
+            # may still promote this run back to the TPU (see main tail).
+            os.environ["PIVOT_BENCH_AUTOFALLBACK"] = "1"
             backend_override = "cpu"
 
     import jax
@@ -373,27 +426,57 @@ def main() -> None:
     if hasattr(signal, "SIGALRM"):
         signal.alarm(0)
 
-    print(
-        json.dumps(
+    line = {
+        "metric": (
+            "cost-aware placement decisions/sec "
+            f"(T={T} tasks x H={H} hosts, {R}-replica vmapped ensemble)"
+        ),
+        "value": round(device_dps, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(device_dps / naive_dps, 2),
+        "baseline_decisions_per_sec": round(naive_dps, 1),
+        "backend": backend,
+        "kernel": winner,
+        "per_kernel": {k: round(v, 1) for k, v in results.items()},
+        **({"kernel_errors": kernel_errors} if kernel_errors else {}),
+        "ensemble_replica_rollouts_per_sec": round(ens_rps, 2),
+        "tpu_attempted": tpu_attempted,
+        "probe_history": probe_history,
+    }
+    print(json.dumps(line), flush=True)
+    if backend == "tpu":
+        _write_tpu_record(line, probe_history)
+    elif (
+        os.environ.get("PIVOT_BENCH_AUTOFALLBACK") == "1"
+        and not os.environ.get("PIVOT_BENCH_POSTPROBE")
+    ):
+        # End-of-run re-probe (VERDICT r02 item 2): tunnels recover on
+        # operator timescales, so a run that STARTED against a dead
+        # tunnel can end against a live one — several minutes have
+        # passed.  If it answers now, re-exec to measure on the chip;
+        # the TPU line prints after (and therefore supersedes) the CPU
+        # line above, and refreshes BENCH_TPU.json.  One shot only
+        # (PIVOT_BENCH_POSTPROBE) so a tunnel that dies again mid-rerun
+        # cannot loop the process.
+        from pivot_tpu.utils import probe_backend_alive
+
+        t0 = time.time()
+        alive = probe_backend_alive(120)
+        probe_history.append(
             {
-                "metric": (
-                    "cost-aware placement decisions/sec "
-                    f"(T={T} tasks x H={H} hosts, {R}-replica vmapped ensemble)"
-                ),
-                "value": round(device_dps, 1),
-                "unit": "decisions/sec",
-                "vs_baseline": round(device_dps / naive_dps, 2),
-                "baseline_decisions_per_sec": round(naive_dps, 1),
-                "backend": backend,
-                "kernel": winner,
-                "per_kernel": {k: round(v, 1) for k, v in results.items()},
-                **({"kernel_errors": kernel_errors} if kernel_errors else {}),
-                "ensemble_replica_rollouts_per_sec": round(ens_rps, 2),
-                "tpu_attempted": tpu_attempted,
-                "probe_history": probe_history,
+                "timeout_s": 120,
+                "wall_s": round(time.time() - t0, 1),
+                "alive": alive,
+                "phase": "post-run",
             }
         )
-    )
+        if alive:
+            os.environ.pop("PIVOT_BENCH_BACKEND", None)
+            os.environ.pop("PIVOT_BENCH_AUTOFALLBACK", None)
+            os.environ["PIVOT_BENCH_POSTPROBE"] = "1"
+            os.environ["PIVOT_BENCH_PROBES"] = json.dumps(probe_history)
+            os.environ["PIVOT_BENCH_TPU_ATTEMPTED"] = "1"
+            os.execv(sys.executable, [sys.executable] + sys.argv)
 
 
 if __name__ == "__main__":

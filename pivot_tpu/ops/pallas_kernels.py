@@ -266,15 +266,18 @@ def cost_aware_pallas_batched(
     T = demands.shape[0]
     if T == 0 or R == 0:
         return jnp.zeros((R, T), jnp.int32), avail_r
+    Hp = _round_up(max(H, 128), 128)
+    chunk = min(256, _round_up(T, 8))
+    # Per-replica VMEM bytes of the block's working set: two [4·RB, Hp]
+    # avail blocks + two [RB, Hp] scratches (40·Hp) and the [RB, chunk]
+    # placement block (8·chunk, both copies); budget ~12 MB of the 16 MB
+    # scoped-VMEM limit.
+    rb_bytes = 40 * Hp + 8 * chunk
     if block_replicas is None:
-        # VMEM budget first: the block's working set is dominated by the
-        # two [4·RB, Hp] avail blocks plus two [RB, Hp] scratches
-        # (~40·RB·Hp bytes) and the [RB, chunk] placement block; cap RB
-        # so it stays ~12 MB of the 16 MB scoped-VMEM limit at ANY host
-        # count (the fixed 512 cap is only proven at Hp ≤ 512).
-        Hp_est = _round_up(max(H, 128), 128)
-        chunk_est = min(256, _round_up(T, 8))
-        vmem_cap = int(12e6 // (40 * Hp_est + 8 * chunk_est))
+        # VMEM budget first: cap RB so the working set stays within
+        # budget at ANY host count (the fixed 512 cap is only proven at
+        # Hp ≤ 512).
+        vmem_cap = int(12e6 // rb_bytes)
         rb_max = max(8, min(_MAX_BLOCK_REPLICAS, vmem_cap // 8 * 8))
         # Then fewest blocks, sized to split R evenly: picking the max
         # block outright would round R up to a multiple of it (e.g.
@@ -282,9 +285,31 @@ def cost_aware_pallas_batched(
         # replica padding under one sublane tile per block.
         n_blocks = -(-R // rb_max)
         block_replicas = _round_up(-(-R // n_blocks), 8)
+    elif block_replicas < 1:
+        raise ValueError(f"block_replicas must be >= 1, got {block_replicas}")
+    elif not interpret:
+        # An explicit block size on the REAL Mosaic path must satisfy the
+        # same constraints the auto default guarantees, or it fails
+        # compilation with an opaque Mosaic error far from the cause.
+        # RB ≤ 8 is left as-is (sublane-padded; RB=1 is the
+        # hardware-proven cost_aware_pallas wrapper case) — larger
+        # non-multiples of 8 are rounded up to a sublane multiple, which
+        # cannot change results (placements are bit-identical across
+        # block sizes by construction; padding replicas are sliced off).
+        if block_replicas > 8:
+            block_replicas = _round_up(block_replicas, 8)
+        # One sublane tile (RB ≤ 8) is exempt, exactly like the auto
+        # path's max(8, ...) floor: there is no smaller block to fall
+        # back to, so the budget is best-effort at extreme host counts.
+        if block_replicas > 8 and block_replicas * rb_bytes > 12e6:
+            raise ValueError(
+                f"block_replicas={block_replicas} needs "
+                f"~{block_replicas * rb_bytes / 1e6:.1f} MB of scoped VMEM at "
+                f"Hp={Hp} (budget 12 MB of the 16 MB limit) and would fail "
+                "Mosaic compilation; pass block_replicas=None for the "
+                "largest known-good block"
+            )
     RB = block_replicas
-    Hp = _round_up(max(H, 128), 128)
-    chunk = min(256, _round_up(T, 8))
     Tp = _round_up(T, chunk)
     Rp = _round_up(R, RB)
     Rb = Rp // RB

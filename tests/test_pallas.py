@@ -225,3 +225,26 @@ def test_pallas_no_fit_and_invalid():
     )
     assert p.tolist() == [-1, -1, -1, -1]
     np.testing.assert_array_equal(np.asarray(out), np.asarray(avail))
+
+
+def test_batched_explicit_block_replicas_validation():
+    """Explicit block sizes are validated up front (advisor r02): RB < 1
+    raises everywhere; an RB whose VMEM working set would fail Mosaic
+    compilation raises a clear ValueError on the non-interpret path
+    instead of an opaque compiler error; interpret mode keeps odd blocks
+    (the CI parity tests sweep non-multiples of 8)."""
+    args = make_inputs(0, 9, 8)
+    avail_r = jnp.asarray(np.asarray(args[0])[None].repeat(4, 0))
+    for interp in (True, False):
+        with pytest.raises(ValueError, match="block_replicas"):
+            cost_aware_pallas_batched(
+                avail_r, *args[1:], block_replicas=0, interpret=interp
+            )
+    with pytest.raises(ValueError, match="scoped VMEM"):
+        cost_aware_pallas_batched(
+            avail_r, *args[1:], block_replicas=4096, interpret=False
+        )
+    p, _ = cost_aware_pallas_batched(
+        avail_r, *args[1:], block_replicas=3, interpret=True
+    )
+    assert p.shape == (4, 9)

@@ -1,0 +1,51 @@
+"""Interpret-mode smoke tests for the hardware validation harness.
+
+``tools/tpu_validate.py`` is the measurement program for the scarce
+live-tunnel windows (VERDICT r02 item 8): a refactor that silently broke
+it would only surface once a window was already open — and waste it.
+These tests drive its two kernel-exercising sections end to end through
+the Mosaic interpreter at tiny shapes, so CI catches harness bit-rot
+off-hardware.  (``floor_and_slope`` is pure timing of already-CI-covered
+kernels and needs no smoke path.)
+"""
+
+import importlib.util
+import os
+import sys
+
+
+def _load_tpu_validate():
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "tpu_validate.py"
+    )
+    spec = importlib.util.spec_from_file_location("tpu_validate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parity_sweep_interpret_smoke():
+    tv = _load_tpu_validate()
+    doc = tv.parity_sweep(interpret=True, shapes=[(0, 9, 8), (3, 17, 5)])
+    # 2 shapes × every policy mode, each checking all four parity axes.
+    assert doc["cases"] >= 2
+    assert doc["all_match"], doc["failures"]
+    assert doc["failures"] == []
+
+
+def test_crossover_interpret_smoke():
+    tv = _load_tpu_validate()
+    doc = tv.crossover(
+        quick=True, interpret=True, shapes=[(12, 8)], Rs=(1, 3), repeats=1
+    )
+    grid = doc["grid"]
+    assert len(grid) == 2  # one row per R
+    for rec in grid:
+        errors = {k: v for k, v in rec.items() if k.endswith("_error")}
+        assert not errors, errors
+        # Every kernel variant produced a timing and a throughput figure,
+        # and the winner field resolved.
+        for name in ("scan", "pallas", "pallas_rb"):
+            assert f"{name}_s" in rec
+            assert rec[f"{name}_decisions_per_s"] > 0
+        assert rec["winner"] in ("scan", "pallas", "pallas_rb")

@@ -44,7 +44,7 @@ def _time_best(fn, repeats=5):
     return best
 
 
-def parity_sweep() -> dict:
+def parity_sweep(interpret: bool = False, shapes=None) -> dict:
     """Hardware (interpret=False) Pallas vs scan kernel placements.
 
     Mirrors tests/test_pallas.py::test_pallas_matches_scan but on the
@@ -53,6 +53,10 @@ def parity_sweep() -> dict:
     index on identical scores — any residual mismatch would mean the two
     lowerings round the score arithmetic differently, which we record
     rather than hide).
+
+    ``interpret=True`` runs the same sweep through the Mosaic
+    interpreter — the CI smoke path (tests/test_tpu_validate.py) that
+    keeps this harness from bit-rotting between live-tunnel windows.
     """
     import jax
     import jax.numpy as jnp
@@ -65,12 +69,14 @@ def parity_sweep() -> dict:
         cost_aware_pallas_batched,
     )
 
+    if shapes is None:
+        shapes = [(0, 37, 13), (1, 300, 50), (2, 5, 200), (7, 700, 40)]
     out = []
-    for seed, T, H in [(0, 37, 13), (1, 300, 50), (2, 5, 200), (7, 700, 40)]:
+    for seed, T, H in shapes:
         for mode in MODES:
             args = make_inputs(seed, T, H)
             p_ref, a_ref = cost_aware_kernel(*args, **mode)
-            p_pal, a_pal = cost_aware_pallas(*args, **mode, interpret=False)
+            p_pal, a_pal = cost_aware_pallas(*args, **mode, interpret=interpret)
             match = p_ref.tolist() == p_pal.tolist()
             avail_close = bool(
                 np.allclose(
@@ -85,13 +91,22 @@ def parity_sweep() -> dict:
                 np.asarray(args[0])[None] * rng.uniform(0.8, 1.2, (R, H, 1)),
                 jnp.float32,
             )
-            p_bat = cost_aware_pallas_batched(
-                avail_r, *args[1:], **mode, interpret=False
-            )[0]
-            p_scan_r = jax.vmap(
-                lambda a: cost_aware_kernel(a, *args[1:], **mode)[0]
+            p_bat, a_bat = cost_aware_pallas_batched(
+                avail_r, *args[1:], **mode, interpret=interpret
+            )
+            p_scan_r, a_scan_r = jax.vmap(
+                lambda a: cost_aware_kernel(a, *args[1:], **mode)
             )(avail_r)
             batched_match = bool(jnp.all(p_bat == p_scan_r))
+            # The [Rb, 4·RB, Hp] availability de-interleave/transpose is
+            # its own failure surface — hold it to the same tolerance as
+            # the single-replica avail_close above.
+            batched_avail_close = bool(
+                np.allclose(
+                    np.asarray(a_scan_r), np.asarray(a_bat),
+                    rtol=1e-6, atol=1e-4,
+                )
+            )
             batched_mism = []
             if not batched_match:
                 bad = np.argwhere(np.asarray(p_bat != p_scan_r))
@@ -107,6 +122,7 @@ def parity_sweep() -> dict:
                 "placements_match": match,
                 "avail_close": avail_close,
                 "batched_match": batched_match,
+                "batched_avail_close": batched_avail_close,
                 **(
                     {"batched_first_mismatches_rthw": batched_mism}
                     if batched_mism
@@ -123,7 +139,12 @@ def parity_sweep() -> dict:
                 rec["first_mismatches"] = mism[:5]
             out.append(rec)
     def _ok(r):
-        return r["placements_match"] and r["avail_close"] and r["batched_match"]
+        return (
+            r["placements_match"]
+            and r["avail_close"]
+            and r["batched_match"]
+            and r["batched_avail_close"]
+        )
 
     return {
         "cases": len(out),
@@ -180,9 +201,22 @@ def floor_and_slope() -> dict:
     }
 
 
-def crossover(quick: bool) -> dict:
+def crossover(
+    quick: bool,
+    interpret: bool = False,
+    shapes=None,
+    Rs=(1, 8, 64, 256, 1024),
+    repeats: int = 3,
+) -> dict:
     """Pallas vs scan throughput across replica counts — where does the
-    VMEM-resident Pallas pass beat the vmapped lax.scan kernel?"""
+    VMEM-resident Pallas pass beat the vmapped lax.scan kernel?
+
+    ``interpret=True`` + tiny ``shapes``/``Rs`` is the CI smoke path
+    (timings are then meaningless; the point is that the harness still
+    drives every kernel variant end to end).
+    """
+    import functools
+
     import jax
     import jax.numpy as jnp
 
@@ -196,8 +230,8 @@ def crossover(quick: bool) -> dict:
 
     mode = dict(bin_pack="first-fit", sort_hosts=True, host_decay=False)
     grid = []
-    Rs = (1, 8, 64, 256, 1024)
-    shapes = [(512, 128), (2048, 512)] if not quick else [(512, 128)]
+    if shapes is None:
+        shapes = [(512, 128), (2048, 512)] if not quick else [(512, 128)]
     for T, H in shapes:
         base = make_inputs(3, T, H)
         for R in Rs:
@@ -220,14 +254,21 @@ def crossover(quick: bool) -> dict:
                 # 16M scoped limit at RB=512, Hp=512 — reproduced; the
                 # both-outputs form compiles and runs).
                 f = jax.jit(
-                    lambda a: cost_aware_pallas_batched(a, *rest, **mode)
+                    lambda a: cost_aware_pallas_batched(
+                        a, *rest, **mode, interpret=interpret
+                    )
                 )
                 return lambda: jnp.sum(f(avail_r)[0])
 
             rec = {"T": T, "H": H, "R": R}
             variants = (
                 ("scan", make(cost_aware_kernel)),
-                ("pallas", make(cost_aware_pallas)),
+                (
+                    "pallas",
+                    make(
+                        functools.partial(cost_aware_pallas, interpret=interpret)
+                    ),
+                ),
                 ("pallas_rb", make_batched()),
             )
             for name, run in variants:
@@ -237,7 +278,7 @@ def crossover(quick: bool) -> dict:
                 # processes); only a repeated failure is a real finding.
                 for attempt in (0, 1):
                     try:
-                        best = _time_best(run, repeats=3)
+                        best = _time_best(run, repeats=repeats)
                         rec[f"{name}_s"] = round(best, 6)
                         rec[f"{name}_decisions_per_s"] = round(R * T / best, 1)
                         rec.pop(f"{name}_error", None)
