@@ -340,10 +340,16 @@ def _write_tpu_record(line: dict, probe_history: list) -> None:
     }
     path = os.path.join(here, "BENCH_TPU.json")
     tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(rec, f, indent=2)
-        f.write("\n")
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        # A read-only checkout must not turn a successful (and scarce)
+        # hardware measurement into a nonzero exit — the authoritative
+        # JSON line has already printed.
+        pass
 
 
 def main() -> None:
@@ -395,6 +401,14 @@ def main() -> None:
                 # Armed only now, so the parent's own init gets the full
                 # budget — the probes must not eat into it.
                 signal.alarm(240)
+        elif os.environ.get("PIVOT_BENCH_POSTPROBE"):
+            # This process exists only because a post-run re-probe saw
+            # the tunnel alive; it has died again before the start
+            # probes (the flappy-tunnel case).  The superseded CPU line
+            # already printed and remains the final authoritative line —
+            # re-measuring the whole CPU bench would add minutes and a
+            # redundant duplicate line.
+            sys.exit(0)
         else:
             os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
             # Our fallback, not a user request: the end-of-run re-probe

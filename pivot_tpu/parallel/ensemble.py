@@ -75,9 +75,12 @@ __all__ = [
 ]
 
 
-# check_group_demands verdict cache: id(demands) → weakref(demands).
-# The weakref guards against id reuse after garbage collection — an
-# entry only counts if it still points at the SAME live array.
+# check_group_demands verdict cache: (id(demands), id(group_of)) →
+# (weakref(demands), weakref(group_of)).  The invariant being cached is a
+# property of the PAIR — a ``_replace(group_of=...)`` reusing an
+# already-checked demands array must re-validate — and the weakrefs guard
+# against id reuse after garbage collection: an entry only counts if both
+# refs still point at the SAME live arrays.
 _checked_demands: dict = {}
 
 
@@ -134,9 +137,13 @@ class EnsembleWorkload(NamedTuple):
         """
         if isinstance(self.demands, jax.core.Tracer):
             return  # inside jit: the constructor invariant is the contract
-        key = id(self.demands)
-        ref = _checked_demands.get(key)
-        if ref is not None and ref() is self.demands:
+        key = (id(self.demands), id(self.group_of))
+        refs = _checked_demands.get(key)
+        if (
+            refs is not None
+            and refs[0]() is self.demands
+            and refs[1]() is self.group_of
+        ):
             return
         dem = np.asarray(self.demands)
         go = np.asarray(self.group_of)
@@ -151,9 +158,17 @@ class EnsembleWorkload(NamedTuple):
                 "build workloads via EnsembleWorkload.from_applications"
             )
         if len(_checked_demands) > 256:  # prune dead refs, bound growth
-            for k in [k for k, r in _checked_demands.items() if r() is None]:
+            dead = [
+                k
+                for k, (rd, rg) in _checked_demands.items()
+                if rd() is None or rg() is None
+            ]
+            for k in dead:
                 del _checked_demands[k]
-        _checked_demands[key] = weakref.ref(self.demands)
+        _checked_demands[key] = (
+            weakref.ref(self.demands),
+            weakref.ref(self.group_of),
+        )
 
     @classmethod
     def from_applications(cls, apps, arrivals=None, dtype=jnp.float32):
@@ -242,6 +257,17 @@ class RolloutState(NamedTuple):
 _PENDING, _RUNNING, _DONE = 0, 1, 2
 
 
+def _resolve_forms(forms: Optional[str]) -> str:
+    """Backend default for the tick-body op forms (see
+    :func:`_rollout_segment`): index/segment ops on the CPU backend,
+    one-hot vector forms on accelerators.  Resolved at trace time by the
+    public entries; pass ``forms`` explicitly to pin a form (the parity
+    suite runs both on one backend)."""
+    if forms is not None:
+        return forms
+    return "indexed" if jax.default_backend() == "cpu" else "vector"
+
+
 def _init_state(avail0, T, Z) -> RolloutState:
     dtype = avail0.dtype
     H = avail0.shape[0]
@@ -273,9 +299,33 @@ def _rollout_segment(
     congestion: bool = False,
     realtime_scoring: bool = False,
     active=None,  # optional [T] bool: early-exit ignores inactive tasks
+    forms: str = "vector",  # | "indexed" — tick-body op forms, see below
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
     (stops early once every task is done).
+
+    ``forms`` selects between two implementations of the tick-body's
+    reduction/selection ops — same math, backend-matched lowering
+    (VERDICT r02 item 3):
+
+      * ``"vector"`` (the TPU form): one-hot select-reduces, membership-
+        mask masked reductions, and HIGHEST-precision one-hot matmuls.
+        Under vmap these stay on the VPU/MXU; the index-based forms they
+        replace lower to batched scatter/gathers whose per-replica index
+        vectors land in TPU scalar memory and serialize on the scalar
+        core (~1 ms/tick each — the round-2 "scalar-core lesson",
+        docs/ARCHITECTURE.md).
+      * ``"indexed"`` (the CPU form): plain ``segment_sum``/``segment_max``
+        /``segment_min`` and gather/scatter indexing.  On CPU these are
+        O(T) loops, where the vector forms are O(T·H)/O(T·G) dense
+        sweeps — measured 5× end-to-end on the bench rollout metric
+        (round-2's TPU-first rewrite regressed the CPU fallback 47 → 9
+        rollouts/s; this restores the indexed forms there).
+
+    Public entries resolve ``forms=None`` to the backend default
+    (``indexed`` on cpu, ``vector`` elsewhere).  The two forms are held
+    bit-identical on every rollout output by
+    ``tests/test_ensemble.py::test_tick_body_forms_bit_identical``.
 
     With ``faults``, each tick applies the crash/recovery schedule at tick
     resolution, mirroring the DES fault semantics (``infra.faults`` +
@@ -307,6 +357,9 @@ def _rollout_segment(
     if realtime_scoring and score_params is not None:
         raise ValueError("realtime_scoring and parameterized score "
                          "exponents are mutually exclusive")
+    if forms not in ("vector", "indexed"):
+        raise ValueError(f"forms must be 'vector' or 'indexed', got {forms!r}")
+    vector = forms == "vector"
     T = workload.n_tasks
     H = state.avail.shape[0]
     Z = topo.cost.shape[0]
@@ -316,18 +369,29 @@ def _rollout_segment(
         fault_host, fail_at, recover_at = faults
         fault_idx = jnp.where(fault_host >= 0, fault_host, H)  # pad → drop
 
-        def _scatter_hosts(hit):  # [F] bool fault mask -> [H] bool host mask
-            # One-hot any-reduce, not ``.at[fault_idx].max``: under vmap
-            # the scatter's per-replica index vector lands in scalar
-            # memory and serializes on the scalar core (three calls per
-            # tick in fault ensembles — see ARCHITECTURE.md, "the
-            # scalar-core lesson").  Padded entries (idx == H) hit no
-            # host, exactly like the old scatter-then-slice.
-            return jnp.any(
-                (fault_idx[:, None] == jnp.arange(H)[None, :])
-                & hit[:, None],
-                axis=0,
-            )
+        if vector:
+
+            def _scatter_hosts(hit):  # [F] bool mask -> [H] bool host mask
+                # One-hot any-reduce, not ``.at[fault_idx].max``: under
+                # vmap the scatter's per-replica index vector lands in
+                # scalar memory and serializes on the scalar core (three
+                # calls per tick in fault ensembles — see
+                # ARCHITECTURE.md, "the scalar-core lesson").  Padded
+                # entries (idx == H) hit no host, exactly like the old
+                # scatter-then-slice.
+                return jnp.any(
+                    (fault_idx[:, None] == jnp.arange(H)[None, :])
+                    & hit[:, None],
+                    axis=0,
+                )
+
+        else:
+
+            def _scatter_hosts(hit):  # [F] bool mask -> [H] bool host mask
+                # Boolean scatter (exact): misses and padded entries
+                # write the sacrificial H row, sliced off.
+                idx = jnp.where(hit, fault_idx, H)
+                return jnp.zeros((H + 1,), bool).at[idx].set(True)[:H]
     # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
     cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
     bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
@@ -413,22 +477,36 @@ def _rollout_segment(
         #    (both deterministic; the DES is the semantic referee and
         #    sums per-event anyway).
         newly_done = (stage == _RUNNING) & (finish <= t)
-        # ONE [T, H] placement one-hot shared by the refund sum and the
-        # done-count einsum (their masks differ only in the stage
-        # predicate ANDed on; fault aborts between them only touch
-        # RUNNING rows, which the done predicate excludes).  The busy
-        # max below rebuilds it because placements land in ``place``
-        # first.  Unplaced rows carry the -1 sentinel and match no host
-        # column.
-        place_oh = place[:, None] == jnp.arange(H)[None, :]
-        refund_per_host = jnp.sum(
-            jnp.where(
-                (place_oh & newly_done[:, None])[:, :, None],
-                workload.demands[:, None, :],
-                jnp.zeros((), dtype),
-            ),
-            axis=0,
-        )  # [H, 4]
+        if vector:
+            # ONE [T, H] placement one-hot shared by the refund sum and
+            # the done-count einsum (their masks differ only in the stage
+            # predicate ANDed on; fault aborts between them only touch
+            # RUNNING rows, which the done predicate excludes).  The busy
+            # max below rebuilds it because placements land in ``place``
+            # first.  Unplaced rows carry the -1 sentinel and match no
+            # host column.
+            place_oh = place[:, None] == jnp.arange(H)[None, :]
+            refund_per_host = jnp.sum(
+                jnp.where(
+                    (place_oh & newly_done[:, None])[:, :, None],
+                    workload.demands[:, None, :],
+                    jnp.zeros((), dtype),
+                ),
+                axis=0,
+            )  # [H, 4]
+        else:
+            # Scatter-add over the retiring tasks' placements (misses →
+            # the sacrificial H row).  Same sum, different accumulation
+            # order than the tree reduce above — held bit-identical on
+            # every rollout output by the forms parity suite.
+            refund_per_host = jax.ops.segment_sum(
+                jnp.where(
+                    newly_done[:, None], workload.demands,
+                    jnp.zeros((), dtype),
+                ),
+                jnp.where(newly_done, place, H),
+                num_segments=H + 1,
+            )[:H]  # [H, 4]
         avail = avail + refund_per_host
         stage = jnp.where(newly_done, _DONE, stage)
 
@@ -484,9 +562,12 @@ def _rollout_segment(
         tau_g = jnp.max(
             jnp.where(workload.pred_group > 0, gf[None, :], -inf), axis=1
         )  # [G] readiness event time (−inf for root groups)
-        tau = jnp.sum(
-            jnp.where(g_oh, tau_g[None, :], jnp.zeros((), dtype)), axis=1
-        )  # [T] — select-reduce, not the former [R, T] gather
+        if vector:
+            tau = jnp.sum(
+                jnp.where(g_oh, tau_g[None, :], jnp.zeros((), dtype)), axis=1
+            )  # [T] — select-reduce, not the [R, T] gather (scalar core)
+        else:
+            tau = tau_g[workload.group_of]  # [T] gather (exact selection)
         pump = arrival + (jnp.floor((tau - arrival) / tick) + 1.0) * tick
         ready_time = jnp.where(has_pred, pump, arrival)
         ready = (
@@ -502,24 +583,37 @@ def _rollout_segment(
         #    transfer estimate, so it is computed for every policy; the
         #    vote itself only matters to cost-aware.)
         done_mask = stage == _DONE
-        # Done-instance counts per (group, host) as ONE bf16 one-hot
-        # contraction over tasks: hv[g, h] = Σ_t 1[group_of[t]=g] ·
-        # 1[place[t]=h, done].  The former segment-sum over a flattened
-        # (group × host) id lowered to a scatter-add with a per-replica
-        # [R, T] scalar-memory index vector — profiled at ~1 ms/tick
-        # serialized on the scalar core, 22% of the whole rollout.  The
-        # matmul form is integer-EXACT: one-hot factors are 0/1 (exact
-        # in bf16), counts ≤ max instances < 256, and the MXU
-        # accumulates in f32 — same argument as ``hv @ zone_onehot``
-        # below.  (The former [R, T] ``host_zone[place]`` gather was
-        # removed by the round-2 rewrite for the same reason.)
-        place_done_oh = place_oh & done_mask[:, None]  # [T, H]
-        hv = jnp.einsum(
-            "tg,th->gh",
-            g_oh.astype(jnp.bfloat16),
-            place_done_oh.astype(jnp.bfloat16),
-            preferred_element_type=dtype,
-        )  # [G, H] done counts per host
+        if vector:
+            # Done-instance counts per (group, host) as ONE bf16 one-hot
+            # contraction over tasks: hv[g, h] = Σ_t 1[group_of[t]=g] ·
+            # 1[place[t]=h, done].  The segment-sum form below lowers
+            # (under vmap) to a scatter-add with a per-replica [R, T]
+            # scalar-memory index vector — profiled at ~1 ms/tick
+            # serialized on the scalar core, 22% of the whole rollout.
+            # The matmul form is integer-EXACT: one-hot factors are 0/1
+            # (exact in bf16), counts ≤ max instances < 256, and the MXU
+            # accumulates in f32 — same argument as ``hv @ zone_onehot``
+            # below.  (The former [R, T] ``host_zone[place]`` gather was
+            # removed by the round-2 rewrite for the same reason.)
+            place_done_oh = place_oh & done_mask[:, None]  # [T, H]
+            hv = jnp.einsum(
+                "tg,th->gh",
+                g_oh.astype(jnp.bfloat16),
+                place_done_oh.astype(jnp.bfloat16),
+                preferred_element_type=dtype,
+            )  # [G, H] done counts per host
+        else:
+            # Flattened (group × host) scatter-add of ones — integer
+            # counts, exact in any accumulation order.
+            flat = workload.group_of * (H + 1) + jnp.where(
+                done_mask, place, H
+            )
+            hv = jax.ops.segment_sum(
+                jnp.where(done_mask, jnp.ones((T,), dtype),
+                          jnp.zeros((), dtype)),
+                flat,
+                num_segments=G * (H + 1),
+            ).reshape(G, H + 1)[:, :H]  # [G, H] done counts per host
         zc = hv @ zone_onehot  # [G, Z]
         if policy == "cost-aware":
             # The DES/reference vote is per HOST, not per zone (Counter
@@ -536,17 +630,23 @@ def _rollout_segment(
             # timestamps).
             votes_h = workload.pred_group @ hv  # [G, H] pred-instance votes
             majority_host = jnp.argmax(votes_h, axis=1)  # [G]
-            # Zone of each group's majority host, then group → task
-            # expansion — both as integer select-reduces on the VPU (the
-            # former ``host_zone[majority_host][group_of]`` double gather
-            # ran on the scalar core; sums of one non-zero int are exact).
-            mh_oh = jnp.arange(H)[None, :] == majority_host[:, None]
-            mz_g = jnp.sum(
-                jnp.where(mh_oh, topo.host_zone[None, :], 0), axis=1
-            )  # [G]
-            majority_zone = jnp.sum(
-                jnp.where(g_oh, mz_g[None, :], 0), axis=1
-            )  # [T]
+            if vector:
+                # Zone of each group's majority host, then group → task
+                # expansion — both as integer select-reduces on the VPU
+                # (the ``host_zone[majority_host][group_of]`` double
+                # gather runs on the scalar core under vmap; sums of one
+                # non-zero int are exact).
+                mh_oh = jnp.arange(H)[None, :] == majority_host[:, None]
+                mz_g = jnp.sum(
+                    jnp.where(mh_oh, topo.host_zone[None, :], 0), axis=1
+                )  # [G]
+                majority_zone = jnp.sum(
+                    jnp.where(g_oh, mz_g[None, :], 0), axis=1
+                )  # [T]
+            else:
+                majority_zone = topo.host_zone[majority_host][
+                    workload.group_of
+                ]  # [T] double gather (exact selection)
             anchor = jnp.where(has_pred, majority_zone, root_anchor)
         else:
             anchor = root_anchor  # unused by the other arms
@@ -607,13 +707,22 @@ def _rollout_segment(
             # 13M cells/replica at the calibrate scale (T≈3.6k).
             B = Z + G
             ready_idx = jnp.where(ready, jnp.arange(T), T).astype(jnp.int32)
-            b_oh = bucket[:, None] == jnp.arange(B)[None, :]  # [T, B]
-            fib = jnp.min(
-                jnp.where(b_oh, ready_idx[:, None], T), axis=0
-            )  # [B] first ready index per bucket
-            bfirst = jnp.sum(
-                jnp.where(b_oh, fib[None, :], 0), axis=1
-            ).astype(jnp.int32)
+            if vector:
+                b_oh = bucket[:, None] == jnp.arange(B)[None, :]  # [T, B]
+                fib = jnp.min(
+                    jnp.where(b_oh, ready_idx[:, None], T), axis=0
+                )  # [B] first ready index per bucket
+                bfirst = jnp.sum(
+                    jnp.where(b_oh, fib[None, :], 0), axis=1
+                ).astype(jnp.int32)
+            else:
+                # Integer min-scatter + gather (exact; empty buckets fill
+                # INT_MAX vs the vector form's T, but bfirst only reads a
+                # task's OWN bucket, which contains it).
+                fib = jax.ops.segment_min(
+                    ready_idx, bucket, num_segments=B
+                )  # [B]
+                bfirst = fib[bucket]  # [T]
             key3 = -dem_norms  # norm-decreasing inside a bucket
         else:
             bfirst = jnp.zeros((T,), jnp.int32)
@@ -690,15 +799,18 @@ def _rollout_segment(
 
         def place_body(c):
             j, avail, pl, delay, norm_snap, prev_bf = c
-            # One [G, 1] group mask for this step, shared by the demand
-            # re-derivation here and the CD row select below.
-            g_hit = (jnp.arange(G) == g_p[j])[:, None]
-            # Demand row from the group table (one [G, 4] select-reduce;
-            # exactly one non-zero term — bit-exact, and g_p[j] is the
-            # batched index the sort already carries).
-            demand = jnp.sum(
-                jnp.where(g_hit, dem_group, jnp.zeros((), dtype)), axis=0
-            )  # [4]
+            if vector:
+                # One [G, 1] group mask for this step, shared by the
+                # demand re-derivation here and the CD row select below.
+                g_hit = (jnp.arange(G) == g_p[j])[:, None]
+                # Demand row from the group table (one [G, 4]
+                # select-reduce; exactly one non-zero term — bit-exact,
+                # and g_p[j] is the batched index the sort carries).
+                demand = jnp.sum(
+                    jnp.where(g_hit, dem_group, jnp.zeros((), dtype)), axis=0
+                )  # [4]
+            else:
+                demand = dem_group[g_p[j]]  # [4] row gather
             if strict:
                 fit = jnp.all(avail > demand[None, :], axis=1)
             else:
@@ -716,25 +828,40 @@ def _rollout_segment(
                 new_bucket = bf_p[j] != prev_bf
                 norm_snap = jnp.where(new_bucket, live_norm, norm_snap)
                 prev_bf = bf_p[j]
-                # Anchor-zone row selection via one-hot select-reduce,
-                # NOT ``table[az_p[j]]``: under vmap the indexed form
-                # lowers to a batched gather whose [R] index vector
-                # lives in scalar memory — serialized on the scalar
-                # core, measured as a dominant rollout cost.  The
+                # Anchor-zone row selection.  Vector form: one-hot
+                # select-reduce, NOT ``table[az_p[j]]`` — under vmap the
+                # indexed form lowers to a batched gather whose [R]
+                # index vector lives in scalar memory, serialized on the
+                # scalar core, measured as a dominant rollout cost.  The
                 # select-reduce stays on the VPU and is bit-exact (the
                 # sum has exactly one non-zero term; adding zeros is
-                # IEEE-exact for finite table entries).
-                zoh = (jnp.arange(Z) == az_p[j])[:, None]  # [Z, 1]
-                zero = jnp.zeros((), dtype)
+                # IEEE-exact for finite table entries).  Indexed form:
+                # the row gather (exact selection, fast on CPU).
+                if vector:
+                    zoh = (jnp.arange(Z) == az_p[j])[:, None]  # [Z, 1]
+                    zero = jnp.zeros((), dtype)
+                    if score_params is None:
+                        cost_row = jnp.sum(
+                            jnp.where(zoh, cost_rt, zero), axis=0
+                        )
+                        bw_row = jnp.sum(
+                            jnp.where(zoh, score_bw_rt, zero), axis=0
+                        )
+                    else:
+                        cost_row = jnp.sum(
+                            jnp.where(zoh, cost_pow, zero), axis=0
+                        )
+                        bw_row = jnp.sum(jnp.where(zoh, bw_pow, zero), axis=0)
+                else:
+                    if score_params is None:
+                        cost_row = cost_rt[az_p[j]]
+                        bw_row = score_bw_rt[az_p[j]]
+                    else:
+                        cost_row = cost_pow[az_p[j]]
+                        bw_row = bw_pow[az_p[j]]
                 if score_params is None:
-                    cost_row = jnp.sum(jnp.where(zoh, cost_rt, zero), axis=0)
-                    bw_row = jnp.sum(
-                        jnp.where(zoh, score_bw_rt, zero), axis=0
-                    )
                     score = cost_row / (norm_snap * bw_row)
                 else:
-                    cost_row = jnp.sum(jnp.where(zoh, cost_pow, zero), axis=0)
-                    bw_row = jnp.sum(jnp.where(zoh, bw_pow, zero), axis=0)
                     score = cost_row / (norm_snap ** w_norm * bw_row)
                 h = jnp.argmin(jnp.where(fit, score, inf))
             elif policy == "first-fit":
@@ -759,30 +886,52 @@ def _rollout_segment(
                 rank = jnp.cumsum(fit) - 1  # rank among fitting hosts
                 h = jnp.argmax(fit & (rank == k))
             ok = jnp.any(fit)
-            # One-hot state updates, NOT ``.at[h].add`` / ``.at[...].set``:
-            # under vmap those lower to batched scatters with scalar-
-            # memory index vectors (serialized on the scalar core — with
-            # the row gathers above, ~85% of rollout wall before this
-            # rewrite).  Bit-exact: x − d·1 ≡ x + (−d), x − d·0 ≡ x.
-            host_hit = (jnp.arange(avail.shape[0]) == h)[:, None]  # [H, 1]
-            avail = avail - jnp.where(
-                host_hit & ok, demand[None, :], jnp.zeros((), avail.dtype)
-            )
-            task_hit = jnp.arange(T) == order[j]
-            pl = jnp.where(
-                task_hit, jnp.where(ok, h, -1).astype(jnp.int32), pl
-            )
-            # Transfer delay CD[group, zone(h)] for this placement via
-            # three tiny VPU selects (zone of h, CD group row, zone
-            # entry); unplaced tasks keep 0, masked by ``placed`` below.
-            z_h = jnp.sum(jnp.where(jnp.arange(H) == h, topo.host_zone, 0))
-            cd_row = jnp.sum(
-                jnp.where(g_hit, CD, jnp.zeros((), dtype)), axis=0
-            )  # [Z]
-            d_j = jnp.sum(
-                jnp.where(jnp.arange(Z) == z_h, cd_row, jnp.zeros((), dtype))
-            )
-            delay = jnp.where(task_hit & ok, d_j, delay)
+            if vector:
+                # One-hot state updates, NOT ``.at[h].add`` /
+                # ``.at[...].set``: under vmap those lower to batched
+                # scatters with scalar-memory index vectors (serialized
+                # on the scalar core — with the row gathers above, ~85%
+                # of rollout wall before the round-2 rewrite).
+                # Bit-exact: x − d·1 ≡ x + (−d), x − d·0 ≡ x.
+                host_hit = (jnp.arange(avail.shape[0]) == h)[:, None]
+                avail = avail - jnp.where(
+                    host_hit & ok, demand[None, :],
+                    jnp.zeros((), avail.dtype),
+                )
+                task_hit = jnp.arange(T) == order[j]
+                pl = jnp.where(
+                    task_hit, jnp.where(ok, h, -1).astype(jnp.int32), pl
+                )
+                # Transfer delay CD[group, zone(h)] for this placement
+                # via three tiny VPU selects (zone of h, CD group row,
+                # zone entry); unplaced tasks keep 0, masked by
+                # ``placed`` below.
+                z_h = jnp.sum(
+                    jnp.where(jnp.arange(H) == h, topo.host_zone, 0)
+                )
+                cd_row = jnp.sum(
+                    jnp.where(g_hit, CD, jnp.zeros((), dtype)), axis=0
+                )  # [Z]
+                d_j = jnp.sum(
+                    jnp.where(
+                        jnp.arange(Z) == z_h, cd_row, jnp.zeros((), dtype)
+                    )
+                )
+                delay = jnp.where(task_hit & ok, d_j, delay)
+            else:
+                # Index forms (exact: x − d ≡ x + (−d); a miss scatters
+                # to the dropped H row instead of adding 0).
+                avail = avail.at[jnp.where(ok, h, H)].add(
+                    -demand, mode="drop"
+                )
+                pl = pl.at[order[j]].set(
+                    jnp.where(ok, h, -1).astype(jnp.int32)
+                )
+                z_h = topo.host_zone[h]
+                d_j = CD[g_p[j], z_h]
+                delay = delay.at[order[j]].set(
+                    jnp.where(ok, d_j, jnp.zeros((), dtype))
+                )
             return j + 1, avail, pl, delay, norm_snap, prev_bf
 
         _, avail, placements, xfer_delay, _, _ = lax.while_loop(
@@ -820,9 +969,34 @@ def _rollout_segment(
             # this lowers to a constant-index gather, not the batched
             # scalar-memory form the placement-loop rewrite eliminated.
             vol_tz = pull_gz[workload.group_of] * placed[:, None]  # [T, Z]
-            v_new = jax.ops.segment_sum(
-                vol_tz, jnp.where(placed, placements, H), num_segments=H + 1
-            )[:H].T  # [Z, H] new queued MB per pipe
+            if vector:
+                # Round-3 congestion-arm vectorization (VERDICT r02
+                # item 1): the two per-tick scalar-core ops below — a
+                # scatter-add with a per-replica [R, T] segment-id
+                # vector and a batched gather on placements — were the
+                # arm's remaining toll (11.4 s vs 2.6–3.1 s for the
+                # static arms at the canonical scale) after both round-2
+                # purges.  Both become HIGHEST-precision one-hot matmuls
+                # on the MXU: the f32 emulation's split-product of x
+                # with an exact 0/1 operand is exact (x·1 = hi + lo = x,
+                # x·0 = 0), so the pipe sums differ from the scatter
+                # form only in accumulation order (tree vs index —
+                # empirically bit-identical on the parity workloads; the
+                # forms suite holds every rollout output to exact
+                # equality), and the ratio "gather" is a one-non-zero-
+                # term select, exact outright.
+                place_oh_f = (
+                    placements[:, None] == jnp.arange(H)[None, :]
+                ).astype(dtype)  # [T, H]; unplaced rows are all-zero
+                v_new = jnp.einsum(
+                    "tz,th->zh", vol_tz, place_oh_f,
+                    precision=lax.Precision.HIGHEST,
+                )  # [Z, H] new queued MB per pipe
+            else:
+                v_new = jax.ops.segment_sum(
+                    vol_tz, jnp.where(placed, placements, H),
+                    num_segments=H + 1,
+                )[:H].T  # [Z, H] new queued MB per pipe
             q_now = q + v_new
             # Per-task congested delay: max over source zones this task
             # pulls NONZERO volume from of backlog/bw at its destination
@@ -830,12 +1004,20 @@ def _rollout_segment(
             # skips it, ``resources/__init__.py:263-267`` — so backlog
             # from other tasks must not delay this one through it).
             pulls_from = vol_tz > 0
-            # This batched gather (per-replica placements index) is the
-            # one the placement-loop rewrite CANNOT eliminate: q_now
-            # depends on all of this tick's placements, so the per-pipe
-            # ratio cannot be selected during placement.  Congestion
-            # rollouts keep this one scalar-memory gather per tick.
-            ratio_t = (q_now * inv_bw_zh)[:, jnp.clip(placements, 0, H - 1)].T
+            if vector:
+                # q_now depends on ALL of this tick's placements, so the
+                # per-pipe ratio cannot be selected during the placement
+                # loop — but the post-loop selection needs no gather:
+                # each task's ratio row is a one-non-zero-term one-hot
+                # contraction of its placement column (exact, on-MXU).
+                ratio_t = jnp.einsum(
+                    "th,zh->tz", place_oh_f, q_now * inv_bw_zh,
+                    precision=lax.Precision.HIGHEST,
+                )  # [T, Z]
+            else:
+                ratio_t = (
+                    q_now * inv_bw_zh
+                )[:, jnp.clip(placements, 0, H - 1)].T
             cong_delay = jnp.max(
                 jnp.where(pulls_from, ratio_t, 0.0), axis=1
             )  # [T]
@@ -868,14 +1050,27 @@ def _rollout_segment(
         contrib = jnp.where(
             stage == _RUNNING, jnp.clip(finish - t, 0.0, tick), 0.0
         )
-        run_at = (
-            (place[:, None] == jnp.arange(H)[None, :])
-            & (stage == _RUNNING)[:, None]
-        )  # [T, H]
-        busy_host = jnp.max(
-            jnp.where(run_at, contrib[:, None], jnp.zeros((), dtype)),
-            axis=0,
-        )  # [H]
+        if vector:
+            run_at = (
+                (place[:, None] == jnp.arange(H)[None, :])
+                & (stage == _RUNNING)[:, None]
+            )  # [T, H]
+            busy_host = jnp.max(
+                jnp.where(run_at, contrib[:, None], jnp.zeros((), dtype)),
+                axis=0,
+            )  # [H]
+        else:
+            # Max-scatter (order-independent, exact); empty hosts fill
+            # −inf, clamped back to the vector form's 0 identity
+            # (contrib ≥ 0, so the clamp cannot alter a busy host).
+            busy_host = jnp.maximum(
+                jax.ops.segment_max(
+                    contrib,
+                    jnp.where(stage == _RUNNING, place, H),
+                    num_segments=H + 1,
+                )[:H],
+                0.0,
+            )  # [H]
         busy = busy + jnp.sum(busy_host)
 
         return (
@@ -967,6 +1162,7 @@ def _single_rollout(
     congestion: bool = False,
     realtime_scoring: bool = False,
     active=None,  # optional [T] bool — tasks outside the mask never run
+    forms: Optional[str] = None,
 ) -> RolloutResult:
     state = _init_state(avail0, workload.n_tasks, topo.cost.shape[0])
     state = _rollout_segment(
@@ -974,6 +1170,7 @@ def _single_rollout(
         faults=faults, totals=avail0, score_params=score_params,
         policy=policy, task_u=task_u, congestion=congestion,
         realtime_scoring=realtime_scoring, active=active,
+        forms=_resolve_forms(forms),
     )
     return _finalize(state, workload, topo, active=active)
 
@@ -1141,7 +1338,7 @@ def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
     static_argnames=(
         "n_replicas", "tick", "max_ticks", "perturb",
         "n_faults", "fault_horizon", "mttr", "policy", "congestion",
-        "realtime_scoring",
+        "realtime_scoring", "forms",
     ),
 )
 def _rollout_states(
@@ -1160,6 +1357,7 @@ def _rollout_states(
     policy: str,
     congestion: bool,
     realtime_scoring: bool,
+    forms: str = "vector",
 ) -> RolloutState:
     """The jitted rollout body: [R]-stacked final states (no finalize)."""
     rt, arr, root_anchor = _perturbations(
@@ -1186,6 +1384,7 @@ def _rollout_states(
             state, r, a, ra, workload, topo, tick, max_ticks,
             faults=f, totals=avail0, policy=policy, task_u=u,
             congestion=congestion, realtime_scoring=realtime_scoring,
+            forms=forms,
         )
 
     return jax.vmap(one)(rt, arr, root_anchor, *extras)
@@ -1227,6 +1426,7 @@ def rollout(
     policy: str = "cost-aware",
     congestion: bool = False,
     realtime_scoring: bool = False,
+    forms: Optional[str] = None,
 ) -> RolloutResult:
     """Vmapped Monte-Carlo rollout: [R]-leading-axis results.
 
@@ -1247,7 +1447,7 @@ def rollout(
         n_replicas=n_replicas, tick=tick, max_ticks=max_ticks,
         perturb=perturb, n_faults=n_faults, fault_horizon=fault_horizon,
         mttr=mttr, policy=policy, congestion=congestion,
-        realtime_scoring=realtime_scoring,
+        realtime_scoring=realtime_scoring, forms=_resolve_forms(forms),
     )
     return _finalize_batch(states, workload, topo)
 
@@ -1413,7 +1613,7 @@ def shard_sweep(sweep_fn, fallback_segment_ticks=None, force_mesh=False,
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "tick", "policy", "congestion", "realtime_scoring", "spec",
+        "tick", "policy", "congestion", "realtime_scoring", "spec", "forms",
     ),
 )
 def _row_segment_step(
@@ -1430,6 +1630,7 @@ def _row_segment_step(
     policy: str = "cost-aware",
     congestion: bool = False,
     realtime_scoring: bool = False,
+    forms: str = "vector",
 ):
     """Advance every row by at most ``segment_ticks`` scheduler ticks."""
 
@@ -1439,7 +1640,7 @@ def _row_segment_step(
             s, r, a, ra_, workload, topo, tick, segment_ticks,
             faults=f, totals=tot, score_params=sp, policy=policy,
             task_u=u, congestion=congestion,
-            realtime_scoring=realtime_scoring, active=act,
+            realtime_scoring=realtime_scoring, active=act, forms=forms,
         )
 
     return jax.vmap(seg)(states, rt, arr, ra, *extras)
@@ -1455,6 +1656,7 @@ def _run_rows(
     totals=None,  # optional [B, H, 4] (fault recovery target)
     score_params=None,  # optional [B, 3]
     active=None,  # optional [B, T] bool
+    forms: Optional[str] = None,
 ) -> RolloutResult:
     """Run B rows to the horizon and finalize through the shared program.
 
@@ -1468,6 +1670,7 @@ def _run_rows(
     """
     Z = topo.cost.shape[0]
     spec, extras = _pack_extras(faults, task_u, totals, score_params, active)
+    forms = _resolve_forms(forms)
 
     states = jax.vmap(lambda av: _init_state(av, workload.n_tasks, Z))(
         avail_rows
@@ -1477,7 +1680,7 @@ def _run_rows(
             states, rt, arr, ra, workload, topo, tick,
             jnp.asarray(max_ticks, jnp.int32), spec, *extras,
             policy=policy, congestion=congestion,
-            realtime_scoring=realtime_scoring,
+            realtime_scoring=realtime_scoring, forms=forms,
         )
     else:
         ticks = 0
@@ -1487,7 +1690,7 @@ def _run_rows(
                 states, rt, arr, ra, workload, topo, tick,
                 jnp.asarray(seg, jnp.int32), spec, *extras,
                 policy=policy, congestion=congestion,
-                realtime_scoring=realtime_scoring,
+                realtime_scoring=realtime_scoring, forms=forms,
             )
             jax.block_until_ready(states)
             ticks += seg
@@ -1528,6 +1731,7 @@ def score_param_sweep(
     perturb: float = 0.1,
     congestion: bool = False,
     segment_ticks: Optional[int] = None,
+    forms: Optional[str] = None,
 ) -> RolloutResult:
     """On-device policy autotuning: sweep the cost-aware score exponents.
 
@@ -1555,7 +1759,7 @@ def score_param_sweep(
         _tile_rows(rt, K), _tile_rows(arr, K), _tile_rows(root_anchor, K),
         workload, topo, tick, max_ticks, segment_ticks,
         policy="cost-aware", congestion=congestion, realtime_scoring=False,
-        score_params=jnp.repeat(grid, R, axis=0),
+        score_params=jnp.repeat(grid, R, axis=0), forms=forms,
     )
     return _reshape_rows(res, K, R)
 
@@ -1597,6 +1801,7 @@ def capacity_sweep(
     fault_horizon: Optional[float] = None,
     mttr: Optional[float] = None,
     segment_ticks: Optional[int] = None,
+    forms: Optional[str] = None,
 ) -> RolloutResult:
     """On-device capacity planning: how does the workload behave on K
     candidate cluster sizes?  Every candidate × replica pair rolls out in
@@ -1666,6 +1871,7 @@ def capacity_sweep(
         ),
         task_u=_tile_rows(task_u, K) if task_u is not None else None,
         totals=avail_rows if faults is not None else None,
+        forms=forms,
     )
     return _reshape_rows(res, K, R)
 
@@ -1685,6 +1891,7 @@ def workload_sweep(
     congestion: bool = False,
     realtime_scoring: bool = False,
     segment_ticks: Optional[int] = None,
+    forms: Optional[str] = None,
 ) -> RolloutResult:
     """On-device workload-size sweep: how do cost and makespan scale with
     the number of applications?  Candidate k activates the first
@@ -1720,6 +1927,7 @@ def workload_sweep(
         realtime_scoring=realtime_scoring,
         task_u=_tile_rows(task_u, K) if task_u is not None else None,
         active=act_rows,
+        forms=forms,
     )
     return _reshape_rows(res, K, R)
 
@@ -1729,7 +1937,9 @@ def workload_sweep(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("tick", "policy", "congestion", "realtime_scoring"),
+    static_argnames=(
+        "tick", "policy", "congestion", "realtime_scoring", "forms",
+    ),
 )
 def _segment_step(
     state: RolloutState,
@@ -1746,6 +1956,7 @@ def _segment_step(
     task_u=None,  # [R, T] opportunistic uniforms
     congestion: bool = False,
     realtime_scoring: bool = False,
+    forms: str = "vector",
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
     spec, extras = _pack_extras(faults, task_u)
@@ -1756,6 +1967,7 @@ def _segment_step(
             s, r, a, ra, workload, topo, tick, segment_ticks,
             faults=f, totals=totals, policy=policy, task_u=u,
             congestion=congestion, realtime_scoring=realtime_scoring,
+            forms=forms,
         )
 
     return jax.vmap(seg)(state, rt, arr, root_anchor, *extras)
@@ -1817,6 +2029,7 @@ def rollout_checkpointed(
     policy: str = "cost-aware",
     congestion: bool = False,
     realtime_scoring: bool = False,
+    forms: Optional[str] = None,
 ) -> RolloutResult:
     """:func:`rollout` with mid-flight checkpoint/resume.
 
@@ -1850,6 +2063,7 @@ def rollout_checkpointed(
     import os
 
     workload.check_group_demands()
+    forms = _resolve_forms(forms)
 
     fp = _fingerprint(
         key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
@@ -1910,6 +2124,7 @@ def rollout_checkpointed(
             task_u=task_u,
             congestion=congestion,
             realtime_scoring=realtime_scoring,
+            forms=forms,
         )
         jax.block_until_ready(state)
         ticks_done += seg

@@ -1085,6 +1085,40 @@ def test_segmented_sweeps_bit_identical(setup):
     )
 
 
+def _random_apps(rng, n_apps, n_groups, chain=False, name="r"):
+    """ONE seeded application builder shared by the segmented-fuzz and
+    forms-parity tests (a TaskGroup/from_applications schema change must
+    apply once, not to drifting copies): chains (``chain=True``) or
+    sparse random DAGs, mixed fan-out, zero and non-zero outputs.
+    ``n_groups`` is an int or a (lo, hi) range drawn per app."""
+    apps = []
+    for a in range(n_apps):
+        ng = n_groups if isinstance(n_groups, int) else int(
+            rng.integers(*n_groups)
+        )
+        groups = []
+        for i in range(ng):
+            if chain:
+                deps = [str(i - 1)] if i else []
+            else:
+                deps = (
+                    [str(int(rng.integers(0, i)))]
+                    if i and rng.random() < 0.6
+                    else []
+                )
+            groups.append(TaskGroup(
+                str(i),
+                cpus=float(rng.choice([0.5, 1, 2])),
+                mem=float(rng.choice([128, 512, 1024])),
+                runtime=float(rng.integers(3, 40)),
+                output_size=float(rng.choice([0, 500, 4000])),
+                instances=int(rng.integers(1, 6)),
+                dependencies=deps,
+            ))
+        apps.append(Application(f"{name}{a}", groups))
+    return apps
+
+
 @pytest.mark.parametrize("seed", [21, 22, 23])
 def test_segmented_rollout_fuzz(setup, seed):
     """Randomized workloads: segmented row execution stays bit-identical
@@ -1093,21 +1127,7 @@ def test_segmented_rollout_fuzz(setup, seed):
 
     cluster, topo = setup
     rng = np.random.default_rng(seed)
-    apps = []
-    for a in range(int(rng.integers(2, 4))):
-        groups = []
-        for i in range(int(rng.integers(2, 5))):
-            deps = [str(int(rng.integers(0, i)))] if i and rng.random() < 0.6 else []
-            groups.append(TaskGroup(
-                str(i),
-                cpus=float(rng.choice([0.5, 1, 2])),
-                mem=float(rng.choice([128, 512])),
-                runtime=float(rng.integers(3, 40)),
-                output_size=float(rng.choice([0, 300, 4000])),
-                instances=int(rng.integers(1, 5)),
-                dependencies=deps,
-            ))
-        apps.append(Application(f"f{a}", groups))
+    apps = _random_apps(rng, int(rng.integers(2, 4)), (2, 5), name="f")
     w = EnsembleWorkload.from_applications(
         apps, arrivals=[float(10 * i) for i in range(len(apps))]
     )
@@ -1123,3 +1143,77 @@ def test_segmented_rollout_fuzz(setup, seed):
                           **kw)
     for x, y in zip(mono, segd):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _forms_workload():
+    """Dependency-rich multi-app workload exercising every tick-body op:
+    fan-out (instance counts > 1), chains (anchor votes + transfers),
+    nonzero outputs (congestion pipes + egress), and staggered arrivals
+    (pump-time readiness)."""
+    apps = _random_apps(
+        np.random.default_rng(99), 3, 4, chain=True, name="fp"
+    )
+    return EnsembleWorkload.from_applications(
+        apps, arrivals=[0.0, 10.0, 25.0]
+    )
+
+
+def test_tick_body_forms_bit_identical(setup):
+    """The 'vector' (TPU one-hot/matmul) and 'indexed' (CPU
+    segment/gather) tick-body forms produce bit-identical rollouts on
+    every output, for every policy arm and model flag (VERDICT r02
+    item 3: the backend-conditional forms must not fork trajectories).
+    """
+    cluster, topo = setup
+    w = _forms_workload()
+    avail0, sz = _ens_inputs(cluster)
+    key = jax.random.PRNGKey(42)
+    configs = [
+        dict(policy="cost-aware"),
+        dict(policy="first-fit"),
+        dict(policy="best-fit"),
+        dict(policy="opportunistic"),
+        dict(policy="cost-aware", congestion=True),
+        dict(policy="cost-aware", congestion=True, realtime_scoring=True),
+        dict(policy="cost-aware", n_faults=2, fault_horizon=200.0,
+             mttr=60.0),
+        dict(policy="first-fit", congestion=True),
+    ]
+    for cfg in configs:
+        kw = dict(n_replicas=6, tick=5.0, max_ticks=96, perturb=0.1, **cfg)
+        rv = rollout(key, avail0, w, topo, sz, forms="vector", **kw)
+        ri = rollout(key, avail0, w, topo, sz, forms="indexed", **kw)
+        for name, xv, xi in zip(rv._fields, rv, ri):
+            np.testing.assert_array_equal(
+                np.asarray(xv), np.asarray(xi),
+                err_msg=f"forms diverge on {name} under {cfg}",
+            )
+
+
+def test_forms_bit_identical_score_params_and_sweeps(setup):
+    """Forms parity through the row-based sweep path (score_params uses
+    the pow-table selects, workload_sweep the active mask)."""
+    from pivot_tpu.parallel.ensemble import score_param_sweep, workload_sweep
+
+    cluster, topo = setup
+    w = _forms_workload()
+    avail0, sz = _ens_inputs(cluster)
+    key = jax.random.PRNGKey(7)
+    grid = np.array([[1, 1, 1], [1.5, 0.8, 0.5]], np.float32)
+    kw = dict(n_replicas=3, tick=5.0, max_ticks=96, perturb=0.1)
+    a = score_param_sweep(key, avail0, w, topo, sz, grid, forms="vector", **kw)
+    b = score_param_sweep(key, avail0, w, topo, sz, grid, forms="indexed", **kw)
+    for name, xv, xi in zip(a._fields, a, b):
+        np.testing.assert_array_equal(
+            np.asarray(xv), np.asarray(xi),
+            err_msg=f"score_param_sweep forms diverge on {name}",
+        )
+    c = workload_sweep(key, avail0, w, topo, sz, [1, 3], forms="vector",
+                       policy="opportunistic", **kw)
+    d = workload_sweep(key, avail0, w, topo, sz, [1, 3], forms="indexed",
+                       policy="opportunistic", **kw)
+    for name, xv, xi in zip(c._fields, c, d):
+        np.testing.assert_array_equal(
+            np.asarray(xv), np.asarray(xi),
+            err_msg=f"workload_sweep forms diverge on {name}",
+        )
