@@ -56,7 +56,10 @@ from pivot_tpu.ops.kernels import (
     first_fit_kernel,
     opportunistic_kernel,
 )
-from pivot_tpu.ops.pallas_kernels import cost_aware_pallas
+from pivot_tpu.ops.pallas_kernels import (
+    cost_aware_pallas,
+    cost_aware_pallas_batched,
+)
 from pivot_tpu.sched import Policy, TickContext
 from pivot_tpu.sched.policies import (
     BestFitPolicy,
@@ -388,7 +391,12 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         )
         self._cpu_twin = self._grouper
 
-    def _device_place(self, ctx: TickContext) -> np.ndarray:
+    def _anchor_stream(self, ctx: TickContext):
+        """The kernel's per-task anchor stream: ``(order, az_arr [B] i32,
+        ng_arr [B] bool, group_rows, row_idx)`` — grouping walked
+        host-side exactly like the numpy twin, tasks laid out
+        bucket-major.  Shared by :meth:`_device_place` and
+        :meth:`placement_sensitivity` so the two cannot drift."""
         T = ctx.n_tasks
         meta = ctx.meta
         storage = ctx.cluster.storage
@@ -424,6 +432,95 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         az_arr[:T] = anchor_zone
         ng_arr = np.zeros(B, dtype=bool)
         ng_arr[:T] = new_group
+        return order, az_arr, ng_arr, group_rows, row_idx
+
+    def placement_sensitivity(
+        self,
+        ctx: TickContext,
+        n_replicas: int = 256,
+        perturb: float = 0.05,
+        seed: int = 0,
+    ):
+        """Monte-Carlo robustness of THIS tick's placement decision.
+
+        How sensitive is the greedy cost-aware placement to noise in the
+        host-availability snapshot (stale resource telemetry, in-flight
+        releases)?  Replica 0 carries the exact snapshot — its placements
+        ARE the production decision — and replicas 1..R−1 draw ±``perturb``
+        multiplicative availability noise.  Returns ``(nominal [T],
+        stability [T], placements [R, T])`` in ctx task order, where
+        ``stability[t]`` is the fraction of replicas agreeing with the
+        nominal host for task t — tasks near a capacity or score boundary
+        score low and are the ones a dispatcher might hold a tick.
+
+        This is the production consumer of the replica-batched Pallas
+        kernel at its native shape (one shared task stream × R perturbed
+        ``[H, 4]`` snapshots, the whole greedy pass VMEM-resident per
+        block — 76–104 M decisions/s on a v5e at the bench shape); on
+        non-TPU backends the vmapped scan kernel serves the same
+        contract.  Not expressible by the ensemble sweeps: their rows'
+        readiness diverges after one tick, breaking the kernel's
+        shared-stream premise (see RESULTS.md round 3).
+        """
+        import jax
+
+        if self.realtime_bw:
+            raise ValueError(
+                "placement_sensitivity scores on the static topology "
+                "tables (the Pallas kernel has no live-bandwidth input)"
+            )
+        if self.topology is None:
+            raise RuntimeError("bind() the policy to a scheduler first")
+        T = ctx.n_tasks
+        order, az_arr, ng_arr, _gr, _ri = self._anchor_stream(ctx)
+        avail, dem, valid = self._padded(ctx, order)
+        rng = np.random.default_rng(seed)
+        noise = rng.uniform(
+            1 - perturb, 1 + perturb, size=(n_replicas, ctx.n_hosts, 1)
+        )
+        noise[0] = 1.0  # replica 0 = the production decision
+        avail_r = jnp.asarray(np.asarray(avail)[None] * noise, dtype=self.dtype)
+        args = (
+            dem,
+            valid,
+            jnp.asarray(ng_arr),
+            jnp.asarray(az_arr),
+            self.topology.cost,
+            self.topology.bw,
+            self.topology.host_zone,
+            jnp.asarray(ctx.host_task_counts, dtype=jnp.int32),
+        )
+        kw = dict(
+            bin_pack=self.bin_pack,
+            sort_hosts=self.sort_hosts,
+            host_decay=self.host_decay,
+        )
+        # Kernel choice mirrors _device_place exactly: an explicit
+        # use_pallas override wins, and the auto default requires the
+        # TPU backend AND f32 (the Pallas kernel is f32-only — an f64
+        # policy must not have its inputs silently quantized).
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            use_pallas = (
+                jax.default_backend() == "tpu" and self.dtype == jnp.float32
+            )
+        if use_pallas:
+            p, _ = cost_aware_pallas_batched(avail_r, *args, **kw)
+        else:
+            p, _ = jax.vmap(
+                lambda a: cost_aware_kernel(a, *args, **kw)
+            )(avail_r)
+        p = np.asarray(p)  # [R, B] in kernel task order
+        placements = np.stack(
+            [self._unpad(row, T, order) for row in p]
+        )  # [R, T] in ctx order
+        nominal = placements[0]
+        stability = (placements == nominal[None, :]).mean(axis=0)
+        return nominal, stability, placements
+
+    def _device_place(self, ctx: TickContext) -> np.ndarray:
+        T = ctx.n_tasks
+        order, az_arr, ng_arr, group_rows, row_idx = self._anchor_stream(ctx)
         avail, dem, valid = self._padded(ctx, order)
         use_pallas = self.use_pallas
         if use_pallas is None:
@@ -448,7 +545,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             rows = np.ones((G, ctx.n_hosts), dtype=np.float64)
             if group_rows:
                 rows[: len(group_rows)] = np.stack(group_rows)
-            idx = np.zeros(B, dtype=np.int32)
+            idx = np.zeros(az_arr.shape[0], dtype=np.int32)
             idx[:T] = row_idx
             kw["rt_bw_rows"] = jnp.asarray(rows, dtype=self.dtype)
             kw["rt_bw_idx"] = jnp.asarray(idx)

@@ -589,16 +589,19 @@ def test_estimator_egress_fidelity_canonical_config():
         billed = float(_sampled_egress(w, topo, zcp, pz, mask))
         assert billed == pytest.approx(des_egress, rel=0.08), policy_name
 
-        # 2. Path fidelity for the anchor-pinned cost-aware arm.
+        # 2. Path fidelity for the anchor-pinned cost-aware arm, under
+        #    the DES-faithful LIFO batch order (round-3 bias diagnosis:
+        #    the legacy fifo order measured +6.1% here, lifo +1.0% —
+        #    the bound tightens accordingly).
         if policy_name == "cost-aware":
             res = rollout(
                 jax.random.PRNGKey(0), avail0, w, topo, sz,
                 n_replicas=1, tick=5.0, max_ticks=4096, perturb=0.0,
-                policy="cost-aware",
+                policy="cost-aware", tick_order="lifo",
             )
             assert int(res.n_unfinished[0]) == 0
             est = float(res.egress_cost[0])
-            assert est == pytest.approx(des_egress, rel=0.12), (
+            assert est == pytest.approx(des_egress, rel=0.08), (
                 est, des_egress,
             )
 
@@ -750,3 +753,142 @@ def test_calibrate_mode_combination_validation():
     with pytest.raises(ValueError):
         calibrate("data/jobs/jobs-5000-200-172800-259200.npz",
                   modes=("realtime",))
+
+
+def test_lifo_wave_parity_vs_des():
+    """The tick_order="lifo" queue emulation (wait-cohort reverse
+    re-drain + fresh LIFO pump order) reproduces the DES's per-wave
+    placement ASSIGNMENTS exactly until the first wave where the
+    tick-resolution transfer-timing model shifts batch composition —
+    i.e., there is no pure-ordering divergence (round-3 bias diagnosis;
+    the legacy fifo order diverged at wave 1 on uniform clusters).
+    Runs the best-fit arm, whose placements are a pure function of batch
+    order and availability (no RNG, no anchors)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bias_diagnose",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "bias_diagnose.py"),
+    )
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+
+    import jax.numpy as jnp
+
+    from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+    from pivot_tpu.workload.trace import load_trace_jobs
+
+    n_hosts, n_apps = 40, 12
+    cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=0))
+    des_ticks, _summary, schedule = bd.des_tick_trace(
+        cluster, "best-fit", bd.TRACE, n_apps, 0, 5.0
+    )
+    schedule2 = load_trace_jobs(bd.TRACE, 1000.0).take(n_apps)
+    cluster2 = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=0))
+    # f64 inputs: the DES scores in numpy float64; x64 removes the
+    # near-tie rounding flips (the tests' jax config enables x64).
+    w, _sl, _arr, topo, avail0, sz = ensemble_inputs_from_schedule(
+        schedule2, cluster2, dtype=jnp.float64
+    )
+    est_ticks, _ = bd.est_tick_trace(
+        w, topo, avail0, sz, "best-fit", 0, 5.0, 4096, tick_order="lifo"
+    )
+    keys = [
+        (a.id, f"{g.id}/{i}")
+        for a in schedule2.apps
+        for g in a.groups
+        for i in range(g.instances)
+    ]
+    row_of = {k: i for i, k in enumerate(keys)}
+    t0 = min(a.start_time for a in schedule.apps)
+    des_waves = {
+        int(round((now - t0) / 5.0)): {
+            row_of[k]: h for k, h in m.items() if k in row_of
+        }
+        for now, m in des_ticks.items()
+    }
+    est_waves = {k: m for k, m in enumerate(est_ticks) if m}
+    waves = sorted(set(des_waves) | set(est_waves))
+    first_count = first_assign = None
+    for wv in waves:
+        dm, em = des_waves.get(wv, {}), est_waves.get(wv, {})
+        if len(dm) != len(em) and first_count is None:
+            first_count = wv
+        if dm != em and first_assign is None:
+            first_assign = wv
+    # Some waves must exist and match at all before the claim means
+    # anything.
+    assert len(waves) >= 10
+    if first_assign is not None:
+        # Any assignment divergence must coincide with a batch-content
+        # divergence (timing model), never precede it (ordering bug).
+        assert first_count is not None and first_assign >= first_count, (
+            first_assign, first_count,
+        )
+
+
+def test_cli_serve_resident_worker(tmp_path):
+    """The resident worker serves repeated requests in one process with
+    per-request reports identical to fresh one-shot runs, and the second
+    identical request reuses the warm programs (no re-init)."""
+    import subprocess
+    import sys
+
+    req = [
+        "--num-hosts", "8", "--job-dir", "data/jobs",
+        "--output-dir", str(tmp_path / "serve"), "--seed", "3",
+        "ensemble", "--num-apps", "1", "--replicas", "2",
+        "--max-ticks", "64",
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    stdin = json.dumps(req) + "\n" + json.dumps(req) + "\nquit\n"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pivot_tpu.experiments.cli", "serve"],
+        input=stdin, capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [
+        json.loads(ln) for ln in proc.stdout.splitlines()
+        if ln.startswith("{")
+    ]
+    sentinels = [d for d in lines if "served" in d]
+    reports = [d for d in lines if "makespan_mean" in d]
+    assert [s["served"] for s in sentinels] == [1, 2]
+    assert all(s["ok"] for s in sentinels)
+    assert len(reports) == 2
+    drop = ("wall_s", "replica_rollouts_per_sec")
+    r0 = {k: v for k, v in reports[0].items() if k not in drop}
+    r1 = {k: v for k, v in reports[1].items() if k not in drop}
+    # Per-request id reset: both runs are bit-identical.
+    assert r0 == r1
+    # One-shot run of the same request matches too (fresh process).
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "pivot_tpu.experiments.cli", *req],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    rep_oneshot = next(
+        json.loads(ln) for ln in proc2.stdout.splitlines()
+        if ln.startswith("{") and "makespan_mean" in ln
+    )
+    assert {k: v for k, v in rep_oneshot.items() if k not in drop} == r0
+    # Bad request: the worker reports the error and keeps its sentinel
+    # cadence instead of dying.
+    proc3 = subprocess.run(
+        [sys.executable, "-m", "pivot_tpu.experiments.cli", "serve"],
+        input='{"not": "argv"}\n["serve"]\nquit\n', capture_output=True,
+        text=True, timeout=300, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc3.returncode == 0
+    out3 = [json.loads(ln) for ln in proc3.stdout.splitlines() if ln.startswith("{")]
+    errors3 = [d for d in out3 if "error" in d]
+    # Both the malformed request and the nested-serve request error out
+    # without killing the worker (sentinels keep their cadence).
+    assert len(errors3) == 2
+    assert "nested" in errors3[1]["error"]
+    assert [d.get("served") for d in out3 if "served" in d] == [1, 2]

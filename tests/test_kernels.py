@@ -337,3 +337,39 @@ def test_tick_parity_cost_aware_realtime_bw_with_queued_routes(meta):
 def test_realtime_bw_rejects_explicit_pallas():
     with pytest.raises(ValueError):
         TpuCostAwarePolicy(realtime_bw=True, use_pallas=True)
+
+
+def test_placement_sensitivity(meta):
+    """The Monte-Carlo placement-robustness analysis (the replica-batched
+    kernel's production shape): replica 0 is the exact nominal decision,
+    zero perturbation degenerates to all-stable, and availability noise
+    on a near-uniform cluster destabilizes score-tie tasks."""
+    pol = TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True)
+    ctx = make_ctx(meta, SHAPES * 4, random_groups(4)(), seed=4)
+    pol.bind(ctx.scheduler)
+
+    nominal, stability, placements = pol.placement_sensitivity(
+        ctx, n_replicas=16, perturb=0.0, seed=1
+    )
+    T = ctx.n_tasks
+    assert nominal.shape == (T,) and stability.shape == (T,)
+    assert placements.shape == (16, T)
+    # perturb=0: every replica sees the same snapshot.
+    assert np.all(stability == 1.0)
+    assert np.all(placements == nominal[None, :])
+
+    n2, s2, p2 = pol.placement_sensitivity(
+        ctx, n_replicas=32, perturb=0.15, seed=1
+    )
+    # Replica 0 carries the unperturbed snapshot: the nominal decision
+    # is independent of the noise draw.
+    assert n2.tolist() == nominal.tolist()
+    assert np.all((0.0 <= s2) & (s2 <= 1.0))
+    # Same-shape hosts tie on scores, so availability noise must flip
+    # some winners across replicas (deterministic given the seed).
+    assert np.any(s2 < 1.0)
+    # Stability is exactly the agreement fraction of the raw placements.
+    assert np.allclose(s2, (p2 == n2[None, :]).mean(axis=0))
+
+    with pytest.raises(ValueError):
+        TpuCostAwarePolicy(realtime_bw=True).placement_sensitivity(ctx)

@@ -49,3 +49,11 @@ def test_crossover_interpret_smoke():
             assert f"{name}_s" in rec
             assert rec[f"{name}_decisions_per_s"] > 0
         assert rec["winner"] in ("scan", "pallas", "pallas_rb")
+
+
+def test_host_scale_interpret_smoke():
+    tv = _load_tpu_validate()
+    doc = tv.host_scale(interpret=True, Hs=(16,), T=10, R=4)
+    assert doc["all_ok"], doc["rows"]
+    # One auto row + three explicit rows per host count.
+    assert len(doc["rows"]) == 4

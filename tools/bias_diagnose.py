@@ -72,7 +72,7 @@ def des_tick_trace(cluster, policy_name, trace, n_apps, seed, interval):
 
 
 def est_tick_trace(workload, topo, avail0, storage_zones, policy_name,
-                   seed, tick, max_ticks):
+                   seed, tick, max_ticks, tick_order="fifo"):
     """Single-replica nominal rollout, segmented per tick: per-tick new
     placements [{row: host}], bit-identical to the monolithic rollout."""
     import jax
@@ -94,7 +94,7 @@ def est_tick_trace(workload, topo, avail0, storage_zones, policy_name,
         state = ens._segment_step(
             state, rt, arr, ra, workload, topo, tick=tick,
             segment_ticks=jnp.asarray(1, jnp.int32), totals=avail0,
-            policy=policy_name, forms="indexed",
+            policy=policy_name, forms="indexed", tick_order=tick_order,
         )
         place = np.asarray(state.place[0])
         new = np.nonzero((prev < 0) & (place >= 0))[0]
@@ -133,7 +133,9 @@ def per_task_egress(workload, topo, place_vec):
 
 
 def diagnose_one(policy, n_hosts, n_apps, cluster_seed, interval=5.0,
-                 max_ticks=4096, des_seed=0):
+                 max_ticks=4096, des_seed=0, tick_order="fifo", x64=False):
+    import jax.numpy as jnp
+
     from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
     from pivot_tpu.utils.config import ClusterConfig, build_cluster
     from pivot_tpu.workload.trace import load_trace_jobs
@@ -146,10 +148,11 @@ def diagnose_one(policy, n_hosts, n_apps, cluster_seed, interval=5.0,
     schedule2 = load_trace_jobs(TRACE, 1000.0).take(n_apps)
     cluster2 = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=cluster_seed))
     w, _sl, _arr, topo, avail0, sz = ensemble_inputs_from_schedule(
-        schedule2, cluster2
+        schedule2, cluster2, dtype=jnp.float64 if x64 else None
     )
     est_ticks, _ = est_tick_trace(
-        w, topo, avail0, sz, policy, des_seed, interval, max_ticks
+        w, topo, avail0, sz, policy, des_seed, interval, max_ticks,
+        tick_order=tick_order,
     )
 
     # Key ↔ row alignment (same layout as the fidelity test).
@@ -278,16 +281,24 @@ def main():
     ap.add_argument("--hosts", type=int, default=80)
     ap.add_argument("--apps", type=int, default=30)
     ap.add_argument("--cluster-seeds", type=int, default=1)
+    ap.add_argument("--tick-order", default="fifo", choices=["fifo", "lifo"])
+    ap.add_argument("--x64", action="store_true",
+                    help="f64 rollout (matches the DES's numpy f64 scores)")
     ap.add_argument("--out", default="")
     ns = ap.parse_args()
 
     from pivot_tpu.utils import pin_virtual_cpu_mesh
 
     pin_virtual_cpu_mesh(1)
+    if ns.x64:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
 
     reports = []
     for cs in range(ns.cluster_seeds):
-        rep = diagnose_one(ns.policy, ns.hosts, ns.apps, cluster_seed=cs)
+        rep = diagnose_one(ns.policy, ns.hosts, ns.apps, cluster_seed=cs,
+                           tick_order=ns.tick_order, x64=ns.x64)
         print(
             json.dumps(
                 {
