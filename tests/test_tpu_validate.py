@@ -14,14 +14,18 @@ import os
 import sys
 
 
-def _load_tpu_validate():
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "tools", "tpu_validate.py"
-    )
-    spec = importlib.util.spec_from_file_location("tpu_validate", path)
+def _load_tool(name):
+    """Import a tools/ module by file path (they live outside the
+    package) — the one loader shared by every tool smoke test."""
+    path = os.path.join(os.path.dirname(__file__), "..", "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_tpu_validate():
+    return _load_tool("tpu_validate")
 
 
 def test_parity_sweep_interpret_smoke():
@@ -57,3 +61,18 @@ def test_host_scale_interpret_smoke():
     assert doc["all_ok"], doc["rows"]
     # One auto row + three explicit rows per host count.
     assert len(doc["rows"]) == 4
+
+
+def test_hw_r03_smoke():
+    """The round-3 hardware campaign's sections run end to end on the
+    CPU backend at tiny shapes — the live-tunnel windows are scarce and
+    must not be wasted on a bit-rotted harness."""
+    hw = _load_tool("hw_r03")
+    cong = hw.congestion_arm(quick=True, n_apps=2, n_hosts=8, n_replicas=4)
+    assert set(cong) >= {"static", "congested", "congested_over_static"}
+    assert cong["static"]["wall_s"] > 0
+    lc = hw.lifo_cost(n_apps=2, n_hosts=8, n_replicas=4)
+    assert lc["fifo"]["wall_s"] > 0 and lc["lifo_over_fifo"] > 0
+    sens = hw.sensitivity_throughput(H=8, T=24, R=4)
+    assert sens["placed"] >= 0 and sens["decisions_per_s"] > 0
+    assert 0.0 <= sens["stability_mean"] <= 1.0

@@ -1,0 +1,249 @@
+"""Round-3 live-tunnel measurement campaign (run when the tunnel answers).
+
+One program, one JSON document, covering every round-3 item that needs
+the real chip (in priority order, so a tunnel that dies mid-campaign
+still leaves the top items measured):
+
+  1. Congestion-arm timing (VERDICT r02 item 1): canonical 25-app ×
+     256-replica rollout, static vs congested arms, after the round-3
+     one-hot-matmul vectorization — target congested ≤ 2× static and
+     ≤ 6 s absolute (round-2: 11.4 s vs 3.1 s).
+  2. The bench rollout metric (target ≥ 4,000 rollouts/s at the bench
+     ensemble shape) — bench.py refreshes BENCH_TPU.json itself; this
+     campaign records the rollout decomposition.
+  3. tick_order="lifo" device cost (the fidelity mode's two extra [T]
+     sorts per tick — 1.9× on CPU; is the TPU hit comparable?).
+  4. Warm `serve` request wall (VERDICT r02 item 7 evidence: repeated
+     what-if queries at device-wall speed) — a resident worker child
+     serves the same ensemble request twice; the second sentinel's
+     wall is the warm figure.
+
+Usage: python tools/hw_r03.py [--quick] > figures/hw_r03.json
+Exits non-zero if the backend is not a live accelerator.
+(tools/tpu_validate.py runs separately for parity/host-scale/crossover.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_bench():
+    """bench.py by file path — the ONE home of the batch-fetch timing
+    primitive (`_timed_calls`: warm + n serialized calls + a single
+    value fetch, immune to the tunnel's block-until-ready under-wait)
+    and of the bench batch builder."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_bench = None
+
+
+def _fetch_timed(fn, fetch, n=3):
+    global _bench
+    if _bench is None:
+        _bench = _load_bench()
+    per_call, _out = _bench._timed_calls(fn, fetch, n=n)
+    return per_call
+
+
+def canonical_workload(n_apps=25, n_hosts=100):
+    """The canonical 25-app trace workload (the round-2 decomposition
+    config: 1,882 instances, ~915 ticks at the 100-host scale)."""
+    from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+    from pivot_tpu.workload.trace import load_trace_jobs
+
+    trace = "data/jobs/jobs-5000-200-172800-259200.npz"
+    schedule = load_trace_jobs(trace, 1000.0).take(n_apps)
+    cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=0))
+    w, _sl, _arr, topo, avail0, sz = ensemble_inputs_from_schedule(
+        schedule, cluster
+    )
+    return w, topo, avail0, sz
+
+
+def congestion_arm(quick: bool, n_apps=25, n_hosts=100,
+                   n_replicas=256) -> dict:
+    """Item 1: the congested rollout after the one-hot-matmul rewrite."""
+    import jax
+
+    from pivot_tpu.parallel.ensemble import rollout
+
+    w, topo, avail0, sz = canonical_workload(n_apps, n_hosts)
+    kw = dict(n_replicas=n_replicas, tick=5.0, max_ticks=1024, perturb=0.1)
+    key = jax.random.PRNGKey(0)
+    out = {}
+    arms = [
+        ("static", dict()),
+        ("congested", dict(congestion=True)),
+        ("realtime", dict(congestion=True, realtime_scoring=True)),
+    ]
+    if quick:
+        arms = arms[:2]
+    for name, extra in arms:
+        per = _fetch_timed(
+            lambda: rollout(key, avail0, w, topo, sz, **kw, **extra),
+            lambda r: float(np.asarray(r.makespan).sum()),
+            n=2 if quick else 3,
+        )
+        out[name] = {"wall_s": round(per, 3)}
+    if "congested" in out and "static" in out:
+        out["congested_over_static"] = round(
+            out["congested"]["wall_s"] / out["static"]["wall_s"], 2
+        )
+        out["target_met"] = (
+            out["congested_over_static"] <= 2.0
+            and out["congested"]["wall_s"] <= 6.0
+        )
+    return out
+
+
+def lifo_cost(n_apps=25, n_hosts=100, n_replicas=256) -> dict:
+    """Item 3: fidelity-order device cost at the canonical shape."""
+    import jax
+
+    from pivot_tpu.parallel.ensemble import rollout
+
+    w, topo, avail0, sz = canonical_workload(n_apps, n_hosts)
+    kw = dict(n_replicas=n_replicas, tick=5.0, max_ticks=1024, perturb=0.1)
+    key = jax.random.PRNGKey(0)
+    out = {}
+    for order in ("fifo", "lifo"):
+        per = _fetch_timed(
+            lambda: rollout(key, avail0, w, topo, sz, tick_order=order, **kw),
+            lambda r: float(np.asarray(r.makespan).sum()),
+        )
+        out[order] = {"wall_s": round(per, 3)}
+    out["lifo_over_fifo"] = round(
+        out["lifo"]["wall_s"] / out["fifo"]["wall_s"], 2
+    )
+    return out
+
+
+def sensitivity_throughput(H=512, T=2048, R=1024) -> dict:
+    """placement_sensitivity at the bench shape — the replica-batched
+    kernel's production consumer, end-to-end."""
+    global _bench
+    if _bench is None:
+        _bench = _load_bench()
+
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+
+    ctx = _bench._build_batch(H, T, seed=7)
+    pol = TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True)
+    pol.bind(ctx.scheduler)
+    # Warm first (jit trace + XLA compile must not pollute the number),
+    # then time — placement_sensitivity returns forced numpy arrays, so
+    # the wall below is a complete execution.
+    pol.placement_sensitivity(ctx, n_replicas=R, perturb=0.05, seed=0)
+    t0 = time.perf_counter()
+    nominal, stability, _ = pol.placement_sensitivity(
+        ctx, n_replicas=R, perturb=0.05, seed=0
+    )
+    wall = time.perf_counter() - t0
+    return {
+        "T": ctx.n_tasks,
+        "H": ctx.n_hosts,
+        "R": R,
+        "wall_s": round(wall, 3),
+        "decisions_per_s": round(R * ctx.n_tasks / wall, 1),
+        "placed": int((nominal >= 0).sum()),
+        "stability_mean": round(float(stability.mean()), 4),
+        "stability_p5": round(float(np.percentile(stability, 5)), 4),
+    }
+
+
+def serve_warm(n_apps=25, replicas=256) -> dict:
+    """Item 4: cold vs warm request wall through the resident worker."""
+    import subprocess
+    import tempfile
+
+    req = [
+        "--num-hosts", "100", "--job-dir", "data/jobs",
+        "--output-dir", tempfile.mkdtemp(prefix="hw_r03_serve_"),
+        "--seed", "0", "ensemble", "--num-apps", str(n_apps),
+        "--replicas", str(replicas),
+    ]
+    stdin = json.dumps(req) + "\n" + json.dumps(req) + "\nquit\n"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pivot_tpu.experiments.cli", "serve"],
+        input=stdin, capture_output=True, text=True, timeout=1800,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    sentinels = [
+        json.loads(ln) for ln in proc.stdout.splitlines()
+        if ln.startswith("{") and "served" in ln
+    ]
+    if len(sentinels) != 2 or not all(s_["ok"] for s_ in sentinels):
+        return {
+            "error": "worker failed",
+            "rc": proc.returncode,
+            "stderr_tail": proc.stderr[-400:],
+        }
+    return {
+        "request": "ensemble %d apps x %d replicas" % (n_apps, replicas),
+        "cold_wall_s": sentinels[0]["wall_s"],
+        "warm_wall_s": sentinels[1]["wall_s"],
+        "speedup": round(
+            sentinels[0]["wall_s"] / max(sentinels[1]["wall_s"], 1e-9), 2
+        ),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ns = ap.parse_args()
+
+    from pivot_tpu.utils import enable_compilation_cache, probe_backend_alive
+
+    if not probe_backend_alive(120):
+        print(json.dumps({"ok": False, "error": "tunnel unresponsive"}))
+        sys.exit(1)
+    import jax
+
+    enable_compilation_cache()
+    if jax.default_backend() == "cpu":
+        print(json.dumps({"ok": False, "error": "backend is cpu"}))
+        sys.exit(1)
+
+    t0 = time.time()
+    doc = {
+        "ok": True,
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    }
+    for name, fn in (
+        ("congestion_arm", lambda: congestion_arm(ns.quick)),
+        ("lifo_cost", lifo_cost),
+        ("sensitivity", sensitivity_throughput),
+        ("serve_warm", serve_warm),
+    ):
+        try:
+            doc[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — partial campaigns count
+            doc[name] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+            doc["ok"] = False
+    doc["wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(doc, indent=2))
+    sys.exit(0 if doc["ok"] else 2)
+
+
+if __name__ == "__main__":
+    main()
