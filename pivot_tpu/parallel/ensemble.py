@@ -608,19 +608,32 @@ def _rollout_segment(
         #     scatter on the vector path).
         iota_t = jnp.arange(T, dtype=jnp.int32)
         if lifo:
+            # Three keys, not six: the wait/fresh/non-ready cohorts and
+            # the wait cohort's reverse re-drain fold into ONE i32 key
+            # (waits carry −qpos ≤ 0, fresh 1, non-ready 2 — integer
+            # selection, order identical to the unfolded keys), and the
+            # fresh cohort's (app creation order, LIFO stack pop) pair
+            # is the STATIC key app·T + (T−1−index); only pump time
+            # stays its own key.
             wait_c = (qpos >= 0) & ready
-            border = lax.sort(
-                (
-                    (~ready).astype(jnp.int32),  # non-ready last
-                    (~wait_c).astype(jnp.int32),  # wait cohort first
-                    jnp.where(wait_c, -qpos, 0),  # reverse re-drain
-                    ready_time,  # fresh: pump event time
-                    workload.app_of.astype(jnp.int32),  # fresh: app order
-                    -iota_t,  # fresh: LIFO stack pop
-                    iota_t,
-                ),
-                num_keys=6,
-            )[6]  # [T] batch order (task index at each position)
+            k1 = jnp.where(
+                ready, jnp.where(wait_c, -qpos, 1), jnp.asarray(2, jnp.int32)
+            )
+            if T <= 46340:  # app·T + T ≤ T² + T < 2³¹ (app_of < n_apps ≤ T)
+                fresh_static = (
+                    workload.app_of.astype(jnp.int32) * T + (T - 1 - iota_t)
+                )
+                keys = (k1, ready_time, fresh_static, iota_t)
+                nk = 3
+            else:  # unreachable with a [T, T] pred matrix in HBM; exact
+                keys = (
+                    k1, ready_time, workload.app_of.astype(jnp.int32),
+                    -iota_t, iota_t,
+                )
+                nk = 4
+            border = lax.sort(keys, num_keys=nk)[
+                len(keys) - 1
+            ]  # [T] batch order (task index at each position)
             if vector:
                 brank = lax.sort((border, iota_t), num_keys=1)[1]
             else:
