@@ -149,6 +149,19 @@ def _execute_run(spec: RunSpec) -> None:
         run.run()
 
 
+def _add_tick_order(sub_parser, default="fifo"):
+    """The ONE definition of the --tick-order flag (five estimator
+    subcommands carry it): 'fifo' is the bit-stable throughput order,
+    'lifo' the DES-faithful popitem-queue emulation (~1.5x per-tick
+    cost; the calibrate default — the round-3 bias fix)."""
+    sub_parser.add_argument(
+        "--tick-order", default=default, choices=["fifo", "lifo"],
+        help="within-tick batch order: 'fifo' (task-index, bit-stable "
+             "throughput default) or 'lifo' (exact DES popitem-queue "
+             f"emulation, ~1.5x per-tick cost; default: {default})",
+    )
+
+
 def parse_args(argv=None):
     parser = argparse.ArgumentParser(
         description="Run cost-aware scheduling simulations on Alibaba traces"
@@ -235,13 +248,7 @@ def parse_args(argv=None):
     ens.add_argument("--perturb", type=float, default=0.1,
                      help="± multiplicative jitter on task runtimes and "
                           "arrival times per replica")
-    ens.add_argument("--tick-order", default="fifo",
-                     choices=["fifo", "lifo"],
-                     help="within-tick batch order: 'fifo' (task-index, "
-                          "the bit-stable throughput default) or 'lifo' "
-                          "(exact DES popitem-queue emulation — the "
-                          "calibrate default; costs two extra [T] sorts "
-                          "per tick)")
+    _add_tick_order(ens)
     ens.add_argument("--tick", type=float, default=5.0)
     ens.add_argument("--max-ticks", type=int, default=2048)
     ens.add_argument("--checkpoint", default=None, metavar="NPZ",
@@ -316,13 +323,7 @@ def parse_args(argv=None):
                      help="run the estimator in float64 like the DES "
                           "(CPU-side harness; tightens the static packing "
                           "arms' fidelity — see RESULTS.md)")
-    cal.add_argument("--tick-order", default="lifo",
-                     choices=["lifo", "fifo"],
-                     help="within-tick batch order: 'lifo' emulates the "
-                          "DES's popitem queue drain exactly (the "
-                          "fidelity default — the round-3 bias fix); "
-                          "'fifo' is the raw rollout entry's throughput "
-                          "order")
+    _add_tick_order(cal, default="lifo")
     cal.add_argument("--realtime", action="store_true",
                      help="calibrate the bandwidth-aware variants against "
                           "each other: DES realtime_bw arm vs estimator "
@@ -339,6 +340,7 @@ def parse_args(argv=None):
     at.add_argument("--replicas", type=int, default=32,
                     help="Monte-Carlo replicas per candidate")
     at.add_argument("--perturb", type=float, default=0.1)
+    _add_tick_order(at)
     at.add_argument("--tick", type=float, default=5.0)
     at.add_argument("--max-ticks", type=int, default=2048)
     at.add_argument("--exponents", nargs="+", type=float,
@@ -368,6 +370,7 @@ def parse_args(argv=None):
                           "balance is preserved")
     cap.add_argument("--replicas", type=int, default=32)
     cap.add_argument("--perturb", type=float, default=0.1)
+    _add_tick_order(cap)
     cap.add_argument("--tick", type=float, default=5.0)
     cap.add_argument("--max-ticks", type=int, default=2048)
     cap.add_argument("--host-hourly-rate", type=float, default=0.932,
@@ -411,6 +414,7 @@ def parse_args(argv=None):
                           "trace, in submission order)")
     aps.add_argument("--replicas", type=int, default=32)
     aps.add_argument("--perturb", type=float, default=0.1)
+    _add_tick_order(aps)
     aps.add_argument("--tick", type=float, default=5.0)
     aps.add_argument("--max-ticks", type=int, default=4096)
     aps.add_argument("--host-hourly-rate", type=float, default=0.932)
@@ -797,7 +801,7 @@ def run_autotune(args) -> dict:
     sweep = _maybe_shard_sweep(
         score_param_sweep, n_replicas=args.replicas, tick=args.tick,
         max_ticks=args.max_ticks, perturb=args.perturb,
-        congestion=args.congestion,
+        congestion=args.congestion, tick_order=args.tick_order,
     )
     res = sweep(
         jax.random.PRNGKey(args.seed), avail0, workload, topo, storage_zones,
@@ -903,6 +907,7 @@ def run_capacity(args) -> dict:
         congestion=args.congestion or args.realtime_scoring,
         realtime_scoring=args.realtime_scoring, n_faults=args.faults,
         fault_horizon=args.fault_horizon, mttr=args.fault_mttr,
+        tick_order=args.tick_order,
     )
     res = sweep(
         jax.random.PRNGKey(args.seed), grid, workload, topo, storage_zones,
@@ -1017,6 +1022,7 @@ def run_apps(args) -> dict:
             workload_sweep, n_replicas=args.replicas,
             tick=args.tick, max_ticks=args.max_ticks, perturb=args.perturb,
             policy=policy, congestion=args.congestion,
+            tick_order=args.tick_order,
         )
         res = sweep(
             jax.random.PRNGKey(args.seed), avail0, workload, topo,
