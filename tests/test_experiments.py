@@ -755,15 +755,20 @@ def test_calibrate_mode_combination_validation():
                   modes=("realtime",))
 
 
-def test_lifo_wave_parity_vs_des():
+@pytest.mark.parametrize(
+    "policy,n_hosts,n_apps",
+    [("best-fit", 40, 12), ("first-fit", 30, 10)],
+)
+def test_lifo_wave_parity_vs_des(policy, n_hosts, n_apps):
     """The tick_order="lifo" queue emulation (wait-cohort reverse
     re-drain + fresh LIFO pump order) reproduces the DES's per-wave
     placement ASSIGNMENTS exactly until the first wave where the
     tick-resolution transfer-timing model shifts batch composition —
     i.e., there is no pure-ordering divergence (round-3 bias diagnosis;
     the legacy fifo order diverged at wave 1 on uniform clusters).
-    Runs the best-fit arm, whose placements are a pure function of batch
-    order and availability (no RNG, no anchors)."""
+    Runs the packing arms, whose placements are a pure function of batch
+    order and availability (no RNG; first-fit adds the norm-decreasing
+    sort whose ties the batch order resolves)."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -780,10 +785,9 @@ def test_lifo_wave_parity_vs_des():
     from pivot_tpu.utils.config import ClusterConfig, build_cluster
     from pivot_tpu.workload.trace import load_trace_jobs
 
-    n_hosts, n_apps = 40, 12
     cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=0))
     des_ticks, _summary, schedule = bd.des_tick_trace(
-        cluster, "best-fit", bd.TRACE, n_apps, 0, 5.0
+        cluster, policy, bd.TRACE, n_apps, 0, 5.0
     )
     schedule2 = load_trace_jobs(bd.TRACE, 1000.0).take(n_apps)
     cluster2 = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=0))
@@ -793,7 +797,7 @@ def test_lifo_wave_parity_vs_des():
         schedule2, cluster2, dtype=jnp.float64
     )
     est_ticks, _ = bd.est_tick_trace(
-        w, topo, avail0, sz, "best-fit", 0, 5.0, 4096, tick_order="lifo"
+        w, topo, avail0, sz, policy, 0, 5.0, 4096, tick_order="lifo"
     )
     keys = [
         (a.id, f"{g.id}/{i}")
