@@ -440,6 +440,30 @@ def main() -> None:
     if hasattr(signal, "SIGALRM"):
         signal.alarm(0)
 
+    tpu_record = None
+    if backend != "tpu":
+        # A fallback line must carry the pointer to the canonical
+        # hardware record, so a dead-tunnel round's artifact is
+        # self-explaining (VERDICT r02 item 2).
+        try:
+            with open(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_TPU.json",
+                )
+            ) as f:
+                rec = json.load(f)
+            tpu_record = {
+                "recorded_at_utc": rec.get("recorded_at_utc"),
+                "value": rec.get("bench_line", {}).get("value"),
+                "kernel": rec.get("bench_line", {}).get("kernel"),
+                "ensemble_replica_rollouts_per_sec": rec.get(
+                    "bench_line", {}
+                ).get("ensemble_replica_rollouts_per_sec"),
+                "see": "BENCH_TPU.json",
+            }
+        except Exception:  # noqa: BLE001 — the pointer is best-effort
+            pass
     line = {
         "metric": (
             "cost-aware placement decisions/sec "
@@ -456,6 +480,7 @@ def main() -> None:
         "ensemble_replica_rollouts_per_sec": round(ens_rps, 2),
         "tpu_attempted": tpu_attempted,
         "probe_history": probe_history,
+        **({"tpu_record": tpu_record} if tpu_record else {}),
     }
     print(json.dumps(line), flush=True)
     if backend == "tpu":
