@@ -72,7 +72,8 @@ def des_tick_trace(cluster, policy_name, trace, n_apps, seed, interval):
 
 
 def est_tick_trace(workload, topo, avail0, storage_zones, policy_name,
-                   seed, tick, max_ticks, tick_order="fifo"):
+                   seed, tick, max_ticks, tick_order="fifo",
+                   congestion=False):
     """Single-replica nominal rollout, segmented per tick: per-tick new
     placements [{row: host}], bit-identical to the monolithic rollout."""
     import jax
@@ -95,6 +96,7 @@ def est_tick_trace(workload, topo, avail0, storage_zones, policy_name,
             state, rt, arr, ra, workload, topo, tick=tick,
             segment_ticks=jnp.asarray(1, jnp.int32), totals=avail0,
             policy=policy_name, forms="indexed", tick_order=tick_order,
+            congestion=congestion,
         )
         place = np.asarray(state.place[0])
         new = np.nonzero((prev < 0) & (place >= 0))[0]
