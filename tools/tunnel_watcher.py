@@ -1,0 +1,266 @@
+"""Long-lived tunnel watcher: poll the TPU link, fire the campaign on success.
+
+VERDICT r03 item 1(b): three rounds of device deliverables have been lost
+to dead tunnel windows because the campaign needed a human (or an agent
+turn) to notice the link coming back.  This watcher removes the luck: it
+probes the backend in a disposable child every ``--interval`` seconds
+(cheap, hang-proof — the probe child is killed on timeout no matter where
+JAX blocks), and the moment a probe answers it fires the staged campaign
+items in priority order, committing each item's artifact to git as soon as
+that item lands.  A window that dies mid-campaign therefore still banks
+whatever finished (including rc-2 partial documents); the watcher just
+keeps polling and retries the rest at the next window.
+
+Campaign items (priority order, same ranking as tools/hw_r03.py):
+
+  1. ``hw_r03``       → figures/hw_r03.json          (rc 0 = complete;
+     rc 2 = partial: artifact banked as hw_r03_partial.json and the item
+     retried at later windows, up to ``MAX_PARTIAL_ATTEMPTS``)
+  2. ``tpu_validate`` → figures/tpu_validate_r04.json (incl. host_scale
+     at H ∈ {600, 1024} — the parity rows VERDICT r03 asks for)
+  3. ``bench``        → BENCH_TPU.json machine-written by bench.py's own
+     ``_write_tpu_record`` path; stdout kept as figures/bench_tpu_r04.json.
+     bench.py exits 0 even on its CPU fallback, so the watcher verifies
+     the reported backend is non-CPU before marking the item done.
+
+State lives in figures/watcher_state.json; every probe/fire attempt is
+appended to figures/watcher_log.jsonl.  The watcher exits 0 once all
+items are complete, so a supervising loop can just wait on it.
+
+Usage:  python tools/tunnel_watcher.py [--interval 180] [--probe-timeout 120]
+        [--once]   # single probe+fire attempt, for tests
+
+The capability being proven on-chip is the accelerated scheduler hot loop
+(ref ``scheduler/cost_aware.py:99-127``) and the network co-simulation
+(ref ``resources/network.py:86-100``); see tools/hw_r03.py for the item
+breakdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIGURES = os.path.join(REPO, "figures")
+STATE = os.path.join(FIGURES, "watcher_state.json")
+LOG = os.path.join(FIGURES, "watcher_log.jsonl")
+
+# An rc-2 "partial" run completed on a live link but had not-ok rows
+# (hw_r03 banks per-item errors; tpu_validate flags failed validations).
+# Retrying can help when the cause was the tunnel dying mid-item, but a
+# deterministic failure would retry forever — so after this many partial
+# attempts the partial artifact is accepted as the item's final result.
+MAX_PARTIAL_ATTEMPTS = 3
+
+# (name, argv, stdout artifact path, per-item timeout seconds).
+# Timeouts are generous: first compiles through the tunnel are slow, and a
+# hung child is killed and simply retried at the next window.
+ITEMS = [
+    (
+        "hw_r03",
+        [sys.executable, "tools/hw_r03.py"],
+        os.path.join(FIGURES, "hw_r03.json"),
+        3600,
+    ),
+    (
+        "tpu_validate",
+        [sys.executable, "tools/tpu_validate.py"],
+        os.path.join(FIGURES, "tpu_validate_r04.json"),
+        3600,
+    ),
+    (
+        "bench",
+        [sys.executable, "bench.py"],
+        os.path.join(FIGURES, "bench_tpu_r04.json"),
+        3600,
+    ),
+]
+
+
+def _log(event: dict) -> None:
+    event = dict(event, t=round(time.time(), 1))
+    with open(LOG, "a") as f:
+        f.write(json.dumps(event) + "\n")
+
+
+def _load_state() -> dict:
+    state = {"done": {}, "partial_attempts": {}, "attempts": 0}
+    if os.path.exists(STATE):
+        with open(STATE) as f:
+            state.update(json.load(f))
+        state.setdefault("partial_attempts", {})
+    return state
+
+
+def _save_state(state: dict) -> None:
+    with open(STATE, "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def probe(timeout: float) -> bool:
+    """True iff a live non-CPU backend answers within ``timeout``.
+
+    Runs in a disposable child because a wedged tunnel can block JAX
+    init un-interruptibly (same rationale as utils.probe_backend_alive;
+    duplicated here so the watcher works even if the package import
+    itself wedges on a half-dead link).
+    """
+    code = (
+        "import jax; b = jax.default_backend(); "
+        "assert b != 'cpu', b; "
+        "jax.block_until_ready(jax.numpy.zeros(8) + 1); print('ok', b)"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout,
+            capture_output=True, text=True, cwd=REPO,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+    return p.returncode == 0 and "ok" in p.stdout
+
+
+def _git_commit(paths, message: str) -> None:
+    existing = [p for p in paths if os.path.exists(p)]
+    if not existing:
+        return
+    try:
+        subprocess.run(["git", "add", *existing], cwd=REPO, check=True,
+                       capture_output=True, timeout=60)
+        p = subprocess.run(
+            ["git", "commit", "-m", message], cwd=REPO,
+            capture_output=True, text=True, timeout=60,
+        )
+        # rc 1 with "nothing to commit" is benign; anything else is a
+        # real banking failure and must reach the log.
+        if p.returncode not in (0, 1) or (
+            p.returncode == 1 and "nothing to commit" not in p.stdout
+        ):
+            _log({"event": "git_commit_failed", "rc": p.returncode,
+                  "stderr": p.stderr[-300:], "stdout": p.stdout[-200:]})
+    except (subprocess.SubprocessError, OSError) as exc:
+        _log({"event": "git_error", "error": str(exc)[:200]})
+
+
+def _bench_backend_ok(stdout: str) -> bool:
+    """True iff bench.py's authoritative (last) JSON line reports a
+    non-CPU backend — bench exits 0 even on its CPU fallback, which must
+    not mark the watcher's bench item done."""
+    last = None
+    for ln in stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                last = json.loads(ln)
+            except ValueError:
+                continue
+    return bool(last) and last.get("backend", "cpu") != "cpu"
+
+
+def run_item(name, argv, artifact, timeout) -> tuple:
+    """Run one campaign item; returns (status, artifact_path_or_None)
+    with status in {'done', 'partial', 'failed'}."""
+    _log({"event": "item_start", "item": name})
+    t0 = time.time()
+    try:
+        p = subprocess.run(argv, timeout=timeout, capture_output=True,
+                           text=True, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        _log({"event": "item_timeout", "item": name, "timeout": timeout})
+        return "failed", None
+    wall = round(time.time() - t0, 1)
+    status = "done" if p.returncode == 0 else (
+        "partial" if p.returncode == 2 else "failed"
+    )
+    if name == "bench" and status == "done" and not _bench_backend_ok(p.stdout):
+        status = "failed"  # CPU fallback: keep polling for a real window
+    # Distinct paths per status so a later failed run cannot clobber an
+    # earlier window's valid partial document.
+    out_path = {
+        "done": artifact,
+        "partial": artifact.replace(".json", "_partial.json"),
+        "failed": artifact.replace(".json", "_failed.json"),
+    }[status]
+    if p.stdout.strip():
+        with open(out_path, "w") as f:
+            f.write(p.stdout)
+    _log({"event": "item_end", "item": name, "status": status,
+          "rc": p.returncode, "wall_s": wall,
+          "stderr_tail": p.stderr[-300:] if status != "done" else ""})
+    return status, (out_path if status in ("done", "partial") else None)
+
+
+def fire_campaign(state: dict) -> bool:
+    """Run every not-yet-done item; True iff all items are now done.
+
+    Partials bank their artifact and move on to the next item (the link
+    is demonstrably alive — an rc-2 document is a *completed* run with
+    not-ok rows, not a dead tunnel); only a hard failure aborts the
+    remaining items back to polling.
+    """
+    for name, argv, artifact, timeout in ITEMS:
+        if state["done"].get(name):
+            continue
+        status, out_path = run_item(name, argv, artifact, timeout)
+        if status == "partial":
+            n = state["partial_attempts"].get(name, 0) + 1
+            state["partial_attempts"][name] = n
+            if n >= MAX_PARTIAL_ATTEMPTS:
+                state["done"][name] = "partial_accepted"
+        elif status == "done":
+            state["done"][name] = True
+        _save_state(state)
+        if out_path is not None:
+            # State is saved before the commit so the banked snapshot
+            # records this item as complete — a fresh clone resuming
+            # from it will not re-run a banked hour-long item.
+            _git_commit(
+                [out_path, os.path.join(REPO, "BENCH_TPU.json"), LOG, STATE],
+                f"tunnel watcher: {name} {status} on live backend",
+            )
+        if status == "failed":
+            # The tunnel likely died mid-campaign; back off to polling
+            # rather than burning the remaining items on a dead link.
+            return False
+    return all(state["done"].get(n) for n, *_ in ITEMS)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=180.0)
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--once", action="store_true",
+                    help="one probe (+ campaign if alive), then exit")
+    ns = ap.parse_args()
+
+    os.makedirs(FIGURES, exist_ok=True)
+    state = _load_state()
+    if all(state["done"].get(n) for n, *_ in ITEMS):
+        print(json.dumps({"ok": True, "note": "campaign already complete"}))
+        return 0
+
+    while True:
+        state["attempts"] = state.get("attempts", 0) + 1
+        alive = probe(ns.probe_timeout)
+        _log({"event": "probe", "alive": alive,
+              "attempt": state["attempts"]})
+        _save_state(state)
+        if alive:
+            if fire_campaign(state):
+                _git_commit([LOG, STATE], "tunnel watcher: campaign complete")
+                print(json.dumps({"ok": True, "attempts": state["attempts"]}))
+                return 0
+        if ns.once:
+            print(json.dumps({"ok": False, "alive": alive,
+                              "done": state["done"]}))
+            return 3
+        time.sleep(ns.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
