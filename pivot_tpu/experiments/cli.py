@@ -425,6 +425,32 @@ def parse_args(argv=None):
                      help="arms to sweep (default: the reference's three)")
     aps.add_argument("--congestion", action="store_true",
                      help="roll out under the link-contention model")
+    sens = sub.add_parser(
+        "sensitivity",
+        help="sensitivity-gated dispatch experiment: score every tick's "
+             "cost-aware decision against R availability-noise replicas "
+             "(one batched kernel call — replica 0 IS the production "
+             "decision), hold placements below a stability threshold for "
+             "one tick, and report the egress/runtime/makespan deltas vs "
+             "the identical un-gated arm on the same (trace, cluster, "
+             "seed)s",
+    )
+    sens.add_argument("--num-apps", type=int, dest="num_apps", default=30)
+    sens.add_argument("--replicas", type=int, default=256,
+                      help="noise replicas per tick (the batched kernel's "
+                           "native axis)")
+    sens.add_argument("--perturb", type=float, default=0.05,
+                      help="± multiplicative noise on the availability "
+                           "snapshot")
+    sens.add_argument("--threshold", type=float, default=0.7,
+                      help="hold a placed task whose replica agreement is "
+                           "below this fraction")
+    sens.add_argument("--max-holds", type=int, default=1,
+                      help="per-task hold budget; after this many holds "
+                           "the nominal decision goes through")
+    sens.add_argument("--des-seeds", type=int, default=5,
+                      help="paired (gated vs baseline) DES runs at this "
+                           "many consecutive seeds")
     sub.add_parser(
         "serve",
         help="resident what-if worker: serve repeated CLI requests from "
@@ -771,6 +797,105 @@ def run_calibrate(args) -> dict:
         from pivot_tpu.experiments.plots import plot_calibration_spread
 
         print(plot_calibration_spread(out_dir))
+    return report
+
+
+def run_sensitivity(args) -> dict:
+    """Paired DES experiment: sensitivity-gated cost-aware dispatch vs the
+    identical un-gated arm (see ``pivot_tpu.sched.sensitivity``).
+
+    Each seed runs BOTH arms on the same (trace, cluster): the baseline
+    is the same ``TpuCostAwarePolicy`` configuration the gated wrapper
+    drives, so the only degree of freedom is the hold rule.  The report
+    is the measured answer to "does deferring low-stability placements
+    one tick help?" — mean signed deltas with per-seed detail, plus the
+    gate's own telemetry (holds, stability profile).
+    """
+    import json
+
+    import numpy as np
+
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.sched.sensitivity import SensitivityGatedCostAware
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+
+    trace = _list_traces(args.job_dir, 1)[0]
+    canonical = dict(bin_pack="first-fit", sort_tasks=True, sort_hosts=True)
+
+    def one(seed: int, gated: bool):
+        cluster = build_cluster(_cluster_config(args))
+        if gated:
+            pol = SensitivityGatedCostAware(
+                threshold=args.threshold,
+                n_replicas=args.replicas,
+                perturb=args.perturb,
+                max_holds=args.max_holds,
+                noise_seed=seed,
+                **canonical,
+            )
+        else:
+            pol = TpuCostAwarePolicy(**canonical)
+        run = ExperimentRun(
+            f"sensitivity-{'gated' if gated else 'base'}-{seed}",
+            cluster, pol, trace,
+            output_size_scale_factor=args.scale_factor,
+            n_apps=args.num_apps, seed=seed, interval=5.0,
+        )
+        summary = run.run()
+        apps = run.schedule.apps
+        t0 = min(a.start_time for a in apps)
+        metrics = {
+            "avg_runtime": summary["avg_runtime"],
+            "egress_cost": summary["egress_cost"],
+            "instance_hours": summary["cum_instance_hours"],
+            "makespan": max(a.end_time for a in apps) - t0,
+        }
+        return metrics, (pol.summary() if gated else None)
+
+    per_seed = []
+    for s in range(args.seed, args.seed + args.des_seeds):
+        base, _ = one(s, gated=False)
+        gated, gate_stats = one(s, gated=True)
+        per_seed.append({
+            "seed": s, "baseline": base, "gated": gated,
+            "gate": gate_stats,
+            "delta": {
+                k: gated[k] - base[k] for k in base
+            },
+        })
+    keys = ("avg_runtime", "egress_cost", "instance_hours", "makespan")
+    deltas = {
+        k: {
+            "mean": float(np.mean([r["delta"][k] for r in per_seed])),
+            "std": float(np.std([r["delta"][k] for r in per_seed])),
+            "mean_rel": float(
+                np.mean([
+                    r["delta"][k] / max(abs(r["baseline"][k]), 1e-12)
+                    for r in per_seed
+                ])
+            ),
+        }
+        for k in keys
+    }
+    report = {
+        "trace": trace,
+        "n_hosts": args.n_hosts,
+        "n_apps": args.num_apps,
+        "replicas": args.replicas,
+        "perturb": args.perturb,
+        "threshold": args.threshold,
+        "max_holds": args.max_holds,
+        "des_seeds": args.des_seeds,
+        "delta_gated_minus_baseline": deltas,
+        "per_seed": per_seed,
+    }
+    out_dir = os.path.join(
+        args.output_dir, "sensitivity", str(int(time.time()))
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report))
     return report
 
 
@@ -1185,6 +1310,8 @@ def main(argv=None) -> None:
         run_calibrate(args)
     elif args.command == "autotune":
         run_autotune(args)
+    elif args.command == "sensitivity":
+        run_sensitivity(args)
     elif args.command == "capacity":
         run_capacity(args)
     elif args.command == "apps":

@@ -1,0 +1,351 @@
+"""Checkpoint/resume and chunked variants of the ensemble rollout."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pivot_tpu.ops.kernels import DeviceTopology
+from pivot_tpu.parallel.ensemble.bill import _finalize_batch
+from pivot_tpu.parallel.ensemble.draws import (
+    _make_fault_schedule,
+    _opportunistic_uniforms,
+    _pack_extras,
+    _perturbations,
+    _unpack_extras,
+)
+from pivot_tpu.parallel.ensemble.state import (
+    _DONE,
+    EnsembleWorkload,
+    RolloutResult,
+    RolloutState,
+    _resolve_forms,
+    _init_state,
+)
+from pivot_tpu.parallel.ensemble.tick import _rollout_segment
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tick", "policy", "congestion", "realtime_scoring", "forms",
+        "tick_order",
+    ),
+)
+def _segment_step(
+    state: RolloutState,
+    rt,  # [R, T] perturbed runtimes (constant for the run — computed once)
+    arr,  # [R, T] perturbed arrivals
+    root_anchor,  # [R, T] i32
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    segment_ticks,  # traced i32 scalar — the final partial segment must
+    faults=None,  # optional ([R, F] i32, [R, F], [R, F]) crash schedules
+    totals=None,  # [H, 4]
+    policy: str = "cost-aware",
+    task_u=None,  # [R, T] opportunistic uniforms
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    forms: str = "vector",
+    tick_order: str = "fifo",
+) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
+    """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
+    spec, extras = _pack_extras(faults, task_u)
+
+    def seg(s, r, a, ra, *ex):
+        f, u, _tot, _sp, _act = _unpack_extras(spec, ex)
+        return _rollout_segment(
+            s, r, a, ra, workload, topo, tick, segment_ticks,
+            faults=f, totals=totals, policy=policy, task_u=u,
+            congestion=congestion, realtime_scoring=realtime_scoring,
+            forms=forms, tick_order=tick_order,
+        )
+
+    return jax.vmap(seg)(state, rt, arr, root_anchor, *extras)
+
+
+def _fingerprint(
+    key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
+    storage_zones, fault_cfg=(0, None, None), policy="cost-aware",
+    congestion=False, realtime_scoring=False, tick_order="fifo",
+    forms="indexed",
+) -> str:
+    """Hash of every input that determines the rollout trajectory —
+    including array *contents*, so a checkpoint can never be resumed
+    against edited workload data that merely kept its shapes."""
+    import hashlib
+
+    # "v2": the tick body's refund select-reduce (round-2 scatter purge)
+    # sums in tree order — ULP-different from the old scatter order for
+    # multiple same-host refunds — so checkpoints written by the old body
+    # must restart, not resume into a mixed-order trajectory.
+    base = ("v2", np.asarray(key).tolist(), n_replicas, tick, max_ticks,
+            perturb)
+    if policy != "cost-aware":
+        # Appended only for non-default arms so cost-aware fingerprints
+        # within a body version are unchanged by this field's existence.
+        base = base + (policy,)
+    if fault_cfg[0]:
+        # Appended only for fault runs (same compat-within-version rule).
+        base = base + (fault_cfg,)
+    if congestion:
+        # Appended only when the backlog model is on (same compat rule).
+        base = base + ("congestion",)
+    if realtime_scoring:
+        base = base + ("realtime_scoring",)
+    if tick_order != "fifo":
+        # Batch order changes actual placements, not just ULPs — a fifo
+        # checkpoint resuming under lifo would be a mixed-order
+        # trajectory (appended only for non-default order, same
+        # compat-within-version rule as the fields above).
+        base = base + (("tick_order", tick_order),)
+    if forms != "indexed":
+        # The tick-body forms are only *empirically* bit-identical (tree
+        # vs sequential f32 pipe sums), so a vector-form checkpoint must
+        # not silently resume under the indexed forms (e.g. a TPU-written
+        # state moved to CPU, where the backend default flips).  The
+        # sentinel is the fixed value "indexed" — NOT the backend default
+        # — because a backend-relative rule would let a TPU default
+        # (vector, unappended) match a CPU default (indexed, unappended),
+        # exactly the cross-form resume being excluded.  Keying on
+        # "indexed" also keeps every historical CPU-written checkpoint
+        # (resolved indexed, unappended) resumable.
+        base = base + (("forms", forms),)
+    h = hashlib.sha256(repr(base).encode())
+    for tree in (workload, topo, (avail0, storage_zones)):
+        for arr in jax.tree_util.tree_leaves(tree):
+            a = np.ascontiguousarray(np.asarray(arr))
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def rollout_checkpointed(
+    key,
+    avail0,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    checkpoint_path: Optional[str],
+    n_replicas: int = 64,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+    segment_ticks: int = 256,
+    resume: bool = True,
+    n_faults: int = 0,
+    fault_horizon: Optional[float] = None,
+    mttr: Optional[float] = None,
+    policy: str = "cost-aware",
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    forms: Optional[str] = None,
+    tick_order: str = "fifo",
+) -> RolloutResult:
+    """:func:`rollout` with mid-flight checkpoint/resume.
+
+    The rollout runs in jitted segments of ``segment_ticks``; after each
+    segment the ``[R]``-stacked :class:`RolloutState` (pure arrays) is
+    written atomically (tmp + rename) to ``checkpoint_path`` (``.npz``).
+    The 256-tick default balances per-segment host round-trips against
+    call duration (measured at the canonical 25-app × 256-replica
+    scale: 64-tick segments cost +49 % over one monolithic call,
+    256-tick +14 %, each call ~1.4 s); callers wanting a finer
+    checkpoint cadence or shorter calls on a flaky transport pass a
+    smaller ``segment_ticks`` — results are bit-identical at any value.
+    If the process dies, rerunning with ``resume=True`` loads the last
+    state and continues — the final result is bit-identical to an
+    uninterrupted :func:`rollout` with the same arguments, because the
+    Monte-Carlo draws are a pure function of ``key`` (regenerated, not
+    stored) and segmentation does not change the tick sequence.
+
+    ``checkpoint_path=None`` runs the same segmented schedule without
+    touching disk — useful in its own right because each segment is one
+    bounded device execution (a monolithic multi-thousand-tick while_loop
+    is a minutes-long single execution, which remote-device transports
+    may kill).
+
+    A config fingerprint stored alongside the state refuses to resume a
+    checkpoint produced by different arguments.  The reference has no
+    analog: its runs are one-shot to event exhaustion
+    (``alibaba/runner.py:44``), and its process state (generator frames)
+    could not be serialized anyway.
+    """
+    import os
+
+    workload.check_group_demands()
+    forms = _resolve_forms(forms)
+
+    fp = _fingerprint(
+        key, n_replicas, tick, max_ticks, perturb, workload, topo, avail0,
+        storage_zones, fault_cfg=(n_faults, fault_horizon, mttr),
+        policy=policy, congestion=congestion,
+        realtime_scoring=realtime_scoring, tick_order=tick_order,
+        forms=forms,
+    )
+
+    ticks_done = 0
+    state = None
+    if checkpoint_path and resume and os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path, allow_pickle=False) as ckpt:
+            fields = set(RolloutState._fields)
+            if str(ckpt["fingerprint"]) == fp and fields <= set(ckpt.files):
+                # A checkpoint missing state fields (written by an older
+                # layout) is ignored rather than resumed partial — resume
+                # must be bit-identical or not happen at all.
+                state = RolloutState(
+                    **{f: jnp.asarray(ckpt[f]) for f in RolloutState._fields}
+                )
+                ticks_done = int(ckpt["ticks_done"])
+    if state is None:
+        Z = topo.cost.shape[0]
+        state = jax.vmap(
+            lambda _: _init_state(avail0, workload.n_tasks, Z)
+        )(jnp.arange(n_replicas))
+
+    # Monte-Carlo draws are a pure function of ``key`` and constant for the
+    # whole run: generated once here (and regenerated once on resume), not
+    # per segment.
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
+    faults = None
+    if n_faults:
+        faults = _make_fault_schedule(
+            key, n_replicas, n_faults, avail0, tick, max_ticks,
+            fault_horizon, mttr,
+        )
+    task_u = _opportunistic_uniforms(
+        key, n_replicas, workload.n_tasks, avail0.dtype
+    ) if policy == "opportunistic" else None
+
+    # Late-bound through the package so a test (or tool) that patches
+    # ``pivot_tpu.parallel.ensemble._segment_step`` — the historical
+    # monolith attribute — still intercepts the segment calls.  Imported
+    # lazily: the package ``__init__`` imports this module, so a
+    # module-level import the other way would be circular.
+    from pivot_tpu.parallel import ensemble as _pkg
+
+    while ticks_done < max_ticks and bool(jnp.any(state.stage != _DONE)):
+        seg = min(segment_ticks, max_ticks - ticks_done)
+        state = _pkg._segment_step(
+            state,
+            rt,
+            arr,
+            root_anchor,
+            workload,
+            topo,
+            tick=tick,
+            segment_ticks=jnp.asarray(seg, jnp.int32),
+            faults=faults,
+            totals=avail0,
+            policy=policy,
+            task_u=task_u,
+            congestion=congestion,
+            realtime_scoring=realtime_scoring,
+            forms=forms,
+            tick_order=tick_order,
+        )
+        jax.block_until_ready(state)
+        ticks_done += seg
+        if checkpoint_path:
+            tmp = checkpoint_path + ".tmp.npz"  # np.savez keeps an .npz suffix
+            np.savez(
+                tmp,
+                fingerprint=fp,
+                ticks_done=ticks_done,
+                **{f: np.asarray(v) for f, v in zip(RolloutState._fields, state)},
+            )
+            os.replace(tmp, checkpoint_path)
+
+    return _finalize_batch(state, workload, topo)
+
+
+def rollout_chunked(
+    key,
+    avail0,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    checkpoint_path: Optional[str],
+    replica_chunk: int,
+    n_replicas: int = 64,
+    segment_ticks: int = 256,
+    resume: bool = True,
+    **kw,
+) -> RolloutResult:
+    """Ensemble rollout in replica chunks of ``replica_chunk``.
+
+    Why chunk: bound the per-call working set and duration.  When the
+    tick body still carried vmapped scatters, R=1024 went superlinear
+    (scalar-memory scatter operands spilled; chunking at 512 measured
+    1.65×).  After the segment-op purge removed those scatters the
+    R-axis scales near-linearly (R=1024 ≈ 4.5× the R=256 wall) and
+    chunking is ~neutral at bench scale (2,520 vs 2,475 rollouts/s) —
+    it remains the pressure valve for replica counts beyond what HBM
+    comfortably holds, and keeps each device call short on remote
+    transports that kill long executions (RESULTS.md, round-2 scaling
+    tables before/after the purge).
+
+    Execution shape per chunk: WITHOUT a ``checkpoint_path``, each chunk
+    is one monolithic :func:`rollout` call (routing chunks through the
+    segmented executor pays per-segment host round-trips).  WITH a
+    ``checkpoint_path``, each chunk runs segmented via
+    :func:`rollout_checkpointed`, checkpointing (and resuming) at
+    ``<root>.c<c><ext>``; finished chunks resume straight to finalize.
+
+    Sample-set semantics: chunk 0 uses ``key`` verbatim — it is
+    bit-identical to ``rollout(key, n_replicas=replica_chunk)``, so the
+    replica-0 ⇔ DES anchor pairing (``_perturbations``) survives
+    chunking.  Chunk ``c > 0`` draws from ``fold_in(key, c)``.  The
+    combined set is therefore a *different* (equally i.i.d.) Monte-Carlo
+    sample than one monolithic ``n_replicas`` draw — threefry counters
+    pair by array halves, so a bitwise-prefix chunking cannot exist —
+    which is why the CLI keeps chunking opt-in (``--replica-chunk``):
+    existing seeded results stay bit-stable unless the caller asks.
+
+    Deterministic: same ``key``/config/chunking → same results.
+    ``replica_chunk <= 0`` (or ``>= n_replicas``) delegates to the
+    unchunked segmented path unchanged.
+    """
+    import os
+
+    if replica_chunk <= 0 or n_replicas <= replica_chunk:
+        return rollout_checkpointed(
+            key, avail0, workload, topo, storage_zones, checkpoint_path,
+            n_replicas=n_replicas, segment_ticks=segment_ticks,
+            resume=resume, **kw,
+        )
+    root, ext = os.path.splitext(checkpoint_path) if checkpoint_path else ("", "")
+    parts = []
+    done = 0
+    while done < n_replicas:
+        c = len(parts)
+        n = min(replica_chunk, n_replicas - done)
+        ck = key if c == 0 else jax.random.fold_in(key, c)
+        if checkpoint_path:
+            parts.append(
+                rollout_checkpointed(
+                    ck, avail0, workload, topo, storage_zones,
+                    f"{root}.c{c}{ext}", n_replicas=n,
+                    segment_ticks=segment_ticks, resume=resume, **kw,
+                )
+            )
+        else:
+            # Lazy: ``rollout`` lives in the package ``__init__``, which
+            # imports this module (see the ``_segment_step`` note above).
+            from pivot_tpu.parallel import ensemble as _pkg
+
+            parts.append(
+                _pkg.rollout(
+                    ck, avail0, workload, topo, storage_zones,
+                    n_replicas=n, **kw,
+                )
+            )
+        done += n
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)

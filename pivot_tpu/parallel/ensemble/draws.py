@@ -1,0 +1,173 @@
+"""Monte-Carlo draws and the packed-extras calling convention.
+
+Per-replica perturbations, fault schedules, opportunistic uniforms, and
+the keyed root-anchor draws shared bit-for-bit with the DES policies
+(``pivot_tpu.sched.rand``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pivot_tpu.parallel.ensemble.state import EnsembleWorkload
+
+def _fault_schedule(key, n_replicas, n_faults, n_hosts, horizon, mttr, dtype):
+    """Per-replica random crash schedules, mirroring
+    ``FaultInjector.random_host_failures``: ``n_faults`` crashes at uniform
+    times in ``[0, horizon)`` on uniformly drawn hosts, each recovering
+    after an Exp(mean=``mttr``) outage (never, if ``mttr`` is None)."""
+    k_t, k_h, k_d = jax.random.split(key, 3)
+    fail_at = jax.random.uniform(
+        k_t, (n_replicas, n_faults), minval=0.0, maxval=horizon, dtype=dtype
+    )
+    host = jax.random.randint(k_h, (n_replicas, n_faults), 0, n_hosts).astype(
+        jnp.int32
+    )
+    if mttr is None:
+        recover_at = jnp.full((n_replicas, n_faults), jnp.inf, dtype=dtype)
+    else:
+        outage = jax.random.exponential(k_d, (n_replicas, n_faults), dtype=dtype)
+        recover_at = fail_at + mttr * outage
+    return host, fail_at, recover_at
+
+
+def _make_fault_schedule(
+    key, n_replicas, n_faults, avail0, tick, max_ticks, fault_horizon, mttr
+):
+    """The one place fault draws derive from the rollout key: fold_in (not
+    split) so the fault-free path's draws — and thus every existing result
+    and checkpoint — are unchanged; shared by :func:`rollout` and
+    :func:`rollout_checkpointed` so segmented runs stay bit-identical."""
+    horizon = fault_horizon if fault_horizon is not None else tick * max_ticks
+    return _fault_schedule(
+        jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
+        avail0.shape[0], horizon, mttr, avail0.dtype,
+    )
+
+
+
+def _pack_extras(faults=None, task_u=None, totals=None, score_params=None,
+                 active=None):
+    """Flatten the optional per-replica/per-row axes for a vmap body.
+
+    Returns ``(spec, extras_list)``; ``spec`` is the static presence
+    tuple consumed by :func:`_unpack_extras` — together they are the ONE
+    place the positional bookkeeping lives, shared by :func:`rollout`,
+    :func:`_segment_step`, and the row-based sweep runner so the
+    execution paths cannot drift.  ``spec`` is hashable, so it can cross
+    a jit boundary as a static argument.
+    """
+    spec = (
+        faults is not None, task_u is not None, totals is not None,
+        score_params is not None, active is not None,
+    )
+    extras = []
+    if faults is not None:
+        extras.extend(faults)
+    for x in (task_u, totals, score_params, active):
+        if x is not None:
+            extras.append(x)
+    return spec, extras
+
+
+def _unpack_extras(spec, ex):
+    """Rebuild ``(faults, task_u, totals, score_params, active)`` from a
+    flat extras tuple, per the presence ``spec`` from :func:`_pack_extras`."""
+    has_f, has_u, has_tot, has_sp, has_act = spec
+    i = 0
+    f = u = tot = sp = act = None
+    if has_f:
+        f = (ex[0], ex[1], ex[2])
+        i = 3
+    if has_u:
+        u = ex[i]
+        i += 1
+    if has_tot:
+        tot = ex[i]
+        i += 1
+    if has_sp:
+        sp = ex[i]
+        i += 1
+    if has_act:
+        act = ex[i]
+        i += 1
+    return f, u, tot, sp, act
+
+
+def _opportunistic_uniforms(key, n_replicas, n_tasks, dtype):
+    """Base uniform per (replica, task) for the opportunistic arm; the
+    placement step rotates it by the golden ratio per tick (Weyl
+    sequence), approximating the DES's independent per-tick redraws
+    (``tick_uniforms``, policies.py:105) without materializing a
+    [ticks, T] draw tensor.  fold_in keeps the other arms' streams
+    untouched."""
+    return jax.random.uniform(
+        jax.random.fold_in(key, 0x09901), (n_replicas, n_tasks), dtype=dtype
+    )
+
+
+def _seed_bits(key):
+    """uint32 seed word of a PRNG key: for ``jax.random.PRNGKey(s)`` this
+    is exactly ``s`` (key data ``[0, s]``), which is what pairs the
+    estimator's keyed root-anchor draws with a DES run seeded ``s``."""
+    try:
+        data = jax.random.key_data(key)
+    except TypeError:  # already a raw uint32 key array
+        data = key
+    return data.reshape(-1)[-1].astype(jnp.uint32)
+
+
+def _keyed_storage_index_jax(seed_bits, app_ids, n_storage, salt):
+    """JAX twin of :func:`pivot_tpu.sched.rand.keyed_storage_index` —
+    identical uint32 math (tested bit-equal), so estimator replica 0
+    anchors exactly match the DES policies' keyed draws."""
+    A = jnp.uint32(0x9E3779B9)
+    B = jnp.uint32(0x85EBCA6B)
+    C = jnp.uint32(0xC2B2AE35)
+    x = seed_bits.astype(jnp.uint32) * A + salt.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * B + app_ids.astype(jnp.uint32) * A
+    x = x ^ (x >> 13)
+    x = x * C
+    x = x ^ (x >> 16)
+    return (x % jnp.uint32(n_storage)).astype(jnp.int32)
+
+
+def _perturbations(key, workload, storage_zones, n_replicas, perturb, dtype):
+    """Deterministic per-replica Monte-Carlo draws — regenerated (not
+    stored) on checkpoint resume, since they are a pure function of key."""
+    T = workload.n_tasks
+    # Still split in 3: threefry subkeys depend on the total split count
+    # (counters pair by halves), so dropping to split(key, 2) would
+    # silently change every rt/arr draw — breaking bit-stability with
+    # existing results and regenerated-on-resume checkpoints.  The third
+    # key (the retired jax.random anchor draw) is simply unused.
+    k_rt, k_arr, _k_retired = jax.random.split(key, 3)
+    rt = workload.runtime[None, :] * jax.random.uniform(
+        k_rt, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
+        dtype=dtype,
+    )
+    arr = workload.arrival[None, :] * jax.random.uniform(
+        k_arr, (n_replicas, T), minval=1 - perturb, maxval=1 + perturb,
+        dtype=dtype,
+    )
+    # Root anchors are shared PER APPLICATION, mirroring the DES cost-aware
+    # policy: all root task groups of one app bucket under the app and draw
+    # ONE storage anchor (``sched/policies.py`` group_tasks; ref
+    # ``scheduler/cost_aware.py:38-39``).  The draw is the entity-keyed
+    # function shared with the DES (replica salt r; r = 0 IS the DES's
+    # draw for a scheduler seeded with this key's seed word), so nominal
+    # calibration runs see identical anchors in both engines.
+    salts = jnp.arange(n_replicas, dtype=jnp.uint32)
+    anchor_idx = _keyed_storage_index_jax(
+        _seed_bits(key),
+        workload.app_of[None, :],
+        storage_zones.shape[0],
+        salts[:, None],
+    )
+    root_anchor = storage_zones[anchor_idx].astype(jnp.int32)
+    return rt, arr, root_anchor
+

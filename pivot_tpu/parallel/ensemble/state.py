@@ -1,0 +1,229 @@
+"""Ensemble state types: workload encoding, rollout state/result, forms.
+
+Split out of the round-3 monolithic ``ensemble.py`` (VERDICT r03 item 8);
+see the package ``__init__`` for the module map.  Nothing here changed in
+the split — the forms-parity and checkpoint suites pin behavior.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# check_group_demands verdict cache: (id(demands), id(group_of)) →
+# (weakref(demands), weakref(group_of)).  The invariant being cached is a
+# property of the PAIR — a ``_replace(group_of=...)`` reusing an
+# already-checked demands array must re-validate — and the weakrefs guard
+# against id reuse after garbage collection: an entry only counts if both
+# refs still point at the SAME live arrays.
+_checked_demands: dict = {}
+
+
+class EnsembleWorkload(NamedTuple):
+    """Dense, instance-level workload description (static across replicas).
+
+    Built from an :class:`pivot_tpu.workload.Application` (or several) via
+    :func:`EnsembleWorkload.from_applications`; every task-group instance
+    becomes one row.
+
+    Alongside the instance-level ``pred`` matrix (used for the [T]-vector
+    readiness matvec), the workload carries its **group structure** —
+    instances of a group share output size and predecessor groups, so
+    transfer delays, anchor votes, and egress cost all reduce *exactly*
+    to [G, Z]-sized tensors via matmuls.  Without this, those quantities
+    need per-replica [T, T] products: at T≈3.6k and 1024 replicas that is
+    a 55 GB allocation — 3× the chip's HBM.
+    """
+
+    demands: jax.Array  # [T, 4]
+    runtime: jax.Array  # [T]
+    output_size: jax.Array  # [T]
+    arrival: jax.Array  # [T] submission time of the owning app
+    pred: jax.Array  # [T, T] f32 — pred[i, p] = 1 iff p precedes i
+    group_of: jax.Array  # [T] i32 — owning group index per instance
+    group_onehot: jax.Array  # [T, G] f32 — one_hot(group_of)
+    pred_group: jax.Array  # [G, G] f32 — group-level adjacency
+    out_group: jax.Array  # [G] per-group output size (MB)
+    app_of: jax.Array  # [T] i32 — owning application index per instance
+
+    @property
+    def n_tasks(self) -> int:
+        return self.runtime.shape[0]
+
+    @property
+    def n_groups(self) -> int:
+        return self.out_group.shape[0]
+
+    def check_group_demands(self) -> None:
+        """Raise if any group's instances disagree on their demand vector.
+
+        The rollout's group-level fit collapse and in-loop demand
+        re-derivation rely on this invariant; ``from_applications``
+        guarantees it, but ``EnsembleWorkload`` is a plain NamedTuple, so
+        a ``_replace(demands=...)`` with per-instance jitter would
+        silently corrupt placements.  Called by the public rollout
+        entries on concrete (non-traced) inputs.
+
+        The [T, 4] device fetch costs a full link round-trip on a remote
+        chip (~70–80 ms on this deployment's tunnel — measured as a
+        −44 % bench-rollout regression when checked per call), so the
+        verdict is cached per live demands array: repeated rollouts over
+        one workload pay it once.
+        """
+        if isinstance(self.demands, jax.core.Tracer):
+            return  # inside jit: the constructor invariant is the contract
+        key = (id(self.demands), id(self.group_of))
+        refs = _checked_demands.get(key)
+        if (
+            refs is not None
+            and refs[0]() is self.demands
+            and refs[1]() is self.group_of
+        ):
+            return
+        dem = np.asarray(self.demands)
+        go = np.asarray(self.group_of)
+        table = np.zeros((self.n_groups, dem.shape[1]), dem.dtype)
+        table[go] = dem
+        if not np.array_equal(table[go], dem):
+            bad = np.nonzero(np.any(table[go] != dem, axis=1))[0]
+            raise ValueError(
+                "EnsembleWorkload demands vary within a group (first "
+                f"offending task rows: {bad[:5].tolist()}); the rollout's "
+                "group-level fit test requires group-constant demands — "
+                "build workloads via EnsembleWorkload.from_applications"
+            )
+        if len(_checked_demands) > 256:  # prune dead refs, bound growth
+            dead = [
+                k
+                for k, (rd, rg) in _checked_demands.items()
+                if rd() is None or rg() is None
+            ]
+            for k in dead:
+                del _checked_demands[k]
+        _checked_demands[key] = (
+            weakref.ref(self.demands),
+            weakref.ref(self.group_of),
+        )
+
+    @classmethod
+    def from_applications(cls, apps, arrivals=None, dtype=jnp.float32):
+        """Flatten applications to instance level.
+
+        Every instance of a group depends on every instance of each
+        predecessor group (the ensemble estimator's conservative stand-in
+        for the DES's sampled 1/n-instance pulls,
+        ``resources/__init__.py:263-267``).
+        """
+        demands, runtime, output, arrival = [], [], [], []
+        group_of, out_group, app_of = [], [], []
+        offset = 0
+        gi = 0
+        edges = []
+        group_edges = []
+        for ai, app in enumerate(apps):
+            at = float(arrivals[ai]) if arrivals is not None else 0.0
+            index = {}
+            for g in app.groups:
+                index[g.id] = (offset, g.instances, gi)
+                out_group.append(g.output_size)
+                for _ in range(g.instances):
+                    demands.append([g.cpus, g.mem, g.disk, g.gpus])
+                    runtime.append(g.runtime)
+                    output.append(g.output_size)
+                    arrival.append(at)
+                    group_of.append(gi)
+                    app_of.append(ai)
+                offset += g.instances
+                gi += 1
+            for g in app.groups:
+                gs, gn, gg = index[g.id]
+                for dep in g.dependencies:
+                    ps, pn, pg = index[dep]
+                    edges.append(((gs, gn), (ps, pn)))
+                    group_edges.append((gg, pg))
+        T, G = offset, gi
+        pred = np.zeros((T, T), dtype=np.float32)
+        for (gs, gn), (ps, pn) in edges:
+            pred[gs : gs + gn, ps : ps + pn] = 1.0
+        pred_group = np.zeros((G, G), dtype=np.float32)
+        for gg, pg in group_edges:
+            pred_group[gg, pg] = 1.0
+        group_of_arr = np.asarray(group_of, dtype=np.int32)
+        group_onehot = np.zeros((T, G), dtype=np.float32)
+        group_onehot[np.arange(T), group_of_arr] = 1.0
+        return cls(
+            demands=jnp.asarray(np.array(demands), dtype=dtype),
+            runtime=jnp.asarray(np.array(runtime), dtype=dtype),
+            output_size=jnp.asarray(np.array(output), dtype=dtype),
+            arrival=jnp.asarray(np.array(arrival), dtype=dtype),
+            pred=jnp.asarray(pred, dtype=dtype),
+            group_of=jnp.asarray(group_of_arr),
+            group_onehot=jnp.asarray(group_onehot, dtype=dtype),
+            pred_group=jnp.asarray(pred_group, dtype=dtype),
+            out_group=jnp.asarray(np.array(out_group), dtype=dtype),
+            app_of=jnp.asarray(np.asarray(app_of, dtype=np.int32)),
+        )
+
+
+class RolloutResult(NamedTuple):
+    makespan: jax.Array  # [R]
+    egress_cost: jax.Array  # [R]
+    finish_time: jax.Array  # [R, T]
+    placement: jax.Array  # [R, T] host index
+    n_unfinished: jax.Array  # [R] tasks still pending at the horizon
+    instance_hours: jax.Array  # [R] busy host-hours (tick-resolution)
+
+
+class RolloutState(NamedTuple):
+    """The full mutable state of one replica's rollout — pure arrays, which
+    is what makes mid-flight checkpoint/resume trivial (something the
+    reference's generator-based processes could never serialize)."""
+
+    t: jax.Array  # scalar sim time
+    stage: jax.Array  # [T] i32
+    finish: jax.Array  # [T]
+    place: jax.Array  # [T] i32
+    avail: jax.Array  # [H, 4]
+    busy: jax.Array  # scalar busy host-seconds accumulator
+    q: jax.Array  # [Z, H] queued MB per (src zone → dst host) pipe
+    qpos: jax.Array  # [T] i32 last-batch position of a still-waiting task
+    # (−1 otherwise) — the wait-queue order carry for tick_order="lifo"
+    # (the DES re-drains its wait dict in reverse insertion order every
+    # tick; see _rollout_segment).  Dead weight under "fifo".
+
+
+# Task stages.
+_PENDING, _RUNNING, _DONE = 0, 1, 2
+
+
+def _resolve_forms(forms: Optional[str]) -> str:
+    """Backend default for the tick-body op forms (see
+    :func:`_rollout_segment`): index/segment ops on the CPU backend,
+    one-hot vector forms on accelerators.  Resolved at trace time by the
+    public entries; pass ``forms`` explicitly to pin a form (the parity
+    suite runs both on one backend)."""
+    if forms is not None:
+        return forms
+    return "indexed" if jax.default_backend() == "cpu" else "vector"
+
+
+def _init_state(avail0, T, Z) -> RolloutState:
+    dtype = avail0.dtype
+    H = avail0.shape[0]
+    return RolloutState(
+        t=jnp.asarray(0.0, dtype),
+        stage=jnp.full((T,), _PENDING, dtype=jnp.int32),
+        finish=jnp.full((T,), jnp.inf, dtype=dtype),
+        place=jnp.full((T,), -1, dtype=jnp.int32),
+        avail=avail0,
+        busy=jnp.asarray(0.0, dtype),
+        q=jnp.zeros((Z, H), dtype=dtype),
+        qpos=jnp.full((T,), -1, dtype=jnp.int32),
+    )
+
+

@@ -1,0 +1,369 @@
+"""Grid sweeps: K candidates × R replicas in one device program.
+
+Score-exponent autotuning, capacity planning, and workload-size sweeps —
+each a row-structured batch over the shared tick body with paired
+Monte-Carlo draws.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pivot_tpu.ops.kernels import DeviceTopology
+from pivot_tpu.parallel.ensemble.bill import _finalize_batch
+from pivot_tpu.parallel.ensemble.draws import (
+    _fault_schedule,
+    _opportunistic_uniforms,
+    _pack_extras,
+    _perturbations,
+    _unpack_extras,
+)
+from pivot_tpu.parallel.ensemble.state import (
+    _DONE,
+    EnsembleWorkload,
+    RolloutResult,
+    _resolve_forms,
+    _init_state,
+)
+from pivot_tpu.parallel.ensemble.tick import _rollout_segment
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tick", "policy", "congestion", "realtime_scoring", "spec", "forms",
+        "tick_order",
+    ),
+)
+def _row_segment_step(
+    states,  # [B]-stacked RolloutState
+    rt,  # [B, T]
+    arr,  # [B, T]
+    ra,  # [B, T] i32
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    segment_ticks,  # traced i32 — partial segments must not recompile
+    spec,  # static (has_faults, has_task_u, has_totals, has_sp, has_active)
+    *extras,  # the present per-row arrays, in spec order
+    policy: str = "cost-aware",
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    forms: str = "vector",
+    tick_order: str = "fifo",
+):
+    """Advance every row by at most ``segment_ticks`` scheduler ticks."""
+
+    def seg(s, r, a, ra_, *ex):
+        f, u, tot, sp, act = _unpack_extras(spec, ex)
+        return _rollout_segment(
+            s, r, a, ra_, workload, topo, tick, segment_ticks,
+            faults=f, totals=tot, score_params=sp, policy=policy,
+            task_u=u, congestion=congestion,
+            realtime_scoring=realtime_scoring, active=act, forms=forms,
+            tick_order=tick_order,
+        )
+
+    return jax.vmap(seg)(states, rt, arr, ra, *extras)
+
+
+def _run_rows(
+    avail_rows,  # [B, H, 4] initial availability per row
+    rt, arr, ra,  # [B, T] perturbed inputs per row
+    workload, topo, tick, max_ticks, segment_ticks,
+    policy, congestion, realtime_scoring,
+    faults=None,  # optional ([B,F] i32, [B,F], [B,F])
+    task_u=None,  # optional [B, T]
+    totals=None,  # optional [B, H, 4] (fault recovery target)
+    score_params=None,  # optional [B, 3]
+    active=None,  # optional [B, T] bool
+    forms: Optional[str] = None,
+    tick_order: str = "fifo",
+) -> RolloutResult:
+    """Run B rows to the horizon and finalize through the shared program.
+
+    ``segment_ticks=None`` issues ONE bounded device call of ``max_ticks``
+    (the while_loop still early-exits) — fully traceable, so
+    :func:`shard_sweep` can jit over it.  An integer runs the rollout in
+    that many device calls per ``segment_ticks`` ticks with host-side
+    early exit between segments — the remote-transport-friendly mode
+    (``rollout_checkpointed``'s rationale): a monolithic multi-thousand-
+    tick program is one minutes-long execution some transports kill.
+    """
+    Z = topo.cost.shape[0]
+    spec, extras = _pack_extras(faults, task_u, totals, score_params, active)
+    forms = _resolve_forms(forms)
+
+    states = jax.vmap(lambda av: _init_state(av, workload.n_tasks, Z))(
+        avail_rows
+    )
+    if segment_ticks is None:
+        states = _row_segment_step(
+            states, rt, arr, ra, workload, topo, tick,
+            jnp.asarray(max_ticks, jnp.int32), spec, *extras,
+            policy=policy, congestion=congestion,
+            realtime_scoring=realtime_scoring, forms=forms,
+            tick_order=tick_order,
+        )
+    else:
+        ticks = 0
+        while ticks < max_ticks:
+            seg = min(segment_ticks, max_ticks - ticks)
+            states = _row_segment_step(
+                states, rt, arr, ra, workload, topo, tick,
+                jnp.asarray(seg, jnp.int32), spec, *extras,
+                policy=policy, congestion=congestion,
+                realtime_scoring=realtime_scoring, forms=forms,
+                tick_order=tick_order,
+            )
+            jax.block_until_ready(states)
+            ticks += seg
+            pending = states.stage != _DONE
+            if active is not None:
+                pending = pending & active
+            if not bool(jnp.any(pending)):
+                break
+    return _finalize_batch(states, workload, topo, active)
+
+
+def _reshape_rows(res: RolloutResult, K: int, R: int) -> RolloutResult:
+    """[B, ...] row results back to [K, R, ...]."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((K, R) + x.shape[1:]), res
+    )
+
+
+def _tile_rows(x, K):
+    """Tile a per-replica array to per-row (candidate-major: row b =
+    candidate b // R, replica b % R)."""
+    return jnp.tile(x, (K,) + (1,) * (x.ndim - 1))
+
+
+# -- policy autotuning --------------------------------------------------------
+
+
+def score_param_sweep(
+    key,
+    avail0,  # [H, 4] full host capacity
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,  # [S] i32
+    param_grid,  # [K, 3] exponents (w_cost, w_bw, w_norm) per candidate
+    n_replicas: int = 32,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+    congestion: bool = False,
+    segment_ticks: Optional[int] = None,
+    forms: Optional[str] = None,
+    tick_order: str = "fifo",
+) -> RolloutResult:
+    """On-device policy autotuning: sweep the cost-aware score exponents.
+
+    The candidate scoring function is ``cost^w_cost / (norm^w_norm ×
+    bw^w_bw)`` — ``(1, 1, 1)`` is the reference's score shape
+    (``scheduler/cost_aware.py:104-119``).  Every candidate × replica pair
+    rolls out in ONE device program (double vmap, [K, R] leading axes), so
+    a K-point scheduler-hyperparameter grid search under R Monte-Carlo
+    scenarios costs one dispatch — the reference would need K × R full OS
+    processes.  All candidates share the same perturbation/anchor draws,
+    so candidate comparisons are paired (common random numbers: the
+    between-candidate variance excludes scenario noise).
+
+    Pick a winner downstream, e.g.
+    ``param_grid[jnp.argmin(res.makespan.mean(axis=1))]`` or any
+    makespan/egress trade-off.
+    """
+    grid = jnp.asarray(param_grid, avail0.dtype)
+    K, R = grid.shape[0], n_replicas
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
+    res = _run_rows(
+        jnp.broadcast_to(avail0, (K * R,) + avail0.shape),
+        _tile_rows(rt, K), _tile_rows(arr, K), _tile_rows(root_anchor, K),
+        workload, topo, tick, max_ticks, segment_ticks,
+        policy="cost-aware", congestion=congestion, realtime_scoring=False,
+        score_params=jnp.repeat(grid, R, axis=0), forms=forms,
+        tick_order=tick_order,
+    )
+    return _reshape_rows(res, K, R)
+
+
+# -- capacity planning --------------------------------------------------------
+
+
+def capacity_grid(avail0, host_counts) -> jax.Array:
+    """[K, H, 4] candidate capacity matrices: candidate k keeps the first
+    ``host_counts[k]`` hosts and masks the rest with the −1 down-host
+    sentinel (no fit can select them; they never accrue busy time).
+
+    Keeping a prefix preserves the generator's round-robin zone balance
+    (``infra/gen.py``), so every candidate is a smaller but equally
+    balanced cluster.
+    """
+    H = avail0.shape[0]
+    counts = jnp.asarray(host_counts, jnp.int32)
+    keep = jnp.arange(H)[None, :] < counts[:, None]  # [K, H]
+    return jnp.where(
+        keep[:, :, None], avail0[None, :, :], jnp.asarray(-1.0, avail0.dtype)
+    )
+
+
+def capacity_sweep(
+    key,
+    avail_grid,  # [K, H, 4] candidate capacity matrices (capacity_grid)
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    n_replicas: int = 32,
+    tick: float = 5.0,
+    max_ticks: int = 512,
+    perturb: float = 0.1,
+    policy: str = "cost-aware",
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    n_faults: int = 0,
+    fault_horizon: Optional[float] = None,
+    mttr: Optional[float] = None,
+    segment_ticks: Optional[int] = None,
+    forms: Optional[str] = None,
+    tick_order: str = "fifo",
+) -> RolloutResult:
+    """On-device capacity planning: how does the workload behave on K
+    candidate cluster sizes?  Every candidate × replica pair rolls out in
+    ONE device program ([K, R] leading axes) with shared Monte-Carlo
+    draws, so candidate comparisons are paired — "how many hosts do I
+    need?" costs one dispatch where the reference needs a full OS-process
+    experiment per cluster size (``alibaba/sim.py:168-196`` regenerates
+    the cluster and re-forks per configuration).
+
+    With ``n_faults > 0`` each replica draws an independent random
+    host-crash schedule (shared across candidates — paired scenarios):
+    resilience-aware sizing, "how many hosts do I need *given* N crashes".
+    Crash hosts are drawn over the LARGEST candidate's host range (the
+    union of all candidates — drawing over the full base cluster would
+    silently dilute the fault count whenever the base is bigger than
+    every candidate); a crash landing on a host a smaller candidate
+    masked out is a no-op there, while the same crash hits the larger
+    candidates — the SAME physical failure trace applied to each
+    provisioning choice.
+
+    Downstream, combine ``instance_hours × hourly_rate + egress_cost``
+    for the cost/makespan trade-off (the reference's financial-cost
+    analysis, ``alibaba/sim.py:132-165``); candidates with
+    ``n_unfinished > 0`` are undersized for the horizon.
+    """
+    K, R = avail_grid.shape[0], n_replicas
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail_grid.dtype
+    )
+    task_u = _opportunistic_uniforms(
+        key, n_replicas, workload.n_tasks, avail_grid.dtype
+    ) if policy == "opportunistic" else None
+    faults = None
+    if n_faults:
+        # Hosts alive in ANY candidate — the union of all candidates'
+        # ranges.  jax.random.randint accepts a traced bound, so no
+        # static host count is needed.
+        alive = jnp.any(avail_grid[:, :, 0] >= 0, axis=0)  # [H]
+        n_alive = jnp.sum(alive)
+        horizon = (
+            fault_horizon if fault_horizon is not None else tick * max_ticks
+        )
+        host_rank, fail_at, recover_at = _fault_schedule(
+            jax.random.fold_in(key, 0x0FA17), n_replicas, n_faults,
+            n_alive, horizon, mttr, avail_grid.dtype,
+        )
+        # The draw is a *rank* in [0, n_alive); map it to the actual host
+        # index so crashes land on alive hosts for ANY candidate grid.
+        # For capacity_grid's prefix-shaped grids this is the identity
+        # (bit-stable with the pre-mapping draws); for a caller-supplied
+        # non-prefix grid it fixes crashes silently hitting masked hosts
+        # and missing alive ones.
+        host = jnp.searchsorted(
+            jnp.cumsum(alive.astype(jnp.int32)), host_rank + 1
+        ).astype(jnp.int32)
+        faults = (host, fail_at, recover_at)
+    avail_rows = jnp.repeat(avail_grid, R, axis=0)  # [B, H, 4]
+    res = _run_rows(
+        avail_rows,
+        _tile_rows(rt, K), _tile_rows(arr, K), _tile_rows(root_anchor, K),
+        workload, topo, tick, max_ticks, segment_ticks,
+        policy=policy, congestion=congestion,
+        realtime_scoring=realtime_scoring,
+        faults=(
+            tuple(_tile_rows(f, K) for f in faults)
+            if faults is not None else None
+        ),
+        task_u=_tile_rows(task_u, K) if task_u is not None else None,
+        totals=avail_rows if faults is not None else None,
+        forms=forms, tick_order=tick_order,
+    )
+    return _reshape_rows(res, K, R)
+
+
+def workload_sweep(
+    key,
+    avail0,  # [H, 4]
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    storage_zones,
+    app_counts,  # [K] i32 — candidate k runs the first app_counts[k] apps
+    n_replicas: int = 32,
+    tick: float = 5.0,
+    max_ticks: int = 2048,
+    perturb: float = 0.1,
+    policy: str = "cost-aware",
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    segment_ticks: Optional[int] = None,
+    forms: Optional[str] = None,
+    tick_order: str = "fifo",
+) -> RolloutResult:
+    """On-device workload-size sweep: how do cost and makespan scale with
+    the number of applications?  Candidate k activates the first
+    ``app_counts[k]`` apps (later apps' tasks get arrival = ∞ and are
+    excluded from the unfinished count); every candidate × replica pair
+    rolls out in ONE device program with shared Monte-Carlo draws, so the
+    cost-vs-#apps curve (the reference's ``num-apps`` experiment,
+    ``alibaba/sim.py:199-230``) comes from one dispatch per policy arm
+    instead of one OS process per (arm, count, trace).
+
+    ``workload`` must carry the FULL app set; since DAG edges never cross
+    applications, masked tasks can neither gate readiness nor bill
+    egress.
+    """
+    counts = jnp.asarray(app_counts, jnp.int32)
+    K, R = counts.shape[0], n_replicas
+    rt, arr, root_anchor = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
+    task_u = _opportunistic_uniforms(
+        key, n_replicas, workload.n_tasks, avail0.dtype
+    ) if policy == "opportunistic" else None
+    act = workload.app_of[None, :] < counts[:, None]  # [K, T]
+    act_rows = jnp.repeat(act, R, axis=0)  # [B, T]
+    arr_rows = jnp.where(
+        act_rows, _tile_rows(arr, K), jnp.asarray(jnp.inf, avail0.dtype)
+    )
+    res = _run_rows(
+        jnp.broadcast_to(avail0, (K * R,) + avail0.shape),
+        _tile_rows(rt, K), arr_rows, _tile_rows(root_anchor, K),
+        workload, topo, tick, max_ticks, segment_ticks,
+        policy=policy, congestion=congestion,
+        realtime_scoring=realtime_scoring,
+        task_u=_tile_rows(task_u, K) if task_u is not None else None,
+        active=act_rows,
+        forms=forms, tick_order=tick_order,
+    )
+    return _reshape_rows(res, K, R)
+
+
+# -- checkpoint / resume -----------------------------------------------------
+

@@ -1,0 +1,941 @@
+"""The tick body: one jitted segment of the ensemble rollout.
+
+``_rollout_segment`` is the whole estimator — readiness, batch ordering,
+anchor voting, placement, transfer/congestion timing, busy integral — as
+one ``lax.while_loop`` over ticks.  See the package ``__init__`` for the
+execution model and the vector/indexed forms contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pivot_tpu.ops.kernels import DeviceTopology, cost_aware_kernel
+from pivot_tpu.parallel.ensemble.bill import _sampling_table
+from pivot_tpu.parallel.ensemble.state import (
+    _DONE,
+    _PENDING,
+    _RUNNING,
+    EnsembleWorkload,
+    RolloutState,
+)
+
+def _rollout_segment(
+    state: RolloutState,
+    runtime,  # [T] perturbed
+    arrival,  # [T] perturbed
+    root_anchor,  # [T] i32 random storage zone per task (used for roots)
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    n_ticks: int,
+    faults=None,  # optional ([F] i32 host, [F] fail_at, [F] recover_at)
+    totals=None,  # [H, 4] full capacity (fault recovery resets to this)
+    score_params=None,  # optional [3] exponents (w_cost, w_bw, w_norm)
+    policy: str = "cost-aware",  # | first-fit | best-fit | opportunistic
+    task_u=None,  # [T] uniforms (opportunistic draws, one per task)
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    active=None,  # optional [T] bool: early-exit ignores inactive tasks
+    forms: str = "vector",  # | "indexed" — tick-body op forms, see below
+    tick_order: str = "fifo",  # | "lifo" — within-tick batch order, see below
+) -> RolloutState:
+    """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
+    (stops early once every task is done).
+
+    ``forms`` selects between two implementations of the tick-body's
+    reduction/selection ops — same math, backend-matched lowering
+    (VERDICT r02 item 3):
+
+      * ``"vector"`` (the TPU form): one-hot select-reduces, membership-
+        mask masked reductions, and HIGHEST-precision one-hot matmuls.
+        Under vmap these stay on the VPU/MXU; the index-based forms they
+        replace lower to batched scatter/gathers whose per-replica index
+        vectors land in TPU scalar memory and serialize on the scalar
+        core (~1 ms/tick each — the round-2 "scalar-core lesson",
+        docs/ARCHITECTURE.md).
+      * ``"indexed"`` (the CPU form): plain ``segment_sum``/``segment_max``
+        /``segment_min`` and gather/scatter indexing.  On CPU these are
+        O(T) loops, where the vector forms are O(T·H)/O(T·G) dense
+        sweeps — measured 5× end-to-end on the bench rollout metric
+        (round-2's TPU-first rewrite regressed the CPU fallback 47 → 9
+        rollouts/s; this restores the indexed forms there).
+
+    Public entries resolve ``forms=None`` to the backend default
+    (``indexed`` on cpu, ``vector`` elsewhere).  The two forms are held
+    bit-identical on every rollout output by
+    ``tests/test_ensemble.py::test_tick_body_forms_bit_identical``.
+
+    With ``faults``, each tick applies the crash/recovery schedule at tick
+    resolution, mirroring the DES fault semantics (``infra.faults`` +
+    ``FastExecutor.abort_host``): a crash in the window aborts the host's
+    running tasks back to PENDING with no capacity refund (they re-enter
+    the placement pass like the DES retry loop), a down host's rows carry
+    the −1 sentinel so no fit can select it, and recovery restores full
+    capacity.  Completions in the same tick window as the crash retire
+    first — the tick-resolution analog of the DES completion-wins tie.
+
+    With ``congestion``, transfer delays account for link contention via
+    the per-replica ``state.q`` backlog tensor (see the placement step for
+    the exact pipe model); without it ``q`` is carried untouched, so the
+    flag cannot perturb the default path.
+
+    With ``realtime_scoring`` (requires ``congestion``), the cost-aware
+    score's inbound-bandwidth term is discounted by the tick-start pipe
+    backlog — ``bw_in / (queued_mb + 1)``, the estimator analog of the
+    DES ``realtime_bw`` arm (``Route.realtime_bw``, ref
+    ``resources/network.py:70-73``): placement actively steers AROUND
+    congested links instead of merely paying for them.
+    """
+    if realtime_scoring and not congestion:
+        raise ValueError("realtime_scoring needs congestion=True (the "
+                         "backlog state is the bandwidth signal)")
+    if realtime_scoring and policy != "cost-aware":
+        raise ValueError("realtime_scoring applies to the cost-aware arm "
+                         "only — no other policy scores on bandwidth")
+    if realtime_scoring and score_params is not None:
+        raise ValueError("realtime_scoring and parameterized score "
+                         "exponents are mutually exclusive")
+    if forms not in ("vector", "indexed"):
+        raise ValueError(f"forms must be 'vector' or 'indexed', got {forms!r}")
+    if tick_order not in ("fifo", "lifo"):
+        raise ValueError(
+            f"tick_order must be 'fifo' or 'lifo', got {tick_order!r}"
+        )
+    vector = forms == "vector"
+    # Within-tick batch order (round-3 bias diagnosis, VERDICT r02
+    # item 4): the reference drains its ready/wait dicts with
+    # ``popitem()`` — LIFO (``scheduler/__init__.py:93-94,187``) — so the
+    # DES's within-tick batch runs DESCENDING task index, while the
+    # estimator historically placed ascending ("fifo").  On uniform
+    # clusters every best-fit score ties, so the order permutes which
+    # app's instances land on which host from the very first wave —
+    # measured as the packing arms' consistent-sign egress bias
+    # (best-fit +54% mean across clusters).  "lifo" mirrors the DES:
+    # fresh cohorts descending, first-fit norm ties descending, and
+    # cost-aware buckets first-seen over the descending batch.
+    lifo = tick_order == "lifo"
+    T = workload.n_tasks
+    H = state.avail.shape[0]
+    Z = topo.cost.shape[0]
+    dtype = state.avail.dtype
+    has_pred = jnp.sum(workload.pred, axis=1) > 0  # [T]
+    if faults is not None:
+        fault_host, fail_at, recover_at = faults
+        fault_idx = jnp.where(fault_host >= 0, fault_host, H)  # pad → drop
+
+        if vector:
+
+            def _scatter_hosts(hit):  # [F] bool mask -> [H] bool host mask
+                # One-hot any-reduce, not ``.at[fault_idx].max``: under
+                # vmap the scatter's per-replica index vector lands in
+                # scalar memory and serializes on the scalar core (three
+                # calls per tick in fault ensembles — see
+                # ARCHITECTURE.md, "the scalar-core lesson").  Padded
+                # entries (idx == H) hit no host, exactly like the old
+                # scatter-then-slice.
+                return jnp.any(
+                    (fault_idx[:, None] == jnp.arange(H)[None, :])
+                    & hit[:, None],
+                    axis=0,
+                )
+
+        else:
+
+            def _scatter_hosts(hit):  # [F] bool mask -> [H] bool host mask
+                # Boolean scatter (exact): misses and padded entries
+                # write the sacrificial H row, sliced off.
+                idx = jnp.where(hit, fault_idx, H)
+                return jnp.zeros((H + 1,), bool).at[idx].set(True)[:H]
+    # [Z, H] round-trip score tables (pure topology — hoisted out of ticks).
+    cost_rt = topo.cost[:, topo.host_zone] + topo.cost[topo.host_zone, :].T
+    bw_rt = topo.bw[:, topo.host_zone] + topo.bw[topo.host_zone, :].T
+    # Static within-tick task order (see the placement step).
+    if policy in ("first-fit", "cost-aware"):
+        dem_norms = jnp.sqrt(jnp.sum(workload.demands**2, axis=1))
+        task_order = jnp.argsort(-dem_norms, stable=True)
+    else:
+        task_order = jnp.arange(T)
+    task_rank = jnp.argsort(task_order)  # static inverse permutation
+    if congestion:
+        # Pipe tables for the backlog model: bandwidth of the (src zone →
+        # dst host) aggregate and its reciprocal, plus per-group instance
+        # counts (the DES pulls a ~1/n_instances sample of predecessor
+        # instances per consumer, ``resources/__init__.py:263-267`` — pull
+        # volumes are scaled by the same fraction).
+        bw_zh = topo.bw[:, topo.host_zone]  # [Z, H]
+        inv_bw_zh = jnp.where(bw_zh > 0, 1.0 / bw_zh, 0.0)
+        # Static pull-volume table: pull_frac[c, g] is a consumer
+        # instance's pulled MB from group g per done g-instance, so this
+        # tick's zone-resolved volume is just ``pull_frac @ zc``.
+        inst, samp = _sampling_table(workload)
+        pull_frac = (
+            workload.pred_group * samp * (workload.out_group / inst)[None, :]
+        )  # [G, G] consumer × producer
+    if score_params is not None:
+        # Parameterized scoring for on-device policy autotuning: exponents
+        # (1, 1, 1) recover the reference score shape (modulo
+        # pow-vs-identity float paths — the unparameterized branch in
+        # place_body stays THE bit-exact default program).  The cost/bw
+        # pow tables are pure (topology × params) — hoisted like
+        # cost_rt/bw_rt; only norm ** w_norm depends on loop state.
+        w_norm = score_params[2]
+        cost_pow = cost_rt ** score_params[0]
+        bw_pow = bw_rt ** score_params[1]
+    inf = jnp.asarray(jnp.inf, dtype)
+    G = workload.pred_group.shape[0]
+    # Static one-hot expansion tables, hoisted out of the tick loop.
+    # They replace per-tick [R, T] gathers (group→task and host→zone
+    # expansions), which lower to scalar-memory gathers inside the
+    # vmapped while loop — serialized on the scalar core, measured as
+    # the dominant per-tick cost.  Select-reduces over them are exact:
+    # each row has exactly one hit, and adding zeros is IEEE-exact.
+    g_oh = workload.group_of[:, None] == jnp.arange(G)[None, :]  # [T, G]
+    zone_onehot = (
+        topo.host_zone[:, None] == jnp.arange(Z)[None, :]
+    ).astype(dtype)  # [H, Z] — integer counts matmul (bf16-exact < 256)
+    # [G, 4] per-group demand table: instances of a group share one
+    # demand vector by construction (``from_applications`` appends the
+    # group row per instance; no other constructor exists), so the
+    # per-tick fit test collapses exactly to group level — T/G ≈ 12×
+    # less compare-reduce work at the canonical scale, measured as the
+    # largest single tick-body op.  Static scatter (shared indices).
+    dem_group = jnp.zeros((G, 4), dtype).at[workload.group_of].set(
+        workload.demands
+    )
+
+    def cond(carry):
+        i, state = carry
+        pending = state.stage != _DONE
+        if active is not None:
+            # Masked-out tasks (workload-size sweeps) stay PENDING forever
+            # with arrival = inf; they must not keep the loop alive.
+            pending = pending & active
+        return (i < n_ticks) & jnp.any(pending)
+
+    def body(carry):
+        i, (t, stage, finish, place, avail, busy, q, qpos) = carry
+
+        # 1. Retire finished tasks and refund their resources.
+        #    Select-reduce over a [T, H] membership mask, NOT a
+        #    segment_sum: under vmap the segment form lowers to a
+        #    scatter-add whose [R, T] index vector lives in scalar
+        #    memory — profiled at ~1 ms/tick serialized on the scalar
+        #    core, 28% of the whole rollout (the same class the
+        #    placement-loop rewrite eliminated; ARCHITECTURE.md, "the
+        #    scalar-core lesson").  A one-hot MATMUL would be faster
+        #    still but is not exact for real-valued f32 demands (MXU
+        #    truncates operands to bf16); the select-reduce stays on the
+        #    VPU with full f32 adds.  Summation is XLA's tree order
+        #    rather than the scatter's index order — refunds of several
+        #    tasks on one host can differ by ULPs from the old path
+        #    (both deterministic; the DES is the semantic referee and
+        #    sums per-event anyway).
+        newly_done = (stage == _RUNNING) & (finish <= t)
+        if vector:
+            # ONE [T, H] placement one-hot shared by the refund sum and
+            # the done-count einsum (their masks differ only in the stage
+            # predicate ANDed on; fault aborts between them only touch
+            # RUNNING rows, which the done predicate excludes).  The busy
+            # max below rebuilds it because placements land in ``place``
+            # first.  Unplaced rows carry the -1 sentinel and match no
+            # host column.
+            place_oh = place[:, None] == jnp.arange(H)[None, :]
+            refund_per_host = jnp.sum(
+                jnp.where(
+                    (place_oh & newly_done[:, None])[:, :, None],
+                    workload.demands[:, None, :],
+                    jnp.zeros((), dtype),
+                ),
+                axis=0,
+            )  # [H, 4]
+        else:
+            # Scatter-add over the retiring tasks' placements (misses →
+            # the sacrificial H row).  Same sum, different accumulation
+            # order than the tree reduce above — held bit-identical on
+            # every rollout output by the forms parity suite.
+            refund_per_host = jax.ops.segment_sum(
+                jnp.where(
+                    newly_done[:, None], workload.demands,
+                    jnp.zeros((), dtype),
+                ),
+                jnp.where(newly_done, place, H),
+                num_segments=H + 1,
+            )[:H]  # [H, 4]
+        avail = avail + refund_per_host
+        stage = jnp.where(newly_done, _DONE, stage)
+
+        # 1b. Faults: crashes strike after this window's completions
+        #     retire (completion-wins tie at tick resolution).
+        if faults is not None:
+            struck = _scatter_hosts((fail_at > t - tick) & (fail_at <= t))
+            down = _scatter_hosts((fail_at <= t) & (t < recover_at))
+            prev_down = _scatter_hosts(
+                (fail_at <= t - tick) & (t - tick < recover_at)
+            )
+            aborted = (
+                (stage == _RUNNING)
+                & (place >= 0)
+                & struck[jnp.clip(place, 0, H - 1)]
+            )
+            stage = jnp.where(aborted, _PENDING, stage)
+            place = jnp.where(aborted, -1, place)
+            finish = jnp.where(aborted, inf, finish)
+            # Recovery hands back a fresh machine (DES Host.recover);
+            # covers both outages ending this window and sub-tick ones.
+            recovered = (prev_down | struck) & ~down
+            avail = jnp.where(recovered[:, None], totals, avail)
+            # Down rows carry the −1 sentinel (no refund for lost work —
+            # reapplied every tick so stray refunds cannot resurrect one).
+            avail = jnp.where(down[:, None], jnp.asarray(-1.0, dtype), avail)
+            if congestion:
+                # A crash cancels the host's pending inbound staging
+                # (FastExecutor.abort_host cancels queued transfers).
+                q = jnp.where(struck[None, :], jnp.asarray(0.0, dtype), q)
+
+        # 2. Readiness: the DES dispatch pipeline at tick resolution
+        #    (measured on the live scheduler, tests/test_sched.py):
+        #      * roots enter the global submit queue at submission time
+        #        and dispatch at the first global tick STRICTLY after it
+        #        (the t=0 tick precedes the local pump);
+        #      * a successor's readiness event is its last predecessor
+        #        instance's finish τ; the app-local pump (period = tick,
+        #        phase = the app's submission time) picks it up at the
+        #        first boundary STRICTLY after τ (a boundary coinciding
+        #        with τ fires before the completion notification lands),
+        #        and the global tick dispatches STRICTLY after the pump.
+        #    Round 1 dispatched successors at the first tick ≥ τ — one to
+        #    two ticks early — which shifted tick-batch composition off
+        #    the DES's at capacity boundaries and was a dominant source
+        #    of packing-arm placement divergence.
+        done_f = (stage == _DONE).astype(dtype)
+        unfinished_preds = workload.pred @ (1.0 - done_f)  # [T]
+        fin_done = jnp.where(stage == _DONE, finish, -inf)
+        gf = jax.ops.segment_max(
+            fin_done, workload.group_of, num_segments=G
+        )  # [G] latest finish among a group's done instances
+        tau_g = jnp.max(
+            jnp.where(workload.pred_group > 0, gf[None, :], -inf), axis=1
+        )  # [G] readiness event time (−inf for root groups)
+        if vector:
+            tau = jnp.sum(
+                jnp.where(g_oh, tau_g[None, :], jnp.zeros((), dtype)), axis=1
+            )  # [T] — select-reduce, not the [R, T] gather (scalar core)
+        else:
+            tau = tau_g[workload.group_of]  # [T] gather (exact selection)
+        pump = arrival + (jnp.floor((tau - arrival) / tick) + 1.0) * tick
+        ready_time = jnp.where(has_pred, pump, arrival)
+        ready = (
+            (stage == _PENDING) & (ready_time < t) & (unfinished_preds == 0)
+        )
+
+        # 2b. Batch rank (tick_order="lifo"): each ready task's position
+        #     in the DES's ready batch this tick.  The reference drains
+        #     its wait dict first, in REVERSE insertion order (popitem),
+        #     and insertion order was last tick's schedule-RETURN order
+        #     (batch order for the batch-order arms, the decreasing sort
+        #     for VBP first-fit — see the ``qpos`` write below) — so the
+        #     wait cohort runs in reverse of its previous positions
+        #     (``qpos`` carry).  Fresh tasks follow, ordered by pump
+        #     event time, then app creation order, then the local
+        #     scheduler's LIFO stack pop (descending task index).  Two
+        #     [T] sorts per tick: one to order, one to invert (no
+        #     scatter on the vector path).
+        iota_t = jnp.arange(T, dtype=jnp.int32)
+        if lifo:
+            # Three keys, not six: the wait/fresh/non-ready cohorts and
+            # the wait cohort's reverse re-drain fold into ONE i32 key
+            # (waits carry −qpos ≤ 0, fresh 1, non-ready 2 — integer
+            # selection, order identical to the unfolded keys), and the
+            # fresh cohort's (app creation order, LIFO stack pop) pair
+            # is the STATIC key app·T + (T−1−index); only pump time
+            # stays its own key.
+            wait_c = (qpos >= 0) & ready
+            k1 = jnp.where(
+                ready, jnp.where(wait_c, -qpos, 1), jnp.asarray(2, jnp.int32)
+            )
+            if T <= 46340:  # app·T + T ≤ T² + T < 2³¹ (app_of < n_apps ≤ T)
+                fresh_static = (
+                    workload.app_of.astype(jnp.int32) * T + (T - 1 - iota_t)
+                )
+                keys = (k1, ready_time, fresh_static, iota_t)
+                nk = 3
+            else:  # unreachable with a [T, T] pred matrix in HBM; exact
+                keys = (
+                    k1, ready_time, workload.app_of.astype(jnp.int32),
+                    -iota_t, iota_t,
+                )
+                nk = 4
+            border = lax.sort(keys, num_keys=nk)[
+                len(keys) - 1
+            ]  # [T] batch order (task index at each position)
+            if vector:
+                brank = lax.sort((border, iota_t), num_keys=1)[1]
+            else:
+                brank = jnp.zeros((T,), jnp.int32).at[border].set(iota_t)
+        else:
+            brank = iota_t  # legacy: batch order = task index order
+
+        # 3. Anchors: majority vote over predecessor placement hosts
+        #    (ref cost_aware.py:45-58); roots use their pre-drawn keyed
+        #    storage zone.  Group-wise: zc[g, z] counts group g's done
+        #    instances in zone z, and summing counts over predecessor
+        #    groups gives exactly the instance-level vote counts without
+        #    any per-replica [T, T] product.  (zc also feeds the
+        #    transfer estimate, so it is computed for every policy; the
+        #    vote itself only matters to cost-aware.)
+        done_mask = stage == _DONE
+        if vector:
+            # Done-instance counts per (group, host) as ONE bf16 one-hot
+            # contraction over tasks: hv[g, h] = Σ_t 1[group_of[t]=g] ·
+            # 1[place[t]=h, done].  The segment-sum form below lowers
+            # (under vmap) to a scatter-add with a per-replica [R, T]
+            # scalar-memory index vector — profiled at ~1 ms/tick
+            # serialized on the scalar core, 22% of the whole rollout.
+            # The matmul form is integer-EXACT: one-hot factors are 0/1
+            # (exact in bf16), counts ≤ max instances < 256, and the MXU
+            # accumulates in f32 — same argument as ``hv @ zone_onehot``
+            # below.  (The former [R, T] ``host_zone[place]`` gather was
+            # removed by the round-2 rewrite for the same reason.)
+            place_done_oh = place_oh & done_mask[:, None]  # [T, H]
+            hv = jnp.einsum(
+                "tg,th->gh",
+                g_oh.astype(jnp.bfloat16),
+                place_done_oh.astype(jnp.bfloat16),
+                preferred_element_type=dtype,
+            )  # [G, H] done counts per host
+        else:
+            # Flattened (group × host) scatter-add of ones — integer
+            # counts, exact in any accumulation order.
+            flat = workload.group_of * (H + 1) + jnp.where(
+                done_mask, place, H
+            )
+            hv = jax.ops.segment_sum(
+                jnp.where(done_mask, jnp.ones((T,), dtype),
+                          jnp.zeros((), dtype)),
+                flat,
+                num_segments=G * (H + 1),
+            ).reshape(G, H + 1)[:, :H]  # [G, H] done counts per host
+        zc = hv @ zone_onehot  # [G, Z]
+        if policy == "cost-aware":
+            # The DES/reference vote is per HOST, not per zone (Counter
+            # over predecessor task *placements*, cost_aware.py:52-55):
+            # the anchor is the single most-loaded host's zone.  A
+            # zone-level vote (round 1) aggregates same-zone hosts and
+            # can crown a different zone whenever an app's instances
+            # spread across several hosts of one zone — measured as a
+            # successor-anchor drift between the engines.  Ties resolve
+            # to the lowest host index — an approximation of the DES's
+            # first-seen insertion order (exact only while host score
+            # order is static over the vote window; a vectorized
+            # first-seen tie-break would need per-instance placement
+            # timestamps).
+            votes_h = workload.pred_group @ hv  # [G, H] pred-instance votes
+            majority_host = jnp.argmax(votes_h, axis=1)  # [G]
+            if vector:
+                # Zone of each group's majority host, then group → task
+                # expansion — both as integer select-reduces on the VPU
+                # (the ``host_zone[majority_host][group_of]`` double
+                # gather runs on the scalar core under vmap; sums of one
+                # non-zero int are exact).
+                mh_oh = jnp.arange(H)[None, :] == majority_host[:, None]
+                mz_g = jnp.sum(
+                    jnp.where(mh_oh, topo.host_zone[None, :], 0), axis=1
+                )  # [G]
+                majority_zone = jnp.sum(
+                    jnp.where(g_oh, mz_g[None, :], 0), axis=1
+                )  # [T]
+            else:
+                majority_zone = topo.host_zone[majority_host][
+                    workload.group_of
+                ]  # [T] double gather (exact selection)
+            anchor = jnp.where(has_pred, majority_zone, root_anchor)
+        else:
+            anchor = root_anchor  # unused by the other arms
+
+        # 4. Placement — same greedy cost-aware decision as the live
+        #    scheduler's fused kernel (first-fit, sorted hosts, per-task
+        #    score group), but the sequential chain is cut to the tasks
+        #    that can actually place this tick:
+        #      * availability only DECREASES within a tick (releases land
+        #        at tick boundaries), so a ready task with no strictly
+        #        fitting host at tick start can never place this tick —
+        #        it is excluded from the chain with placement −1, exactly
+        #        what its in-chain step would produce.  This is what keeps
+        #        saturated phases cheap, where thousands of tasks wait but
+        #        only a handful can land.
+        #      * the eligible tasks are compacted to the front (stable, so
+        #        index order — and therefore every placement — is
+        #        bit-identical to the full scan) and a bounded while_loop
+        #        runs max-over-replicas(n_eligible) steps instead of T.
+        strict = policy in ("cost-aware", "best-fit")  # ref :124 / vbp :45
+        # Group-level fit test (exact — see ``dem_group``), expanded per
+        # task by a shared-index gather (constant across replicas, so it
+        # lowers cheap, not to a batched scalar-memory gather).
+        if strict:
+            fits_g = jnp.all(
+                avail[None, :, :] > dem_group[:, None, :], axis=2
+            )  # [G, H]
+        else:
+            fits_g = jnp.all(
+                avail[None, :, :] >= dem_group[:, None, :], axis=2
+            )
+        fits_at_start = jnp.any(fits_g, axis=1)[workload.group_of]  # [T]
+        eligible = ready & fits_at_start
+        # Within-tick order mirrors the canonical DES arms.  Cost-aware
+        # processes anchor *buckets* group-major (the DES groups the
+        # batch by anchor — Storage node for successors, the Application
+        # for roots — and places one bucket at a time), with tasks inside
+        # a bucket demand-norm-decreasing (sort_tasks).  VBP first-fit
+        # runs one global decreasing sort; best-fit/opportunistic place
+        # in batch order.
+        if policy == "cost-aware":
+            # Bucket code: successor groups merge by anchor zone
+            # (Storage identity), root groups stay per-app (Application
+            # identity) — Z + app_of keeps the two key spaces disjoint.
+            bucket = jnp.where(
+                has_pred, anchor, Z + workload.app_of.astype(jnp.int32)
+            )
+            # Bucket order keys on the min READY index — the DES buckets
+            # first-seen over the full ready batch, including tasks with
+            # no fitting host (they still pin their bucket's position).
+            # Computed as [T, B] one-hot min/select-reduces on the VPU
+            # (the former segment_min + ``first_in_bucket[bucket]`` pair
+            # both lowered to scalar-memory scatter/gather inside the
+            # loop).  B = Z + G bounds the bucket key space statically:
+            # successor buckets are zones (< Z) and root buckets are
+            # Z + app index, with #apps ≤ G (every app owns ≥ 1 group) —
+            # linear in T, unlike a [T, T] same-bucket compare, which is
+            # 13M cells/replica at the calibrate scale (T≈3.6k).
+            B = Z + G
+            # Bucket rank = first-seen position in the DES's ready batch
+            # (``brank``: task index order under "fifo", the emulated
+            # LIFO queue order under "lifo").
+            ready_idx = jnp.where(ready, brank, T).astype(jnp.int32)
+            if vector:
+                b_oh = bucket[:, None] == jnp.arange(B)[None, :]  # [T, B]
+                fib = jnp.min(
+                    jnp.where(b_oh, ready_idx[:, None], T), axis=0
+                )  # [B] first ready position per bucket
+                bfirst = jnp.sum(
+                    jnp.where(b_oh, fib[None, :], 0), axis=1
+                ).astype(jnp.int32)
+            else:
+                # Integer min-scatter + gather (exact; empty buckets fill
+                # INT_MAX vs the vector form's T, but bfirst only reads a
+                # task's OWN bucket, which contains it).
+                fib = jax.ops.segment_min(
+                    ready_idx, bucket, num_segments=B
+                )  # [B]
+                bfirst = fib[bucket]  # [T]
+            key3 = -dem_norms  # norm-decreasing inside a bucket
+        else:
+            bfirst = jnp.zeros((T,), jnp.int32)
+            if policy == "first-fit":
+                # VBP decreasing sort; the tie key below resolves equal
+                # norms in batch order (the legacy path keys on the
+                # precomputed rank, whose ties are baked in ascending).
+                key3 = -dem_norms if lifo else task_rank
+            else:
+                # Batch order arms: the tie key IS the order.
+                key3 = jnp.zeros((T,), jnp.int32) if lifo else task_rank
+        # ONE multi-operand sort carrying every per-task payload through,
+        # replacing lexsort + four ``x[order]`` gathers (each a batched
+        # gather with scalar-memory indices — the dominant per-tick cost
+        # before this rewrite).
+        # Demands are NOT carried as payloads: the loop re-derives each
+        # step's demand row from the group table (``dem_group[g_p[j]]``
+        # as a tiny [G, 4] select-reduce) — four fewer [R, T] sort
+        # operands per tick, exact by group-wise demand constancy.
+        # Keys (major → minor): ineligible-last, bucket first-seen,
+        # policy key, batch-rank tie.  Under "fifo" the batch rank IS
+        # the task index, so ``iota_t`` serves as both the tie key and
+        # the permutation payload — the round-2 seven-operand shape, no
+        # extra [R, T] operand on the throughput hot path.  Under
+        # "lifo" the per-tick ``brank`` is the tie key and ``iota_t``
+        # rides as a separate payload.
+        operands = [
+            (~eligible).astype(jnp.int32),
+            bfirst,
+            key3,
+        ]
+        if lifo:
+            operands.extend([brank, iota_t])
+            payload0 = 4
+        else:
+            operands.append(iota_t)
+            payload0 = 3
+        operands.extend([anchor, workload.group_of.astype(jnp.int32)])
+        if task_u is not None:
+            operands.append(task_u)
+        sorted_ops = lax.sort(tuple(operands), num_keys=4)
+        order = sorted_ops[payload0]
+        bf_p = sorted_ops[1]
+        az_p = sorted_ops[payload0 + 1]
+        g_p = sorted_ops[payload0 + 2]
+        u_p = sorted_ops[payload0 + 3] if task_u is not None else None
+        n_ready = jnp.sum(eligible)
+        if realtime_scoring and policy == "cost-aware":
+            # Discount the inbound leg of the round-trip bandwidth by the
+            # tick-start backlog on each (anchor zone → host) pipe — the
+            # outbound leg has no tracked queue and stays static.  This is
+            # the signal the DES realtime_bw arm reads from live route
+            # queues (ref ``resources/network.py:70-73``).  The where
+            # keeps empty pipes BIT-identical to the static table (the
+            # algebraic form bw_rt − bw_zh + bw_zh can round 1 ulp off).
+            score_bw_rt = jnp.where(
+                q > 0, bw_rt - bw_zh + bw_zh / (q + 1.0), bw_rt
+            )
+        else:
+            score_bw_rt = bw_rt
+
+        # 5a. Transfer-delay table — BEFORE the placement loop (it only
+        #     reads zc, which predates placement): max over predecessor
+        #     instances of size / bw(src zone → dst zone).  All instances
+        #     of a producer group share one output size, so the max
+        #     reduces exactly to zone *presence* per group: GD[g, z] =
+        #     out_g × max over source zones s with a done g-instance of
+        #     1/bw[s, z] ([G, Z]), then CD[c, z] = max over c's
+        #     predecessor groups of GD.  Each placement selects its
+        #     CD[g, zone(h)] entry inside the loop (tiny VPU selects);
+        #     the former post-loop path gathered [R, T] ``new_zone`` and
+        #     ``CD[group_of, new_zone]`` through scalar memory.
+        inv_bw = jnp.where(topo.bw > 0, 1.0 / topo.bw, 0.0)  # [Z, Z]
+        presence = (zc > 0).astype(dtype)  # [G, Z]
+        GD = (
+            jnp.max(presence[:, :, None] * inv_bw[None, :, :], axis=1)
+            * workload.out_group[:, None]
+        )  # [G, Z]
+        CD = lax.map(
+            lambda col: jnp.max(workload.pred_group * col[None, :], axis=1),
+            GD.T,
+        ).T  # [G, Z] max over predecessor groups, zone column at a time
+
+        def place_cond(c):
+            j, _avail, _pl, _dl, _ns, _bf = c
+            return j < n_ready
+
+        def place_body(c):
+            j, avail, pl, delay, norm_snap, prev_bf = c
+            if vector:
+                # One [G, 1] group mask for this step, shared by the
+                # demand re-derivation here and the CD row select below.
+                g_hit = (jnp.arange(G) == g_p[j])[:, None]
+                # Demand row from the group table (one [G, 4]
+                # select-reduce; exactly one non-zero term — bit-exact,
+                # and g_p[j] is the batched index the sort carries).
+                demand = jnp.sum(
+                    jnp.where(g_hit, dem_group, jnp.zeros((), dtype)), axis=0
+                )  # [4]
+            else:
+                demand = dem_group[g_p[j]]  # [4] row gather
+            if strict:
+                fit = jnp.all(avail > demand[None, :], axis=1)
+            else:
+                fit = jnp.all(avail >= demand[None, :], axis=1)
+            if policy == "cost-aware":
+                # Stale-score semantics (ref cost_aware.py:104-119, DES
+                # CostAwarePolicy._first_fit): host scores are computed
+                # ONCE per anchor bucket from availability at bucket
+                # start, then tasks first-fit in that frozen order with
+                # LIVE fit checks.  Re-scoring per task (live norms) was
+                # round 1's model — it spreads load as a host's residual
+                # shrinks, where the DES keeps concentrating on it;
+                # measured as the dominant cost-aware egress/IH bias.
+                live_norm = jnp.sqrt(jnp.sum(avail * avail, axis=1))
+                new_bucket = bf_p[j] != prev_bf
+                norm_snap = jnp.where(new_bucket, live_norm, norm_snap)
+                prev_bf = bf_p[j]
+                # Anchor-zone row selection.  Vector form: one-hot
+                # select-reduce, NOT ``table[az_p[j]]`` — under vmap the
+                # indexed form lowers to a batched gather whose [R]
+                # index vector lives in scalar memory, serialized on the
+                # scalar core, measured as a dominant rollout cost.  The
+                # select-reduce stays on the VPU and is bit-exact (the
+                # sum has exactly one non-zero term; adding zeros is
+                # IEEE-exact for finite table entries).  Indexed form:
+                # the row gather (exact selection, fast on CPU).
+                if vector:
+                    zoh = (jnp.arange(Z) == az_p[j])[:, None]  # [Z, 1]
+                    zero = jnp.zeros((), dtype)
+                    if score_params is None:
+                        cost_row = jnp.sum(
+                            jnp.where(zoh, cost_rt, zero), axis=0
+                        )
+                        bw_row = jnp.sum(
+                            jnp.where(zoh, score_bw_rt, zero), axis=0
+                        )
+                    else:
+                        cost_row = jnp.sum(
+                            jnp.where(zoh, cost_pow, zero), axis=0
+                        )
+                        bw_row = jnp.sum(jnp.where(zoh, bw_pow, zero), axis=0)
+                else:
+                    if score_params is None:
+                        cost_row = cost_rt[az_p[j]]
+                        bw_row = score_bw_rt[az_p[j]]
+                    else:
+                        cost_row = cost_pow[az_p[j]]
+                        bw_row = bw_pow[az_p[j]]
+                if score_params is None:
+                    score = cost_row / (norm_snap * bw_row)
+                else:
+                    score = cost_row / (norm_snap ** w_norm * bw_row)
+                h = jnp.argmin(jnp.where(fit, score, inf))
+            elif policy == "first-fit":
+                h = jnp.argmax(fit)  # lowest-index fit (ref vbp.py:6-29)
+            elif policy == "best-fit":
+                resid = avail - demand[None, :]
+                score = jnp.sqrt(jnp.sum(resid * resid, axis=1))
+                h = jnp.argmin(jnp.where(fit, score, inf))
+            else:  # opportunistic: uniform among fits (ref opportunistic.py)
+                # Per-tick redraw via a Weyl rotation of the task's base
+                # uniform (the DES redraws per tick, policies.py:105; a
+                # retrying task must not deterministically re-target the
+                # same rank every tick).  Keyed on absolute time, so
+                # checkpoint segmentation cannot shift the sequence.
+                tick_idx = (t / tick).astype(jnp.int32)
+                u_eff = jnp.mod(
+                    u_p[j] + tick_idx.astype(u_p.dtype) * 0.6180339887498949,
+                    1.0,
+                )
+                n_fit = jnp.sum(fit)
+                k = jnp.minimum((u_eff * n_fit).astype(jnp.int32), n_fit - 1)
+                rank = jnp.cumsum(fit) - 1  # rank among fitting hosts
+                h = jnp.argmax(fit & (rank == k))
+            ok = jnp.any(fit)
+            if vector:
+                # One-hot state updates, NOT ``.at[h].add`` /
+                # ``.at[...].set``: under vmap those lower to batched
+                # scatters with scalar-memory index vectors (serialized
+                # on the scalar core — with the row gathers above, ~85%
+                # of rollout wall before the round-2 rewrite).
+                # Bit-exact: x − d·1 ≡ x + (−d), x − d·0 ≡ x.
+                host_hit = (jnp.arange(avail.shape[0]) == h)[:, None]
+                avail = avail - jnp.where(
+                    host_hit & ok, demand[None, :],
+                    jnp.zeros((), avail.dtype),
+                )
+                task_hit = jnp.arange(T) == order[j]
+                pl = jnp.where(
+                    task_hit, jnp.where(ok, h, -1).astype(jnp.int32), pl
+                )
+                # Transfer delay CD[group, zone(h)] for this placement
+                # via three tiny VPU selects (zone of h, CD group row,
+                # zone entry); unplaced tasks keep 0, masked by
+                # ``placed`` below.
+                z_h = jnp.sum(
+                    jnp.where(jnp.arange(H) == h, topo.host_zone, 0)
+                )
+                cd_row = jnp.sum(
+                    jnp.where(g_hit, CD, jnp.zeros((), dtype)), axis=0
+                )  # [Z]
+                d_j = jnp.sum(
+                    jnp.where(
+                        jnp.arange(Z) == z_h, cd_row, jnp.zeros((), dtype)
+                    )
+                )
+                delay = jnp.where(task_hit & ok, d_j, delay)
+            else:
+                # Index forms (exact: x − d ≡ x + (−d); a miss scatters
+                # to the dropped H row instead of adding 0).
+                avail = avail.at[jnp.where(ok, h, H)].add(
+                    -demand, mode="drop"
+                )
+                pl = pl.at[order[j]].set(
+                    jnp.where(ok, h, -1).astype(jnp.int32)
+                )
+                z_h = topo.host_zone[h]
+                d_j = CD[g_p[j], z_h]
+                delay = delay.at[order[j]].set(
+                    jnp.where(ok, d_j, jnp.zeros((), dtype))
+                )
+            return j + 1, avail, pl, delay, norm_snap, prev_bf
+
+        _, avail, placements, xfer_delay, _, _ = lax.while_loop(
+            place_cond,
+            place_body,
+            (
+                jnp.asarray(0, jnp.int32),
+                avail,
+                jnp.full((T,), -1, dtype=jnp.int32),
+                jnp.zeros((T,), dtype),
+                jnp.sqrt(jnp.sum(avail * avail, axis=1)),
+                jnp.asarray(-1, jnp.int32),
+            ),
+        )
+        placed = placements >= 0
+        if lifo:
+            # Wait-queue carry: a ready task that did not place this
+            # tick re-enters the wait dict at its position in the
+            # policy's SCHEDULE-RETURN order — the reference's tick loop
+            # consumes ``schedule(ready_q)``'s return list, so insertion
+            # order is batch order for the batch-order arms but the
+            # decreasing-sorted order for VBP first-fit, which returns
+            # the sorted list (ref ``scheduler/__init__.py:102-115``,
+            # ``vbp.py:17``; the DES twin mirrors this via
+            # ``TickContext.visit_order``).  Next tick's re-drain
+            # reverses on -qpos above.  Placed / non-ready rows reset to
+            # the -1 sentinel (an aborted task re-enters as FRESH, like
+            # the DES's resubmission through submit_q).
+            if policy == "first-fit":
+                # Return-order rank over the FULL ready batch (including
+                # tasks with no fitting host — the sorted list holds
+                # them too): non-ready last, norm-decreasing, ties in
+                # batch order (``sorted`` is stable).  The placement
+                # sort above cannot be reused — it keys ineligible rows
+                # last, which is provably placement-neutral but wrong
+                # for insertion positions.
+                s_nonready = (~ready).astype(jnp.int32)
+                sord = lax.sort(
+                    (s_nonready, -dem_norms, brank, iota_t), num_keys=3
+                )[3]
+                if vector:
+                    srank = lax.sort((sord, iota_t), num_keys=1)[1]
+                else:
+                    srank = jnp.zeros((T,), jnp.int32).at[sord].set(iota_t)
+            else:
+                srank = brank  # batch-order arms: return order = batch
+            qpos = jnp.where(
+                ready & ~placed, srank, jnp.asarray(-1, jnp.int32)
+            )
+
+        if congestion:
+            # Backlog pipe model: every (src zone s → dst host h) aggregate
+            # is one FIFO pipe with queued-MB state q[s, h]; a pull joins
+            # the backlog and completes when the pipe has drained it, so
+            # its delay is (backlog + this tick's volume) / bw — the
+            # tick-resolution analog of the DES's per-route round-robin
+            # chunk service, where concurrent transfers on one route all
+            # finish together at backlog-drain time.  Pull volumes follow
+            # the DES sampling rule via the hoisted ``pull_frac`` table;
+            # aggregation is one matmul + one segment sum — nothing bigger
+            # than [T, Z] is materialized.
+            pull_gz = pull_frac @ zc  # [G, Z] pulled MB per consumer instance
+            # Group → task expansion kept as a shared-index gather: a
+            # g_oh one-hot MATMUL here would not be bit-exact (pull_gz
+            # carries real f32 values, which the MXU truncates to bf16 —
+            # unlike the integer-count ``hv @ zone_onehot`` above), and a
+            # where/reduce select would build an [R, T, G, Z] broadcast.
+            # The index vector (group_of) is shared across replicas, so
+            # this lowers to a constant-index gather, not the batched
+            # scalar-memory form the placement-loop rewrite eliminated.
+            vol_tz = pull_gz[workload.group_of] * placed[:, None]  # [T, Z]
+            if vector:
+                # Round-3 congestion-arm vectorization (VERDICT r02
+                # item 1): the two per-tick scalar-core ops below — a
+                # scatter-add with a per-replica [R, T] segment-id
+                # vector and a batched gather on placements — were the
+                # arm's remaining toll (11.4 s vs 2.6–3.1 s for the
+                # static arms at the canonical scale) after both round-2
+                # purges.  Both become HIGHEST-precision one-hot matmuls
+                # on the MXU: the f32 emulation's split-product of x
+                # with an exact 0/1 operand is exact (x·1 = hi + lo = x,
+                # x·0 = 0), so the pipe sums differ from the scatter
+                # form only in accumulation order (tree vs index —
+                # empirically bit-identical on the parity workloads; the
+                # forms suite holds every rollout output to exact
+                # equality), and the ratio "gather" is a one-non-zero-
+                # term select, exact outright.
+                place_oh_f = (
+                    placements[:, None] == jnp.arange(H)[None, :]
+                ).astype(dtype)  # [T, H]; unplaced rows are all-zero
+                v_new = jnp.einsum(
+                    "tz,th->zh", vol_tz, place_oh_f,
+                    precision=lax.Precision.HIGHEST,
+                )  # [Z, H] new queued MB per pipe
+            else:
+                v_new = jax.ops.segment_sum(
+                    vol_tz, jnp.where(placed, placements, H),
+                    num_segments=H + 1,
+                )[:H].T  # [Z, H] new queued MB per pipe
+            q_now = q + v_new
+            # Per-task congested delay: max over source zones this task
+            # pulls NONZERO volume from of backlog/bw at its destination
+            # host (a zero-output predecessor transfers nothing — the DES
+            # skips it, ``resources/__init__.py:263-267`` — so backlog
+            # from other tasks must not delay this one through it).
+            pulls_from = vol_tz > 0
+            if vector:
+                # q_now depends on ALL of this tick's placements, so the
+                # per-pipe ratio cannot be selected during the placement
+                # loop — but the post-loop selection needs no gather:
+                # each task's ratio row is a one-non-zero-term one-hot
+                # contraction of its placement column (exact, on-MXU).
+                ratio_t = jnp.einsum(
+                    "th,zh->tz", place_oh_f, q_now * inv_bw_zh,
+                    precision=lax.Precision.HIGHEST,
+                )  # [T, Z]
+            else:
+                ratio_t = (
+                    q_now * inv_bw_zh
+                )[:, jnp.clip(placements, 0, H - 1)].T
+            cong_delay = jnp.max(
+                jnp.where(pulls_from, ratio_t, 0.0), axis=1
+            )  # [T]
+            # Never undercut the uncongested bound: an empty pipe with one
+            # puller reduces to the static size/bw estimate or below (the
+            # sampled volume is a 1/n fraction), so take the max.
+            xfer_delay = jnp.maximum(xfer_delay, cong_delay)
+            # Drain the pipes over the coming window.
+            q = jnp.maximum(q_now - bw_zh * tick, 0.0)
+
+        stage = jnp.where(placed, _RUNNING, stage)
+        place = jnp.where(placed, placements, place)
+        finish = jnp.where(placed, t + xfer_delay + runtime, finish)
+
+        # 6. Busy-host integral (instance-hours estimator).  Tasks only
+        #    start at tick boundaries, so a host's busy interval inside
+        #    this window always begins at t and ends at the latest
+        #    resident finish (capped at the window) — the per-window
+        #    integral max_tasks(min(finish − t, tick)) is exact within
+        #    the rollout's own timing model, not a whole-tick rounding.
+        #    Select-max over a [T, H] membership mask, NOT a segment_max
+        #    (the vmapped segment form is a scalar-memory scatter like
+        #    the refund above — profiled at ~1 ms/tick, 22% of the
+        #    rollout).  Max is order-independent, so this is bit-exact
+        #    vs the old path; empty hosts reduce to the 0 identity the
+        #    old ``maximum(·, 0)`` clamp produced.  The mask is rebuilt
+        #    rather than shared with the tick-start ``place_oh``: this
+        #    tick's placements have landed in ``place`` by now and must
+        #    count toward busy time.
+        contrib = jnp.where(
+            stage == _RUNNING, jnp.clip(finish - t, 0.0, tick), 0.0
+        )
+        if vector:
+            run_at = (
+                (place[:, None] == jnp.arange(H)[None, :])
+                & (stage == _RUNNING)[:, None]
+            )  # [T, H]
+            busy_host = jnp.max(
+                jnp.where(run_at, contrib[:, None], jnp.zeros((), dtype)),
+                axis=0,
+            )  # [H]
+        else:
+            # Max-scatter (order-independent, exact); empty hosts fill
+            # −inf, clamped back to the vector form's 0 identity
+            # (contrib ≥ 0, so the clamp cannot alter a busy host).
+            busy_host = jnp.maximum(
+                jax.ops.segment_max(
+                    contrib,
+                    jnp.where(stage == _RUNNING, place, H),
+                    num_segments=H + 1,
+                )[:H],
+                0.0,
+            )  # [H]
+        busy = busy + jnp.sum(busy_host)
+
+        return (
+            i + 1,
+            RolloutState(
+                t + tick, stage, finish, place, avail, busy, q, qpos
+            ),
+        )
+
+    _, out = lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), state))
+    return out
+

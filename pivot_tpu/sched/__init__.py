@@ -78,6 +78,16 @@ class TickContext:
         )
         self._host_zones: Optional[np.ndarray] = None
         self._host_task_counts: Optional[np.ndarray] = None
+        # Policies that iterate the batch in a different order than given
+        # (the VBP decreasing arms) record it here: the reference's tick
+        # loop consumes ``schedule(ready_q)``'s RETURN list — the sorted
+        # one — so dispatch and wait-queue insertion follow the policy's
+        # visit order, not batch order (ref ``scheduler/__init__.py:102-115``,
+        # ``vbp.py:17,42``).  ``None`` means batch order (opportunistic
+        # returns ``list(tasks)``, cost-aware returns ``tasks`` unsorted —
+        # its sort happens per anchor bucket on a copy, ref
+        # ``cost_aware.py:28-43``).
+        self.visit_order: Optional[List[int]] = None
 
     @property
     def n_tasks(self) -> int:
@@ -278,7 +288,18 @@ class GlobalScheduler(LogMixin):
                             sum(1 for h in placements if h >= 0)
                         )
                 self._tick_seq += 1
-                for task, h_idx in zip(ready, placements):
+                # Reference parity: consume placements in the policy's
+                # visit order (``schedule()``'s return order) — it sets
+                # both the within-tick dispatch sequence and, decisively,
+                # the wait-queue insertion order that next tick's LIFO
+                # drain reverses (ref ``scheduler/__init__.py:102-115``).
+                visit = (
+                    ctx.visit_order
+                    if ctx.visit_order is not None
+                    else range(len(ready))
+                )
+                for i in visit:
+                    task, h_idx = ready[i], placements[i]
                     if not task.is_nascent:
                         self.logger.error("task %s not nascent at dispatch", task.id)
                         continue

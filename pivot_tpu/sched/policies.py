@@ -153,6 +153,7 @@ class FirstFitPolicy(Policy):
         idxs = list(range(ctx.n_tasks))
         if self.decreasing:
             idxs = _sort_decreasing(demands, idxs)
+            ctx.visit_order = idxs  # ref returns the sorted list (vbp.py:17)
         if self.mode == "naive":
             for i in idxs:
                 for h in range(ctx.n_hosts):
@@ -204,6 +205,7 @@ class BestFitPolicy(Policy):
         idxs = list(range(ctx.n_tasks))
         if self.decreasing:
             idxs = _sort_decreasing(demands, idxs)
+            ctx.visit_order = idxs  # ref returns the sorted list (vbp.py:42)
         if self.mode == "naive":
             for i in idxs:
                 best, best_score = -1, np.inf

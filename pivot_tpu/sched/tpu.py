@@ -311,6 +311,7 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
         order = None
         if self.decreasing:
             order = _sort_decreasing(ctx.demands, list(range(T)))
+            ctx.visit_order = order  # ref returns the sorted list (vbp.py:17)
         avail, dem, valid = self._padded(ctx, order)
         placements, _ = first_fit_kernel(avail, dem, valid, strict=False)
         return self._unpad(placements, T, order)
@@ -329,6 +330,7 @@ class TpuBestFitPolicy(_DevicePolicyBase):
         order = None
         if self.decreasing:
             order = _sort_decreasing(ctx.demands, list(range(T)))
+            ctx.visit_order = order  # ref returns the sorted list (vbp.py:42)
         avail, dem, valid = self._padded(ctx, order)
         placements, _ = best_fit_kernel(avail, dem, valid)
         return self._unpad(placements, T, order)

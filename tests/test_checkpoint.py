@@ -169,6 +169,59 @@ def test_fingerprint_mismatch_restarts(setup, tmp_path):
     _assert_same(plain, res)
 
 
+def test_forms_mismatch_restarts(setup, tmp_path, monkeypatch):
+    """A vector-form checkpoint must not resume under the indexed forms.
+
+    The two form sets are only *empirically* bit-identical (tree vs
+    sequential f32 pipe sums), so cross-form resume is excluded by the
+    fingerprint — e.g. a TPU-written checkpoint (backend default vector)
+    moved to CPU (default indexed) restarts instead of mixing
+    trajectories.  Asserted structurally: the second run recomputes from
+    tick 0 (as many segment calls as a cold run), rather than by result
+    comparison, which the forms parity would satisfy either way.
+    """
+    import pivot_tpu.parallel.ensemble as ens
+
+    avail0, workload, topo, storage_zones = setup
+    key = jax.random.PRNGKey(6)
+    ckpt = str(tmp_path / "roll.npz")
+
+    calls = []
+    orig = ens._segment_step
+
+    def counting(*args, **kw):
+        calls.append(1)
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(ens, "_segment_step", counting)
+    rollout_checkpointed(
+        key, avail0, workload, topo, storage_zones, ckpt,
+        segment_ticks=8, forms="vector", **CFG,
+    )
+    n_cold = len(calls)
+    assert n_cold >= 1
+
+    # Same arguments, same form → resumes, strictly fewer segment calls.
+    calls.clear()
+    rollout_checkpointed(
+        key, avail0, workload, topo, storage_zones, ckpt,
+        segment_ticks=8, forms="vector", **CFG,
+    )
+    assert len(calls) < n_cold
+
+    # Same arguments, indexed forms → fingerprint mismatch → full rerun.
+    calls.clear()
+    res = rollout_checkpointed(
+        key, avail0, workload, topo, storage_zones, ckpt,
+        segment_ticks=8, forms="indexed", **CFG,
+    )
+    assert len(calls) == n_cold
+    plain = rollout(
+        key, avail0, workload, topo, storage_zones, forms="indexed", **CFG
+    )
+    _assert_same(plain, res)
+
+
 def test_cli_grid_resume(tmp_path):
     """--resume reuses the experiment dir and skips completed runs."""
     from pivot_tpu.experiments import cli

@@ -1,0 +1,95 @@
+"""Canonical packing-arm bias campaign at statistical strength.
+
+VERDICT r03 item 2: the round-3 headline — best-fit egress bias +12.4%
+under lifo+x64 at the canonical 100 hosts × 50 apps — rested on 5 cluster
+seeds (SE ≈ 10%), coin-flip grade for an "inside the ±15% bar" claim.
+This tool re-runs the same paired DES↔estimator comparison at ≥20 cluster
+seeds × ≥2 DES seeds (all CPU-side) and reports mean ± standard error per
+arm, so the claim either stands with SE ≤ 5% or gets restated honestly.
+
+One process per policy (launch best-fit and first-fit concurrently; the
+estimator's XLA compile is shared across clusters within a process since
+the workload shapes are identical).  Writes one JSON document per policy:
+
+  figures/bias_r04_<policy>.json
+    {"summary": {mode: {metric: {mean, std, se, n}}},
+     "per_cluster": {mode: [egress rel_err per cluster seed]},
+     "calibrate": <full calibrate() report>}
+
+Usage:
+  python tools/bias_campaign.py --policy best-fit [--cluster-seeds 24]
+      [--des-seeds 2] [--hosts 100] [--apps 50]
+
+Ref context: billing ground truth `/root/reference/resources/__init__.py:565-569`;
+the reference has no estimator to calibrate — this fidelity program is
+framework-only capability.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRACE = "data/jobs/jobs-5000-200-172800-259200.npz"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--policy", default="best-fit")
+    ap.add_argument("--cluster-seeds", type=int, default=24)
+    ap.add_argument("--des-seeds", type=int, default=2)
+    ap.add_argument("--hosts", type=int, default=100)
+    ap.add_argument("--apps", type=int, default=50)
+    ap.add_argument("--out", default="")
+    ns = ap.parse_args()
+
+    from pivot_tpu.utils import pin_virtual_cpu_mesh
+
+    pin_virtual_cpu_mesh(1)
+
+    from pivot_tpu.experiments.calibrate import _METRICS, calibrate
+
+    rep = calibrate(
+        TRACE, n_hosts=ns.hosts, n_apps=ns.apps, policy=ns.policy,
+        x64=True, tick_order="lifo", modes=("static", "congested"),
+        cluster_seeds=ns.cluster_seeds, des_seeds=ns.des_seeds, seed=0,
+    )
+    summary = {}
+    per_cluster = {}
+    for mode in ("static", "congested"):
+        summary[mode] = {}
+        for k in _METRICS:
+            s = rep["cluster_summary"][mode][k]
+            n = s["n"]
+            summary[mode][k] = {
+                "mean": s["mean_rel_err"],
+                "std": s["std_rel_err"],
+                "se": (s["std_rel_err"] / math.sqrt(n)) if n else None,
+                "n": n,
+            }
+        per_cluster[mode] = [
+            r[mode]["rel_err"]["egress_cost"] for r in rep["clusters"]
+        ]
+    out = ns.out or f"figures/bias_r04_{ns.policy}.json"
+    with open(out, "w") as f:
+        json.dump(
+            {"config": vars(ns), "summary": summary,
+             "per_cluster_egress": per_cluster, "calibrate": rep},
+            f, indent=2,
+        )
+    eg = summary["static"]["egress_cost"]
+    print(json.dumps({
+        "policy": ns.policy,
+        "static_egress_mean": eg["mean"], "static_egress_se": eg["se"],
+        "congested_egress_mean": summary["congested"]["egress_cost"]["mean"],
+        "n": eg["n"], "wrote": out,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -135,7 +135,8 @@ def per_task_egress(workload, topo, place_vec):
 
 
 def diagnose_one(policy, n_hosts, n_apps, cluster_seed, interval=5.0,
-                 max_ticks=4096, des_seed=0, tick_order="fifo", x64=False):
+                 max_ticks=4096, des_seed=0, tick_order="fifo", x64=False,
+                 congestion=False):
     import jax.numpy as jnp
 
     from pivot_tpu.experiments.calibrate import ensemble_inputs_from_schedule
@@ -154,7 +155,7 @@ def diagnose_one(policy, n_hosts, n_apps, cluster_seed, interval=5.0,
     )
     est_ticks, _ = est_tick_trace(
         w, topo, avail0, sz, policy, des_seed, interval, max_ticks,
-        tick_order=tick_order,
+        tick_order=tick_order, congestion=congestion,
     )
 
     # Key ↔ row alignment (same layout as the fidelity test).
@@ -284,6 +285,11 @@ def main():
     ap.add_argument("--apps", type=int, default=30)
     ap.add_argument("--cluster-seeds", type=int, default=1)
     ap.add_argument("--tick-order", default="fifo", choices=["fifo", "lifo"])
+    ap.add_argument("--congestion", action="store_true",
+                    help="estimator side uses the backlog-pipe transfer "
+                         "model (the DES side is unchanged — this "
+                         "diagnoses the congested ESTIMATOR against the "
+                         "same ground truth)")
     ap.add_argument("--x64", action="store_true",
                     help="f64 rollout (matches the DES's numpy f64 scores)")
     ap.add_argument("--out", default="")
@@ -300,7 +306,8 @@ def main():
     reports = []
     for cs in range(ns.cluster_seeds):
         rep = diagnose_one(ns.policy, ns.hosts, ns.apps, cluster_seed=cs,
-                           tick_order=ns.tick_order, x64=ns.x64)
+                           tick_order=ns.tick_order, x64=ns.x64,
+                           congestion=ns.congestion)
         print(
             json.dumps(
                 {
