@@ -76,6 +76,22 @@ def ensemble_inputs_from_schedule(schedule, cluster, dtype=None):
     return workload, app_slices, arrivals, topo, avail0, storage_zones
 
 
+def des_metrics(summary: dict, schedule) -> dict:
+    """The four comparison metrics from a finished DES run — the ONE
+    definition shared by the calibration harness and the sensitivity
+    experiment (``cli.py run_sensitivity``), so their numbers cannot
+    silently diverge.  Makespan runs first submission → last app
+    completion (the rollout clock starts at the first submission)."""
+    apps = schedule.apps
+    t0 = min(a.start_time for a in apps)
+    return {
+        "avg_runtime": summary["avg_runtime"],
+        "egress_cost": summary["egress_cost"],
+        "instance_hours": summary["cum_instance_hours"],
+        "makespan": max(a.end_time for a in apps) - t0,
+    }
+
+
 def _des_ground_truth(cluster, policy_name, trace_file, n_apps, scale_factor,
                       seed, interval, realtime=False):
     """Run the exact simulation; return its metric dict."""
@@ -104,18 +120,10 @@ def _des_ground_truth(cluster, policy_name, trace_file, n_apps, scale_factor,
         interval=interval,
     )
     summary = run.run()
-    # Makespan: first submission → last app completion (the rollout's
-    # clock starts at the first submission); timestamps live on the
-    # runner's schedule, whose apps went through the simulation.
+    # Timestamps live on the runner's schedule, whose apps went through
+    # the simulation.
     schedule = run.schedule
-    apps = schedule.apps
-    t0 = min(a.start_time for a in apps)
-    return {
-        "avg_runtime": summary["avg_runtime"],
-        "egress_cost": summary["egress_cost"],
-        "instance_hours": summary["cum_instance_hours"],
-        "makespan": max(a.end_time for a in apps) - t0,
-    }, schedule
+    return des_metrics(summary, schedule), schedule
 
 
 def _estimate(workload, app_slices, arrivals, topo, avail0, storage_zones,

@@ -842,15 +842,11 @@ def run_sensitivity(args) -> dict:
             n_apps=args.num_apps, seed=seed, interval=5.0,
         )
         summary = run.run()
-        apps = run.schedule.apps
-        t0 = min(a.start_time for a in apps)
-        metrics = {
-            "avg_runtime": summary["avg_runtime"],
-            "egress_cost": summary["egress_cost"],
-            "instance_hours": summary["cum_instance_hours"],
-            "makespan": max(a.end_time for a in apps) - t0,
-        }
-        return metrics, (pol.summary() if gated else None)
+        from pivot_tpu.experiments.calibrate import des_metrics
+
+        return des_metrics(summary, run.schedule), (
+            pol.summary() if gated else None
+        )
 
     per_seed = []
     for s in range(args.seed, args.seed + args.des_seeds):

@@ -104,6 +104,13 @@ def _fingerprint(
         # trajectory (appended only for non-default order, same
         # compat-within-version rule as the fields above).
         base = base + (("tick_order", tick_order),)
+        if policy == "first-fit":
+            # Round-4 wait-reinsertion change: lifo first-fit now carries
+            # the schedule-RETURN-order rank (the decreasing sort) as the
+            # wait re-entry key instead of the batch rank — a different
+            # trajectory for exactly this (policy, order) pair, so
+            # pre-change checkpoints must restart, not resume mixed.
+            base = base + (("qpos", "return-order"),)
     if forms != "indexed":
         # The tick-body forms are only *empirically* bit-identical (tree
         # vs sequential f32 pipe sums), so a vector-form checkpoint must

@@ -44,3 +44,117 @@ def _reset_ids():
 
     reset_ids()
     yield
+
+
+# --- quick/slow tiers (VERDICT r03 item 7) ---------------------------------
+#
+# ``pytest -m quick`` is the sub-2-minute CI tier: every test module keeps
+# at least one quick test (asserted below), so a quick run still imports
+# and exercises every subsystem.  ``pytest tests/`` (no -m) remains the
+# full pre-commit tier.  Membership is centralized here — a test is slow
+# because its *measured* wall (suite --durations) says so, and the list is
+# cheaper to retune in one place than markers scattered over 20 files.
+# Matching is by test NAME prefix so parametrized variants inherit it.
+
+_SLOW_TESTS = {
+    "test_audit.py": ["test_cli_audit_flag"],
+    "test_checkpoint.py": [
+        "test_checkpointed_policy_arm_matches_plain",
+        "test_chunked_first_chunk_matches_plain",
+        "test_checkpointed_congestion_rollout_matches_plain",
+        "test_checkpointed_fault_rollout_matches_plain",
+        "test_checkpointed_matches_plain",
+        "test_cli_grid_resume",
+        "test_chunked_checkpoint_resume",
+        "test_forms_mismatch_restarts",
+        "test_resume_after_interrupt",
+        "test_resume_continues_not_restarts",
+    ],
+    "test_ensemble.py": [
+        "test_tick_body_forms_bit_identical",
+        "test_forms_bit_identical_score_params_and_sweeps",
+        "test_sharded_sweeps_8_devices",
+        "test_segmented_sweeps_bit_identical",
+        "test_fault_rollout_replicas_differ",
+        "test_policy_comparison_cost_aware_wins_egress",
+        "test_realtime_scoring_checkpoint_bit_identical",
+        "test_score_param_sweep_shapes_and_pairing",
+        "test_congestion_noop_without_transfers",
+        "test_capacity_sweep_with_faults_paired_across_sizes",
+        "test_build_hybrid_mesh_two_processes",
+        "test_realtime_scoring_steers_around_backlog",
+        "test_segmented_rollout_fuzz",
+        "test_fault_rollout_all_hosts_down_forever",
+        "test_sharded_fault_rollout_8_devices",
+        "test_workload_sweep_scales_with_app_count",
+        "test_congestion_slows_contended_fanout",
+        "test_fault_rollout_crash_and_recover_extends_makespan",
+        "test_congestion_ignores_zero_output_predecessors",
+        "test_rollout_perturbation_spreads",
+        "test_rollout_respects_capacity",
+        "test_rollout_chain_makespan",
+        "test_rollout_transfer_delay_and_egress",
+        "test_sharded_policy_arm_8_devices",
+        "test_opportunistic_rollout_spreads_and_is_deterministic",
+        "test_capacity_sweep_tradeoff",
+        "test_instance_hours_",
+    ],
+    "test_executor.py": ["test_full_sim_bit_parity"],
+    "test_experiments.py": [
+        "test_cli_serve_resident_worker",
+        "test_estimator_egress_fidelity_canonical_config",
+        "test_lifo_wave_parity_vs_des",
+        "test_calibrate_distributional_des_seeds",
+        "test_cli_num_apps_end_to_end",
+        "test_ensemble_and_capacity_figures",
+        "test_cli_autotune_end_to_end",
+        "test_cli_ensemble_end_to_end",
+        "test_cli_ensemble_replica_chunk",
+        "test_cli_ensemble_checkpoint",
+        "test_cli_overall_end_to_end",
+        "test_calibrate_report_structure",
+        "test_cli_capacity_end_to_end",
+        "test_cli_apps_sweep_end_to_end",
+        "test_capacity_unfinished_candidate_clamped",
+        "test_calibrate_mode_combination_validation",
+    ],
+    "test_graft_entry.py": [
+        "test_dryrun_multichip_reexec_fallback",
+        "test_dryrun_multichip_8",
+    ],
+    "test_kernels.py": [
+        "test_full_sim_parity_cost_aware",
+    ],
+    "test_sensitivity.py": ["test_cli_sensitivity_paired_experiment"],
+    "test_tpu_validate.py": [
+        "test_parity_sweep_interpret_smoke",
+        "test_hw_r03_smoke",
+        "test_crossover_interpret_smoke",
+    ],
+    "test_trace.py": ["test_device_profile_captures"],
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    modules_seen = {}
+    for item in items:
+        fname = item.path.name if hasattr(item, "path") else item.fspath.basename
+        slow_names = _SLOW_TESTS.get(fname, ())
+        base = item.name.split("[")[0]
+        is_slow = any(base.startswith(s) for s in slow_names)
+        item.add_marker(pytest.mark.slow if is_slow else pytest.mark.quick)
+        modules_seen.setdefault(fname, []).append(is_slow)
+    # Tier invariant: a quick run must touch every module.  Checked only
+    # on full-suite collections — a node-id / -k / --lf selection
+    # legitimately sees a partial, possibly all-slow subset.
+    if config.args == [str(config.rootpath / "tests")] or config.args == [
+        "tests/"
+    ] or config.args == ["tests"]:
+        all_slow = [
+            m for m, flags in modules_seen.items() if flags and all(flags)
+        ]
+        if all_slow:
+            pytest.fail(
+                f"tier invariant: modules with no quick test: {all_slow}",
+                pytrace=False,
+            )
