@@ -280,6 +280,11 @@ def calibrate(
         ("realtime",) if realtime
         else ("static", "congested") if modes is None else tuple(modes)
     )
+    unknown = set(modes) - {"static", "congested", "realtime", "pairs"}
+    if unknown:
+        # A typo'd mode would otherwise run as a second static arm under
+        # the wrong label — and could even be crowned recommended_mode.
+        raise ValueError(f"unknown calibration mode(s): {sorted(unknown)}")
     if cluster_seeds > 1:
         if cluster is not None:
             raise ValueError("cluster_seeds > 1 generates its own clusters "
@@ -307,6 +312,19 @@ def calibrate(
                     "std_rel_err": float(np.std(errs)) if errs else None,
                     "n": len(errs),
                 }
+        # Measured per-arm mode recommendation (docs/ARCHITECTURE.md "Per-
+        # arm transfer-model recommendation"): the congested model can
+        # WORSEN an arm (best-fit: its global argmin chain amplifies the
+        # zone-pipe's overstated contention), so the right mode is an
+        # empirical property of the arm — picked here by smallest |mean
+        # egress error| over the measured clusters, the metric the packing
+        # arms diverge on.
+        candidates = [
+            (abs(summary[m]["egress_cost"]["mean_rel_err"]), m)
+            for m in modes
+            if summary[m]["egress_cost"]["mean_rel_err"] is not None
+        ]
+        recommended = min(candidates)[1] if candidates else None
         return {
             "trace": trace_file,
             "n_hosts": base_cfg.n_hosts,
@@ -318,6 +336,7 @@ def calibrate(
             "cluster_seeds": cluster_seeds,
             "clusters": runs,
             "cluster_summary": summary,
+            "recommended_mode": recommended,
         }
     if cluster is not None and cluster_config is not None:
         raise ValueError("pass cluster or cluster_config, not both")
@@ -410,7 +429,10 @@ def _calibrate_modes(inputs, des, schedule, trace_file, cluster, policy,
     for mode in modes:
         est = _estimate(
             *inputs, policy, seed, tick, max_ticks, replicas, perturb,
-            congestion=(mode in ("congested", "realtime")),
+            congestion=(
+                "pairs" if mode == "pairs"
+                else mode in ("congested", "realtime")
+            ),
             realtime_scoring=(mode == "realtime"), tick_order=tick_order,
         )
         report[mode] = _with_errors(est, des)

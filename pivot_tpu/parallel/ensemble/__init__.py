@@ -152,7 +152,8 @@ def _single_rollout(
     forms: Optional[str] = None,
     tick_order: str = "fifo",
 ) -> RolloutResult:
-    state = _init_state(avail0, workload.n_tasks, topo.cost.shape[0])
+    state = _init_state(avail0, workload.n_tasks, topo.cost.shape[0],
+                        congestion=congestion)
     state = _rollout_segment(
         state, runtime, arrival, root_anchor, workload, topo, tick, max_ticks,
         faults=faults, totals=avail0, score_params=score_params,
@@ -211,7 +212,8 @@ def _rollout_states(
 
     def one(r, a, ra, *ex):
         f, u, _tot, _sp, _act = _unpack_extras(spec, ex)
-        state = _init_state(avail0, workload.n_tasks, Z)
+        state = _init_state(avail0, workload.n_tasks, Z,
+                            congestion=congestion)
         return _rollout_segment(
             state, r, a, ra, workload, topo, tick, max_ticks,
             faults=f, totals=avail0, policy=policy, task_u=u,

@@ -84,6 +84,10 @@ def _fingerprint(
     # sums in tree order — ULP-different from the old scatter order for
     # multiple same-host refunds — so checkpoints written by the old body
     # must restart, not resume into a mixed-order trajectory.
+    # Normalize truthy non-bool congestion (1, np.True_) so the identity
+    # check below agrees with the tick body's equality-based validation —
+    # the trajectory is the same, so the fingerprint must be too.
+    congestion = "pairs" if congestion == "pairs" else bool(congestion)
     base = ("v2", np.asarray(key).tolist(), n_replicas, tick, max_ticks,
             perturb)
     if policy != "cost-aware":
@@ -95,7 +99,12 @@ def _fingerprint(
         base = base + (fault_cfg,)
     if congestion:
         # Appended only when the backlog model is on (same compat rule).
-        base = base + ("congestion",)
+        # The host-pair rung is a different trajectory family, so it
+        # fingerprints distinctly; plain True keeps the historical token.
+        base = base + (
+            ("congestion",) if congestion is True
+            else (("congestion", congestion),)
+        )
     if realtime_scoring:
         base = base + ("realtime_scoring",)
     if tick_order != "fifo":
@@ -212,7 +221,8 @@ def rollout_checkpointed(
     if state is None:
         Z = topo.cost.shape[0]
         state = jax.vmap(
-            lambda _: _init_state(avail0, workload.n_tasks, Z)
+            lambda _: _init_state(avail0, workload.n_tasks, Z,
+                                  congestion=congestion)
         )(jnp.arange(n_replicas))
 
     # Monte-Carlo draws are a pure function of ``key`` and constant for the

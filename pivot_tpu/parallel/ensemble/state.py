@@ -212,9 +212,14 @@ def _resolve_forms(forms: Optional[str]) -> str:
     return "indexed" if jax.default_backend() == "cpu" else "vector"
 
 
-def _init_state(avail0, T, Z) -> RolloutState:
+def _init_state(avail0, T, Z, congestion=False) -> RolloutState:
     dtype = avail0.dtype
     H = avail0.shape[0]
+    # Backlog-pipe state rows are source ZONES for the default model and
+    # source HOSTS for the host-pair refinement rung
+    # (``congestion="pairs"`` — see tick.py); columns are always
+    # destination hosts.
+    src_rows = H if congestion == "pairs" else Z
     return RolloutState(
         t=jnp.asarray(0.0, dtype),
         stage=jnp.full((T,), _PENDING, dtype=jnp.int32),
@@ -222,7 +227,7 @@ def _init_state(avail0, T, Z) -> RolloutState:
         place=jnp.full((T,), -1, dtype=jnp.int32),
         avail=avail0,
         busy=jnp.asarray(0.0, dtype),
-        q=jnp.zeros((Z, H), dtype=dtype),
+        q=jnp.zeros((src_rows, H), dtype=dtype),
         qpos=jnp.full((T,), -1, dtype=jnp.int32),
     )
 

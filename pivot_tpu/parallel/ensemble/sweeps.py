@@ -95,6 +95,12 @@ def _run_rows(
     (``rollout_checkpointed``'s rationale): a monolithic multi-thousand-
     tick program is one minutes-long execution some transports kill.
     """
+    if congestion == "pairs":
+        raise ValueError(
+            "the host-pair congestion rung is a calibration diagnostic "
+            "(rollout / rollout_checkpointed / calibrate), not a sweep "
+            "mode — use congestion=True here"
+        )
     Z = topo.cost.shape[0]
     spec, extras = _pack_extras(faults, task_u, totals, score_params, active)
     forms = _resolve_forms(forms)

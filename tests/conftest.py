@@ -81,6 +81,8 @@ _SLOW_TESTS = {
         "test_score_param_sweep_shapes_and_pairing",
         "test_congestion_noop_without_transfers",
         "test_capacity_sweep_with_faults_paired_across_sizes",
+        "test_congestion_pairs_equals_zone_on_singleton_zones",
+        "test_congestion_pairs_splits_same_zone_sources",
         "test_build_hybrid_mesh_two_processes",
         "test_realtime_scoring_steers_around_backlog",
         "test_segmented_rollout_fuzz",
@@ -105,6 +107,7 @@ _SLOW_TESTS = {
         "test_estimator_egress_fidelity_canonical_config",
         "test_lifo_wave_parity_vs_des",
         "test_calibrate_distributional_des_seeds",
+        "test_calibrate_cluster_seeds_recommends_mode",
         "test_cli_num_apps_end_to_end",
         "test_ensemble_and_capacity_figures",
         "test_cli_autotune_end_to_end",

@@ -642,6 +642,90 @@ def test_congestion_slows_contended_fanout(setup):
     assert np.array_equal(np.asarray(base.placement), np.asarray(cong.placement))
 
 
+def test_congestion_pairs_equals_zone_on_singleton_zones(meta):
+    """One host per zone: the host-pair pipe rung IS the zone model (row
+    per source collapses to row per zone), so every output matches
+    bit-for-bit — the pairs model's base-case correctness anchor."""
+    env = Environment()
+    zones = meta.zones
+    hosts = [Host(env, 16, 1 << 17, 100, 4, locality=zones[i])
+             for i in range(5)]
+    storage = [Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)]
+    cluster = Cluster(env, hosts=hosts, storage=storage, meta=meta,
+                      route_mode="meta", seed=0)
+    topo = DeviceTopology.from_cluster(cluster, jnp.float32)
+    app = Application("p", [
+        TaskGroup("a", cpus=1, mem=64, runtime=30, output_size=500,
+                  instances=6),
+        TaskGroup("b", cpus=2, mem=128, runtime=20, dependencies=["a"],
+                  instances=4),
+    ])
+    w = EnsembleWorkload.from_applications([app])
+    avail0 = jnp.asarray(cluster.availability_matrix(), dtype=jnp.float32)
+    sz = jnp.asarray(cluster.storage_zone_vector())
+    kw = dict(n_replicas=4, tick=5.0, max_ticks=64, perturb=0.1)
+    key = jax.random.PRNGKey(0)
+    rz = rollout(key, avail0, w, topo, sz, congestion=True, **kw)
+    rp = rollout(key, avail0, w, topo, sz, congestion="pairs", **kw)
+    for field in ("makespan", "placement", "finish_time", "egress_cost",
+                  "instance_hours"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rz, field)), np.asarray(getattr(rp, field))
+        )
+
+
+def test_congestion_pairs_splits_same_zone_sources(meta):
+    """Two producers on DIFFERENT hosts of one zone feeding one consumer
+    host: the zone model aggregates both volumes onto a single
+    (zone → dst) pipe, while the DES serves each host-pair route
+    independently — the pairs rung models that, so its transfer completes
+    strictly earlier."""
+    env = Environment()
+    zones = meta.zones
+    hosts = [
+        Host(env, 16, 1 << 17, 100, 4, locality=zones[0]),
+        Host(env, 16, 1 << 17, 100, 4, locality=zones[0]),
+        Host(env, 16, 1 << 17, 100, 4, locality=zones[1]),
+    ]
+    storage = [Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)]
+    cluster = Cluster(env, hosts=hosts, storage=storage, meta=meta,
+                      route_mode="meta", seed=0)
+    topo = DeviceTopology.from_cluster(cluster, jnp.float32)
+    # 16-cpu producers -> one per host (h0, h1 — both zone 0); the
+    # 16-cpu consumer lands on h0 after they release, pulling one full
+    # output from EACH producer host.
+    app = Application("split", [
+        TaskGroup("a", cpus=16, mem=256, runtime=5, output_size=30000,
+                  instances=2),
+        TaskGroup("b", cpus=16, mem=256, runtime=5, dependencies=["a"]),
+    ])
+    w = EnsembleWorkload.from_applications([app])
+    avail0 = jnp.asarray(cluster.availability_matrix(), dtype=jnp.float32)
+    sz = jnp.asarray(cluster.storage_zone_vector())
+    kw = dict(n_replicas=1, tick=5.0, max_ticks=256, perturb=0.0,
+              policy="first-fit")
+    key = jax.random.PRNGKey(1)
+    rz = rollout(key, avail0, w, topo, sz, congestion=True, **kw)
+    rp = rollout(key, avail0, w, topo, sz, congestion="pairs", **kw)
+    assert int(np.asarray(rz.n_unfinished).max()) == 0
+    assert int(np.asarray(rp.n_unfinished).max()) == 0
+    assert np.array_equal(np.asarray(rz.placement), np.asarray(rp.placement))
+    assert np.asarray(rp.makespan)[0] < np.asarray(rz.makespan)[0]
+
+
+def test_congestion_pairs_rejected_by_sweeps(setup):
+    from pivot_tpu.parallel.ensemble import workload_sweep
+
+    cluster, topo = setup
+    w = EnsembleWorkload.from_applications([chain_app()])
+    avail0, sz = _ens_inputs(cluster)
+    with pytest.raises(ValueError, match="host-pair"):
+        workload_sweep(
+            jax.random.PRNGKey(0), avail0, w, topo, sz,
+            app_counts=np.array([1]), n_replicas=2, congestion="pairs",
+        )
+
+
 def test_congestion_delay_hand_computed(setup):
     """Pipes are per destination host: 2 consumers forced onto SEPARATE
     hosts (16-cpu demand) each get their own uncontended pipe, so the

@@ -353,6 +353,32 @@ def test_calibrate_x64_mode():
     assert err is None or abs(err) < 10  # finite, parsed, sane
 
 
+def test_calibrate_cluster_seeds_recommends_mode():
+    """cluster_seeds > 1: the summary carries the measured per-arm mode
+    recommendation (smallest |mean egress error|) and a pairs mode can
+    participate in the comparison."""
+    from pivot_tpu.experiments.calibrate import calibrate
+
+    report = calibrate(
+        "data/jobs/jobs-5000-200-172800-259200.npz",
+        n_hosts=8,
+        n_apps=2,
+        policy="first-fit",
+        max_ticks=256,
+        modes=("static", "congested", "pairs"),
+        cluster_seeds=2,
+    )
+    assert report["cluster_seeds"] == 2
+    assert set(report["cluster_summary"]) == {"static", "congested", "pairs"}
+    rec = report["recommended_mode"]
+    assert rec in ("static", "congested", "pairs")
+    errs = {
+        m: abs(report["cluster_summary"][m]["egress_cost"]["mean_rel_err"])
+        for m in ("static", "congested", "pairs")
+    }
+    assert errs[rec] == min(errs.values())
+
+
 def test_calibrate_distributional_des_seeds():
     """des_seeds > 1: the report's DES target is the per-seed mean, with
     the per-seed paths and spread attached — the distributional fidelity

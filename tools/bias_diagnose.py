@@ -86,7 +86,8 @@ def est_tick_trace(workload, topo, avail0, storage_zones, policy_name,
     rt, arr, ra = ens._perturbations(
         key, workload, storage_zones, 1, 0.0, avail0.dtype
     )
-    state = jax.vmap(lambda _: ens._init_state(avail0, workload.n_tasks, Z))(
+    state = jax.vmap(lambda _: ens._init_state(
+        avail0, workload.n_tasks, Z, congestion=congestion))(
         jnp.arange(1)
     )
     prev = np.full(workload.n_tasks, -1, np.int64)
@@ -284,12 +285,19 @@ def main():
     ap.add_argument("--hosts", type=int, default=80)
     ap.add_argument("--apps", type=int, default=30)
     ap.add_argument("--cluster-seeds", type=int, default=1)
+    ap.add_argument("--first-seed", type=int, default=0,
+                    help="first cluster seed (diagnose seeds "
+                         "first-seed..first-seed+cluster-seeds-1)")
     ap.add_argument("--tick-order", default="fifo", choices=["fifo", "lifo"])
     ap.add_argument("--congestion", action="store_true",
                     help="estimator side uses the backlog-pipe transfer "
                          "model (the DES side is unchanged — this "
                          "diagnoses the congested ESTIMATOR against the "
                          "same ground truth)")
+    ap.add_argument("--pairs", action="store_true",
+                    help="host-pair pipe resolution (the congestion "
+                         "ladder's finest rung; implies the backlog "
+                         "model)")
     ap.add_argument("--x64", action="store_true",
                     help="f64 rollout (matches the DES's numpy f64 scores)")
     ap.add_argument("--out", default="")
@@ -304,10 +312,10 @@ def main():
         jax.config.update("jax_enable_x64", True)
 
     reports = []
-    for cs in range(ns.cluster_seeds):
+    for cs in range(ns.first_seed, ns.first_seed + ns.cluster_seeds):
         rep = diagnose_one(ns.policy, ns.hosts, ns.apps, cluster_seed=cs,
                            tick_order=ns.tick_order, x64=ns.x64,
-                           congestion=ns.congestion)
+                           congestion="pairs" if ns.pairs else ns.congestion)
         print(
             json.dumps(
                 {
