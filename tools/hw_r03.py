@@ -115,7 +115,13 @@ def congestion_arm(quick: bool, n_apps=25, n_hosts=100,
 
 
 def lifo_cost(n_apps=25, n_hosts=100, n_replicas=256) -> dict:
-    """Item 3: fidelity-order device cost at the canonical shape."""
+    """Item 3: fidelity-order device cost at the canonical shape.
+
+    Round-4 addendum: the first-fit arm's lifo path now computes a
+    second per-tick [T] sort pair (the schedule-return-order rank that
+    keys wait re-insertion — the reference-parity fix); ``first_fit``
+    rows measure its device cost next to the cost-aware fifo/lifo pair.
+    """
     import jax
 
     from pivot_tpu.parallel.ensemble import rollout
@@ -124,15 +130,25 @@ def lifo_cost(n_apps=25, n_hosts=100, n_replicas=256) -> dict:
     kw = dict(n_replicas=n_replicas, tick=5.0, max_ticks=1024, perturb=0.1)
     key = jax.random.PRNGKey(0)
     out = {}
-    for order in ("fifo", "lifo"):
-        per = _fetch_timed(
-            lambda: rollout(key, avail0, w, topo, sz, tick_order=order, **kw),
-            lambda r: float(np.asarray(r.makespan).sum()),
-        )
-        out[order] = {"wall_s": round(per, 3)}
-    out["lifo_over_fifo"] = round(
-        out["lifo"]["wall_s"] / out["fifo"]["wall_s"], 2
-    )
+    # Priority order within the item too: the r03 cost-aware pair first,
+    # each arm fail-soft, so a tunnel dying during the r04 first-fit
+    # addendum cannot discard measurements already taken.
+    for prefix, policy in (("", "cost-aware"), ("first_fit_", "first-fit")):
+        try:
+            for order in ("fifo", "lifo"):
+                per = _fetch_timed(
+                    lambda: rollout(key, avail0, w, topo, sz,
+                                    tick_order=order, policy=policy, **kw),
+                    lambda r: float(np.asarray(r.makespan).sum()),
+                )
+                out[f"{prefix}{order}"] = {"wall_s": round(per, 3)}
+            out[f"{prefix}lifo_over_fifo"] = round(
+                out[f"{prefix}lifo"]["wall_s"]
+                / out[f"{prefix}fifo"]["wall_s"], 2
+            )
+        except Exception as exc:  # noqa: BLE001 — partial items count
+            out[f"{prefix}error"] = f"{type(exc).__name__}: {exc}"[:300]
+            break
     return out
 
 
