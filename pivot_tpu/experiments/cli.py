@@ -436,6 +436,12 @@ def parse_args(argv=None):
              "seed)s",
     )
     sens.add_argument("--num-apps", type=int, dest="num_apps", default=30)
+    sens.add_argument("--policy", default="cost-aware",
+                      choices=["cost-aware", "vbp"],
+                      help="arm to gate: the canonical cost-aware policy "
+                           "or the VBP arm (first-fit decreasing) — the "
+                           "arm whose egress headroom is 100x larger at "
+                           "scale (VERDICT r04 item 2)")
     sens.add_argument("--replicas", type=int, default=256,
                       help="noise replicas per tick (the batched kernel's "
                            "native axis)")
@@ -817,10 +823,20 @@ def run_sensitivity(args) -> dict:
 
     from pivot_tpu.experiments.runner import ExperimentRun
     from pivot_tpu.sched.sensitivity import SensitivityGatedCostAware
-    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy, TpuFirstFitPolicy
 
     trace = _list_traces(args.job_dir, 1)[0]
-    canonical = dict(bin_pack="first-fit", sort_tasks=True, sort_hosts=True)
+    policy_name = getattr(args, "policy", "cost-aware")
+    if policy_name == "vbp":
+        # The reference's VBP arm: first-fit decreasing (config.py:111).
+        def make_inner():
+            return TpuFirstFitPolicy(decreasing=True)
+    else:
+        canonical = dict(bin_pack="first-fit", sort_tasks=True,
+                         sort_hosts=True)
+
+        def make_inner():
+            return TpuCostAwarePolicy(**canonical)
 
     def one(seed: int, gated: bool):
         cluster = build_cluster(_cluster_config(args))
@@ -831,30 +847,37 @@ def run_sensitivity(args) -> dict:
                 perturb=args.perturb,
                 max_holds=args.max_holds,
                 noise_seed=seed,
-                **canonical,
+                inner=make_inner(),
             )
         else:
-            pol = TpuCostAwarePolicy(**canonical)
+            pol = make_inner()
         run = ExperimentRun(
             f"sensitivity-{'gated' if gated else 'base'}-{seed}",
             cluster, pol, trace,
             output_size_scale_factor=args.scale_factor,
             n_apps=args.num_apps, seed=seed, interval=5.0,
         )
+        t0 = time.perf_counter()
         summary = run.run()
+        wall = time.perf_counter() - t0
         from pivot_tpu.experiments.calibrate import des_metrics
 
         return des_metrics(summary, run.schedule), (
             pol.summary() if gated else None
-        )
+        ), round(wall, 2)
 
     per_seed = []
     for s in range(args.seed, args.seed + args.des_seeds):
-        base, _ = one(s, gated=False)
-        gated, gate_stats = one(s, gated=True)
+        base, _, base_wall = one(s, gated=False)
+        gated, gate_stats, gated_wall = one(s, gated=True)
         per_seed.append({
             "seed": s, "baseline": base, "gated": gated,
             "gate": gate_stats,
+            # The gate's price at this scale: paired run walls plus the
+            # time inside the batched sensitivity calls themselves
+            # (gate.sensitivity_wall_s / _per_tick_s).
+            "baseline_wall_s": base_wall,
+            "gated_wall_s": gated_wall,
             "delta": {
                 k: gated[k] - base[k] for k in base
             },
@@ -875,8 +898,23 @@ def run_sensitivity(args) -> dict:
     }
     report = {
         "trace": trace,
+        "policy": policy_name,
         "n_hosts": args.n_hosts,
         "n_apps": args.num_apps,
+        "gate_cost": {
+            "mean_baseline_wall_s": float(
+                np.mean([r["baseline_wall_s"] for r in per_seed])
+            ),
+            "mean_gated_wall_s": float(
+                np.mean([r["gated_wall_s"] for r in per_seed])
+            ),
+            "mean_sensitivity_wall_per_tick_s": float(np.mean([
+                r["gate"]["sensitivity_wall_per_tick_s"]
+                for r in per_seed
+                if r["gate"] and r["gate"]["sensitivity_wall_per_tick_s"]
+                is not None
+            ])) if per_seed else None,
+        },
         "replicas": args.replicas,
         "perturb": args.perturb,
         "threshold": args.threshold,

@@ -32,19 +32,22 @@ __all__ = ["SensitivityGatedCostAware"]
 
 
 class SensitivityGatedCostAware(Policy):
-    """Cost-aware placement with low-stability decisions held one tick.
+    """Placement with low-stability decisions held one tick.
 
-    Wraps a :class:`TpuCostAwarePolicy`; each tick runs ONE batched
-    sensitivity call (replica 0 of which is the production decision, so
-    gating adds no second placement pass) and overrides to −1 any placed
-    task with ``stability < threshold`` that has not already been held
-    ``max_holds`` times.  Held tasks re-enter through the scheduler's
-    wait queue and are re-scored — with fresh noise — next tick; after
-    ``max_holds`` holds the nominal decision goes through regardless, so
-    a permanently-marginal task cannot starve.
+    Wraps any device policy exposing ``placement_sensitivity`` (the
+    cost-aware arm by default; pass ``inner=TpuFirstFitPolicy(
+    decreasing=True)`` for the VBP arm — VERDICT r04 item 2); each tick
+    runs ONE batched sensitivity call (replica 0 of which is the
+    production decision, so gating adds no second placement pass) and
+    overrides to −1 any placed task with ``stability < threshold`` that
+    has not already been held ``max_holds`` times.  Held tasks re-enter
+    through the scheduler's wait queue and are re-scored — with fresh
+    noise — next tick; after ``max_holds`` holds the nominal decision
+    goes through regardless, so a permanently-marginal task cannot
+    starve.
     """
 
-    name = "cost_aware_sensitivity_gated"
+    name = "cost_aware_sensitivity_gated"  # refined per-inner in __init__
 
     def __init__(
         self,
@@ -61,6 +64,13 @@ class SensitivityGatedCostAware(Policy):
         if inner is not None and inner_kwargs:
             raise ValueError("pass inner or inner_kwargs, not both")
         self.inner = inner or TpuCostAwarePolicy(**inner_kwargs)
+        if not hasattr(self.inner, "placement_sensitivity"):
+            raise TypeError(
+                f"{type(self.inner).__name__} has no placement_sensitivity"
+                " — the gate needs the batched noise-replica kernel"
+            )
+        inner_name = getattr(self.inner, "name", type(self.inner).__name__)
+        self.name = f"{inner_name}_sensitivity_gated"
         self.threshold = threshold
         self.n_replicas = n_replicas
         self.perturb = perturb
@@ -75,21 +85,29 @@ class SensitivityGatedCostAware(Policy):
             "forced_through": 0,  # low-stability but hold budget exhausted
             "stability_sum": 0.0,
             "min_stability": 1.0,
+            # Wall seconds spent inside the batched sensitivity calls —
+            # the gate's own price (VERDICT r04: "the gate's per-tick
+            # wall cost is unmeasured anywhere").
+            "sensitivity_wall_s": 0.0,
         }
 
     def bind(self, scheduler) -> None:
         self.inner.bind(scheduler)
 
     def place(self, ctx: TickContext) -> np.ndarray:
+        import time
+
         # Fresh noise per tick (seed keyed on the tick ordinal): a held
         # task is re-judged against new draws, not the sample that
         # flagged it.
+        t0 = time.perf_counter()
         nominal, stability, _ = self.inner.placement_sensitivity(
             ctx,
             n_replicas=self.n_replicas,
             perturb=self.perturb,
             seed=self.noise_seed + ctx.tick_seq,
         )
+        self.stats["sensitivity_wall_s"] += time.perf_counter() - t0
         placements = np.asarray(nominal, dtype=np.int64).copy()
         st = self.stats
         st["ticks"] += 1
@@ -120,5 +138,10 @@ class SensitivityGatedCostAware(Policy):
             st.pop("stability_sum") / st["placed_nominal"]
             if st["placed_nominal"]
             else None
+        )
+        st["sensitivity_wall_s"] = round(st["sensitivity_wall_s"], 3)
+        st["sensitivity_wall_per_tick_s"] = (
+            round(st["sensitivity_wall_s"] / st["ticks"], 4)
+            if st["ticks"] else None
         )
         return st

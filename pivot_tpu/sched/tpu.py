@@ -279,6 +279,34 @@ class _DevicePolicyBase(Policy):
         unscrambled[np.asarray(order)] = out
         return unscrambled
 
+    def _mc_sensitivity(self, ctx, order, batched_place, n_replicas,
+                        perturb, seed):
+        """Shared Monte-Carlo scaffolding behind every policy's
+        ``placement_sensitivity``: replica 0 carries the exact
+        availability snapshot (its placements ARE the production
+        decision), replicas 1..R−1 draw ±``perturb`` multiplicative
+        noise, and ``stability[t]`` is the fraction of replicas agreeing
+        with the nominal host for task t.  ``batched_place(avail_r, dem,
+        valid) -> [R, B]`` supplies the policy's own batched kernel.
+        Returns ``(nominal [T], stability [T], placements [R, T])`` in
+        ctx task order."""
+        T = ctx.n_tasks
+        avail, dem, valid = self._padded(ctx, order)
+        rng = np.random.default_rng(seed)
+        noise = rng.uniform(
+            1 - perturb, 1 + perturb, size=(n_replicas, ctx.n_hosts, 1)
+        )
+        noise[0] = 1.0  # replica 0 = the production decision
+        avail_r = jnp.asarray(np.asarray(avail)[None] * noise,
+                              dtype=self.dtype)
+        p = np.asarray(batched_place(avail_r, dem, valid))  # [R, B]
+        placements = np.stack(
+            [self._unpad(row, T, order) for row in p]
+        )  # [R, T] in ctx order
+        nominal = placements[0]
+        stability = (placements == nominal[None, :]).mean(axis=0)
+        return nominal, stability, placements
+
 
 class TpuOpportunisticPolicy(_DevicePolicyBase):
     name = "opportunistic_tpu"
@@ -316,6 +344,27 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
         placements, _ = first_fit_kernel(avail, dem, valid, strict=False)
         return self._unpad(placements, T, order)
 
+    def placement_sensitivity(self, ctx: TickContext, n_replicas: int = 256,
+                              perturb: float = 0.05, seed: int = 0):
+        """Monte-Carlo robustness of this tick's first-fit decision —
+        same contract as :meth:`TpuCostAwarePolicy.placement_sensitivity`
+        (replica 0 is the production decision), scoring with this arm's
+        own kernel so the sensitivity-gated dispatcher can wrap the VBP
+        arm (ref ``scheduler/vbp.py:9-17``)."""
+        import jax
+
+        order = None
+        if self.decreasing:
+            order = _sort_decreasing(ctx.demands, list(range(ctx.n_tasks)))
+            ctx.visit_order = order  # ref returns the sorted list (vbp.py:17)
+        return self._mc_sensitivity(
+            ctx, order,
+            lambda avail_r, dem, valid: jax.vmap(
+                lambda a: first_fit_kernel(a, dem, valid, strict=False)[0]
+            )(avail_r),
+            n_replicas, perturb, seed,
+        )
+
 
 class TpuBestFitPolicy(_DevicePolicyBase):
     name = "best_fit_tpu"
@@ -334,6 +383,26 @@ class TpuBestFitPolicy(_DevicePolicyBase):
         avail, dem, valid = self._padded(ctx, order)
         placements, _ = best_fit_kernel(avail, dem, valid)
         return self._unpad(placements, T, order)
+
+    def placement_sensitivity(self, ctx: TickContext, n_replicas: int = 256,
+                              perturb: float = 0.05, seed: int = 0):
+        """Monte-Carlo robustness of this tick's best-fit decision —
+        same contract as :meth:`TpuCostAwarePolicy.placement_sensitivity`
+        (replica 0 is the production decision), scoring with this arm's
+        own kernel (ref ``scheduler/vbp.py:20-42``)."""
+        import jax
+
+        order = None
+        if self.decreasing:
+            order = _sort_decreasing(ctx.demands, list(range(ctx.n_tasks)))
+            ctx.visit_order = order  # ref returns the sorted list (vbp.py:42)
+        return self._mc_sensitivity(
+            ctx, order,
+            lambda avail_r, dem, valid: jax.vmap(
+                lambda a: best_fit_kernel(a, dem, valid)[0]
+            )(avail_r),
+            n_replicas, perturb, seed,
+        )
 
 
 class TpuCostAwarePolicy(_DevicePolicyBase):
@@ -473,52 +542,43 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             )
         if self.topology is None:
             raise RuntimeError("bind() the policy to a scheduler first")
-        T = ctx.n_tasks
         order, az_arr, ng_arr, _gr, _ri = self._anchor_stream(ctx)
-        avail, dem, valid = self._padded(ctx, order)
-        rng = np.random.default_rng(seed)
-        noise = rng.uniform(
-            1 - perturb, 1 + perturb, size=(n_replicas, ctx.n_hosts, 1)
-        )
-        noise[0] = 1.0  # replica 0 = the production decision
-        avail_r = jnp.asarray(np.asarray(avail)[None] * noise, dtype=self.dtype)
-        args = (
-            dem,
-            valid,
-            jnp.asarray(ng_arr),
-            jnp.asarray(az_arr),
-            self.topology.cost,
-            self.topology.bw,
-            self.topology.host_zone,
-            jnp.asarray(ctx.host_task_counts, dtype=jnp.int32),
-        )
-        kw = dict(
-            bin_pack=self.bin_pack,
-            sort_hosts=self.sort_hosts,
-            host_decay=self.host_decay,
-        )
-        # Kernel choice mirrors _device_place exactly: an explicit
-        # use_pallas override wins, and the auto default requires the
-        # TPU backend AND f32 (the Pallas kernel is f32-only — an f64
-        # policy must not have its inputs silently quantized).
-        use_pallas = self.use_pallas
-        if use_pallas is None:
-            use_pallas = (
-                jax.default_backend() == "tpu" and self.dtype == jnp.float32
+
+        def batched(avail_r, dem, valid):
+            args = (
+                dem,
+                valid,
+                jnp.asarray(ng_arr),
+                jnp.asarray(az_arr),
+                self.topology.cost,
+                self.topology.bw,
+                self.topology.host_zone,
+                jnp.asarray(ctx.host_task_counts, dtype=jnp.int32),
             )
-        if use_pallas:
-            p, _ = cost_aware_pallas_batched(avail_r, *args, **kw)
-        else:
-            p, _ = jax.vmap(
+            kw = dict(
+                bin_pack=self.bin_pack,
+                sort_hosts=self.sort_hosts,
+                host_decay=self.host_decay,
+            )
+            # Kernel choice mirrors _device_place exactly: an explicit
+            # use_pallas override wins, and the auto default requires the
+            # TPU backend AND f32 (the Pallas kernel is f32-only — an f64
+            # policy must not have its inputs silently quantized).
+            use_pallas = self.use_pallas
+            if use_pallas is None:
+                use_pallas = (
+                    jax.default_backend() == "tpu"
+                    and self.dtype == jnp.float32
+                )
+            if use_pallas:
+                return cost_aware_pallas_batched(avail_r, *args, **kw)[0]
+            return jax.vmap(
                 lambda a: cost_aware_kernel(a, *args, **kw)
-            )(avail_r)
-        p = np.asarray(p)  # [R, B] in kernel task order
-        placements = np.stack(
-            [self._unpad(row, T, order) for row in p]
-        )  # [R, T] in ctx order
-        nominal = placements[0]
-        stability = (placements == nominal[None, :]).mean(axis=0)
-        return nominal, stability, placements
+            )(avail_r)[0]
+
+        return self._mc_sensitivity(
+            ctx, order, batched, n_replicas, perturb, seed
+        )
 
     def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks

@@ -80,6 +80,51 @@ def test_gate_fresh_noise_seed_per_tick():
     assert inner.calls == [100, 107]
 
 
+def test_vbp_placement_sensitivity_replica0_is_production():
+    """The first-fit/best-fit sensitivity methods (VERDICT r04 item 2 —
+    the VBP wrap) must honour the contract: replica 0's placements ARE
+    the production ``place()`` decision, stability ∈ [0, 1]."""
+    import bench as bench_mod
+    from pivot_tpu.sched.tpu import TpuBestFitPolicy, TpuFirstFitPolicy
+
+    ctx = bench_mod._build_batch(12, 24, seed=3)
+    for cls in (TpuFirstFitPolicy, TpuBestFitPolicy):
+        pol = cls(decreasing=True)
+        pol.bind(ctx.scheduler)
+        avail0 = ctx.avail.copy()
+        nominal, stability, placements = pol.placement_sensitivity(
+            ctx, n_replicas=8, perturb=0.2, seed=0
+        )
+        ctx.avail[:] = avail0
+        prod = pol.place(ctx)
+        ctx.avail[:] = avail0
+        assert nominal.tolist() == prod.tolist(), cls.__name__
+        assert placements.shape == (8, ctx.n_tasks)
+        assert float(stability.min()) >= 0.0
+        assert float(stability.max()) <= 1.0
+        # Every nominal agreement row: replica 0 always agrees with
+        # itself, so no stability can be 0 for a placed task.
+        assert (stability[nominal >= 0] >= 1.0 / 8).all()
+
+
+def test_gate_wraps_vbp_inner():
+    """SensitivityGatedCostAware generalizes to any inner exposing
+    placement_sensitivity; the policy name reflects the wrapped arm."""
+    from pivot_tpu.sched.tpu import TpuFirstFitPolicy
+
+    pol = SensitivityGatedCostAware(inner=TpuFirstFitPolicy(decreasing=True))
+    assert pol.name == "first_fit_tpu_sensitivity_gated"
+
+    class _NoSens:
+        pass
+
+    try:
+        SensitivityGatedCostAware(inner=_NoSens())
+        raise AssertionError("expected TypeError")
+    except TypeError:
+        pass
+
+
 def test_cli_sensitivity_paired_experiment(tmp_path):
     """The user-invocable flow end-to-end at toy scale: paired runs per
     seed, signed deltas, gate telemetry in the report."""

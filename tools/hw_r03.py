@@ -54,11 +54,15 @@ def _load_bench():
 _bench = None
 
 
-def _fetch_timed(fn, fetch, n=3):
+def _get_bench():
     global _bench
     if _bench is None:
         _bench = _load_bench()
-    per_call, _out = _bench._timed_calls(fn, fetch, n=n)
+    return _bench
+
+
+def _fetch_timed(fn, fetch, n=3):
+    per_call, _out = _get_bench()._timed_calls(fn, fetch, n=n)
     return per_call
 
 
@@ -93,6 +97,10 @@ def congestion_arm(quick: bool, n_apps=25, n_hosts=100,
         ("static", dict()),
         ("congested", dict(congestion=True)),
         ("realtime", dict(congestion=True, realtime_scoring=True)),
+        # r05 addendum (VERDICT r04 item 1b): the host-pair [H,H] pipe
+        # rung — its one-hot outer-product backlog update is the arm
+        # most likely to diverge from CPU timing on the MXU.
+        ("pairs", dict(congestion="pairs")),
     ]
     if quick:
         arms = arms[:2]
@@ -155,13 +163,9 @@ def lifo_cost(n_apps=25, n_hosts=100, n_replicas=256) -> dict:
 def sensitivity_throughput(H=512, T=2048, R=1024) -> dict:
     """placement_sensitivity at the bench shape — the replica-batched
     kernel's production consumer, end-to-end."""
-    global _bench
-    if _bench is None:
-        _bench = _load_bench()
-
     from pivot_tpu.sched.tpu import TpuCostAwarePolicy
 
-    ctx = _bench._build_batch(H, T, seed=7)
+    ctx = _get_bench()._build_batch(H, T, seed=7)
     pol = TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True)
     pol.bind(ctx.scheduler)
     # Warm first (jit trace + XLA compile must not pollute the number),
@@ -183,6 +187,43 @@ def sensitivity_throughput(H=512, T=2048, R=1024) -> dict:
         "stability_mean": round(float(stability.mean()), 4),
         "stability_p5": round(float(np.percentile(stability, 5)), 4),
     }
+
+
+def gate_tick_cost(H=100, R=256) -> dict:
+    """r05 addendum (VERDICT r04 item 1b): the sensitivity GATE's
+    per-tick device cost at its production config (R=256, perturb=0.05
+    — ``sched/sensitivity.py:87-92``), next to the plain nominal pass it
+    replaces.  Measured at two per-tick task counts bracketing the
+    canonical trace workload's tick sizes.  Both paths go through the
+    batch-fetch timing primitive (warm + serialized calls) so a single
+    tunnel-RTT jitter cannot swing the published overhead ratio."""
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+
+    out = {}
+    for T in (64, 256):
+        ctx = _get_bench()._build_batch(H, T, seed=7)
+        pol = TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True)
+        pol.bind(ctx.scheduler)
+        # Both calls return forced numpy, so the walls are complete
+        # executions; _fetch_timed warms once (trace + XLA compile must
+        # not pollute the number) then averages serialized calls.
+        plain = _fetch_timed(
+            lambda: pol.place(ctx), lambda r: int(np.asarray(r)[0])
+        )
+        gated = _fetch_timed(
+            lambda: pol.placement_sensitivity(
+                ctx, n_replicas=R, perturb=0.05, seed=0
+            ),
+            lambda r: int(np.asarray(r[0])[0]),
+        )
+        out[f"T{T}"] = {
+            "plain_place_s": round(plain, 4),
+            "gated_tick_s": round(gated, 4),
+            "overhead_x": round(gated / max(plain, 1e-9), 1),
+        }
+    out["R"] = R
+    out["H"] = H
+    return out
 
 
 def serve_warm(n_apps=25, replicas=256) -> dict:
@@ -249,6 +290,7 @@ def main() -> None:
         ("congestion_arm", lambda: congestion_arm(ns.quick)),
         ("lifo_cost", lifo_cost),
         ("sensitivity", sensitivity_throughput),
+        ("gate_tick_cost", gate_tick_cost),
         ("serve_warm", serve_warm),
     ):
         try:

@@ -16,10 +16,10 @@ Campaign items (priority order, same ranking as tools/hw_r03.py):
   1. ``hw_r03``       → figures/hw_r03.json          (rc 0 = complete;
      rc 2 = partial: artifact banked as hw_r03_partial.json and the item
      retried at later windows, up to ``MAX_PARTIAL_ATTEMPTS``)
-  2. ``tpu_validate`` → figures/tpu_validate_r04.json (incl. host_scale
+  2. ``tpu_validate`` → figures/tpu_validate_r05.json (incl. host_scale
      at H ∈ {600, 1024} — the parity rows VERDICT r03 asks for)
   3. ``bench``        → BENCH_TPU.json machine-written by bench.py's own
-     ``_write_tpu_record`` path; stdout kept as figures/bench_tpu_r04.json.
+     ``_write_tpu_record`` path; stdout kept as figures/bench_tpu_r05.json.
      bench.py exits 0 even on its CPU fallback, so the watcher verifies
      the reported backend is non-CPU before marking the item done.
 
@@ -70,13 +70,13 @@ ITEMS = [
     (
         "tpu_validate",
         [sys.executable, "tools/tpu_validate.py"],
-        os.path.join(FIGURES, "tpu_validate_r04.json"),
+        os.path.join(FIGURES, "tpu_validate_r05.json"),
         3600,
     ),
     (
         "bench",
         [sys.executable, "bench.py"],
-        os.path.join(FIGURES, "bench_tpu_r04.json"),
+        os.path.join(FIGURES, "bench_tpu_r05.json"),
         3600,
     ),
 ]
@@ -136,11 +136,16 @@ def _git_commit(paths, message: str) -> None:
             ["git", "commit", "-m", message], cwd=REPO,
             capture_output=True, text=True, timeout=60,
         )
-        # rc 1 with "nothing to commit" is benign; anything else is a
-        # real banking failure and must reach the log.
-        if p.returncode not in (0, 1) or (
-            p.returncode == 1 and "nothing to commit" not in p.stdout
-        ):
+        # rc 1 meaning "no staged changes" is benign — git words it
+        # "nothing to commit" on a clean tree but "no changes added to
+        # commit" when unrelated unstaged edits exist; anything else is
+        # a real banking failure and must reach the log.
+        benign = (
+            "nothing to commit" in p.stdout
+            or "no changes added to commit" in p.stdout
+            or "nothing added to commit" in p.stdout
+        )
+        if p.returncode not in (0, 1) or (p.returncode == 1 and not benign):
             _log({"event": "git_commit_failed", "rc": p.returncode,
                   "stderr": p.stderr[-300:], "stdout": p.stdout[-200:]})
     except (subprocess.SubprocessError, OSError) as exc:

@@ -1,0 +1,153 @@
+"""End-to-end drill of the tunnel watcher's banking path (VERDICT r04 #1a).
+
+Every ``git`` event in the round-4 banked watcher log is an rc-128
+failure — drive tests added ``/tmp`` artifact paths, which git rejects —
+so the production ``_git_commit`` had never succeeded when it mattered.
+This drill runs the REAL watcher ``main()`` loop with exactly two
+substitutions:
+
+  * ``probe`` is stubbed to report a live backend (the tunnel is down;
+    the drill is about the landing path, not the link), and
+  * ``ITEMS`` is replaced with one cheap item whose artifact lives
+    INSIDE ``figures/`` — the same constraint the production artifacts
+    satisfy — so ``git add`` succeeds.
+
+Everything else — state load/save, ``fire_campaign``, ``run_item``'s
+subprocess + artifact write, both ``_git_commit`` call sites, the JSONL
+log — is the production code.  After the drill the state file is
+rewritten to hold ONLY the real campaign items' banked progress (the
+drill's own "done" entry and any stub residue are dropped — never a
+blanket wipe, so an already-banked hour-long item is not re-run at the
+next live window) and the reset itself is logged.
+
+The drill refuses to run while a live watcher process holds the state
+file: both sides rewrite it on their own clock, so a concurrent drill
+would either wipe the watcher's progress or have its reset silently
+overwritten seconds later.
+
+Usage: python tools/watcher_drill.py   (exits 0 iff the drill commit
+landed in git and the state file is clean)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import tunnel_watcher as tw  # noqa: E402
+
+
+def _live_watcher_pids() -> list:
+    """PIDs of running tunnel_watcher.py processes (not this drill)."""
+    try:
+        ps = subprocess.run(
+            ["ps", "-eo", "pid,args"], capture_output=True, text=True,
+            timeout=10,
+        ).stdout
+    except (subprocess.SubprocessError, OSError):
+        return []
+    pids = []
+    for ln in ps.splitlines():
+        parts = ln.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, args = parts
+        if "tunnel_watcher.py" in args and "ps -eo" not in args \
+                and not args.startswith(("grep", "/bin/bash", "bash", "sh")):
+            pids.append(int(pid))
+    return pids
+
+
+def main() -> int:
+    live = _live_watcher_pids()
+    if live:
+        print(json.dumps({
+            "ok": False,
+            "error": f"live watcher holds the state file (pids {live}) — "
+                     "stop it before drilling",
+        }))
+        return 2
+
+    real_items = [name for name, *_ in tw.ITEMS]
+    pre_state = tw._load_state()
+    drill_artifact = os.path.join(tw.FIGURES, "watcher_drill.json")
+    tw.probe = lambda timeout: True  # stubbed live probe — drill only
+    tw.ITEMS = [
+        (
+            "drill",
+            [
+                sys.executable,
+                "-c",
+                (
+                    "import json; print(json.dumps({'ok': True,"
+                    " 'drill': 'watcher banking path, stubbed probe',"
+                    " 'figures_internal_artifact': True}))"
+                ),
+            ],
+            drill_artifact,
+            60,
+        )
+    ]
+
+    head_before = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=tw.REPO,
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+
+    # The production main() loop, single pass.
+    sys.argv = ["tunnel_watcher.py", "--once"]
+    try:
+        rc = tw.main()
+    except SystemExit as exc:  # argparse or main's own exit
+        rc = int(exc.code or 0)
+
+    head_after = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=tw.REPO,
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    committed = head_after != head_before
+    subject = subprocess.run(
+        ["git", "log", "-1", "--format=%s"], cwd=tw.REPO,
+        capture_output=True, text=True,
+    ).stdout.strip()
+
+    # Rewrite the state file keeping ONLY real campaign items' progress:
+    # the drill's "done" marker must not stop the real watcher from
+    # running the real items, VERDICT r04 flagged the stub residue the
+    # round-4 drive tests left behind, and a blanket wipe would discard
+    # any genuinely banked item.
+    clean = {
+        "done": {k: v for k, v in pre_state["done"].items()
+                 if k in real_items},
+        "partial_attempts": {
+            k: v for k, v in pre_state["partial_attempts"].items()
+            if k in real_items
+        },
+        # Preserve the cumulative probe counter: the banked log numbers
+        # probe events by it, and resetting would duplicate attempt
+        # numbers in figures/watcher_log.jsonl (the drill added exactly
+        # one probe, which is honest history, not residue).
+        "attempts": pre_state.get("attempts", 0),
+    }
+    tw._save_state(clean)
+    tw._log({"event": "drill_complete_state_reset", "committed": committed,
+             "head": head_after[:12], "subject": subject})
+
+    ok = rc == 0 and committed and os.path.exists(drill_artifact)
+    print(json.dumps({
+        "ok": ok,
+        "watcher_rc": rc,
+        "commit_landed": committed,
+        "commit_subject": subject,
+        "artifact": os.path.relpath(drill_artifact, tw.REPO),
+        "state_reset": clean,
+    }, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
