@@ -45,6 +45,10 @@ def main() -> None:
     ap.add_argument("--des-seeds", type=int, default=2)
     ap.add_argument("--hosts", type=int, default=100)
     ap.add_argument("--apps", type=int, default=50)
+    ap.add_argument("--modes", nargs="+", default=["static", "congested"],
+                    help="estimator transfer-model rungs to calibrate "
+                         "(add 'pairs' for the host-pair pipe rung — "
+                         "VERDICT r04 item 4)")
     ap.add_argument("--out", default="")
     ns = ap.parse_args()
 
@@ -56,12 +60,12 @@ def main() -> None:
 
     rep = calibrate(
         TRACE, n_hosts=ns.hosts, n_apps=ns.apps, policy=ns.policy,
-        x64=True, tick_order="lifo", modes=("static", "congested"),
+        x64=True, tick_order="lifo", modes=tuple(ns.modes),
         cluster_seeds=ns.cluster_seeds, des_seeds=ns.des_seeds, seed=0,
     )
     summary = {}
     per_cluster = {}
-    for mode in ("static", "congested"):
+    for mode in ns.modes:
         summary[mode] = {}
         for k in _METRICS:
             s = rep["cluster_summary"][mode][k]
@@ -82,13 +86,13 @@ def main() -> None:
              "per_cluster_egress": per_cluster, "calibrate": rep},
             f, indent=2,
         )
-    eg = summary["static"]["egress_cost"]
-    print(json.dumps({
-        "policy": ns.policy,
-        "static_egress_mean": eg["mean"], "static_egress_se": eg["se"],
-        "congested_egress_mean": summary["congested"]["egress_cost"]["mean"],
-        "n": eg["n"], "wrote": out,
-    }), flush=True)
+    sentinel = {"policy": ns.policy, "wrote": out}
+    for mode in ns.modes:
+        eg = summary[mode]["egress_cost"]
+        sentinel[f"{mode}_egress_mean"] = eg["mean"]
+        sentinel[f"{mode}_egress_se"] = eg["se"]
+        sentinel["n"] = eg["n"]
+    print(json.dumps(sentinel), flush=True)
 
 
 if __name__ == "__main__":

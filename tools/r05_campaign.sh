@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-5 CPU campaign chain. Single-core machine: strictly sequential,
+# one artifact per step, chain survives individual step failures.
+# Steps map to VERDICT r04 items 2 (sensitivity at material scale +
+# VBP wrap + gate price), 4 (pairs rung on best-fit), 5 (ladder at
+# statistical strength).
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+LOG=figures/r05_campaign.log
+mkdir -p figures/sensitivity output
+echo "=== chain start $(date -u +%FT%TZ)" >> "$LOG"
+
+step () {
+  name=$1; tmo=$2; shift 2
+  echo "--- $name start $(date -u +%FT%TZ)" >> "$LOG"
+  timeout "$tmo" "$@" 2>> "$LOG"
+  echo "--- $name rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+}
+
+# 1. Sensitivity gate wrapping the VBP arm where egress is material
+#    (600 hosts x 1000 apps; VBP leaves ~$101 egress at this scale).
+step sens_vbp_600x1000 14400 \
+  python -m pivot_tpu.experiments.cli --num-hosts 600 --job-dir data/jobs \
+    --output-dir output --seed 0 sensitivity --num-apps 1000 \
+    --des-seeds 3 --policy vbp \
+  > figures/sensitivity/report_vbp_600x1000.json
+
+# 2. Same scale, canonical cost-aware arm (absolute-$ context row).
+step sens_costaware_600x1000 10800 \
+  python -m pivot_tpu.experiments.cli --num-hosts 600 --job-dir data/jobs \
+    --output-dir output --seed 0 sensitivity --num-apps 1000 \
+    --des-seeds 2 --policy cost-aware \
+  > figures/sensitivity/report_costaware_600x1000.json
+
+# 3. Pairs rung on the best-fit worst cluster (seed 3): the pinned
+#    mechanism (zone aggregation overstates contention) predicts
+#    pairs <= static error here.
+step diag_bestfit_c3_pairs 7200 \
+  python tools/bias_diagnose.py --policy best-fit --hosts 100 --apps 50 \
+    --first-seed 3 --tick-order lifo --x64 --pairs \
+    --out figures/diag_bestfit_c3_pairs.json
+
+# 4. Ladder at statistical strength: 5 cluster seeds per rung
+#    (was 1 — VERDICT r04 item 5). Overwrites the canonical rung files;
+#    the single-seed versions live in git history.
+step ladder_static 14400 \
+  python tools/bias_diagnose.py --policy first-fit --hosts 100 --apps 50 \
+    --cluster-seeds 5 --tick-order lifo --x64 \
+    --out figures/ladder_ff_static.json
+step ladder_zone 14400 \
+  python tools/bias_diagnose.py --policy first-fit --hosts 100 --apps 50 \
+    --cluster-seeds 5 --tick-order lifo --x64 --congestion \
+    --out figures/ladder_ff_zone.json
+step ladder_pairs 14400 \
+  python tools/bias_diagnose.py --policy first-fit --hosts 100 --apps 50 \
+    --cluster-seeds 5 --tick-order lifo --x64 --pairs \
+    --out figures/ladder_ff_pairs.json
+
+# 5. 24-cluster best-fit campaign with the pairs rung included:
+#    does pairs beat static on the arm whose congested error is +74%?
+step bias_bestfit_pairs 21600 \
+  python tools/bias_campaign.py --policy best-fit --cluster-seeds 24 \
+    --des-seeds 2 --modes static congested pairs \
+    --out figures/bias_r05_best-fit.json
+
+echo "=== chain done $(date -u +%FT%TZ)" >> "$LOG"
