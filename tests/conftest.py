@@ -135,6 +135,10 @@ _SLOW_TESTS = {
         "test_crossover_interpret_smoke",
     ],
     "test_trace.py": ["test_device_profile_captures"],
+    "test_watcher.py": [
+        "test_run_item_status_routing",
+        "test_fire_campaign_banks_partial_then_accepts",
+    ],
 }
 
 
