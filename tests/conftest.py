@@ -22,6 +22,17 @@ import jax  # noqa: E402
 # backend; TPU runs use f32 (see pivot_tpu/ops/kernels.py docstring).
 jax.config.update("jax_enable_x64", True)
 
+# The full tier is compile-bound (the forms-parity test alone compiles 16
+# full-rollout programs, ~62 s of its wall): persist XLA executables
+# across suite runs like every production entry point already does
+# (VERDICT r04 item 7 — the pre-commit gate's wall is dominated by
+# recompiling unchanged programs).  Cache entries are keyed on backend +
+# flags, so the 8-device x64 CPU test programs never collide with
+# production TPU entries; a cold run pays one population pass.
+from pivot_tpu.utils import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
 import pytest  # noqa: E402
 
 
