@@ -437,11 +437,12 @@ def parse_args(argv=None):
     )
     sens.add_argument("--num-apps", type=int, dest="num_apps", default=30)
     sens.add_argument("--policy", default="cost-aware",
-                      choices=["cost-aware", "vbp"],
-                      help="arm to gate: the canonical cost-aware policy "
-                           "or the VBP arm (first-fit decreasing) — the "
-                           "arm whose egress headroom is 100x larger at "
-                           "scale (VERDICT r04 item 2)")
+                      choices=["cost-aware", "vbp", "best-fit"],
+                      help="arm to gate: the canonical cost-aware policy, "
+                           "the VBP arm (first-fit decreasing — the arm "
+                           "whose egress headroom is 100x larger at "
+                           "scale, VERDICT r04 item 2), or best-fit "
+                           "decreasing")
     sens.add_argument("--replicas", type=int, default=256,
                       help="noise replicas per tick (the batched kernel's "
                            "native axis)")
@@ -823,14 +824,29 @@ def run_sensitivity(args) -> dict:
 
     from pivot_tpu.experiments.runner import ExperimentRun
     from pivot_tpu.sched.sensitivity import SensitivityGatedCostAware
-    from pivot_tpu.sched.tpu import TpuCostAwarePolicy, TpuFirstFitPolicy
+    from pivot_tpu.sched.tpu import (
+        TpuBestFitPolicy,
+        TpuCostAwarePolicy,
+        TpuFirstFitPolicy,
+    )
 
     trace = _list_traces(args.job_dir, 1)[0]
     policy_name = getattr(args, "policy", "cost-aware")
+    # Recorded in the report: a reader comparing against the calibrate /
+    # overall arms must be able to see which packing variant ran (VBP is
+    # first-fit DEcreasing per the reference, config.py:111; best-fit's
+    # canonical arm is plain, decreasing=False).
+    decreasing = None
     if policy_name == "vbp":
-        # The reference's VBP arm: first-fit decreasing (config.py:111).
+        decreasing = True
+
         def make_inner():
             return TpuFirstFitPolicy(decreasing=True)
+    elif policy_name == "best-fit":
+        decreasing = False
+
+        def make_inner():
+            return TpuBestFitPolicy(decreasing=False)
     else:
         canonical = dict(bin_pack="first-fit", sort_tasks=True,
                          sort_hosts=True)
@@ -899,6 +915,7 @@ def run_sensitivity(args) -> dict:
     report = {
         "trace": trace,
         "policy": policy_name,
+        **({"decreasing": decreasing} if decreasing is not None else {}),
         "n_hosts": args.n_hosts,
         "n_apps": args.num_apps,
         "gate_cost": {
