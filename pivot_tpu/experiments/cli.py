@@ -8,7 +8,10 @@ directory, write the per-run JSON metric layout, and render the matching
 plots.  Additions: ``--device {naive,numpy,tpu}`` selects the policy
 backend, ``--trace-limit`` bounds the grid, and runs execute sequentially
 by default (fork with ``--workers N`` like the reference's unconditional
-``multiprocessing`` fan-out, ``alibaba/sim.py:187-195``).
+``multiprocessing`` fan-out, ``alibaba/sim.py:187-195``, or — device
+backend only — advance ``--batch-runs G`` runs tick-synchronously in one
+process with their per-tick placement dispatches coalesced into single
+vmapped device calls, bit-identical to sequential execution).
 
 Usage:
   python -m pivot_tpu.experiments.cli --num-hosts 100 overall --num-apps 100
@@ -110,20 +113,12 @@ def _is_complete(spec: RunSpec) -> bool:
     return False
 
 
-def _execute_run(spec: RunSpec) -> None:
+def _build_run(spec: RunSpec):
+    """Materialize a spec: cluster + policy + ExperimentRun (not executed)."""
     from pivot_tpu.experiments.runner import ExperimentRun
-    from pivot_tpu.utils.trace import device_profile
-
-    # Grid-level resume.  _run_grid also pre-filters in the parent (so a
-    # worker process is never forked for a skip); this in-run check covers
-    # sequential execution and direct callers, before any construction.
-    if _is_complete(spec):
-        logger.info("skipping completed run %s (%s)",
-                    spec.policy.display_label, spec.data_dir)
-        return
 
     cluster = build_cluster(spec.cluster)
-    run = ExperimentRun(
+    return ExperimentRun(
         spec.policy.display_label,
         cluster,
         make_policy(spec.policy),
@@ -136,6 +131,20 @@ def _execute_run(spec: RunSpec) -> None:
         identity=_spec_identity(spec),
         audit=spec.audit,
     )
+
+
+def _execute_run(spec: RunSpec) -> None:
+    from pivot_tpu.utils.trace import device_profile
+
+    # Grid-level resume.  _run_grid also pre-filters in the parent (so a
+    # worker process is never forked for a skip); this in-run check covers
+    # sequential execution and direct callers, before any construction.
+    if _is_complete(spec):
+        logger.info("skipping completed run %s (%s)",
+                    spec.policy.display_label, spec.data_dir)
+        return
+
+    run = _build_run(spec)
     # Per-run profile dir: jax.profiler names sessions by wall-clock second
     # and hostname, so concurrent/sub-second runs sharing one dir collide.
     # Reuse the run's unique data-dir tail (".../data/<...>/<i>") as the key.
@@ -221,6 +230,13 @@ def parse_args(argv=None):
                              "directory (TensorBoard-loadable)")
     parser.add_argument("--workers", type=int, default=1,
                         help="process-parallel runs (1 = sequential)")
+    parser.add_argument("--batch-runs", type=int, default=1, metavar="G",
+                        help="advance G grid runs tick-synchronously and "
+                             "coalesce their per-tick placement dispatches "
+                             "into one vmapped device call (amortizes the "
+                             "per-call dispatch floor; --device tpu only, "
+                             "implies --no-adaptive; bit-identical to "
+                             "sequential execution)")
     parser.add_argument("--resume", default=None, metavar="EXP_DIR",
                         help="resume an interrupted sweep: reuse this "
                              "experiment directory and skip completed runs")
@@ -477,6 +493,21 @@ def parse_args(argv=None):
             "--realtime-score/--realtime apply to the cost-aware arm only "
             "— no other policy scores on bandwidth"
         )
+    if args.batch_runs > 1:
+        if args.device != "tpu":
+            parser.error(
+                "--batch-runs coalesces device-kernel dispatches — it "
+                "requires --device tpu"
+            )
+        if args.workers > 1:
+            parser.error(
+                "--batch-runs and --workers are mutually exclusive (the "
+                "lock-step driver already runs the grid concurrently)"
+            )
+        # Adaptive routing is timing-dependent (it would make batch
+        # membership — and, on f32 backends, placements — nondeterministic);
+        # the lock-step driver needs the pure device path.
+        args.adaptive = False
     if args.network == "native":
         from pivot_tpu import native
 
@@ -510,8 +541,13 @@ def _list_traces(job_dir: str, limit=None) -> List[str]:
     return out[:limit] if limit else out
 
 
-def _run_grid(specs: List[RunSpec], workers: int):
-    """Execute runs sequentially or across worker processes."""
+def _run_grid(specs: List[RunSpec], workers: int, batch_runs: int = 1):
+    """Execute runs sequentially, across worker processes, or — with
+    ``batch_runs > 1`` — in tick-synchronous lock-step chunks whose
+    per-tick device dispatches coalesce (``runner.run_grid_lockstep``)."""
+    if batch_runs > 1:
+        _run_grid_batched(specs, batch_runs)
+        return
     if workers <= 1:
         for spec in specs:
             _execute_run(spec)
@@ -553,6 +589,41 @@ def _run_grid(specs: List[RunSpec], workers: int):
     _join(active)
 
 
+def _run_grid_batched(specs: List[RunSpec], batch_runs: int) -> None:
+    """Lock-step chunks of ≤ ``batch_runs`` same-policy runs.
+
+    Grouped by policy label before chunking: only same-kernel, same-config
+    ticks can share a vmapped dispatch, so batching across policy arms
+    would park runs without ever coalescing them.  Completed runs are
+    skipped up front (the same resume contract as the other drivers).
+    """
+    from pivot_tpu.experiments.runner import run_grid_lockstep
+
+    pending = []
+    for spec in specs:
+        if _is_complete(spec):
+            logger.info("skipping completed run %s (%s)",
+                        spec.policy.display_label, spec.data_dir)
+        else:
+            pending.append(spec)
+    if any(s.profile_dir for s in pending):
+        logger.warning(
+            "--profile-dir is ignored under --batch-runs (the device "
+            "profiler is process-global; concurrent run sessions collide)"
+        )
+    by_label: dict = {}
+    for spec in pending:
+        by_label.setdefault(spec.policy.display_label, []).append(spec)
+    for label, group in by_label.items():
+        for i in range(0, len(group), batch_runs):
+            chunk = group[i : i + batch_runs]
+            stats: dict = {}
+            run_grid_lockstep([_build_run(s) for s in chunk],
+                              stats_out=stats)
+            logger.info("lockstep chunk %s[%d:%d]: %s",
+                        label, i, i + len(chunk), stats)
+
+
 def _cluster_config(args) -> ClusterConfig:
     return ClusterConfig(
         n_hosts=args.n_hosts,
@@ -580,7 +651,7 @@ def run_overall(args) -> str:
     ]
     logger.info("overall: %d runs (%d traces × %d policies) → %s",
                 len(specs), len(traces), len(policy_set), exp_dir)
-    _run_grid(specs, args.workers)
+    _run_grid(specs, args.workers, args.batch_runs)
     return exp_dir
 
 
@@ -602,7 +673,7 @@ def run_num_apps(args) -> str:
         for pc in policy_set
     ]
     logger.info("num-apps sweep: %d runs → %s", len(specs), exp_dir)
-    _run_grid(specs, args.workers)
+    _run_grid(specs, args.workers, args.batch_runs)
     return exp_dir
 
 

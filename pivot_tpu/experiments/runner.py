@@ -8,8 +8,13 @@ inter-arrival gaps, then stops the scheduler; the run executes to event
 exhaustion and writes the meter's JSON output plus ``avg_runtime``.
 
 Runs are plain callables — the grid driver in ``experiments.cli`` executes
-them sequentially or via ``multiprocessing`` (the reference always forks;
-on a single-core host sequential is faster).
+them sequentially, via ``multiprocessing`` (the reference always forks; on
+a single-core host sequential is faster), or — for device-backed policies —
+tick-synchronously through one cross-run :class:`DispatchBatcher`
+(:func:`run_grid_lockstep`, the ``--batch-runs`` path): G runs advance in
+lock-step and their per-tick placement dispatches coalesce into single
+``[G]``-vmapped device calls, amortizing the per-call dispatch floor the
+reference pays once per OS process.
 """
 
 from __future__ import annotations
@@ -22,11 +27,16 @@ from pivot_tpu.des import Environment
 from pivot_tpu.infra import Cluster
 from pivot_tpu.infra.meter import Meter
 from pivot_tpu.sched import GlobalScheduler, Policy
-from pivot_tpu.utils import LogMixin
+from pivot_tpu.utils import LogMixin, get_logger
 from pivot_tpu.utils.trace import Tracer
 from pivot_tpu.workload.trace import TraceSchedule, load_trace_jobs
 
-__all__ = ["ExperimentRun", "replay_schedule", "sentinel_path"]
+__all__ = [
+    "ExperimentRun",
+    "replay_schedule",
+    "run_grid_lockstep",
+    "sentinel_path",
+]
 
 
 def sentinel_path(data_dir: str, label: str) -> str:
@@ -60,6 +70,106 @@ def replay_schedule(
             break
         last_ts = ts
     scheduler.stop()
+
+
+def run_grid_lockstep(runs, stats_out: Optional[dict] = None) -> list:
+    """Advance several :class:`ExperimentRun`\\ s tick-synchronously through
+    one cross-run dispatch batcher (``pivot_tpu.sched.batch``).
+
+    Each run executes its full DES event loop in its own thread; a
+    device-policy placement call parks the thread at its tick boundary,
+    and the coordinator (this thread) flushes whenever every live run is
+    parked — co-pending same-shape ticks become ONE vmapped device
+    dispatch.  All runs share the tick grid (the global scheduler ticks
+    at ``start + k·interval`` from sim time 0), so runs of one grid stay
+    aligned until their workloads drain; a run with no co-pending
+    partner (desynchronized or last alive) falls back to plain
+    sequential kernel calls.
+
+    Correctness bar (``tests/test_batch_dispatch.py``): per-run
+    placements, meter output, and artifacts are **bit-identical** to the
+    same runs executed sequentially — the kernels are pure per-tick
+    functions, per-run Philox streams are stateless, and vmap preserves
+    each row's op sequence.
+
+    Runs whose policy is not device-backed (or is adaptive — its routing
+    is timing-dependent) execute sequentially first, then the batchable
+    runs execute in lock-step.  Returns per-run summaries in input
+    order; ``stats_out`` (optional dict) receives the batcher's
+    coalescing counters.
+    """
+    import threading
+
+    import jax
+
+    from pivot_tpu.sched.batch import DispatchBatcher
+    from pivot_tpu.sched.tpu import _DevicePolicyBase
+
+    logger = get_logger("runner")
+    batchable, sequential = [], []
+    for i, run in enumerate(runs):
+        if isinstance(run.policy, _DevicePolicyBase) and not run.policy.adaptive:
+            batchable.append((i, run))
+        else:
+            sequential.append((i, run))
+    results: list = [None] * len(runs)
+    if sequential:
+        logger.info(
+            "lockstep grid: %d run(s) not batchable (non-device or "
+            "adaptive policy) — executing sequentially", len(sequential),
+        )
+        for i, run in sequential:
+            results[i] = run.run()
+    if len(batchable) == 1:
+        # A batch of one is the sequential program with extra threads.
+        i, run = batchable[0]
+        results[i] = run.run()
+        batchable = []
+    if not batchable:
+        if stats_out is not None:
+            stats_out.update(runs=0)
+        return results
+
+    # Initialize the backend once, here, before any run thread touches
+    # jax — concurrent first-touch PJRT client creation is not safe.
+    jax.default_backend()
+    batcher = DispatchBatcher(len(batchable))
+    errors: list = [None] * len(batchable)
+
+    def work(slot, idx, run, client):
+        try:
+            run.policy.enable_batching(client)
+            results[idx] = run.run()
+        except BaseException as exc:  # noqa: BLE001 — joined below
+            errors[slot] = exc
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(
+            target=work, args=(slot, idx, run, batcher.client()),
+            name=f"lockstep-{run.label}", daemon=True,
+        )
+        for slot, (idx, run) in enumerate(batchable)
+    ]
+    for t in threads:
+        t.start()
+    batcher.serve()
+    for t in threads:
+        t.join()
+    failed = [e for e in errors if e is not None]
+    if failed:
+        raise failed[0]
+    if stats_out is not None:
+        stats_out.update(batcher.stats)
+    logger.info(
+        "lockstep grid: %d runs, %d kernel dispatches in %d device calls "
+        "(%d coalesced, max batch %d)",
+        len(batchable), batcher.stats["dispatches"],
+        batcher.stats["device_calls"], batcher.stats["coalesced"],
+        batcher.stats["max_group"],
+    )
+    return results
 
 
 class ExperimentRun(LogMixin):

@@ -96,7 +96,9 @@ from pivot_tpu.parallel.ensemble.bill import (  # noqa: F401
 )
 from pivot_tpu.parallel.ensemble.checkpoint import (  # noqa: F401
     _fingerprint,
+    _run_segments_pipelined,
     _segment_step,
+    _segment_step_carry,
     rollout_checkpointed,
     rollout_chunked,
 )
@@ -124,6 +126,7 @@ from pivot_tpu.parallel.ensemble.state import (  # noqa: F401
 from pivot_tpu.parallel.ensemble.sweeps import (  # noqa: F401
     _reshape_rows,
     _row_segment_step,
+    _row_segment_step_carry,
     _run_rows,
     _tile_rows,
     capacity_grid,

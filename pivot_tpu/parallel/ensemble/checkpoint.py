@@ -55,6 +55,21 @@ def _segment_step(
     tick_order: str = "fifo",
 ) -> RolloutState:  # not trigger an XLA recompile of the whole rollout
     """One jitted, vmapped checkpoint segment (at most ``segment_ticks``)."""
+    return _vmapped_segment(
+        state, rt, arr, root_anchor, workload, topo, tick, segment_ticks,
+        faults, totals, policy, task_u, congestion, realtime_scoring,
+        forms, tick_order,
+    )
+
+
+def _vmapped_segment(
+    state, rt, arr, root_anchor, workload, topo, tick, segment_ticks,
+    faults, totals, policy, task_u, congestion, realtime_scoring, forms,
+    tick_order,
+) -> RolloutState:
+    """The one vmapped segment body behind :func:`_segment_step` and
+    :func:`_segment_step_carry` — the twins differ only in jit decoration
+    (donation) and the carry's pending-flag reduction."""
     spec, extras = _pack_extras(faults, task_u)
 
     def seg(s, r, a, ra, *ex):
@@ -67,6 +82,96 @@ def _segment_step(
         )
 
     return jax.vmap(seg)(state, rt, arr, root_anchor, *extras)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tick", "policy", "congestion", "realtime_scoring", "forms",
+        "tick_order",
+    ),
+    donate_argnums=(0,),
+)
+def _segment_step_carry(
+    state: RolloutState,
+    rt,
+    arr,
+    root_anchor,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    segment_ticks,
+    faults=None,
+    totals=None,
+    policy: str = "cost-aware",
+    task_u=None,
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    forms: str = "vector",
+    tick_order: str = "fifo",
+):
+    """:func:`_segment_step` with a **donated, device-resident carry**.
+
+    Two differences from the plain step, both aimed at the per-segment
+    host round-trip the segmented executor pays (RESULTS.md: 256-tick
+    segments cost +14 % over one monolithic call — the toll is
+    dispatch + state traffic, not compute):
+
+      * ``donate_argnums=(0,)`` — the ``[R]``-stacked
+        :class:`RolloutState` input buffers are donated to the output, so
+        the carry stays device-resident across the whole rollout instead
+        of holding two live copies per segment boundary (the tree has a
+        [R, T] finish/stage/place/qpos set plus [R, H, 4] avail — the
+        dominant live allocation at large R).  Callers must NOT reuse
+        the passed state, and must never pass a buffer that aliases a
+        non-donated argument (the segmented executors defensively copy
+        the freshly-initialized state once, before the first call).
+      * returns ``(state, pending)`` where ``pending`` is the scalar
+        early-exit flag (any replica not DONE) computed on-device — the
+        host inspects ONE scalar per segment boundary instead of pulling
+        (or even readiness-checking) the full state tree.
+
+    Same trajectory math as :func:`_segment_step` — a segment entered
+    with nothing pending is a bit-exact no-op (the tick while_loop's
+    condition fails at entry), which is what makes the speculative
+    double-buffered pipeline in :func:`_run_segments_pipelined` safe.
+    """
+    out = _vmapped_segment(
+        state, rt, arr, root_anchor, workload, topo, tick, segment_ticks,
+        faults, totals, policy, task_u, congestion, realtime_scoring,
+        forms, tick_order,
+    )
+    return out, jnp.any(out.stage != _DONE)
+
+
+def _run_segments_pipelined(step, state, max_ticks: int, segment_ticks: int):
+    """Drive a donated-carry segment step to the horizon, double-buffered.
+
+    ``step(state, seg_i32) -> (state, pending)`` must donate its carry
+    and be a bit-exact no-op when nothing is pending.  Segment k+1 is
+    enqueued BEFORE segment k's early-exit flag is fetched, so the
+    device never idles across a segment boundary waiting on the host's
+    continue/stop decision; the flag fetch is one scalar, not the state
+    tree.  When the flag says "done", the one speculative segment
+    already in flight was a no-op, so the trailing state is identical to
+    an unpipelined loop's — results are bit-identical at any
+    ``segment_ticks`` (the ``rollout_checkpointed`` contract).
+
+    The caller's ``state`` buffers are donated by the first call: pass a
+    tree whose buffers nothing else aliases (copy freshly-initialized
+    state — it can alias ``avail0``/``totals``, which ride every call).
+    """
+    ticks = 0
+    flag = None
+    while ticks < max_ticks:
+        seg = min(segment_ticks, max_ticks - ticks)
+        prev = flag
+        state, flag = step(state, jnp.asarray(seg, jnp.int32))
+        ticks += seg
+        # Inspect segment k's flag only after k+1 is on the queue.
+        if prev is not None and not bool(prev):
+            break
+    return state
 
 
 def _fingerprint(
@@ -247,6 +352,32 @@ def rollout_checkpointed(
     # lazily: the package ``__init__`` imports this module, so a
     # module-level import the other way would be circular.
     from pivot_tpu.parallel import ensemble as _pkg
+
+    if not checkpoint_path:
+        # Pure segmented execution (no disk): the donated-carry,
+        # double-buffered pipeline — state never round-trips to host,
+        # each boundary costs one scalar flag fetch, and segment k+1 is
+        # enqueued while k's flag is in flight.  The disk-checkpoint loop
+        # below stays synchronous on purpose: it must materialize the
+        # full state tree to host after every segment anyway.
+        def step(s, seg):
+            return _pkg._segment_step_carry(
+                s, rt, arr, root_anchor, workload, topo, tick=tick,
+                segment_ticks=seg, faults=faults, totals=avail0,
+                policy=policy, task_u=task_u, congestion=congestion,
+                realtime_scoring=realtime_scoring, forms=forms,
+                tick_order=tick_order,
+            )
+
+        if max_ticks > 0 and bool(jnp.any(state.stage != _DONE)):
+            # Copy once: the fresh state's buffers may alias avail0,
+            # which also rides every call as ``totals`` — a donated
+            # buffer must not double as a regular argument.
+            state = _run_segments_pipelined(
+                step, jax.tree_util.tree_map(jnp.copy, state),
+                max_ticks, segment_ticks,
+            )
+        return _finalize_batch(state, workload, topo)
 
     while ticks_done < max_ticks and bool(jnp.any(state.stage != _DONE)):
         seg = min(segment_ticks, max_ticks - ticks_done)

@@ -58,6 +58,19 @@ def _row_segment_step(
     tick_order: str = "fifo",
 ):
     """Advance every row by at most ``segment_ticks`` scheduler ticks."""
+    return _vmapped_row_segment(
+        states, rt, arr, ra, workload, topo, tick, segment_ticks, spec,
+        extras, policy, congestion, realtime_scoring, forms, tick_order,
+    )
+
+
+def _vmapped_row_segment(
+    states, rt, arr, ra, workload, topo, tick, segment_ticks, spec, extras,
+    policy, congestion, realtime_scoring, forms, tick_order,
+):
+    """The one vmapped row-segment body behind :func:`_row_segment_step`
+    and :func:`_row_segment_step_carry` — the twins differ only in jit
+    decoration (donation) and the carry's pending-flag reduction."""
 
     def seg(s, r, a, ra_, *ex):
         f, u, tot, sp, act = _unpack_extras(spec, ex)
@@ -70,6 +83,48 @@ def _row_segment_step(
         )
 
     return jax.vmap(seg)(states, rt, arr, ra, *extras)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tick", "policy", "congestion", "realtime_scoring", "spec", "forms",
+        "tick_order",
+    ),
+    donate_argnums=(0,),
+)
+def _row_segment_step_carry(
+    states,  # [B]-stacked RolloutState — DONATED to the output
+    rt,
+    arr,
+    ra,
+    workload: EnsembleWorkload,
+    topo: DeviceTopology,
+    tick: float,
+    segment_ticks,
+    spec,
+    *extras,
+    policy: str = "cost-aware",
+    congestion: bool = False,
+    realtime_scoring: bool = False,
+    forms: str = "vector",
+    tick_order: str = "fifo",
+):
+    """:func:`_row_segment_step` with a donated carry and an on-device
+    early-exit flag — the sweeps' analog of
+    ``checkpoint._segment_step_carry`` (see its docstring for the
+    donation contract).  ``pending`` honors the rows' ``active`` masks
+    (workload-size sweeps park masked tasks at PENDING forever; they
+    must not keep the pipeline alive)."""
+    out = _vmapped_row_segment(
+        states, rt, arr, ra, workload, topo, tick, segment_ticks, spec,
+        extras, policy, congestion, realtime_scoring, forms, tick_order,
+    )
+    pending = out.stage != _DONE
+    _f, _u, _tot, _sp, act = _unpack_extras(spec, extras)
+    if act is not None:
+        pending = pending & act
+    return out, jnp.any(pending)
 
 
 def _run_rows(
@@ -117,23 +172,29 @@ def _run_rows(
             tick_order=tick_order,
         )
     else:
-        ticks = 0
-        while ticks < max_ticks:
-            seg = min(segment_ticks, max_ticks - ticks)
-            states = _row_segment_step(
-                states, rt, arr, ra, workload, topo, tick,
-                jnp.asarray(seg, jnp.int32), spec, *extras,
+        # Host-side segmented loop (the remote-transport-friendly mode):
+        # donated carry + double-buffered dispatch, same shape as the
+        # checkpoint executor (``checkpoint._run_segments_pipelined``) —
+        # the host inspects one scalar early-exit flag per boundary while
+        # the next segment is already on the device queue.  The initial
+        # copy breaks aliasing with ``avail_rows``/``totals``, which ride
+        # every call as non-donated arguments.
+        from pivot_tpu.parallel.ensemble.checkpoint import (
+            _run_segments_pipelined,
+        )
+
+        def step(s, seg):
+            return _row_segment_step_carry(
+                s, rt, arr, ra, workload, topo, tick, seg, spec, *extras,
                 policy=policy, congestion=congestion,
                 realtime_scoring=realtime_scoring, forms=forms,
                 tick_order=tick_order,
             )
-            jax.block_until_ready(states)
-            ticks += seg
-            pending = states.stage != _DONE
-            if active is not None:
-                pending = pending & active
-            if not bool(jnp.any(pending)):
-                break
+
+        states = _run_segments_pipelined(
+            step, jax.tree_util.tree_map(jnp.copy, states),
+            max_ticks, segment_ticks,
+        )
     return _finalize_batch(states, workload, topo, active)
 
 

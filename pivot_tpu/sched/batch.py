@@ -1,0 +1,331 @@
+"""Cross-run dispatch batching: one device call for many runs' ticks.
+
+The hot path of the DES grid driver is no longer compute-bound but
+*dispatch-bound*: a remote accelerator has a fixed per-call latency floor
+(76–86 ms over this image's tunnel, ``sched/tpu.py``) that dwarfs the
+per-tick kernel compute, and the reference's only answer to many
+concurrent experiments is one OS process per run
+(``alibaba/sim.py:187-195``) — every process pays the full floor alone.
+This module amortizes the floor across runs: G concurrently-stepped DES
+experiment runs submit their per-tick placement-kernel calls to a
+:class:`DispatchBatcher`, which coalesces co-pending calls of identical
+shape into a single ``[G, ...]``-vmapped device dispatch and hands each
+run back its own row.
+
+Correctness contract (the bar the grid driver is held to,
+``tests/test_batch_dispatch.py``): a run's placements are **bit-identical**
+whether its tick was served alone or inside any batch.  This holds
+because the kernels are pure functions of their per-tick inputs — the
+RNG the opportunistic arm consumes is the stateless per-tick Philox
+stream (``sched/rand.py``), keyed on (seed, tick, task), so per-run
+streams stay aligned with the numpy twins no matter how ticks are
+grouped — and ``vmap`` of the placement kernels evaluates each row with
+the same op sequence as the unbatched program.  Batch *composition* may
+vary run-to-run with thread timing; results cannot.
+
+Compilation discipline: the group axis pads to a bucket
+(:func:`group_bucket`, the G-analog of ``sched.tpu.pad_bucket``), so XLA
+compiles one program per (G-bucket, T-bucket, H) triple, never per group
+size.  Pad rows replicate request 0 (no NaNs, no shape churn) and their
+outputs are discarded.
+
+Two layers:
+
+  * :func:`batch_execute` — the pure core: take N same-shaped kernel
+    requests, run one vmapped dispatch, return per-request outputs
+    (host-fetched in ONE transfer — the other half of the
+    amortization).  ``bench.py``'s ``grid_batched`` row times exactly
+    this program against N sequential dispatches.
+  * :class:`DispatchBatcher` — the concurrency layer for the lock-step
+    grid driver (``experiments.runner.run_grid_lockstep``): each DES run
+    advances in its own thread, a blocked :meth:`BatchClient.dispatch`
+    parks the run at its tick boundary, and the coordinator flushes
+    whenever every live run is parked — tick-synchronous lock-step
+    without rewriting the event kernel.  Runs that desynchronize
+    (different tick boundaries, no co-pending partner of the same
+    shape) fall back to a plain sequential kernel call, bit-identical
+    by the contract above.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+__all__ = [
+    "BatchClient",
+    "DispatchBatcher",
+    "batch_execute",
+    "group_bucket",
+]
+
+#: Small-G buckets below the task-axis bucket ladder: grid batches are
+#: typically a handful of runs, where padding 3 → 8 would double the
+#: dispatch's compute for nothing.
+_G_BUCKETS = (2, 4, 8, 16)
+
+
+def group_bucket(g: int) -> int:
+    """Smallest batch bucket ≥ g (caps XLA program count per tick shape).
+
+    1 is its own bucket — a lone request runs the *unbatched* kernel
+    program (the sequential-fallback path), which both skips a useless
+    vmap wrapper and keeps the single-run program the only one compiled
+    for non-coalescing workloads.
+    """
+    if g <= 1:
+        return 1
+    for b in _G_BUCKETS:
+        if g <= b:
+            return b
+    from pivot_tpu.sched.tpu import pad_bucket
+
+    return pad_bucket(g)
+
+
+@functools.lru_cache(maxsize=256)
+def _batched_fn(kernel, static_items: tuple, n_args: int, kw_keys: tuple):
+    """jit(vmap(kernel)) closed over the static config — cached per
+    (kernel, static kwargs, array-kwarg names); jit's own cache keys the
+    shapes, so this is one entry per kernel configuration, one XLA
+    program per (G-bucket, input-shape) combination.  The signature is
+    flat positional leaves (arguments first, array-kwargs in ``kw_keys``
+    order after) — nested container pytrees cost measurably more per
+    dispatch, and per-dispatch overhead is this module's whole subject."""
+    static_kw = dict(static_items)
+
+    def call(*cols):
+        return kernel(
+            *cols[:n_args],
+            **dict(zip(kw_keys, cols[n_args:])),
+            **static_kw,
+        )
+
+    return jax.jit(jax.vmap(call))
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def batch_execute(
+    kernel,
+    requests: Sequence[Tuple[tuple, dict]],
+    static_kw: Optional[dict] = None,
+) -> list:
+    """Serve N same-shaped kernel requests as one vmapped device dispatch.
+
+    ``requests`` is a sequence of ``(args, arr_kw)`` pairs — positional
+    array arguments plus array keyword arguments — whose shapes and
+    dtypes must match pairwise (the caller groups by
+    :func:`_request_key`).  Returns one output pytree per request, in
+    order, with every leaf already fetched to host numpy: the batch pays
+    ONE host→device staging and ONE device→host fetch where N sequential
+    dispatches pay N of each.
+
+    A single request takes the unbatched kernel program — the sequential
+    fallback, bit-identical by the vmap-parity contract.
+
+    Run-invariant operands (topology tables) are stacked G-wide like
+    everything else rather than closed over with ``in_axes=None``: a
+    broadcast concept would forbid grouping runs whose topologies differ
+    (heterogeneous-cluster grids) or force value-hashing every dispatch,
+    and the redundant bytes ride INSIDE the one batched call — a few KB
+    of [Z, Z] tables against the ~78 ms per-call floor being amortized,
+    no extra round-trip.
+    """
+    static_kw = static_kw or {}
+    g = len(requests)
+    if g == 0:
+        return []
+    if g == 1:
+        args, arr_kw = requests[0]
+        return [_to_host(kernel(*args, **arr_kw, **static_kw))]
+    gb = group_bucket(g)
+
+    def stack(col):
+        arrs = [np.asarray(a) for a in col]
+        if gb > g:
+            # Pad rows replicate row 0: same shapes, finite values, and
+            # their output rows are sliced off below.
+            arrs = arrs + [arrs[0]] * (gb - g)
+        # Host numpy, NOT jnp.asarray: the jitted call converts its
+        # arguments on its fast C++ path; an explicit per-column
+        # device_put costs ~3× as much in Python dispatch (measured) —
+        # exactly the overhead this module exists to amortize.
+        return np.stack(arrs)
+
+    args_cols = tuple(stack(col) for col in zip(*(r[0] for r in requests)))
+    kw_keys = tuple(sorted(requests[0][1]))
+    kw_cols = tuple(stack([r[1][k] for r in requests]) for k in kw_keys)
+    fn = _batched_fn(
+        kernel, tuple(sorted(static_kw.items())), len(args_cols), kw_keys
+    )
+    out = _to_host(fn(*args_cols, *kw_cols))
+    return [
+        jax.tree_util.tree_map(lambda x: x[r], out) for r in range(g)
+    ]
+
+
+def _request_key(kernel, args, arr_kw, static_kw) -> tuple:
+    """Requests with equal keys may share one vmapped dispatch."""
+    return (
+        kernel,
+        tuple(sorted(static_kw.items())),
+        tuple((tuple(a.shape), str(a.dtype)) for a in args),
+        tuple(
+            (k, tuple(v.shape), str(v.dtype))
+            for k, v in sorted(arr_kw.items())
+        ),
+    )
+
+
+class _Request:
+    __slots__ = ("slot", "kernel", "args", "arr_kw", "static_kw", "key",
+                 "done", "result", "error")
+
+    def __init__(self, slot, kernel, args, arr_kw, static_kw):
+        self.slot = slot
+        self.kernel = kernel
+        self.args = args
+        self.arr_kw = arr_kw
+        self.static_kw = static_kw
+        self.key = _request_key(kernel, args, arr_kw, static_kw)
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class BatchClient:
+    """One run's handle on the batcher: ``dispatch`` blocks the run's
+    thread at its tick boundary until the coordinator serves the batch."""
+
+    def __init__(self, batcher: "DispatchBatcher", slot: int):
+        self._batcher = batcher
+        self.slot = slot
+        self._closed = False
+
+    def dispatch(self, kernel, args, arr_kw=None, static_kw=None):
+        req = _Request(
+            self.slot, kernel, tuple(args), dict(arr_kw or {}),
+            dict(static_kw or {}),
+        )
+        self._batcher._submit(req)
+        req.done.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def close(self) -> None:
+        """Mark this run finished (idempotent) — the coordinator stops
+        waiting for it.  MUST be called (``finally``) or the barrier
+        deadlocks."""
+        if not self._closed:
+            self._closed = True
+            self._batcher._close_slot()
+
+
+class DispatchBatcher:
+    """Tick-synchronous barrier + coalescer for G concurrent DES runs.
+
+    Each run executes in its own thread; a placement dispatch parks the
+    thread.  The coordinator (:meth:`serve`, run on the driver thread)
+    waits for *quiescence* — every not-yet-finished run parked on a
+    request — then flushes: co-pending requests with identical
+    (kernel, shape, static-config) keys become one vmapped device call,
+    stragglers run the plain single-run program.  Deadlock-free by
+    construction: run threads only ever block inside ``dispatch``, and
+    the coordinator only waits on the quiescence predicate, which thread
+    exits (``BatchClient.close``) also satisfy.
+
+    ``stats`` after :meth:`serve`: ``dispatches`` (kernel calls
+    requested), ``device_calls`` (actual dispatches issued),
+    ``coalesced`` (requests served inside a >1 batch), ``max_group``.
+    """
+
+    def __init__(self, n_slots: int):
+        if n_slots < 1:
+            raise ValueError("DispatchBatcher needs at least one slot")
+        self._cond = threading.Condition()
+        self._n_slots = n_slots
+        self._open = n_slots
+        self._pending: List[_Request] = []
+        self._clients = 0
+        self.stats: Dict[str, int] = {
+            "runs": n_slots,
+            "dispatches": 0,
+            "device_calls": 0,
+            "coalesced": 0,
+            "max_group": 0,
+        }
+
+    def client(self) -> BatchClient:
+        with self._cond:
+            if self._clients >= self._n_slots:
+                raise ValueError(
+                    f"all {self._n_slots} batcher slots already claimed"
+                )
+            slot = self._clients
+            self._clients += 1
+        return BatchClient(self, slot)
+
+    # -- run-thread side --------------------------------------------------
+    def _submit(self, req: _Request) -> None:
+        with self._cond:
+            self._pending.append(req)
+            self._cond.notify_all()
+
+    def _close_slot(self) -> None:
+        with self._cond:
+            self._open -= 1
+            self._cond.notify_all()
+
+    # -- coordinator side -------------------------------------------------
+    def _quiescent(self) -> bool:
+        # Every live run is parked on a request (each run has at most one
+        # outstanding dispatch — its thread is blocked on it).
+        return len(self._pending) >= self._open
+
+    def serve(self) -> None:
+        """Coordinator loop: flush batches until every run finished."""
+        while True:
+            with self._cond:
+                self._cond.wait_for(self._quiescent)
+                if self._open == 0 and not self._pending:
+                    return
+                batch, self._pending = self._pending, []
+            self._flush(batch)
+
+    def _flush(self, batch: List[_Request]) -> None:
+        # Deterministic composition given a fixed co-pending set: groups
+        # in first-key-seen order, rows in slot order.  (Results are
+        # composition-independent anyway — the vmap-parity contract.)
+        groups: Dict[tuple, List[_Request]] = {}
+        for req in batch:
+            groups.setdefault(req.key, []).append(req)
+        for reqs in groups.values():
+            reqs.sort(key=lambda r: r.slot)
+            self.stats["dispatches"] += len(reqs)
+            self.stats["device_calls"] += 1
+            self.stats["max_group"] = max(self.stats["max_group"], len(reqs))
+            if len(reqs) > 1:
+                self.stats["coalesced"] += len(reqs)
+            try:
+                outs = batch_execute(
+                    reqs[0].kernel,
+                    [(r.args, r.arr_kw) for r in reqs],
+                    reqs[0].static_kw,
+                )
+            except BaseException as exc:  # noqa: BLE001 — deliver, don't hang
+                for r in reqs:
+                    r.error = exc
+                    r.done.set()
+                continue
+            for r, out in zip(reqs, outs):
+                r.result = out
+                r.done.set()

@@ -157,6 +157,11 @@ class _DevicePolicyBase(Policy):
         self.topology: Optional[DeviceTopology] = None
         self._scheduler = None
         self.adaptive = adaptive
+        # Cross-run dispatch coalescing (sched.batch): when a BatchClient
+        # is attached, every device-kernel call routes through it so G
+        # concurrently-stepped runs share one vmapped dispatch per tick.
+        self._batch_client = None
+        self._topology_host: Optional[DeviceTopology] = None
         self._cpu_twin: Optional[Policy] = None  # set by subclasses
         self._cpu_cell_cost = self._CELL_COST_SEED
         self._device_floor = 0.0  # per-call latency floor, seconds
@@ -174,10 +179,64 @@ class _DevicePolicyBase(Policy):
         _ensure_live_backend()
         _enable_compilation_cache()
         self.topology = DeviceTopology.from_cluster(scheduler.cluster, self.dtype)
+        self._topology_host = None  # rebind = new cluster; drop the host cache
         if self._cpu_twin is not None:
             self._cpu_twin.bind(scheduler)
         if self.adaptive:
             self._device_floor = _probe_device_floor()
+
+    # -- cross-run dispatch batching --------------------------------------
+    def enable_batching(self, client) -> None:
+        """Attach a :class:`pivot_tpu.sched.batch.BatchClient`: device
+        kernel calls block at the tick boundary and are coalesced with
+        the other grid runs' co-pending ticks into one vmapped dispatch
+        (bit-identical placements — see ``sched/batch.py``).
+
+        Requires deterministic routing: the adaptive twin routes on
+        measured latencies, which would make batch membership — and on
+        the f32 TPU backend, placements — timing-dependent.
+        """
+        if self.adaptive:
+            raise ValueError(
+                "cross-run batching needs deterministic dispatch — "
+                "construct the policy with adaptive=False"
+            )
+        self._batch_client = client
+
+    def _call_kernel(self, kernel, *args, **kw):
+        """Kernel-call indirection: direct when unbatched, through the
+        cross-run batcher when a client is attached.  Array-valued
+        keyword arguments (the realtime-bw rows) batch along with the
+        positional arrays; plain keywords stay static."""
+        if self._batch_client is None:
+            return kernel(*args, **kw)
+        arr_kw = {k: v for k, v in kw.items() if hasattr(v, "shape")}
+        static_kw = {k: v for k, v in kw.items() if k not in arr_kw}
+        return self._batch_client.dispatch(kernel, args, arr_kw, static_kw)
+
+    def _stage(self, x, dtype=None):
+        """Per-tick operand staging: device-put for a direct dispatch;
+        host numpy when batched — the batcher stacks host arrays and the
+        jitted batch call stages them ONCE, whereas handing it device
+        buffers would pay a device→host fetch per operand per tick on a
+        remote backend (exactly the floor being amortized)."""
+        if self._batch_client is not None:
+            return (
+                np.asarray(x) if dtype is None
+                else np.asarray(x, dtype=np.dtype(dtype))
+            )
+        return jnp.asarray(x) if dtype is None else jnp.asarray(x, dtype=dtype)
+
+    def _staged_topology(self) -> DeviceTopology:
+        """Topology operands for a dispatch: the bind-time device arrays
+        normally; a host copy (fetched once, cached) when batched."""
+        if self._batch_client is None:
+            return self.topology
+        if self._topology_host is None:
+            self._topology_host = DeviceTopology(
+                *(np.asarray(a) for a in self.topology)
+            )
+        return self._topology_host
 
     # -- adaptive dispatch ------------------------------------------------
     def place(self, ctx: TickContext) -> np.ndarray:
@@ -267,8 +326,8 @@ class _DevicePolicyBase(Policy):
         dem[:T] = demands
         valid = np.zeros(B, dtype=bool)
         valid[:T] = True
-        avail = jnp.asarray(ctx.avail, dtype=self.dtype)
-        return avail, jnp.asarray(dem, dtype=self.dtype), jnp.asarray(valid)
+        avail = self._stage(ctx.avail, self.dtype)
+        return avail, self._stage(dem, self.dtype), self._stage(valid)
 
     @staticmethod
     def _unpad(placements, T: int, order: Optional[List[int]] = None) -> np.ndarray:
@@ -320,8 +379,9 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
         avail, dem, valid = self._padded(ctx)
         u = np.zeros(valid.shape[0], dtype=np.float64)
         u[:T] = tick_uniforms(ctx.scheduler.seed or 0, ctx.tick_seq, T)
-        placements, _ = opportunistic_kernel(
-            avail, dem, valid, jnp.asarray(u, dtype=self.dtype)
+        placements, _ = self._call_kernel(
+            opportunistic_kernel, avail, dem, valid,
+            self._stage(u, self.dtype),
         )
         return self._unpad(placements, T)
 
@@ -341,7 +401,9 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
             order = _sort_decreasing(ctx.demands, list(range(T)))
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:17)
         avail, dem, valid = self._padded(ctx, order)
-        placements, _ = first_fit_kernel(avail, dem, valid, strict=False)
+        placements, _ = self._call_kernel(
+            first_fit_kernel, avail, dem, valid, strict=False
+        )
         return self._unpad(placements, T, order)
 
     def placement_sensitivity(self, ctx: TickContext, n_replicas: int = 256,
@@ -381,7 +443,7 @@ class TpuBestFitPolicy(_DevicePolicyBase):
             order = _sort_decreasing(ctx.demands, list(range(T)))
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:42)
         avail, dem, valid = self._padded(ctx, order)
-        placements, _ = best_fit_kernel(avail, dem, valid)
+        placements, _ = self._call_kernel(best_fit_kernel, avail, dem, valid)
         return self._unpad(placements, T, order)
 
     def placement_sensitivity(self, ctx: TickContext, n_replicas: int = 256,
@@ -461,6 +523,15 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             realtime_bw=realtime_bw,
         )
         self._cpu_twin = self._grouper
+
+    def enable_batching(self, client) -> None:
+        if self.use_pallas:
+            raise ValueError(
+                "cross-run batching serves ticks through vmap(scan "
+                "kernel); the Pallas kernel batches replicas on its own "
+                "sublane axis — drop use_pallas=True"
+            )
+        super().enable_batching(client)
 
     def _anchor_stream(self, ctx: TickContext):
         """The kernel's per-task anchor stream: ``(order, az_arr [B] i32,
@@ -596,6 +667,12 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 # use_pallas=True + realtime_bw is rejected in __init__).
                 and not self.realtime_bw
             )
+        if self._batch_client is not None:
+            # The batcher's program is vmap(scan kernel): the Pallas
+            # greedy kernel batches replicas along its own sublane axis
+            # (cost_aware_pallas_batched) and cannot ride a run axis too.
+            # Explicit use_pallas=True is rejected at enable_batching.
+            use_pallas = False
         kw = {}
         if group_rows is not None:
             # One [H] row per anchor group + a per-task row index: the
@@ -609,19 +686,21 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 rows[: len(group_rows)] = np.stack(group_rows)
             idx = np.zeros(az_arr.shape[0], dtype=np.int32)
             idx[:T] = row_idx
-            kw["rt_bw_rows"] = jnp.asarray(rows, dtype=self.dtype)
-            kw["rt_bw_idx"] = jnp.asarray(idx)
+            kw["rt_bw_rows"] = self._stage(rows, self.dtype)
+            kw["rt_bw_idx"] = self._stage(idx)
         kernel = cost_aware_pallas if use_pallas else cost_aware_kernel
-        placements, _ = kernel(
+        topo = self._staged_topology()
+        placements, _ = self._call_kernel(
+            kernel,
             avail,
             dem,
             valid,
-            jnp.asarray(ng_arr),
-            jnp.asarray(az_arr),
-            self.topology.cost,
-            self.topology.bw,
-            self.topology.host_zone,
-            jnp.asarray(ctx.host_task_counts, dtype=jnp.int32),
+            self._stage(ng_arr),
+            self._stage(az_arr),
+            topo.cost,
+            topo.bw,
+            topo.host_zone,
+            self._stage(ctx.host_task_counts, jnp.int32),
             bin_pack=self.bin_pack,
             sort_hosts=self.sort_hosts,
             host_decay=self.host_decay,

@@ -1,0 +1,244 @@
+"""Cross-run dispatch batching + donated rollout carries.
+
+The correctness bar of the ``--batch-runs`` grid driver
+(``sched/batch.py`` + ``experiments.runner.run_grid_lockstep``): a run
+executed inside a lock-step batch is **bit-identical** — placements and
+meter output — to the same run executed sequentially.  Plus the
+donated-carry contract of the segmented ensemble executors and the
+bench's batch-construction smoke path (tier-1-safe, tiny scale).
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import load_root_module
+
+TRACE = "data/jobs/jobs-5000-200-172800-259200.npz"
+
+
+def _grid_runs(n_runs, policy_name="cost-aware", n_hosts=16, n_apps=4):
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    pcfg = PolicyConfig(
+        name=policy_name, device="tpu", bin_pack="first-fit",
+        sort_tasks=True, sort_hosts=True, adaptive=False,
+    )
+    runs = []
+    for seed in range(n_runs):
+        cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed))
+        runs.append(
+            ExperimentRun(
+                f"run-{seed}", cluster, make_policy(pcfg), TRACE,
+                n_apps=n_apps, seed=seed, interval=5.0,
+            )
+        )
+    return runs
+
+
+def _record_placements(run):
+    """Shadow the policy's place() with a recorder (instance attribute)."""
+    log = []
+    orig = run.policy.place
+
+    def recorder(ctx):
+        p = orig(ctx)
+        log.append(np.asarray(p).copy())
+        return p
+
+    run.policy.place = recorder
+    return log
+
+
+def _strip_wall(summary):
+    return {k: v for k, v in summary.items() if k != "wall_clock"}
+
+
+def test_lockstep_grid_bit_identical_to_sequential():
+    """The tentpole parity bar: a 4-run grid through the DispatchBatcher
+    produces bit-identical per-tick placements and meter output to the
+    same 4 runs executed sequentially (CPU backend, fixed seeds) — and
+    the batcher genuinely coalesced (full-width batches, fewer device
+    calls than dispatches)."""
+    from pivot_tpu.experiments.runner import run_grid_lockstep
+    from pivot_tpu.utils import reset_ids
+
+    reset_ids()
+    seq_runs = _grid_runs(4)
+    seq_logs = [_record_placements(r) for r in seq_runs]
+    seq_sums = [r.run() for r in seq_runs]
+
+    reset_ids()
+    bat_runs = _grid_runs(4)
+    bat_logs = [_record_placements(r) for r in bat_runs]
+    stats = {}
+    bat_sums = run_grid_lockstep(bat_runs, stats_out=stats)
+
+    for g in range(4):
+        assert len(seq_logs[g]) == len(bat_logs[g])
+        for tick, (a, b) in enumerate(zip(seq_logs[g], bat_logs[g])):
+            np.testing.assert_array_equal(a, b, err_msg=f"run {g} tick {tick}")
+        assert _strip_wall(seq_sums[g]) == _strip_wall(bat_sums[g])
+    # Coalescing happened: every run dispatched every tick it had, and at
+    # least one device call carried the full 4-run batch.
+    assert stats["max_group"] == 4
+    assert stats["device_calls"] < stats["dispatches"]
+    assert stats["coalesced"] > 0
+
+
+def test_batch_execute_matches_individual_calls():
+    """The pure core: N same-shaped kernel requests through one vmapped
+    dispatch (including a padded, non-power bucket: 3 → 4) return exactly
+    the unbatched kernel's outputs."""
+    from pivot_tpu.ops.kernels import first_fit_kernel
+    from pivot_tpu.sched.batch import batch_execute, group_bucket
+
+    assert group_bucket(1) == 1
+    assert group_bucket(3) == 4
+    assert group_bucket(8) == 8
+    assert group_bucket(9) == 16
+
+    rng = np.random.default_rng(0)
+    reqs = []
+    for _ in range(3):
+        avail = rng.uniform(1, 8, size=(6, 4)).astype(np.float32)
+        dem = rng.uniform(0.5, 4, size=(8, 4)).astype(np.float32)
+        valid = np.ones(8, dtype=bool)
+        valid[5:] = False
+        reqs.append(((avail, dem, valid), {}))
+    outs = batch_execute(first_fit_kernel, reqs, {"strict": False})
+    assert len(outs) == 3
+    for (args, _), (p_b, avail_b) in zip(reqs, outs):
+        p_s, avail_s = first_fit_kernel(*args, strict=False)
+        np.testing.assert_array_equal(np.asarray(p_s), p_b)
+        np.testing.assert_array_equal(np.asarray(avail_s), avail_b)
+
+
+def test_enable_batching_rejects_adaptive():
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+
+    pol = TpuCostAwarePolicy(adaptive=True)
+    with pytest.raises(ValueError, match="adaptive"):
+        pol.enable_batching(object())
+    pallas = TpuCostAwarePolicy(use_pallas=True)
+    with pytest.raises(ValueError, match="[Pp]allas"):
+        pallas.enable_batching(object())
+
+
+@pytest.fixture(scope="module")
+def small_rollout_inputs():
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra import Cluster, Host, Storage
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.ops.kernels import DeviceTopology
+    from pivot_tpu.parallel.ensemble import EnsembleWorkload
+    from pivot_tpu.workload import Application, TaskGroup
+
+    meta = ResourceMetadata(seed=0)
+    env = Environment()
+    zones = meta.zones
+    hosts = [
+        Host(env, 16, 1 << 16, 100, 2, locality=zones[i % 4])
+        for i in range(6)
+    ]
+    storage = [
+        Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)
+    ]
+    cluster = Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, route_mode="meta",
+        seed=0,
+    )
+    topo = DeviceTopology.from_cluster(cluster, jnp.float32)
+    app = Application(
+        "don",
+        [
+            TaskGroup("a", cpus=1, mem=64, runtime=25, output_size=100,
+                      instances=4),
+            TaskGroup("b", cpus=2, mem=128, runtime=15, dependencies=["a"],
+                      instances=3),
+        ],
+    )
+    workload = EnsembleWorkload.from_applications([app])
+    avail0 = jnp.asarray(cluster.availability_matrix(), dtype=jnp.float32)
+    return workload, topo, avail0
+
+
+def test_rollout_segment_accepts_donated_carry(small_rollout_inputs):
+    """``_rollout_segment`` jitted with ``donate_argnums=(0,)`` accepts a
+    donated carry, and a 2-segment rollout through the donated step is
+    bit-identical to the 1-segment reference."""
+    from pivot_tpu.parallel.ensemble.state import _init_state
+    from pivot_tpu.parallel.ensemble.tick import _rollout_segment
+
+    workload, topo, avail0 = small_rollout_inputs
+    T, Z = workload.n_tasks, topo.cost.shape[0]
+    ra = jnp.zeros((T,), jnp.int32)
+
+    def segment(state, n_ticks):
+        return _rollout_segment(
+            state, workload.runtime, workload.arrival, ra, workload, topo,
+            5.0, n_ticks, forms="indexed",
+        )
+
+    donated = jax.jit(
+        segment, static_argnames=("n_ticks",), donate_argnums=(0,)
+    )
+
+    ref = segment(_init_state(avail0, T, Z), 32)
+    s = _init_state(avail0, T, Z)
+    s = jax.tree_util.tree_map(jnp.copy, s)  # never donate avail0 itself
+    s = donated(s, n_ticks=16)
+    s = donated(s, n_ticks=16)  # segment 2 consumes segment 1's carry
+    for name, a, b in zip(ref._fields, ref, s):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=name
+        )
+
+
+def test_pipelined_segments_match_monolithic(small_rollout_inputs):
+    """The double-buffered donated executor (checkpoint-less
+    ``rollout_checkpointed``) is bit-identical to the monolithic rollout
+    at an awkward segment size."""
+    from pivot_tpu.parallel.ensemble import rollout, rollout_checkpointed
+
+    workload, topo, avail0 = small_rollout_inputs
+    sz = jnp.asarray([0, 1], jnp.int32)
+    cfg = dict(n_replicas=4, tick=5.0, max_ticks=48, perturb=0.1)
+    key = jax.random.PRNGKey(11)
+    plain = rollout(key, avail0, workload, topo, sz, **cfg)
+    piped = rollout_checkpointed(
+        key, avail0, workload, topo, sz, None, segment_ticks=7, **cfg
+    )
+    for field in ("makespan", "placement", "finish_time", "egress_cost"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain, field)),
+            np.asarray(getattr(piped, field)),
+            err_msg=field,
+        )
+
+
+def test_bench_grid_batched_smoke():
+    """Tier-1 bench smoke (tiny scale, CPU): the batch-construction path
+    builds, runs, and holds the sequential-vs-batched parity bit — bench
+    regressions surface here instead of only in live windows."""
+    bench = load_root_module("bench")
+    row = bench._bench_grid_batched(
+        n_runs=2, n_tasks=8, n_hosts=8, repeats=1
+    )
+    assert row["g"] == 2 and row["t"] == 8 and row["h"] == 8
+    assert row["parity"] is True
+    assert row["sequential_dps"] > 0 and row["batched_dps"] > 0
+    assert set(row) >= {
+        "decisions_per_dispatch", "sequential_dps", "batched_dps",
+        "amortization",
+    }
