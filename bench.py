@@ -418,6 +418,139 @@ def _bench_grid_batched(
     }
 
 
+def _bench_serve_stream(
+    n_sessions: int = 2,
+    n_jobs: int = 24,
+    rate: float = 0.25,
+    n_hosts: int = 16,
+    queue_depth: int = 16,
+    flush_after: float = 0.02,
+    seed: int = 0,
+) -> dict:
+    """Online-serving row (``pivot_tpu.serve``): sustained placement
+    decisions/sec and decision-latency percentiles while a Poisson
+    arrival stream flows through ``n_sessions`` always-on scheduling
+    sessions sharing one batched device dispatch.
+
+    The measured regime is the serving hot path: per-tick dispatches of
+    a handful of ready tasks, where the fixed per-call cost dominates —
+    the batcher amortizes it across sessions exactly as ``grid_batched``
+    does across grid runs, but under *streaming* arrivals with the
+    deadline flush armed.  Replay pacing (as fast as the sessions can
+    schedule) so the figure is throughput, not sleep time.
+    """
+    from pivot_tpu.serve import ServeDriver, ServeSession, poisson_arrivals
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    pcfg = PolicyConfig(
+        name="cost-aware", device="tpu", bin_pack="first-fit",
+        sort_tasks=True, sort_hosts=True, adaptive=False,
+    )
+    sessions = [
+        ServeSession(
+            f"bench-{g}",
+            build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed)),
+            make_policy(pcfg),
+            seed=seed,
+        )
+        for g in range(n_sessions)
+    ]
+    driver = ServeDriver(
+        sessions, queue_depth=queue_depth, backpressure="shed",
+        flush_after=flush_after,
+    )
+    t0 = time.perf_counter()
+    report = driver.run(poisson_arrivals(rate, n_jobs, seed=seed))
+    wall = time.perf_counter() - t0
+    slo = report["slo"]
+    lat = slo["decision_latency_s"]
+    return {
+        "sessions": n_sessions,
+        "jobs": n_jobs,
+        "arrival_rate": rate,
+        "h": n_hosts,
+        "completed": slo["counters"]["completed"],
+        "shed": slo["counters"]["shed"],
+        "decisions": slo["counters"]["decisions"],
+        "decisions_per_sec": round(slo["counters"]["decisions"] / wall, 1),
+        "p50_decision_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+        "p99_decision_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+        "batcher": report["batcher"],
+        "wall_s": round(wall, 3),
+    }
+
+
+def _child_backend_setup():
+    """Shared child preamble: apply the parent's ``PIVOT_BENCH_BACKEND``
+    override explicitly (ignoring it would silently contradict the
+    parent — ADVICE.md) and warm the persistent compile cache.  Returns
+    the configured ``jax`` module."""
+    import jax
+
+    from pivot_tpu.utils import enable_compilation_cache
+
+    override = os.environ.get("PIVOT_BENCH_BACKEND")
+    if override:
+        jax.config.update("jax_platforms", override)
+    enable_compilation_cache()
+    return jax
+
+
+def _run_row_in_child(env_flag: str, timeout_s: int,
+                      error_base: dict = None) -> dict:
+    """Shared parent side of every child-isolated bench row: spawn this
+    file as a disposable child with ``env_flag=1``, bound it, parse its
+    one-JSON-line row.  Failures — nonzero exit, hang, dead backend —
+    become a recorded error row carrying the child's stdout/stderr tail
+    (tracebacks and libtpu diagnostics land on stderr; an empty stdout
+    tail would record "rc=N:" with no content — ADVICE.md)."""
+    import subprocess
+
+    base = error_base or {}
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**os.environ, env_flag: "1"},
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        if proc.returncode != 0:
+            out_lines = [
+                ln for ln in proc.stdout.strip().splitlines() if ln.strip()
+            ]
+            err_lines = [
+                ln for ln in proc.stderr.strip().splitlines() if ln.strip()
+            ]
+            tail = (out_lines or err_lines or [""])[-1][:300]
+            return {**base, "error": f"child rc={proc.returncode}: {tail}"}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001 — row-level isolation
+        return {**base, "error": f"{type(exc).__name__}: {exc}"[:300]}
+
+
+def _serve_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SERVE_CHILD=1``): run the
+    serve_stream row and print ONE JSON line.  A child for the same two
+    reasons as the saturated row: a wedged tunnel RPC can hang where
+    SIGALRM cannot reach, and on the single-tenant backend the child
+    must be the only PJRT client alive."""
+    jax = _child_backend_setup()
+    row = _bench_serve_stream()
+    row["backend"] = jax.default_backend()
+    print(json.dumps(row), flush=True)
+
+
+def _bench_serve_in_child(timeout_s: int = 420) -> dict:
+    """Parent side of the serve_stream row — see ``_run_row_in_child``."""
+    return _run_row_in_child("PIVOT_BENCH_SERVE_CHILD", timeout_s)
+
+
 # (probe timeout s, sleep-before s): ~7 min worst-case total. A wedged
 # single-tenant tunnel recovers on operator timescales, so one 150 s shot
 # (round 1) under-samples it; spreading attempts across the bench runtime
@@ -504,17 +637,7 @@ def _saturated_child() -> None:
     fires between Python bytecodes), but the parent can always kill a
     child process no matter where it blocks.
     """
-    import jax
-
-    from pivot_tpu.utils import enable_compilation_cache
-
-    # Apply an explicit backend override exactly like main() does — the
-    # child inherits PIVOT_BENCH_BACKEND from the environment, and
-    # ignoring it here would silently contradict the parent (ADVICE.md).
-    override = os.environ.get("PIVOT_BENCH_BACKEND")
-    if override:
-        jax.config.update("jax_platforms", override)
-    enable_compilation_cache()
+    jax = _child_backend_setup()
     if jax.default_backend() != "tpu":
         print(json.dumps({"error": f"child backend {jax.default_backend()}"}))
         sys.exit(3)
@@ -527,42 +650,18 @@ def _saturated_child() -> None:
 
 
 def _bench_saturated_in_child(timeout_s: int = 420) -> dict:
-    """Parent side of the saturated row: spawn, bound, parse."""
-    import subprocess
-
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env={**os.environ, "PIVOT_BENCH_SATURATED_CHILD": "1"},
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-        if proc.returncode != 0:
-            # Tracebacks and libtpu diagnostics land on stderr; an empty
-            # stdout tail would record "rc=N:" with no content (ADVICE.md).
-            out_lines = [
-                ln for ln in proc.stdout.strip().splitlines() if ln.strip()
-            ]
-            err_lines = [
-                ln for ln in proc.stderr.strip().splitlines() if ln.strip()
-            ]
-            tail = (out_lines or err_lines or [""])[-1][:300]
-            return {
-                "n_replicas": 1024,
-                "error": f"child rc={proc.returncode}: {tail}",
-            }
-        return json.loads(proc.stdout.strip().splitlines()[-1])
-    except Exception as exc:  # noqa: BLE001 — row-level isolation
-        return {
-            "n_replicas": 1024,
-            "error": f"{type(exc).__name__}: {exc}"[:300],
-        }
+    """Parent side of the saturated row — see ``_run_row_in_child``."""
+    return _run_row_in_child(
+        "PIVOT_BENCH_SATURATED_CHILD", timeout_s, {"n_replicas": 1024}
+    )
 
 
 def main() -> None:
     if os.environ.get("PIVOT_BENCH_SATURATED_CHILD"):
         _saturated_child()
+        return
+    if os.environ.get("PIVOT_BENCH_SERVE_CHILD"):
+        _serve_child()
         return
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
@@ -653,6 +752,15 @@ def main() -> None:
         # Explicit TPU request: same single-tenant serialization — the
         # saturated child runs before this process touches the device.
         ens_saturated = _bench_saturated_in_child()
+
+    # Online-serving row, also child-isolated and serialized BEFORE this
+    # process creates its own PJRT client (single-tenant co-acquisition
+    # guard, ADVICE.md).  The child inherits PIVOT_BENCH_BACKEND — set
+    # above on every fallback/override path — so the row always measures
+    # the same backend the headline metrics will; a crash, hang, or dead
+    # backend costs this one row (recorded error + stderr tail), never
+    # the record.
+    serve_stream = _bench_serve_in_child()
 
     import jax
 
@@ -759,6 +867,7 @@ def main() -> None:
         **({"kernel_errors": kernel_errors} if kernel_errors else {}),
         "ensemble_replica_rollouts_per_sec": round(ens_rps, 2),
         "grid_batched": grid_batched,
+        "serve_stream": serve_stream,
         **(
             {"ensemble_saturated": ens_saturated} if ens_saturated else {}
         ),

@@ -385,7 +385,11 @@ def _calibrate_one(trace_file, cluster, n_apps, policy, scale_factor, seed,
     # Scoped: jax_enable_x64 is process-global, so restore the caller's
     # value on exit — otherwise a later calibrate(x64=False) in the same
     # process would silently run f64 while reporting "x64": False.
-    x64_scope = jax.enable_x64(True) if x64 else contextlib.nullcontext()
+    # (jax.enable_x64 was removed from the top-level namespace; the
+    # context manager lives in jax.experimental.)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    x64_scope = _enable_x64(True) if x64 else contextlib.nullcontext()
     with x64_scope:
         inputs = ensemble_inputs_from_schedule(
             schedule, cluster, dtype=jnp.float64 if x64 else None

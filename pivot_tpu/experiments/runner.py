@@ -96,7 +96,20 @@ def run_grid_lockstep(runs, stats_out: Optional[dict] = None) -> list:
     is timing-dependent) execute sequentially first, then the batchable
     runs execute in lock-step.  Returns per-run summaries in input
     order; ``stats_out`` (optional dict) receives the batcher's
-    coalescing counters.
+    coalescing counters — the documented key set (asserted by
+    ``tests/test_batch_dispatch.py``, described in
+    ``docs/ARCHITECTURE.md``):
+
+      * ``runs`` — lock-step slots (0 when nothing was batchable, in
+        which case no other key is written);
+      * ``dispatches`` — kernel calls requested by the runs;
+      * ``device_calls`` — actual device dispatches issued (< dispatches
+        when coalescing worked);
+      * ``coalesced`` — requests served inside a >1-run batch;
+      * ``max_group`` — largest batch assembled;
+      * ``deadline_flushes`` — partial flushes forced by a flush
+        deadline (always 0 here: the grid driver runs quiescence-only;
+        the serving layer's batcher sets a deadline).
     """
     import threading
 
@@ -180,7 +193,7 @@ class ExperimentRun(LogMixin):
         label: str,
         cluster: Cluster,
         policy: Policy,
-        trace_file: str,
+        trace_file: Optional[str] = None,
         output_size_scale_factor: float = 1000.0,
         n_apps: Optional[int] = None,
         data_dir: Optional[str] = None,
@@ -189,11 +202,18 @@ class ExperimentRun(LogMixin):
         trace_events: bool = False,
         identity: Optional[dict] = None,
         audit: bool = False,
+        schedule: Optional[TraceSchedule] = None,
     ):
         self.label = label
         self.cluster = cluster
         self.policy = policy
         self.trace_file = trace_file
+        # In-memory submission schedule: bypasses the trace-file load —
+        # the serving parity harness (tests/test_serve.py) compares a
+        # served job subset against exactly this run.
+        self._schedule = schedule
+        if trace_file is None and schedule is None:
+            raise ValueError("ExperimentRun needs a trace_file or schedule")
         self.output_size_scale_factor = output_size_scale_factor
         self.n_apps = n_apps
         self.data_dir = data_dir
@@ -216,7 +236,9 @@ class ExperimentRun(LogMixin):
             return self.identity
         return {
             "label": self.label,
-            "trace_file": os.path.abspath(self.trace_file),
+            "trace_file": (
+                os.path.abspath(self.trace_file) if self.trace_file else None
+            ),
             "n_apps": self.n_apps,
             "seed": self.seed,
             "scale_factor": self.output_size_scale_factor,
@@ -236,7 +258,12 @@ class ExperimentRun(LogMixin):
             meter=meter,
             tracer=self.tracer,
         )
-        schedule = load_trace_jobs(self.trace_file, self.output_size_scale_factor)
+        if self._schedule is not None:
+            schedule = self._schedule
+        else:
+            schedule = load_trace_jobs(
+                self.trace_file, self.output_size_scale_factor
+            )
         if self.n_apps:
             schedule = schedule.take(self.n_apps)
         # Kept for post-run inspection (app start/end times carry the

@@ -9,14 +9,19 @@ average congestion delay — serialized as the same four JSON files
 ``host_usage.json``, ref ``resources/meter.py:108-133``).
 
 Additions over the reference: wall-clock + decisions/sec counters for the
-BENCH harness, and ``summary()`` returning everything as a dict without
-touching disk.
+BENCH harness, ``summary()`` returning everything as a dict without
+touching disk, and the serving-grade telemetry primitives behind
+``pivot_tpu.serve`` — :class:`StreamingHistogram` (fixed-memory
+log-bucketed percentiles) and :class:`SloMeter` (thread-safe decision
+latency / queue depth / admission counters with a JSON snapshot).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, List
@@ -25,7 +30,7 @@ import numpy as np
 
 from pivot_tpu.utils import LogMixin, ceil_bucket, floor_bucket
 
-__all__ = ["Meter"]
+__all__ = ["Meter", "SloMeter", "StreamingHistogram"]
 
 
 class Meter(LogMixin):
@@ -279,3 +284,164 @@ class Meter(LogMixin):
         with open(os.path.join(data_dir, "host_usage.json"), "w") as f:
             x, y = self.host_usage_curve()
             json.dump({"timestamps": x, "n_hosts": y}, f)
+
+
+class StreamingHistogram:
+    """Fixed-memory log-bucketed histogram for unbounded value streams.
+
+    The serving layer records one decision latency per scheduler tick and
+    one queue-depth sample per arrival for the lifetime of the process —
+    an always-on service cannot keep the raw samples the way
+    ``Meter._sched_turnovers`` does for a finite batch run.  Geometric
+    buckets (``bins_per_decade`` per power of ten between ``lo`` and
+    ``hi``) give percentile estimates with bounded relative error
+    (~``10^(1/bins_per_decade) − 1``, <4 % at the default 64) in O(1)
+    memory and O(1) per record.
+
+    Values below ``lo`` clamp into the first bucket, values above ``hi``
+    into the last; exact ``min``/``max``/``sum``/``count`` moments are
+    tracked alongside, so the snapshot's mean and extremes are exact even
+    where the percentiles are bucketed.  Not thread-safe on its own —
+    :class:`SloMeter` serializes access.
+    """
+
+    __slots__ = ("lo", "hi", "_scale", "_counts", "count", "_sum",
+                 "_min", "_max")
+
+    def __init__(
+        self, lo: float = 1e-6, hi: float = 1e4, bins_per_decade: int = 64
+    ):
+        if not (0 < lo < hi):
+            raise ValueError("need 0 < lo < hi")
+        self.lo = lo
+        self.hi = hi
+        self._scale = bins_per_decade
+        n = int(math.ceil((math.log10(hi) - math.log10(lo)) * bins_per_decade))
+        self._counts = np.zeros(n + 1, dtype=np.int64)
+        self.count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self._sum += v
+        self._min = min(self._min, v)
+        self._max = max(self._max, v)
+        if v <= self.lo:
+            idx = 0
+        else:
+            idx = int((math.log10(v) - math.log10(self.lo)) * self._scale) + 1
+            idx = min(idx, len(self._counts) - 1)
+        self._counts[idx] += 1
+
+    def percentile(self, q: float) -> float:
+        """Upper-edge estimate of the ``q``-th percentile (0 < q ≤ 100)."""
+        if self.count == 0:
+            return 0.0
+        rank = max(int(math.ceil(q / 100.0 * self.count)), 1)
+        cum = np.cumsum(self._counts)
+        idx = int(np.searchsorted(cum, rank))
+        if idx == 0:
+            return min(self.lo, self._max)
+        edge = self.lo * 10 ** (idx / self._scale)
+        # An edge cannot overstate the true max (exactly tracked).
+        return min(edge, self._max)
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self.count if self.count else 0.0
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": int(self.count),
+            "mean": self.mean,
+            "min": self._min,
+            "max": self._max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
+class SloMeter(LogMixin):
+    """Serving-grade telemetry for the online layer (``pivot_tpu.serve``).
+
+    The batch :class:`Meter` is per-run and sim-time-centric; this meter
+    is per-*service* and wall-clock-centric: decision latency (the wall
+    duration of each placement call, batcher wait included), admission
+    queue depth at each arrival, and admission-control counters
+    (admitted / shed-by-reason / spilled / blocked / late injections).
+    All hooks are thread-safe — sessions and the stream driver record
+    concurrently.  :meth:`snapshot` exports everything JSON-ready.
+    """
+
+    #: Counter keys always present in the snapshot (tests rely on these).
+    COUNTERS = (
+        "arrived", "admitted", "completed", "shed", "spilled",
+        "blocked_waits", "late_injections", "decisions", "placed",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._wall_start = time.perf_counter()
+        self.counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
+        self.shed_reasons: Dict[str, int] = {}
+        # Wall seconds per placement call (decision latency SLO).
+        self.decision_latency = StreamingHistogram(1e-6, 1e4)
+        # Admitted-but-incomplete jobs at each arrival instant.
+        self.queue_depth = StreamingHistogram(1.0, 1e7, bins_per_decade=32)
+        # Sim-time job sojourn: admission timestamp -> app completion.
+        self.sojourn_sim = StreamingHistogram(1e-3, 1e9, bins_per_decade=32)
+
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] = self.counters.get(key, 0) + n
+
+    def record_shed(self, reason: str) -> None:
+        with self._lock:
+            self.counters["shed"] += 1
+            self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+
+    def record_decision(self, wall_s: float, n_tasks: int,
+                        n_placed: int) -> None:
+        """One placement call: wall latency + batch size + placements."""
+        with self._lock:
+            self.decision_latency.record(wall_s)
+            self.counters["decisions"] += n_tasks
+            self.counters["placed"] += n_placed
+
+    def record_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth.record(depth)
+
+    def record_sojourn(self, sim_s: float) -> None:
+        with self._lock:
+            self.sojourn_sim.record(sim_s)
+
+    @property
+    def wall_clock(self) -> float:
+        return time.perf_counter() - self._wall_start
+
+    def snapshot(self) -> dict:
+        """JSON-ready view of the service's SLO state at this instant."""
+        with self._lock:
+            return {
+                "wall_s": round(self.wall_clock, 4),
+                "counters": dict(self.counters),
+                "shed_reasons": dict(self.shed_reasons),
+                "decision_latency_s": self.decision_latency.snapshot(),
+                "queue_depth": self.queue_depth.snapshot(),
+                "sojourn_sim_s": self.sojourn_sim.snapshot(),
+            }
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=2)
+        os.replace(tmp, path)

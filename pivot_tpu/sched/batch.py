@@ -209,6 +209,7 @@ class BatchClient:
         self._batcher = batcher
         self.slot = slot
         self._closed = False
+        self._idle = False
 
     def dispatch(self, kernel, args, arr_kw=None, static_kw=None):
         req = _Request(
@@ -221,13 +222,24 @@ class BatchClient:
             raise req.error
         return req.result
 
+    def set_idle(self, idle: bool) -> None:
+        """Declare this slot idle (no work pending, not about to dispatch)
+        or busy again.  An idle slot is excluded from the quiescence count,
+        so a serving session blocked on its job inbox cannot park the
+        other sessions' co-pending dispatches forever.  Idempotent; a
+        closed client ignores the call."""
+        if self._closed or idle == self._idle:
+            return
+        self._idle = idle
+        self._batcher._set_idle(1 if idle else -1)
+
     def close(self) -> None:
         """Mark this run finished (idempotent) — the coordinator stops
         waiting for it.  MUST be called (``finally``) or the barrier
         deadlocks."""
         if not self._closed:
             self._closed = True
-            self._batcher._close_slot()
+            self._batcher._close_slot(was_idle=self._idle)
 
 
 class DispatchBatcher:
@@ -243,17 +255,37 @@ class DispatchBatcher:
     the coordinator only waits on the quiescence predicate, which thread
     exits (``BatchClient.close``) also satisfy.
 
-    ``stats`` after :meth:`serve`: ``dispatches`` (kernel calls
-    requested), ``device_calls`` (actual dispatches issued),
-    ``coalesced`` (requests served inside a >1 batch), ``max_group``.
+    Two serving extensions over the batch-mode barrier (both inert by
+    default, used by ``pivot_tpu.serve``):
+
+      * **idle slots** — :meth:`BatchClient.set_idle` excludes a slot
+        from the quiescence count while its session waits for work, so
+        an empty session cannot park a busy one;
+      * **deadline flush** (``flush_after`` seconds) — once at least one
+        request is pending, the coordinator waits at most that long for
+        full quiescence before flushing the partial batch, so a
+        straggler session cannot stall co-pending dispatches
+        indefinitely.  ``None`` (the batch-mode default) keeps the
+        quiescence-only flush.
+
+    ``stats`` after :meth:`serve` (documented contract — asserted by
+    ``tests/test_batch_dispatch.py`` and ``docs/ARCHITECTURE.md``):
+    ``runs`` (slots), ``dispatches`` (kernel calls requested),
+    ``device_calls`` (actual dispatches issued), ``coalesced`` (requests
+    served inside a >1 batch), ``max_group`` (largest batch), and
+    ``deadline_flushes`` (partial flushes forced by ``flush_after``).
     """
 
-    def __init__(self, n_slots: int):
+    def __init__(self, n_slots: int, flush_after: Optional[float] = None):
         if n_slots < 1:
             raise ValueError("DispatchBatcher needs at least one slot")
+        if flush_after is not None and flush_after <= 0:
+            raise ValueError("flush_after must be positive (or None)")
         self._cond = threading.Condition()
         self._n_slots = n_slots
         self._open = n_slots
+        self._idle = 0
+        self._flush_after = flush_after
         self._pending: List[_Request] = []
         self._clients = 0
         self.stats: Dict[str, int] = {
@@ -262,6 +294,7 @@ class DispatchBatcher:
             "device_calls": 0,
             "coalesced": 0,
             "max_group": 0,
+            "deadline_flushes": 0,
         }
 
     def client(self) -> BatchClient:
@@ -280,24 +313,48 @@ class DispatchBatcher:
             self._pending.append(req)
             self._cond.notify_all()
 
-    def _close_slot(self) -> None:
+    def _close_slot(self, was_idle: bool = False) -> None:
         with self._cond:
             self._open -= 1
+            if was_idle:
+                self._idle -= 1
+            self._cond.notify_all()
+
+    def _set_idle(self, delta: int) -> None:
+        with self._cond:
+            self._idle += delta
             self._cond.notify_all()
 
     # -- coordinator side -------------------------------------------------
     def _quiescent(self) -> bool:
-        # Every live run is parked on a request (each run has at most one
-        # outstanding dispatch — its thread is blocked on it).
-        return len(self._pending) >= self._open
+        # Every live, non-idle run is parked on a request (each run has at
+        # most one outstanding dispatch — its thread is blocked on it).
+        if self._open == 0:
+            return True
+        if not self._pending:
+            return False
+        return len(self._pending) >= max(self._open - self._idle, 0)
 
     def serve(self) -> None:
         """Coordinator loop: flush batches until every run finished."""
         while True:
             with self._cond:
-                self._cond.wait_for(self._quiescent)
+                # Phase 1: sleep until there is anything to do at all — a
+                # pending request to (eventually) flush, or shutdown.
+                self._cond.wait_for(
+                    lambda: self._pending or self._open == 0
+                )
                 if self._open == 0 and not self._pending:
                     return
+                # Phase 2: wait for quiescence, bounded by the flush
+                # deadline.  ``wait_for`` returns False on timeout.
+                quiesced = self._cond.wait_for(
+                    self._quiescent, timeout=self._flush_after
+                )
+                if not self._pending:
+                    continue
+                if not quiesced:
+                    self.stats["deadline_flushes"] += 1
                 batch, self._pending = self._pending, []
             self._flush(batch)
 
@@ -305,27 +362,41 @@ class DispatchBatcher:
         # Deterministic composition given a fixed co-pending set: groups
         # in first-key-seen order, rows in slot order.  (Results are
         # composition-independent anyway — the vmap-parity contract.)
-        groups: Dict[tuple, List[_Request]] = {}
-        for req in batch:
-            groups.setdefault(req.key, []).append(req)
-        for reqs in groups.values():
-            reqs.sort(key=lambda r: r.slot)
-            self.stats["dispatches"] += len(reqs)
-            self.stats["device_calls"] += 1
-            self.stats["max_group"] = max(self.stats["max_group"], len(reqs))
-            if len(reqs) > 1:
-                self.stats["coalesced"] += len(reqs)
-            try:
-                outs = batch_execute(
-                    reqs[0].kernel,
-                    [(r.args, r.arr_kw) for r in reqs],
-                    reqs[0].static_kw,
+        try:
+            groups: Dict[tuple, List[_Request]] = {}
+            for req in batch:
+                groups.setdefault(req.key, []).append(req)
+            for reqs in groups.values():
+                reqs.sort(key=lambda r: r.slot)
+                self.stats["dispatches"] += len(reqs)
+                self.stats["device_calls"] += 1
+                self.stats["max_group"] = max(
+                    self.stats["max_group"], len(reqs)
                 )
-            except BaseException as exc:  # noqa: BLE001 — deliver, don't hang
-                for r in reqs:
+                if len(reqs) > 1:
+                    self.stats["coalesced"] += len(reqs)
+                try:
+                    outs = batch_execute(
+                        reqs[0].kernel,
+                        [(r.args, r.arr_kw) for r in reqs],
+                        reqs[0].static_kw,
+                    )
+                except BaseException as exc:  # noqa: BLE001 — deliver, don't hang
+                    for r in reqs:
+                        r.error = exc
+                        r.done.set()
+                    continue
+                for r, out in zip(reqs, outs):
+                    r.result = out
+                    r.done.set()
+        except BaseException as exc:  # noqa: BLE001 — coordinator crash-safety
+            # A failure OUTSIDE the per-group kernel call (malformed
+            # request, stats bookkeeping, result demux) must still reach
+            # every owning slot: an undelivered request would leave its
+            # run thread parked forever and the whole grid deadlocked.
+            # The exception propagates through each owner's ``dispatch``;
+            # the coordinator itself keeps serving the other slots.
+            for r in batch:
+                if not r.done.is_set():
                     r.error = exc
                     r.done.set()
-                continue
-            for r, out in zip(reqs, outs):
-                r.result = out
-                r.done.set()

@@ -1,0 +1,55 @@
+"""Online serving layer: streaming arrivals, admission control, and
+SLO-metered continuous scheduling.
+
+Every other entry point in the framework is batch-shaped — a fixed
+workload in, run to exhaustion, exit.  This package is the layer the
+ROADMAP's "serves heavy traffic" north star needs above the batched
+dispatch engine (PR 1's ``sched/batch.py``): an unbounded stream of job
+arrivals (:mod:`~pivot_tpu.serve.arrivals`) flows through a bounded
+admission queue with configurable backpressure
+(:mod:`~pivot_tpu.serve.admission`) into G always-on scheduling
+sessions (:mod:`~pivot_tpu.serve.session`) whose per-tick placement
+dispatches coalesce into single vmapped device calls via idle-aware,
+deadline-flushed ``DispatchBatcher`` slots, all coordinated by the
+stream driver (:mod:`~pivot_tpu.serve.driver`) and metered by the
+serving-grade :class:`~pivot_tpu.infra.meter.SloMeter`.
+
+Entry points: ``python -m pivot_tpu.experiments.cli serve`` (the CLI
+service), ``bench.py``'s ``serve_stream`` row (sustained decisions/sec
++ p99 decision latency at a fixed arrival rate), and the classes below
+for embedding.  The correctness bar is inherited from the batch layer:
+a served schedule is **bit-identical** to the same job set run through
+batch-mode ``ExperimentRun`` (``tests/test_serve.py``).
+"""
+
+from pivot_tpu.serve.admission import (
+    ADMITTED,
+    BLOCKED,
+    SHED,
+    SPILLED,
+    AdmissionQueue,
+)
+from pivot_tpu.serve.arrivals import (
+    JobArrival,
+    poisson_arrivals,
+    synthetic_app_factory,
+    trace_arrivals,
+)
+from pivot_tpu.serve.driver import ServeDriver, closed_loop_source
+from pivot_tpu.serve.session import STOP, ServeSession
+
+__all__ = [
+    "ADMITTED",
+    "AdmissionQueue",
+    "BLOCKED",
+    "JobArrival",
+    "SHED",
+    "SPILLED",
+    "STOP",
+    "ServeDriver",
+    "ServeSession",
+    "closed_loop_source",
+    "poisson_arrivals",
+    "synthetic_app_factory",
+    "trace_arrivals",
+]

@@ -1,0 +1,108 @@
+"""Bounded admission control for the stream driver.
+
+The admission queue bounds **in-flight work** — jobs admitted into a
+scheduling session but not yet completed.  (A buffer of *unrouted*
+arrivals would always be drained instantly by the router and never
+exert backpressure; what an always-on scheduler must bound is the work
+it has accepted responsibility for.)  ``depth`` is therefore the
+service's concurrent-job capacity, and the queue-depth histogram the
+SLO meter records at each offer is the in-flight count.
+
+Three backpressure policies when the queue is full:
+
+  * ``shed``  — reject the arrival, recording the reason
+    (``queue_full``) in the SLO meter.  Lossy, latency-protecting.
+  * ``spill`` — defer the arrival to a spill buffer; the driver
+    re-offers it at the next completion boundary with its submission
+    time pushed to the following scheduler grid point ("spill to next
+    tick").  Lossless, order-preserving, latency-paying.
+  * ``block`` — the producer waits for capacity.  Lossless with the
+    original timestamps, but couples the arrival loop to completion
+    wall-time; in replay mode the driver advances the sim-release gate
+    while blocked so the wait can resolve deterministically.
+
+Decisions are returned as module constants (``ADMITTED`` / ``SHED`` /
+``SPILLED`` / ``BLOCKED``); the blocking dance itself lives in the
+driver, which owns the condition variable the completions notify.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+from pivot_tpu.infra.meter import SloMeter
+
+__all__ = [
+    "ADMITTED",
+    "AdmissionQueue",
+    "BLOCKED",
+    "SHED",
+    "SPILLED",
+]
+
+ADMITTED = "admitted"
+SHED = "shed"
+SPILLED = "spilled"
+BLOCKED = "blocked"
+
+_POLICIES = ("block", "shed", "spill")
+
+
+class AdmissionQueue:
+    """In-flight bound + backpressure decision.  NOT thread-safe on its
+    own: the driver serializes every call under its coordination lock
+    (the same lock completions notify), so decision + counter update are
+    atomic with respect to releases."""
+
+    def __init__(self, depth: int, policy: str = "shed",
+                 slo: Optional[SloMeter] = None):
+        if depth < 1:
+            raise ValueError("admission queue depth must be >= 1")
+        if policy not in _POLICIES:
+            raise ValueError(
+                f"unknown backpressure policy {policy!r} (use one of "
+                f"{_POLICIES})"
+            )
+        self.depth = depth
+        self.policy = policy
+        self.slo = slo or SloMeter()
+        self.in_flight = 0
+        self.spilled = deque()
+
+    @property
+    def full(self) -> bool:
+        return self.in_flight >= self.depth
+
+    def offer(self, arrival) -> str:
+        """One admission decision.  ``ADMITTED`` increments the in-flight
+        count (the caller routes the job); ``BLOCKED`` means the caller
+        must wait for capacity and re-offer."""
+        self.slo.count("arrived")
+        self.slo.record_queue_depth(self.in_flight)
+        if not self.full:
+            self.in_flight += 1
+            self.slo.count("admitted")
+            return ADMITTED
+        if self.policy == "shed":
+            self.slo.record_shed("queue_full")
+            return SHED
+        if self.policy == "spill":
+            self.spilled.append(arrival)
+            self.slo.count("spilled")
+            return SPILLED
+        return BLOCKED
+
+    def readmit(self, arrival) -> bool:
+        """Re-offer a spilled/blocked arrival (no double counting of the
+        ``arrived`` counter).  True = admitted."""
+        if self.full:
+            return False
+        self.in_flight += 1
+        self.slo.count("admitted")
+        return True
+
+    def release(self, n: int = 1) -> None:
+        """A job completed — free its capacity."""
+        self.in_flight -= n
+        assert self.in_flight >= 0, "admission release underflow"

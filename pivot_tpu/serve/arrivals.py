@@ -1,0 +1,131 @@
+"""Arrival generators for the online serving layer.
+
+A serving workload is a stream of :class:`JobArrival` items — an
+:class:`~pivot_tpu.workload.Application` stamped with the *sim-time*
+instant at which it enters the system.  Two sources:
+
+  * :func:`poisson_arrivals` — synthetic jobs from the
+    ``workload/gen.py`` generators at exponential inter-arrival gaps
+    (rate λ jobs per sim-second), the classic open-loop load model;
+  * :func:`trace_arrivals` — replay of a sampled Alibaba trace window
+    (YAML or the converter's columnar ``.npz``, ``workload/convert.py``)
+    at its recorded submit times, optionally re-timed onto a Poisson
+    process so a fixed trace can be replayed at any target load.
+
+Both are plain generators: the stream driver consumes lazily, so an
+unbounded stream (``n_jobs=None``) is just a generator that never ends.
+Arrival times are drawn from a seeded ``numpy`` Generator — the stream
+is deterministic per seed, which is what makes a served schedule
+bit-comparable to the same jobs through batch-mode ``ExperimentRun``
+(``tests/test_serve.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from pivot_tpu.workload import Application
+from pivot_tpu.workload.gen import (
+    SequentialApplicationGenerator,
+    _RangeSpec,
+)
+
+__all__ = [
+    "JobArrival",
+    "poisson_arrivals",
+    "synthetic_app_factory",
+    "trace_arrivals",
+]
+
+
+@dataclasses.dataclass
+class JobArrival:
+    """One job entering the service at sim-time ``ts``."""
+
+    ts: float
+    app: Application
+
+
+def synthetic_app_factory(
+    seed: int = 0,
+    n_nodes=(2, 4),
+    runtime=(5.0, 60.0),
+    instances_hint: int = 4,
+) -> Callable[[], Application]:
+    """Deterministic factory of small chain-DAG applications.
+
+    Alibaba-trace-like demands (fractional CPUs, fractional memory of a
+    7.68 GB-normalized machine) via the same ``_RangeSpec`` sampling the
+    batch generators use; suitable for load tests where the *arrival
+    process*, not DAG structure, is under study.
+    """
+    spec = _RangeSpec(
+        cpus=(0.5, 2.0),
+        mem=(64, 2048),
+        runtime=runtime,
+        output_size=(0, 200),
+    )
+    gen = SequentialApplicationGenerator(n_nodes, spec, seed=seed)
+    return gen.generate
+
+
+def poisson_arrivals(
+    rate: float,
+    n_jobs: Optional[int],
+    seed: int = 0,
+    make_app: Optional[Callable[[], Application]] = None,
+    start: float = 0.0,
+) -> Iterator[JobArrival]:
+    """Open-loop Poisson stream: exponential gaps at ``rate`` jobs per
+    sim-second, apps from ``make_app`` (default: the synthetic chain-DAG
+    factory seeded with ``seed``).  ``n_jobs=None`` streams forever."""
+    if rate <= 0:
+        raise ValueError("arrival rate must be positive")
+    rng = np.random.default_rng(seed)
+    if make_app is None:
+        make_app = synthetic_app_factory(seed=seed)
+    t = float(start)
+    produced = 0
+    while n_jobs is None or produced < n_jobs:
+        # Gap first: arrivals at start + Exp gaps, never exactly at the
+        # scheduler's t=0 grid point (same-instant submission/tick races
+        # are the one thing the bit-parity contract cannot absorb).
+        t += float(rng.exponential(1.0 / rate))
+        yield JobArrival(t, make_app())
+        produced += 1
+
+
+def trace_arrivals(
+    trace_file: str,
+    n_apps: Optional[int] = None,
+    scale_factor: float = 1000.0,
+    rate: Optional[float] = None,
+    seed: int = 0,
+) -> Iterator[JobArrival]:
+    """Replay a sampled Alibaba trace window as an arrival stream.
+
+    With ``rate=None`` jobs keep their recorded submit times (shifted so
+    the first arrival lands at its absolute trace offset — the batch
+    runner's schedule semantics).  With a ``rate``, the same job
+    *sequence* is re-timed onto a seeded Poisson process, which turns
+    one trace window into a load dial.
+    """
+    from pivot_tpu.workload.trace import load_trace_jobs
+
+    schedule = load_trace_jobs(trace_file, scale_factor)
+    if n_apps:
+        schedule = schedule.take(n_apps)
+    if rate is None:
+        for ts, apps in schedule.bins:
+            for app in apps:
+                yield JobArrival(float(ts), app)
+        return
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    for _ts, apps in schedule.bins:
+        for app in apps:
+            t += float(rng.exponential(1.0 / rate))
+            yield JobArrival(t, app)

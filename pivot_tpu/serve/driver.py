@@ -1,0 +1,344 @@
+"""The stream driver: admission, routing, release gate, lifecycle.
+
+Topology (docs/ARCHITECTURE.md "The online serving layer")::
+
+    arrivals ──▶ AdmissionQueue ──▶ round-robin router ──▶ session inboxes
+    (Poisson /     (bounded;          (deterministic)        │ one thread
+     trace-replay)  block/shed/spill)                        ▼ per session
+                                                   ServeSession event loops
+                                                         │ placement ticks
+                                                         ▼
+                                                   DispatchBatcher slots
+                                              (idle-aware, deadline flush)
+                                                         │
+                                                         ▼
+                                           ONE [G]-vmapped device dispatch
+
+The driver owns one condition variable that serializes every control
+decision: admission (in-flight accounting + backpressure), routing
+(round-robin over sessions — deterministic, which is what lets a served
+schedule be compared bit-for-bit against per-session batch runs), the
+**release gate** (sessions may not step an event past the largest
+arrival timestamp the stream has revealed — an online scheduler cannot
+simulate past "now"), completions (capacity release + spill re-offers +
+closed-loop refill), and shutdown.
+
+Wall-clock pacing is optional (``pace`` sim-seconds per wall-second);
+the default *replay* mode runs as fast as the sessions can step, which
+is both the bench configuration and the deterministic one.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import time
+
+from pivot_tpu.infra.meter import SloMeter
+from pivot_tpu.utils import LogMixin
+
+from pivot_tpu.serve.admission import ADMITTED, BLOCKED, AdmissionQueue
+from pivot_tpu.serve.arrivals import JobArrival
+from pivot_tpu.serve.session import ServeSession
+
+__all__ = ["ServeDriver", "closed_loop_source"]
+
+
+class ServeDriver(LogMixin):
+    """Always-on scheduling service over G concurrent sessions."""
+
+    #: Wall seconds between capacity re-checks while a ``block``-policy
+    #: producer waits; each expiry also advances the release gate one
+    #: scheduler tick so blocked admission cannot freeze sim time.
+    _BLOCK_POLL_S = 0.02
+
+    def __init__(
+        self,
+        sessions: List[ServeSession],
+        queue_depth: int = 64,
+        backpressure: str = "shed",
+        flush_after: Optional[float] = None,
+        slo: Optional[SloMeter] = None,
+    ):
+        if not sessions:
+            raise ValueError("ServeDriver needs at least one session")
+        self.sessions = list(sessions)
+        self.slo = slo or SloMeter()
+        self.queue = AdmissionQueue(queue_depth, backpressure, self.slo)
+        self.flush_after = flush_after
+        self.interval = sessions[0].interval
+        self.batcher = None
+        self._cv = threading.Condition()
+        self._released = 0.0
+        self._stop = False
+        self._errors: List[BaseException] = []
+        self._rr = 0
+        self._completion_hooks: List[Callable] = []
+        for slot, s in enumerate(self.sessions):
+            s._driver = self
+            s.slot = slot
+            s.slo = self.slo  # one service-wide SLO meter
+
+    # -- gate + coordination ----------------------------------------------
+    def wait_released(self, session: ServeSession, t: float,
+                      client=None) -> bool:
+        """Block ``session`` until the release frontier reaches sim time
+        ``t`` (or new work lands in its inbox, or shutdown).  The
+        session's batcher slot is marked idle for the duration so gated
+        sessions never park co-pending dispatches.  Returns False on
+        shutdown."""
+        with self._cv:
+            if self._released >= t or not session._inbox.empty():
+                return not self._stop
+            if client is not None:
+                client.set_idle(True)
+            try:
+                self._cv.wait_for(
+                    lambda: (
+                        self._stop
+                        or self._released >= t
+                        or not session._inbox.empty()
+                    )
+                )
+            finally:
+                if client is not None:
+                    client.set_idle(False)
+            return not self._stop
+
+    def _release_to(self, ts: float) -> None:
+        if ts > self._released:
+            self._released = ts
+            self._cv.notify_all()
+
+    def _next_tick(self, t: float) -> float:
+        return (math.floor(t / self.interval) + 1) * self.interval
+
+    def advance_gate(self) -> None:
+        """Let sim time flow one scheduler tick with no new arrivals —
+        the "time passes while we wait" primitive behind block-mode
+        admission and the closed-loop load generator (both wait on
+        completions that can only happen if the sessions may advance)."""
+        with self._cv:
+            if self._released != float("inf"):
+                self._release_to(self._next_tick(self._released))
+
+    # -- completions -------------------------------------------------------
+    def add_completion_hook(self, fn: Callable) -> None:
+        """``fn(session, app, sim_now)`` after every job completion —
+        the closed-loop load generator's refill tap."""
+        self._completion_hooks.append(fn)
+
+    def on_completed(self, session: ServeSession, app, sim_now: float):
+        with self._cv:
+            self.queue.release()
+            self.slo.count("completed")
+            self._reoffer_spilled(after_sim=sim_now)
+            self._cv.notify_all()
+        for fn in self._completion_hooks:
+            fn(session, app, sim_now)
+
+    def on_session_error(self, session: ServeSession, exc) -> None:
+        with self._cv:
+            self._errors.append(exc)
+            self._stop = True
+            self._cv.notify_all()
+        for s in self.sessions:
+            s.shutdown()
+
+    def _reoffer_spilled(self, after_sim: Optional[float] = None) -> None:
+        """Drain the spill buffer into freed capacity (cv held).  A
+        spilled job's submission lands no earlier than the scheduler
+        grid point after the instant that freed its slot — the "spill to
+        next tick" contract.  ``after_sim`` is the freeing completion's
+        sim time; the belt-and-braces call sites without one (capacity
+        cannot actually be free there — every release re-offers
+        immediately) fall back to the release frontier so a readmission
+        can never land in a session's past."""
+        while self.queue.spilled and not self.queue.full:
+            arr = self.queue.spilled.popleft()
+            floor_t = after_sim
+            if floor_t is None and self._released != float("inf"):
+                floor_t = self._released
+            if floor_t is not None:
+                arr = JobArrival(
+                    max(arr.ts, self._next_tick(floor_t)), arr.app
+                )
+            self.queue.readmit(arr)
+            self._route(arr)
+
+    # -- admission + routing ----------------------------------------------
+    def _route(self, arrival: JobArrival) -> None:
+        target = self.sessions[self._rr % len(self.sessions)]
+        self._rr += 1
+        target.offer(arrival)
+        self._cv.notify_all()
+
+    def _admit(self, arrival: JobArrival) -> None:
+        with self._cv:
+            # An arrival at ts proves the stream silent before ts: time
+            # may flow to it even while admission deliberates.
+            self._release_to(arrival.ts)
+            self._reoffer_spilled()
+            status = self.queue.offer(arrival)
+            while (
+                status == BLOCKED and not self._stop and not self._errors
+            ):
+                self.slo.count("blocked_waits")
+                notified = self._cv.wait(timeout=self._BLOCK_POLL_S)
+                if not notified and self._released != float("inf"):
+                    # No completion freed capacity: advance sim time one
+                    # tick so in-flight work can progress toward one.
+                    self._release_to(self._next_tick(self._released))
+                if not self.queue.full:
+                    self.queue.readmit(arrival)
+                    status = ADMITTED
+            if status == ADMITTED:
+                self._route(arrival)
+
+    def _produce(self, arrivals: Iterable[JobArrival],
+                 pace: Optional[float]) -> None:
+        wall0 = time.perf_counter()
+        try:
+            for arr in arrivals:
+                if self._stop:
+                    return
+                if pace:
+                    lag = arr.ts / pace - (time.perf_counter() - wall0)
+                    if lag > 0:
+                        time.sleep(lag)
+                self._admit(arr)
+            # Stream exhausted: reveal the open horizon, wait for the
+            # admitted work (and any spilled stragglers) to drain.
+            with self._cv:
+                self._release_to(float("inf"))
+                while not self._stop and not self._errors and (
+                    self.queue.in_flight > 0 or self.queue.spilled
+                ):
+                    self._reoffer_spilled()
+                    if self.queue.in_flight == 0 and not self.queue.spilled:
+                        break
+                    self._cv.wait(timeout=0.5)
+        except BaseException as exc:  # noqa: BLE001 — surfaced by run()
+            with self._cv:
+                self._errors.append(exc)
+                self._stop = True
+                self._cv.notify_all()
+        finally:
+            with self._cv:
+                self._release_to(float("inf"))
+            for s in self.sessions:
+                s.shutdown()
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self, arrivals: Iterable[JobArrival],
+            pace: Optional[float] = None) -> dict:
+        """Serve the stream to completion; returns the service report.
+
+        Batching engages when every session's policy qualifies (device
+        backend, deterministic routing — the ``run_grid_lockstep``
+        criterion): each session gets a ``DispatchBatcher`` slot and the
+        caller's thread runs the flush coordinator.  Otherwise sessions
+        run free (numpy/naive policies have no dispatch to coalesce).
+        """
+        clients = [None] * len(self.sessions)
+        if all(s.batchable for s in self.sessions):
+            # Initialize the backend once, here, before any session
+            # thread dispatches — concurrent first-touch PJRT client
+            # creation is not safe (same guard as run_grid_lockstep).
+            import jax
+
+            jax.default_backend()
+            from pivot_tpu.sched.batch import DispatchBatcher
+
+            self.batcher = DispatchBatcher(
+                len(self.sessions), flush_after=self.flush_after
+            )
+            clients = [self.batcher.client() for _ in self.sessions]
+            for s, c in zip(self.sessions, clients):
+                s.policy.enable_batching(c)
+        threads = [
+            threading.Thread(
+                target=s.loop, args=(c,),
+                name=f"serve-{s.label}", daemon=True,
+            )
+            for s, c in zip(self.sessions, clients)
+        ]
+        for t in threads:
+            t.start()
+        producer = threading.Thread(
+            target=self._produce, args=(arrivals, pace),
+            name="serve-producer", daemon=True,
+        )
+        producer.start()
+        if self.batcher is not None:
+            self.batcher.serve()
+        for t in threads:
+            t.join()
+        producer.join()
+        errors = self._errors + [
+            s.error for s in self.sessions if s.error is not None
+        ]
+        if errors:
+            raise errors[0]
+        return self.report()
+
+    def report(self) -> dict:
+        return {
+            "sessions": len(self.sessions),
+            "backpressure": self.queue.policy,
+            "queue_depth": self.queue.depth,
+            "flush_after_s": self.flush_after,
+            "slo": self.slo.snapshot(),
+            "batcher": dict(self.batcher.stats) if self.batcher else None,
+            "per_session": [s.summary() for s in self.sessions],
+        }
+
+
+def closed_loop_source(
+    driver: ServeDriver,
+    make_app: Callable,
+    concurrency: int,
+    n_jobs: int,
+    stagger: float = 1e-3,
+):
+    """Closed-loop load generator: keep ``concurrency`` jobs in flight;
+    every completion injects the next job at the scheduler grid point
+    after the completing session's clock — the N-users-think-time-zero
+    model, the complement of the open-loop Poisson stream."""
+    import queue as _queue
+
+    feed: "_queue.Queue" = _queue.Queue()
+    produced = {"n": 0}
+    lock = threading.Lock()
+
+    def emit(ts: float) -> None:
+        with lock:
+            if produced["n"] >= n_jobs:
+                return
+            produced["n"] += 1
+        feed.put(JobArrival(ts, make_app()))
+
+    for i in range(min(concurrency, n_jobs)):
+        emit(stagger * (i + 1))
+    driver.add_completion_hook(
+        lambda _s, _a, sim_now: emit(driver._next_tick(sim_now))
+    )
+
+    def gen():
+        yielded = 0
+        while yielded < n_jobs:
+            if driver._stop:
+                return
+            try:
+                item = feed.get(timeout=0.02)
+            except _queue.Empty:
+                # No completion yet: the in-flight jobs need sim time to
+                # finish, and only the producer can grant it.
+                driver.advance_gate()
+                continue
+            yield item
+            yielded += 1
+
+    return gen()

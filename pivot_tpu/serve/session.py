@@ -1,0 +1,246 @@
+"""One always-on scheduling session: a continuous DES under live arrivals.
+
+A :class:`ServeSession` is the serving analog of one batch
+``ExperimentRun``: the same construction — fresh event kernel, meter,
+cluster clone, ``GlobalScheduler`` wired to a policy — but instead of
+replaying a fixed schedule to event exhaustion, the session's thread
+*drains on demand*: it blocks on a job inbox, injects admitted arrivals
+at their sim-time instants, and steps the event kernel until the live
+work completes, then goes idle again.  The scheduler is never
+``stop()``-ed until shutdown, so its tick grid (``k × interval`` from
+sim time 0) keeps running exactly as a batch run's would — idle ticks
+are no-ops (empty ready batch ⇒ no policy call, no tick_seq advance,
+no meter traffic), which is what makes a served schedule bit-comparable
+to the same jobs through batch mode (``tests/test_serve.py``).
+
+Two serving-specific couplings:
+
+  * **dispatch batching** — when the driver hands the session a
+    ``BatchClient``, every device placement call parks the thread at its
+    tick boundary and coalesces with the other sessions' co-pending
+    ticks (``sched/batch.py``); the session marks its slot idle while
+    waiting for work so an empty session never stalls a busy one.
+  * **the release gate** — an online scheduler may not simulate past
+    "now": before stepping an event at sim time t the session waits for
+    the driver's release frontier to reach t (the driver advances it as
+    arrivals stream in, and to ∞ when the stream ends).  This is what
+    guarantees an arrival is injected before the session's clock passes
+    its timestamp — without the gate, a fast session could race ahead
+    of the arrival stream and every later job would slip.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra.meter import Meter, SloMeter
+from pivot_tpu.sched import GlobalScheduler
+from pivot_tpu.utils import LogMixin
+
+from pivot_tpu.serve.arrivals import JobArrival
+
+__all__ = ["STOP", "ServeSession"]
+
+#: Inbox sentinel: the driver ends a session's loop with this.
+STOP = object()
+
+
+def _is_batchable(policy) -> bool:
+    """Device-backed, deterministic-routing policies may share a batched
+    dispatch (the ``run_grid_lockstep`` criterion, checked structurally
+    so pure-numpy serving never imports jax)."""
+    return (
+        hasattr(policy, "enable_batching")
+        and not getattr(policy, "adaptive", False)
+        and not getattr(policy, "use_pallas", False)
+    )
+
+
+class ServeSession(LogMixin):
+    """One live scheduling context multiplexed by the serve driver."""
+
+    def __init__(
+        self,
+        label: str,
+        cluster,
+        policy,
+        seed: Optional[int] = None,
+        interval: float = 5.0,
+        slo: Optional[SloMeter] = None,
+    ):
+        self.label = label
+        self.policy = policy
+        self.seed = seed
+        self.interval = interval
+        self.slo = slo or SloMeter()
+        self.error: Optional[BaseException] = None
+        self.completed: List = []
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._live: List = []  # injected, not yet finished apps
+        self._injected: List = []  # every app ever injected, in order
+        self._driver = None  # attached by ServeDriver
+        self.slot = -1
+
+        # Mirror ExperimentRun.run()'s construction exactly — the parity
+        # contract depends on the two modes building identical worlds.
+        self.env = Environment()
+        self.meter = Meter(self.env, cluster.meta)
+        self.cluster = cluster.clone(self.env, self.meter)
+        self.scheduler = GlobalScheduler(
+            self.env,
+            self.cluster,
+            policy,
+            interval=interval,
+            seed=seed,
+            meter=self.meter,
+        )
+        self.cluster.start()
+        self.scheduler.start()
+        self._last_unfinished = 0
+        self._install_decision_tap()
+
+    @property
+    def batchable(self) -> bool:
+        return _is_batchable(self.policy)
+
+    def _install_decision_tap(self) -> None:
+        """Wrap ``policy.place`` with the SLO decision-latency recorder.
+        Measures the full wall duration of each placement call — batcher
+        park time included, which is exactly the latency a caller of an
+        online scheduler experiences."""
+        orig = self.policy.place
+
+        def timed_place(ctx):
+            t0 = time.perf_counter()
+            out = orig(ctx)
+            dt = time.perf_counter() - t0
+            arr = np.asarray(out)
+            # Late-bound through the session: the driver swaps in the
+            # service-wide SLO meter after construction.
+            self.slo.record_decision(dt, int(arr.shape[0]),
+                                     int((arr >= 0).sum()))
+            return out
+
+        self.policy.place = timed_place
+
+    # -- driver-facing ----------------------------------------------------
+    def offer(self, arrival: JobArrival) -> None:
+        """Route one admitted arrival to this session (driver thread)."""
+        self._inbox.put(arrival)
+
+    def shutdown(self) -> None:
+        self._inbox.put(STOP)
+
+    # -- the session thread ----------------------------------------------
+    def loop(self, client=None) -> None:
+        """Thread body: wait for work, inject, drain, repeat until STOP."""
+        try:
+            while True:
+                if client is not None:
+                    client.set_idle(True)
+                item = self._inbox.get()
+                if client is not None:
+                    client.set_idle(False)
+                if item is STOP:
+                    break
+                self._inject(item)
+                self._drain(client)
+        except BaseException as exc:  # noqa: BLE001 — surfaced by driver
+            self.error = exc
+            if self._driver is not None:
+                self._driver.on_session_error(self, exc)
+        finally:
+            self.scheduler.stop()
+            if client is not None:
+                client.close()
+
+    def _poll_inbox(self) -> None:
+        while True:
+            try:
+                item = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if item is STOP:
+                # Re-queue so the outer loop sees it after the drain.
+                self._inbox.put(item)
+                return
+            self._inject(item)
+
+    def _inject(self, arrival: JobArrival) -> None:
+        """Enter one job: submission scheduled at its sim-time instant,
+        or immediately (a recorded *late injection*) when the session's
+        clock has already passed it."""
+        env = self.env
+        self._live.append(arrival.app)
+        self._injected.append(arrival.app)
+        arrival.app._serve_admit_ts = arrival.ts
+        if arrival.ts >= env.now:
+            env.schedule_callback_at(
+                arrival.ts,
+                lambda app=arrival.app: self.scheduler.submit(app),
+            )
+        else:
+            self.slo.count("late_injections")
+            self.scheduler.submit(arrival.app)
+
+    def _work_pending(self) -> bool:
+        return bool(self._live)
+
+    def _drain(self, client=None) -> None:
+        env = self.env
+        driver = self._driver
+        while self._work_pending():
+            self._poll_inbox()
+            t_next = env.peek()
+            if t_next == float("inf"):
+                break  # defensive: nothing scheduled at all
+            if driver is not None and not driver.wait_released(
+                self, t_next, client
+            ):
+                return  # shutdown requested mid-drain
+            self._poll_inbox()  # arrivals routed while gated
+            env.step()
+            if self.scheduler._n_unfinished != self._last_unfinished:
+                self._last_unfinished = self.scheduler._n_unfinished
+                self._reap_completions()
+        # Close out the current instant (same-time meter/bookkeeping
+        # events) so the idle state the session parks in is final.
+        now = env.now
+        while env.peek() <= now:
+            env.step()
+        self._reap_completions()
+
+    def _reap_completions(self) -> None:
+        done = [a for a in self._live if a.is_finished]
+        if not done:
+            return
+        self._live = [a for a in self._live if not a.is_finished]
+        for app in done:
+            self.completed.append(app)
+            admit_ts = getattr(app, "_serve_admit_ts", app.start_time)
+            self.slo.record_sojourn(max(app.end_time - admit_ts, 0.0))
+            if self._driver is not None:
+                self._driver.on_completed(self, app, self.env.now)
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> dict:
+        s = self.meter.summary()
+        # Injection order, not completion order: the float sum must run
+        # in the same order batch-mode ``ExperimentRun`` sums its
+        # schedule, or avg_runtime drifts by an ULP (the parity test
+        # compares exact values).
+        runtimes = [
+            a.end_time - a.start_time for a in self._injected
+            if a.is_finished
+        ]
+        s["label"] = self.label
+        s["n_apps"] = len(self.completed)
+        s["avg_runtime"] = (
+            sum(runtimes) / len(runtimes) if runtimes else 0.0
+        )
+        return s

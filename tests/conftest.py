@@ -69,6 +69,14 @@ def _reset_ids():
 
 _SLOW_TESTS = {
     "test_audit.py": ["test_cli_audit_flag"],
+    "test_batch_dispatch.py": [
+        # Each keeps a quick smoke twin in tier 1 (PR 2 runtime hygiene):
+        # test_lockstep_grid_smoke_and_stats_keys,
+        # test_rollout_segment_donated_smoke, test_pipelined_segments_smoke.
+        "test_lockstep_grid_bit_identical_to_sequential",
+        "test_rollout_segment_accepts_donated_carry",
+        "test_pipelined_segments_match_monolithic",
+    ],
     "test_checkpoint.py": [
         "test_checkpointed_policy_arm_matches_plain",
         "test_chunked_first_chunk_matches_plain",
@@ -114,7 +122,7 @@ _SLOW_TESTS = {
     ],
     "test_executor.py": ["test_full_sim_bit_parity"],
     "test_experiments.py": [
-        "test_cli_serve_resident_worker",
+        "test_cli_worker_resident",
         "test_estimator_egress_fidelity_canonical_config",
         "test_lifo_wave_parity_vs_des",
         "test_calibrate_distributional_des_seeds",
@@ -131,6 +139,8 @@ _SLOW_TESTS = {
         "test_cli_apps_sweep_end_to_end",
         "test_capacity_unfinished_candidate_clamped",
         "test_calibrate_mode_combination_validation",
+        # Quick twin in tier 1: test_plot_host_usage_smoke.
+        "test_plot_host_and_resource_usage",
     ],
     "test_graft_entry.py": [
         "test_dryrun_multichip_reexec_fallback",
@@ -138,6 +148,8 @@ _SLOW_TESTS = {
     ],
     "test_kernels.py": [
         "test_full_sim_parity_cost_aware",
+        # Quick twin in tier 1: test_full_sim_parity_smoke_opportunistic.
+        "test_full_sim_parity_opportunistic",
     ],
     "test_sensitivity.py": ["test_cli_sensitivity_paired_experiment"],
     "test_tpu_validate.py": [

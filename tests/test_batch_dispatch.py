@@ -96,6 +96,171 @@ def test_lockstep_grid_bit_identical_to_sequential():
     assert stats["coalesced"] > 0
 
 
+def test_lockstep_grid_smoke_and_stats_keys():
+    """Quick-tier twin of the full 4-run parity test: a 2-run lockstep
+    grid at tiny scale stays bit-identical to sequential execution, and
+    ``stats_out`` carries exactly the documented key set
+    (docs/ARCHITECTURE.md; the runner docstring is the contract)."""
+    from pivot_tpu.experiments.runner import run_grid_lockstep
+    from pivot_tpu.utils import reset_ids
+
+    reset_ids()
+    seq_runs = _grid_runs(2, n_hosts=8, n_apps=2)
+    seq_logs = [_record_placements(r) for r in seq_runs]
+    seq_sums = [r.run() for r in seq_runs]
+
+    reset_ids()
+    bat_runs = _grid_runs(2, n_hosts=8, n_apps=2)
+    bat_logs = [_record_placements(r) for r in bat_runs]
+    stats = {}
+    bat_sums = run_grid_lockstep(bat_runs, stats_out=stats)
+
+    assert set(stats) == {
+        "runs", "dispatches", "device_calls", "coalesced", "max_group",
+        "deadline_flushes",
+    }
+    assert stats["runs"] == 2
+    assert stats["device_calls"] <= stats["dispatches"]
+    assert stats["deadline_flushes"] == 0  # grid mode: quiescence-only
+    for g in range(2):
+        assert len(seq_logs[g]) == len(bat_logs[g])
+        for tick, (a, b) in enumerate(zip(seq_logs[g], bat_logs[g])):
+            np.testing.assert_array_equal(a, b, err_msg=f"run {g} tick {tick}")
+        assert _strip_wall(seq_sums[g]) == _strip_wall(bat_sums[g])
+
+
+def test_flush_exception_propagates_to_owning_slots():
+    """Crash-safety: a kernel that raises inside a flush must deliver
+    the exception to every owning slot and leave the coordinator alive —
+    parked threads released, no deadlock (the satellite regression)."""
+    import threading
+
+    from pivot_tpu.sched.batch import DispatchBatcher
+
+    def boom(x):
+        raise RuntimeError("kernel exploded")
+
+    batcher = DispatchBatcher(2)
+    clients = [batcher.client() for _ in range(2)]
+    x = np.ones((4,), dtype=np.float32)
+    results = {}
+
+    def work(slot):
+        try:
+            try:
+                # Same kernel + shape on both slots → ONE coalesced
+                # group → the failure happens inside the vmapped flush.
+                clients[slot].dispatch(boom, (x,))
+                results[slot] = "no error"
+            except RuntimeError as exc:
+                results[slot] = str(exc)
+        finally:
+            clients[slot].close()
+
+    threads = [
+        threading.Thread(target=work, args=(s,), daemon=True)
+        for s in range(2)
+    ]
+    for t in threads:
+        t.start()
+    batcher.serve()  # must return — a deadlock here hangs the test
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "parked thread leaked"
+    assert results == {0: "kernel exploded", 1: "kernel exploded"}
+
+
+def test_flush_exception_spares_other_groups():
+    """An exploding group must not take down a co-pending healthy group
+    in the same flush."""
+    import threading
+
+    from pivot_tpu.sched.batch import DispatchBatcher
+
+    def boom(x):
+        raise RuntimeError("kernel exploded")
+
+    def good(x):
+        return x + 1
+
+    batcher = DispatchBatcher(2)
+    clients = [batcher.client() for _ in range(2)]
+    x = np.ones((4,), dtype=np.float32)
+    out = {}
+
+    def work(slot, kernel):
+        try:
+            try:
+                out[slot] = clients[slot].dispatch(kernel, (x,))
+            except RuntimeError as exc:
+                out[slot] = str(exc)
+        finally:
+            clients[slot].close()
+
+    threads = [
+        threading.Thread(target=work, args=(0, boom), daemon=True),
+        threading.Thread(target=work, args=(1, good), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    batcher.serve()
+    for t in threads:
+        t.join(timeout=30)
+    assert out[0] == "kernel exploded"
+    np.testing.assert_array_equal(out[1], x + 1)
+
+
+def test_deadline_flush_with_single_occupied_slot():
+    """Serving extension: with ``flush_after`` set, one parked slot is
+    served within the deadline even though a second slot is neither
+    parked, idle, nor closed (the straggler-session scenario)."""
+    import threading
+    import time
+
+    from pivot_tpu.sched.batch import DispatchBatcher
+
+    batcher = DispatchBatcher(2, flush_after=0.05)
+    c0 = batcher.client()
+    c1 = batcher.client()  # claimed, silent: simulates a busy straggler
+    server = threading.Thread(target=batcher.serve, daemon=True)
+    server.start()
+
+    t0 = time.perf_counter()
+    out = c0.dispatch(lambda x: x * 2, (np.arange(4.0),))
+    waited = time.perf_counter() - t0
+    np.testing.assert_array_equal(out, np.arange(4.0) * 2)
+    assert waited < 5.0, "deadline flush did not fire"
+    assert batcher.stats["deadline_flushes"] >= 1
+    c0.close()
+    c1.close()
+    server.join(timeout=30)
+    assert not server.is_alive()
+
+
+def test_idle_slot_excluded_from_quiescence():
+    """An idle slot does not park co-pending dispatches: with slot 1
+    declared idle, slot 0's dispatch is served by quiescence (no
+    deadline needed) — the serve-session inbox-wait contract."""
+    import threading
+
+    from pivot_tpu.sched.batch import DispatchBatcher
+
+    batcher = DispatchBatcher(2)  # NO flush_after: quiescence-only
+    c0 = batcher.client()
+    c1 = batcher.client()
+    c1.set_idle(True)
+    server = threading.Thread(target=batcher.serve, daemon=True)
+    server.start()
+
+    out = c0.dispatch(lambda x: x + 3, (np.arange(3.0),))
+    np.testing.assert_array_equal(out, np.arange(3.0) + 3)
+    assert batcher.stats["deadline_flushes"] == 0
+    c0.close()
+    c1.close()
+    server.join(timeout=30)
+    assert not server.is_alive()
+
+
 def test_batch_execute_matches_individual_calls():
     """The pure core: N same-shaped kernel requests through one vmapped
     dispatch (including a padded, non-power bucket: 3 → 4) return exactly
@@ -202,6 +367,56 @@ def test_rollout_segment_accepts_donated_carry(small_rollout_inputs):
     for name, a, b in zip(ref._fields, ref, s):
         np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b), err_msg=name
+        )
+
+
+def test_rollout_segment_donated_smoke(small_rollout_inputs):
+    """Quick-tier twin of the donated-carry test: a single donated
+    segment call accepts the donated carry and matches the undonated
+    program (the slow variant chains two segments at 2× the ticks)."""
+    from pivot_tpu.parallel.ensemble.state import _init_state
+    from pivot_tpu.parallel.ensemble.tick import _rollout_segment
+
+    workload, topo, avail0 = small_rollout_inputs
+    T, Z = workload.n_tasks, topo.cost.shape[0]
+    ra = jnp.zeros((T,), jnp.int32)
+
+    def segment(state, n_ticks):
+        return _rollout_segment(
+            state, workload.runtime, workload.arrival, ra, workload, topo,
+            5.0, n_ticks, forms="indexed",
+        )
+
+    donated = jax.jit(
+        segment, static_argnames=("n_ticks",), donate_argnums=(0,)
+    )
+    ref = segment(_init_state(avail0, T, Z), 8)
+    s = jax.tree_util.tree_map(jnp.copy, _init_state(avail0, T, Z))
+    s = donated(s, n_ticks=8)
+    for name, a, b in zip(ref._fields, ref, s):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=name
+        )
+
+
+def test_pipelined_segments_smoke(small_rollout_inputs):
+    """Quick-tier twin of the pipelined-executor parity test at smoke
+    scale (2 replicas × 16 ticks, ragged 5-tick segments)."""
+    from pivot_tpu.parallel.ensemble import rollout, rollout_checkpointed
+
+    workload, topo, avail0 = small_rollout_inputs
+    sz = jnp.asarray([0, 1], jnp.int32)
+    cfg = dict(n_replicas=2, tick=5.0, max_ticks=16, perturb=0.1)
+    key = jax.random.PRNGKey(11)
+    plain = rollout(key, avail0, workload, topo, sz, **cfg)
+    piped = rollout_checkpointed(
+        key, avail0, workload, topo, sz, None, segment_ticks=5, **cfg
+    )
+    for field in ("makespan", "placement", "finish_time", "egress_cost"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain, field)),
+            np.asarray(getattr(piped, field)),
+            err_msg=field,
         )
 
 

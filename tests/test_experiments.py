@@ -139,6 +139,32 @@ def test_cli_num_apps_end_to_end(tmp_path):
     assert (exp_dir / "data" / "10").is_dir()
 
 
+def test_plot_host_usage_smoke(tmp_path):
+    """Quick-tier twin of the usage-curve renderer test: a tiny run's
+    serialized host_usage.json still renders to a non-empty file."""
+    from pivot_tpu.des import Environment
+    from pivot_tpu.experiments.plots import plot_host_usage
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.sched.policies import FirstFitPolicy
+
+    meta = ResourceMetadata(seed=0)
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(6)
+    run = ExperimentRun(
+        "usage-smoke", cluster, FirstFitPolicy(decreasing=True),
+        "data/jobs/jobs-5000-200-86400-172800.npz",
+        n_apps=2, seed=0, data_dir=str(tmp_path),
+    )
+    run.run()
+    out = plot_host_usage(str(tmp_path / "usage-smoke"))
+    assert os.path.exists(out) and os.path.getsize(out) > 0
+
+
 def test_plot_host_and_resource_usage(tmp_path):
     """The usage-curve renderers (ref meter.py:135-159) produce files from a
     real run's meter and serialized host_usage.json."""
@@ -859,7 +885,7 @@ def test_lifo_wave_parity_vs_des(policy, n_hosts, n_apps):
         )
 
 
-def test_cli_serve_resident_worker(tmp_path):
+def test_cli_worker_resident(tmp_path):
     """The resident worker serves repeated requests in one process with
     per-request reports identical to fresh one-shot runs, and the second
     identical request reuses the warm programs (no re-init)."""
@@ -875,7 +901,7 @@ def test_cli_serve_resident_worker(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     stdin = json.dumps(req) + "\n" + json.dumps(req) + "\nquit\n"
     proc = subprocess.run(
-        [sys.executable, "-m", "pivot_tpu.experiments.cli", "serve"],
+        [sys.executable, "-m", "pivot_tpu.experiments.cli", "worker"],
         input=stdin, capture_output=True, text=True, timeout=600, env=env,
         cwd=os.path.join(os.path.dirname(__file__), ".."),
     )
@@ -909,15 +935,15 @@ def test_cli_serve_resident_worker(tmp_path):
     # Bad request: the worker reports the error and keeps its sentinel
     # cadence instead of dying.
     proc3 = subprocess.run(
-        [sys.executable, "-m", "pivot_tpu.experiments.cli", "serve"],
-        input='{"not": "argv"}\n["serve"]\nquit\n', capture_output=True,
+        [sys.executable, "-m", "pivot_tpu.experiments.cli", "worker"],
+        input='{"not": "argv"}\n["worker"]\nquit\n', capture_output=True,
         text=True, timeout=300, env=env,
         cwd=os.path.join(os.path.dirname(__file__), ".."),
     )
     assert proc3.returncode == 0
     out3 = [json.loads(ln) for ln in proc3.stdout.splitlines() if ln.startswith("{")]
     errors3 = [d for d in out3 if "error" in d]
-    # Both the malformed request and the nested-serve request error out
+    # Both the malformed request and the nested-worker request error out
     # without killing the worker (sentinels keep their cadence).
     assert len(errors3) == 2
     assert "nested" in errors3[1]["error"]

@@ -212,6 +212,31 @@ def test_full_sim_parity_opportunistic(meta):
     assert run(OpportunisticPolicy("numpy")) == run(as_f64(TpuOpportunisticPolicy()))
 
 
+def test_full_sim_parity_smoke_opportunistic(meta):
+    """Quick-tier twin of the full opportunistic parity run: same
+    numpy-vs-device whole-simulation comparison at smoke scale (the
+    slow variant keeps the canonical 20 hosts × 15 apps)."""
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(10)
+    trace = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def run(policy):
+        s = ExperimentRun(
+            "parity-smoke", cluster, policy, trace, n_apps=4, seed=4
+        ).run()
+        return (s["avg_runtime"], s["egress_cost"], s["cum_instance_hours"])
+
+    assert run(OpportunisticPolicy("numpy")) == run(
+        as_f64(TpuOpportunisticPolicy())
+    )
+
+
 # -- adaptive dispatch -------------------------------------------------------
 
 

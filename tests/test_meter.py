@@ -153,3 +153,83 @@ def test_turnover_in_general_json(meter, tmp_path):
     with open(tmp_path / "general.json") as f:
         general = json.load(f)
     assert general["avg_scheduling_turnover"] == pytest.approx(10.0)
+
+
+# -- serving telemetry (StreamingHistogram / SloMeter) -----------------------
+
+
+def test_streaming_histogram_percentiles_bounded_error():
+    """Log-bucketed percentile estimates track numpy's within the
+    bucket's relative-error bound, and the exact moments are exact."""
+    import numpy as np
+
+    from pivot_tpu.infra.meter import StreamingHistogram
+
+    rng = np.random.default_rng(0)
+    samples = rng.lognormal(mean=-5.0, sigma=1.5, size=5000)
+    h = StreamingHistogram(1e-6, 1e4, bins_per_decade=64)
+    for v in samples:
+        h.record(v)
+    assert h.count == 5000
+    assert h.mean == pytest.approx(samples.mean())
+    assert h.snapshot()["min"] == samples.min()
+    assert h.snapshot()["max"] == samples.max()
+    rel = 10 ** (1 / 64) - 1  # one-bucket relative width
+    for q in (50, 90, 95, 99):
+        exact = float(np.percentile(samples, q))
+        est = h.percentile(q)
+        assert est >= exact * (1 - 1e-12), (q, est, exact)
+        assert est <= exact * (1 + 2 * rel) + 1e-12, (q, est, exact)
+
+
+def test_streaming_histogram_edges_and_empty():
+    from pivot_tpu.infra.meter import StreamingHistogram
+
+    h = StreamingHistogram(1e-3, 1e3)
+    assert h.snapshot() == {"count": 0}
+    assert h.percentile(99) == 0.0
+    h.record(1e-9)   # below lo: clamps into the first bucket
+    h.record(1e9)    # above hi: clamps into the last bucket
+    assert h.count == 2
+    snap = h.snapshot()
+    assert snap["min"] == 1e-9 and snap["max"] == 1e9
+    # p50 lands in the clamp buckets but never exceeds the exact max.
+    assert h.percentile(100) <= 1e9
+
+
+def test_slo_meter_counters_and_snapshot():
+    from pivot_tpu.infra.meter import SloMeter
+
+    slo = SloMeter()
+    slo.count("arrived", 3)
+    slo.count("admitted", 2)
+    slo.record_shed("queue_full")
+    slo.record_decision(0.002, 5, 4)
+    slo.record_decision(0.004, 3, 3)
+    slo.record_queue_depth(2)
+    slo.record_sojourn(120.0)
+    snap = slo.snapshot()
+    c = snap["counters"]
+    assert c["arrived"] == 3 and c["admitted"] == 2
+    assert c["shed"] == 1 and snap["shed_reasons"] == {"queue_full": 1}
+    assert c["decisions"] == 8 and c["placed"] == 7
+    assert snap["decision_latency_s"]["count"] == 2
+    assert 0.002 <= snap["decision_latency_s"]["p50"] <= 0.005
+    assert snap["queue_depth"]["count"] == 1
+    assert snap["sojourn_sim_s"]["max"] == 120.0
+    # Every documented counter key is present even when untouched.
+    assert set(SloMeter.COUNTERS) <= set(c)
+
+
+def test_slo_meter_save_round_trips(tmp_path):
+    import json
+
+    from pivot_tpu.infra.meter import SloMeter
+
+    slo = SloMeter()
+    slo.record_decision(0.001, 1, 1)
+    path = str(tmp_path / "slo" / "snapshot.json")
+    slo.save(path)
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["counters"]["decisions"] == 1

@@ -1,0 +1,262 @@
+"""Online serving layer (``pivot_tpu.serve``).
+
+The acceptance bars: a Poisson arrival stream served end-to-end on the
+CPU backend with ≥2 sessions sharing one batched dispatch; backpressure
+(shed / spill / block) observable in the SLO snapshot; and the parity
+contract — a served schedule is **bit-identical** to the same job set
+executed through batch-mode ``ExperimentRun``.
+"""
+
+import numpy as np
+
+from conftest import load_root_module
+
+from pivot_tpu.serve import (
+    ServeDriver,
+    ServeSession,
+    closed_loop_source,
+    poisson_arrivals,
+    synthetic_app_factory,
+    trace_arrivals,
+)
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.utils.config import (
+    ClusterConfig,
+    PolicyConfig,
+    build_cluster,
+    make_policy,
+)
+
+TRACE = "data/jobs/jobs-5000-200-172800-259200.npz"
+
+
+def _device_policy():
+    return make_policy(
+        PolicyConfig(
+            name="cost-aware", device="tpu", bin_pack="first-fit",
+            sort_tasks=True, sort_hosts=True, adaptive=False,
+        )
+    )
+
+
+def _numpy_policy():
+    return make_policy(
+        PolicyConfig(
+            name="cost-aware", device="numpy",
+            sort_tasks=True, sort_hosts=True,
+        )
+    )
+
+
+def _sessions(n, make_pol, n_hosts=8, seed=0, cluster_seed=0):
+    return [
+        ServeSession(
+            f"s{g}",
+            build_cluster(ClusterConfig(n_hosts=n_hosts, seed=cluster_seed)),
+            make_pol(),
+            seed=seed,
+        )
+        for g in range(n)
+    ]
+
+
+def _record_placements(policy):
+    log = []
+    orig = policy.place
+
+    def recorder(ctx):
+        p = orig(ctx)
+        log.append(np.asarray(p).copy())
+        return p
+
+    policy.place = recorder
+    return log
+
+
+# -- end-to-end + parity (the tentpole acceptance) ---------------------------
+
+
+def test_poisson_stream_shares_batched_dispatch():
+    """≥2 concurrent sessions serve a Poisson stream end-to-end on the
+    CPU backend with their per-tick placement dispatches coalesced into
+    shared vmapped device calls."""
+    sessions = _sessions(2, _device_policy)
+    driver = ServeDriver(sessions, queue_depth=32, backpressure="shed",
+                         flush_after=0.5)
+    report = driver.run(poisson_arrivals(rate=0.1, n_jobs=8, seed=3))
+    c = report["slo"]["counters"]
+    assert c["arrived"] == 8 and c["admitted"] == 8
+    assert c["completed"] == 8 and c["shed"] == 0
+    assert c["decisions"] > 0 and c["placed"] > 0
+    stats = report["batcher"]
+    assert stats["coalesced"] > 0, "no dispatch was shared across sessions"
+    assert stats["max_group"] == 2
+    assert stats["device_calls"] < stats["dispatches"]
+    # Decision-latency SLO is live.
+    lat = report["slo"]["decision_latency_s"]
+    assert lat["count"] == stats["dispatches"]
+    assert 0 < lat["p50"] <= lat["p99"]
+
+
+def test_served_schedule_bit_identical_to_batch_mode():
+    """The parity bar: per-tick placements AND meter output of every
+    served session are bit-identical to the same job subset run through
+    batch-mode ``ExperimentRun`` (same cluster, policy, seed).
+
+    The comparator schedule carries an empty t=0 bin so ``replay_schedule``
+    submits at the stream's ABSOLUTE arrival instants (its first bin
+    otherwise submits at process start), and Poisson float timestamps
+    keep submissions off the tick grid — the serve layer's documented
+    parity preconditions.
+    """
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.workload.trace import TraceSchedule
+
+    G, N = 2, 8
+
+    def arrivals():
+        return list(
+            poisson_arrivals(
+                rate=0.05, n_jobs=N, seed=7,
+                make_app=synthetic_app_factory(seed=11),
+            )
+        )
+
+    reset_ids()
+    arrs = arrivals()
+    sessions = _sessions(G, _device_policy)
+    serve_logs = [_record_placements(s.policy) for s in sessions]
+    driver = ServeDriver(sessions, queue_depth=32, backpressure="shed")
+    report = driver.run(iter(arrs))
+    assert report["slo"]["counters"]["completed"] == N
+    assert report["batcher"]["coalesced"] > 0
+    serve_sums = [s.summary() for s in sessions]
+
+    reset_ids()
+    arrs2 = arrivals()  # identical regeneration (seeded, fresh ids)
+    keys = (
+        "egress_cost", "cum_instance_hours", "avg_congestion_delay",
+        "total_scheduling_ops", "avg_scheduling_turnover", "avg_runtime",
+        "n_apps",
+    )
+    for g in range(G):
+        subset = arrs2[g::G]  # the driver's round-robin assignment
+        schedule = TraceSchedule(
+            [(0.0, [])] + [(a.ts, [a.app]) for a in subset]
+        )
+        policy = _device_policy()
+        run = ExperimentRun(
+            f"batch-{g}",
+            build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            policy, schedule=schedule, seed=0, interval=5.0,
+        )
+        batch_log = _record_placements(policy)
+        batch_sum = run.run()
+        assert len(serve_logs[g]) == len(batch_log)
+        for tick, (a, b) in enumerate(zip(serve_logs[g], batch_log)):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"session {g} tick {tick}"
+            )
+        assert {k: serve_sums[g][k] for k in keys} == {
+            k: batch_sum[k] for k in keys
+        }
+
+
+def test_trace_replay_source_serves_alibaba_jobs():
+    """The trace-replay generator (Alibaba converter .npz) feeds the
+    service; recorded submit times replay losslessly at ample depth."""
+    arrs = list(trace_arrivals(TRACE, n_apps=4))
+    assert [a.ts for a in arrs] == sorted(a.ts for a in arrs)
+    sessions = _sessions(2, _numpy_policy)
+    driver = ServeDriver(sessions, queue_depth=16, backpressure="shed")
+    report = driver.run(iter(arrs))
+    assert report["slo"]["counters"]["completed"] == 4
+    assert report["batcher"] is None  # numpy sessions have no dispatch
+
+
+# -- backpressure ------------------------------------------------------------
+
+
+def test_queue_full_shed_path():
+    """Arrivals beyond the in-flight bound are shed with a recorded
+    reason; admitted jobs still complete."""
+    sessions = _sessions(1, _numpy_policy)
+    driver = ServeDriver(sessions, queue_depth=2, backpressure="shed")
+    # Long jobs + a burst of arrivals in a short sim window: in-flight
+    # necessarily exceeds depth 2 before anything can complete.
+    make_app = synthetic_app_factory(seed=5, runtime=(200.0, 300.0))
+    report = driver.run(
+        poisson_arrivals(rate=1.0, n_jobs=8, seed=2, make_app=make_app)
+    )
+    c = report["slo"]["counters"]
+    assert c["arrived"] == 8
+    assert c["shed"] > 0, "queue never shed despite depth 2"
+    assert report["slo"]["shed_reasons"].get("queue_full") == c["shed"]
+    assert c["completed"] == c["admitted"] == 8 - c["shed"]
+    assert report["slo"]["queue_depth"]["max"] >= 2
+
+
+def test_spill_backpressure_is_lossless():
+    """Spill-to-next-tick: overflow arrivals are deferred, never
+    dropped — every job completes and the spills are counted."""
+    sessions = _sessions(1, _numpy_policy)
+    driver = ServeDriver(sessions, queue_depth=2, backpressure="spill")
+    make_app = synthetic_app_factory(seed=5, runtime=(200.0, 300.0))
+    report = driver.run(
+        poisson_arrivals(rate=1.0, n_jobs=8, seed=2, make_app=make_app)
+    )
+    c = report["slo"]["counters"]
+    assert c["spilled"] > 0, "queue never spilled despite depth 2"
+    assert c["shed"] == 0
+    assert c["completed"] == 8
+
+
+def test_block_backpressure_is_lossless():
+    """Block: the producer waits for capacity (sim time flows while it
+    waits); every job is admitted and completes."""
+    sessions = _sessions(1, _numpy_policy)
+    driver = ServeDriver(sessions, queue_depth=2, backpressure="block")
+    make_app = synthetic_app_factory(seed=5, runtime=(100.0, 200.0))
+    report = driver.run(
+        poisson_arrivals(rate=1.0, n_jobs=6, seed=2, make_app=make_app)
+    )
+    c = report["slo"]["counters"]
+    assert c["shed"] == 0 and c["spilled"] == 0
+    assert c["admitted"] == c["completed"] == 6
+    assert c["blocked_waits"] > 0, "depth 2 never blocked the producer"
+
+
+def test_closed_loop_load_generator():
+    """The closed-loop generator keeps C jobs in flight: each completion
+    injects the next job until n_jobs have been served."""
+    sessions = _sessions(2, _numpy_policy)
+    driver = ServeDriver(sessions, queue_depth=8, backpressure="shed")
+    src = closed_loop_source(
+        driver, synthetic_app_factory(seed=9), concurrency=3, n_jobs=7
+    )
+    report = driver.run(src)
+    c = report["slo"]["counters"]
+    assert c["completed"] == 7 and c["shed"] == 0
+    # Concurrency bound: in-flight depth can never exceed C.
+    assert report["slo"]["queue_depth"]["max"] <= 3
+
+
+# -- bench smoke -------------------------------------------------------------
+
+
+def test_bench_serve_stream_smoke():
+    """Tier-1 smoke of the ``serve_stream`` bench row at tiny scale: it
+    builds, serves, and reports sustained decisions/sec + p99 decision
+    latency (the CI-visible face of the bench satellite)."""
+    bench = load_root_module("bench")
+    row = bench._bench_serve_stream(
+        n_sessions=2, n_jobs=6, rate=0.5, n_hosts=8, queue_depth=8
+    )
+    assert set(row) >= {
+        "sessions", "jobs", "arrival_rate", "decisions_per_sec",
+        "p50_decision_ms", "p99_decision_ms", "batcher", "completed",
+    }
+    assert row["sessions"] == 2 and row["jobs"] == 6
+    assert row["decisions_per_sec"] > 0
+    assert row["p99_decision_ms"] >= row["p50_decision_ms"] > 0
+    assert row["batcher"]["dispatches"] > 0

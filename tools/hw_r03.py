@@ -13,7 +13,7 @@ still leaves the top items measured):
      campaign records the rollout decomposition.
   3. tick_order="lifo" device cost (the fidelity mode's two extra [T]
      sorts per tick — 1.9× on CPU; is the TPU hit comparable?).
-  4. Warm `serve` request wall (VERDICT r02 item 7 evidence: repeated
+  4. Warm `worker` request wall (VERDICT r02 item 7 evidence: repeated
      what-if queries at device-wall speed) — a resident worker child
      serves the same ensemble request twice; the second sentinel's
      wall is the warm figure.
@@ -239,7 +239,7 @@ def serve_warm(n_apps=25, replicas=256) -> dict:
     ]
     stdin = json.dumps(req) + "\n" + json.dumps(req) + "\nquit\n"
     proc = subprocess.run(
-        [sys.executable, "-m", "pivot_tpu.experiments.cli", "serve"],
+        [sys.executable, "-m", "pivot_tpu.experiments.cli", "worker"],
         input=stdin, capture_output=True, text=True, timeout=1800,
         cwd=os.path.join(os.path.dirname(__file__), ".."),
     )
