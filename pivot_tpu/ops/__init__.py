@@ -1,9 +1,17 @@
-"""Fused placement kernels (JAX) — the TPU decision backend."""
+"""Fused placement kernels (JAX) — the TPU decision backend.
+
+Two families (``ops.kernels``): the two-phase production kernels and the
+``*_kernel_ref`` scan oracles they are held bit-identical to.
+"""
 
 from pivot_tpu.ops.kernels import (  # noqa: F401
     DeviceTopology,
     best_fit_kernel,
+    best_fit_kernel_ref,
     cost_aware_kernel,
+    cost_aware_kernel_ref,
     first_fit_kernel,
+    first_fit_kernel_ref,
     opportunistic_kernel,
+    opportunistic_kernel_ref,
 )

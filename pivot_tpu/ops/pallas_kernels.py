@@ -24,8 +24,16 @@ Layout (TPU-first):
     ``[RB, Hp]`` slabs, so every fit mask / norm / argmin issue advances
     RB replicas at once — no cross-lane work except the per-replica
     min-reductions;
-  * ``[Z, H]`` round-trip cost/bw tables are precomputed outside and read
-    per task by a dynamic-sublane gather on the anchor zone.
+  * **phase-1 score tiles** (round 6): the per-task ``[T, H]`` round-trip
+    cost/bandwidth rows are materialized OUTSIDE the kernel in one fused
+    batched gather (``cost_rt[anchor_zone]`` — the two-phase kernels'
+    phase 1, ``ops/kernels.py``) and streamed through the existing
+    Mosaic pipeline as ``[chunk, Hp]`` VMEM tiles alongside the task
+    scalars.  This replaces the previous in-kernel per-step
+    dynamic-sublane gather on the anchor zone from whole-VMEM ``[Z, H]``
+    tables — the anchor-zone SMEM stream disappears and each step reads
+    its row by loop index from the prefetched tile.  The values are the
+    same gathered rows, so placements are bit-identical.
 
 One greedy body serves every form: :func:`cost_aware_pallas_batched`
 takes the whole ``[R, H, 4]`` replica ensemble (task stream shared — the
@@ -130,9 +138,8 @@ def _greedy_body_batched(
         demands_s,  # [4, chunk] f32 SMEM (shared task stream)
         valid_s,  # [1, chunk] i32 SMEM
         ng_s,  # [1, chunk] i32 SMEM
-        az_s,  # [1, chunk] i32 SMEM
-        cost_rt,  # [Zp, Hp] f32 VMEM
-        bw_rt,  # [Zp, Hp] f32 VMEM
+        cost_rows,  # [chunk, Hp] f32 VMEM (phase-1 per-task cost rows)
+        bw_rows,  # [chunk, Hp] f32 VMEM (phase-1 per-task bw rows)
         base_row,  # [1, Hp] f32 VMEM
         avail_in,  # [1, 4*RB, Hp] f32 VMEM (resource-major replica slabs)
         place_out,  # [1, RB, chunk] i32 VMEM out
@@ -153,11 +160,11 @@ def _greedy_body_batched(
 
         def step(i, _):
             valid_i = valid_s[0, i] > 0
-            az = az_s[0, i]
             d = [demands_s[r, i] for r in range(4)]
             a = [avail_out[0, r * RB : (r + 1) * RB, :] for r in range(4)]
-            cost_row = cost_rt[pl.ds(az, 1), :]  # [1, Hp] → broadcasts
-            bw_row = bw_rt[pl.ds(az, 1), :]
+            # Phase-1 tile rows by loop index (no zone gather in-kernel).
+            cost_row = cost_rows[pl.ds(i, 1), :]  # [1, Hp] → broadcasts
+            bw_row = bw_rows[pl.ds(i, 1), :]
 
             if first_fit:
 
@@ -271,13 +278,18 @@ def cost_aware_pallas_batched(
     # Per-replica VMEM bytes of the block's working set: two [4·RB, Hp]
     # avail blocks + two [RB, Hp] scratches (40·Hp) and the [RB, chunk]
     # placement block (8·chunk, both copies); budget ~12 MB of the 16 MB
-    # scoped-VMEM limit.
+    # scoped-VMEM limit.  The phase-1 score tiles are replica-independent
+    # fixed overhead: two [chunk, Hp] streamed inputs, double-buffered by
+    # the pipeline (16·chunk·Hp bytes), subtracted from the budget before
+    # the replica split.
     rb_bytes = 40 * Hp + 8 * chunk
+    tile_bytes = 16 * chunk * Hp
+    vmem_budget = max(int(12e6 - tile_bytes), rb_bytes * 8)
     if block_replicas is None:
         # VMEM budget first: cap RB so the working set stays within
         # budget at ANY host count (the fixed 512 cap is only proven at
         # Hp ≤ 512).
-        vmem_cap = int(12e6 // rb_bytes)
+        vmem_cap = vmem_budget // rb_bytes
         rb_max = max(8, min(_MAX_BLOCK_REPLICAS, vmem_cap // 8 * 8))
         # Then fewest blocks, sized to split R evenly: picking the max
         # block outright would round R up to a multiple of it (e.g.
@@ -301,13 +313,14 @@ def cost_aware_pallas_batched(
         # One sublane tile (RB ≤ 8) is exempt, exactly like the auto
         # path's max(8, ...) floor: there is no smaller block to fall
         # back to, so the budget is best-effort at extreme host counts.
-        if block_replicas > 8 and block_replicas * rb_bytes > 12e6:
+        if block_replicas > 8 and block_replicas * rb_bytes > vmem_budget:
             raise ValueError(
                 f"block_replicas={block_replicas} needs "
                 f"~{block_replicas * rb_bytes / 1e6:.1f} MB of scoped VMEM at "
-                f"Hp={Hp} (budget 12 MB of the 16 MB limit) and would fail "
-                "Mosaic compilation; pass block_replicas=None for the "
-                "largest known-good block"
+                f"Hp={Hp} (budget {vmem_budget / 1e6:.1f} MB of the 16 MB "
+                "limit after the phase-1 score tiles) and would fail Mosaic "
+                "compilation; pass block_replicas=None for the largest "
+                "known-good block"
             )
     RB = block_replicas
     Tp = _round_up(T, chunk)
@@ -330,15 +343,20 @@ def cost_aware_pallas_batched(
     dem = pad_t(demands, 0.0, f32)
     val = pad_t(valid, 0, jnp.int32)
     ng = pad_t(new_group, 0, jnp.int32)
-    az = pad_t(anchor_zone, 0, jnp.int32)
 
+    # Phase 1 (shared with ops/kernels.py): [Z, H] round-trip tables, then
+    # ONE fused batched gather to per-task [T, H] score rows — hoisted out
+    # of the greedy pass entirely and streamed as tiles.
     hz = host_zone.astype(jnp.int32)
     cost_rt = (cost_zz[:, hz] + cost_zz[hz, :].T).astype(f32)
     bw_rt = (bw_zz[:, hz] + bw_zz[hz, :].T).astype(f32)
-    Z = cost_rt.shape[0]
-    Zp = _round_up(Z, 8)
-    cost_rt = jnp.pad(cost_rt, ((0, Zp - Z), (0, Hp - H)))
-    bw_rt = jnp.pad(bw_rt, ((0, Zp - Z), (0, Hp - H)), constant_values=1.0)
+    az = anchor_zone.astype(jnp.int32)
+    cost_rows = jnp.pad(
+        cost_rt[az], ((0, Tp - T), (0, Hp - H))
+    )  # [Tp, Hp]; pad tasks are invalid, pad hosts unselectable
+    bw_rows = jnp.pad(
+        bw_rt[az], ((0, Tp - T), (0, Hp - H)), constant_values=1.0
+    )
     base_row = jnp.pad(
         base_task_counts.astype(f32).reshape(1, H), ((0, 0), (0, Hp - H))
     )
@@ -364,9 +382,14 @@ def cost_aware_pallas_batched(
             smem_chunk(4),  # demands
             smem_chunk(1),  # valid
             smem_chunk(1),  # new_group
-            smem_chunk(1),  # anchor zone
-            whole((Zp, Hp)),  # cost_rt
-            whole((Zp, Hp)),  # bw_rt
+            pl.BlockSpec(  # phase-1 cost-row tiles, streamed by chunk
+                (chunk, Hp), lambda rb, tc: (tc, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(  # phase-1 bw-row tiles
+                (chunk, Hp), lambda rb, tc: (tc, 0),
+                memory_space=pltpu.VMEM,
+            ),
             whole((1, Hp)),  # base counts
             pl.BlockSpec(
                 (1, 4 * RB, Hp), lambda rb, tc: (rb, 0, 0),
@@ -392,7 +415,7 @@ def cost_aware_pallas_batched(
             pltpu.VMEM((RB, Hp), f32),  # best-fit live counters
         ],
         interpret=interpret,
-    )(dem, val, ng, az, cost_rt, bw_rt, base_row, a)
+    )(dem, val, ng, cost_rows, bw_rows, base_row, a)
 
     placements = placements.reshape(Rp, Tp)[:R, :T]
     avail_out = jnp.transpose(

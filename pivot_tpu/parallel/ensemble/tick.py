@@ -645,6 +645,22 @@ def _rollout_segment(
             GD.T,
         ).T  # [G, Z] max over predecessor groups, zone column at a time
 
+        # Round-6 two-phase audit (ops/kernels.py restructure): this loop
+        # was swept for the same phase-1 hoisting.  The score-row
+        # selections (cost_rt / score_bw_rt by anchor zone) are NOT
+        # hoistable here — a per-task [R, T, H] materialization is out of
+        # memory at calibrate scale, and folding cost/bw into one ratio
+        # table changes operand association (breaks DES wave parity).
+        # Per-step conditional skips also buy nothing: this loop is always
+        # vmapped over replicas, where lax.cond lowers to a select that
+        # evaluates both branches.  The one loop-invariant found in-loop
+        # was the opportunistic arm's Weyl rotation, hoisted below.
+        if policy == "opportunistic" and task_u is not None:
+            tick_idx = (t / tick).astype(jnp.int32)
+            weyl_rot = tick_idx.astype(u_p.dtype) * 0.6180339887498949
+        else:
+            weyl_rot = None
+
         def place_cond(c):
             j, _avail, _pl, _dl, _ns, _bf = c
             return j < n_ready
@@ -727,12 +743,10 @@ def _rollout_segment(
                 # uniform (the DES redraws per tick, policies.py:105; a
                 # retrying task must not deterministically re-target the
                 # same rank every tick).  Keyed on absolute time, so
-                # checkpoint segmentation cannot shift the sequence.
-                tick_idx = (t / tick).astype(jnp.int32)
-                u_eff = jnp.mod(
-                    u_p[j] + tick_idx.astype(u_p.dtype) * 0.6180339887498949,
-                    1.0,
-                )
+                # checkpoint segmentation cannot shift the sequence.  The
+                # rotation is a per-tick constant, hoisted out of the loop
+                # (same operands, same association — bit-exact).
+                u_eff = jnp.mod(u_p[j] + weyl_rot, 1.0)
                 n_fit = jnp.sum(fit)
                 k = jnp.minimum((u_eff * n_fit).astype(jnp.int32), n_fit - 1)
                 rank = jnp.cumsum(fit) - 1  # rank among fitting hosts

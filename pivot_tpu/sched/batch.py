@@ -23,6 +23,17 @@ grouped — and ``vmap`` of the placement kernels evaluates each row with
 the same op sequence as the unbatched program.  Batch *composition* may
 vary run-to-run with thread timing; results cannot.
 
+The round-6 two-phase kernels (``ops/kernels.py``) keep this contract in
+every phase-2 mode: their ``lax.while_loop`` passes stop at each row's
+own last valid task, and under ``vmap`` rows that finish early go inert
+(out-of-range writes drop, fit masks force no-ops) while longer rows
+keep stepping — asserted by ``tests/test_two_phase.py::
+test_two_phase_vmap_mixed_valid_lengths`` with rows of different task
+counts sharing one dispatch, exactly the mixed-T batches this module
+coalesces.  The ``totals`` pre-filter operand rides as a normal stacked
+array column; the static ``phase2`` selector rides in ``static_kw`` like
+every other kernel config flag.
+
 Compilation discipline: the group axis pads to a bucket
 (:func:`group_bucket`, the G-analog of ``sched.tpu.pad_bucket``), so XLA
 compiles one program per (G-bucket, T-bucket, H) triple, never per group

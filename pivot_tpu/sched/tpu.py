@@ -20,8 +20,15 @@ over this image's tunnel, median 78.5 ms, re-measured on the live chip in
 round 2: ``figures/tpu_validate_r02.json``) that dwarfs small ticks, while the
 in-process numpy twin costs ~50 ns per task×host cell.  The wrapper keeps
 an online affine latency model of both sides — twin: cells × per-cell
-cost; device: probed link floor + cells × per-cell cost (the scan kernels
-are sequential over tasks, so device time grows with the batch too).
+cost; device: probed link floor + cells × per-cell cost (the placement
+kernels stay sequential over tasks, so device time grows with the batch
+too).  Round-6 re-fit for the two-phase kernels: on the CPU backend the
+slim phase-2 pass stops at the last VALID task instead of walking the
+padded bucket, so the model's device cell count uses the true T there
+(bucket-based cells would overcharge a T=600 tick in the 2048 bucket
+~3.4×, exactly the early-exit the rewrite bought); non-CPU backends keep
+the bucket-padded count (``phase2="auto"`` resolves to the scan form
+there — see ``ops/kernels.py``).
 Per-cell terms are EMAs of observed calls at meaningful sizes; the floor
 is probe-only (folding full call times into it would starve the device
 path permanently).  Each tick routes to whichever side the model predicts
@@ -153,10 +160,16 @@ class _DevicePolicyBase(Policy):
     #: this bounds each exploration sample to ~margin × floor seconds.
     _EXPLORE_MARGIN = 8.0
 
-    def __init__(self, adaptive: bool = False):
+    def __init__(self, adaptive: bool = False, phase2="auto"):
         self.topology: Optional[DeviceTopology] = None
         self._scheduler = None
         self.adaptive = adaptive
+        #: Phase-2 mode forwarded to the two-phase kernels
+        #: (``ops/kernels.py``): "auto" (slim on CPU, scan elsewhere),
+        #: "scan", "slim", or an int chunk size for speculative chunk
+        #: commit — the latency-floor-bound shape, where the phase-1
+        #: ``totals`` pre-filter steers the fill speculation.
+        self.phase2 = phase2
         # Cross-run dispatch coalescing (sched.batch): when a BatchClient
         # is attached, every device-kernel call routes through it so G
         # concurrently-stepped runs share one vmapped dispatch per tick.
@@ -241,12 +254,20 @@ class _DevicePolicyBase(Policy):
     # -- adaptive dispatch ------------------------------------------------
     def place(self, ctx: TickContext) -> np.ndarray:
         if self.adaptive and self._cpu_twin is not None:
+            import jax
+
             cells = ctx.n_tasks * ctx.n_hosts
             bucket = pad_bucket(ctx.n_tasks)
-            # The twin loops over the true T; the kernels scan the PADDED
-            # bucket, so the two sides' cell counts differ — mixing them
-            # would put predictions and EMA samples in inconsistent units.
-            dev_cells = bucket * ctx.n_hosts
+            # The twin loops over the true T; the scan-form kernels walk
+            # the PADDED bucket, so the two sides' cell counts differ —
+            # mixing them would put predictions and EMA samples in
+            # inconsistent units.  The CPU slim pass (phase2="auto")
+            # early-exits at the last valid task, so its work scales with
+            # the true T (the round-6 model re-fit).
+            if jax.default_backend() == "cpu":
+                dev_cells = cells
+            else:
+                dev_cells = bucket * ctx.n_hosts
             pred_twin = cells * self._cpu_cell_cost
             pred_device = self._device_floor + dev_cells * self._device_cell_cost
             twin_predicted = pred_twin <= self._DEVICE_ADVANTAGE * pred_device
@@ -370,8 +391,8 @@ class _DevicePolicyBase(Policy):
 class TpuOpportunisticPolicy(_DevicePolicyBase):
     name = "opportunistic_tpu"
 
-    def __init__(self, adaptive: bool = False):
-        super().__init__(adaptive)
+    def __init__(self, adaptive: bool = False, phase2="auto"):
+        super().__init__(adaptive, phase2)
         self._cpu_twin = OpportunisticPolicy(mode="numpy")
 
     def _device_place(self, ctx: TickContext) -> np.ndarray:
@@ -382,6 +403,7 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
         placements, _ = self._call_kernel(
             opportunistic_kernel, avail, dem, valid,
             self._stage(u, self.dtype),
+            phase2=self.phase2,
         )
         return self._unpad(placements, T)
 
@@ -389,8 +411,9 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
 class TpuFirstFitPolicy(_DevicePolicyBase):
     name = "first_fit_tpu"
 
-    def __init__(self, decreasing: bool = False, adaptive: bool = False):
-        super().__init__(adaptive)
+    def __init__(self, decreasing: bool = False, adaptive: bool = False,
+                 phase2="auto"):
+        super().__init__(adaptive, phase2)
         self.decreasing = decreasing
         self._cpu_twin = FirstFitPolicy(decreasing=decreasing, mode="numpy")
 
@@ -402,7 +425,9 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:17)
         avail, dem, valid = self._padded(ctx, order)
         placements, _ = self._call_kernel(
-            first_fit_kernel, avail, dem, valid, strict=False
+            first_fit_kernel, avail, dem, valid, strict=False,
+            totals=self._staged_topology().totals,
+            phase2=self.phase2,
         )
         return self._unpad(placements, T, order)
 
@@ -431,8 +456,9 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
 class TpuBestFitPolicy(_DevicePolicyBase):
     name = "best_fit_tpu"
 
-    def __init__(self, decreasing: bool = False, adaptive: bool = False):
-        super().__init__(adaptive)
+    def __init__(self, decreasing: bool = False, adaptive: bool = False,
+                 phase2="auto"):
+        super().__init__(adaptive, phase2)
         self.decreasing = decreasing
         self._cpu_twin = BestFitPolicy(decreasing=decreasing, mode="numpy")
 
@@ -443,7 +469,11 @@ class TpuBestFitPolicy(_DevicePolicyBase):
             order = _sort_decreasing(ctx.demands, list(range(T)))
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:42)
         avail, dem, valid = self._padded(ctx, order)
-        placements, _ = self._call_kernel(best_fit_kernel, avail, dem, valid)
+        placements, _ = self._call_kernel(
+            best_fit_kernel, avail, dem, valid,
+            totals=self._staged_topology().totals,
+            phase2=self.phase2,
+        )
         return self._unpad(placements, T, order)
 
     def placement_sensitivity(self, ctx: TickContext, n_replicas: int = 256,
@@ -486,8 +516,9 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         realtime_bw: bool = False,
         use_pallas: Optional[bool] = None,
         adaptive: bool = False,
+        phase2="auto",
     ):
-        super().__init__(adaptive)
+        super().__init__(adaptive, phase2)
         assert bin_pack in ("first-fit", "best-fit")
         if realtime_bw and use_pallas:
             raise ValueError(
@@ -690,6 +721,13 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             kw["rt_bw_idx"] = self._stage(idx)
         kernel = cost_aware_pallas if use_pallas else cost_aware_kernel
         topo = self._staged_topology()
+        if not use_pallas:
+            # Phase-1 demand-vs-total pre-filter (two-phase kernels only —
+            # the Pallas kernel has no totals input).  Speculation-only:
+            # it steers the chunked form's fill model and can never
+            # change a placement (ops/kernels.py).
+            kw["totals"] = topo.totals
+            kw["phase2"] = self.phase2
         placements, _ = self._call_kernel(
             kernel,
             avail,

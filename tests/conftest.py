@@ -157,12 +157,42 @@ _SLOW_TESTS = {
         "test_hw_r03_smoke",
         "test_crossover_interpret_smoke",
     ],
+    "test_two_phase.py": [
+        # Quick twins in tier 1: test_two_phase_parity_small,
+        # test_two_phase_parity_contended_small.
+        "test_two_phase_parity_sweep_full",
+        "test_two_phase_parity_contended_full",
+    ],
     "test_trace.py": ["test_device_profile_captures"],
     "test_watcher.py": [
         "test_run_item_status_routing",
         "test_fire_campaign_banks_partial_then_accepts",
     ],
 }
+
+
+
+# --- tier-1 per-test runtime guard (round 6) -------------------------------
+#
+# ``tests/test_meta.py::test_tier1_per_test_budget`` reads these via the
+# ``tier1_durations`` fixture and fails the suite if any non-slow test
+# exceeded its wall budget — the structural stop to tier-1 time creeping
+# PR over PR.  Durations come from pytest's own runtest reports; the
+# guard item is moved to the end of the collection so it sees everyone.
+
+TEST_DURATIONS: dict = {}  # nodeid -> seconds (call phase)
+SLOW_NODEIDS: set = set()
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        TEST_DURATIONS[report.nodeid] = report.duration
+
+
+@pytest.fixture(scope="session")
+def tier1_durations():
+    """(durations, slow nodeids) — the runtime-guard data feed."""
+    return TEST_DURATIONS, SLOW_NODEIDS
 
 
 def pytest_collection_modifyitems(config, items):
@@ -173,7 +203,15 @@ def pytest_collection_modifyitems(config, items):
         base = item.name.split("[")[0]
         is_slow = any(base.startswith(s) for s in slow_names)
         item.add_marker(pytest.mark.slow if is_slow else pytest.mark.quick)
+        if is_slow:
+            SLOW_NODEIDS.add(item.nodeid)
         modules_seen.setdefault(fname, []).append(is_slow)
+    # The runtime-guard test must run last (stable sort; every other
+    # item keeps its collection order — the tier-1 command pins plugin
+    # order with -p no:randomly / no:xdist).
+    items.sort(
+        key=lambda it: it.name.startswith("test_tier1_per_test_budget")
+    )
     # Tier invariant: a quick run must touch every module.  Checked only
     # on full-suite collections — a node-id / -k / --lf selection
     # legitimately sees a partial, possibly all-slow subset.

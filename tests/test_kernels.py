@@ -146,6 +146,22 @@ def test_cost_aware_parity(meta, seed, kwargs):
     assert p_cpu.tolist() == p_dev.tolist()
 
 
+@pytest.mark.parametrize("phase2", ["scan", "slim", 8])
+def test_cost_aware_parity_phase2_modes(meta, phase2):
+    """The policy-level ``phase2`` plumbing (round 6): every phase-2 mode
+    — including speculative chunk commit, the mode that consumes the
+    ``totals`` pre-filter the wrappers stage — reproduces the numpy
+    twin's placements through the full policy path."""
+    p_cpu, p_dev, *_ = pair_place(
+        meta,
+        CostAwarePolicy(mode="numpy", sort_tasks=True, sort_hosts=True),
+        TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True, phase2=phase2),
+        random_groups(1),
+        seed=1,
+    )
+    assert p_cpu.tolist() == p_dev.tolist()
+
+
 def test_cost_aware_parity_with_placed_predecessors(meta):
     """Parity must also hold when anchors come from majority votes."""
     groups = [

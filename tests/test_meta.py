@@ -1,0 +1,46 @@
+"""Suite meta-checks: the tier-1 per-test runtime budget (round 6).
+
+Tier-1 (``pytest -m 'not slow'``) is the pre-merge gate; its total wall
+has crept PR over PR because nothing structural stops an individual test
+from quietly growing.  The guard here reads pytest's own duration
+reports (collected by ``conftest.pytest_runtest_logreport``; the guard
+item is sorted to the END of the collection by
+``conftest.pytest_collection_modifyitems`` so it observes every test
+that ran before it) and fails if any test NOT marked slow exceeded the
+per-test wall budget — the fix is to slow-mark the offender (with a
+quick twin, per the tier invariant) or make it faster, not to raise the
+budget.
+"""
+
+import pytest
+
+#: Per-test wall budget for tier-1 tests, seconds.  Set ~2.5× the
+#: slowest legitimate quick test observed at round 6 (the forms-parity
+#: smokes, ~8–10 s on the reference container) so machine variance
+#: doesn't flake it, while a test doubling its wall still trips.
+TIER1_BUDGET_S = 25.0
+
+#: Only enforce on runs that exercised a meaningful slice of the suite —
+#: a single-file or -k selection legitimately carries different timing
+#: (cold caches, first-import costs concentrated on few tests).
+MIN_TESTS_FOR_ENFORCEMENT = 50
+
+
+def test_tier1_per_test_budget(tier1_durations):
+    durations, slow_nodeids = tier1_durations
+    if len(durations) < MIN_TESTS_FOR_ENFORCEMENT:
+        pytest.skip(
+            f"only {len(durations)} tests ran before the guard; budget "
+            f"enforcement needs >= {MIN_TESTS_FOR_ENFORCEMENT} (full-suite "
+            "selections)"
+        )
+    offenders = {
+        nodeid: round(secs, 1)
+        for nodeid, secs in durations.items()
+        if secs > TIER1_BUDGET_S and nodeid not in slow_nodeids
+    }
+    assert not offenders, (
+        f"tier-1 tests over the {TIER1_BUDGET_S:.0f}s per-test budget — "
+        f"slow-mark them (keeping a quick twin) or speed them up: "
+        f"{offenders}"
+    )

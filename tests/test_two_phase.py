@@ -1,0 +1,306 @@
+"""Two-phase kernel parity: every phase-2 mode vs the scan oracles.
+
+The round-6 restructure (``ops/kernels.py``) keeps the old scan kernels as
+in-tree oracles (``*_kernel_ref``) and promises the two-phase forms —
+slim sequential pass and speculative chunk commit at every chunk size —
+produce **bit-identical placements AND availability** on CPU x64.  This
+suite sweeps policies × phase-2 modes × shapes, including adversarial
+high-contention workloads where every task fits exactly one host (the
+worst case for speculation: every chunk conflicts immediately), and a
+vmapped mixed-valid batch (the cross-run batcher contract, where rows
+finish their task prefixes at different lengths).
+
+Tier split: the full T-bucket × H ∈ {small, 600, 1024} sweep is
+slow-marked; a tiny twin of every axis stays in tier 1.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pivot_tpu.ops.kernels import (
+    best_fit_kernel,
+    best_fit_kernel_ref,
+    cost_aware_kernel,
+    cost_aware_kernel_ref,
+    first_fit_kernel,
+    first_fit_kernel_ref,
+    opportunistic_kernel,
+    opportunistic_kernel_ref,
+)
+
+Z = 7
+
+
+def make_inputs(seed, T, H, B, group_size=4):
+    """Random grouped tick batch (task axis padded to B)."""
+    rng = np.random.default_rng(seed)
+    avail = rng.uniform(0, 16, size=(H, 4))
+    dem = np.zeros((B, 4))
+    g = np.arange(max(T, 1)) // max(group_size, 1)
+    n_g = g.max() + 1
+    dem[:T, 0] = rng.choice([0.5, 1.0, 2.0, 4.0], size=n_g)[g[:T]]
+    dem[:T, 1] = rng.uniform(0, 8, size=n_g)[g[:T]]
+    valid = np.zeros(B, bool)
+    valid[:T] = True
+    ng = np.zeros(B, bool)
+    ng[:T] = np.r_[True, g[1:T] != g[: T - 1]] if T else []
+    az = np.zeros(B, np.int32)
+    az[:T] = rng.integers(0, Z, size=n_g)[g[:T]]
+    u = np.zeros(B)
+    u[:T] = rng.random(T)
+    cost = rng.uniform(0, 0.11, size=(Z, Z))
+    np.fill_diagonal(cost, 0)
+    bw = rng.uniform(50, 15000, size=(Z, Z))
+    hz = rng.integers(0, Z, size=H).astype(np.int32)
+    counts = rng.integers(0, 5, size=H).astype(np.int32)
+    totals = avail * rng.uniform(1.0, 1.3, size=(H, 1))
+    return {
+        "avail": jnp.asarray(avail),
+        "dem": jnp.asarray(dem),
+        "valid": jnp.asarray(valid),
+        "ng": jnp.asarray(ng),
+        "az": jnp.asarray(az),
+        "u": jnp.asarray(u),
+        "cost": jnp.asarray(cost),
+        "bw": jnp.asarray(bw),
+        "hz": jnp.asarray(hz),
+        "counts": jnp.asarray(counts),
+        "totals": jnp.asarray(totals),
+    }
+
+
+def contended_inputs(T, H):
+    """Adversarial high-contention batch: task t targets exactly host
+    (t // 2) % H — two dimensions pin the fit window to one host — and
+    each host only has room for ONE of its two suitors, so speculation
+    conflicts on every second task."""
+    B = T
+    avail = np.zeros((H, 4))
+    avail[:, 0] = np.arange(H) + 1.0
+    avail[:, 1] = H - np.arange(H)
+    avail[:, 2:] = 8.0
+    dem = np.zeros((B, 4))
+    k = (np.arange(T) // 2) % H
+    dem[:, 0] = k + 0.5
+    dem[:, 1] = H - k - 0.5
+    valid = np.ones(B, bool)
+    ng = np.zeros(B, bool)
+    ng[::3] = True
+    ng[0] = True
+    az = (k % Z).astype(np.int32)
+    rng = np.random.default_rng(0)
+    u = rng.random(B)
+    cost = rng.uniform(0, 0.11, size=(Z, Z))
+    bw = rng.uniform(50, 15000, size=(Z, Z))
+    hz = (np.arange(H) % Z).astype(np.int32)
+    counts = np.zeros(H, np.int32)
+    totals = avail * 1.0
+    return {
+        k2: jnp.asarray(v)
+        for k2, v in dict(
+            avail=avail, dem=dem, valid=valid, ng=ng, az=az, u=u,
+            cost=cost, bw=bw, hz=hz, counts=counts, totals=totals,
+        ).items()
+    }
+
+
+CA_MODES = [
+    dict(bin_pack="first-fit", sort_hosts=True, host_decay=False),
+    dict(bin_pack="first-fit", sort_hosts=True, host_decay=True),
+    dict(bin_pack="first-fit", sort_hosts=False, host_decay=False),
+    dict(bin_pack="best-fit", sort_hosts=True, host_decay=False),
+    dict(bin_pack="best-fit", sort_hosts=True, host_decay=True),
+]
+#: Tier-1 subset — one per bin-pack arm; every XLA program in this file
+#: is a fresh compile on a cold cache, so the quick tier trades flag
+#: coverage for wall (the slow sweep runs the full grid).
+CA_QUICK = [CA_MODES[0], CA_MODES[3]]
+
+
+def assert_all_modes(x, phase2_modes, ca_modes=CA_MODES, totals_opts=(None, "t")):
+    """Every kernel × phase-2 mode × totals option vs its scan oracle."""
+    ca_args = (x["avail"], x["dem"], x["valid"], x["ng"], x["az"], x["cost"],
+               x["bw"], x["hz"], x["counts"])
+    for phase2 in phase2_modes:
+        for tot in totals_opts:
+            totals = x["totals"] if tot else None
+            pairs = [
+                (
+                    opportunistic_kernel_ref(
+                        x["avail"], x["dem"], x["valid"], x["u"]
+                    ),
+                    # No totals input: the random choice has no fill
+                    # model for the pre-filter to steer.
+                    opportunistic_kernel(
+                        x["avail"], x["dem"], x["valid"], x["u"],
+                        phase2=phase2,
+                    ),
+                    "opportunistic",
+                ),
+                (
+                    first_fit_kernel_ref(x["avail"], x["dem"], x["valid"]),
+                    first_fit_kernel(
+                        x["avail"], x["dem"], x["valid"],
+                        totals=totals, phase2=phase2,
+                    ),
+                    "first_fit",
+                ),
+                (
+                    best_fit_kernel_ref(x["avail"], x["dem"], x["valid"]),
+                    best_fit_kernel(
+                        x["avail"], x["dem"], x["valid"],
+                        totals=totals, phase2=phase2,
+                    ),
+                    "best_fit",
+                ),
+            ]
+            for mode in ca_modes:
+                pairs.append(
+                    (
+                        cost_aware_kernel_ref(*ca_args, **mode),
+                        cost_aware_kernel(
+                            *ca_args, **mode, totals=totals, phase2=phase2
+                        ),
+                        f"cost_aware:{mode}",
+                    )
+                )
+            for (p_ref, a_ref), (p_new, a_new), name in pairs:
+                assert np.array_equal(np.asarray(p_ref), np.asarray(p_new)), (
+                    name, phase2, tot,
+                    np.asarray(p_ref)[:16].tolist(),
+                    np.asarray(p_new)[:16].tolist(),
+                )
+                assert np.array_equal(np.asarray(a_ref), np.asarray(a_new)), (
+                    name, phase2, tot,
+                )
+
+
+def test_two_phase_parity_small():
+    """Tier-1 twin of the full sweep: tiny shapes, every policy, one
+    chunked and the slim mode.  Kept deliberately narrow — each
+    (kernel, shape, mode) cell is a separate XLA program and tier-1
+    wall is budgeted (test_meta.py); the slow sweep carries the full
+    seed × chunk-size × totals grid."""
+    for seed, (T, H, B, gs), modes in [
+        (0, (5, 4, 8, 2), ("slim", 4)),
+        (1, (28, 12, 32, 5), ("slim",)),
+    ]:
+        x = make_inputs(seed, T, H, B, group_size=gs)
+        assert_all_modes(x, modes, ca_modes=CA_QUICK, totals_opts=("t",))
+
+
+def test_two_phase_parity_contended_small():
+    """Tier-1 twin of the adversarial sweep: every task fits exactly one
+    host and every host can serve only one of its two suitors."""
+    x = contended_inputs(24, 8)
+    assert_all_modes(x, ("slim", 4), ca_modes=CA_QUICK, totals_opts=("t",))
+
+
+def test_two_phase_realtime_bw_rows():
+    """The realtime-bandwidth row override flows through phase 1."""
+    x = make_inputs(3, 28, 12, 32, group_size=5)
+    rng = np.random.default_rng(9)
+    G = 4
+    rows = jnp.asarray(rng.uniform(50, 15000, size=(G, 12)))
+    ridx = jnp.asarray((np.arange(32) % G).astype(np.int32))
+    args = (x["avail"], x["dem"], x["valid"], x["ng"], x["az"], x["cost"],
+            x["bw"], x["hz"], x["counts"])
+    for mode in (
+        dict(bin_pack="first-fit", sort_hosts=True),
+        dict(bin_pack="best-fit", sort_hosts=True),
+    ):
+        p_ref, a_ref = cost_aware_kernel_ref(
+            *args, **mode, rt_bw_rows=rows, rt_bw_idx=ridx
+        )
+        for phase2 in ("slim", 4):
+            p_new, a_new = cost_aware_kernel(
+                *args, **mode, rt_bw_rows=rows, rt_bw_idx=ridx, phase2=phase2
+            )
+            assert np.array_equal(np.asarray(p_ref), np.asarray(p_new))
+            assert np.array_equal(np.asarray(a_ref), np.asarray(a_new))
+
+
+def test_two_phase_empty_and_all_invalid():
+    x = make_inputs(0, 0, 6, 8, group_size=2)  # all rows padding
+    assert not bool(np.any(np.asarray(x["valid"])))
+    assert_all_modes(x, ("slim", 4), ca_modes=CA_QUICK, totals_opts=(None,))
+    # Fully empty task axis.
+    x0 = make_inputs(0, 0, 6, 0)
+    p, a = cost_aware_kernel(
+        x0["avail"], x0["dem"], x0["valid"], x0["ng"], x0["az"], x0["cost"],
+        x0["bw"], x0["hz"], x0["counts"], phase2="slim",
+    )
+    assert p.shape == (0,)
+    assert np.array_equal(np.asarray(a), np.asarray(x0["avail"]))
+
+
+def test_two_phase_interspersed_invalid():
+    """Invalid rows in the middle of the batch are -1 no-ops, exactly as
+    the scan treats them."""
+    x = make_inputs(5, 28, 12, 32, group_size=5)
+    valid = np.asarray(x["valid"]).copy()
+    valid[3] = valid[11] = valid[17] = False
+    x["valid"] = jnp.asarray(valid)
+    assert_all_modes(x, ("slim",), ca_modes=CA_QUICK, totals_opts=("t",))
+
+
+def test_two_phase_vmap_mixed_valid_lengths():
+    """The batcher contract: rows of a vmapped dispatch carry different
+    valid prefixes; every row must equal its own unbatched call (rows
+    that finish early must go inert, not re-place their last task)."""
+    xs = [make_inputs(s, T, 12, 32, group_size=5)
+          for s, T in ((0, 7), (1, 32), (2, 19))]
+    stack = lambda k: jnp.stack([x[k] for x in xs])
+    shared = xs[0]
+    for phase2 in ("slim", 4):
+        batched = jax.vmap(
+            lambda a, d, v, n, z: cost_aware_kernel(
+                a, d, v, n, z, shared["cost"], shared["bw"], shared["hz"],
+                shared["counts"], phase2=phase2,
+            )[0]
+        )(stack("avail"), stack("dem"), stack("valid"), stack("ng"),
+          stack("az"))
+        for r, x in enumerate(xs):
+            solo, _ = cost_aware_kernel(
+                x["avail"], x["dem"], x["valid"], x["ng"], x["az"],
+                shared["cost"], shared["bw"], shared["hz"], shared["counts"],
+                phase2=phase2,
+            )
+            assert np.array_equal(np.asarray(batched[r]), np.asarray(solo)), (
+                phase2, r,
+            )
+
+
+def test_phase2_validation():
+    x = make_inputs(0, 5, 4, 8)
+    with pytest.raises(ValueError, match="phase2"):
+        first_fit_kernel(x["avail"], x["dem"], x["valid"], phase2=0)
+    with pytest.raises(ValueError, match="phase2"):
+        first_fit_kernel(x["avail"], x["dem"], x["valid"], phase2="bogus")
+
+
+def test_two_phase_parity_sweep_full():
+    """Slow full sweep: T-buckets × H ∈ {small, 600, 1024} × all four
+    policies × {slim, chunked C ∈ 1, 8, 64} vs the scan oracles,
+    bit-identical placements AND availability (ISSUE-3 acceptance)."""
+    for seed, (T, H, B, gs) in enumerate(
+        [(60, 16, 64, 7), (300, 600, 512, 16), (600, 1024, 2048, 24)]
+    ):
+        x = make_inputs(seed, T, H, B, group_size=gs)
+        # Restrict the cost-aware flag grid at the big shapes to bound
+        # compile count; the small-shape twin covers the full grid.
+        ca = CA_MODES if H <= 16 else CA_MODES[:1] + CA_MODES[3:4]
+        assert_all_modes(x, ("slim", 1, 8, 64), ca_modes=ca,
+                         totals_opts=("t",))
+
+
+def test_two_phase_parity_contended_full():
+    """Slow adversarial sweep at material scale: single-fit tasks with
+    one-slot hosts — speculation conflicts every other task and the
+    commit degrades to the exact sequential replay."""
+    x = contended_inputs(256, 64)
+    assert_all_modes(x, ("slim", 8, 64), ca_modes=CA_MODES[:1] + CA_MODES[3:4],
+                     totals_opts=("t",))
