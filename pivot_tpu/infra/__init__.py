@@ -195,6 +195,16 @@ class Host(Node):
         #: Liveness flag — flipped by fault injection (``infra.faults``).
         #: A down host admits nothing and reports zero availability.
         self.up = True
+        #: Spot-preemption drain flag (``infra.faults.preempt_host``):
+        #: a draining host still runs its residents and still ADMITS (the
+        #: machine is alive), but the scheduler's live mask excludes it
+        #: from NEW placements so work drains ahead of the abort.
+        self.draining = False
+        #: Straggler multiplier (``infra.faults.slow_host``): compute
+        #: started while > 1 takes ``runtime × slowdown`` sim-seconds.
+        #: Exactly 1.0 when healthy — ``x * 1.0 == x`` bitwise, so the
+        #: no-straggler trajectory is unchanged.
+        self.slowdown = 1.0
         # task -> abort Event raced against its compute/staging waits.
         self._aborts: Dict[Task, Event] = {}
 
@@ -258,8 +268,8 @@ class Host(Node):
             if meter:
                 self._record_transfer(task, preds, routes, pull_start)
 
-        # Timed compute.
-        fired = yield env.any_of([env.timeout(task.runtime), abort])
+        # Timed compute (stretched while the host straggles).
+        fired = yield env.any_of([env.timeout(task.runtime * self.slowdown), abort])
         if fired is abort:
             return self._conclude_aborted(task)
 
@@ -315,10 +325,13 @@ class Host(Node):
                 abort.succeed()
 
     def recover(self) -> None:
-        """Bring the host back as a fresh machine: full capacity, no tasks."""
+        """Bring the host back as a fresh machine: full capacity, no
+        tasks, no drain flag, no straggler slowdown."""
         if self.up:
             return
         self.up = True
+        self.draining = False
+        self.slowdown = 1.0
         self.resource.reset()
         self._tasks.clear()
         self._aborts.clear()
@@ -443,6 +456,11 @@ class Cluster(LogMixin):
         self._storage: Dict[str, Storage] = {}
         self._storage_by_locality: Dict[Locality, Storage] = {}
         self._routes: Dict[Tuple[str, str], Route] = {}
+        # Called with each newly materialized route.  Routes are lazy, so
+        # state that must cover the whole fabric (an active network
+        # partition, ``infra.faults``) registers here to catch links that
+        # materialize while it is in force.
+        self._route_hooks: List = []
         for h in hosts:
             self.add_host(h)
         for s in storage:
@@ -511,7 +529,14 @@ class Cluster(LogMixin):
             else:
                 route = Route(self.env, src, dst, bw, meter=metered)
             self._routes[key] = route
+            for hook in self._route_hooks:
+                hook(route)
         return route
+
+    def add_route_hook(self, hook) -> None:
+        """Register ``hook(route)`` to run on every future lazy route
+        materialization (existing routes are the caller's to walk)."""
+        self._route_hooks.append(hook)
 
     # -- lifecycle -------------------------------------------------------
     def clone(

@@ -23,13 +23,28 @@ a kernel step observer (``start_periodic_audit``) to fail fast at the
 first corrupted state — the DES analog of running under a sanitizer.
 The observer never schedules events, so it cannot advance sim time or
 change any metric.
+
+Round 7 adds the **conservation and billing audits** the chaos soak is
+refereed by: :func:`audit_conservation` (every admitted task terminates
+exactly once — completed, dead-lettered, or cancelled with its failed
+app — and no placement ever landed on a down or quarantined host) and
+:func:`audit_meter` (busy-interval/billing well-formedness under any
+fault schedule), combined by :func:`audit_run`.
 """
 
 from __future__ import annotations
 
 from typing import List
 
-__all__ = ["AuditError", "audit_cluster", "check", "start_periodic_audit"]
+__all__ = [
+    "AuditError",
+    "audit_cluster",
+    "audit_conservation",
+    "audit_meter",
+    "audit_run",
+    "check",
+    "start_periodic_audit",
+]
 
 #: Relative tolerance for float accounting (fractional trace demands
 #: accumulate rounding on acquire/release).
@@ -142,3 +157,145 @@ def start_periodic_audit(cluster, period: float = 5.0) -> None:
         check(cluster, f"t={env.now:.3f}")
 
     env.add_step_observer(_observe)
+
+
+# ---------------------------------------------------------------------------
+# Conservation + billing audits (round 7 — the chaos soak's referee)
+# ---------------------------------------------------------------------------
+
+
+def audit_conservation(scheduler, apps) -> List[str]:
+    """Task-conservation law under retry governance (``sched/retry.py``):
+    after a run drains, every materialized task of every submitted app
+    terminates **exactly once** —
+
+      * a finished app: all tasks FINISHED, none dead-lettered;
+      * a failed app: every task FINISHED (completed before the failure),
+        DEAD (exactly the dead-letter queue's entries), or NASCENT
+        (cancelled with the app — never placed again);
+      * no task both finished and dead-lettered, no task left in the
+        SUBMITTED/RUNNING limbo states;
+      * each DEAD task has one dead-letter record, budget-exhausted
+        entries consumed exactly ``max_retries + 1`` attempts, and no
+        placement ever landed on a down or quarantined host
+        (``scheduler.placement_violations``).
+
+    Returns human-readable violations (empty = the law holds).
+    """
+    violations: List[str] = []
+    dead_ids = {}
+    for entry in scheduler.dead_letters:
+        if entry.task_id in dead_ids:
+            violations.append(
+                f"task {entry.task_id}: multiple dead-letter records "
+                "(terminated more than once)"
+            )
+        dead_ids[entry.task_id] = entry
+    retry = scheduler.retry
+    if retry is not None and retry.max_retries is not None:
+        for entry in scheduler.dead_letters:
+            if entry.reason == "retry_budget" and (
+                entry.attempts != retry.max_retries + 1
+            ):
+                violations.append(
+                    f"task {entry.task_id}: dead-lettered after "
+                    f"{entry.attempts} attempts, budget says "
+                    f"{retry.max_retries + 1}"
+                )
+    seen_dead = set()
+    for app in apps:
+        failed = bool(getattr(app, "failed", False))
+        if failed and app.is_finished:
+            violations.append(f"app {app.id}: both failed and finished")
+        for group in app.groups:
+            for task in group.tasks:
+                state = task.state.value
+                if task.is_dead:
+                    seen_dead.add(task.id)
+                    if task.id not in dead_ids:
+                        violations.append(
+                            f"task {task.id}: DEAD with no dead-letter record"
+                        )
+                    if not failed:
+                        violations.append(
+                            f"task {task.id}: dead-lettered but app "
+                            f"{app.id} not marked failed"
+                        )
+                elif task.is_finished:
+                    if task.id in dead_ids:
+                        violations.append(
+                            f"task {task.id}: both finished and dead-lettered"
+                        )
+                elif state in ("submitted", "running"):
+                    violations.append(
+                        f"task {task.id}: still {state} after the run "
+                        "drained (lost in flight)"
+                    )
+                elif state == "nascent" and not failed:
+                    violations.append(
+                        f"task {task.id}: nascent in a live app after the "
+                        "run drained (lost before placement)"
+                    )
+    for task_id in dead_ids:
+        if task_id not in seen_dead:
+            violations.append(
+                f"dead-letter record for {task_id} but task not DEAD"
+            )
+    violations.extend(scheduler.placement_violations)
+    return violations
+
+
+def audit_meter(meter, at_end: bool = True) -> List[str]:
+    """Billing consistency: host busy intervals well-formed (closed when
+    the run has drained, non-negative, chronologically ordered,
+    non-overlapping) and scheduling turnovers non-negative — the
+    invariants ``cumulative_instance_hours`` (the billing figure) rests
+    on.  Chaos can legally reshape intervals (aborts close them early,
+    recoveries reopen), but can never corrupt them."""
+    violations: List[str] = []
+    for host, intervals in meter._host_intervals.items():
+        prev_end = None
+        for iv in intervals:
+            if len(iv) == 1:
+                if at_end:
+                    violations.append(
+                        f"{host.id}: busy interval opened at {iv[0]:.6g} "
+                        "never closed"
+                    )
+                continue
+            start, end = iv
+            if end < start:
+                violations.append(
+                    f"{host.id}: negative busy interval [{start:.6g}, {end:.6g}]"
+                )
+            if prev_end is not None and start < prev_end:
+                violations.append(
+                    f"{host.id}: overlapping busy intervals at {start:.6g}"
+                )
+            prev_end = end
+    for t in meter._sched_turnovers:
+        if t < 0:
+            violations.append(f"negative scheduling turnover {t:.6g}")
+            break
+    return violations
+
+
+def audit_run(
+    scheduler, apps, context: str = "end of run",
+    cluster=None, meter=None,
+) -> None:
+    """One-call referee for a drained (chaos) run: cluster-state,
+    conservation, and billing audits; raises :class:`AuditError` with
+    every violation on the first breach.  ``cluster``/``meter`` default
+    to the scheduler's own."""
+    cluster = cluster if cluster is not None else scheduler.cluster
+    meter = meter if meter is not None else scheduler.meter
+    violations = audit_cluster(cluster)
+    violations += audit_conservation(scheduler, apps)
+    if meter is not None:
+        violations += audit_meter(meter)
+    if violations:
+        raise AuditError(
+            f"simulation state corrupted ({context}):\n  "
+            + "\n  ".join(violations)
+        )

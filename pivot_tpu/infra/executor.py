@@ -165,8 +165,15 @@ class FastExecutor(LogMixin):
         self._start_compute(ex)
 
     def _start_compute(self, ex: _Exec) -> None:
-        ex.conclude_at = self.env.now + ex.task.runtime
-        self.env.schedule_callback(ex.task.runtime, lambda: self._compute_done(ex))
+        # Straggler fault model (``infra.faults.slow_host``): compute
+        # started while the host straggles is stretched by the current
+        # multiplier; in-flight compute keeps its original finish time
+        # (the timer is already on the heap).  slowdown == 1.0 when
+        # healthy, and x * 1.0 == x bitwise — the no-straggler
+        # trajectory is unchanged, same as ``Host.execute``.
+        duration = ex.task.runtime * ex.host.slowdown
+        ex.conclude_at = self.env.now + duration
+        self.env.schedule_callback(duration, lambda: self._compute_done(ex))
 
     def _compute_done(self, ex: _Exec) -> None:
         # No-op hop mirroring the process executor's timeout event: the

@@ -21,11 +21,44 @@ This module supplies the missing fault *sources* so that path (mirrored by
 
 All draws come from a dedicated seeded RNG, so fault schedules are
 deterministic and independent of workload/cluster RNG streams.
+
+**The chaos engine** (round 7) grows the independent-fault injector into
+a failure-domain model — the four production fault classes a resilient
+scheduler must absorb (Borg / Bamboo / chaos-engineering lineage,
+PAPERS.md):
+
+  * **Correlated domain outages** — :meth:`FaultInjector.fail_domain`
+    takes down every host sharing a failure domain (a zone, or a whole
+    cloud region) in one draw, using the same locality topology the
+    placement kernels score with.
+  * **Spot preemption with a warning lead** —
+    :meth:`FaultInjector.preempt_host`: at the warning instant the host
+    starts *draining* (``Host.draining`` — still running and admitting
+    its residents, but excluded from NEW placements via the scheduler's
+    live mask), and the abort fires only after the lead window, so
+    short tasks drain out the way real spot workloads do.
+  * **Transient stragglers** — :meth:`FaultInjector.slow_host`: a
+    multiplicative compute slowdown for a window; compute *started*
+    during the window is stretched, in-flight compute keeps its
+    already-scheduled finish time.
+  * **Region-pair network partitions** —
+    :meth:`FaultInjector.partition_regions`: every route between two
+    cloud regions suspends (in-wire chunks finish, queues park, nothing
+    is dropped) until the partition heals; lazily materialized routes
+    are caught by a cluster route hook.
+
+All of it is drivable from a :class:`ChaosSchedule` — a serializable,
+seeded event list that can be saved, replayed, and diffed
+(``tools/chaos_replay.py``), which is what makes chaos runs regression-
+testable: same schedule ⇒ bit-identical fault log and meter snapshot
+(``tests/test_chaos.py``).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -33,7 +66,7 @@ from pivot_tpu.des import Environment
 from pivot_tpu.utils import LogMixin
 from pivot_tpu.utils.trace import NULL_TRACER, Tracer
 
-__all__ = ["FaultInjector"]
+__all__ = ["ChaosEvent", "ChaosSchedule", "FaultInjector"]
 
 
 class FaultInjector(LogMixin):
@@ -60,6 +93,11 @@ class FaultInjector(LogMixin):
         # host_id -> sim time until which the host must stay down.
         # Overlapping outages extend to the union (max end), never truncate.
         self._down_until: dict = {}
+        # Active region-pair partitions: frozenset of two (cloud, region)
+        # tuples each.  Lazily materialized routes consult this through a
+        # cluster route hook (installed on first partition).
+        self._partitions: set = set()
+        self._partition_hook_installed = False
 
     # -- host faults -----------------------------------------------------
     def fail_host(self, host_id: str, at: float, duration: Optional[float] = None):
@@ -68,6 +106,11 @@ class FaultInjector(LogMixin):
         host = self.cluster.get_host(host_id)
         if host is None:
             raise KeyError(f"unknown host {host_id!r}")
+        if duration is not None and duration <= 0:
+            raise ValueError(
+                f"outage duration must be > 0 (or None for permanent), "
+                f"got {duration}"
+            )
 
         recover_at = at + duration if duration is not None else float("inf")
 
@@ -116,6 +159,11 @@ class FaultInjector(LogMixin):
         an Exp(mean=``mttr``) outage (never, if ``mttr`` is None).
         Returns the (time, host_id) schedule for assertions/reporting."""
         hosts = self.cluster.hosts
+        if not hosts:
+            raise ValueError(
+                "random_host_failures needs a cluster with at least one "
+                "host (rng.integers(0, 0) would otherwise fail opaquely)"
+            )
         times = np.sort(self.rng.uniform(start, horizon, size=n_failures))
         picks = self.rng.integers(0, len(hosts), size=n_failures)
         schedule = []
@@ -126,6 +174,241 @@ class FaultInjector(LogMixin):
             self.fail_host(hosts[int(hi)].id, float(t), duration)
             schedule.append((float(t), hosts[int(hi)].id))
         return schedule
+
+    # -- correlated / failure-domain faults -------------------------------
+    def _domain_members(self, domain: str) -> List:
+        """Hosts inside failure domain ``domain`` — ``"cloud/region/zone"``
+        (one zone) or ``"cloud/region"`` (every zone of a region)."""
+        parts = str(domain).split("/")
+        if len(parts) == 3:
+            match = lambda loc: (loc.cloud, loc.region, loc.zone) == tuple(parts)  # noqa: E731
+        elif len(parts) == 2:
+            match = lambda loc: (loc.cloud, loc.region) == tuple(parts)  # noqa: E731
+        else:
+            raise ValueError(
+                f"failure domain must be 'cloud/region' or "
+                f"'cloud/region/zone', got {domain!r}"
+            )
+        return [h for h in self.cluster.hosts if match(h.locality)]
+
+    def fail_domain(
+        self, domain: str, at: float, duration: Optional[float] = None
+    ) -> List[str]:
+        """Correlated outage: one draw takes down EVERY host in ``domain``
+        at sim time ``at`` (all recover together after ``duration``).
+        Returns the member host ids.  The log carries a ``domain_outage``
+        marker ahead of the per-host ``failed`` events."""
+        members = self._domain_members(domain)
+        if not members:
+            raise ValueError(f"failure domain {domain!r} has no hosts")
+
+        def _mark():
+            self.log.append((self.env.now, str(domain), "domain_outage"))
+            self.tracer.emit(
+                "domain", "outage", self.env.now, id=str(domain),
+                n_hosts=len(members),
+            )
+
+        self.env.schedule_callback_at(at, _mark)
+        for h in members:
+            self.fail_host(h.id, at, duration)
+        return [h.id for h in members]
+
+    def preempt_host(
+        self,
+        host_id: str,
+        at: float,
+        lead: float,
+        outage: Optional[float] = None,
+    ) -> None:
+        """Spot preemption with a warning lead: at ``at`` the host starts
+        *draining* (no NEW placements via the scheduler live mask; its
+        residents keep running — tasks shorter than the lead drain out),
+        and at ``at + lead`` the abort fires (``fail_host`` semantics;
+        ``outage`` None = the capacity never comes back)."""
+        host = self.cluster.get_host(host_id)
+        if host is None:
+            raise KeyError(f"unknown host {host_id!r}")
+        if lead < 0:
+            raise ValueError(f"preemption lead must be >= 0, got {lead}")
+
+        def _warn():
+            if not host.up:
+                return  # already down: the preemption is moot
+            host.draining = True
+            self.log.append((self.env.now, host.id, "preempt_warning"))
+            self.tracer.emit(
+                "host", "preempt_warning", self.env.now, id=host.id,
+                lead=lead,
+            )
+
+        self.env.schedule_callback_at(at, _warn)
+        self.fail_host(host_id, at + lead, outage)
+
+    def spot_preemptions(
+        self,
+        n_preemptions: int,
+        horizon: float,
+        lead: float,
+        outage: Optional[float] = None,
+        zone_rates: Optional[Dict[str, float]] = None,
+        start: float = 0.0,
+    ) -> List[Tuple[float, str]]:
+        """Schedule ``n_preemptions`` spot preemptions at uniform times in
+        ``[start, horizon)``.  Victims are drawn per ``zone_rates`` — a
+        ``{"cloud/region/zone": relative rate}`` map (unlisted zones get
+        rate 0; ``None`` = uniform over hosts) — so capacity pools with
+        hot spot markets are preempted proportionally more often.
+        Returns the (warning time, host id) schedule."""
+        hosts = self.cluster.hosts
+        if not hosts:
+            raise ValueError("spot_preemptions needs a non-empty cluster")
+        if zone_rates is None:
+            weights = np.ones(len(hosts))
+        else:
+            weights = np.array(
+                [zone_rates.get(repr(h.locality), 0.0) for h in hosts],
+                dtype=np.float64,
+            )
+            if weights.sum() <= 0:
+                raise ValueError(
+                    "zone_rates assigns zero total rate to this cluster's "
+                    f"zones (keys must be locality strings like "
+                    f"{next(iter(hosts)).locality!r})"
+                )
+        weights = weights / weights.sum()
+        times = np.sort(self.rng.uniform(start, horizon, size=n_preemptions))
+        picks = self.rng.choice(len(hosts), size=n_preemptions, p=weights)
+        schedule = []
+        for t, hi in zip(times, picks):
+            self.preempt_host(hosts[int(hi)].id, float(t), lead, outage)
+            schedule.append((float(t), hosts[int(hi)].id))
+        return schedule
+
+    def slow_host(
+        self, host_id: str, at: float, duration: float, factor: float
+    ) -> None:
+        """Transient straggler: compute STARTED on ``host_id`` during
+        ``[at, at + duration)`` is stretched by ``factor``; compute
+        already in flight keeps its scheduled finish time (its timer is
+        on the heap).  Overlapping windows: last writer wins, and the
+        earliest expiry restores full speed — straggle windows are for
+        chaos schedules, not precise overlap algebra (documented)."""
+        host = self.cluster.get_host(host_id)
+        if host is None:
+            raise KeyError(f"unknown host {host_id!r}")
+        if duration <= 0:
+            raise ValueError(f"straggler duration must be > 0, got {duration}")
+        if factor <= 1.0:
+            raise ValueError(
+                f"straggler factor must be > 1 (a slowdown), got {factor}"
+            )
+
+        def _start():
+            if not host.up:
+                return
+            host.slowdown = factor
+            self.log.append((self.env.now, host.id, "straggler_start"))
+            self.tracer.emit(
+                "host", "straggler_start", self.env.now, id=host.id,
+                factor=factor,
+            )
+
+        def _end():
+            if host.slowdown == 1.0:
+                return  # crashed + recovered mid-window, or already ended
+            host.slowdown = 1.0
+            self.log.append((self.env.now, host.id, "straggler_end"))
+            self.tracer.emit("host", "straggler_end", self.env.now, id=host.id)
+
+        self.env.schedule_callback_at(at, _start)
+        self.env.schedule_callback_at(at + duration, _end)
+
+    # -- network partitions ------------------------------------------------
+    @staticmethod
+    def _region_of(node) -> Tuple[str, str]:
+        return (node.locality.cloud, node.locality.region)
+
+    def _route_partitioned(self, route) -> bool:
+        key = frozenset((self._region_of(route.src), self._region_of(route.dst)))
+        return key in self._partitions
+
+    def partition_regions(
+        self, region_a: str, region_b: str, at: float, duration: float
+    ) -> None:
+        """Partition the network between two cloud regions
+        (``"cloud/region"`` strings) for ``[at, at + duration)``: every
+        route crossing the pair suspends — in-wire chunks finish, queued
+        transfers park, nothing is dropped — and resumes at heal time.
+        Routes materialized during the partition are caught by a cluster
+        route hook.  Python network backend only (native routes serve
+        their queue in the C++ engine)."""
+        if self.cluster.network_backend != "python":
+            raise ValueError(
+                "network partitions require network_backend='python' "
+                "(native routes serve their queue in the C++ engine)"
+            )
+        if duration <= 0:
+            raise ValueError(f"partition duration must be > 0, got {duration}")
+        regs = []
+        for r in (region_a, region_b):
+            parts = str(r).split("/")
+            if len(parts) != 2:
+                raise ValueError(
+                    f"partition endpoints are regions ('cloud/region'), got {r!r}"
+                )
+            regs.append(tuple(parts))
+        if regs[0] == regs[1]:
+            raise ValueError("a partition needs two distinct regions")
+        pair = frozenset(regs)
+        label = "|".join(sorted("/".join(r) for r in regs))
+        if not self._partition_hook_installed:
+            self.cluster.add_route_hook(
+                lambda route: route.suspend()
+                if self._route_partitioned(route)
+                else None
+            )
+            self._partition_hook_installed = True
+
+        def _cut():
+            self._partitions.add(pair)
+            for route in self.cluster._routes.values():
+                if self._route_partitioned(route):
+                    route.suspend()
+            self.log.append((self.env.now, label, "partition_start"))
+            self.tracer.emit("network", "partition_start", self.env.now, id=label)
+
+        def _heal():
+            self._partitions.discard(pair)
+            for route in self.cluster._routes.values():
+                if route.suspended and not self._route_partitioned(route):
+                    route.resume()
+            self.log.append((self.env.now, label, "partition_end"))
+            self.tracer.emit("network", "partition_end", self.env.now, id=label)
+
+        self.env.schedule_callback_at(at, _cut)
+        self.env.schedule_callback_at(at + duration, _heal)
+
+    # -- schedule replay ---------------------------------------------------
+    def apply_schedule(self, schedule: "ChaosSchedule") -> "FaultInjector":
+        """Install every event of a (possibly deserialized)
+        :class:`ChaosSchedule` — the replay entry point: same schedule on
+        the same seeded world ⇒ identical fault log and meter snapshot."""
+        for ev in schedule.events:
+            if ev.kind == "host_outage":
+                self.fail_host(ev.target, ev.at, ev.duration)
+            elif ev.kind == "domain_outage":
+                self.fail_domain(ev.target, ev.at, ev.duration)
+            elif ev.kind == "preemption":
+                self.preempt_host(ev.target, ev.at, ev.lead, ev.duration)
+            elif ev.kind == "straggler":
+                self.slow_host(ev.target, ev.at, ev.duration, ev.factor)
+            elif ev.kind == "partition":
+                a, b = ev.target.split("|")
+                self.partition_regions(a, b, ev.at, ev.duration)
+            else:
+                raise ValueError(f"unknown chaos event kind {ev.kind!r}")
+        return self
 
     # -- network faults --------------------------------------------------
     def fluctuate_bandwidth(
@@ -179,3 +462,289 @@ class FaultInjector(LogMixin):
             self.env.schedule_callback(period, _tick)
             if until is not None:
                 self.env.schedule_callback_at(until, _restore)
+
+
+# ---------------------------------------------------------------------------
+# ChaosSchedule — the serializable, replayable fault plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One fault in a :class:`ChaosSchedule`.
+
+    ``kind`` selects the injector primitive; ``target`` is a host id, a
+    failure-domain string (``"cloud/region"`` / ``"cloud/region/zone"``),
+    or a sorted ``"regionA|regionB"`` pair for partitions.  ``duration``
+    doubles as the preemption outage length (None = permanent) and is
+    required for stragglers and partitions; ``lead`` / ``factor`` are the
+    preemption warning lead and straggler slowdown."""
+
+    kind: str  # host_outage | domain_outage | preemption | straggler | partition
+    at: float
+    target: str
+    duration: Optional[float] = None
+    lead: float = 0.0
+    factor: float = 1.0
+
+    KINDS = ("host_outage", "domain_outage", "preemption", "straggler", "partition")
+
+    def __post_init__(self):
+        if self.kind not in self.KINDS:
+            raise ValueError(f"unknown chaos event kind {self.kind!r}")
+        if self.at < 0:
+            raise ValueError(f"event time must be >= 0, got {self.at}")
+        # Fail at construction/deserialization, not deep inside
+        # apply_schedule: stragglers and partitions are windowed faults —
+        # duration=None has no meaning for them (unlike outages and
+        # preemptions, where None = the capacity never comes back).
+        if self.kind in ("straggler", "partition") and (
+            self.duration is None or self.duration <= 0
+        ):
+            raise ValueError(
+                f"{self.kind} events require a positive duration, "
+                f"got {self.duration!r}"
+            )
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind, "at": self.at, "target": self.target}
+        if self.duration is not None:
+            d["duration"] = self.duration
+        if self.lead:
+            d["lead"] = self.lead
+        if self.factor != 1.0:
+            d["factor"] = self.factor
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosEvent":
+        return cls(
+            kind=d["kind"],
+            at=float(d["at"]),
+            target=str(d["target"]),
+            duration=(None if d.get("duration") is None else float(d["duration"])),
+            lead=float(d.get("lead", 0.0)),
+            factor=float(d.get("factor", 1.0)),
+        )
+
+    def describe(self) -> str:
+        bits = [f"t={self.at:g}", self.kind, self.target]
+        if self.duration is not None:
+            bits.append(f"dur={self.duration:g}")
+        if self.lead:
+            bits.append(f"lead={self.lead:g}")
+        if self.factor != 1.0:
+            bits.append(f"x{self.factor:g}")
+        return " ".join(bits)
+
+
+class ChaosSchedule:
+    """A seeded, serializable fault plan: generate once, save, replay, diff.
+
+    Events are kept sorted by ``(at, kind, target)`` so two schedules
+    with the same content compare equal regardless of construction
+    order, and the JSON form is canonical (diffs are meaningful).
+    Python's ``json`` round-trips floats exactly (repr-based), so a
+    loaded schedule replays the *bit-identical* fault sequence — the
+    determinism regression in ``tests/test_chaos.py`` holds a replayed
+    run to the original's fault log and final meter snapshot.
+    """
+
+    VERSION = 1
+
+    def __init__(
+        self,
+        events,
+        seed: Optional[int] = None,
+        meta: Optional[dict] = None,
+    ):
+        self.events: List[ChaosEvent] = sorted(
+            events, key=lambda e: (e.at, e.kind, e.target)
+        )
+        self.seed = seed
+        self.meta = dict(meta or {})
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, ChaosSchedule) and self.events == other.events
+        )
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    # -- (de)serialization -------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "version": self.VERSION,
+            "seed": self.seed,
+            "meta": self.meta,
+            "events": [e.to_dict() for e in self.events],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosSchedule":
+        if d.get("version", 1) != cls.VERSION:
+            raise ValueError(
+                f"unsupported ChaosSchedule version {d.get('version')!r}"
+            )
+        return cls(
+            [ChaosEvent.from_dict(e) for e in d.get("events", ())],
+            seed=d.get("seed"),
+            meta=d.get("meta"),
+        )
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @classmethod
+    def loads(cls, text: str) -> "ChaosSchedule":
+        return cls.from_dict(json.loads(text))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.dumps())
+
+    @classmethod
+    def load(cls, path: str) -> "ChaosSchedule":
+        with open(path) as f:
+            return cls.loads(f.read())
+
+    def diff(self, other: "ChaosSchedule") -> List[str]:
+        """Human-readable event diff (empty = identical fault plans)."""
+        mine = {e.describe() for e in self.events}
+        theirs = {e.describe() for e in other.events}
+        out = [f"- {d}" for d in sorted(mine - theirs)]
+        out += [f"+ {d}" for d in sorted(theirs - mine)]
+        return out
+
+    # -- generation --------------------------------------------------------
+    @classmethod
+    def generate(
+        cls,
+        cluster,
+        seed: int,
+        horizon: float,
+        *,
+        n_domain_outages: int = 0,
+        domain_level: str = "zone",
+        outage_duration: float = 120.0,
+        n_preemptions: int = 0,
+        preempt_lead: float = 10.0,
+        preempt_outage: Optional[float] = 300.0,
+        zone_rates: Optional[Dict[str, float]] = None,
+        n_stragglers: int = 0,
+        straggler_factor: float = 4.0,
+        straggler_duration: float = 60.0,
+        n_partitions: int = 0,
+        partition_duration: float = 60.0,
+    ) -> "ChaosSchedule":
+        """Draw a seeded chaos plan against ``cluster``'s topology.
+
+        All draws come from one ``default_rng(seed)`` in a fixed order,
+        so the plan is a pure function of (cluster topology, seed,
+        parameters).  Domain outages pick occupied zones (or regions);
+        preemptions pick hosts weighted by ``zone_rates`` (uniform when
+        None — same contract as :meth:`FaultInjector.spot_preemptions`);
+        partitions pick distinct occupied region pairs.  Event times are
+        uniform over ``[0, horizon)``.
+        """
+        rng = np.random.default_rng(seed)
+        hosts = cluster.hosts
+        if not hosts:
+            raise ValueError("chaos generation needs a non-empty cluster")
+        zones = sorted({repr(h.locality) for h in hosts})
+        regions = sorted(
+            {f"{h.locality.cloud}/{h.locality.region}" for h in hosts}
+        )
+        events: List[ChaosEvent] = []
+
+        if n_domain_outages:
+            if domain_level == "zone":
+                pool = zones
+            elif domain_level == "region":
+                pool = regions
+            else:
+                raise ValueError(
+                    f"domain_level must be 'zone' or 'region', got {domain_level!r}"
+                )
+            for t in rng.uniform(0, horizon, size=n_domain_outages):
+                events.append(
+                    ChaosEvent(
+                        "domain_outage",
+                        float(t),
+                        pool[int(rng.integers(0, len(pool)))],
+                        duration=outage_duration,
+                    )
+                )
+
+        if n_preemptions:
+            if zone_rates is None:
+                weights = np.ones(len(hosts))
+            else:
+                weights = np.array(
+                    [zone_rates.get(repr(h.locality), 0.0) for h in hosts]
+                )
+                if weights.sum() <= 0:
+                    raise ValueError("zone_rates cover none of the cluster")
+            weights = weights / weights.sum()
+            times = rng.uniform(0, horizon, size=n_preemptions)
+            picks = rng.choice(len(hosts), size=n_preemptions, p=weights)
+            for t, hi in zip(times, picks):
+                events.append(
+                    ChaosEvent(
+                        "preemption",
+                        float(t),
+                        hosts[int(hi)].id,
+                        duration=preempt_outage,
+                        lead=preempt_lead,
+                    )
+                )
+
+        for _ in range(n_stragglers):
+            t = float(rng.uniform(0, horizon))
+            hi = int(rng.integers(0, len(hosts)))
+            events.append(
+                ChaosEvent(
+                    "straggler",
+                    t,
+                    hosts[hi].id,
+                    duration=straggler_duration,
+                    factor=straggler_factor,
+                )
+            )
+
+        if n_partitions:
+            if len(regions) < 2:
+                raise ValueError(
+                    "partitions need hosts in at least two regions "
+                    f"(cluster spans {regions})"
+                )
+            for _ in range(n_partitions):
+                t = float(rng.uniform(0, horizon))
+                a, b = rng.choice(len(regions), size=2, replace=False)
+                pair = sorted((regions[int(a)], regions[int(b)]))
+                events.append(
+                    ChaosEvent(
+                        "partition",
+                        t,
+                        "|".join(pair),
+                        duration=partition_duration,
+                    )
+                )
+
+        return cls(
+            events,
+            seed=seed,
+            meta={
+                "horizon": horizon,
+                "n_hosts": len(hosts),
+                "zones": zones,
+                "regions": regions,
+            },
+        )

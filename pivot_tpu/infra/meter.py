@@ -381,9 +381,16 @@ class SloMeter(LogMixin):
     """
 
     #: Counter keys always present in the snapshot (tests rely on these).
+    #: The round-7 self-healing keys: ``failed_jobs`` (dead-lettered
+    #: applications reaped by a session), ``session_restarts`` /
+    #: ``requeued`` (supervisor recoveries and the in-flight jobs they
+    #: re-admitted), ``kernel_failures`` / ``degraded_decisions`` (device
+    #: kernel faults absorbed by CPU-twin degradation).
     COUNTERS = (
         "arrived", "admitted", "completed", "shed", "spilled",
         "blocked_waits", "late_injections", "decisions", "placed",
+        "failed_jobs", "session_restarts", "requeued",
+        "kernel_failures", "degraded_decisions",
     )
 
     def __init__(self):

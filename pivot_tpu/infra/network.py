@@ -47,7 +47,10 @@ class Transfer:
 class Route(LogMixin):
     """A directed (src, dst) link with FIFO round-robin chunk service."""
 
-    __slots__ = ("env", "src", "dst", "bw", "meter", "_queue", "_busy", "_in_service")
+    __slots__ = (
+        "env", "src", "dst", "bw", "meter", "_queue", "_busy",
+        "_in_service", "_suspended",
+    )
 
     def __init__(self, env: Environment, src, dst, bw: float, meter=None):
         self.env = env
@@ -58,6 +61,10 @@ class Route(LogMixin):
         self._queue: deque = deque()
         self._busy = False
         self._in_service: Optional[Transfer] = None
+        # Network-partition state (``infra.faults.partition_regions``):
+        # a suspended route parks its queue — the chunk already on the
+        # wire finishes, nothing further is served until resume().
+        self._suspended = False
 
     @property
     def queued_mb(self) -> float:
@@ -99,8 +106,26 @@ class Route(LogMixin):
         if self._in_service is not None and self._in_service.done is done:
             self._in_service.cancelled = True
 
+    def suspend(self) -> None:
+        """Partition this link: the in-service chunk (data already on the
+        wire) completes, then service parks.  Queued transfers are kept,
+        not dropped — a partition delays, a crash cancels."""
+        self._suspended = True
+
+    def resume(self) -> None:
+        """Heal the partition; parked transfers resume round-robin."""
+        if not self._suspended:
+            return
+        self._suspended = False
+        if not self._busy and self._queue:
+            self._serve_next()
+
+    @property
+    def suspended(self) -> bool:
+        return self._suspended
+
     def _serve_next(self) -> None:
-        if not self._queue:
+        if self._suspended or not self._queue:
             self._busy = False
             self._in_service = None
             return
@@ -152,6 +177,14 @@ class NativeRoute(Route):
     @property
     def queued_mb(self) -> float:
         return self.engine.queued_mb(self.index)
+
+    def suspend(self) -> None:
+        raise NotImplementedError(
+            "network partitions require network_backend='python' "
+            "(native routes serve their queue inside the C++ engine)"
+        )
+
+    resume = suspend
 
     def send(self, size_mb: float, done: Optional[Event] = None) -> Event:
         if size_mb <= 0:

@@ -150,6 +150,31 @@ def _fits(avail: jax.Array, demand: jax.Array, strict: bool) -> jax.Array:
     return jnp.all(avail >= demand, axis=1)
 
 
+def _apply_live(avail, live):
+    """Fuse an optional [H] quarantine mask (``live``; False = host
+    excluded from placement — circuit-breaker quarantine or preemption
+    drain, ``sched/retry.py``) into every downstream fit test by giving
+    masked rows the −1 sentinel the availability snapshot already uses
+    for DOWN hosts: demands are ≥ 0, so neither the strict nor the
+    non-strict comparison can ever select a −1 row (zero-demand tasks
+    included), and the chunked fill model prices masked hosts at zero
+    capacity.  Returns ``(masked avail, restore)`` where ``restore``
+    rewrites the untouched original rows into the availability output —
+    a masked host's capacity is unchanged by a tick that cannot place on
+    it, and the restore is what keeps every phase-2 mode's availability
+    output bit-identical to the scan oracle's under any mask.
+
+    ``live=None`` (the default everywhere) is the identity: the traced
+    program is unchanged, so all-live callers keep today's compiled
+    kernels and today's outputs bit for bit.
+    """
+    if live is None:
+        return avail, lambda out: out
+    orig = avail
+    masked = jnp.where(live[:, None], avail, jnp.asarray(-1.0, avail.dtype))
+    return masked, lambda out: jnp.where(live[:, None], out, orig)
+
+
 def _norms(mat: jax.Array) -> jax.Array:
     return jnp.sqrt(jnp.sum(mat * mat, axis=-1))
 
@@ -214,14 +239,17 @@ def _opportunistic_scan(avail, demands, valid, uniforms):
 
 
 @jax.jit
-def opportunistic_kernel_ref(avail, demands, valid, uniforms):
+def opportunistic_kernel_ref(avail, demands, valid, uniforms, live=None):
     """Uniformly random fitting host per task (ref opportunistic.py:11-20).
 
     The k-th fitting host (k = ⌊u·n_fit⌋) is selected via a cumulative-sum
-    rank match — no host list materialization.
+    rank match — no host list materialization.  ``live`` is the optional
+    [H] quarantine mask (:func:`_apply_live`).
     Returns ([T] int32 placements, [H,4] new availability).
     """
-    return _opportunistic_scan(avail, demands, valid, uniforms)
+    avail, restore = _apply_live(avail, live)
+    p, a = _opportunistic_scan(avail, demands, valid, uniforms)
+    return p, restore(a)
 
 
 def _first_fit_scan(avail, demands, valid, strict):
@@ -236,9 +264,11 @@ def _first_fit_scan(avail, demands, valid, strict):
 
 
 @functools.partial(jax.jit, static_argnames=("strict",))
-def first_fit_kernel_ref(avail, demands, valid, strict=False):
+def first_fit_kernel_ref(avail, demands, valid, strict=False, live=None):
     """Lowest-index fitting host per task (ref vbp.py:6-29)."""
-    return _first_fit_scan(avail, demands, valid, strict)
+    avail, restore = _apply_live(avail, live)
+    p, a = _first_fit_scan(avail, demands, valid, strict)
+    return p, restore(a)
 
 
 def _best_fit_scan(avail, demands, valid):
@@ -256,9 +286,11 @@ def _best_fit_scan(avail, demands, valid):
 
 
 @jax.jit
-def best_fit_kernel_ref(avail, demands, valid):
+def best_fit_kernel_ref(avail, demands, valid, live=None):
     """Min residual-L2 host among strict fits (ref vbp.py:32-49)."""
-    return _best_fit_scan(avail, demands, valid)
+    avail, restore = _apply_live(avail, live)
+    p, a = _best_fit_scan(avail, demands, valid)
+    return p, restore(a)
 
 
 def _cost_aware_scan(
@@ -356,6 +388,7 @@ def cost_aware_kernel_ref(
     host_decay: bool = False,
     rt_bw_rows=None,
     rt_bw_idx=None,
+    live=None,
 ):
     """The PIVOT cost-aware placement (ref cost_aware.py:28-127), fused —
     the reference-shaped scan, retained as the parity oracle.
@@ -382,13 +415,16 @@ def cost_aware_kernel_ref(
     frozen when the scan enters the group (matching the reference's
     sort-at-group-start); placement is a masked argmin with strict fits.
     Best-fit: per-task score ``cost·‖avail−d‖·decay / bw`` over non-strict
-    fits, with a live placement counter in the decay.
+    fits, with a live placement counter in the decay.  ``live`` is the
+    optional [H] quarantine mask (:func:`_apply_live`).
     """
-    return _cost_aware_scan(
+    avail, restore = _apply_live(avail, live)
+    p, a = _cost_aware_scan(
         avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
         host_zone, base_task_counts, bin_pack, sort_hosts, host_decay,
         rt_bw_rows, rt_bw_idx,
     )
+    return p, restore(a)
 
 
 def _scan_swap(body, avail, xs):
@@ -615,19 +651,23 @@ def _chunk_drive(avail, demands, valid, n_eff, C, speculate, recheck):
 
 
 @functools.partial(jax.jit, static_argnames=("phase2",))
-def opportunistic_kernel(avail, demands, valid, uniforms, phase2="auto"):
+def opportunistic_kernel(avail, demands, valid, uniforms, phase2="auto",
+                         live=None):
     """Uniformly random fitting host per task (ref opportunistic.py:11-20),
     two-phase form — see the module docstring for the ``phase2`` modes.
     Bit-identical to :func:`opportunistic_kernel_ref` in every mode.
     No ``totals`` pre-filter input: the random choice has no fill model
     to steer, so the operand would be dead weight on the dispatch path.
+    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`).
     Returns ([T] int32 placements, [H,4] new availability)."""
     mode = _resolve_phase2(phase2)
+    avail, restore = _apply_live(avail, live)
     if mode == "scan":
-        return _opportunistic_scan(avail, demands, valid, uniforms)
+        p, a = _opportunistic_scan(avail, demands, valid, uniforms)
+        return p, restore(a)
     B = demands.shape[0]
     if B == 0:
-        return jnp.zeros((0,), jnp.int32), avail
+        return jnp.zeros((0,), jnp.int32), restore(avail)
     n_eff = _effective_len(valid)
 
     if mode == "slim":
@@ -639,7 +679,8 @@ def opportunistic_kernel(avail, demands, valid, uniforms, phase2="auto"):
             h = jnp.argmax(fit & (rank == k + 1))
             return h, n_fit > 0
 
-        return _slim_drive(avail, demands, n_eff, decide_row)
+        p, a = _slim_drive(avail, demands, n_eff, decide_row)
+        return p, restore(a)
 
     C = min(mode, B)
     uP = _pad_chunk(uniforms, C)
@@ -657,26 +698,30 @@ def opportunistic_kernel(avail, demands, valid, uniforms, phase2="auto"):
     # Random choices do not pile on, so fit masks rarely move within a
     # chunk: plain chunk-entry speculation (the decision itself, run
     # against A0) commits wide here.
-    return _chunk_drive(
+    p, a = _chunk_drive(
         avail, demands, valid, n_eff, C,
         lambda avail, dem_c, valid_c, pos: decide(
             avail[None], dem_c, valid_c, pos
         ),
         decide,
     )
+    return p, restore(a)
 
 
 @functools.partial(jax.jit, static_argnames=("strict", "phase2"))
 def first_fit_kernel(avail, demands, valid, strict=False, totals=None,
-                     phase2="auto"):
+                     phase2="auto", live=None):
     """Lowest-index fitting host per task (ref vbp.py:6-29), two-phase
-    form.  Bit-identical to :func:`first_fit_kernel_ref` in every mode."""
+    form.  Bit-identical to :func:`first_fit_kernel_ref` in every mode.
+    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`)."""
     mode = _resolve_phase2(phase2)
+    avail, restore = _apply_live(avail, live)
     if mode == "scan":
-        return _first_fit_scan(avail, demands, valid, strict)
+        p, a = _first_fit_scan(avail, demands, valid, strict)
+        return p, restore(a)
     B = demands.shape[0]
     if B == 0:
-        return jnp.zeros((0,), jnp.int32), avail
+        return jnp.zeros((0,), jnp.int32), restore(avail)
     n_eff = _effective_len(valid)
 
     if mode == "slim":
@@ -684,7 +729,8 @@ def first_fit_kernel(avail, demands, valid, strict=False, totals=None,
             fit = _fits(avail, demand, strict) & valid[j]
             return jnp.argmax(fit), jnp.any(fit)
 
-        return _slim_drive(avail, demands, n_eff, decide_row)
+        p, a = _slim_drive(avail, demands, n_eff, decide_row)
+        return p, restore(a)
 
     def speculate(avail, dem_c, valid_c, pos):
         # Fill speculation in host-index order (first-fit's score IS the
@@ -703,21 +749,26 @@ def first_fit_kernel(avail, demands, valid, strict=False, totals=None,
         fit = fit & valid_c[:, None]
         return jnp.argmax(fit, axis=1).astype(jnp.int32), jnp.any(fit, axis=1)
 
-    return _chunk_drive(
+    p, a = _chunk_drive(
         avail, demands, valid, n_eff, min(mode, B), speculate, recheck
     )
+    return p, restore(a)
 
 
 @functools.partial(jax.jit, static_argnames=("phase2",))
-def best_fit_kernel(avail, demands, valid, totals=None, phase2="auto"):
+def best_fit_kernel(avail, demands, valid, totals=None, phase2="auto",
+                    live=None):
     """Min residual-L2 host among strict fits (ref vbp.py:32-49), two-phase
-    form.  Bit-identical to :func:`best_fit_kernel_ref` in every mode."""
+    form.  Bit-identical to :func:`best_fit_kernel_ref` in every mode.
+    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`)."""
     mode = _resolve_phase2(phase2)
+    avail, restore = _apply_live(avail, live)
     if mode == "scan":
-        return _best_fit_scan(avail, demands, valid)
+        p, a = _best_fit_scan(avail, demands, valid)
+        return p, restore(a)
     B = demands.shape[0]
     if B == 0:
-        return jnp.zeros((0,), jnp.int32), avail
+        return jnp.zeros((0,), jnp.int32), restore(avail)
     big = jnp.asarray(jnp.inf, avail.dtype)
     n_eff = _effective_len(valid)
 
@@ -727,7 +778,8 @@ def best_fit_kernel(avail, demands, valid, totals=None, phase2="auto"):
             residual = _norms(avail - demand)
             return jnp.argmin(jnp.where(fit, residual, big)), jnp.any(fit)
 
-        return _slim_drive(avail, demands, n_eff, decide_row)
+        p, a = _slim_drive(avail, demands, n_eff, decide_row)
+        return p, restore(a)
 
     def speculate(avail, dem_c, valid_c, pos):
         # Best-fit piles onto its argmin host (placing there shrinks the
@@ -746,9 +798,10 @@ def best_fit_kernel(avail, demands, valid, totals=None, phase2="auto"):
         h = jnp.argmin(jnp.where(fit, residual, big), axis=1)
         return h.astype(jnp.int32), jnp.any(fit, axis=1)
 
-    return _chunk_drive(
+    p, a = _chunk_drive(
         avail, demands, valid, n_eff, min(mode, B), speculate, recheck
     )
+    return p, restore(a)
 
 
 @functools.partial(
@@ -772,11 +825,13 @@ def cost_aware_kernel(
     rt_bw_idx=None,
     totals=None,
     phase2="auto",
+    live=None,
 ):
     """The PIVOT cost-aware placement (ref cost_aware.py:28-127), two-phase
     form — argument contract as :func:`cost_aware_kernel_ref`, plus the
-    phase-1 ``totals`` pre-filter and the static ``phase2`` mode selector
-    (module docstring).  Bit-identical to the oracle in every mode.
+    phase-1 ``totals`` pre-filter, the static ``phase2`` mode selector
+    (module docstring), and the optional [H] quarantine mask ``live``
+    (:func:`_apply_live`).  Bit-identical to the oracle in every mode.
 
     Phase-1 hoists here: the ``[Z, H]`` round-trip tables (already
     pre-scan), the host-decay prescale of the cost table (exact: the same
@@ -790,15 +845,17 @@ def cost_aware_kernel(
     (``ops/pallas_kernels.py``).
     """
     mode = _resolve_phase2(phase2)
+    avail, restore = _apply_live(avail, live)
     if mode == "scan":
-        return _cost_aware_scan(
+        p, a = _cost_aware_scan(
             avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
             host_zone, base_task_counts, bin_pack, sort_hosts, host_decay,
             rt_bw_rows, rt_bw_idx,
         )
+        return p, restore(a)
     B, H = demands.shape[0], avail.shape[0]
     if B == 0:
-        return jnp.zeros((0,), jnp.int32), avail
+        return jnp.zeros((0,), jnp.int32), restore(avail)
     first_fit = bin_pack == "first-fit"
     big = jnp.asarray(jnp.inf, avail.dtype)
     dtype = avail.dtype
@@ -871,7 +928,7 @@ def cost_aware_kernel(
         _, placements, avail, _, _ = lax.while_loop(
             lambda st: st[0] < n_eff, body, st0
         )
-        return placements, avail
+        return placements, restore(avail)
 
     C = min(mode, B)
     demP, validP, ngP = (_pad_chunk(x, C) for x in (demands, valid, new_group))
@@ -979,4 +1036,4 @@ def cost_aware_kernel(
     _, placements, avail, _, _ = lax.while_loop(
         lambda st: st[0] < n_eff, body, st0
     )
-    return placements[:B], avail
+    return placements[:B], restore(avail)

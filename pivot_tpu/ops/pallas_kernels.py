@@ -84,12 +84,15 @@ def cost_aware_pallas(
     sort_hosts: bool = True,
     host_decay: bool = False,
     interpret: bool = False,
+    live=None,
 ):
     """Drop-in Pallas replacement for ``kernels.cost_aware_kernel``.
 
     Returns ``([T] int32 placements, [H, 4] new availability)`` with the
     same greedy semantics; ``interpret=True`` runs the Mosaic interpreter
-    (CPU parity tests).  The single-replica case of
+    (CPU parity tests).  ``live`` is the optional [H] quarantine mask
+    (False = host excluded from placement — same contract as the scan
+    kernels' ``live``).  The single-replica case of
     :func:`cost_aware_pallas_batched` — one greedy body serves both, so
     the policy semantics (fit predicates, score formulas, tie rule)
     cannot drift between the batched and unbatched forms.
@@ -109,6 +112,7 @@ def cost_aware_pallas(
         host_decay=host_decay,
         block_replicas=1,
         interpret=interpret,
+        live=live,
     )
     return placements[0], avail_out[0]
 
@@ -243,6 +247,7 @@ def cost_aware_pallas_batched(
     host_decay: bool = False,
     block_replicas: Optional[int] = None,
     interpret: bool = False,
+    live=None,
 ):
     """Replica-batched greedy pass: ``R`` Monte-Carlo replicas, one kernel.
 
@@ -273,6 +278,16 @@ def cost_aware_pallas_batched(
     T = demands.shape[0]
     if T == 0 or R == 0:
         return jnp.zeros((R, T), jnp.int32), avail_r
+    avail_in = avail_r
+    if live is not None:
+        # Quarantine mask ([H] bool, False = excluded): masked hosts get
+        # the same -1e30 sentinel as PADDING lanes, so no fit test in
+        # the kernel body can select them — the Pallas analog of the
+        # scan kernels' fused ``live`` mask.  Their true availability is
+        # restored on the output below (a tick that cannot place on a
+        # host cannot change its capacity), keeping the availability
+        # result bit-identical to ``cost_aware_kernel(..., live=...)``.
+        avail_r = jnp.where(live[None, :, None], avail_r, _NEG)
     Hp = _round_up(max(H, 128), 128)
     chunk = min(256, _round_up(T, 8))
     # Per-replica VMEM bytes of the block's working set: two [4·RB, Hp]
@@ -421,4 +436,7 @@ def cost_aware_pallas_batched(
     avail_out = jnp.transpose(
         avail_out.reshape(Rb, 4, RB, Hp), (0, 2, 1, 3)
     ).reshape(Rp, 4, Hp)[:R, :, :H]
-    return placements, jnp.transpose(avail_out, (0, 2, 1)).astype(avail_r.dtype)
+    avail_out = jnp.transpose(avail_out, (0, 2, 1)).astype(avail_in.dtype)
+    if live is not None:
+        avail_out = jnp.where(live[None, :, None], avail_out, avail_in)
+    return placements, avail_out

@@ -29,6 +29,18 @@ SURVEY.md §4):
   * Finished applications are actually removed from the local-scheduler
     registry (the reference pops by the wrong key, ``:145``, and rescans
     every app's DAG each tick).
+
+**Retry governance** (round 7, ``sched/retry.py``): the reference's
+resubmit-forever loop is now *governed* when the scheduler is built with
+a :class:`~pivot_tpu.sched.retry.RetryPolicy` (per-task budgets +
+deterministically-jittered exponential backoff; budget exhaustion
+dead-letters the task, fails its application, and records the shed
+reason) and/or a :class:`~pivot_tpu.sched.retry.HostCircuitBreaker`
+(K consecutive failures quarantine a host for a cooldown; the ``[H]``
+live mask in :attr:`TickContext.live_mask` — quarantines plus
+spot-preemption drain flags — is fused into every placement backend's
+fit mask).  Both default to ``None``, which keeps the loop bit-identical
+to the reference-parity behavior above.
 """
 
 from __future__ import annotations
@@ -39,12 +51,21 @@ import numpy as np
 
 from pivot_tpu.des import Environment, Store
 from pivot_tpu.infra import Cluster, Host
-from pivot_tpu.infra.meter import Meter
+from pivot_tpu.infra.meter import Meter, SloMeter
+from pivot_tpu.sched.retry import DeadLetter, HostCircuitBreaker, RetryPolicy
 from pivot_tpu.utils import LogMixin
 from pivot_tpu.utils.trace import NULL_TRACER, Tracer
-from pivot_tpu.workload import Application, Task
+from pivot_tpu.workload import Application, Task, TaskState
 
-__all__ = ["TickContext", "Policy", "GlobalScheduler", "LocalScheduler"]
+__all__ = [
+    "TickContext",
+    "Policy",
+    "GlobalScheduler",
+    "LocalScheduler",
+    "DeadLetter",
+    "HostCircuitBreaker",
+    "RetryPolicy",
+]
 
 
 class TickContext:
@@ -78,6 +99,8 @@ class TickContext:
         )
         self._host_zones: Optional[np.ndarray] = None
         self._host_task_counts: Optional[np.ndarray] = None
+        self._live_mask: Optional[np.ndarray] = None
+        self._live_mask_set = False
         # Policies that iterate the batch in a different order than given
         # (the VBP decreasing arms) record it here: the reference's tick
         # loop consumes ``schedule(ready_q)``'s RETURN list — the sorted
@@ -111,6 +134,34 @@ class TickContext:
                 [h.n_tasks for h in self.hosts], dtype=np.int32
             )
         return self._host_task_counts
+
+    @property
+    def live_mask(self) -> Optional[np.ndarray]:
+        """[H] bool quarantine mask for this tick — False marks hosts
+        excluded from NEW placements: circuit-breaker quarantines
+        (``scheduler.breaker``) and spot-preemption drain flags
+        (``Host.draining``).  ``None`` = every host live, the
+        allocation-free common case.  Down hosts are *not* represented
+        here — the availability snapshot's −1 sentinel already keeps
+        every fit mask off them.  CPU policies fold the mask into the
+        availability working copy (``policies.fold_quarantine``); device
+        policies pass it to the kernels' ``live`` argument — identical
+        fit masks either way."""
+        if self._live_mask_set:
+            return self._live_mask
+        self._live_mask_set = True
+        breaker = getattr(self.scheduler, "breaker", None)
+        now = self.env_now
+        mask = None
+        for i, h in enumerate(self.hosts):
+            if getattr(h, "draining", False) or (
+                breaker is not None and breaker.is_quarantined(h.id, now)
+            ):
+                if mask is None:
+                    mask = np.ones(len(self.hosts), dtype=bool)
+                mask[i] = False
+        self._live_mask = mask
+        return mask
 
 
 class Policy(LogMixin):
@@ -208,6 +259,9 @@ class GlobalScheduler(LogMixin):
         seed: Optional[int] = None,
         meter: Optional[Meter] = None,
         tracer: Optional[Tracer] = None,
+        retry: Optional[RetryPolicy] = None,
+        breaker: Optional[HostCircuitBreaker] = None,
+        slo: Optional[SloMeter] = None,
     ):
         self.env = env
         self.cluster = cluster
@@ -216,6 +270,22 @@ class GlobalScheduler(LogMixin):
         self.seed = seed
         self.meter = meter
         self.tracer = tracer or NULL_TRACER
+        #: Retry governance (``sched/retry.py``) — both None by default,
+        #: which preserves the reference-parity resubmit-forever loop
+        #: bit for bit.  ``slo`` (serving layer) receives shed reasons
+        #: for dead-lettered tasks.
+        self.retry = retry
+        self.breaker = breaker
+        self.slo = slo
+        #: Terminal dead-letter queue, in dead-lettering order.
+        self.dead_letters: List[DeadLetter] = []
+        #: Tasks of failed applications dropped before (re)placement.
+        self.n_cancelled = 0
+        #: Placements that landed on a down or quarantined host — the
+        #: invariant auditor asserts this stays empty (infra/audit.py).
+        self.placement_violations: List[str] = []
+        self._attempts: Dict[Task, int] = {}  # failures per live task
+        self._failed_apps: set = set()
         self.randomizer = np.random.RandomState(seed)
         self.submit_q = Store(env)
         self._wait_stack: List[Task] = []
@@ -267,6 +337,20 @@ class GlobalScheduler(LogMixin):
             while self._wait_stack:
                 ready.append(self._wait_stack.pop())  # LIFO, ref popitem()
             ready.extend(self.submit_q.drain())
+            if self._failed_apps and ready:
+                # A dead-lettered task fails its whole application;
+                # sibling tasks still circulating (wait queue, submit
+                # queue, late local-scheduler pumps) are cancelled here
+                # rather than placed — the conservation auditor accounts
+                # them via ``n_cancelled``.
+                kept: List[Task] = []
+                for task in ready:
+                    app = task.application
+                    if app is not None and app.id in self._failed_apps:
+                        self._cancel_task(task)
+                    else:
+                        kept.append(task)
+                ready = kept
             if ready:
                 if self.meter:
                     self.meter.increment_scheduling_ops(len(ready))
@@ -298,6 +382,7 @@ class GlobalScheduler(LogMixin):
                     if ctx.visit_order is not None
                     else range(len(ready))
                 )
+                live = ctx.live_mask
                 for i in visit:
                     task, h_idx = ready[i], placements[i]
                     if not task.is_nascent:
@@ -307,7 +392,16 @@ class GlobalScheduler(LogMixin):
                         task.placement = None
                         self._wait_stack.append(task)
                     else:
-                        task.placement = ctx.hosts[int(h_idx)].id
+                        host = ctx.hosts[int(h_idx)]
+                        if not host.up or (
+                            live is not None and not live[int(h_idx)]
+                        ):
+                            self.placement_violations.append(
+                                f"t={env.now:.3f}: task {task.id} placed on "
+                                f"{'down' if not host.up else 'quarantined'} "
+                                f"host {host.id}"
+                            )
+                        task.placement = host.id
                         cluster.dispatch_q.put(task)
                         task.set_submitted()
                         if self.meter:
@@ -338,19 +432,60 @@ class GlobalScheduler(LogMixin):
             return
         local = self._local.get(app.id)
         if local is None:
+            if app.id in self._failed_apps:
+                # Late notification for a dead-lettered application: an
+                # in-flight sibling concluded after the app failed.
+                # Account it so the conservation audit still balances.
+                if success:
+                    task.set_finished()
+                else:
+                    task.set_nascent()
+                    task.placement = None
+                    self._cancel_task(task)
+                return
             self.logger.error("application %s unknown", app.id)
             return
         if success:
+            if self.breaker is not None and task.placement is not None:
+                self.breaker.record_success(task.placement)
+            if self.retry is not None:
+                self._attempts.pop(task, None)
             task.set_finished()
             self.tracer.emit(
                 "task", "finished", env.now, id=task.id, host=task.placement
             )
             local.notify(task)
         else:
+            failed_host = task.placement
+            if self.breaker is not None and failed_host is not None:
+                if self.breaker.record_failure(failed_host, env.now):
+                    self.tracer.emit(
+                        "host", "quarantined", env.now, id=failed_host,
+                        until=env.now + self.breaker.cooldown,
+                    )
             task.set_nascent()
             task.placement = None
-            self.tracer.emit("task", "retry", env.now, id=task.id)
-            self.submit_q.put(task)
+            if self.retry is not None:
+                attempts = self._attempts.get(task, 0) + 1
+                self._attempts[task] = attempts
+                if self.retry.exhausted(attempts):
+                    self._dead_letter(task, failed_host, attempts)
+                    return
+                self.tracer.emit("task", "retry", env.now, id=task.id)
+                delay = self.retry.backoff(attempts, task.id)
+                if delay > 0.0:
+                    # Backed-off resubmission: the task re-enters the
+                    # submit queue only after its (deterministically
+                    # jittered) delay — de-synchronizing the retry wave
+                    # a correlated outage creates.
+                    env.schedule_callback(
+                        delay, lambda t=task: self.submit_q.put(t)
+                    )
+                else:
+                    self.submit_q.put(task)
+            else:
+                self.tracer.emit("task", "retry", env.now, id=task.id)
+                self.submit_q.put(task)
         if app.is_finished:
             app.end_time = env.now
             self.tracer.emit("app", "finished", env.now, id=app.id)
@@ -361,4 +496,51 @@ class GlobalScheduler(LogMixin):
                 app.end_time - app.start_time,
             )
             self._local.pop(app.id, None)
+            self._n_unfinished -= 1
+
+    # -- retry governance (``sched/retry.py``) ----------------------------
+    def _cancel_task(self, task: Task) -> None:
+        """Drop a task whose application has already failed: it is never
+        (re)placed; its pending bookkeeping is released."""
+        self.n_cancelled += 1
+        self._pending_since.pop(task, None)
+        self._attempts.pop(task, None)
+        self.tracer.emit("task", "cancelled", self.env.now, id=task.id)
+
+    def _dead_letter(
+        self, task: Task, host_id: Optional[str], attempts: int,
+        reason: str = "retry_budget",
+    ) -> None:
+        """Terminal path for a budget-exhausted task: record it, shed the
+        reason to the SLO meter, and fail its application (a DAG with a
+        permanently lost task can never finish — leaving it live would
+        keep the scheduler loop alive forever, the reference's wedge)."""
+        task.set_dead()
+        self._attempts.pop(task, None)
+        self._pending_since.pop(task, None)
+        entry = DeadLetter(
+            task.id, task.application.id, host_id, reason, self.env.now,
+            attempts,
+        )
+        self.dead_letters.append(entry)
+        if self.slo is not None:
+            self.slo.record_shed(reason)
+        self.tracer.emit(
+            "task", "dead_letter", self.env.now, id=task.id, reason=reason,
+            attempts=attempts, host=host_id,
+        )
+        self.logger.warning(
+            "[%.3f] task %s dead-lettered after %d attempts (%s)",
+            self.env.now, task.id, attempts, reason,
+        )
+        self._fail_application(task.application)
+
+    def _fail_application(self, app: Application) -> None:
+        if app.id in self._failed_apps:
+            return
+        self._failed_apps.add(app.id)
+        app.failed = True
+        app.end_time = self.env.now
+        self.tracer.emit("app", "failed", self.env.now, id=app.id)
+        if self._local.pop(app.id, None) is not None:
             self._n_unfinished -= 1

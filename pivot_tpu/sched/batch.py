@@ -223,6 +223,11 @@ class BatchClient:
         self._idle = False
 
     def dispatch(self, kernel, args, arr_kw=None, static_kw=None):
+        if self._closed:
+            # An abandoned (stall-supervised) session thread waking up
+            # after its slot was reclaimed must not re-enter the barrier:
+            # its request would inflate the quiescence count forever.
+            raise RuntimeError("batch client is closed")
         req = _Request(
             self.slot, kernel, tuple(args), dict(arr_kw or {}),
             dict(static_kw or {}),
@@ -316,6 +321,23 @@ class DispatchBatcher:
                 )
             slot = self._clients
             self._clients += 1
+        return BatchClient(self, slot)
+
+    def respawn_client(self) -> BatchClient:
+        """Open a FRESH slot beyond the construction-time count — the
+        serving supervisor's restart path (``serve/driver.py``): a
+        crashed session's slot is closed by its dying thread, and its
+        replacement session must not inherit that slot's state, so it
+        gets a new one.  The quiescence predicate tracks ``_open``
+        (closed slots don't count), so total slot count growing over
+        restarts never parks the coordinator."""
+        with self._cond:
+            slot = self._clients
+            self._clients += 1
+            self._n_slots += 1
+            self._open += 1
+            self.stats["runs"] = self._n_slots
+            self._cond.notify_all()
         return BatchClient(self, slot)
 
     # -- run-thread side --------------------------------------------------

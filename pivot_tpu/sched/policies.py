@@ -50,7 +50,24 @@ __all__ = [
     "FirstFitPolicy",
     "BestFitPolicy",
     "CostAwarePolicy",
+    "fold_quarantine",
 ]
+
+
+def fold_quarantine(ctx: TickContext) -> None:
+    """Fold the tick's quarantine/drain mask into the availability
+    working copy: masked hosts get the −1 sentinel — the same mechanism
+    that already excludes DOWN hosts from every fit test (demands are
+    ≥ 0, so no strict or non-strict comparison can select a −1 row,
+    zero-demand tasks included).  Reusing the sentinel keeps every
+    naive/numpy inner loop and incremental fast path untouched, and is
+    placement-identical to the device kernels' fused ``live`` mask: the
+    two produce the same fit masks, and scores of *fitting* (live,
+    untouched) hosts are computed from identical rows.  No-op when every
+    host is live."""
+    live = ctx.live_mask
+    if live is not None:
+        ctx.avail[~live] = -1.0
 
 
 def _norms(mat: np.ndarray) -> np.ndarray:
@@ -101,6 +118,7 @@ class OpportunisticPolicy(Policy):
         self.mode = mode
 
     def place(self, ctx: TickContext) -> np.ndarray:
+        fold_quarantine(ctx)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         if self.mode == "naive":
@@ -148,6 +166,7 @@ class FirstFitPolicy(Policy):
         self.mode = mode
 
     def place(self, ctx: TickContext) -> np.ndarray:
+        fold_quarantine(ctx)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         idxs = list(range(ctx.n_tasks))
@@ -200,6 +219,7 @@ class BestFitPolicy(Policy):
         self.mode = mode
 
     def place(self, ctx: TickContext) -> np.ndarray:
+        fold_quarantine(ctx)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         idxs = list(range(ctx.n_tasks))
@@ -346,6 +366,7 @@ class CostAwarePolicy(Policy):
 
     # -- placement -------------------------------------------------------
     def place(self, ctx: TickContext) -> np.ndarray:
+        fold_quarantine(ctx)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         storage = ctx.cluster.storage

@@ -160,10 +160,24 @@ class _DevicePolicyBase(Policy):
     #: this bounds each exploration sample to ~margin × floor seconds.
     _EXPLORE_MARGIN = 8.0
 
-    def __init__(self, adaptive: bool = False, phase2="auto"):
+    def __init__(self, adaptive: bool = False, phase2="auto",
+                 degrade_after: Optional[int] = None):
         self.topology: Optional[DeviceTopology] = None
         self._scheduler = None
         self.adaptive = adaptive
+        #: Graceful degradation (serving self-healing, ``serve/driver``):
+        #: after this many CONSECUTIVE device-kernel failures the policy
+        #: permanently falls back to its CPU twin — the same numpy
+        #: oracle the parity suite holds the kernels to, so placements
+        #: don't change, only the backend serving them.  Individual
+        #: failures are served by the twin too (per-tick fallback) and
+        #: counted in ``kernel_failures``.  ``None`` (default) keeps
+        #: kernel exceptions fatal — batch experiments must not silently
+        #: mask a broken kernel as twin output.
+        self.degrade_after = degrade_after
+        self.degraded = False
+        self.kernel_failures = 0
+        self._consecutive_failures = 0
         #: Phase-2 mode forwarded to the two-phase kernels
         #: (``ops/kernels.py``): "auto" (slim on CPU, scan elsewhere),
         #: "scan", "slim", or an int chunk size for speculative chunk
@@ -251,8 +265,54 @@ class _DevicePolicyBase(Policy):
             )
         return self._topology_host
 
+    # -- quarantine mask ---------------------------------------------------
+    def _live_arg(self, ctx: TickContext):
+        """The tick's [H] quarantine mask staged for the kernels' ``live``
+        argument, or None when every host is live (None keeps the
+        all-live compiled program — and today's outputs — untouched)."""
+        live = ctx.live_mask
+        if live is None:
+            return None
+        return self._stage(live)
+
+    # -- graceful degradation ----------------------------------------------
+    def _note_kernel_failure(self, exc: BaseException) -> None:
+        self.kernel_failures += 1
+        self._consecutive_failures += 1
+        if self._consecutive_failures >= self.degrade_after:
+            self.degraded = True
+            self.logger.error(
+                "device kernel failed %d times consecutively — degrading "
+                "to the CPU twin permanently: %s",
+                self._consecutive_failures, exc,
+            )
+        else:
+            self.logger.warning(
+                "device kernel failed (%d/%d before degradation): %s",
+                self._consecutive_failures, self.degrade_after, exc,
+            )
+
+    def _guarded_device_place(self, ctx: TickContext) -> np.ndarray:
+        """Device dispatch with the degradation guard: a failing kernel
+        call is served by the CPU twin for this tick (bit-identical
+        placements — the twin consumes the same per-tick Philox stream);
+        ``degrade_after`` consecutive failures make the fallback
+        permanent.  Guard disabled (``degrade_after=None``): exceptions
+        propagate unchanged."""
+        if self.degrade_after is None or self._cpu_twin is None:
+            return self._device_place(ctx)
+        try:
+            out = self._device_place(ctx)
+        except Exception as exc:  # noqa: BLE001 — the guard's whole point
+            self._note_kernel_failure(exc)
+            return self._cpu_twin.place(ctx)
+        self._consecutive_failures = 0
+        return out
+
     # -- adaptive dispatch ------------------------------------------------
     def place(self, ctx: TickContext) -> np.ndarray:
+        if self.degraded and self._cpu_twin is not None:
+            return self._cpu_twin.place(ctx)
         if self.adaptive and self._cpu_twin is not None:
             import jax
 
@@ -313,7 +373,17 @@ class _DevicePolicyBase(Policy):
                     self._twin_routed += 1
                 return out
             t0 = time.perf_counter()
-            out = self._device_place(ctx)
+            if self.degrade_after is not None:
+                try:
+                    out = self._device_place(ctx)
+                except Exception as exc:  # noqa: BLE001 — degradation guard
+                    # Twin fallback; no EMA update (the sample measures
+                    # neither side's healthy cost).
+                    self._note_kernel_failure(exc)
+                    return self._cpu_twin.place(ctx)
+                self._consecutive_failures = 0
+            else:
+                out = self._device_place(ctx)
             dt = time.perf_counter() - t0
             # Attribute time beyond the probed floor to per-padded-cell
             # work — but never from a bucket's first call, which includes
@@ -330,7 +400,7 @@ class _DevicePolicyBase(Policy):
             else:
                 self._device_routed += 1
             return out
-        return self._device_place(ctx)
+        return self._guarded_device_place(ctx)
 
     def _device_place(self, ctx: TickContext) -> np.ndarray:
         raise NotImplementedError
@@ -391,8 +461,9 @@ class _DevicePolicyBase(Policy):
 class TpuOpportunisticPolicy(_DevicePolicyBase):
     name = "opportunistic_tpu"
 
-    def __init__(self, adaptive: bool = False, phase2="auto"):
-        super().__init__(adaptive, phase2)
+    def __init__(self, adaptive: bool = False, phase2="auto",
+                 degrade_after=None):
+        super().__init__(adaptive, phase2, degrade_after)
         self._cpu_twin = OpportunisticPolicy(mode="numpy")
 
     def _device_place(self, ctx: TickContext) -> np.ndarray:
@@ -403,7 +474,7 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
         placements, _ = self._call_kernel(
             opportunistic_kernel, avail, dem, valid,
             self._stage(u, self.dtype),
-            phase2=self.phase2,
+            phase2=self.phase2, live=self._live_arg(ctx),
         )
         return self._unpad(placements, T)
 
@@ -412,8 +483,8 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
     name = "first_fit_tpu"
 
     def __init__(self, decreasing: bool = False, adaptive: bool = False,
-                 phase2="auto"):
-        super().__init__(adaptive, phase2)
+                 phase2="auto", degrade_after=None):
+        super().__init__(adaptive, phase2, degrade_after)
         self.decreasing = decreasing
         self._cpu_twin = FirstFitPolicy(decreasing=decreasing, mode="numpy")
 
@@ -427,7 +498,7 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
         placements, _ = self._call_kernel(
             first_fit_kernel, avail, dem, valid, strict=False,
             totals=self._staged_topology().totals,
-            phase2=self.phase2,
+            phase2=self.phase2, live=self._live_arg(ctx),
         )
         return self._unpad(placements, T, order)
 
@@ -457,8 +528,8 @@ class TpuBestFitPolicy(_DevicePolicyBase):
     name = "best_fit_tpu"
 
     def __init__(self, decreasing: bool = False, adaptive: bool = False,
-                 phase2="auto"):
-        super().__init__(adaptive, phase2)
+                 phase2="auto", degrade_after=None):
+        super().__init__(adaptive, phase2, degrade_after)
         self.decreasing = decreasing
         self._cpu_twin = BestFitPolicy(decreasing=decreasing, mode="numpy")
 
@@ -472,7 +543,7 @@ class TpuBestFitPolicy(_DevicePolicyBase):
         placements, _ = self._call_kernel(
             best_fit_kernel, avail, dem, valid,
             totals=self._staged_topology().totals,
-            phase2=self.phase2,
+            phase2=self.phase2, live=self._live_arg(ctx),
         )
         return self._unpad(placements, T, order)
 
@@ -517,8 +588,9 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         use_pallas: Optional[bool] = None,
         adaptive: bool = False,
         phase2="auto",
+        degrade_after: Optional[int] = None,
     ):
-        super().__init__(adaptive, phase2)
+        super().__init__(adaptive, phase2, degrade_after)
         assert bin_pack in ("first-fit", "best-fit")
         if realtime_bw and use_pallas:
             raise ValueError(
@@ -720,6 +792,11 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             kw["rt_bw_rows"] = self._stage(rows, self.dtype)
             kw["rt_bw_idx"] = self._stage(idx)
         kernel = cost_aware_pallas if use_pallas else cost_aware_kernel
+        live_arg = self._live_arg(ctx)
+        if live_arg is not None:
+            # Both kernel arms accept the quarantine mask; omit it when
+            # all-live so the existing compiled programs keep serving.
+            kw["live"] = live_arg
         topo = self._staged_topology()
         if not use_pallas:
             # Phase-1 demand-vs-total pre-filter (two-phase kernels only —

@@ -20,6 +20,15 @@ service), ``bench.py``'s ``serve_stream`` row (sustained decisions/sec
 for embedding.  The correctness bar is inherited from the batch layer:
 a served schedule is **bit-identical** to the same job set run through
 batch-mode ``ExperimentRun`` (``tests/test_serve.py``).
+
+Round 7 makes the layer *self-healing*: ``ServeDriver`` grows a session
+supervisor (``session_factory`` / ``stall_timeout`` / ``max_restarts``
+— crashed or stalled sessions are replaced on fresh batcher slots with
+their in-flight jobs requeued), sessions forward retry governance
+(``retry`` / ``breaker``, ``sched/retry.py``) into their schedulers and
+reap dead-lettered jobs as ``failed_jobs``, and device policies degrade
+to their CPU twins after repeated kernel failures rather than taking
+the service down (``sched/tpu.py`` ``degrade_after``).
 """
 
 from pivot_tpu.serve.admission import (

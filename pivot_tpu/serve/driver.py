@@ -31,6 +31,7 @@ is both the bench configuration and the deterministic one.
 from __future__ import annotations
 
 import math
+import queue as _pyqueue
 import threading
 from typing import Callable, Iterable, List, Optional
 
@@ -41,13 +42,30 @@ from pivot_tpu.utils import LogMixin
 
 from pivot_tpu.serve.admission import ADMITTED, BLOCKED, AdmissionQueue
 from pivot_tpu.serve.arrivals import JobArrival
-from pivot_tpu.serve.session import ServeSession
+from pivot_tpu.serve.session import STOP, ServeSession
 
 __all__ = ["ServeDriver", "closed_loop_source"]
 
 
 class ServeDriver(LogMixin):
-    """Always-on scheduling service over G concurrent sessions."""
+    """Always-on scheduling service over G concurrent sessions.
+
+    **Session supervision** (round 7): when constructed with a
+    ``session_factory``, the driver self-heals instead of fail-stopping —
+    a session that crashes (its thread raises) or stalls past
+    ``stall_timeout`` wall-seconds with live work is *abandoned*: its
+    in-flight jobs (un-injected inbox arrivals plus a clone of every
+    live, partially-run job) are requeued, a replacement session from the
+    factory takes its place on a FRESH :class:`DispatchBatcher` slot
+    (``respawn_client`` — the dead slot's state is never inherited), and
+    the service keeps serving.  Requeued jobs retain their admission
+    capacity across the restart: re-offering them past the backpressure
+    bound could shed an already-admitted job, which would break the
+    at-least-once contract the supervisor exists to provide; the
+    admission queue still governs them (their completion releases
+    capacity exactly once).  ``max_restarts`` bounds the recovery budget
+    — exhausting it falls back to the fail-stop path.
+    """
 
     #: Wall seconds between capacity re-checks while a ``block``-policy
     #: producer waits; each expiry also advances the release gate one
@@ -61,9 +79,14 @@ class ServeDriver(LogMixin):
         backpressure: str = "shed",
         flush_after: Optional[float] = None,
         slo: Optional[SloMeter] = None,
+        session_factory: Optional[Callable[[str], ServeSession]] = None,
+        max_restarts: int = 2,
+        stall_timeout: Optional[float] = None,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
+        if stall_timeout is not None and stall_timeout <= 0:
+            raise ValueError("stall_timeout must be positive (or None)")
         self.sessions = list(sessions)
         self.slo = slo or SloMeter()
         self.queue = AdmissionQueue(queue_depth, backpressure, self.slo)
@@ -76,10 +99,20 @@ class ServeDriver(LogMixin):
         self._errors: List[BaseException] = []
         self._rr = 0
         self._completion_hooks: List[Callable] = []
+        #: Supervisor state (inert when ``session_factory`` is None).
+        self._session_factory = session_factory
+        self._max_restarts = max_restarts
+        self.stall_timeout = stall_timeout
+        self._restarts = 0
+        #: (session, thread) for every session thread ever spawned.
+        self._threads: List = []
+        self._abandoned: List[ServeSession] = []
+        self._watch_stop = threading.Event()
         for slot, s in enumerate(self.sessions):
             s._driver = self
             s.slot = slot
             s.slo = self.slo  # one service-wide SLO meter
+            s.scheduler.slo = self.slo  # dead-letter sheds land here too
 
     # -- gate + coordination ----------------------------------------------
     def wait_released(self, session: ServeSession, t: float,
@@ -130,22 +163,162 @@ class ServeDriver(LogMixin):
         the closed-loop load generator's refill tap."""
         self._completion_hooks.append(fn)
 
-    def on_completed(self, session: ServeSession, app, sim_now: float):
+    def on_completed(self, session: ServeSession, app, sim_now: float,
+                     failed: bool = False):
+        if session.abandoned:
+            return  # a replaced session's stale thread reporting late
         with self._cv:
             self.queue.release()
-            self.slo.count("completed")
+            self.slo.count("failed_jobs" if failed else "completed")
             self._reoffer_spilled(after_sim=sim_now)
             self._cv.notify_all()
         for fn in self._completion_hooks:
             fn(session, app, sim_now)
 
     def on_session_error(self, session: ServeSession, exc) -> None:
+        if session.abandoned:
+            return  # already replaced by the supervisor; nothing to do
+        if (
+            self._session_factory is not None
+            and self._restarts < self._max_restarts
+            and not self._stop
+        ):
+            self.logger.error(
+                "session %s crashed (%s) — supervisor restarting",
+                session.label, exc,
+            )
+            self._restart_session(session, close_client=False)
+            return
         with self._cv:
             self._errors.append(exc)
             self._stop = True
             self._cv.notify_all()
-        for s in self.sessions:
+        for s in self.sessions + self._abandoned:
             s.shutdown()
+
+    # -- the session supervisor --------------------------------------------
+    def _restart_session(self, dead: ServeSession,
+                         close_client: bool) -> None:
+        """Replace a crashed/stalled session: requeue its in-flight jobs
+        into a factory-fresh session on a fresh batcher slot.  Called
+        from the dying session's own thread (crash path — its client
+        closes itself in the loop's ``finally``) or from the watchdog
+        (stall path — ``close_client=True``, the stalled thread may never
+        reach its finally).
+
+        Stall-path caveat (best effort by design): the wedged thread may
+        still be mid-``env.step`` while this reads ``dead._live`` and
+        clones its apps — Python threads cannot be paused, so a
+        truly-concurrent mutation can tear a clone.  The crash path (the
+        common case) has no such window: the dying thread is parked in
+        its own except handler while it runs this."""
+        with self._cv:
+            if self._stop or dead.abandoned:
+                return
+            dead.abandoned = True
+            self._restarts += 1
+            self._abandoned.append(dead)
+            self.slo.count("session_restarts")
+            idx = self.sessions.index(dead)
+            # In-flight work to recover: arrivals routed but never
+            # injected keep their original timestamps; live (possibly
+            # partially-run) jobs are resubmitted as clones — the dead
+            # session's world is gone, so their execution restarts, but
+            # their admission capacity is retained (see class docstring).
+            lost: List[JobArrival] = []
+            while True:
+                try:
+                    item = dead._inbox.get_nowait()
+                except _pyqueue.Empty:
+                    break
+                if item is not STOP:
+                    lost.append(item)
+            for app in dead._live:
+                if app.is_finished or getattr(app, "failed", False):
+                    # Terminated inside the dead session but never reaped
+                    # (the crash/stall hit between the state flip and
+                    # _reap_completions): settle its admission capacity
+                    # HERE — the abandoned thread's late reap is ignored
+                    # by on_completed, so skipping it would leak a queue
+                    # slot per restart.
+                    self.queue.release()
+                    self.slo.count(
+                        "completed" if app.is_finished else "failed_jobs"
+                    )
+                    continue
+                ts = getattr(app, "_serve_admit_ts", 0.0)
+                lost.append(JobArrival(ts, app.clone()))
+            self._reoffer_spilled()
+            new = self._session_factory(f"{dead.label}-r{self._restarts}")
+            new._driver = self
+            new.slot = dead.slot
+            new.slo = self.slo
+            new.scheduler.slo = self.slo
+            self.sessions[idx] = new
+            client = None
+            if self.batcher is not None:
+                client = self.batcher.respawn_client()
+                new.policy.enable_batching(client)
+            new._client = client
+            thread = threading.Thread(
+                target=new.loop, args=(client,),
+                name=f"serve-{new.label}", daemon=True,
+            )
+            self._threads.append((new, thread))
+            thread.start()
+            # Requeue: submission times never before the release
+            # frontier's next tick (a readmission cannot land in the new
+            # session's past).
+            floor_t = (
+                self._released if self._released != float("inf") else None
+            )
+            for arr in lost:
+                ts = (
+                    arr.ts if floor_t is None
+                    else max(arr.ts, self._next_tick(floor_t))
+                )
+                self.slo.count("requeued")
+                new.offer(JobArrival(ts, arr.app))
+            self._cv.notify_all()
+        # Unblock the dead session outside the lock: wake it if parked on
+        # its inbox (it sees ``abandoned`` and exits), and reclaim its
+        # batcher slot on the stall path.
+        dead.shutdown()
+        if close_client and getattr(dead, "_client", None) is not None:
+            dead._client.close()
+
+    def _watchdog(self) -> None:
+        """Stall detector: a session with live work whose event loop has
+        not stepped for ``stall_timeout`` wall-seconds is declared dead
+        and replaced (its wedged thread is abandoned — Python threads
+        cannot be killed — and ignored when it eventually wakes)."""
+        poll = self.stall_timeout / 4.0
+        while not self._watch_stop.wait(poll):
+            if self._stop:
+                return
+            now = time.perf_counter()
+            for s in list(self.sessions):
+                if s.abandoned or s.error is not None or not s._live:
+                    continue
+                if now - s.last_progress <= self.stall_timeout:
+                    continue
+                if (
+                    self._session_factory is None
+                    or self._restarts >= self._max_restarts
+                ):
+                    self.on_session_error(
+                        s,
+                        RuntimeError(
+                            f"session {s.label} stalled "
+                            f"> {self.stall_timeout}s with live work"
+                        ),
+                    )
+                    return
+                self.logger.error(
+                    "session %s stalled > %.3fs — supervisor restarting",
+                    s.label, self.stall_timeout,
+                )
+                self._restart_session(s, close_client=True)
 
     def _reoffer_spilled(self, after_sim: Optional[float] = None) -> None:
         """Drain the spill buffer into freed capacity (cv held).  A
@@ -258,15 +431,25 @@ class ServeDriver(LogMixin):
             clients = [self.batcher.client() for _ in self.sessions]
             for s, c in zip(self.sessions, clients):
                 s.policy.enable_batching(c)
-        threads = [
-            threading.Thread(
-                target=s.loop, args=(c,),
-                name=f"serve-{s.label}", daemon=True,
+        for s, c in zip(self.sessions, clients):
+            s._client = c
+            self._threads.append(
+                (
+                    s,
+                    threading.Thread(
+                        target=s.loop, args=(c,),
+                        name=f"serve-{s.label}", daemon=True,
+                    ),
+                )
             )
-            for s, c in zip(self.sessions, clients)
-        ]
-        for t in threads:
+        for _s, t in list(self._threads):
             t.start()
+        watchdog = None
+        if self.stall_timeout is not None:
+            watchdog = threading.Thread(
+                target=self._watchdog, name="serve-watchdog", daemon=True,
+            )
+            watchdog.start()
         producer = threading.Thread(
             target=self._produce, args=(arrivals, pace),
             name="serve-producer", daemon=True,
@@ -274,9 +457,26 @@ class ServeDriver(LogMixin):
         producer.start()
         if self.batcher is not None:
             self.batcher.serve()
-        for t in threads:
-            t.join()
+        # Supervisor restarts append replacement threads while we join —
+        # loop until every NON-ABANDONED thread has exited.  Abandoned
+        # sessions' threads are excluded: a permanently wedged thread is
+        # exactly what the stall watchdog replaced (it cannot be killed,
+        # only out-lived — daemon threads die with the process), and
+        # waiting on it would hang the service shutdown the restart just
+        # saved.
+        while True:
+            pending = [
+                t for s, t in self._threads
+                if t.is_alive() and not s.abandoned
+            ]
+            if not pending:
+                break
+            for t in pending:
+                t.join(timeout=0.5)
         producer.join()
+        self._watch_stop.set()
+        if watchdog is not None:
+            watchdog.join()
         errors = self._errors + [
             s.error for s in self.sessions if s.error is not None
         ]
@@ -290,6 +490,7 @@ class ServeDriver(LogMixin):
             "backpressure": self.queue.policy,
             "queue_depth": self.queue.depth,
             "flush_after_s": self.flush_after,
+            "restarts": self._restarts,
             "slo": self.slo.snapshot(),
             "batcher": dict(self.batcher.stats) if self.batcher else None,
             "per_session": [s.summary() for s in self.sessions],

@@ -72,6 +72,8 @@ class ServeSession(LogMixin):
         seed: Optional[int] = None,
         interval: float = 5.0,
         slo: Optional[SloMeter] = None,
+        retry=None,
+        breaker=None,
     ):
         self.label = label
         self.policy = policy
@@ -80,11 +82,20 @@ class ServeSession(LogMixin):
         self.slo = slo or SloMeter()
         self.error: Optional[BaseException] = None
         self.completed: List = []
+        self.failed: List = []  # dead-lettered (retry-governed) apps
         self._inbox: "queue.Queue" = queue.Queue()
         self._live: List = []  # injected, not yet finished apps
         self._injected: List = []  # every app ever injected, in order
         self._driver = None  # attached by ServeDriver
+        self._client = None  # this session's BatchClient (driver-owned)
         self.slot = -1
+        #: Supervisor liveness: wall clock of the last event-kernel step
+        #: (or inbox wait) — the stall watchdog's heartbeat.
+        self.last_progress = time.perf_counter()
+        #: Set by the supervisor when this session is declared dead and
+        #: replaced; an abandoned session's late callbacks are ignored.
+        self.abandoned = False
+        self._kernel_failures_seen = 0
 
         # Mirror ExperimentRun.run()'s construction exactly — the parity
         # contract depends on the two modes building identical worlds.
@@ -98,6 +109,9 @@ class ServeSession(LogMixin):
             interval=interval,
             seed=seed,
             meter=self.meter,
+            retry=retry,
+            breaker=breaker,
+            slo=self.slo,
         )
         self.cluster.start()
         self.scheduler.start()
@@ -124,6 +138,17 @@ class ServeSession(LogMixin):
             # service-wide SLO meter after construction.
             self.slo.record_decision(dt, int(arr.shape[0]),
                                      int((arr >= 0).sum()))
+            # Degradation telemetry (device policies only): surface
+            # kernel failures absorbed by the CPU-twin fallback and
+            # ticks served degraded (``sched/tpu.py`` degrade_after).
+            failures = getattr(self.policy, "kernel_failures", 0)
+            if failures > self._kernel_failures_seen:
+                self.slo.count(
+                    "kernel_failures", failures - self._kernel_failures_seen
+                )
+                self._kernel_failures_seen = failures
+            if getattr(self.policy, "degraded", False):
+                self.slo.count("degraded_decisions")
             return out
 
         self.policy.place = timed_place
@@ -144,9 +169,10 @@ class ServeSession(LogMixin):
                 if client is not None:
                     client.set_idle(True)
                 item = self._inbox.get()
+                self.last_progress = time.perf_counter()
                 if client is not None:
                     client.set_idle(False)
-                if item is STOP:
+                if item is STOP or self.abandoned:
                     break
                 self._inject(item)
                 self._drain(client)
@@ -195,6 +221,8 @@ class ServeSession(LogMixin):
         env = self.env
         driver = self._driver
         while self._work_pending():
+            if self.abandoned:
+                return  # supervisor replaced this session mid-drain
             self._poll_inbox()
             t_next = env.peek()
             if t_next == float("inf"):
@@ -205,6 +233,7 @@ class ServeSession(LogMixin):
                 return  # shutdown requested mid-drain
             self._poll_inbox()  # arrivals routed while gated
             env.step()
+            self.last_progress = time.perf_counter()
             if self.scheduler._n_unfinished != self._last_unfinished:
                 self._last_unfinished = self.scheduler._n_unfinished
                 self._reap_completions()
@@ -216,16 +245,27 @@ class ServeSession(LogMixin):
         self._reap_completions()
 
     def _reap_completions(self) -> None:
-        done = [a for a in self._live if a.is_finished]
+        done = [
+            a for a in self._live
+            if a.is_finished or getattr(a, "failed", False)
+        ]
         if not done:
             return
-        self._live = [a for a in self._live if not a.is_finished]
+        self._live = [a for a in self._live if a not in done]
         for app in done:
-            self.completed.append(app)
-            admit_ts = getattr(app, "_serve_admit_ts", app.start_time)
-            self.slo.record_sojourn(max(app.end_time - admit_ts, 0.0))
+            if app.is_finished:
+                self.completed.append(app)
+                admit_ts = getattr(app, "_serve_admit_ts", app.start_time)
+                self.slo.record_sojourn(max(app.end_time - admit_ts, 0.0))
+            else:
+                # Dead-lettered by retry governance: the job terminates
+                # as failed — its admission capacity is still released
+                # (the service must not wedge on a lost job).
+                self.failed.append(app)
             if self._driver is not None:
-                self._driver.on_completed(self, app, self.env.now)
+                self._driver.on_completed(
+                    self, app, self.env.now, failed=not app.is_finished
+                )
 
     # -- reporting ---------------------------------------------------------
     def summary(self) -> dict:
@@ -243,4 +283,10 @@ class ServeSession(LogMixin):
         s["avg_runtime"] = (
             sum(runtimes) / len(runtimes) if runtimes else 0.0
         )
+        s["n_failed"] = len(self.failed)
+        s["degraded"] = bool(getattr(self.policy, "degraded", False))
+        s["kernel_failures"] = int(
+            getattr(self.policy, "kernel_failures", 0)
+        )
+        s["dead_letters"] = len(self.scheduler.dead_letters)
         return s

@@ -41,6 +41,11 @@ class TaskState(enum.Enum):
     SUBMITTED = "submitted"
     RUNNING = "running"
     FINISHED = "finished"
+    #: Terminal dead-letter state (retry governance, ``sched/retry.py``):
+    #: the task exhausted its retry budget and will never be resubmitted.
+    #: Not FINISHED — a dead task never counts toward group completion,
+    #: so its application cannot silently "finish" around a lost task.
+    DEAD = "dead"
 
 
 class Task:
@@ -102,6 +107,10 @@ class Task:
     def is_finished(self) -> bool:
         return self.state == TaskState.FINISHED
 
+    @property
+    def is_dead(self) -> bool:
+        return self.state == TaskState.DEAD
+
     def _leave_finished(self) -> None:
         if self.state == TaskState.FINISHED:
             self.group._n_finished -= 1
@@ -122,6 +131,11 @@ class Task:
         if self.state != TaskState.FINISHED:
             self.group._n_finished += 1
         self.state = TaskState.FINISHED
+
+    def set_dead(self) -> None:
+        """Dead-letter terminal transition (see ``TaskState.DEAD``)."""
+        self._leave_finished()
+        self.state = TaskState.DEAD
 
     def __repr__(self) -> str:
         return f"Task({self.id}@{self.placement})"
@@ -236,6 +250,10 @@ class Application(LogMixin):
         self._check_acyclic()
         self.start_time: float = 0.0
         self.end_time: float = 0.0
+        #: Set by retry governance when a task of this app is
+        #: dead-lettered: the DAG can never finish, the scheduler stops
+        #: tracking it, and the serving layer reaps it as a failed job.
+        self.failed: bool = False
 
     # -- structure -------------------------------------------------------
     def _check_acyclic(self) -> None:

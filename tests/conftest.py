@@ -77,6 +77,12 @@ _SLOW_TESTS = {
         "test_rollout_segment_accepts_donated_carry",
         "test_pipelined_segments_match_monolithic",
     ],
+    "test_chaos.py": [
+        # Quick twins in tier 1: test_chaos_soak_quick,
+        # test_chaos_replay_determinism.  The full soak also carries the
+        # ``chaos`` marker (applied in the test file) for -m chaos runs.
+        "test_chaos_soak_full",
+    ],
     "test_checkpoint.py": [
         "test_checkpointed_policy_arm_matches_plain",
         "test_chunked_first_chunk_matches_plain",
@@ -159,9 +165,11 @@ _SLOW_TESTS = {
     ],
     "test_two_phase.py": [
         # Quick twins in tier 1: test_two_phase_parity_small,
-        # test_two_phase_parity_contended_small.
+        # test_two_phase_parity_contended_small,
+        # test_quarantine_mask_parity_small (+ contended twin).
         "test_two_phase_parity_sweep_full",
         "test_two_phase_parity_contended_full",
+        "test_quarantine_mask_parity_full",
     ],
     "test_trace.py": ["test_device_profile_captures"],
     "test_watcher.py": [

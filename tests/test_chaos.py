@@ -1,0 +1,568 @@
+"""Chaos engine + resilient scheduling pipeline (round 7).
+
+Covers the four fault classes (correlated zone outages, spot preemption
+with a drain lead, transient stragglers, region-pair partitions), retry
+governance (budgets, deterministic backoff jitter, dead-lettering, the
+host circuit breaker and its quarantine mask), graceful device-kernel
+degradation, and the conservation/billing invariant audit — plus the two
+acceptance regressions: the seeded chaos soak (quick twin here, full
+soak slow-marked under the ``chaos`` marker) and ChaosSchedule replay
+determinism (identical fault log and meter snapshot through a JSON
+round trip).
+"""
+
+import numpy as np
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.audit import audit_conservation, audit_run
+from pivot_tpu.infra.faults import ChaosEvent, ChaosSchedule, FaultInjector
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.sched import GlobalScheduler, HostCircuitBreaker, RetryPolicy
+from pivot_tpu.sched.policies import FirstFitPolicy
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.workload import Application, TaskGroup
+
+INTERVAL = 5
+
+
+@pytest.fixture(scope="module")
+def meta():
+    return ResourceMetadata(seed=0)
+
+
+def build(meta, host_shapes, seed=0, retry=None, breaker=None, policy=None):
+    env = Environment()
+    meter = Meter(env, meta)
+    zones = meta.zones
+    hosts = [
+        Host(env, *shape, locality=zones[i % len(zones)], meter=meter)
+        for i, shape in enumerate(host_shapes)
+    ]
+    storage = [Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)]
+    cluster = Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, meter=meter,
+        route_mode="meta", seed=seed,
+    )
+    scheduler = GlobalScheduler(
+        env, cluster, policy or FirstFitPolicy(), interval=INTERVAL,
+        seed=seed, meter=meter, retry=retry, breaker=breaker,
+    )
+    cluster.start()
+    scheduler.start()
+    return env, cluster, scheduler
+
+
+# -- ChaosSchedule -----------------------------------------------------------
+
+
+def test_chaos_schedule_roundtrip_and_diff(meta):
+    env, cluster, _ = build(meta, [(4, 4096, 10, 0)] * 8)
+    s = ChaosSchedule.generate(
+        cluster, seed=3, horizon=500.0, n_domain_outages=1,
+        n_preemptions=2, n_stragglers=1, n_partitions=1,
+    )
+    assert s.counts() == {
+        "domain_outage": 1, "preemption": 2, "straggler": 1, "partition": 1,
+    }
+    s2 = ChaosSchedule.loads(s.dumps())
+    assert s2 == s and s.diff(s2) == []
+    # Same (cluster, seed, params) => identical plan; different seed diffs.
+    s3 = ChaosSchedule.generate(
+        cluster, seed=3, horizon=500.0, n_domain_outages=1,
+        n_preemptions=2, n_stragglers=1, n_partitions=1,
+    )
+    assert s3 == s
+    s4 = ChaosSchedule.generate(
+        cluster, seed=4, horizon=500.0, n_domain_outages=1,
+        n_preemptions=2, n_stragglers=1, n_partitions=1,
+    )
+    assert s4 != s and s.diff(s4)
+
+
+def test_chaos_event_validation():
+    with pytest.raises(ValueError, match="kind"):
+        ChaosEvent("bogus", 1.0, "host-0")
+    with pytest.raises(ValueError, match="time"):
+        ChaosEvent("host_outage", -1.0, "host-0")
+
+
+# -- correlated domain outages ----------------------------------------------
+
+
+def test_zone_outage_takes_down_domain(meta):
+    """One draw fails every host sharing the zone; they recover together;
+    hosts in other zones never blink."""
+    env, cluster, sched = build(meta, [(4, 4096, 10, 0)] * 6)
+    zone = repr(cluster.hosts[0].locality)
+    members = [h for h in cluster.hosts if repr(h.locality) == zone]
+    others = [h for h in cluster.hosts if repr(h.locality) != zone]
+    inj = FaultInjector(cluster, seed=0)
+    ids = inj.fail_domain(zone, at=10.0, duration=20.0)
+    assert set(ids) == {h.id for h in members}
+    env.run(until=15.0)
+    assert all(not h.up for h in members)
+    assert all(h.up for h in others)
+    env.run(until=40.0)
+    assert all(h.up for h in members)
+    assert inj.log[0] == (10.0, zone, "domain_outage")
+
+
+def test_fail_domain_validation(meta):
+    env, cluster, _ = build(meta, [(4, 4096, 10, 0)])
+    inj = FaultInjector(cluster, seed=0)
+    with pytest.raises(ValueError, match="failure domain"):
+        inj.fail_domain("aws", at=1.0)
+    with pytest.raises(ValueError, match="no hosts"):
+        inj.fail_domain("gcp/nowhere-9/z", at=1.0)
+
+
+# -- spot preemption with drain lead ----------------------------------------
+
+
+def test_preemption_drains_then_aborts(meta):
+    """During the warning lead the host takes no NEW placements (live
+    mask) but finishes short residents; the abort fires at warn+lead."""
+    env, cluster, sched = build(meta, [(2, 2048, 10, 0)] * 2)
+    h0, h1 = cluster.hosts
+    inj = FaultInjector(cluster, seed=0)
+    # Short task placed at the t=5 tick on h0 finishes at 8 — inside the
+    # lead window, so it drains out instead of aborting.
+    a_short = Application("s", [TaskGroup("g", cpus=1, mem=256, runtime=3)])
+    sched.submit(a_short)
+    inj.preempt_host(h0.id, at=6.0, lead=10.0, outage=50.0)
+    # Submitted during the drain window: must route around h0.
+    a_late = Application("l", [TaskGroup("g", cpus=1, mem=256, runtime=3)])
+    env.schedule_callback_at(6.5, lambda: sched.submit(a_late))
+    sched.stop()
+    env.run()
+    assert a_short.is_finished
+    assert a_short.groups[0].tasks[0].placement == h0.id  # drained out
+    assert a_late.is_finished
+    assert a_late.groups[0].tasks[0].placement == h1.id  # drain exclusion
+    events = [e for _, hid, e in inj.log if hid == h0.id]
+    assert events == ["preempt_warning", "failed", "recovered"]
+    assert h0.up and not h0.draining  # recover() clears the drain flag
+
+
+def test_preemption_validation(meta):
+    env, cluster, _ = build(meta, [(4, 4096, 10, 0)])
+    inj = FaultInjector(cluster, seed=0)
+    with pytest.raises(KeyError):
+        inj.preempt_host("nope", at=0.0, lead=1.0)
+    with pytest.raises(ValueError, match="lead"):
+        inj.preempt_host(cluster.hosts[0].id, at=0.0, lead=-1.0)
+
+
+# -- transient stragglers ----------------------------------------------------
+
+
+def test_straggler_stretches_started_compute(meta):
+    """Compute STARTED inside the window runs factor× slower; the window's
+    end restores full speed for later starts."""
+    env, cluster, sched = build(meta, [(1, 1024, 10, 0)])
+    inj = FaultInjector(cluster, seed=0)
+    inj.slow_host(cluster.hosts[0].id, at=0.0, duration=100.0, factor=4.0)
+    app = Application("st", [TaskGroup("g", cpus=1, mem=256, runtime=10)])
+    sched.submit(app)
+    sched.stop()
+    env.run()
+    # Placed at the t=5 tick, stretched 10 -> 40.
+    assert app.end_time == pytest.approx(45.0)
+    assert [e for _, _, e in inj.log] == ["straggler_start", "straggler_end"]
+    assert cluster.hosts[0].slowdown == 1.0
+
+
+def test_straggler_validation(meta):
+    env, cluster, _ = build(meta, [(4, 4096, 10, 0)])
+    inj = FaultInjector(cluster, seed=0)
+    with pytest.raises(ValueError, match="factor"):
+        inj.slow_host(cluster.hosts[0].id, at=0.0, duration=10.0, factor=1.0)
+    with pytest.raises(ValueError, match="duration"):
+        inj.slow_host(cluster.hosts[0].id, at=0.0, duration=0.0, factor=2.0)
+
+
+# -- region-pair network partitions -----------------------------------------
+
+
+def _hosts_in_two_regions(cluster):
+    by_region = {}
+    for h in cluster.hosts:
+        by_region.setdefault(
+            f"{h.locality.cloud}/{h.locality.region}", []
+        ).append(h)
+    regions = sorted(r for r, hs in by_region.items() if hs)
+    assert len(regions) >= 2
+    return regions[0], regions[1], by_region
+
+
+def test_partition_parks_transfers_until_heal(meta):
+    env, cluster, sched = build(meta, [(4, 4096, 10, 0)] * 8)
+    sched.stop()  # no workload: the tick loop must not keep run() alive
+    ra, rb, by_region = _hosts_in_two_regions(cluster)
+    src, dst = by_region[ra][0], by_region[rb][0]
+    route = cluster.get_route(src.id, dst.id)
+    done = {"t": None}
+    evt = route.send(2 * 1000.0)  # two chunks
+    evt.callbacks.append(lambda _e: done.update(t=env.now))
+    unaffected = cluster.get_route(by_region[ra][0].id, by_region[ra][0].id)
+
+    inj = FaultInjector(cluster, seed=0)
+    inj.partition_regions(ra, rb, at=0.0, duration=500.0)
+    env.run(until=400.0)
+    assert done["t"] is None, "transfer completed across an active partition"
+    assert route.suspended and not unaffected.suspended
+    env.run()
+    assert done["t"] is not None and done["t"] >= 500.0  # resumed at heal
+    assert not route.suspended
+    assert [(t, e) for t, _x, e in inj.log] == [
+        (0.0, "partition_start"), (500.0, "partition_end"),
+    ]
+
+
+def test_partition_catches_lazy_routes(meta):
+    """A route materialized DURING the partition starts suspended."""
+    env, cluster, _ = build(meta, [(4, 4096, 10, 0)] * 8)
+    ra, rb, by_region = _hosts_in_two_regions(cluster)
+    inj = FaultInjector(cluster, seed=0)
+    inj.partition_regions(ra, rb, at=0.0, duration=100.0)
+    env.run(until=10.0)
+    late = cluster.get_route(by_region[rb][0].id, by_region[ra][0].id)
+    assert late.suspended
+    intra = cluster.get_route(by_region[ra][0].id, by_region[ra][0].id)
+    assert not intra.suspended
+    env.run(until=150.0)
+    assert not late.suspended
+
+
+def test_partition_validation(meta):
+    env, cluster, _ = build(meta, [(4, 4096, 10, 0)] * 8)
+    inj = FaultInjector(cluster, seed=0)
+    ra, rb, _ = _hosts_in_two_regions(cluster)
+    with pytest.raises(ValueError, match="region"):
+        inj.partition_regions("aws/us-east-1/a", rb, at=0.0, duration=10.0)
+    with pytest.raises(ValueError, match="distinct"):
+        inj.partition_regions(ra, ra, at=0.0, duration=10.0)
+    with pytest.raises(ValueError, match="duration"):
+        inj.partition_regions(ra, rb, at=0.0, duration=0.0)
+
+
+# -- retry governance --------------------------------------------------------
+
+
+def test_retry_backoff_deterministic_jitter():
+    rp = RetryPolicy(max_retries=5, base=2.0, factor=2.0, cap=30.0,
+                     jitter=0.2, seed=9)
+    d1 = [rp.backoff(a, "task/0") for a in (1, 2, 3, 4, 5, 6)]
+    d2 = [rp.backoff(a, "task/0") for a in (1, 2, 3, 4, 5, 6)]
+    assert d1 == d2  # deterministic
+    assert d1 != [rp.backoff(a, "task/1") for a in (1, 2, 3, 4, 5, 6)]
+    # Exponential growth within jitter bands, capped.
+    for a, d in enumerate(d1, start=1):
+        nominal = min(2.0 * 2.0 ** (a - 1), 30.0)
+        assert 0.8 * nominal <= d <= 1.2 * nominal
+    assert not rp.exhausted(5) and rp.exhausted(6)
+    assert RetryPolicy(max_retries=None).exhausted(10 ** 6) is False
+    with pytest.raises(ValueError):
+        RetryPolicy(max_retries=-1)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.5)
+
+
+def test_retry_budget_dead_letters_and_fails_app(meta):
+    """Failure max_retries+1 dead-letters the task, fails the app, frees
+    the scheduler (the sim terminates), and the conservation audit
+    reconciles every terminal state."""
+    env, cluster, sched = build(
+        meta, [(1, 1024, 10, 0)],
+        retry=RetryPolicy(max_retries=2, base=0.0),
+    )
+    host = cluster.hosts[0]
+    inj = FaultInjector(cluster, seed=0)
+    # Crash mid-compute on every attempt: placements at the 5/10/15 ticks.
+    for t in (7.0, 12.0, 17.0):
+        inj.fail_host(host.id, at=t, duration=1.0)
+    app = Application("d", [TaskGroup("g", cpus=1, mem=512, runtime=10)])
+    sched.submit(app)
+    sched.stop()
+    env.run()  # must terminate — the failed app releases the loop
+
+    assert app.failed and not app.is_finished
+    task = app.groups[0].tasks[0]
+    assert task.is_dead
+    assert len(sched.dead_letters) == 1
+    entry = sched.dead_letters[0]
+    assert entry.task_id == task.id
+    assert entry.reason == "retry_budget"
+    assert entry.attempts == 3  # budget + 1, the acceptance arithmetic
+    assert entry.at == pytest.approx(17.0)
+    assert audit_conservation(sched, [app]) == []
+
+
+def test_retry_backoff_delays_resubmission(meta):
+    """base > 0 holds the retry out of the next tick: with a 12 s backoff
+    the resubmission misses the t=5 and t=10 ticks and lands at t=15."""
+    env, cluster, sched = build(
+        meta, [(1, 1024, 10, 0)] * 2,
+        retry=RetryPolicy(max_retries=5, base=12.0, jitter=0.0),
+    )
+    inj = FaultInjector(cluster, seed=0)
+    inj.fail_host(cluster.hosts[0].id, at=7.0)  # permanent
+    app = Application("b", [TaskGroup("g", cpus=1, mem=512, runtime=10)])
+    sched.submit(app)
+    sched.stop()
+    env.run()
+    assert app.is_finished
+    # Placed at 5, fail at 7, backoff 12 -> resubmit at 19, placed at
+    # the t=20 tick on the surviving host.
+    assert app.end_time == pytest.approx(30.0)
+
+
+def test_circuit_breaker_quarantines_flaky_host(meta):
+    """K consecutive failures trip the breaker: the flaky host is masked
+    out of placement for the cooldown, and the task completes elsewhere."""
+    env, cluster, sched = build(
+        meta, [(4, 4096, 10, 0)] * 2,
+        retry=RetryPolicy(max_retries=10, base=0.0),
+        breaker=HostCircuitBreaker(k=2, cooldown=100.0),
+    )
+    h0, h1 = cluster.hosts
+    inj = FaultInjector(cluster, seed=0)
+    # Two crash/recover cycles abort two consecutive attempts on h0
+    # (placements land on the 5/10 ticks).
+    inj.fail_host(h0.id, at=7.0, duration=1.0)
+    inj.fail_host(h0.id, at=12.0, duration=1.0)
+    app = Application("q", [TaskGroup("g", cpus=1, mem=512, runtime=10)])
+    sched.submit(app)
+    sched.stop()
+    env.run()
+    assert app.is_finished
+    assert app.groups[0].tasks[0].placement == h1.id
+    assert [t[1] for t in sched.breaker.trips] == [h0.id]
+    assert sched.breaker.trips[0][0] == pytest.approx(12.0)
+    assert sched.placement_violations == []
+    assert audit_conservation(sched, [app]) == []
+
+
+def test_breaker_streak_resets_on_success():
+    b = HostCircuitBreaker(k=3, cooldown=10.0)
+    assert not b.record_failure("h", 0.0)
+    assert not b.record_failure("h", 1.0)
+    b.record_success("h")  # streak back to 0
+    assert not b.record_failure("h", 2.0)
+    assert not b.record_failure("h", 3.0)
+    assert b.record_failure("h", 4.0)  # third consecutive: trips
+    assert b.is_quarantined("h", 5.0)
+    assert not b.is_quarantined("g", 5.0)
+    assert not b.is_quarantined("h", 14.0)  # cooldown expired
+    assert b.n_quarantined == 0  # expiry check pruned the record
+
+
+# -- chaos soak + replay determinism (the acceptance regressions) ------------
+
+
+def _soak_world(meta, seed=11):
+    reset_ids()
+    env, cluster, sched = build(
+        meta, [(4, 4096, 20, 0)] * 10, seed=seed,
+        retry=RetryPolicy(max_retries=20, base=1.0, seed=seed),
+        breaker=HostCircuitBreaker(k=3, cooldown=60.0),
+    )
+    rng = np.random.default_rng(seed)
+    apps = []
+    for i in range(5):
+        apps.append(
+            Application(
+                f"soak-{i}",
+                [
+                    TaskGroup(
+                        "src", cpus=1, mem=256,
+                        runtime=float(rng.uniform(15, 40)),
+                        output_size=float(rng.uniform(100, 400)),
+                        instances=int(rng.integers(1, 3)),
+                    ),
+                    TaskGroup(
+                        "dst", cpus=1, mem=256,
+                        runtime=float(rng.uniform(15, 40)),
+                        dependencies=["src"],
+                    ),
+                ],
+            )
+        )
+    return env, cluster, sched, apps
+
+
+def _soak_schedule(cluster, seed=11):
+    return ChaosSchedule.generate(
+        cluster, seed=seed, horizon=250.0,
+        n_domain_outages=1, domain_level="zone", outage_duration=60.0,
+        n_preemptions=2, preempt_lead=8.0, preempt_outage=80.0,
+        n_stragglers=1, straggler_factor=3.0, straggler_duration=50.0,
+        n_partitions=1, partition_duration=40.0,
+    )
+
+
+def test_chaos_soak_quick(meta):
+    """Tier-1 acceptance twin: a seeded schedule mixing a zone outage,
+    spot preemptions, a straggler, and a partition — the run drains with
+    ZERO lost tasks (budget is generous, so no dead letters either) and
+    the full invariant audit (cluster + conservation + billing) passes."""
+    env, cluster, sched, apps = _soak_world(meta)
+    schedule = _soak_schedule(cluster)
+    assert set(schedule.counts()) == {
+        "domain_outage", "preemption", "straggler", "partition",
+    }
+    inj = FaultInjector(cluster, seed=0).apply_schedule(schedule)
+    for app in apps:
+        sched.submit(app)
+    sched.stop()
+    env.run()
+    assert all(a.is_finished for a in apps), "lost tasks under chaos"
+    assert sched.dead_letters == []
+    assert inj.log, "chaos schedule injected nothing"
+    audit_run(sched, apps, context="quick chaos soak")
+
+
+def test_chaos_replay_determinism(meta):
+    """Acceptance: replaying a serialized ChaosSchedule on an identical
+    seeded world reproduces the identical fault log AND the identical
+    final meter snapshot (wall clock excluded — the one legitimately
+    non-deterministic field)."""
+
+    def one_run(schedule_json):
+        env, cluster, sched, apps = _soak_world(meta)
+        schedule = (
+            _soak_schedule(cluster) if schedule_json is None
+            else ChaosSchedule.loads(schedule_json)
+        )
+        inj = FaultInjector(cluster, seed=0).apply_schedule(schedule)
+        for app in apps:
+            sched.submit(app)
+        sched.stop()
+        env.run()
+        summary = sched.meter.summary()
+        summary.pop("wall_clock")
+        return schedule.dumps(), list(inj.log), summary
+
+    text, log_a, sum_a = one_run(None)
+    _, log_b, sum_b = one_run(text)  # through the JSON round trip
+    assert log_a == log_b
+    assert sum_a == sum_b
+
+
+@pytest.mark.chaos
+def test_chaos_soak_full(meta):
+    """Slow lane (``chaos`` marker): a denser schedule over a larger
+    cluster and workload, plus uncorrelated random crashes on top —
+    every app completes or dead-letters cleanly, and the audit holds."""
+    reset_ids()
+    env, cluster, sched = build(
+        meta, [(8, 8192, 40, 0)] * 24, seed=5,
+        retry=RetryPolicy(max_retries=30, base=1.0, seed=5),
+        breaker=HostCircuitBreaker(k=3, cooldown=90.0),
+    )
+    rng = np.random.default_rng(5)
+    apps = [
+        Application(
+            f"soakfull-{i}",
+            [
+                TaskGroup(
+                    "a", cpus=2, mem=512, runtime=float(rng.uniform(20, 80)),
+                    output_size=float(rng.uniform(200, 800)),
+                    instances=int(rng.integers(1, 5)),
+                ),
+                TaskGroup(
+                    "b", cpus=1, mem=256, runtime=float(rng.uniform(20, 60)),
+                    dependencies=["a"], instances=int(rng.integers(1, 3)),
+                ),
+                TaskGroup(
+                    "c", cpus=1, mem=256, runtime=float(rng.uniform(10, 40)),
+                    dependencies=["b"],
+                ),
+            ],
+        )
+        for i in range(12)
+    ]
+    schedule = ChaosSchedule.generate(
+        cluster, seed=5, horizon=600.0,
+        n_domain_outages=2, domain_level="zone", outage_duration=90.0,
+        n_preemptions=5, preempt_lead=10.0, preempt_outage=120.0,
+        n_stragglers=3, straggler_factor=4.0, straggler_duration=80.0,
+        n_partitions=2, partition_duration=60.0,
+    )
+    inj = FaultInjector(cluster, seed=1)
+    inj.apply_schedule(schedule)
+    inj.random_host_failures(6, horizon=600.0, mttr=60.0)
+    for app in apps:
+        sched.submit(app)
+    sched.stop()
+    env.run()
+    for app in apps:
+        assert app.is_finished or app.failed
+    audit_run(sched, apps, context="full chaos soak")
+    assert len(inj.log) >= len(schedule)
+
+
+# -- graceful degradation ----------------------------------------------------
+
+
+def test_device_kernel_degradation_to_cpu_twin(meta):
+    """After ``degrade_after`` consecutive device-kernel failures the
+    policy serves every tick from its CPU twin — placements stay valid
+    (the twin is the parity oracle), the run completes, and the failure
+    counters are visible."""
+    from pivot_tpu.sched.tpu import TpuFirstFitPolicy
+
+    policy = TpuFirstFitPolicy(adaptive=False, degrade_after=2)
+    boom = {"left": 3}
+    orig = policy._device_place
+
+    def flaky(ctx):
+        if boom["left"] > 0:
+            boom["left"] -= 1
+            raise RuntimeError("injected kernel fault")
+        return orig(ctx)
+
+    policy._device_place = flaky
+    env, cluster, sched = build(meta, [(4, 4096, 10, 0)] * 2, policy=policy)
+    # Three chained groups => three separate placement ticks: fail, fail
+    # (degrade), then the degraded path (twin, no device call at all).
+    app = Application(
+        "deg",
+        [
+            TaskGroup("g1", cpus=1, mem=256, runtime=10),
+            TaskGroup("g2", cpus=1, mem=256, runtime=10,
+                      dependencies=["g1"]),
+            TaskGroup("g3", cpus=1, mem=256, runtime=10,
+                      dependencies=["g2"]),
+        ],
+    )
+    sched.submit(app)
+    sched.stop()
+    env.run()
+    assert app.is_finished
+    assert policy.degraded
+    assert policy.kernel_failures == 2  # degraded at the 2nd consecutive
+    assert boom["left"] == 1  # twin serves everything after degradation
+    for group in app.groups:
+        assert all(t.placement is not None for t in group.tasks)
+
+
+def test_degradation_disabled_raises(meta):
+    """degrade_after=None (the batch default) keeps kernel faults fatal."""
+    from pivot_tpu.sched.tpu import TpuFirstFitPolicy
+
+    policy = TpuFirstFitPolicy(adaptive=False)
+
+    def flaky(ctx):
+        raise RuntimeError("injected kernel fault")
+
+    policy._device_place = flaky
+    env, cluster, sched = build(meta, [(4, 4096, 10, 0)], policy=policy)
+    app = Application("f", [TaskGroup("g", cpus=1, mem=256, runtime=5)])
+    sched.submit(app)
+    sched.stop()
+    with pytest.raises(RuntimeError, match="injected kernel fault"):
+        env.run()

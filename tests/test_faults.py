@@ -261,3 +261,57 @@ def test_zero_demand_task_never_lands_on_down_host(meta):
     env.run()  # must terminate
     assert app.is_finished
     assert app.groups[0].tasks[0].placement == cluster.hosts[1].id
+
+
+def test_random_failures_empty_cluster_raises(meta):
+    """Edge hardening: an empty host list must fail loudly, not with the
+    opaque ``rng.integers(0, 0)`` error."""
+    env = Environment()
+    cluster = Cluster(env, hosts=[], storage=[], meta=meta, seed=0)
+    inj = FaultInjector(cluster, seed=0)
+    with pytest.raises(ValueError, match="at least one host"):
+        inj.random_host_failures(3, horizon=100.0)
+
+
+def test_fail_host_rejects_nonpositive_duration(meta):
+    env, cluster, _sched = build(meta, [(4, 4096, 10, 0)])
+    inj = FaultInjector(cluster, seed=0)
+    with pytest.raises(ValueError, match="duration"):
+        inj.fail_host(cluster.hosts[0].id, at=1.0, duration=0.0)
+    with pytest.raises(ValueError, match="duration"):
+        inj.fail_host(cluster.hosts[0].id, at=1.0, duration=-5.0)
+
+
+def test_second_longer_outage_extends_past_first_recovery(meta):
+    """The other side of the outage union (the ``_down_until`` max-end
+    comment): a LONGER second outage must swallow the first outage's
+    recovery event — the host stays down until the union's end."""
+    env, cluster, _sched = build(meta, [(4, 4096, 10, 0)])
+    host = cluster.hosts[0]
+    inj = FaultInjector(cluster, seed=0)
+    inj.fail_host(host.id, at=10.0, duration=20.0)  # down [10, 30)
+    inj.fail_host(host.id, at=20.0, duration=40.0)  # extends to 60
+    env.run(until=35.0)
+    assert not host.up  # the t=30 recovery must NOT have fired
+    env.run(until=70.0)
+    assert host.up
+    assert [e for _, _, e in inj.log] == ["failed", "recovered"]
+    assert inj.log[-1][0] == pytest.approx(60.0)
+
+
+def test_fluctuation_tick_on_horizon_does_not_resample(meta):
+    """The half-open-window race documented in ``fluctuate_bandwidth``:
+    a resample tick landing exactly ON the ``until`` horizon fires AFTER
+    the restore (earlier-seq) callback — the guard must make it a no-op,
+    or the final draw would persist as permanent bias."""
+    env, cluster, _sched = build(meta, [(4, 4096, 10, 0)] * 2)
+    route = cluster.get_route(cluster.hosts[0].id, cluster.hosts[1].id)
+    base = route.bw
+    # period=50, until=100: ticks at 50 and exactly 100 (the race tick).
+    FaultInjector(cluster, seed=3).fluctuate_bandwidth(
+        period=50.0, amplitude=0.3, until=100.0
+    )
+    env.run(until=99.0)
+    assert route.bw != base  # the t=50 tick did resample
+    env.run(until=200.0)
+    assert route.bw == base  # restored at 100; the on-horizon tick no-oped

@@ -248,3 +248,35 @@ def test_batched_explicit_block_replicas_validation():
         avail_r, *args[1:], block_replicas=3, interpret=True
     )
     assert p.shape == (4, 9)
+
+
+def test_pallas_quarantine_mask_matches_scan():
+    """The Pallas kernel's ``live`` quarantine mask: placements and
+    availability match the scan kernel under the same mask; all-live is
+    bit-identical to no-mask; masked hosts never receive a placement and
+    keep their availability rows (round-7 acceptance)."""
+    args = make_inputs(4, 64, 24)
+    H = 24
+    rng = np.random.default_rng(1)
+    live = np.ones(H, bool)
+    live[rng.choice(H, size=6, replace=False)] = False
+    livej = jnp.asarray(live)
+    all_live = jnp.ones(H, bool)
+    for mode in (MODES[0], MODES[3]):
+        p0, a0 = cost_aware_pallas(*args, **mode, interpret=True)
+        p1, a1 = cost_aware_pallas(*args, **mode, interpret=True,
+                                   live=all_live)
+        assert p0.tolist() == p1.tolist()
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))
+        pm, am = cost_aware_pallas(*args, **mode, interpret=True, live=livej)
+        ps, as_ = cost_aware_kernel(*args, **mode, live=livej)
+        assert pm.tolist() == ps.tolist()
+        np.testing.assert_allclose(
+            np.asarray(am), np.asarray(as_), rtol=1e-6, atol=1e-5
+        )
+        placed = np.asarray(pm)
+        placed = placed[placed >= 0]
+        assert live[placed].all()
+        np.testing.assert_array_equal(
+            np.asarray(am)[~live], np.asarray(args[0])[~live]
+        )

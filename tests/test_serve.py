@@ -260,3 +260,133 @@ def test_bench_serve_stream_smoke():
     assert row["decisions_per_sec"] > 0
     assert row["p99_decision_ms"] >= row["p50_decision_ms"] > 0
     assert row["batcher"]["dispatches"] > 0
+
+
+# -- session supervision (round 7 self-healing) ------------------------------
+
+
+def _crash_session(session, fail_on_call=1):
+    """Wrap a session's live policy so its Nth place() call raises — the
+    session-crash injection vector (the exception unwinds the session
+    thread).  Mutates ``policy.place`` in place: the scheduler and the
+    session share the policy object."""
+    orig = session.policy.place
+    state = {"calls": 0}
+
+    def crashing(ctx):
+        state["calls"] += 1
+        if state["calls"] == fail_on_call:
+            raise RuntimeError("injected session crash")
+        return orig(ctx)
+
+    session.policy.place = crashing
+
+
+def test_supervisor_restarts_crashed_session():
+    """A session whose thread dies mid-service is replaced by a factory
+    session and its in-flight jobs are requeued — every admitted job
+    still completes (the at-least-once acceptance bar)."""
+    reset_ids()
+    sessions = _sessions(2, _numpy_policy)
+    # Session 0's very first placement call raises.
+    _crash_session(sessions[0])
+
+    def factory(label):
+        return ServeSession(
+            label,
+            build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            _numpy_policy(),
+            seed=0,
+        )
+
+    driver = ServeDriver(
+        sessions, queue_depth=16, backpressure="shed",
+        session_factory=factory, max_restarts=2,
+    )
+    report = driver.run(poisson_arrivals(rate=0.2, n_jobs=8, seed=3))
+    c = report["slo"]["counters"]
+    assert report["restarts"] == 1
+    assert c["session_restarts"] == 1
+    assert c["requeued"] >= 1
+    assert c["completed"] == 8 and c["shed"] == 0
+    assert all(s.error is None for s in driver.sessions)
+
+
+def test_supervisor_restart_on_fresh_batcher_slot():
+    """Batched (device-policy) path: the replacement session gets a FRESH
+    DispatchBatcher slot (runs grows) and the coalesced service drains
+    every job."""
+    reset_ids()
+    sessions = _sessions(2, _device_policy)
+    _crash_session(sessions[1], fail_on_call=2)
+
+    def factory(label):
+        return ServeSession(
+            label,
+            build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            _device_policy(),
+            seed=0,
+        )
+
+    driver = ServeDriver(
+        sessions, queue_depth=16, backpressure="shed",
+        flush_after=0.2, session_factory=factory, max_restarts=2,
+    )
+    report = driver.run(poisson_arrivals(rate=0.2, n_jobs=8, seed=5))
+    c = report["slo"]["counters"]
+    assert report["restarts"] == 1
+    assert c["completed"] == 8 and c["shed"] == 0
+    assert report["batcher"]["runs"] == 3  # 2 original slots + 1 respawned
+
+
+def test_supervisor_exhausted_budget_fails_stop():
+    """Past max_restarts the supervisor falls back to fail-stop: the
+    crash surfaces to the caller exactly as before supervision."""
+    reset_ids()
+    sessions = _sessions(1, _numpy_policy)
+    _crash_session(sessions[0])
+    driver = ServeDriver(
+        sessions, queue_depth=8, backpressure="shed",
+        session_factory=None,  # supervision off
+    )
+    import pytest
+
+    with pytest.raises(RuntimeError, match="injected session crash"):
+        driver.run(poisson_arrivals(rate=0.5, n_jobs=4, seed=1))
+
+
+def test_stall_watchdog_restarts_wedged_session():
+    """A session that stops stepping (wedged placement call) past
+    stall_timeout is abandoned and replaced; its jobs complete in the
+    replacement."""
+    import time as _time
+
+    reset_ids()
+    sessions = _sessions(1, _numpy_policy)
+    orig = sessions[0].policy.place
+    state = {"calls": 0}
+
+    def wedging(ctx):
+        state["calls"] += 1
+        if state["calls"] == 1:
+            _time.sleep(2.0)  # well past the stall timeout
+        return orig(ctx)
+
+    sessions[0].policy.place = wedging
+
+    def factory(label):
+        return ServeSession(
+            label,
+            build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            _numpy_policy(),
+            seed=0,
+        )
+
+    driver = ServeDriver(
+        sessions, queue_depth=8, backpressure="shed",
+        session_factory=factory, max_restarts=1, stall_timeout=0.4,
+    )
+    report = driver.run(poisson_arrivals(rate=0.5, n_jobs=4, seed=2))
+    c = report["slo"]["counters"]
+    assert report["restarts"] == 1
+    assert c["completed"] == 4

@@ -304,3 +304,134 @@ def test_two_phase_parity_contended_full():
     x = contended_inputs(256, 64)
     assert_all_modes(x, ("slim", 8, 64), ca_modes=CA_MODES[:1] + CA_MODES[3:4],
                      totals_opts=("t",))
+
+
+# -- quarantine (live) mask parity (round 7) ---------------------------------
+
+
+def _live_masks(H, seed=0):
+    rng = np.random.default_rng(seed)
+    live = np.ones(H, bool)
+    live[rng.choice(H, size=max(H // 4, 1), replace=False)] = False
+    return jnp.asarray(live), jnp.ones(H, bool)
+
+
+def assert_mask_modes(x, phase2_modes, ca_modes=CA_QUICK):
+    """Every kernel × phase-2 mode under a quarantine mask: (a) all-live
+    mask bit-identical to no-mask, (b) masked two-phase == masked scan
+    oracle (placements AND availability), (c) no placement lands on a
+    masked host, (d) masked hosts' availability rows pass through
+    untouched."""
+    H = int(x["avail"].shape[0])
+    live, all_live = _live_masks(H)
+    live_np = np.asarray(live)
+    ca_args = (x["avail"], x["dem"], x["valid"], x["ng"], x["az"], x["cost"],
+               x["bw"], x["hz"], x["counts"])
+    for phase2 in phase2_modes:
+        cases = [
+            (
+                "opportunistic",
+                lambda lv, p2=phase2: opportunistic_kernel(
+                    x["avail"], x["dem"], x["valid"], x["u"], phase2=p2,
+                    live=lv,
+                ),
+                lambda lv: opportunistic_kernel_ref(
+                    x["avail"], x["dem"], x["valid"], x["u"], live=lv
+                ),
+            ),
+            (
+                "first_fit",
+                lambda lv, p2=phase2: first_fit_kernel(
+                    x["avail"], x["dem"], x["valid"], totals=x["totals"],
+                    phase2=p2, live=lv,
+                ),
+                lambda lv: first_fit_kernel_ref(
+                    x["avail"], x["dem"], x["valid"], live=lv
+                ),
+            ),
+            (
+                "best_fit",
+                lambda lv, p2=phase2: best_fit_kernel(
+                    x["avail"], x["dem"], x["valid"], totals=x["totals"],
+                    phase2=p2, live=lv,
+                ),
+                lambda lv: best_fit_kernel_ref(
+                    x["avail"], x["dem"], x["valid"], live=lv
+                ),
+            ),
+        ]
+        for mode in ca_modes:
+            cases.append(
+                (
+                    f"cost_aware:{mode}",
+                    lambda lv, p2=phase2, m=mode: cost_aware_kernel(
+                        *ca_args, **m, totals=x["totals"], phase2=p2, live=lv
+                    ),
+                    lambda lv, m=mode: cost_aware_kernel_ref(
+                        *ca_args, **m, live=lv
+                    ),
+                )
+            )
+        for name, newk, refk in cases:
+            # (a) all-live == no-mask, bit for bit.
+            p0, a0 = newk(None)
+            p1, a1 = newk(all_live)
+            assert np.array_equal(np.asarray(p0), np.asarray(p1)), (
+                name, phase2, "all-live placements"
+            )
+            assert np.array_equal(np.asarray(a0), np.asarray(a1)), (
+                name, phase2, "all-live availability"
+            )
+            # (b) masked: two-phase == scan oracle.
+            pm, am = newk(live)
+            pr, ar = refk(live)
+            assert np.array_equal(np.asarray(pm), np.asarray(pr)), (
+                name, phase2, "masked placements vs oracle"
+            )
+            assert np.array_equal(np.asarray(am), np.asarray(ar)), (
+                name, phase2, "masked availability vs oracle"
+            )
+            # (c) exclusion + (d) untouched masked rows.
+            placed = np.asarray(pm)
+            placed = placed[placed >= 0]
+            assert live_np[placed].all(), (name, phase2, "masked host placed")
+            assert np.array_equal(
+                np.asarray(am)[~live_np], np.asarray(x["avail"])[~live_np]
+            ), (name, phase2, "masked rows mutated")
+
+
+def test_quarantine_mask_parity_small():
+    """Tier-1 twin: the [H] quarantine mask across every kernel and the
+    slim + one chunked phase-2 mode (ISSUE-4 acceptance)."""
+    x = make_inputs(2, 28, 12, 32, group_size=5)
+    assert_mask_modes(x, ("scan", "slim", 4))
+
+
+def test_quarantine_mask_contended_small():
+    """Masked adversarial case: tasks whose ONLY fitting host is masked
+    must go unplaced, not spill onto the wrong host."""
+    x = contended_inputs(24, 8)
+    H = 8
+    live = np.ones(H, bool)
+    live[3] = False
+    livej = jnp.asarray(live)
+    for phase2 in ("slim", 4):
+        p, _ = first_fit_kernel(
+            x["avail"], x["dem"], x["valid"], phase2=phase2, live=livej
+        )
+        p_ref, _ = first_fit_kernel_ref(
+            x["avail"], x["dem"], x["valid"], live=livej
+        )
+        assert np.array_equal(np.asarray(p), np.asarray(p_ref))
+        placed = np.asarray(p)
+        assert not (placed == 3).any()
+
+
+def test_quarantine_mask_parity_full():
+    """Slow sweep: mask parity at material shapes and chunk sizes."""
+    for seed, (T, H, B, gs) in enumerate(
+        [(60, 16, 64, 7), (300, 600, 512, 16)]
+    ):
+        x = make_inputs(seed, T, H, B, group_size=gs)
+        assert_mask_modes(x, ("scan", "slim", 8, 64),
+                          ca_modes=CA_MODES[:1] + CA_MODES[3:4])

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Chaos-replay CLI: generate, re-run, and diff serialized ChaosSchedules.
+
+The regression workflow the chaos engine is built around
+(``pivot_tpu/infra/faults.py``):
+
+  1. ``generate`` — draw a seeded :class:`ChaosSchedule` against a
+     deterministic synthetic cluster and save it as JSON;
+  2. ``run`` — rebuild the same seeded world, apply a saved schedule,
+     drive a synthetic workload through a retry-governed scheduler to
+     completion, run the full invariant audit
+     (``pivot_tpu.infra.audit.audit_run``), and write a report: the
+     fault log, the final meter summary, dead-letter and audit state;
+  3. ``diff`` — compare two schedule files or two run reports.  Two
+     ``run`` reports from the same (schedule, seed, cluster, workload)
+     must be IDENTICAL — any diff is a determinism regression.
+
+Examples::
+
+    python tools/chaos_replay.py generate --seed 7 --hosts 12 \
+        --zone-outages 1 --preemptions 2 --stragglers 1 --partitions 1 \
+        --horizon 400 --out /tmp/chaos.json
+    python tools/chaos_replay.py run --schedule /tmp/chaos.json \
+        --hosts 12 --seed 7 --out /tmp/report_a.json
+    python tools/chaos_replay.py run --schedule /tmp/chaos.json \
+        --hosts 12 --seed 7 --out /tmp/report_b.json
+    python tools/chaos_replay.py diff /tmp/report_a.json /tmp/report_b.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# The replay harness is a pure-DES consumer: no device work, and the CPU
+# backend keeps runs reproducible on any machine.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build_world(n_hosts: int, seed: int, interval: float,
+                 max_retries: int, breaker_k: int):
+    from pivot_tpu.infra.meter import Meter
+    from pivot_tpu.sched import GlobalScheduler, HostCircuitBreaker, RetryPolicy
+    from pivot_tpu.sched.policies import FirstFitPolicy
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    reset_ids()  # host-N ids must match across replays
+    cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed))
+    meter = Meter(cluster.env, cluster.meta)
+    cluster.meter = meter
+    scheduler = GlobalScheduler(
+        cluster.env,
+        cluster,
+        FirstFitPolicy(),
+        interval=interval,
+        seed=seed,
+        meter=meter,
+        retry=RetryPolicy(max_retries=max_retries, base=1.0, seed=seed),
+        breaker=HostCircuitBreaker(k=breaker_k, cooldown=60.0),
+    )
+    cluster.start()
+    scheduler.start()
+    return cluster, scheduler, meter
+
+
+def _synthetic_apps(n_apps: int, seed: int):
+    import numpy as np
+
+    from pivot_tpu.workload import Application, TaskGroup
+
+    rng = np.random.default_rng(seed)
+    apps = []
+    for i in range(n_apps):
+        src = TaskGroup(
+            "src", cpus=1, mem=256, runtime=float(rng.uniform(20, 60)),
+            output_size=float(rng.uniform(100, 500)),
+            instances=int(rng.integers(1, 4)),
+        )
+        dst = TaskGroup(
+            "dst", cpus=1, mem=256, runtime=float(rng.uniform(20, 60)),
+            dependencies=["src"],
+        )
+        apps.append(Application(f"chaos-app-{i}", [src, dst]))
+    return apps
+
+
+def cmd_generate(args) -> int:
+    from pivot_tpu.infra.faults import ChaosSchedule
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    cluster = build_cluster(ClusterConfig(n_hosts=args.hosts, seed=args.seed))
+    schedule = ChaosSchedule.generate(
+        cluster,
+        seed=args.seed,
+        horizon=args.horizon,
+        n_domain_outages=args.zone_outages,
+        domain_level="zone",
+        outage_duration=args.outage_duration,
+        n_preemptions=args.preemptions,
+        preempt_lead=args.preempt_lead,
+        preempt_outage=args.outage_duration,
+        n_stragglers=args.stragglers,
+        straggler_factor=args.straggler_factor,
+        straggler_duration=args.outage_duration,
+        n_partitions=args.partitions,
+        partition_duration=args.outage_duration,
+    )
+    schedule.save(args.out)
+    print(f"wrote {len(schedule)} events to {args.out}: {schedule.counts()}")
+    return 0
+
+
+def cmd_run(args) -> int:
+    from pivot_tpu.infra.audit import audit_cluster, audit_conservation, audit_meter
+    from pivot_tpu.infra.faults import ChaosSchedule, FaultInjector
+
+    schedule = ChaosSchedule.load(args.schedule)
+    cluster, scheduler, meter = _build_world(
+        args.hosts, args.seed, args.interval, args.max_retries,
+        args.breaker_k,
+    )
+    injector = FaultInjector(cluster, seed=args.seed)
+    injector.apply_schedule(schedule)
+    apps = _synthetic_apps(args.apps, args.seed)
+    for app in apps:
+        scheduler.submit(app)
+    scheduler.stop()
+    cluster.env.run()
+
+    violations = (
+        audit_cluster(cluster)
+        + audit_conservation(scheduler, apps)
+        + audit_meter(meter)
+    )
+    report = {
+        "schedule": os.path.abspath(args.schedule),
+        "seed": args.seed,
+        "n_hosts": args.hosts,
+        "n_apps": args.apps,
+        "fault_log": [[t, target, ev] for t, target, ev in injector.log],
+        "meter": meter.summary(),
+        "dead_letters": [
+            {
+                "task": d.task_id, "app": d.app_id, "host": d.host_id,
+                "reason": d.reason, "at": d.at, "attempts": d.attempts,
+            }
+            for d in scheduler.dead_letters
+        ],
+        "n_cancelled": scheduler.n_cancelled,
+        "breaker_trips": [list(t) for t in scheduler.breaker.trips],
+        "finished_apps": sum(a.is_finished for a in apps),
+        "failed_apps": sum(a.failed for a in apps),
+        "audit_violations": violations,
+    }
+    # wall_clock is the one legitimately non-deterministic field.
+    report["meter"].pop("wall_clock", None)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+    status = "CLEAN" if not violations else f"{len(violations)} VIOLATIONS"
+    print(
+        f"run complete: {report['finished_apps']}/{args.apps} apps finished, "
+        f"{len(report['dead_letters'])} dead-lettered, audit {status} "
+        f"-> {args.out}"
+    )
+    return 0 if not violations else 1
+
+
+def cmd_diff(args) -> int:
+    with open(args.a) as f:
+        a = json.load(f)
+    with open(args.b) as f:
+        b = json.load(f)
+    if "events" in a and "events" in b:  # two schedules
+        from pivot_tpu.infra.faults import ChaosSchedule
+
+        delta = ChaosSchedule.from_dict(a).diff(ChaosSchedule.from_dict(b))
+        for line in delta:
+            print(line)
+        print("schedules identical" if not delta else f"{len(delta)} diffs")
+        return 0 if not delta else 1
+    # Two run reports: field-by-field.
+    keys = sorted(set(a) | set(b))
+    diffs = [k for k in keys if a.get(k) != b.get(k)]
+    for k in diffs:
+        print(f"field {k!r} differs:\n  a: {a.get(k)!r}\n  b: {b.get(k)!r}")
+    print("reports identical" if not diffs else f"{len(diffs)} fields differ")
+    return 0 if not diffs else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("generate", help="draw a seeded chaos schedule")
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--hosts", type=int, default=12)
+    g.add_argument("--horizon", type=float, default=400.0)
+    g.add_argument("--zone-outages", type=int, default=1)
+    g.add_argument("--preemptions", type=int, default=2)
+    g.add_argument("--preempt-lead", type=float, default=10.0)
+    g.add_argument("--stragglers", type=int, default=1)
+    g.add_argument("--straggler-factor", type=float, default=4.0)
+    g.add_argument("--partitions", type=int, default=1)
+    g.add_argument("--outage-duration", type=float, default=90.0)
+    g.add_argument("--out", required=True)
+    g.set_defaults(fn=cmd_generate)
+
+    r = sub.add_parser("run", help="replay a schedule; write an audit report")
+    r.add_argument("--schedule", required=True)
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--hosts", type=int, default=12)
+    r.add_argument("--apps", type=int, default=6)
+    r.add_argument("--interval", type=float, default=5.0)
+    r.add_argument("--max-retries", type=int, default=20)
+    r.add_argument("--breaker-k", type=int, default=3)
+    r.add_argument("--out", required=True)
+    r.set_defaults(fn=cmd_run)
+
+    d = sub.add_parser("diff", help="diff two schedules or two run reports")
+    d.add_argument("a")
+    d.add_argument("b")
+    d.set_defaults(fn=cmd_diff)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
