@@ -348,6 +348,157 @@ def _bench_two_phase(n_tasks: int = 600, n_hosts: int = 1024,
     return row
 
 
+def _bench_fused_tick(
+    n_hosts: int = 64,
+    cohort: int = 8,
+    k_sweep=(1, 2, 4, 8, 16, 32),
+    repeats: int = 30,
+) -> dict:
+    """Round-8 acceptance row: the device-resident multi-tick loop
+    (``ops/tickloop.py``) vs the per-tick dispatch path, K ticks per
+    span.
+
+    Shape: one ``cohort``-task wave arrives every tick onto a roomy
+    cluster (every wave places in full), so each span tick does real
+    placement work and the carry genuinely folds tick to tick.  The
+    sequential baseline is :func:`reference_tick_run` — the exact
+    per-tick protocol (one jitted kernel dispatch + host wait-queue
+    algebra per tick) the fused driver replaces.  ``overhead_per_tick``
+    isolates the dispatch floor by subtracting the marginal per-tick
+    device cost (two-point difference over the largest two K, where the
+    floor cancels — the ``_scan_step_probe`` idiom); the acceptance bar
+    is that overhead at K=16 amortized ≥5× below K=1.  Roofline's
+    ``fused_loop_model`` supplies the predicted-vs-measured column from
+    the probed dispatch floor alone.  Per-tick placements are checked
+    fused-vs-sequential in-row: a parity break becomes a row-level
+    ``error`` and forces ``meets_5x`` false.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from pivot_tpu.infra import roofline
+    from pivot_tpu.ops.tickloop import fused_tick_run, reference_tick_run
+    from pivot_tpu.sched.tpu import _probe_device_floor, pad_bucket
+
+    rng = np.random.default_rng(11)
+    backend = jax.default_backend()
+    floor_s = _probe_device_floor()
+    k_max = max(k_sweep)
+    dem_all = rng.uniform(0.3, 2.0, (cohort * k_max, 4))
+    # ONE slot bucket for the whole sweep: per-tick compute must be
+    # constant across K for the two-point overhead isolation below (the
+    # slim pass early-exits at the live batch, so pad slots are free,
+    # but a K-dependent bucket would still change sort/gather widths).
+    B = pad_bucket(cohort * k_max)
+    rows = {}
+    walls = {}
+    parity = True
+    for K in k_sweep:
+        S = cohort * K
+        dem = np.zeros((B, 4))
+        dem[:S] = dem_all[:S]
+        arrive = np.full(B, k_max + 1, np.int32)
+        arrive[:S] = np.repeat(np.arange(K, dtype=np.int32), cohort)
+        # Roomy cluster: every wave fits, so all K ticks place `cohort`
+        # tasks each — the maximal-work span shape.
+        avail = np.full((n_hosts, 4), 4.0 * cohort * k_max / n_hosts + 8.0)
+        kw = dict(policy="first-fit", strict=False)
+
+        def fused_call():
+            return fused_tick_run(
+                jnp.asarray(avail), jnp.asarray(dem), jnp.asarray(arrive),
+                jnp.asarray(K, jnp.int32), n_ticks=K, **kw,
+            )
+
+        # Best-of-N single-call walls (value-fetch completion barrier):
+        # these spans run in the hundreds of microseconds on CPU, where
+        # a mean soaks up scheduler/GC jitter that the min rejects.
+        res = fused_call()
+        int(np.asarray(res.placements).sum())  # warm: compile + settle
+        t_fused = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = fused_call()
+            int(np.asarray(out.placements).sum())
+            t_fused = min(t_fused, time.perf_counter() - t0)
+        ref = reference_tick_run(avail, dem, arrive, K, **kw)
+        p_parity = bool(
+            np.array_equal(np.asarray(res.placements), ref[0])
+            and np.array_equal(np.asarray(res.avail), ref[3])
+        )
+        parity = parity and p_parity
+        t_seq = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            reference_tick_run(avail, dem, arrive, K, **kw)
+            t_seq = min(t_seq, time.perf_counter() - t0)
+        walls[K] = t_fused
+        rows[K] = {
+            "span_s": round(t_fused, 6),
+            "per_tick_fused_s": round(t_fused / K, 6),
+            "per_tick_sequential_s": round(t_seq / K, 6),
+            "sequential_span_s": round(t_seq, 6),
+            "speedup_vs_sequential": round(t_seq / t_fused, 2),
+            "parity": p_parity,
+        }
+    # Marginal per-tick device cost: the floor cancels in the two-point
+    # difference over the two largest spans.
+    k_hi, k_lo = k_sweep[-1], k_sweep[-2]
+    tick_s = max((walls[k_hi] - walls[k_lo]) / (k_hi - k_lo), 1e-9)
+    # The fused program's own per-call floor (staging + dispatch + fetch
+    # of its operand set) from the smallest span's intercept — the
+    # trivial-kernel probe ``floor_s`` bounds it from below but misses
+    # the operand staging, exactly the cost being amortized.
+    k1 = k_sweep[0]
+    floor_fused = max(walls[k1] - k1 * tick_s, floor_s)
+    for K in k_sweep:
+        overhead = max(walls[K] / K - tick_s, 0.0)
+        model = roofline.fused_loop_model(K, tick_s, floor_fused)
+        rows[K]["overhead_per_tick_us"] = round(overhead * 1e6, 3)
+        rows[K]["fused_loop_model"] = {
+            **model,
+            "measured_s": round(walls[K], 6),
+            "model_over_measured": round(
+                model["predicted_s"] / walls[K], 3
+            ),
+        }
+    ov1 = rows[k_sweep[0]]["overhead_per_tick_us"]
+    ov16 = rows[16]["overhead_per_tick_us"] if 16 in rows else None
+    # A zero K=16 overhead means the floor amortized below measurement
+    # resolution — better than any finite ratio, but the ratio itself is
+    # undefined; emit null (an inf would make the record line invalid
+    # strict JSON) and record the full-amortization fact explicitly.
+    fully_amortized = ov16 == 0.0 and ov1 > 0.0
+    amort = (
+        round(ov1 / ov16, 2)
+        if ov16 not in (None, 0.0) else None
+    )
+    return {
+        **(
+            {"error": "fused span placements != sequential ticking"}
+            if not parity else {}
+        ),
+        "h": n_hosts,
+        "cohort_per_tick": cohort,
+        "backend": backend,
+        "policy": "first-fit",
+        "parity": parity,
+        "dispatch_floor_us": round(floor_s * 1e6, 3),
+        "fused_call_floor_us": round(floor_fused * 1e6, 3),
+        "marginal_tick_us": round(tick_s * 1e6, 3),
+        "per_k": {str(k): rows[k] for k in k_sweep},
+        "overhead_amortization_k16_vs_k1": amort,
+        "overhead_fully_amortized_at_k16": fully_amortized,
+        "meets_5x": bool(
+            parity
+            and ov16 is not None
+            and (fully_amortized or (amort is not None and amort >= 5.0))
+        ),
+    }
+
+
 def _bench_device(ctx, n_replicas: int, repeats: int = 5):
     """Decisions/sec of the vmapped fused kernel over a perturbed ensemble."""
     import numpy as np
@@ -1031,6 +1182,13 @@ def main() -> None:
         grid_batched = _bench_grid_batched()
     except Exception as exc:  # noqa: BLE001 — row-level isolation
         grid_batched = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    # Round-8 acceptance row: K simulator ticks fused into one device
+    # program (ops/tickloop.py) vs K per-tick dispatches, with the
+    # fused-loop roofline model's predicted-vs-measured columns.
+    try:
+        fused_tick = _bench_fused_tick()
+    except Exception as exc:  # noqa: BLE001 — row-level isolation
+        fused_tick = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     if backend != "tpu":
         # The Pallas variants cannot run on the fallback backend, so the
         # official record would otherwise exercise one kernel (VERDICT
@@ -1108,6 +1266,7 @@ def main() -> None:
         "ensemble_roofline": ens_roofline,
         "two_phase": two_phase,
         "grid_batched": grid_batched,
+        "fused_tick": fused_tick,
         "serve_stream": serve_stream,
         **(
             {"ensemble_saturated": ens_saturated} if ens_saturated else {}

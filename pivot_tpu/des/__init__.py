@@ -155,12 +155,32 @@ class Callback:
     per run) this halves per-event kernel overhead.  Not awaitable: a
     process cannot yield one (``Process._resume`` rejects it), which is
     exactly the contract — passive services never have waiters.
+
+    ``owner`` is an optional tag a scheduler component may attach to
+    recognize its own entries during a heap scan (the pure-tick-run
+    extractor classifies local-pump callbacks by it, ``scan_window``).
+    :meth:`cancel` disarms the entry in place — popping from the middle
+    of a heap is O(n), so cancelled entries stay queued and ``step``
+    skips them.  The fast-forward sleep uses this to move its wake when
+    a submission lands mid-window (``GlobalScheduler
+    ._reschedule_ff_wake``).  Note that folded pump deliveries are NOT
+    cancelled — a fused span leaves them armed and firing (their epoch
+    bumps are expected by the replay), which is what keeps event
+    ordering identical to sequential execution.
     """
 
-    __slots__ = ("fn",)
+    __slots__ = ("fn", "owner")
 
-    def __init__(self, fn: Callable[[], None]):
+    def __init__(self, fn: Callable[[], None], owner: Any = None):
         self.fn = fn
+        self.owner = owner
+
+    def cancel(self) -> None:
+        self.fn = None
+
+    @property
+    def cancelled(self) -> bool:
+        return self.fn is None
 
 
 class StoreGet(Event):
@@ -353,7 +373,8 @@ class Environment:
         t, _prio, _seq, event = heapq.heappop(self._heap)
         self._now = t
         if type(event) is Callback:
-            event.fn()
+            if event.fn is not None:  # cancelled entries are inert
+                event.fn()
         else:
             if event._value is Event._PENDING:
                 event._value = (
@@ -382,3 +403,37 @@ class Environment:
     def peek(self) -> float:
         """Timestamp of the next scheduled event, or +inf if none."""
         return self._heap[0][0] if self._heap else float("inf")
+
+    def scan_window(self, exclude=(), allow=None):
+        """Classify the pending heap for pure-tick-run extraction.
+
+        Returns ``(t_foreign, allowed)`` where ``t_foreign`` is the
+        earliest scheduled instant of any entry that is neither in
+        ``exclude`` (identity membership) nor approved by the ``allow``
+        predicate, or ``+inf`` when no such entry exists; ``allowed`` is
+        every approved entry scheduled STRICTLY before ``t_foreign``, as
+        ``(time, priority, seq, event)`` tuples in firing order.  An
+        approved entry at or after the first foreign instant is dropped
+        from ``allowed`` — its firing order against the foreign event is
+        the heap's business, not the caller's.
+
+        Cancelled callbacks are invisible (they fire as no-ops).  One
+        O(heap) pass, no mutation — the caller decides what to do with
+        the window (``GlobalScheduler`` fuses scheduling ticks across
+        it).
+        """
+        t_foreign = float("inf")
+        allowed: list = []
+        for t, prio, seq, ev in self._heap:
+            if type(ev) is Callback and ev.fn is None:
+                continue
+            if any(ev is x for x in exclude):
+                continue
+            if allow is not None and allow(ev):
+                allowed.append((t, prio, seq, ev))
+                continue
+            if t < t_foreign:
+                t_foreign = t
+        allowed = [e for e in allowed if e[0] < t_foreign]
+        allowed.sort(key=lambda e: (e[0], e[1], e[2]))
+        return t_foreign, allowed

@@ -199,6 +199,7 @@ class ExperimentRun(LogMixin):
         data_dir: Optional[str] = None,
         seed: Optional[int] = None,
         interval: float = 5,
+        fuse_spans: bool = True,
         trace_events: bool = False,
         identity: Optional[dict] = None,
         audit: bool = False,
@@ -219,6 +220,11 @@ class ExperimentRun(LogMixin):
         self.data_dir = data_dir
         self.seed = seed
         self.interval = interval
+        #: Pure-tick-run fusion (round 8): fast-forward provably no-op
+        #: ticks and serve pump-delivery windows as fused device spans.
+        #: Bit-identical outputs either way; off only for harnesses that
+        #: compare per-tick policy-call logs (tests/test_serve.py).
+        self.fuse_spans = fuse_spans
         # Structured event tracing (utils.trace); written next to the
         # meter's JSON when data_dir is set, kept on .tracer otherwise.
         self.trace_events = trace_events
@@ -257,6 +263,7 @@ class ExperimentRun(LogMixin):
             seed=self.seed,
             meter=meter,
             tracer=self.tracer,
+            fuse_spans=self.fuse_spans,
         )
         if self._schedule is not None:
             schedule = self._schedule

@@ -41,6 +41,7 @@ __all__ = [
     "annotate",
     "backend_peaks",
     "cpu_peaks",
+    "fused_loop_model",
     "placement_cost",
     "serial_model",
 ]
@@ -242,4 +243,39 @@ def serial_model(n_steps: int, step_seconds: float) -> Dict[str, float]:
         "n_steps": int(n_steps),
         "step_us": round(step_seconds * 1e6, 3),
         "predicted_s": round(n_steps * step_seconds, 6),
+    }
+
+
+def fused_loop_model(
+    n_ticks: int,
+    tick_seconds: float,
+    dispatch_floor_s: float,
+) -> Dict[str, float]:
+    """Dispatch-amortization model of the fused tick driver
+    (``ops/tickloop.py``): a span of ``n_ticks`` simulator ticks pays the
+    fixed per-call dispatch floor ONCE, where the per-tick path pays it
+    every tick — the fused-loop extension of :func:`serial_model` (which
+    prices only the in-call serial chain).
+
+      predicted wall(K)          = floor + K · tick_seconds
+      predicted per-tick overhead = floor / K
+
+    ``tick_seconds`` is the marginal device cost of ONE simulated tick
+    (measured by a two-point difference over span lengths, so the floor
+    cancels — the ``_scan_step_probe`` idiom); ``dispatch_floor_s`` is
+    the probe-measured per-call round trip.  ``bench.py``'s
+    ``fused_tick`` row pairs these predictions with measured walls per
+    K — the predicted-vs-measured column of the round-8 acceptance
+    criterion (per-tick overhead amortizing toward zero as K grows).
+    """
+    predicted = dispatch_floor_s + n_ticks * tick_seconds
+    return {
+        "n_ticks": int(n_ticks),
+        "tick_us": round(tick_seconds * 1e6, 3),
+        "dispatch_floor_us": round(dispatch_floor_s * 1e6, 3),
+        "predicted_s": round(predicted, 9),
+        "predicted_per_tick_s": round(predicted / n_ticks, 9),
+        "predicted_overhead_per_tick_us": round(
+            dispatch_floor_s / n_ticks * 1e6, 3
+        ),
     }

@@ -1,8 +1,19 @@
 """Fused placement kernels (JAX) — the TPU decision backend.
 
 Two families (``ops.kernels``): the two-phase production kernels and the
-``*_kernel_ref`` scan oracles they are held bit-identical to.
+``*_kernel_ref`` scan oracles they are held bit-identical to.  On top of
+them, ``ops.tickloop`` fuses whole *pure tick runs* — K scheduler ticks
+whose inputs are computable up front — into one device program, with the
+availability carry, wait-queue permutation, and meters device-resident
+between ticks (round 8; see ``docs/ARCHITECTURE.md``).
 """
+
+from pivot_tpu.ops.tickloop import (  # noqa: F401
+    SpanResult,
+    fused_tick_run,
+    reference_tick_run,
+    span_bucket,
+)
 
 from pivot_tpu.ops.kernels import (  # noqa: F401
     DeviceTopology,

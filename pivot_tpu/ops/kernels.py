@@ -111,6 +111,10 @@ __all__ = [
     "first_fit_kernel_ref",
     "best_fit_kernel_ref",
     "cost_aware_kernel_ref",
+    "opportunistic_impl",
+    "first_fit_impl",
+    "best_fit_impl",
+    "cost_aware_impl",
 ]
 
 
@@ -647,12 +651,20 @@ def _chunk_drive(avail, demands, valid, n_eff, C, speculate, recheck):
 
 # ---------------------------------------------------------------------------
 # Public two-phase kernels
+#
+# Each kernel's body lives in an UNJITTED ``*_impl`` core; the public name
+# is its jitted wrapper.  The cores are the reuse surface of the fused
+# tick driver (``ops/tickloop.py``), which invokes one core per simulated
+# tick INSIDE its own jitted ``lax.while_loop`` — re-entering a ``jax.jit``
+# there would be a trace-time no-op at best, and the driver must be able
+# to fold the per-tick availability output straight into its loop carry.
+# The cores are also the hotpath-lint targets (``tools/hotpath_lint.py``):
+# no host-sync call may appear in them.
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("phase2",))
-def opportunistic_kernel(avail, demands, valid, uniforms, phase2="auto",
-                         live=None):
+def opportunistic_impl(avail, demands, valid, uniforms, phase2="auto",
+                       live=None):
     """Uniformly random fitting host per task (ref opportunistic.py:11-20),
     two-phase form — see the module docstring for the ``phase2`` modes.
     Bit-identical to :func:`opportunistic_kernel_ref` in every mode.
@@ -708,9 +720,13 @@ def opportunistic_kernel(avail, demands, valid, uniforms, phase2="auto",
     return p, restore(a)
 
 
-@functools.partial(jax.jit, static_argnames=("strict", "phase2"))
-def first_fit_kernel(avail, demands, valid, strict=False, totals=None,
-                     phase2="auto", live=None):
+opportunistic_kernel = jax.jit(
+    opportunistic_impl, static_argnames=("phase2",)
+)
+
+
+def first_fit_impl(avail, demands, valid, strict=False, totals=None,
+                   phase2="auto", live=None):
     """Lowest-index fitting host per task (ref vbp.py:6-29), two-phase
     form.  Bit-identical to :func:`first_fit_kernel_ref` in every mode.
     ``live`` is the optional [H] quarantine mask (:func:`_apply_live`)."""
@@ -755,9 +771,13 @@ def first_fit_kernel(avail, demands, valid, strict=False, totals=None,
     return p, restore(a)
 
 
-@functools.partial(jax.jit, static_argnames=("phase2",))
-def best_fit_kernel(avail, demands, valid, totals=None, phase2="auto",
-                    live=None):
+first_fit_kernel = jax.jit(
+    first_fit_impl, static_argnames=("strict", "phase2")
+)
+
+
+def best_fit_impl(avail, demands, valid, totals=None, phase2="auto",
+                  live=None):
     """Min residual-L2 host among strict fits (ref vbp.py:32-49), two-phase
     form.  Bit-identical to :func:`best_fit_kernel_ref` in every mode.
     ``live`` is the optional [H] quarantine mask (:func:`_apply_live`)."""
@@ -804,11 +824,10 @@ def best_fit_kernel(avail, demands, valid, totals=None, phase2="auto",
     return p, restore(a)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("bin_pack", "sort_hosts", "host_decay", "phase2"),
-)
-def cost_aware_kernel(
+best_fit_kernel = jax.jit(best_fit_impl, static_argnames=("phase2",))
+
+
+def cost_aware_impl(
     avail,
     demands,
     valid,
@@ -1037,3 +1056,9 @@ def cost_aware_kernel(
         lambda st: st[0] < n_eff, body, st0
     )
     return placements[:B], restore(avail)
+
+
+cost_aware_kernel = jax.jit(
+    cost_aware_impl,
+    static_argnames=("bin_pack", "sort_hosts", "host_decay", "phase2"),
+)

@@ -1,0 +1,545 @@
+"""Device-resident multi-tick scheduler driver — the fused DES step.
+
+Roofline accounting (round 6, ``infra/roofline.py``) proved the placement
+hot path is *dispatch/serialization*-bound: every scheduling tick pays a
+fixed host→device→host round trip (probe-measured 76–86 ms over the TPU
+tunnel, ~0.1–1 ms even on the in-process CPU backend) that dwarfs the
+per-tick kernel compute at realistic tick sizes.  ``DispatchBatcher``
+(round 5) amortizes that floor *across* concurrent runs; this module
+amortizes it *along the time axis*: K consecutive scheduling ticks of one
+run execute as ONE device program, with the ``[H, 4]`` availability
+carry, the within-span wait-queue permutation, the resident-task decay
+counters, and the decision meters all staying device-resident between
+ticks.
+
+**The pure-tick-run contract.**  A span of K ticks may be fused only when
+its inputs are computable up front — the DES side
+(``GlobalScheduler._dispatch_loop``) extracts *pure tick runs*: maximal
+windows in which the event heap holds nothing that could mutate
+scheduler-visible state (no completions, no fault/chaos callbacks, no
+retry resubmissions, no quarantine expiries), except local-scheduler pump
+deliveries, whose payloads are snapshotted and folded in as *cohorts* —
+``arrive[b]`` below is the tick index at which slot ``b`` joins the ready
+pool.  Within such a window the ready set evolves only by this driver's
+own placements: unplaced tasks re-enter the wait stack in visit order and
+re-drain LIFO next tick (the reference's ``popitem`` semantics), which
+the loop carry reproduces exactly.  Everything else — anchors, demands,
+the live/quarantine mask, Philox draws — is constant or precomputable
+over the window.  See ``docs/ARCHITECTURE.md`` ("pure tick runs").
+
+**Bit-parity.**  Each simulated tick invokes the same unjitted two-phase
+kernel core (``ops/kernels.py`` ``*_impl``) the per-tick path jits, on an
+identically ordered task stream, so a fused span is bit-identical —
+placements, availability carry, and meter counts — to K sequential
+single-tick dispatches in every ``phase2`` mode (scan oracle, slim,
+speculative chunk commit).  :func:`reference_tick_run` is the in-module
+sequential referee: an independent host-side implementation of the same
+span semantics driving one public kernel call per tick, which the parity
+suite (``tests/test_tickloop.py``) holds :func:`fused_tick_run` to.
+
+**Early exit.**  Two provable no-op conditions end the loop before the
+horizon: the pool drained with no future cohorts (subsequent ticks have
+an empty ready batch), and a zero-placement tick with no future cohorts —
+availability only ever *decreases* within a span, so a task batch with no
+fitting host this tick can never fit later in the span; all remaining
+ticks are exact no-ops the host accounts for without device work.  The
+returned ``ticks_run``/``n_stack_final`` let the caller extrapolate the
+skipped ticks' meters exactly.
+
+Host-sync discipline: no ``block_until_ready`` / host fetch / ``.item()``
+may appear inside the loop body — enforced statically by
+``tools/hotpath_lint.py`` (tier-1 wired).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pivot_tpu.ops.kernels import (
+    _apply_live,
+    best_fit_impl,
+    best_fit_kernel,
+    cost_aware_impl,
+    cost_aware_kernel,
+    first_fit_impl,
+    first_fit_kernel,
+    opportunistic_impl,
+    opportunistic_kernel,
+)
+
+__all__ = [
+    "SpanResult",
+    "fused_tick_run",
+    "reference_tick_run",
+    "span_bucket",
+]
+
+#: Static span-length buckets: one XLA program per (bucket, B, H, config),
+#: never per span length — ``n_ticks_dyn`` trims the actual horizon.
+_K_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def span_bucket(k: int) -> int:
+    """Smallest span bucket ≥ k (caps XLA program count per shape)."""
+    for b in _K_BUCKETS:
+        if k <= b:
+            return b
+    return ((k + 31) // 32) * 32
+
+
+class SpanResult(NamedTuple):
+    """One fused span's outputs (axes: K = tick bucket, B = slot bucket).
+
+    ``placements`` rows are indexed by *slot* (the span's task identity:
+    tick-0 ready batch first in batch order, then cohorts in delivery
+    order); −1 = unplaced that tick / not in that tick's batch.  Rows at
+    index ≥ ``ticks_run`` are provable no-ops (all −1): if
+    ``n_stack_final`` > 0 the span stalled (those ticks still present
+    ``n_stack_final`` ready tasks to the meter and place none), otherwise
+    the pool drained (those ticks have an empty ready batch and touch no
+    meter).
+    """
+
+    placements: jax.Array  # [K, B] i32 host index per slot, −1 unplaced
+    n_ready: jax.Array  # [K] i32 ready-batch size per executed tick
+    n_placed: jax.Array  # [K] i32 placements per executed tick
+    ticks_run: jax.Array  # scalar i32 — ticks actually executed
+    n_stack_final: jax.Array  # scalar i32 — wait-stack size at exit
+    stackpos: jax.Array  # [B] i32 final wait-stack position, −1 = out
+    avail: jax.Array  # [H, 4] availability carry at exit
+
+
+def _fused_tick_run_impl(
+    avail,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    uniforms,
+    sort_norm,
+    anchor_zone,
+    bucket_id,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    base_task_counts,
+    totals,
+    live,
+    *,
+    policy: str,
+    n_ticks: int,
+    strict: bool,
+    decreasing: bool,
+    bin_pack: str,
+    sort_tasks: bool,
+    sort_hosts: bool,
+    host_decay: bool,
+    phase2,
+):
+    B = demands.shape[0]
+    H = avail.shape[0]
+    K = n_ticks
+    avail, restore = _apply_live(avail, live)
+    iota_b = jnp.arange(B, dtype=jnp.int32)
+    big = jnp.asarray(2 * B + 2, jnp.int32)  # > any real batch position
+
+    def cond(st):
+        k, done = st[0], st[1]
+        return (k < n_ticks_dyn) & ~done
+
+    def body(st):
+        k, done, stackpos, n_stack, avail, cum, p_out, nr_out, np_out = st
+        # A dead row under vmap (the cross-run batcher coalesces whole
+        # spans) must be inert: every state write below gates on alive.
+        alive = (k < n_ticks_dyn) & ~done
+
+        # 1. This tick's ready batch: LIFO re-drain of the wait stack
+        #    (reverse stack order), then the tick's arriving cohort in
+        #    delivery order — exactly the dispatch loop's drain sequence.
+        arriving = arrive == k
+        arr_rank = jnp.cumsum(arriving.astype(jnp.int32)) - 1
+        in_stack = stackpos >= 0
+        batch_pos = jnp.where(
+            in_stack,
+            n_stack - 1 - stackpos,
+            jnp.where(arriving, n_stack + arr_rank, big),
+        ).astype(jnp.int32)
+        in_batch = in_stack | arriving
+        t_k = (n_stack + jnp.sum(arriving.astype(jnp.int32))).astype(
+            jnp.int32
+        )
+
+        # 2. Kernel-stream order (ties resolved by batch position, which
+        #    is unique — every sort below is total, no stability needed):
+        #      * batch-order arms: the batch order itself;
+        #      * decreasing VBP arms: demand-norm-descending over the
+        #        batch (``sort_norm`` is the HOST-computed f64 norm, the
+        #        same values ``_sort_decreasing`` keys on — recomputing
+        #        norms device-side could round a tie differently);
+        #      * cost-aware: anchor buckets in first-seen batch order
+        #        (``bucket_id`` is the host-resolved anchor identity —
+        #        buckets have unique first-seen positions, so groups are
+        #        contiguous after the sort), batch-ordered or
+        #        norm-descending within a bucket.
+        inactive = (~in_batch).astype(jnp.int32)
+        if policy == "cost-aware":
+            bf_bucket = jax.ops.segment_min(
+                jnp.where(in_batch, batch_pos, big),
+                bucket_id,
+                num_segments=B,
+            )
+            bfirst = bf_bucket[bucket_id]
+            key3 = -sort_norm if sort_tasks else batch_pos
+            order = lax.sort(
+                (inactive, bfirst, key3, batch_pos, iota_b), num_keys=4
+            )[-1]
+        elif decreasing:
+            order = lax.sort(
+                (inactive, -sort_norm, batch_pos, iota_b), num_keys=3
+            )[-1]
+        else:
+            order = lax.sort((inactive, batch_pos, iota_b), num_keys=2)[-1]
+        dem_p = demands[order]
+        valid_p = in_batch[order]
+
+        # 3. One two-phase kernel core — the same ops the per-tick jitted
+        #    path runs, so placements are bit-identical to a single-tick
+        #    dispatch with these inputs.
+        if policy == "opportunistic":
+            # Positional Philox draws: row k is ``tick_uniforms(seed,
+            # tick_seq + k, B)`` and position j's draw serves batch
+            # position j — identical to the sequential path's per-tick
+            # stream (prefix property of the counter-based generator).
+            p_ord, new_avail = opportunistic_impl(
+                avail, dem_p, valid_p, uniforms[k], phase2=phase2
+            )
+        elif policy == "first-fit":
+            p_ord, new_avail = first_fit_impl(
+                avail, dem_p, valid_p, strict=strict, totals=totals,
+                phase2=phase2,
+            )
+        elif policy == "best-fit":
+            p_ord, new_avail = best_fit_impl(
+                avail, dem_p, valid_p, totals=totals, phase2=phase2
+            )
+        else:  # cost-aware
+            b_p = bucket_id[order]
+            ng_p = jnp.where(iota_b == 0, True, b_p != jnp.roll(b_p, 1))
+            p_ord, new_avail = cost_aware_impl(
+                avail,
+                dem_p,
+                valid_p,
+                ng_p,
+                anchor_zone[order],
+                cost_zz,
+                bw_zz,
+                host_zone,
+                base_task_counts + cum,
+                bin_pack=bin_pack,
+                sort_hosts=sort_hosts,
+                host_decay=host_decay,
+                totals=totals,
+                phase2=phase2,
+            )
+        row = jnp.full((B,), -1, jnp.int32).at[order].set(
+            p_ord.astype(jnp.int32)
+        )
+        placed = row >= 0
+        n_placed = jnp.sum(placed.astype(jnp.int32)).astype(jnp.int32)
+
+        # 4. Wait-stack rebuild: unplaced batch members re-enter in VISIT
+        #    order — the kernel-stream order for the decreasing VBP arms
+        #    (the reference consumes ``schedule()``'s sorted return
+        #    list), the batch order for everything else (cost-aware's
+        #    bucket sort happens on a copy; its return order is the
+        #    batch).
+        if decreasing:
+            visit_pos = jnp.zeros((B,), jnp.int32).at[order].set(iota_b)
+        else:
+            visit_pos = batch_pos
+        unplaced = in_batch & ~placed
+        srt = lax.sort(
+            (jnp.where(unplaced, visit_pos, big), iota_b), num_keys=1
+        )[1]
+        ranks = jnp.zeros((B,), jnp.int32).at[srt].set(iota_b)
+        new_stackpos = jnp.where(unplaced, ranks, -1)
+        new_n_stack = jnp.sum(unplaced.astype(jnp.int32)).astype(jnp.int32)
+
+        # 5. Span-cumulative resident-task counts (the host-decay base
+        #    grows by one per placement, mirroring Host.n_tasks at
+        #    admission).
+        cum_new = cum.at[jnp.where(placed, row, H)].add(
+            placed.astype(jnp.int32), mode="drop"
+        )
+
+        # 6. Provable-no-op early exit (see module docstring).
+        future = jnp.any((arrive > k) & (arrive < n_ticks_dyn))
+        done_new = ~future & ((new_n_stack == 0) | (n_placed == 0))
+
+        kk = jnp.where(alive, k, K)  # dead rows write out of bounds → drop
+        return (
+            k + 1,
+            jnp.where(alive, done_new, done),
+            jnp.where(alive, new_stackpos, stackpos),
+            jnp.where(alive, new_n_stack, n_stack),
+            jnp.where(alive, new_avail, avail),
+            jnp.where(alive, cum_new, cum),
+            p_out.at[kk].set(jnp.where(alive, row, -1), mode="drop"),
+            nr_out.at[kk].set(t_k, mode="drop"),
+            np_out.at[kk].set(n_placed, mode="drop"),
+        )
+
+    st0 = (
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(False),
+        jnp.full((B,), -1, jnp.int32),  # tick-0 stack is empty: the base
+        jnp.asarray(0, jnp.int32),      # batch arrives as cohort 0
+        avail,
+        jnp.zeros((H,), jnp.int32),
+        jnp.full((K, B), -1, jnp.int32),
+        jnp.zeros((K,), jnp.int32),
+        jnp.zeros((K,), jnp.int32),
+    )
+    k, _done, stackpos, n_stack, avail, _cum, p_out, nr_out, np_out = (
+        lax.while_loop(cond, body, st0)
+    )
+    return SpanResult(
+        p_out, nr_out, np_out, k, n_stack, stackpos, restore(avail)
+    )
+
+
+_fused_tick_run = jax.jit(
+    _fused_tick_run_impl,
+    static_argnames=(
+        "policy",
+        "n_ticks",
+        "strict",
+        "decreasing",
+        "bin_pack",
+        "sort_tasks",
+        "sort_hosts",
+        "host_decay",
+        "phase2",
+    ),
+)
+
+
+def fused_tick_run(
+    avail,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    *,
+    policy: str,
+    n_ticks: int,
+    uniforms=None,
+    sort_norm=None,
+    anchor_zone=None,
+    bucket_id=None,
+    cost_zz=None,
+    bw_zz=None,
+    host_zone=None,
+    base_task_counts=None,
+    totals=None,
+    live=None,
+    strict: bool = False,
+    decreasing: bool = False,
+    bin_pack: str = "first-fit",
+    sort_tasks: bool = False,
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    phase2="auto",
+) -> SpanResult:
+    """Execute up to ``n_ticks_dyn`` scheduling ticks as one device program.
+
+    Inputs (B = slot bucket, K = ``n_ticks`` span bucket, H hosts):
+      avail            [H, 4]  availability carry at span start
+      demands          [B, 4]  per-slot demand (slot layout: tick-0 ready
+                               batch in batch order, then cohorts in
+                               delivery order; pad slots get
+                               ``arrive >= n_ticks``)
+      arrive           [B] i32 tick index at which each slot joins the pool
+      n_ticks_dyn      scalar  actual span horizon (≤ the static bucket)
+      uniforms         [K, B]  positional Philox draws (opportunistic)
+      sort_norm        [B]     host-computed demand L2 norms (the
+                               ``_sort_decreasing`` keys; decreasing /
+                               ``sort_tasks`` arms)
+      anchor_zone      [B] i32 per-slot anchor zone (cost-aware)
+      bucket_id        [B] i32 per-slot anchor-bucket identity < B
+                               (cost-aware; anchors are span-constant)
+      cost_zz/bw_zz/host_zone/base_task_counts/totals — the cost-aware
+                               topology operands (``DeviceTopology``)
+      live             [H]     span-constant quarantine mask (or None)
+
+    Static config mirrors the per-tick kernels (``strict``/``decreasing``
+    for the VBP arms, ``bin_pack``/``sort_tasks``/``sort_hosts``/
+    ``host_decay`` for cost-aware, ``phase2`` selecting the sequential
+    pass).  Returns a :class:`SpanResult` (see its docstring for the
+    no-op-tail contract).  Bit-identical to :func:`reference_tick_run`
+    on the same inputs — the fused-parity suite's contract.
+    """
+    return _fused_tick_run(
+        avail,
+        demands,
+        arrive,
+        n_ticks_dyn,
+        uniforms,
+        sort_norm,
+        anchor_zone,
+        bucket_id,
+        cost_zz,
+        bw_zz,
+        host_zone,
+        base_task_counts,
+        totals,
+        live,
+        policy=policy,
+        n_ticks=n_ticks,
+        strict=strict,
+        decreasing=decreasing,
+        bin_pack=bin_pack,
+        sort_tasks=sort_tasks,
+        sort_hosts=sort_hosts,
+        host_decay=host_decay,
+        phase2=phase2,
+    )
+
+
+def reference_tick_run(
+    avail,
+    demands,
+    arrive,
+    n_ticks: int,
+    *,
+    policy: str,
+    uniforms=None,
+    sort_norm=None,
+    anchor_zone=None,
+    bucket_id=None,
+    cost_zz=None,
+    bw_zz=None,
+    host_zone=None,
+    base_task_counts=None,
+    totals=None,
+    live=None,
+    strict: bool = False,
+    decreasing: bool = False,
+    bin_pack: str = "first-fit",
+    sort_tasks: bool = False,
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    phase2="auto",
+):
+    """Sequential referee for :func:`fused_tick_run`: the same span
+    semantics driven tick by tick with ONE public (jitted) kernel call
+    per tick and the wait-stack algebra in plain Python — i.e. exactly
+    what the per-tick dispatch path pays, which is also what ``bench.py``
+    ``fused_tick`` times it against.  Returns ``(placements [K, B] i64,
+    n_ready [K], n_placed [K], avail [H, 4])`` as host numpy, with the
+    no-op tail rows materialized (so outputs compare 1:1 against a
+    :class:`SpanResult` whose tail the device loop skipped).
+    """
+    B = demands.shape[0]
+    avail = jnp.asarray(avail)
+    arrive = np.asarray(arrive)
+    placements = np.full((n_ticks, B), -1, dtype=np.int64)
+    n_ready = np.zeros(n_ticks, dtype=np.int64)
+    n_placed = np.zeros(n_ticks, dtype=np.int64)
+    dem_host = np.asarray(demands)
+    cum = np.zeros(np.asarray(avail).shape[0], dtype=np.int32)
+    stack: list = []
+    for k in range(n_ticks):
+        batch = list(reversed(stack)) + [
+            int(b) for b in np.flatnonzero(arrive == k)
+        ]
+        if not batch:
+            continue
+        n_ready[k] = len(batch)
+        if policy == "cost-aware":
+            first_seen: dict = {}
+            for pos, b in enumerate(batch):
+                first_seen.setdefault(int(bucket_id[b]), pos)
+            if sort_tasks:
+                order = sorted(
+                    range(len(batch)),
+                    key=lambda pos: (
+                        first_seen[int(bucket_id[batch[pos]])],
+                        -float(sort_norm[batch[pos]]),
+                        pos,
+                    ),
+                )
+            else:
+                order = sorted(
+                    range(len(batch)),
+                    key=lambda pos: (
+                        first_seen[int(bucket_id[batch[pos]])],
+                        pos,
+                    ),
+                )
+            order = [batch[pos] for pos in order]
+        elif decreasing:
+            order = sorted(
+                batch, key=lambda b: -float(sort_norm[b])
+            )  # python sort is stable: ties keep batch order
+        else:
+            order = batch
+        dem_p = np.zeros_like(dem_host)
+        dem_p[: len(order)] = dem_host[order]
+        valid_p = np.zeros(B, dtype=bool)
+        valid_p[: len(order)] = True
+        kw = dict(phase2=phase2, live=live)
+        if policy == "opportunistic":
+            p_ord, avail = opportunistic_kernel(
+                avail, jnp.asarray(dem_p), jnp.asarray(valid_p),
+                uniforms[k], **kw,
+            )
+        elif policy == "first-fit":
+            p_ord, avail = first_fit_kernel(
+                avail, jnp.asarray(dem_p), jnp.asarray(valid_p),
+                strict=strict, totals=totals, **kw,
+            )
+        elif policy == "best-fit":
+            p_ord, avail = best_fit_kernel(
+                avail, jnp.asarray(dem_p), jnp.asarray(valid_p),
+                totals=totals, **kw,
+            )
+        else:
+            az_p = np.zeros(B, dtype=np.int32)
+            az_p[: len(order)] = np.asarray(anchor_zone)[order]
+            ng_p = np.zeros(B, dtype=bool)
+            prev = None
+            for j, b in enumerate(order):
+                ng_p[j] = prev is None or int(bucket_id[b]) != prev
+                prev = int(bucket_id[b])
+            p_ord, avail = cost_aware_kernel(
+                avail,
+                jnp.asarray(dem_p),
+                jnp.asarray(valid_p),
+                jnp.asarray(ng_p),
+                jnp.asarray(az_p),
+                cost_zz,
+                bw_zz,
+                host_zone,
+                base_task_counts + jnp.asarray(cum),
+                bin_pack=bin_pack,
+                sort_hosts=sort_hosts,
+                host_decay=host_decay,
+                totals=totals,
+                **kw,
+            )
+        p_host = np.asarray(p_ord)
+        for j, b in enumerate(order):
+            placements[k, b] = p_host[j]
+        visit = order if decreasing else batch
+        stack = [b for b in visit if placements[k, b] < 0]
+        placed_hosts = [
+            int(placements[k, b]) for b in order if placements[k, b] >= 0
+        ]
+        np.add.at(cum, placed_hosts, 1)
+        n_placed[k] = len(placed_hosts)
+    return placements, n_ready, n_placed, np.asarray(avail)

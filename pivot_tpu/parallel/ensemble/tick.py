@@ -4,6 +4,14 @@
 anchor voting, placement, transfer/congestion timing, busy integral — as
 one ``lax.while_loop`` over ticks.  See the package ``__init__`` for the
 execution model and the vector/indexed forms contract.
+
+This is the original device-resident tick loop — the estimator-fidelity
+ancestor of the DES-exact fused span driver (``ops/tickloop.py``, round
+8): both keep the availability carry and meters on-device across ticks
+and return to host only at genuine decision points.  The body is a
+registered hot path of ``tools/hotpath_lint.py`` — no host
+synchronization (fetches, ``.item()``, scalar coercion of tracers) may
+appear inside it; the lint runs in tier 1 (``tests/test_meta.py``).
 """
 
 from __future__ import annotations

@@ -49,7 +49,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from pivot_tpu.des import Environment, Store
+from pivot_tpu.des import Callback, Environment, Store
 from pivot_tpu.infra import Cluster, Host
 from pivot_tpu.infra.meter import Meter, SloMeter
 from pivot_tpu.sched.retry import DeadLetter, HostCircuitBreaker, RetryPolicy
@@ -192,14 +192,21 @@ class LocalScheduler(LogMixin):
         app: Application,
         submit_q: Store,
         interval: float = 5,
+        scheduler: Optional["GlobalScheduler"] = None,
     ):
         self.env = env
         self.application = app
         self.submit_q = submit_q
         self.interval = interval
+        self.scheduler = scheduler
         self._ready_stack: List[Task] = []
         self._start_time = 0.0
         self._wake_armed = False
+        #: The armed pump's heap entry — tagged with ``owner=self`` so the
+        #: pure-tick-run extractor (``GlobalScheduler._extract_span``) can
+        #: recognize, snapshot, and absorb the delivery into a fused span
+        #: (cancelling the entry so it cannot double-deliver).
+        self._wake_cb: Optional[Callback] = None
 
     def start(self) -> None:
         env, app = self.env, self.application
@@ -210,16 +217,35 @@ class LocalScheduler(LogMixin):
                 self._ready_stack.append(task)
         # First pump fires immediately (grid point k = 0).
         self._wake_armed = True
-        env.schedule_callback(0.0, self._pump)
+        self._wake_cb = env.schedule_callback(0.0, self._pump)
+        self._wake_cb.owner = self
+        if self.scheduler is not None:
+            self.scheduler._armed_pumps += 1
 
     def _pump(self) -> None:
         self._wake_armed = False
+        self._wake_cb = None
+        if self.scheduler is not None:
+            self.scheduler._armed_pumps -= 1
+            # Submissions mutate the ready set a fused span speculated
+            # over — an un-absorbed pump firing mid-replay must abort the
+            # remaining span ticks (``_replay_span``'s epoch check).
+            self.scheduler._span_epoch += 1
         submit = self.submit_q.put
         stack = self._ready_stack
         while stack:
             task = stack.pop()  # LIFO, ref popitem()
             if task.is_nascent:
                 submit(task)
+
+    def pump_snapshot(self) -> List[Task]:
+        """The tasks the armed pump will deliver when it fires, in
+        delivery order.  Valid across a pure window: stack membership
+        only changes via completions (which abort fused spans before the
+        affected tick) and nascency only via placement (stack tasks are
+        unplaced until delivered) — so the span extractor can fold this
+        as the pump's future delivery without touching the pump itself."""
+        return [t for t in reversed(self._ready_stack) if t.is_nascent]
 
     def _arm_wake(self) -> None:
         """Schedule the next pump at the first grid point after now."""
@@ -229,7 +255,10 @@ class LocalScheduler(LogMixin):
         k = int(elapsed // self.interval) + 1
         delay = self._start_time + k * self.interval - self.env.now
         self._wake_armed = True
-        self.env.schedule_callback(delay, self._pump)
+        self._wake_cb = self.env.schedule_callback(delay, self._pump)
+        self._wake_cb.owner = self
+        if self.scheduler is not None:
+            self.scheduler._armed_pumps += 1
 
     def notify(self, task: Task) -> None:
         """Called by the global listener when one of our tasks finishes.
@@ -247,6 +276,37 @@ class LocalScheduler(LogMixin):
         self._arm_wake()
 
 
+class SpanPlan:
+    """One extracted pure tick run, priced and served as a single fused
+    device dispatch (``ops/tickloop.py``).
+
+    ``slots`` is the span's task universe: the tick-0 ready batch in
+    batch order, then each in-window pump delivery (cohort) in fire
+    order; ``arrive[s]`` is the tick index at which slot ``s`` joins the
+    ready pool.  ``outcome`` is filled by the policy's ``place_span``
+    (slot-indexed per-tick placements).  The plan never mutates DES
+    state: the folded pumps stay armed and fire normally during replay —
+    the fused program merely *pre-computed* what they will deliver.
+    """
+
+    __slots__ = (
+        "ctx", "grid", "slots", "arrive", "pump_ticks", "epoch", "outcome",
+    )
+
+    def __init__(self, ctx, grid, slots, arrive, pump_ticks, epoch):
+        self.ctx = ctx
+        self.grid = grid  # [K] exact tick instants (iterated fl-adds)
+        self.slots = slots  # [S] Task — ready batch, then cohorts
+        self.arrive = arrive  # [S] int — delivery tick per slot
+        self.pump_ticks = pump_ticks  # delivery tick per folded pump
+        self.epoch = epoch  # span epoch at extraction
+        self.outcome = None
+
+    @property
+    def n_ticks(self) -> int:
+        return len(self.grid)
+
+
 class GlobalScheduler(LogMixin):
     """The global tick loop + completion listener around a pluggable policy."""
 
@@ -262,6 +322,7 @@ class GlobalScheduler(LogMixin):
         retry: Optional[RetryPolicy] = None,
         breaker: Optional[HostCircuitBreaker] = None,
         slo: Optional[SloMeter] = None,
+        fuse_spans: bool = True,
     ):
         self.env = env
         self.cluster = cluster
@@ -297,6 +358,39 @@ class GlobalScheduler(LogMixin):
         self._n_unfinished = 0
         self._stopped = False
         self._tick_seq = 0
+        #: Pure-tick-run fusion (round 8).  When on, the dispatch loop
+        #: (a) fast-forwards across windows of provably no-op ticks
+        #: instead of paying one policy dispatch each (availability only
+        #: decreases within a pure window, so a tick that leaves tasks
+        #: unplaced proves every later in-window tick places nothing),
+        #: and (b) hands whole windows WITH in-window pump deliveries to
+        #: a span-capable device policy (``place_span``) as ONE fused
+        #: device program (``ops/tickloop.py``).  Placements, meters, and
+        #: wait-queue order are bit-identical either way — asserted by
+        #: ``tests/test_tickloop.py``'s DES parity tests.
+        self.fuse_spans = fuse_spans
+        #: Monotone counter of scheduler-visible mutations (completions,
+        #: submissions, un-absorbed pump fires).  A fused span's replay
+        #: commits precomputed ticks only while this stays unchanged; any
+        #: bump aborts the remaining span (the committed prefix is exact).
+        self._span_epoch = 0
+        self._ff_evt = None  # pending fast-forward wake (early-wakeable)
+        self._ff_cb: Optional[Callback] = None
+        self._ff_anchor = 0.0  # tick-grid anchor of the pending wake
+        self._ff_rescheduled = False  # a submit pulled the wake earlier
+        self._ff_target = float("inf")
+        #: Armed local-pump count — the O(1) gate on span extraction
+        #: (maintained by LocalScheduler arm/fire).
+        self._armed_pumps = 0
+        #: Fusion observability: fast-forwarded no-op ticks, fused spans
+        #: served / their tick count, replay aborts, declined plans.
+        self.span_stats: Dict[str, int] = {
+            "ff_ticks": 0,
+            "fused_spans": 0,
+            "fused_ticks": 0,
+            "span_aborts": 0,
+            "spans_declined": 0,
+        }
         policy.bind(self)
 
     # -- lifecycle -------------------------------------------------------
@@ -321,10 +415,19 @@ class GlobalScheduler(LogMixin):
         # Monotone — ``_local`` drops finished apps, so its size recycles.
         app._submit_ordinal = self._n_submitted
         self._n_submitted += 1
-        local = LocalScheduler(self.env, app, self.submit_q, self.interval)
+        self._span_epoch += 1
+        local = LocalScheduler(
+            self.env, app, self.submit_q, self.interval, scheduler=self
+        )
         self._local[app.id] = local
         self._n_unfinished += 1
         local.start()
+        # A submission while the dispatch loop sleeps across a
+        # fast-forwarded window (serve-mode thread injection) must pull
+        # the wake back to the next grid tick, or the new app would wait
+        # out the whole window.
+        if self._ff_evt is not None and not self._ff_evt.triggered:
+            self._reschedule_ff_wake()
 
     def get_local(self, app_id: str) -> Optional[LocalScheduler]:
         return self._local.get(app_id)
@@ -333,6 +436,7 @@ class GlobalScheduler(LogMixin):
     def _dispatch_loop(self):
         env, cluster = self.env, self.cluster
         while self.is_active:
+            at_boundary = False
             ready: List[Task] = []
             while self._wait_stack:
                 ready.append(self._wait_stack.pop())  # LIFO, ref popitem()
@@ -363,52 +467,393 @@ class GlobalScheduler(LogMixin):
                     for task in ready:
                         self._pending_since.setdefault(task, now)
                 ctx = TickContext(self, ready, self._tick_seq)
-                with self.tracer.span(
-                    "scheduler", "tick", env.now, n_ready=len(ready)
-                ) as span_args:
-                    placements = self.policy.place(ctx)
-                    if self.tracer.enabled:
-                        span_args["n_placed"] = int(
-                            sum(1 for h in placements if h >= 0)
-                        )
-                self._tick_seq += 1
-                # Reference parity: consume placements in the policy's
-                # visit order (``schedule()``'s return order) — it sets
-                # both the within-tick dispatch sequence and, decisively,
-                # the wait-queue insertion order that next tick's LIFO
-                # drain reverses (ref ``scheduler/__init__.py:102-115``).
-                visit = (
-                    ctx.visit_order
-                    if ctx.visit_order is not None
-                    else range(len(ready))
+                plan = (
+                    self._extract_span(ctx) if self.fuse_spans else None
                 )
-                live = ctx.live_mask
-                for i in visit:
-                    task, h_idx = ready[i], placements[i]
-                    if not task.is_nascent:
-                        self.logger.error("task %s not nascent at dispatch", task.id)
-                        continue
-                    if h_idx < 0:
-                        task.placement = None
-                        self._wait_stack.append(task)
-                    else:
-                        host = ctx.hosts[int(h_idx)]
-                        if not host.up or (
-                            live is not None and not live[int(h_idx)]
-                        ):
-                            self.placement_violations.append(
-                                f"t={env.now:.3f}: task {task.id} placed on "
-                                f"{'down' if not host.up else 'quarantined'} "
-                                f"host {host.id}"
+                if plan is not None:
+                    at_boundary = yield from self._serve_span(ctx, plan)
+                else:
+                    with self.tracer.span(
+                        "scheduler", "tick", env.now, n_ready=len(ready)
+                    ) as span_args:
+                        placements = self.policy.place(ctx)
+                        if self.tracer.enabled:
+                            span_args["n_placed"] = int(
+                                sum(1 for h in placements if h >= 0)
                             )
-                        task.placement = host.id
-                        cluster.dispatch_q.put(task)
-                        task.set_submitted()
-                        if self.meter:
-                            self.meter.add_scheduling_turnover(
-                                env.now - self._pending_since.pop(task, env.now)
+                    self._tick_seq += 1
+                    # Reference parity: consume placements in the
+                    # policy's visit order (``schedule()``'s return
+                    # order) — it sets both the within-tick dispatch
+                    # sequence and, decisively, the wait-queue insertion
+                    # order that next tick's LIFO drain reverses (ref
+                    # ``scheduler/__init__.py:102-115``).
+                    visit = (
+                        ctx.visit_order
+                        if ctx.visit_order is not None
+                        else range(len(ready))
+                    )
+                    self._dispatch_tick(ctx, ready, placements, visit)
+            if at_boundary:
+                # A span replay aborted at a fresh, unprocessed tick
+                # instant: run that tick now, without sleeping.
+                continue
+            if self.fuse_spans:
+                yield from self._sleep_to_next_tick()
+            else:
+                yield env.timeout(self.interval)
+
+    def _dispatch_tick(self, ctx, ready, placements, visit) -> None:
+        """Consume one tick's placements in visit order: dispatch placed
+        tasks, re-stack unplaced ones — the half of the tick the fused
+        span replay shares with the per-tick path."""
+        env, cluster = self.env, self.cluster
+        live = ctx.live_mask
+        for i in visit:
+            task, h_idx = ready[i], placements[i]
+            if not task.is_nascent:
+                self.logger.error("task %s not nascent at dispatch", task.id)
+                continue
+            if h_idx < 0:
+                task.placement = None
+                self._wait_stack.append(task)
+            else:
+                host = ctx.hosts[int(h_idx)]
+                if not host.up or (
+                    live is not None and not live[int(h_idx)]
+                ):
+                    self.placement_violations.append(
+                        f"t={env.now:.3f}: task {task.id} placed on "
+                        f"{'down' if not host.up else 'quarantined'} "
+                        f"host {host.id}"
+                    )
+                task.placement = host.id
+                cluster.dispatch_q.put(task)
+                task.set_submitted()
+                if self.meter:
+                    self.meter.add_scheduling_turnover(
+                        env.now - self._pending_since.pop(task, env.now)
+                    )
+
+    # -- pure-tick-run fusion (round 8) -----------------------------------
+    #
+    # A *pure tick run* is a window of upcoming ticks whose scheduler
+    # inputs are computable now: the event heap holds nothing before the
+    # window's end except local-pump deliveries (whose payloads are
+    # snapshot-stable over the window), no quarantine expires inside it,
+    # and therefore availability / live mask / ready sets evolve only by
+    # this scheduler's own placements.  Two exploits:
+    #
+    #   * ``_sleep_to_next_tick`` — after ANY tick, the unplaced remainder
+    #     provably cannot place until the window ends (availability only
+    #     decreases within it, and a task that had no fitting host at its
+    #     own step availability — a superset of every later snapshot —
+    #     never gains one), so the in-window ticks are exact no-ops: the
+    #     loop accounts their meters/wait-queue churn in O(1) kernel
+    #     dispatches (zero) and sleeps to the first potentially-productive
+    #     tick.  The sleep is early-wakeable by ``submit`` (serve-mode
+    #     thread injection).
+    #   * ``_extract_span``/``_serve_span`` — when pump deliveries land
+    #     INSIDE the window, placements genuinely evolve across ticks;
+    #     a span-capable device policy executes the whole window as one
+    #     fused device program (``place_span`` → ``ops/tickloop.py``) and
+    #     the loop replays the precomputed decisions tick by tick.  The
+    #     folded pumps are never touched — they fire normally during the
+    #     replay (each bump of ``_span_epoch`` is *expected*); any
+    #     UNexpected epoch bump (completion, foreign submission) aborts
+    #     the remaining span before the affected tick, which is exact:
+    #     committed ticks saw precisely the state the device assumed.
+
+    def _quarantine_bound(self, now: float) -> float:
+        if self.breaker is None:
+            return float("inf")
+        return self.breaker.next_expiry(now)
+
+    def _pump_allow(self):
+        """Heap-scan predicate approving OUR locals' armed pump entries."""
+        def allow(ev) -> bool:
+            owner = getattr(ev, "owner", None)
+            return (
+                type(ev) is Callback
+                and isinstance(owner, LocalScheduler)
+                and self._local.get(owner.application.id) is owner
+            )
+        return allow
+
+    def _extract_span(self, ctx: "TickContext") -> Optional[SpanPlan]:
+        """Try to extract (and device-price) a fused span starting at the
+        current tick.  Returns a plan with ``outcome`` filled, or None —
+        in which case NOTHING was mutated and the per-tick path serves
+        the tick.  Spans need a span-capable policy AND at least one
+        non-empty in-window pump delivery; windows without deliveries are
+        the fast-forward path's business (strictly cheaper)."""
+        policy = self.policy
+        place_span = getattr(policy, "place_span", None)
+        if place_span is None or not policy.span_capable():
+            return None
+        if self._armed_pumps == 0:
+            # O(1) bail before the O(heap) scan: spans exist to fold
+            # in-window pump deliveries; with no pump armed there is
+            # nothing to fold (fast-forward owns delivery-free windows).
+            return None
+        env = self.env
+        now = env.now
+        t_foreign, allowed = env.scan_window(allow=self._pump_allow())
+        if not allowed:
+            return None
+        t_bound = min(t_foreign, self._quarantine_bound(now))
+        cap = int(getattr(policy, "span_cap", 32))
+        # Exact grid: iterated float adds, the same op sequence the
+        # sequential timeout chain performs — anchor + k*interval can
+        # differ by an ulp and shift every in-window event comparison.
+        grid = [now]
+        t = now
+        for _ in range(cap - 1):
+            t = t + self.interval
+            if t >= t_bound:
+                break
+            grid.append(t)
+        if len(grid) < 2:
+            return None
+        k_span = len(grid)
+        slots: List[Task] = list(ctx.tasks)
+        arrive: List[int] = [0] * len(slots)
+        pump_ticks: List[int] = []
+        any_delivery = False
+        for (t_p, _prio, _seq, cb) in allowed:
+            if t_p > grid[-1]:
+                continue  # delivers beyond the span; stays armed
+            # Delivery tick: first grid instant at-or-after the pump.  A
+            # pump landing EXACTLY on a grid instant fires BEFORE that
+            # tick — any in-window pump was armed before the span
+            # started, so its heap seq precedes the replay timeout
+            # scheduled one interval earlier (identical ordering to the
+            # sequential chain's per-tick timeouts).
+            tick_i = next(i for i in range(1, k_span) if grid[i] >= t_p)
+            pump_ticks.append(tick_i)
+            snap = cb.owner.pump_snapshot()
+            if snap:
+                any_delivery = True
+            slots.extend(snap)
+            arrive.extend([tick_i] * len(snap))
+        if not any_delivery:
+            return None
+        plan = SpanPlan(ctx, grid, slots, arrive, pump_ticks,
+                        self._span_epoch)
+        outcome = place_span(ctx, plan)
+        if outcome is None:
+            self.span_stats["spans_declined"] += 1
+            return None
+        plan.outcome = outcome
+        return plan
+
+    def _serve_span(self, ctx: "TickContext", plan: SpanPlan):
+        """Replay a priced span: commit the precomputed placements tick
+        by tick, sleeping the normal interval in between so in-window
+        events (transfers, the folded pumps themselves) fire exactly as
+        they would sequentially.  Yields from ``_dispatch_loop``; returns
+        True when the replay aborted at a fresh unprocessed tick instant
+        (the caller re-enters its loop body without sleeping)."""
+        env = self.env
+        outcome = plan.outcome
+        placements = outcome.placements  # [K, B] slot-indexed, host numpy
+        slots = plan.slots
+        slot_of = {task: s for s, task in enumerate(slots)}
+        decreasing = bool(getattr(self.policy, "decreasing", False))
+        if decreasing:
+            dem = np.stack([t.demand for t in slots])
+            norms = np.sqrt(np.sum(dem * dem, axis=1))
+        self.span_stats["fused_spans"] += 1
+        ready_k = list(ctx.tasks)
+        for k in range(plan.n_ticks):
+            if k > 0:
+                yield env.timeout(self.interval)
+                expected = plan.epoch + sum(
+                    1 for pt in plan.pump_ticks if pt <= k
+                )
+                if self._span_epoch != expected or not self.is_active:
+                    self.span_stats["span_aborts"] += 1
+                    return True
+                ready_k = []
+                while self._wait_stack:
+                    ready_k.append(self._wait_stack.pop())
+                ready_k.extend(self.submit_q.drain())
+                if any(t not in slot_of for t in ready_k):
+                    # Defensive: the batch diverged from the speculation
+                    # (should be unreachable under the epoch check) —
+                    # serve this tick live and end the span.
+                    self.span_stats["span_aborts"] += 1
+                    sub_ctx = TickContext(self, ready_k, self._tick_seq)
+                    with self.tracer.span(
+                        "scheduler", "tick", env.now, n_ready=len(ready_k)
+                    ) as span_args:
+                        live_placements = self.policy.place(sub_ctx)
+                        if self.tracer.enabled:
+                            span_args["n_placed"] = int(
+                                sum(1 for h in live_placements if h >= 0)
                             )
-            yield env.timeout(self.interval)
+                    self._tick_seq += 1
+                    visit = (
+                        sub_ctx.visit_order
+                        if sub_ctx.visit_order is not None
+                        else range(len(ready_k))
+                    )
+                    self._dispatch_tick(
+                        sub_ctx, ready_k, live_placements, visit
+                    )
+                    return False
+                if not ready_k:
+                    continue  # pool drained, cohort still ahead
+                if self.meter:
+                    self.meter.increment_scheduling_ops(len(ready_k))
+                    now = env.now
+                    for task in ready_k:
+                        self._pending_since.setdefault(task, now)
+            row = placements[k]
+            pl = [int(row[slot_of[t]]) for t in ready_k]
+            if self.tracer.enabled:
+                with self.tracer.span(
+                    "scheduler", "tick", env.now, n_ready=len(ready_k)
+                ) as span_args:
+                    span_args["n_placed"] = int(
+                        sum(1 for h in pl if h >= 0)
+                    )
+            self._tick_seq += 1
+            self.span_stats["fused_ticks"] += 1
+            if decreasing:
+                bn = norms[[slot_of[t] for t in ready_k]]
+                visit = [int(j) for j in np.argsort(-bn, kind="stable")]
+            else:
+                visit = list(range(len(ready_k)))
+            self._dispatch_tick(ctx, ready_k, pl, visit)
+        return False
+
+    def _reschedule_ff_wake(self) -> None:
+        """Pull a pending fast-forward wake back to the first grid tick
+        strictly after now (a submission injected work mid-window).  The
+        woken loop processes that tick IMMEDIATELY — it is the first tick
+        that can see the new work, exactly when the sequential chain
+        would have drained it."""
+        env = self.env
+        t = self._ff_anchor
+        while t <= env.now:
+            t = t + self.interval
+        if self._ff_rescheduled and t >= self._ff_target:
+            return  # an earlier submission already pulled the wake ≤ t
+        if self._ff_cb is not None:
+            self._ff_cb.cancel()
+        self._ff_rescheduled = True
+        self._ff_target = t
+        evt = self._ff_evt
+        self._ff_cb = env.schedule_callback_at(
+            t, lambda: None if evt.triggered else evt.succeed()
+        )
+
+    def _noop_tick_churn(self, stack: List[Task]) -> List[Task]:
+        """Wait-stack state after one provably-no-op tick: drain
+        (LIFO-reversed), visit in the policy's order, push back.  The
+        decreasing VBP arms visit norm-descending (``_sort_decreasing``
+        semantics — stable on ties); everything else visits in batch
+        order, i.e. the stack simply reverses."""
+        ready = list(reversed(stack))
+        if getattr(self.policy, "decreasing", False):
+            dem = np.stack([t.demand for t in ready])
+            norms = np.sqrt(np.sum(dem * dem, axis=1))
+            order = np.argsort(-norms, kind="stable")
+            return [ready[int(i)] for i in order]
+        return ready
+
+    def _sleep_to_next_tick(self):
+        """Sleep to the next tick that could possibly make progress,
+        accounting the provably-no-op ticks in between without paying a
+        policy dispatch for any of them.  The last hop is a plain
+        ``timeout(interval)`` issued from the final skipped instant, so
+        same-instant event ordering at the productive tick is identical
+        to the sequential chain's."""
+        env = self.env
+        interval = self.interval
+        anchor = env.now
+        # O(1) bail before the O(heap) scan: an event due before the
+        # next tick makes that tick the first potentially-productive one
+        # — nothing to skip (the overwhelmingly common case in busy
+        # phases, where the heap is at its largest).
+        if env.peek() < anchor + interval:
+            yield env.timeout(interval)
+            return
+        t_foreign, _ = env.scan_window()
+        t_bound = min(t_foreign, self._quarantine_bound(anchor))
+        # First grid tick at-or-after the bound may see input — run it.
+        # Everything strictly before is a no-op: empty-ready if the wait
+        # stack is empty, a zero-placement re-scan otherwise.
+        n_skip = 0
+        t = anchor + interval
+        if t_bound != float("inf"):
+            while t < t_bound and n_skip < 1_000_000:
+                n_skip += 1
+                t = t + interval
+        if n_skip == 0:
+            yield env.timeout(interval)
+            return
+        self._ff_anchor = anchor
+        self._ff_rescheduled = False
+        self._ff_target = float("inf")
+        evt = env.event()
+        self._ff_evt = evt
+        # Wake at the LAST no-op instant (one interval short of the
+        # productive tick); the final timeout below is issued from that
+        # instant exactly like the sequential chain's last timeout, so
+        # same-instant event ordering at the productive tick matches.
+        last_noop = anchor
+        for _ in range(n_skip):
+            last_noop = last_noop + interval
+        self._ff_cb = env.schedule_callback_at(
+            last_noop, lambda: None if evt.triggered else evt.succeed()
+        )
+        yield evt
+        self._ff_evt = None
+        self._ff_cb = None
+        rescheduled = self._ff_rescheduled
+        self._ff_rescheduled = False
+        # Lazily account what was actually skipped — an early wake via
+        # ``submit`` shortens the window, and its wake instant is the
+        # first tick that can SEE the submission: it is processed, not
+        # skipped.  A normal wake's instant is itself a provable no-op;
+        # the trailing timeout then reaches the productive tick.
+        now = env.now
+        skipped = 0
+        t = anchor + interval
+        while t < now:
+            skipped += 1
+            t = t + interval
+        if not rescheduled:
+            skipped += 1  # the wake instant itself (t == now)
+        stack = self._wait_stack
+        if skipped > 0:
+            self.span_stats["ff_ticks"] += skipped
+        if skipped > 0 and stack:
+            if self.meter:
+                self.meter.increment_scheduling_ops(skipped * len(stack))
+            if self.tracer.enabled:
+                t = anchor
+                for _ in range(skipped):
+                    t = t + interval
+                    with self.tracer.span(
+                        "scheduler", "tick", t, n_ready=len(stack)
+                    ) as span_args:
+                        span_args["n_placed"] = 0
+            self._tick_seq += skipped
+            # Stack churn has period 2 after the first tick (a stable
+            # sort of a reversed sorted list flips tie runs; flipping
+            # again restores them), so two explicit churns cover any m.
+            s1 = self._noop_tick_churn(stack)
+            if skipped == 1:
+                final = s1
+            else:
+                s2 = self._noop_tick_churn(s1)
+                final = s1 if skipped % 2 == 1 else s2
+            self._wait_stack = final
+        if not rescheduled:
+            yield env.timeout(interval)
 
     # -- the completion listener -----------------------------------------
     def _listen_loop(self):
@@ -425,6 +870,7 @@ class GlobalScheduler(LogMixin):
 
     def _handle_notification(self, item):
         env = self.env
+        self._span_epoch += 1  # completions invalidate speculated spans
         success, task = item
         app = task.application
         if app is None:

@@ -40,6 +40,21 @@ compiles one program per (G-bucket, T-bucket, H) triple, never per group
 size.  Pad rows replicate request 0 (no NaNs, no shape churn) and their
 outputs are discarded.
 
+**Whole spans, not just single ticks (round 8).**  The request model is
+kernel-agnostic — a request is (callable, same-shaped arrays, static
+config) — so the fused tick driver (``ops/tickloop.py``) rides the same
+machinery: ``sched.tpu.place_span`` routes through ``_call_kernel``
+exactly like a per-tick kernel call, and co-pending same-shape spans of
+G lock-step runs coalesce into one vmapped dispatch covering G×K
+simulator ticks.  Span *lengths* may differ per row (``n_ticks_dyn`` is
+a stacked operand): the driver's loop body is per-row inert once a
+row's horizon ends, asserted by ``tests/test_tickloop.py::
+test_fused_span_batched_rows_stay_inert``.  This is also what
+simplified the request model's economics at G=1: a lone live slot now
+takes a synchronous same-thread fast path (``single_fast_path`` stat)
+instead of paying the queue hand-off and coordinator hop for a batch of
+one.
+
 Two layers:
 
   * :func:`batch_execute` — the pure core: take N same-shaped kernel
@@ -228,6 +243,28 @@ class BatchClient:
             # after its slot was reclaimed must not re-enter the barrier:
             # its request would inflate the quiescence count forever.
             raise RuntimeError("batch client is closed")
+        batcher = self._batcher
+        with batcher._cond:
+            # Single-live-slot fast path: a G=1 grid (or the last
+            # surviving run of a larger one) has nobody to coalesce
+            # with, so the queue hand-off and the coordinator-thread hop
+            # buy nothing — serve the call synchronously on this thread.
+            # Safe under the lock snapshot: we ARE the one open slot (a
+            # closed client raised above), nothing is pending to group
+            # with, and we never enter ``_pending``, so the coordinator
+            # stays parked on its wait predicate.  Bit-identical by the
+            # same contract as a one-request flush (``batch_execute``
+            # serves both through the unbatched kernel program).
+            solo = batcher._open == 1 and not batcher._pending
+            if solo:
+                batcher.stats["dispatches"] += 1
+                batcher.stats["device_calls"] += 1
+                batcher.stats["single_fast_path"] += 1
+        if solo:
+            return batch_execute(
+                kernel, [(tuple(args), dict(arr_kw or {}))],
+                dict(static_kw or {}),
+            )[0]
         req = _Request(
             self.slot, kernel, tuple(args), dict(arr_kw or {}),
             dict(static_kw or {}),
@@ -288,8 +325,11 @@ class DispatchBatcher:
     ``tests/test_batch_dispatch.py`` and ``docs/ARCHITECTURE.md``):
     ``runs`` (slots), ``dispatches`` (kernel calls requested),
     ``device_calls`` (actual dispatches issued), ``coalesced`` (requests
-    served inside a >1 batch), ``max_group`` (largest batch), and
-    ``deadline_flushes`` (partial flushes forced by ``flush_after``).
+    served inside a >1 batch), ``max_group`` (largest batch),
+    ``deadline_flushes`` (partial flushes forced by ``flush_after``),
+    and ``single_fast_path`` (calls served synchronously on the owning
+    thread because theirs was the only live slot — no queue hand-off,
+    no coordinator hop).
     """
 
     def __init__(self, n_slots: int, flush_after: Optional[float] = None):
@@ -311,6 +351,7 @@ class DispatchBatcher:
             "coalesced": 0,
             "max_group": 0,
             "deadline_flushes": 0,
+            "single_fast_path": 0,
         }
 
     def client(self) -> BatchClient:

@@ -177,3 +177,14 @@ class HostCircuitBreaker:
     def n_quarantined(self) -> int:
         """Hosts with a (possibly expired, not yet pruned) quarantine."""
         return len(self._until)
+
+    def next_expiry(self, now: float) -> float:
+        """Earliest instant a live quarantine expires, or ``+inf``.
+
+        Quarantine expiry is the one scheduler-visible state change that
+        happens by CLOCK rather than by event (``is_quarantined`` just
+        compares ``now``), so the pure-tick-run extractor must bound its
+        fused windows by it: a tick at or past an expiry sees a larger
+        live mask and is no longer a provable no-op."""
+        live = [u for u in self._until.values() if u > now]
+        return min(live) if live else float("inf")

@@ -67,6 +67,7 @@ from pivot_tpu.ops.pallas_kernels import (
     cost_aware_pallas,
     cost_aware_pallas_batched,
 )
+from pivot_tpu.ops.tickloop import fused_tick_run, span_bucket
 from pivot_tpu.sched import Policy, TickContext
 from pivot_tpu.sched.policies import (
     BestFitPolicy,
@@ -101,6 +102,15 @@ def pad_bucket(n: int) -> int:
 # Shared wedged-tunnel guard (moved to utils in round 2 so the estimator
 # CLI flows get the same protection as the policy path).
 from pivot_tpu.utils import ensure_live_backend as _ensure_live_backend  # noqa: E402
+
+
+class _SpanOutcome:
+    """A priced span: slot-indexed per-tick placements, host-fetched."""
+
+    __slots__ = ("placements",)
+
+    def __init__(self, placements: np.ndarray):
+        self.placements = placements
 
 
 def _probe_device_floor() -> float:
@@ -309,6 +319,92 @@ class _DevicePolicyBase(Policy):
         self._consecutive_failures = 0
         return out
 
+    # -- fused span tier (round 8, ``ops/tickloop.py``) --------------------
+    #
+    # The routing ladder is now: ``place_span`` (a whole pure tick run as
+    # one device program) above ``place`` (one tick per dispatch) above
+    # the adaptive CPU twin.  The scheduler extracts spans
+    # (``GlobalScheduler._extract_span``) and calls ``place_span`` only
+    # when the policy advertises ``span_capable()``; any declined or
+    # aborted span falls back to the per-tick path below, bit-identically
+    # — placements depend only on per-tick inputs, and the opportunistic
+    # Philox stream is stateless (keyed on tick_seq), so serving a tick
+    # from the span program, the per-tick kernel, or the CPU twin yields
+    # the same decisions on the CPU backend.
+
+    #: Maximum ticks fused per span (the K axis of the tick driver);
+    #: bucketed by ``span_bucket`` so XLA compiles one program per
+    #: (K-bucket, B-bucket, H, config).
+    span_cap = 32
+
+    def span_capable(self) -> bool:
+        """Fused spans need deterministic device routing (no adaptive
+        twin), a healthy kernel (not degraded), and the scan-form kernel
+        family (the Pallas kernel has no tick-loop form)."""
+        return not (
+            self.adaptive
+            or self.degraded
+            or getattr(self, "use_pallas", False)
+            or getattr(self, "realtime_bw", False)
+        )
+
+    def _span_kw(self, ctx: TickContext, plan, dem_host: np.ndarray,
+                 B: int, K: int) -> Optional[dict]:
+        """Policy-specific driver operands (None declines the span)."""
+        raise NotImplementedError
+
+    def place_span(self, ctx: TickContext, plan):
+        """Serve a whole pure tick run as ONE fused device dispatch.
+
+        Builds the slot-level span operands (demands, cohort arrival
+        ticks, per-policy streams), runs ``ops.tickloop.fused_tick_run``
+        — through the cross-run batcher when one is attached, so
+        co-pending spans of G grid runs coalesce into a single vmapped
+        dispatch exactly like single ticks do — and returns an outcome
+        whose ``placements[k, s]`` is slot ``s``'s host index at span
+        tick ``k`` (−1 unplaced).  Returns None to decline (the
+        scheduler then serves the tick per-tick, bit-identically).
+        """
+        slots = plan.slots
+        S = len(slots)
+        B = pad_bucket(S)
+        k_dyn = plan.n_ticks
+        K = span_bucket(k_dyn)
+        dem_host = np.stack([t.demand for t in slots])
+        kw = self._span_kw(ctx, plan, dem_host, B, K)
+        if kw is None:
+            return None
+        dem = np.zeros((B, 4), dtype=np.dtype(self.dtype))
+        dem[:S] = dem_host
+        arrive = np.full(B, K, dtype=np.int32)
+        arrive[:S] = plan.arrive
+        live = ctx.live_mask
+        if live is not None:
+            kw["live"] = self._stage(live)
+        res = self._call_kernel(
+            fused_tick_run,
+            self._stage(ctx.avail, self.dtype),
+            self._stage(dem),
+            self._stage(arrive),
+            np.int32(k_dyn),
+            n_ticks=K,
+            **kw,
+        )
+        # ONE host fetch — the placements matrix is the span's entire
+        # host-visible output (meters derive from it in the replay).
+        return _SpanOutcome(np.asarray(res.placements))
+
+    def _span_norms(self, dem_host: np.ndarray, B: int):
+        """Host-side f64 demand norms padded to the slot bucket — the
+        exact ``_sort_decreasing`` keys, staged for the driver's
+        device-side ordering so a device-recomputed norm can never round
+        a tie differently than the CPU twin's sort."""
+        norms = np.zeros(B, dtype=np.float64)
+        norms[: dem_host.shape[0]] = np.sqrt(
+            np.sum(dem_host * dem_host, axis=1)
+        )
+        return self._stage(norms)
+
     # -- adaptive dispatch ------------------------------------------------
     def place(self, ctx: TickContext) -> np.ndarray:
         if self.degraded and self._cpu_twin is not None:
@@ -466,6 +562,19 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
         super().__init__(adaptive, phase2, degrade_after)
         self._cpu_twin = OpportunisticPolicy(mode="numpy")
 
+    def _span_kw(self, ctx, plan, dem_host, B, K):
+        # [K, B] positional Philox rows: tick k of the span consumes
+        # ``tick_uniforms(seed, tick_seq + k, ·)`` exactly like the
+        # sequential path (prefix property — the per-tick path draws the
+        # first T_k of the same counter stream), so span service leaves
+        # the stream aligned for any fallback tick.
+        seed = ctx.scheduler.seed or 0
+        u = np.zeros((K, B), dtype=np.float64)
+        for k in range(plan.n_ticks):
+            u[k] = tick_uniforms(seed, ctx.tick_seq + k, B)
+        return dict(policy="opportunistic", uniforms=self._stage(u, self.dtype),
+                    phase2=self.phase2)
+
     def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
         avail, dem, valid = self._padded(ctx)
@@ -487,6 +596,15 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
         super().__init__(adaptive, phase2, degrade_after)
         self.decreasing = decreasing
         self._cpu_twin = FirstFitPolicy(decreasing=decreasing, mode="numpy")
+
+    def _span_kw(self, ctx, plan, dem_host, B, K):
+        return dict(
+            policy="first-fit", strict=False, decreasing=self.decreasing,
+            sort_norm=(
+                self._span_norms(dem_host, B) if self.decreasing else None
+            ),
+            totals=self._staged_topology().totals, phase2=self.phase2,
+        )
 
     def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
@@ -532,6 +650,15 @@ class TpuBestFitPolicy(_DevicePolicyBase):
         super().__init__(adaptive, phase2, degrade_after)
         self.decreasing = decreasing
         self._cpu_twin = BestFitPolicy(decreasing=decreasing, mode="numpy")
+
+    def _span_kw(self, ctx, plan, dem_host, B, K):
+        return dict(
+            policy="best-fit", decreasing=self.decreasing,
+            sort_norm=(
+                self._span_norms(dem_host, B) if self.decreasing else None
+            ),
+            totals=self._staged_topology().totals, phase2=self.phase2,
+        )
 
     def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
@@ -635,6 +762,50 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 "sublane axis — drop use_pallas=True"
             )
         super().enable_batching(client)
+
+    def _span_kw(self, ctx, plan, dem_host, B, K):
+        if self.realtime_bw:
+            return None  # live route-queue samples are per-tick host state
+        slots = plan.slots
+        # Per-slot anchor identity and zone: anchors are span-constant
+        # (a ready group's predecessors are finished with immutable
+        # placements; root anchors are entity-keyed draws), so ONE
+        # grouping walk covers every tick — the driver re-derives each
+        # tick's first-seen bucket order from its own batch order.
+        span_ctx = TickContext(ctx.scheduler, list(slots), ctx.tick_seq)
+        groups = self._grouper.group_tasks(span_ctx)
+        storage = ctx.cluster.storage
+        meta = ctx.meta
+        az = np.zeros(B, dtype=np.int32)
+        bucket = np.zeros(B, dtype=np.int32)
+        for bi, (anchor, idxs) in enumerate(groups.items()):
+            if not hasattr(anchor, "locality"):  # root group → keyed storage
+                anchor = storage[
+                    resolve_root_anchor(span_ctx, anchor, len(storage))
+                ]
+            zone = meta.zone_index[anchor.locality]
+            for i in idxs:
+                az[i] = zone
+                bucket[i] = bi
+        topo = self._staged_topology()
+        return dict(
+            policy="cost-aware",
+            bin_pack=self.bin_pack,
+            sort_tasks=self.sort_tasks,
+            sort_hosts=self.sort_hosts,
+            host_decay=self.host_decay,
+            sort_norm=(
+                self._span_norms(dem_host, B) if self.sort_tasks else None
+            ),
+            anchor_zone=self._stage(az),
+            bucket_id=self._stage(bucket),
+            cost_zz=topo.cost,
+            bw_zz=topo.bw,
+            host_zone=topo.host_zone,
+            base_task_counts=self._stage(ctx.host_task_counts, jnp.int32),
+            totals=topo.totals,
+            phase2=self.phase2,
+        )
 
     def _anchor_stream(self, ctx: TickContext):
         """The kernel's per-task anchor stream: ``(order, az_arr [B] i32,

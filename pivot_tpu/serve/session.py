@@ -112,6 +112,14 @@ class ServeSession(LogMixin):
             retry=retry,
             breaker=breaker,
             slo=self.slo,
+            # Serving keeps per-tick dispatch: the SLO meter counts one
+            # decision latency per dispatch and the ServeDriver's whole
+            # amortization story is coalescing co-pending per-tick calls
+            # ACROSS sessions (a fused span would collapse several ticks
+            # into one dispatch and skew both).  Span outputs are
+            # bit-identical either way — asserted by the serve-vs-batch
+            # parity test, whose batch arm runs with fusion on.
+            fuse_spans=False,
         )
         self.cluster.start()
         self.scheduler.start()

@@ -158,6 +158,15 @@ _SLOW_TESTS = {
         "test_full_sim_parity_opportunistic",
     ],
     "test_sensitivity.py": ["test_cli_sensitivity_paired_experiment"],
+    "test_tickloop.py": [
+        # Quick twins in tier 1: test_fused_span_parity_quick,
+        # test_fused_span_parity_live_mask_quick,
+        # test_des_fused_span_bit_parity_quick, plus the chaos/FF
+        # interruption tests.  The K-sweep and full device-policy DES
+        # parity tests also carry the ``fused`` marker (-m fused).
+        "test_fused_span_parity_sweep_full",
+        "test_des_fused_span_bit_parity_full",
+    ],
     "test_tpu_validate.py": [
         "test_parity_sweep_interpret_smoke",
         "test_hw_r03_smoke",

@@ -117,7 +117,7 @@ def test_lockstep_grid_smoke_and_stats_keys():
 
     assert set(stats) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
-        "deadline_flushes",
+        "deadline_flushes", "single_fast_path",
     }
     assert stats["runs"] == 2
     assert stats["device_calls"] <= stats["dispatches"]
@@ -259,6 +259,60 @@ def test_idle_slot_excluded_from_quiescence():
     c1.close()
     server.join(timeout=30)
     assert not server.is_alive()
+
+
+def test_single_live_slot_fast_path_parity():
+    """A G=1 batcher (and the last survivor of a larger one) serves
+    dispatches synchronously on the calling thread — no queue hand-off,
+    no coordinator hop — with bit-identical results to the coordinator
+    path and the ``single_fast_path`` counter tracking it."""
+    import threading
+
+    from pivot_tpu.ops.kernels import first_fit_kernel
+    from pivot_tpu.sched.batch import DispatchBatcher
+
+    rng = np.random.default_rng(0)
+    avail = rng.uniform(1, 8, (8, 4))
+    dem = rng.uniform(0.2, 2.0, (16, 4))
+    valid = np.ones(16, dtype=bool)
+    args = (avail, dem, valid)
+    direct_p, direct_a = first_fit_kernel(
+        *(jnp.asarray(a) for a in args), strict=False
+    )
+
+    # G=1 from construction: every dispatch takes the fast path.
+    batcher = DispatchBatcher(1)
+    coord = threading.Thread(target=batcher.serve)
+    coord.start()
+    client = batcher.client()
+    out_p, out_a = client.dispatch(
+        first_fit_kernel, args, static_kw={"strict": False}
+    )
+    client.close()
+    coord.join(timeout=10)
+    assert not coord.is_alive()
+    np.testing.assert_array_equal(np.asarray(direct_p), out_p)
+    np.testing.assert_array_equal(np.asarray(direct_a), out_a)
+    assert batcher.stats["single_fast_path"] == 1
+    assert batcher.stats["dispatches"] == 1
+    assert batcher.stats["device_calls"] == 1
+    assert batcher.stats["coalesced"] == 0
+
+    # Last survivor of a G=2 batcher: after the partner closes, the
+    # remaining slot's dispatches take the fast path too.
+    batcher2 = DispatchBatcher(2)
+    coord2 = threading.Thread(target=batcher2.serve)
+    coord2.start()
+    c_a, c_b = batcher2.client(), batcher2.client()
+    c_b.close()
+    out_p2, _ = c_a.dispatch(
+        first_fit_kernel, args, static_kw={"strict": False}
+    )
+    c_a.close()
+    coord2.join(timeout=10)
+    assert not coord2.is_alive()
+    np.testing.assert_array_equal(np.asarray(direct_p), out_p2)
+    assert batcher2.stats["single_fast_path"] == 1
 
 
 def test_batch_execute_matches_individual_calls():

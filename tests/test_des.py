@@ -220,3 +220,51 @@ def test_any_of_already_processed_event():
     env.process(proc())
     env.run()
     assert results == [(True, 3)]
+
+
+def test_callback_cancel_is_inert():
+    """A cancelled callback stays queued (heap middles are O(n) to pop)
+    but fires as a no-op; sim time still advances through its instant."""
+    from pivot_tpu.des import Callback
+
+    env = Environment()
+    fired = []
+    cb = env.schedule_callback(3, lambda: fired.append("cancelled"))
+    env.schedule_callback(5, lambda: fired.append("live"))
+    assert isinstance(cb, Callback) and not cb.cancelled
+    cb.cancel()
+    assert cb.cancelled
+    env.run()
+    assert fired == ["live"]
+    assert env.now == 5
+
+
+def test_scan_window_classifies_heap():
+    """``scan_window`` returns the earliest foreign instant and the
+    approved entries strictly before it, in firing order — cancelled
+    callbacks invisible, excluded events skipped, approved entries at or
+    past the foreign instant dropped."""
+    env = Environment()
+    own = env.schedule_callback(5, lambda: None)
+    pump_a = env.schedule_callback(3, lambda: None)
+    pump_a.owner = "pump"
+    pump_b = env.schedule_callback(7, lambda: None)
+    pump_b.owner = "pump"
+    ghost = env.schedule_callback(1, lambda: None)
+    ghost.cancel()
+    foreign = env.schedule_callback(6, lambda: None)
+
+    allow = lambda ev: getattr(ev, "owner", None) == "pump"
+    t_foreign, allowed = env.scan_window(exclude=(own,), allow=allow)
+    assert t_foreign == 6
+    # pump_b (t=7) is past the foreign instant — dropped; pump_a kept.
+    assert [(t, ev) for (t, _p, _s, ev) in allowed] == [(3, pump_a)]
+
+    # No allow predicate: everything uncancelled and unexcluded is
+    # foreign; the earliest wins.
+    t_all, none_allowed = env.scan_window(exclude=(own,))
+    assert t_all == 3 and none_allowed == []
+
+    # Empty heap → +inf.
+    env2 = Environment()
+    assert env2.scan_window() == (float("inf"), [])

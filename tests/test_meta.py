@@ -26,6 +26,73 @@ TIER1_BUDGET_S = 25.0
 MIN_TESTS_FOR_ENFORCEMENT = 50
 
 
+def test_hotpath_lint_clean():
+    """Tier-1 wiring of the fused-hot-path host-sync lint
+    (``tools/hotpath_lint.py``): no host synchronization — fetches,
+    ``.item()``, numpy materialization, scalar coercion of tracers —
+    may appear inside the fused tick driver, the two-phase kernel
+    cores, or the ensemble rollout body.  This is the structural stop
+    against the dispatch floor silently creeping back in."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(__file__), "..", "tools"),
+    )
+    try:
+        import hotpath_lint
+    finally:
+        sys.path.pop(0)
+    violations = hotpath_lint.lint_paths()
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_hotpath_lint_catches_seeded_violations(tmp_path):
+    """Regression: the lint must actually bite.  A seeded file carrying
+    one of each banned construct inside a registered function body
+    produces one violation per construct; a missing registered function
+    is itself flagged (renames can't silently drop coverage)."""
+    import os
+    import sys
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(__file__), "..", "tools"),
+    )
+    try:
+        import hotpath_lint
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "seeded.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def hot_body(x, carry):\n"
+        "    a = np.asarray(x)\n"
+        "    b = x.block_until_ready()\n"
+        "    c = float(carry)\n"
+        "    d = x.item()\n"
+        "    print(x)\n"
+        "    e = int(3)\n"  # literal coercion: allowed
+        "    return a, b, c, d, e\n"
+        "def clean_body(x):\n"
+        "    return x + 1\n"
+    )
+    violations = hotpath_lint.lint_file(str(bad), ["hot_body"])
+    messages = "\n".join(str(v) for v in violations)
+    assert len(violations) == 5, messages
+    assert "np.asarray" in messages
+    assert ".block_until_ready()" in messages
+    assert "float(...)" in messages
+    assert ".item()" in messages
+    assert "print(...)" in messages
+    # Clean function: no violations.
+    assert hotpath_lint.lint_file(str(bad), ["clean_body"]) == []
+    # Missing registration is flagged.
+    missing = hotpath_lint.lint_file(str(bad), ["renamed_away"])
+    assert len(missing) == 1 and "not found" in str(missing[0])
+
+
 def test_tier1_per_test_budget(tier1_durations):
     durations, slow_nodeids = tier1_durations
     if len(durations) < MIN_TESTS_FOR_ENFORCEMENT:

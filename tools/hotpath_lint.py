@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""Static host-sync lint for the fused device hot paths.
+
+The dispatch floor this repo spent three perf rounds killing (cross-run
+batching, two-phase kernels, the fused tick loop) creeps back in through
+ONE line of code: a host synchronization inside a device loop body.  A
+``np.asarray`` on a tracer, an ``.item()``, a ``float(...)`` coercion, a
+stray ``block_until_ready`` — each forces a device→host round trip per
+loop iteration and silently turns an O(1)-dispatch program back into an
+O(K)-dispatch one (worse: under ``jax.jit`` most of these simply fail at
+trace time only when the path is exercised, which a cached-compile test
+run may never do).
+
+This lint walks the AST of the registered hot-path function bodies — the
+fused tick driver (``ops/tickloop.py``), every two-phase kernel core
+(``ops/kernels.py``), and the ensemble rollout tick body
+(``parallel/ensemble/tick.py``) — and fails on any call that can force a
+host sync:
+
+  * ``<x>.block_until_ready(...)``, ``<x>.item(...)``, ``<x>.tolist(...)``
+  * ``np.asarray(...)`` / ``np.array(...)`` (any of the usual numpy
+    aliases) — host materialization of a device value
+  * ``jax.device_get(...)``
+  * ``float(...)`` / ``int(...)`` / ``bool(...)`` on a non-literal —
+    scalar coercion of a tracer blocks on the value
+  * ``print(...)`` — stringification fetches
+
+Nested helper functions defined inside a registered body are scanned
+too (the loop bodies are closures).  Run as a CLI (exit 1 on violation)
+or through :func:`lint_paths` — ``tests/test_meta.py`` wires the clean
+check into tier 1, with a seeded-violation regression proving the lint
+actually bites.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Dict, List, NamedTuple, Sequence
+
+#: Registered hot paths: repo-relative file → function names whose whole
+#: bodies must stay host-sync-free.
+DEFAULT_TARGETS: Dict[str, Sequence[str]] = {
+    "pivot_tpu/ops/tickloop.py": ["_fused_tick_run_impl"],
+    "pivot_tpu/ops/kernels.py": [
+        "opportunistic_impl",
+        "first_fit_impl",
+        "best_fit_impl",
+        "cost_aware_impl",
+        "_opportunistic_scan",
+        "_first_fit_scan",
+        "_best_fit_scan",
+        "_cost_aware_scan",
+        "_slim_drive",
+        "_chunk_drive",
+        "_speculate_commit",
+    ],
+    "pivot_tpu/parallel/ensemble/tick.py": ["_rollout_segment"],
+}
+
+_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_NUMPY_HOST_FNS = {"asarray", "array", "copyto", "savetxt"}
+_COERCIONS = {"float", "int", "bool"}
+
+
+class Violation(NamedTuple):
+    path: str
+    func: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: in {self.func}(): {self.message}"
+
+
+def _is_literal(node: ast.AST) -> bool:
+    """Constant-ish argument — coercing it cannot touch a device value.
+    Covers signed numeric literals (``-1`` parses as UnaryOp(USub,
+    Constant))."""
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_literal(node.operand)
+    return isinstance(node, (ast.Constant, ast.Num, ast.Str))
+
+
+def _check_call(node: ast.Call, path: str, func: str) -> List[Violation]:
+    out: List[Violation] = []
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _SYNC_ATTRS:
+            out.append(Violation(
+                path, func, node.lineno,
+                f"host-sync call .{f.attr}() inside a fused hot path",
+            ))
+        elif (
+            isinstance(f.value, ast.Name)
+            and f.value.id in _NUMPY_ALIASES
+            and f.attr in _NUMPY_HOST_FNS
+        ):
+            out.append(Violation(
+                path, func, node.lineno,
+                f"host materialization {f.value.id}.{f.attr}(...) inside "
+                "a fused hot path",
+            ))
+        elif (
+            isinstance(f.value, ast.Name)
+            and f.value.id == "jax"
+            and f.attr == "device_get"
+        ):
+            out.append(Violation(
+                path, func, node.lineno,
+                "jax.device_get(...) inside a fused hot path",
+            ))
+    elif isinstance(f, ast.Name):
+        if f.id in _COERCIONS and node.args and not all(
+            _is_literal(a) for a in node.args
+        ):
+            out.append(Violation(
+                path, func, node.lineno,
+                f"scalar coercion {f.id}(...) on a non-literal inside a "
+                "fused hot path (blocks on the traced value)",
+            ))
+        elif f.id == "print":
+            out.append(Violation(
+                path, func, node.lineno,
+                "print(...) inside a fused hot path (stringification "
+                "fetches)",
+            ))
+    return out
+
+
+def lint_file(path: str, func_names: Sequence[str]) -> List[Violation]:
+    """Violations found in ``path``'s registered function bodies.
+
+    A registered name that does not exist in the file is itself a
+    violation — a silently renamed hot path would otherwise drop out of
+    coverage without anyone noticing.
+    """
+    with open(path) as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    found: set = set()
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in func_names
+        ):
+            found.add(node.name)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    out.extend(_check_call(sub, path, node.name))
+    for missing in sorted(set(func_names) - found):
+        out.append(Violation(
+            path, missing, 0,
+            "registered hot-path function not found — update "
+            "tools/hotpath_lint.py DEFAULT_TARGETS after renames",
+        ))
+    return out
+
+
+def lint_paths(
+    targets: Dict[str, Sequence[str]] = None, root: str = None
+) -> List[Violation]:
+    """Lint every registered hot path; returns all violations."""
+    import os
+
+    targets = targets if targets is not None else DEFAULT_TARGETS
+    root = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: List[Violation] = []
+    for rel, funcs in targets.items():
+        out.extend(lint_file(os.path.join(root, rel), funcs))
+    return out
+
+
+def main(argv: Sequence[str] = None) -> int:
+    violations = lint_paths()
+    for v in violations:
+        print(v, file=sys.stderr)
+    if violations:
+        print(f"hotpath lint: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    n_funcs = sum(len(v) for v in DEFAULT_TARGETS.values())
+    print(f"hotpath lint: clean ({n_funcs} hot-path bodies checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
