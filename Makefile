@@ -1,0 +1,18 @@
+# Developer/CI entry points.  Everything runs on the CPU backend
+# (JAX_PLATFORMS=cpu) — the TPU chip is bench.py's business only.
+
+.PHONY: smoke tier1 bench
+
+# The per-PR resilience gate: quick chaos soak, hot-path host-sync
+# lint, and chaos replay determinism against the committed seed
+# (data/chaos/ci_seed.json).  ~1 minute; see tools/ci_smoke.sh.
+smoke:
+	tools/ci_smoke.sh
+
+# The full quick test tier (ROADMAP.md "Tier-1 verify").
+tier1:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	    --continue-on-collection-errors -p no:cacheprovider
+
+bench:
+	python bench.py

@@ -853,6 +853,136 @@ def _bench_serve_stream(
     }
 
 
+def _bench_serve_tiers(
+    n_jobs: int = 40,
+    rate: float = 2.5,
+    n_hosts: int = 16,
+    queue_depth: int = 12,
+    seed: int = 0,
+    fixed_sessions: int = 2,
+    g_min: int = 1,
+    g_max: int = 4,
+    slo_p99_s: float = 0.25,
+) -> dict:
+    """Multi-tenant serving row (round 9): a mixed-tier Poisson stream
+    (25 % serving / 35 % batch / 40 % best-effort) at 10× the
+    ``serve_stream`` row's arrival rate, against a queue too small for
+    it — tier reservations + per-tier policies + in-queue preemption
+    keep tier 0 lossless while the lower tiers absorb the pressure.
+
+    Two arms over identical arrivals: a FIXED pool of
+    ``fixed_sessions``, and the SLO-driven autoscaler free to resize in
+    [g_min, g_max] against the tier-0 p99 decision-latency target.
+    Each arm reports sustained decisions/s and per-tier p50/p95/p99
+    decision latency; the autoscaler arm adds its scaling-event log.
+    Runnable on CPU under ``JAX_PLATFORMS=cpu`` like every row.
+
+    Caveat for cross-arm latency reads: both arms share one process, so
+    the FIRST (fixed) arm pays jit tracing/compilation inside its early
+    decision latencies while the second starts warm — compare tiers
+    *within* an arm, and pool/shed/preemption trajectories across arms.
+    """
+    from pivot_tpu.serve import (
+        AutoscaleConfig,
+        ServeDriver,
+        ServeSession,
+        mixed_tier_arrivals,
+        synthetic_app_factory,
+    )
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    pcfg = PolicyConfig(
+        name="cost-aware", device="tpu", bin_pack="first-fit",
+        sort_tasks=True, sort_hosts=True, adaptive=False,
+    )
+
+    def make_session(label):
+        return ServeSession(
+            label,
+            build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed)),
+            make_policy(pcfg),
+            seed=seed,
+        )
+
+    def one_arm(label, n_sessions, autoscale):
+        reset_ids()
+        sessions = [
+            make_session(f"{label}-{g}") for g in range(n_sessions)
+        ]
+        driver = ServeDriver(
+            sessions,
+            queue_depth=queue_depth,
+            backpressure="shed",
+            flush_after=0.02,
+            tier_reserve=(0, 2, 4),
+            tier_policies=("spill", "shed", "shed"),
+            routing="least_loaded",
+            preempt=True,
+            session_factory=make_session,
+            autoscale=autoscale,
+        )
+        stream = mixed_tier_arrivals(
+            rate, n_jobs, weights=(0.25, 0.35, 0.40), seed=seed,
+            make_app=synthetic_app_factory(seed=seed),
+        )
+        t0 = time.perf_counter()
+        report = driver.run(stream)
+        wall = time.perf_counter() - t0
+        driver.audit(context=f"serve_tiers bench ({label})")
+        snap = report["slo"]
+        tiers = {}
+        for tier, tsnap in snap["tiers"].items():
+            lat = tsnap["decision_latency_s"]
+            tiers[tier] = {
+                "p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+                "p95_ms": round(lat.get("p95", 0.0) * 1e3, 3),
+                "p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+                "admitted": tsnap["counters"]["admitted"],
+                "completed": tsnap["counters"]["completed"],
+                "shed": tsnap["counters"]["shed"],
+                "preempted": tsnap["counters"]["preempted"],
+            }
+        arm = {
+            "wall_s": round(wall, 3),
+            "decisions": snap["counters"]["decisions"],
+            "decisions_per_sec": round(
+                snap["counters"]["decisions"] / max(wall, 1e-9), 1
+            ),
+            "completed": snap["counters"]["completed"],
+            "shed": snap["counters"]["shed"],
+            "preempted": snap["counters"]["preempted"],
+            "pool_final": report["pool"]["final"],
+            "dispatch": snap["dispatch"],
+            "tiers": tiers,
+        }
+        if report["autoscaler"] is not None:
+            arm["scale_events"] = report["autoscaler"]["events"]
+        return arm
+
+    return {
+        "jobs": n_jobs,
+        "arrival_rate": rate,
+        "h": n_hosts,
+        "queue_depth": queue_depth,
+        "tier_mix": [0.25, 0.35, 0.40],
+        "slo_p99_ms": slo_p99_s * 1e3,
+        "fixed_pool": one_arm("fix", fixed_sessions, None),
+        "autoscaled": one_arm(
+            "auto", g_min,
+            AutoscaleConfig(
+                g_min=g_min, g_max=g_max, slo_p99_s=slo_p99_s,
+                check_interval_s=0.05,
+            ),
+        ),
+    }
+
+
 def _child_backend_setup():
     """Shared child preamble: apply the parent's ``PIVOT_BENCH_BACKEND``
     override explicitly (ignoring it would silently contradict the
@@ -917,6 +1047,22 @@ def _serve_child() -> None:
 def _bench_serve_in_child(timeout_s: int = 420) -> dict:
     """Parent side of the serve_stream row — see ``_run_row_in_child``."""
     return _run_row_in_child("PIVOT_BENCH_SERVE_CHILD", timeout_s)
+
+
+def _serve_tiers_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SERVE_TIERS_CHILD=1``): run the
+    serve_tiers row and print ONE JSON line.  Child-isolated for the
+    same reasons as serve_stream (wedged-tunnel hangs; single-tenant
+    backend wants one PJRT client alive)."""
+    jax = _child_backend_setup()
+    row = _bench_serve_tiers()
+    row["backend"] = jax.default_backend()
+    print(json.dumps(row), flush=True)
+
+
+def _bench_serve_tiers_in_child(timeout_s: int = 420) -> dict:
+    """Parent side of the serve_tiers row — see ``_run_row_in_child``."""
+    return _run_row_in_child("PIVOT_BENCH_SERVE_TIERS_CHILD", timeout_s)
 
 
 # (probe timeout s, sleep-before s): ~7 min worst-case total. A wedged
@@ -1037,6 +1183,9 @@ def main() -> None:
     if os.environ.get("PIVOT_BENCH_SERVE_CHILD"):
         _serve_child()
         return
+    if os.environ.get("PIVOT_BENCH_SERVE_TIERS_CHILD"):
+        _serve_tiers_child()
+        return
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
     # so a CPU-fallback JSON line is always self-explaining.
@@ -1135,6 +1284,7 @@ def main() -> None:
     # backend costs this one row (recorded error + stderr tail), never
     # the record.
     serve_stream = _bench_serve_in_child()
+    serve_tiers = _bench_serve_tiers_in_child()
 
     import jax
 
@@ -1268,6 +1418,7 @@ def main() -> None:
         "grid_batched": grid_batched,
         "fused_tick": fused_tick,
         "serve_stream": serve_stream,
+        "serve_tiers": serve_tiers,
         **(
             {"ensemble_saturated": ens_saturated} if ens_saturated else {}
         ),

@@ -521,6 +521,38 @@ def parse_args(argv=None):
                      choices=["cost-aware", "first-fit", "best-fit",
                               "opportunistic"],
                      help="placement arm every session runs")
+    srv.add_argument("--tier-mix", default="",
+                     help="multi-tenant arrival mix: comma-separated "
+                          "tier weights, index = priority tier (0 = "
+                          "serving, most important), e.g. "
+                          "'0.25,0.35,0.40'.  Empty = single-tenant "
+                          "tier-0 stream (the bit-parity default)")
+    srv.add_argument("--tier-reserve", default="",
+                     help="per-tier depth reservations, e.g. '0,2,4': "
+                          "reserve[t] queue slots are off-limits to "
+                          "tier t, so low tiers run out of queue first")
+    srv.add_argument("--tier-policies", default="",
+                     help="per-tier backpressure override, e.g. "
+                          "'spill,shed,shed' (tier 0 lossless, lower "
+                          "tiers shed).  Empty = --backpressure for all")
+    srv.add_argument("--routing", choices=["rr", "least-loaded"],
+                     default="rr",
+                     help="job routing: deterministic round-robin (the "
+                          "bit-parity default) or least-loaded over "
+                          "inbox depth + recent decision latency")
+    srv.add_argument("--preempt", action="store_true",
+                     help="in-queue preemption: a high-tier arrival "
+                          "meeting a full queue cancels an admitted-"
+                          "but-unplaced lower-tier job (requeued to "
+                          "the spill buffer) instead of degrading")
+    srv.add_argument("--autoscale", default="", metavar="GMIN:GMAX",
+                     help="SLO-driven session-pool autoscaling between "
+                          "GMIN and GMAX (e.g. '1:8'): grow on p99 "
+                          "decision-latency breach, drain-then-retire "
+                          "on calm.  Empty = fixed pool")
+    srv.add_argument("--slo-p99-ms", type=float, default=50.0,
+                     help="tier-0 p99 decision-latency target (ms) the "
+                          "autoscaler sizes the pool against")
     sub.add_parser(
         "worker",
         help="resident what-if worker: serve repeated CLI requests from "
@@ -539,6 +571,15 @@ def parse_args(argv=None):
         parser.error(
             "--realtime-score/--realtime apply to the cost-aware arm only "
             "— no other policy scores on bandwidth"
+        )
+    if args.command == "serve" and args.tier_mix and (
+        args.source == "trace" or args.closed_loop
+    ):
+        parser.error(
+            "--tier-mix generates its own synthetic mixed-tier Poisson "
+            "stream — it cannot be combined with --source trace or "
+            "--closed-loop (the trace/closed-loop jobs would be "
+            "silently replaced)"
         )
     if args.command == "serve" and args.device == "tpu":
         # Shared-dispatch serving needs deterministic routing, exactly
@@ -1390,9 +1431,11 @@ def run_serve_stream(args) -> dict:
     import json
 
     from pivot_tpu.serve import (
+        AutoscaleConfig,
         ServeDriver,
         ServeSession,
         closed_loop_source,
+        mixed_tier_arrivals,
         poisson_arrivals,
         synthetic_app_factory,
         trace_arrivals,
@@ -1406,26 +1449,54 @@ def run_serve_stream(args) -> dict:
     elif args.policy == "first-fit":
         arm.update(decreasing=True)  # the reference's VBP arm
     pcfg = PolicyConfig(**arm)
-    sessions = [
-        ServeSession(
-            f"session-{g}",
+
+    def make_session(label):
+        return ServeSession(
+            label,
             build_cluster(_cluster_config(args)),
             make_policy(pcfg),
             seed=args.seed,
         )
-        for g in range(args.sessions)
-    ]
+
+    sessions = [make_session(f"session-{g}") for g in range(args.sessions)]
     flush_after = (args.flush_after_us or 0) / 1e6 or None
+
+    def _csv(text, cast):
+        return tuple(cast(x) for x in text.split(",")) if text else None
+
+    autoscale = None
+    if args.autoscale:
+        try:
+            g_min, g_max = (int(x) for x in args.autoscale.split(":"))
+        except ValueError:
+            raise SystemExit(
+                f"--autoscale wants GMIN:GMAX, got {args.autoscale!r}"
+            )
+        autoscale = AutoscaleConfig(
+            g_min=g_min, g_max=g_max, slo_p99_s=args.slo_p99_ms / 1e3,
+        )
     driver = ServeDriver(
         sessions,
         queue_depth=args.queue_depth,
         backpressure=args.backpressure,
         flush_after=flush_after,
+        tier_reserve=_csv(args.tier_reserve, int),
+        tier_policies=_csv(args.tier_policies, str),
+        routing=args.routing.replace("-", "_"),
+        preempt=args.preempt,
+        session_factory=make_session if autoscale else None,
+        autoscale=autoscale,
     )
     if args.closed_loop:
         arrivals = closed_loop_source(
             driver, synthetic_app_factory(seed=args.seed),
             args.closed_loop, args.jobs,
+        )
+    elif args.tier_mix:
+        arrivals = mixed_tier_arrivals(
+            args.arrival_rate, args.jobs,
+            weights=_csv(args.tier_mix, float),
+            seed=args.seed,
         )
     elif args.source == "trace":
         arrivals = trace_arrivals(

@@ -42,6 +42,7 @@ __all__ = [
     "audit_conservation",
     "audit_meter",
     "audit_run",
+    "audit_serve",
     "check",
     "start_periodic_audit",
 ]
@@ -192,15 +193,16 @@ def audit_conservation(scheduler, apps) -> List[str]:
             )
         dead_ids[entry.task_id] = entry
     retry = scheduler.retry
-    if retry is not None and retry.max_retries is not None:
+    if retry is not None:
         for entry in scheduler.dead_letters:
-            if entry.reason == "retry_budget" and (
-                entry.attempts != retry.max_retries + 1
+            budget = retry.budget(getattr(entry, "tier", 0))
+            if entry.reason == "retry_budget" and budget is not None and (
+                entry.attempts != budget + 1
             ):
                 violations.append(
                     f"task {entry.task_id}: dead-lettered after "
-                    f"{entry.attempts} attempts, budget says "
-                    f"{retry.max_retries + 1}"
+                    f"{entry.attempts} attempts, tier budget says "
+                    f"{budget + 1}"
                 )
     seen_dead = set()
     for app in apps:
@@ -277,6 +279,78 @@ def audit_meter(meter, at_end: bool = True) -> List[str]:
         if t < 0:
             violations.append(f"negative scheduling turnover {t:.6g}")
             break
+    return violations
+
+
+def audit_serve(driver) -> List[str]:
+    """Serve-layer conservation law (round 9 — the multi-tenant chaos
+    soak's referee).  After a drained ``ServeDriver.run``:
+
+      * capacity fully settled: zero in-flight, empty spill buffer,
+        empty admission ledger;
+      * globally and per tier, ``admitted == completed + failed_jobs +
+        preempted`` — every admission terminates exactly once (a
+        preemption *is* a termination of that admission; the victim's
+        re-entry is a fresh ``admitted`` when the spill buffer
+        readmits it);
+      * every preempted job was requeued-to-spill exactly once
+        (``preempted == preempt_requeued``), so with the spill buffer
+        empty each victim re-entered and then terminated — nothing
+        vanished, nothing terminated twice;
+      * every surviving (non-abandoned) session's world passes the
+        task-conservation, cluster-state, and billing audits.
+
+    Returns human-readable violations (empty = the law holds).
+    """
+    violations: List[str] = []
+    q = driver.queue
+    if q.in_flight != 0:
+        violations.append(
+            f"admission queue drained with in_flight={q.in_flight}"
+        )
+    if q.spilled:
+        violations.append(
+            f"{len(q.spilled)} arrival(s) left in the spill buffer"
+        )
+    if driver._inflight:
+        violations.append(
+            f"{len(driver._inflight)} stale admission ledger entries"
+        )
+
+    def _check(counters, scope: str) -> None:
+        admitted = counters.get("admitted", 0)
+        settled = (
+            counters.get("completed", 0)
+            + counters.get("failed_jobs", 0)
+            + counters.get("preempted", 0)
+        )
+        if admitted != settled:
+            violations.append(
+                f"{scope}: admitted {admitted} != completed + failed + "
+                f"preempted {settled} (an admission terminated zero or "
+                "multiple times)"
+            )
+
+    snap = driver.slo.snapshot()
+    _check(snap["counters"], "service")
+    if snap["counters"].get("preempted", 0) != snap["counters"].get(
+        "preempt_requeued", 0
+    ):
+        violations.append(
+            f"preempted {snap['counters'].get('preempted', 0)} != "
+            f"preempt_requeued {snap['counters'].get('preempt_requeued', 0)}"
+        )
+    for tier, tsnap in snap.get("tiers", {}).items():
+        _check(tsnap["counters"], f"tier {tier}")
+    for s in driver.sessions + driver._retired:
+        violations += [
+            f"session {s.label}: {v}"
+            for v in (
+                audit_conservation(s.scheduler, s._injected)
+                + audit_cluster(s.cluster)
+                + audit_meter(s.meter)
+            )
+        ]
     return violations
 
 

@@ -24,7 +24,7 @@ import os
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -349,6 +349,29 @@ class StreamingHistogram:
         # An edge cannot overstate the true max (exactly tracked).
         return min(edge, self._max)
 
+    def baseline(self) -> np.ndarray:
+        """Bucket-count snapshot for windowed percentile queries — pair
+        with :meth:`percentile_since`.  The autoscaler's breach detector
+        needs *recent* latency, not lifetime latency: a service that ran
+        calm for an hour would otherwise drown a fresh SLO breach in old
+        samples."""
+        return self._counts.copy()
+
+    def percentile_since(self, baseline: np.ndarray, q: float) -> float:
+        """Upper-edge ``q``-th percentile of the samples recorded since
+        ``baseline`` was taken (0.0 when the window is empty).  The
+        window's true max is unknown, so the estimate is the raw bucket
+        edge — still bounded-relative-error."""
+        delta = self._counts - baseline
+        total = int(delta.sum())
+        if total <= 0:
+            return 0.0
+        rank = max(int(math.ceil(q / 100.0 * total)), 1)
+        idx = int(np.searchsorted(np.cumsum(delta), rank))
+        if idx == 0:
+            return self.lo
+        return self.lo * 10 ** (idx / self._scale)
+
     @property
     def mean(self) -> float:
         return self._sum / self.count if self.count else 0.0
@@ -385,12 +408,35 @@ class SloMeter(LogMixin):
     #: applications reaped by a session), ``session_restarts`` /
     #: ``requeued`` (supervisor recoveries and the in-flight jobs they
     #: re-admitted), ``kernel_failures`` / ``degraded_decisions`` (device
-    #: kernel faults absorbed by CPU-twin degradation).
+    #: kernel faults absorbed by CPU-twin degradation).  Round-9
+    #: multi-tenant keys: ``preempted`` / ``preempt_requeued`` (in-queue
+    #: preemptions and their spill re-entries), ``preempt_requests`` /
+    #: ``preempt_misses`` (attempts and already-placed refusals),
+    #: ``scale_up_events`` / ``scale_down_events`` (autoscaler actions).
     COUNTERS = (
         "arrived", "admitted", "completed", "shed", "spilled",
         "blocked_waits", "late_injections", "decisions", "placed",
         "failed_jobs", "session_restarts", "requeued",
         "kernel_failures", "degraded_decisions",
+        "preempted", "preempt_requeued", "preempt_requests",
+        "preempt_misses", "scale_up_events", "scale_down_events",
+    )
+
+    #: The dispatch-path mix section of the snapshot mirrors the
+    #: ``DispatchBatcher.stats`` documented key set (the ``stats_out``
+    #: contract of ``run_grid_lockstep`` — ``sched/batch.py``), so bench
+    #: rows and soak reports can attribute how placement calls reached
+    #: the device: coalesced flushes vs the single-live-slot fast path.
+    DISPATCH_KEYS = (
+        "runs", "dispatches", "device_calls", "coalesced", "max_group",
+        "deadline_flushes", "single_fast_path", "respawns",
+        "retired_slots",
+    )
+
+    #: Per-tier counter keys (each tier's section of the snapshot).
+    TIER_COUNTERS = (
+        "arrived", "admitted", "completed", "failed_jobs", "shed",
+        "spilled", "preempted", "decisions",
     )
 
     def __init__(self):
@@ -404,15 +450,60 @@ class SloMeter(LogMixin):
         self.queue_depth = StreamingHistogram(1.0, 1e7, bins_per_decade=32)
         # Sim-time job sojourn: admission timestamp -> app completion.
         self.sojourn_sim = StreamingHistogram(1e-3, 1e9, bins_per_decade=32)
+        #: Per-tier telemetry, lazily created on first record for a tier
+        #: (single-tenant services never allocate any).  Each entry:
+        #: counters dict + shed reasons + decision-latency / sojourn
+        #: histograms, serialized under ``snapshot()["tiers"]``.
+        self._tiers: Dict[int, dict] = {}
+        #: Live reference to the serving batcher's stats dict (attached
+        #: by ``ServeDriver.run``); ``None`` snapshots as all-zero.
+        self._dispatch_stats: Optional[dict] = None
+
+    def _tier(self, tier: int) -> dict:
+        """Per-tier slot (lock held by caller)."""
+        t = self._tiers.get(tier)
+        if t is None:
+            t = {
+                "counters": {k: 0 for k in self.TIER_COUNTERS},
+                "shed_reasons": {},
+                "decision_latency": StreamingHistogram(1e-6, 1e4),
+                "sojourn_sim": StreamingHistogram(
+                    1e-3, 1e9, bins_per_decade=32
+                ),
+            }
+            self._tiers[tier] = t
+        return t
+
+    def attach_dispatch_stats(self, stats: dict) -> None:
+        """Point the snapshot's ``dispatch`` section at the live
+        ``DispatchBatcher.stats`` dict (the documented key set) so soak
+        reports and bench rows carry the dispatch-path mix — notably
+        ``single_fast_path``, which tells a reader whether decisions
+        were coalesced across sessions or served same-thread."""
+        with self._lock:
+            self._dispatch_stats = stats
 
     def count(self, key: str, n: int = 1) -> None:
         with self._lock:
             self.counters[key] = self.counters.get(key, 0) + n
 
-    def record_shed(self, reason: str) -> None:
+    def count_tier(self, tier: int, key: str, n: int = 1) -> None:
+        """Per-tier counter (also bumps nothing globally — call
+        :meth:`count` separately when a key exists at both scopes)."""
+        with self._lock:
+            c = self._tier(tier)["counters"]
+            c[key] = c.get(key, 0) + n
+
+    def record_shed(self, reason: str, tier: Optional[int] = None) -> None:
         with self._lock:
             self.counters["shed"] += 1
             self.shed_reasons[reason] = self.shed_reasons.get(reason, 0) + 1
+            if tier is not None:
+                t = self._tier(tier)
+                t["counters"]["shed"] += 1
+                t["shed_reasons"][reason] = (
+                    t["shed_reasons"].get(reason, 0) + 1
+                )
 
     def record_decision(self, wall_s: float, n_tasks: int,
                         n_placed: int) -> None:
@@ -422,13 +513,45 @@ class SloMeter(LogMixin):
             self.counters["decisions"] += n_tasks
             self.counters["placed"] += n_placed
 
+    def record_decision_tier(self, tier: int, wall_s: float,
+                             n_tasks: int = 0) -> None:
+        """Attribute one placement call's wall latency to ``tier`` —
+        called once per tier *present in the decided batch*, so a tier's
+        histogram measures the latency its work actually experienced
+        (mixed-tier batches count toward every tier they carried)."""
+        with self._lock:
+            t = self._tier(tier)
+            t["decision_latency"].record(wall_s)
+            t["counters"]["decisions"] += n_tasks
+
     def record_queue_depth(self, depth: int) -> None:
         with self._lock:
             self.queue_depth.record(depth)
 
-    def record_sojourn(self, sim_s: float) -> None:
+    def record_sojourn(self, sim_s: float, tier: Optional[int] = None) -> None:
         with self._lock:
             self.sojourn_sim.record(sim_s)
+            if tier is not None:
+                self._tier(tier)["sojourn_sim"].record(sim_s)
+
+    def tier_decision_baseline(self, tier: int) -> "np.ndarray":
+        """Windowed-percentile baseline for ``tier``'s decision-latency
+        histogram (see :meth:`StreamingHistogram.baseline`)."""
+        with self._lock:
+            return self._tier(tier)["decision_latency"].baseline()
+
+    def tier_decision_p99_since(self, tier: int, baseline) -> float:
+        """p99 decision latency of ``tier``'s samples since ``baseline``
+        (0.0 for an empty window) — the autoscaler's breach signal."""
+        with self._lock:
+            return self._tier(tier)["decision_latency"].percentile_since(
+                baseline, 99
+            )
+
+    def tier_counter(self, tier: int, key: str) -> int:
+        with self._lock:
+            t = self._tiers.get(tier)
+            return 0 if t is None else t["counters"].get(key, 0)
 
     @property
     def wall_clock(self) -> float:
@@ -437,6 +560,7 @@ class SloMeter(LogMixin):
     def snapshot(self) -> dict:
         """JSON-ready view of the service's SLO state at this instant."""
         with self._lock:
+            stats = self._dispatch_stats or {}
             return {
                 "wall_s": round(self.wall_clock, 4),
                 "counters": dict(self.counters),
@@ -444,6 +568,21 @@ class SloMeter(LogMixin):
                 "decision_latency_s": self.decision_latency.snapshot(),
                 "queue_depth": self.queue_depth.snapshot(),
                 "sojourn_sim_s": self.sojourn_sim.snapshot(),
+                # The documented DispatchBatcher stats key set, zeros
+                # when the service never engaged a batcher — fixed
+                # schema either way (tests assert it).
+                "dispatch": {
+                    k: int(stats.get(k, 0)) for k in self.DISPATCH_KEYS
+                },
+                "tiers": {
+                    str(tier): {
+                        "counters": dict(t["counters"]),
+                        "shed_reasons": dict(t["shed_reasons"]),
+                        "decision_latency_s": t["decision_latency"].snapshot(),
+                        "sojourn_sim_s": t["sojourn_sim"].snapshot(),
+                    }
+                    for tier, t in sorted(self._tiers.items())
+                },
             }
 
     def save(self, path: str) -> None:

@@ -432,6 +432,53 @@ class GlobalScheduler(LogMixin):
     def get_local(self, app_id: str) -> Optional[LocalScheduler]:
         return self._local.get(app_id)
 
+    def can_withdraw(self, app: Application) -> bool:
+        """True iff ``app`` is *admitted-but-unplaced*: it is live here
+        and no materialized task has ever left NASCENT (none submitted,
+        running, finished — and none ever failed, which would leave a
+        backed-off resubmission callback in flight).  Only such apps are
+        preemptible: withdrawing them cancels pure bookkeeping, no
+        in-flight execution or heap event refers to them afterwards."""
+        if self._local.get(app.id) is None:
+            return False
+        for group in app.groups:
+            for task in group.tasks:
+                if not task.is_nascent or task in self._attempts:
+                    return False
+        return True
+
+    def withdraw(self, app: Application) -> bool:
+        """In-queue preemption (round 9, the serve driver's victim path):
+        remove an admitted-but-unplaced application from the scheduler —
+        its armed local pump is cancelled, its tasks are purged from the
+        wait stack and submit queue, and the app stops counting toward
+        ``_n_unfinished`` — as if it had never been submitted.  Returns
+        False (and mutates nothing) when the app is not withdrawable
+        (already placed / running / finished / unknown).  Must run on
+        the thread that owns this scheduler's event kernel."""
+        if not self.can_withdraw(app):
+            return False
+        local = self._local.pop(app.id)
+        if local._wake_armed and local._wake_cb is not None:
+            local._wake_cb.cancel()
+            local._wake_armed = False
+            local._wake_cb = None
+            self._armed_pumps -= 1
+        mine = {t for g in app.groups for t in g.tasks}
+        self._wait_stack = [t for t in self._wait_stack if t not in mine]
+        self.submit_q.items[:] = [
+            t for t in self.submit_q.items if t not in mine
+        ]
+        for t in mine:
+            self._pending_since.pop(t, None)
+        self._n_unfinished -= 1
+        # Withdrawal mutates the ready universe a fused span may have
+        # speculated over — and wakes the loop's fast-forward sleep path
+        # conservatively via the epoch bump at its next check.
+        self._span_epoch += 1
+        self.tracer.emit("app", "withdrawn", self.env.now, id=app.id)
+        return True
+
     # -- the tick loop ---------------------------------------------------
     def _dispatch_loop(self):
         env, cluster = self.env, self.cluster
@@ -914,7 +961,11 @@ class GlobalScheduler(LogMixin):
             if self.retry is not None:
                 attempts = self._attempts.get(task, 0) + 1
                 self._attempts[task] = attempts
-                if self.retry.exhausted(attempts):
+                # Tier-aware budget: multi-tenant serving stamps the
+                # app's priority tier at injection; batch apps default
+                # to tier 0, which resolves to the classic budget.
+                tier = int(getattr(app, "_serve_tier", 0))
+                if self.retry.exhausted(attempts, tier):
                     self._dead_letter(task, failed_host, attempts)
                     return
                 self.tracer.emit("task", "retry", env.now, id=task.id)
@@ -966,7 +1017,7 @@ class GlobalScheduler(LogMixin):
         self._pending_since.pop(task, None)
         entry = DeadLetter(
             task.id, task.application.id, host_id, reason, self.env.now,
-            attempts,
+            attempts, tier=int(getattr(task.application, "_serve_tier", 0)),
         )
         self.dead_letters.append(entry)
         if self.slo is not None:

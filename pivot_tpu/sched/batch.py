@@ -327,9 +327,13 @@ class DispatchBatcher:
     ``device_calls`` (actual dispatches issued), ``coalesced`` (requests
     served inside a >1 batch), ``max_group`` (largest batch),
     ``deadline_flushes`` (partial flushes forced by ``flush_after``),
-    and ``single_fast_path`` (calls served synchronously on the owning
+    ``single_fast_path`` (calls served synchronously on the owning
     thread because theirs was the only live slot — no queue hand-off,
-    no coordinator hop).
+    no coordinator hop), and the pool-resize pair ``respawns`` (slots
+    opened beyond the construction-time count: supervisor restarts and
+    autoscaler growth) / ``retired_slots`` (slots closed for good:
+    finished runs, drained-and-retired or crashed sessions).  At any
+    instant ``live_slots == runs − retired_slots``.
     """
 
     def __init__(self, n_slots: int, flush_after: Optional[float] = None):
@@ -353,6 +357,12 @@ class DispatchBatcher:
             "deadline_flushes": 0,
             "single_fast_path": 0,
         }
+        #: Pool-resize accounting (serving autoscaler + supervisor):
+        #: slots opened beyond the construction-time count and slots
+        #: retired (closed for good — drained sessions, crashed runs).
+        #: ``live_slots`` is the open count the autoscaler sizes against.
+        self.stats["respawns"] = 0
+        self.stats["retired_slots"] = 0
 
     def client(self) -> BatchClient:
         with self._cond:
@@ -366,20 +376,29 @@ class DispatchBatcher:
 
     def respawn_client(self) -> BatchClient:
         """Open a FRESH slot beyond the construction-time count — the
-        serving supervisor's restart path (``serve/driver.py``): a
-        crashed session's slot is closed by its dying thread, and its
-        replacement session must not inherit that slot's state, so it
+        serving supervisor's restart path and the autoscaler's growth
+        path (``serve/driver.py`` / ``serve/autoscale.py``): a crashed
+        session's slot is closed by its dying thread, and a replacement
+        or scale-up session must not inherit any old slot's state, so it
         gets a new one.  The quiescence predicate tracks ``_open``
-        (closed slots don't count), so total slot count growing over
-        restarts never parks the coordinator."""
+        (closed slots don't count), so the slot population growing and
+        shrinking over restarts/resizes never parks the coordinator."""
         with self._cond:
             slot = self._clients
             self._clients += 1
             self._n_slots += 1
             self._open += 1
             self.stats["runs"] = self._n_slots
+            self.stats["respawns"] += 1
             self._cond.notify_all()
         return BatchClient(self, slot)
+
+    @property
+    def live_slots(self) -> int:
+        """Open (not yet retired) slots — what the serving autoscaler
+        sizes the pool against."""
+        with self._cond:
+            return self._open
 
     # -- run-thread side --------------------------------------------------
     def _submit(self, req: _Request) -> None:
@@ -390,6 +409,7 @@ class DispatchBatcher:
     def _close_slot(self, was_idle: bool = False) -> None:
         with self._cond:
             self._open -= 1
+            self.stats["retired_slots"] += 1
             if was_idle:
                 self._idle -= 1
             self._cond.notify_all()

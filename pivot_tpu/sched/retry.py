@@ -78,6 +78,14 @@ class RetryPolicy:
     cap: float = 300.0
     jitter: float = 0.1
     seed: int = 0
+    #: Optional per-tier retry budgets for multi-tenant serving
+    #: (``pivot_tpu.serve``): index = priority tier (0 = most important,
+    #: tiers beyond the tuple use its last entry), value = that tier's
+    #: ``max_retries`` (``None`` = unbounded).  Production cells spend
+    #: far more retry budget on serving work than on best-effort batch
+    #: (Borg-NG, PAPERS.md); this is that knob.  ``None`` (default) uses
+    #: ``max_retries`` for every tier — bit-identical to pre-tier runs.
+    tier_max_retries: Optional[tuple] = None
 
     def __post_init__(self):
         if self.max_retries is not None and self.max_retries < 0:
@@ -88,10 +96,28 @@ class RetryPolicy:
             raise ValueError(f"backoff factor must be >= 1, got {self.factor}")
         if not 0.0 <= self.jitter < 1.0:
             raise ValueError(f"jitter must be in [0, 1), got {self.jitter}")
+        if self.tier_max_retries is not None:
+            t = tuple(self.tier_max_retries)
+            if not t or any(b is not None and b < 0 for b in t):
+                raise ValueError(
+                    f"tier_max_retries must be a non-empty tuple of "
+                    f"budgets >= 0 (or None), got {self.tier_max_retries!r}"
+                )
+            object.__setattr__(self, "tier_max_retries", t)
 
-    def exhausted(self, attempts: int) -> bool:
-        """True once ``attempts`` failures have overdrawn the budget."""
-        return self.max_retries is not None and attempts > self.max_retries
+    def budget(self, tier: int = 0) -> Optional[int]:
+        """Effective retry budget for ``tier`` (``None`` = unbounded)."""
+        if self.tier_max_retries is None:
+            return self.max_retries
+        return self.tier_max_retries[
+            min(tier, len(self.tier_max_retries) - 1)
+        ]
+
+    def exhausted(self, attempts: int, tier: int = 0) -> bool:
+        """True once ``attempts`` failures have overdrawn ``tier``'s
+        budget (tier 0 with no per-tier table = the classic budget)."""
+        budget = self.budget(tier)
+        return budget is not None and attempts > budget
 
     def backoff(self, attempt: int, key: str) -> float:
         """Sim-seconds to wait before resubmitting failure ``attempt`` of
@@ -114,7 +140,8 @@ class DeadLetter:
     host_id: Optional[str]  # last placement that failed (None: never placed)
     reason: str  # "retry_budget" | "app_failed"
     at: float  # sim time of dead-lettering
-    attempts: int  # failures consumed (== max_retries + 1 on budget exhaustion)
+    attempts: int  # failures consumed (== budget(tier) + 1 on exhaustion)
+    tier: int = 0  # the app's serving tier (0 outside multi-tenant serving)
 
 
 class HostCircuitBreaker:

@@ -29,6 +29,18 @@ their in-flight jobs requeued), sessions forward retry governance
 reap dead-lettered jobs as ``failed_jobs``, and device policies degrade
 to their CPU twins after repeated kernel failures rather than taking
 the service down (``sched/tpu.py`` ``degrade_after``).
+
+Round 9 makes it *multi-tenant*: arrivals carry priority tiers
+(:data:`~pivot_tpu.serve.arrivals.TIER_NAMES`), the admission queue
+gets per-tier depth reservations and per-tier backpressure policies,
+high-tier arrivals can **preempt** admitted-but-unplaced low-tier jobs
+(cancel + requeue-to-spill, fully metered and audited), routing can be
+least-loaded instead of round-robin, and an **SLO-driven autoscaler**
+(:mod:`~pivot_tpu.serve.autoscale`) grows/shrinks the session pool
+between ``g_min``/``g_max`` against windowed per-tier p99
+decision-latency targets — drain-then-retire on the way down, fresh
+batcher slots on the way up.  All knobs default off: the single-tenant
+fixed-pool service (and its bit-parity proof) is unchanged.
 """
 
 from pivot_tpu.serve.admission import (
@@ -39,25 +51,33 @@ from pivot_tpu.serve.admission import (
     AdmissionQueue,
 )
 from pivot_tpu.serve.arrivals import (
+    TIER_NAMES,
     JobArrival,
+    mixed_tier_arrivals,
     poisson_arrivals,
     synthetic_app_factory,
     trace_arrivals,
 )
+from pivot_tpu.serve.autoscale import AutoscaleConfig, SloAutoscaler
 from pivot_tpu.serve.driver import ServeDriver, closed_loop_source
-from pivot_tpu.serve.session import STOP, ServeSession
+from pivot_tpu.serve.session import STOP, PreemptRequest, ServeSession
 
 __all__ = [
     "ADMITTED",
     "AdmissionQueue",
+    "AutoscaleConfig",
     "BLOCKED",
     "JobArrival",
+    "PreemptRequest",
     "SHED",
     "SPILLED",
     "STOP",
     "ServeDriver",
     "ServeSession",
+    "SloAutoscaler",
+    "TIER_NAMES",
     "closed_loop_source",
+    "mixed_tier_arrivals",
     "poisson_arrivals",
     "synthetic_app_factory",
     "trace_arrivals",

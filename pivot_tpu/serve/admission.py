@@ -1,4 +1,4 @@
-"""Bounded admission control for the stream driver.
+"""Bounded, tier-aware admission control for the stream driver.
 
 The admission queue bounds **in-flight work** — jobs admitted into a
 scheduling session but not yet completed.  (A buffer of *unrouted*
@@ -21,15 +21,39 @@ Three backpressure policies when the queue is full:
     wall-time; in replay mode the driver advances the sim-release gate
     while blocked so the wait can resolve deterministically.
 
+**Priority tiers** (round 9, the Borg-NG batch/serving split —
+PAPERS.md): every :class:`~pivot_tpu.serve.arrivals.JobArrival` carries
+a ``tier`` (0 = most important), and the queue can be built with
+
+  * ``tier_reserve`` — per-tier depth reservations: ``reserve[t]``
+    slots are off-limits to arrivals of tier ``t`` (tiers beyond the
+    sequence use its last entry), so tier t's effective depth is
+    ``depth − reserve[t]``.  Tier 0 conventionally reserves 0: under
+    load the low tiers run out of queue *first*, which is exactly the
+    "shed low tiers before blocking high ones" ordering.
+  * ``tier_policies`` — per-tier backpressure override (same indexing),
+    e.g. ``("spill", "shed", "shed")``: tier 0 is lossless while lower
+    tiers absorb the sheds.
+
+The spill buffer re-offers in **(tier, arrival-timestamp) order** — the
+highest surviving tier first, original arrival order within a tier,
+*including* preemption victims re-entering at their original arrival
+position (the single-tier case degenerates to pure FIFO, the documented
+re-offer ordering guarantee ``tests/test_serve.py`` pins).  Both tier
+knobs default to off, under which every decision, counter, and re-offer
+is bit-identical to the single-tenant queue.
+
 Decisions are returned as module constants (``ADMITTED`` / ``SHED`` /
 ``SPILLED`` / ``BLOCKED``); the blocking dance itself lives in the
-driver, which owns the condition variable the completions notify.
+driver, which owns the condition variable the completions notify (as
+does in-queue *preemption*, which frees low-tier in-flight capacity
+when a high-tier arrival would otherwise degrade — ``serve/driver.py``).
 """
 
 from __future__ import annotations
 
-from collections import deque
-from typing import Optional
+import bisect
+from typing import List, Optional, Sequence
 
 from pivot_tpu.infra.meter import SloMeter
 
@@ -56,7 +80,9 @@ class AdmissionQueue:
     atomic with respect to releases."""
 
     def __init__(self, depth: int, policy: str = "shed",
-                 slo: Optional[SloMeter] = None):
+                 slo: Optional[SloMeter] = None,
+                 tier_reserve: Optional[Sequence[int]] = None,
+                 tier_policies: Optional[Sequence[str]] = None):
         if depth < 1:
             raise ValueError("admission queue depth must be >= 1")
         if policy not in _POLICIES:
@@ -64,45 +90,130 @@ class AdmissionQueue:
                 f"unknown backpressure policy {policy!r} (use one of "
                 f"{_POLICIES})"
             )
+        if tier_reserve is not None:
+            tier_reserve = tuple(int(r) for r in tier_reserve)
+            if not tier_reserve or any(r < 0 for r in tier_reserve):
+                raise ValueError(
+                    f"tier_reserve must be non-empty, non-negative, got "
+                    f"{tier_reserve!r}"
+                )
+            if max(tier_reserve) >= depth:
+                raise ValueError(
+                    f"tier_reserve {tier_reserve!r} leaves no capacity at "
+                    f"depth {depth}"
+                )
+        if tier_policies is not None:
+            tier_policies = tuple(tier_policies)
+            bad = [p for p in tier_policies if p not in _POLICIES]
+            if not tier_policies or bad:
+                raise ValueError(
+                    f"tier_policies must be drawn from {_POLICIES}, got "
+                    f"{tier_policies!r}"
+                )
         self.depth = depth
         self.policy = policy
+        self.tier_reserve = tier_reserve
+        self.tier_policies = tier_policies
         self.slo = slo or SloMeter()
         self.in_flight = 0
-        self.spilled = deque()
+        #: Spill buffer, kept sorted by (tier, arrival ts): re-offers
+        #: serve the most important surviving tier first and preserve
+        #: original arrival order within a tier.
+        self.spilled: List = []
+        self._spill_keys: List[tuple] = []
+        self._arrival_seq = 0
+
+    @staticmethod
+    def _tier_of(arrival) -> int:
+        return int(getattr(arrival, "tier", 0))
+
+    def _per_tier(self, table, tier: int, default):
+        if table is None:
+            return default
+        return table[min(tier, len(table) - 1)]
+
+    def reserve_for(self, tier: int) -> int:
+        return self._per_tier(self.tier_reserve, tier, 0)
+
+    def policy_for(self, tier: int) -> str:
+        return self._per_tier(self.tier_policies, tier, self.policy)
 
     @property
     def full(self) -> bool:
         return self.in_flight >= self.depth
 
+    def has_room(self, tier: int) -> bool:
+        """Capacity check at ``tier``'s effective depth (reservations for
+        more-important tiers subtracted)."""
+        return self.in_flight < self.depth - self.reserve_for(tier)
+
     def offer(self, arrival) -> str:
         """One admission decision.  ``ADMITTED`` increments the in-flight
         count (the caller routes the job); ``BLOCKED`` means the caller
         must wait for capacity and re-offer."""
+        tier = self._tier_of(arrival)
         self.slo.count("arrived")
+        self.slo.count_tier(tier, "arrived")
         self.slo.record_queue_depth(self.in_flight)
-        if not self.full:
-            self.in_flight += 1
-            self.slo.count("admitted")
+        if self.has_room(tier):
+            self._admit_one(tier)
             return ADMITTED
-        if self.policy == "shed":
-            self.slo.record_shed("queue_full")
+        policy = self.policy_for(tier)
+        if policy == "shed":
+            self.slo.record_shed("queue_full", tier=tier)
             return SHED
-        if self.policy == "spill":
-            self.spilled.append(arrival)
-            self.slo.count("spilled")
+        if policy == "spill":
+            self.spill(arrival)
             return SPILLED
         return BLOCKED
+
+    def _admit_one(self, tier: int) -> None:
+        self.in_flight += 1
+        self.slo.count("admitted")
+        self.slo.count_tier(tier, "admitted")
+
+    def spill(self, arrival, count: bool = True) -> None:
+        """Park an arrival in the spill buffer, sorted by (tier,
+        original arrival timestamp, insertion seq): re-offers serve the
+        most important surviving tier first and ORIGINAL arrival order
+        within a tier.  Keying on the arrival's own timestamp (not
+        insertion order) is what keeps the guarantee through
+        preemption — a victim requeued here re-enters at its original
+        arrival position, ahead of same-tier jobs that arrived later
+        but spilled earlier.  ``count=False`` skips the ``spilled``
+        counters — the preemption path meters its victim as
+        ``preempted``, not as a fresh spill."""
+        tier = self._tier_of(arrival)
+        key = (tier, float(getattr(arrival, "ts", 0.0)), self._arrival_seq)
+        self._arrival_seq += 1
+        idx = bisect.bisect(self._spill_keys, key)
+        self._spill_keys.insert(idx, key)
+        self.spilled.insert(idx, arrival)
+        if count:
+            self.slo.count("spilled")
+            self.slo.count_tier(tier, "spilled")
+
+    def peek_spill(self):
+        """Head of the spill buffer (highest tier, oldest) or None."""
+        return self.spilled[0] if self.spilled else None
+
+    def pop_spill(self):
+        self._spill_keys.pop(0)
+        return self.spilled.pop(0)
 
     def readmit(self, arrival) -> bool:
         """Re-offer a spilled/blocked arrival (no double counting of the
         ``arrived`` counter).  True = admitted."""
-        if self.full:
+        tier = self._tier_of(arrival)
+        if not self.has_room(tier):
             return False
-        self.in_flight += 1
-        self.slo.count("admitted")
+        self._admit_one(tier)
         return True
 
     def release(self, n: int = 1) -> None:
-        """A job completed — free its capacity."""
+        """A job completed (or was preempted) — free its capacity.
+        Reservations are headroom carved out of the shared bound, not
+        per-tier occupancy quotas, so release is tier-blind by design —
+        ``has_room`` only ever consults the global ``in_flight``."""
         self.in_flight -= n
         assert self.in_flight >= 0, "admission release underflow"

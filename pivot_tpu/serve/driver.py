@@ -2,26 +2,43 @@
 
 Topology (docs/ARCHITECTURE.md "The online serving layer")::
 
-    arrivals ──▶ AdmissionQueue ──▶ round-robin router ──▶ session inboxes
-    (Poisson /     (bounded;          (deterministic)        │ one thread
-     trace-replay)  block/shed/spill)                        ▼ per session
-                                                   ServeSession event loops
-                                                         │ placement ticks
-                                                         ▼
+    arrivals ──▶ AdmissionQueue ──▶ router (rr / least-loaded) ──▶ inboxes
+    (Poisson /     (bounded, tiered;                               │ one
+     trace-replay)  block/shed/spill                               ▼ thread
+     × priority     + in-queue                           ServeSession event
+       tiers)         preemption)                        loops │ placement
+                                                               ▼ ticks
                                                    DispatchBatcher slots
-                                              (idle-aware, deadline flush)
+                                              (idle-aware, deadline flush,
+                                               autoscaler-resized)
                                                          │
                                                          ▼
                                            ONE [G]-vmapped device dispatch
 
 The driver owns one condition variable that serializes every control
-decision: admission (in-flight accounting + backpressure), routing
-(round-robin over sessions — deterministic, which is what lets a served
-schedule be compared bit-for-bit against per-session batch runs), the
-**release gate** (sessions may not step an event past the largest
-arrival timestamp the stream has revealed — an online scheduler cannot
-simulate past "now"), completions (capacity release + spill re-offers +
-closed-loop refill), and shutdown.
+decision: admission (in-flight accounting + tier-ordered backpressure +
+in-queue preemption), routing (deterministic round-robin by default —
+what lets a served schedule be compared bit-for-bit against per-session
+batch runs — or least-loaded over inbox depth + recent decision
+latency), the **release gate** (sessions may not step an event past the
+largest arrival timestamp the stream has revealed — an online scheduler
+cannot simulate past "now"), completions (capacity release + spill
+re-offers + closed-loop refill), pool resizing (supervisor restarts,
+autoscaler grow/retire), and shutdown.
+
+**Multi-tenant serving** (round 9): every arrival carries a priority
+tier (0 = most important).  Under pressure the service *degrades, never
+fails* (SpotServe, PAPERS.md): per-tier depth reservations and per-tier
+backpressure policies shed/spill the low tiers first, and — with
+``preempt=True`` — a high-tier arrival that would still degrade
+preempts an admitted-but-unplaced lower-tier job instead: the victim is
+cancelled on its session's thread (submission callback cancelled, or
+``GlobalScheduler.withdraw`` if already submitted but never placed),
+its capacity freed, and the victim requeued to the spill buffer, from
+which it re-enters — original arrival order within its tier — once
+pressure subsides.  Every preemption is metered per tier and reconciled
+by the serve conservation audit (``infra/audit.py::audit_serve``):
+every admitted or preempted job terminates exactly once.
 
 Wall-clock pacing is optional (``pace`` sim-seconds per wall-second);
 the default *replay* mode runs as fast as the sessions can step, which
@@ -33,7 +50,7 @@ from __future__ import annotations
 import math
 import queue as _pyqueue
 import threading
-from typing import Callable, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 import time
 
@@ -42,13 +59,34 @@ from pivot_tpu.utils import LogMixin
 
 from pivot_tpu.serve.admission import ADMITTED, BLOCKED, AdmissionQueue
 from pivot_tpu.serve.arrivals import JobArrival
-from pivot_tpu.serve.session import STOP, ServeSession
+from pivot_tpu.serve.autoscale import AutoscaleConfig, SloAutoscaler
+from pivot_tpu.serve.session import STOP, PreemptRequest, ServeSession
 
 __all__ = ["ServeDriver", "closed_loop_source"]
 
+_ROUTINGS = ("rr", "least_loaded")
+
+
+class _Inflight:
+    """Ledger entry for one admitted job — what preemption victims are
+    chosen from and what completions settle against."""
+
+    __slots__ = ("app", "ts", "tier", "tenant", "seq", "session",
+                 "requested", "preemptible")
+
+    def __init__(self, app, ts, tier, tenant, seq):
+        self.app = app
+        self.ts = ts
+        self.tier = tier
+        self.tenant = tenant
+        self.seq = seq  # admission order (victim tie-break: youngest)
+        self.session: Optional[ServeSession] = None
+        self.requested = False  # a preempt request is in flight
+        self.preemptible = True  # False after a miss (it placed/ran)
+
 
 class ServeDriver(LogMixin):
-    """Always-on scheduling service over G concurrent sessions.
+    """Always-on scheduling service over a (resizable) pool of sessions.
 
     **Session supervision** (round 7): when constructed with a
     ``session_factory``, the driver self-heals instead of fail-stopping —
@@ -65,11 +103,18 @@ class ServeDriver(LogMixin):
     admission queue still governs them (their completion releases
     capacity exactly once).  ``max_restarts`` bounds the recovery budget
     — exhausting it falls back to the fail-stop path.
+
+    **Tiers, preemption, routing, autoscaling** (round 9): see the
+    module docstring; all four knobs (``tier_reserve``/``tier_policies``,
+    ``preempt``, ``routing``, ``autoscale``) default to off, under which
+    the service is bit-identical to the single-tenant fixed-pool driver
+    (the PR-2 parity tests run unmodified).
     """
 
     #: Wall seconds between capacity re-checks while a ``block``-policy
-    #: producer waits; each expiry also advances the release gate one
-    #: scheduler tick so blocked admission cannot freeze sim time.
+    #: producer (or a preempting admission) waits; each expiry also
+    #: advances the release gate one scheduler tick so a blocked
+    #: admission cannot freeze sim time.
     _BLOCK_POLL_S = 0.02
 
     def __init__(
@@ -82,31 +127,82 @@ class ServeDriver(LogMixin):
         session_factory: Optional[Callable[[str], ServeSession]] = None,
         max_restarts: int = 2,
         stall_timeout: Optional[float] = None,
+        tier_reserve=None,
+        tier_policies=None,
+        routing: str = "rr",
+        preempt: bool = False,
+        preempt_timeout: float = 5.0,
+        autoscale: Optional[AutoscaleConfig] = None,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
         if stall_timeout is not None and stall_timeout <= 0:
             raise ValueError("stall_timeout must be positive (or None)")
+        if routing not in _ROUTINGS:
+            raise ValueError(
+                f"unknown routing {routing!r} (use one of {_ROUTINGS})"
+            )
+        if preempt_timeout <= 0:
+            raise ValueError("preempt_timeout must be positive")
+        if autoscale is not None:
+            if session_factory is None and autoscale.g_max > len(sessions):
+                raise ValueError(
+                    "autoscale growth (g_max > initial pool) needs a "
+                    "session_factory"
+                )
+            if len(sessions) < autoscale.g_min:
+                raise ValueError(
+                    f"initial pool {len(sessions)} below autoscale.g_min "
+                    f"{autoscale.g_min}"
+                )
         self.sessions = list(sessions)
         self.slo = slo or SloMeter()
-        self.queue = AdmissionQueue(queue_depth, backpressure, self.slo)
+        self.queue = AdmissionQueue(
+            queue_depth, backpressure, self.slo,
+            tier_reserve=tier_reserve, tier_policies=tier_policies,
+        )
         self.flush_after = flush_after
+        self.routing = routing
+        self.preempt = preempt
+        self.preempt_timeout = preempt_timeout
+        self.autoscale = autoscale
         self.interval = sessions[0].interval
         self.batcher = None
         self._cv = threading.Condition()
         self._released = 0.0
         self._stop = False
+        #: Set (under the cv) once the stream has fully drained and the
+        #: shutdown STOPs are being delivered: pool GROWTH past this
+        #: point would spawn a session nobody ever stops (run()'s join
+        #: loop would spin on it forever), so grow_pool refuses and a
+        #: supervisor replacement immediately queues its own STOP
+        #: behind the requeued jobs.
+        self._draining = False
         self._errors: List[BaseException] = []
         self._rr = 0
         self._completion_hooks: List[Callable] = []
+        #: Admission ledger: app.id -> _Inflight for every job currently
+        #: holding queue capacity (preemption victims come from here).
+        self._inflight: Dict[str, _Inflight] = {}
+        self._admit_seq = 0
+        #: Tier of the arrival the producer is currently parked on (the
+        #: preempt dance / block wait): spill re-offers must not hand
+        #: freed capacity to anything less important, or a preempted
+        #: victim would re-enter the instant its preemption freed the
+        #: slot it was preempted FOR (livelock).
+        self._waiting_tier: Optional[int] = None
+        self._preempt_outstanding = 0
         #: Supervisor state (inert when ``session_factory`` is None).
         self._session_factory = session_factory
         self._max_restarts = max_restarts
         self.stall_timeout = stall_timeout
         self._restarts = 0
+        self._n_grown = 0
         #: (session, thread) for every session thread ever spawned.
         self._threads: List = []
         self._abandoned: List[ServeSession] = []
+        self._retired: List[ServeSession] = []
+        self._autoscaler: Optional[SloAutoscaler] = None
         self._watch_stop = threading.Event()
         for slot, s in enumerate(self.sessions):
             s._driver = self
@@ -168,8 +264,15 @@ class ServeDriver(LogMixin):
         if session.abandoned:
             return  # a replaced session's stale thread reporting late
         with self._cv:
+            rec = self._inflight.pop(app.id, None)
+            tier = (
+                rec.tier if rec is not None
+                else int(getattr(app, "_serve_tier", 0))
+            )
             self.queue.release()
-            self.slo.count("failed_jobs" if failed else "completed")
+            key = "failed_jobs" if failed else "completed"
+            self.slo.count(key)
+            self.slo.count_tier(tier, key)
             self._reoffer_spilled(after_sim=sim_now)
             self._cv.notify_all()
         for fn in self._completion_hooks:
@@ -178,6 +281,17 @@ class ServeDriver(LogMixin):
     def on_session_error(self, session: ServeSession, exc) -> None:
         if session.abandoned:
             return  # already replaced by the supervisor; nothing to do
+        if session.retiring and not self._stop:
+            # A crash DURING a scale-down drain: the retire was already
+            # decided — settle it (requeue the in-flight jobs onto the
+            # surviving pool, retire the slot exactly once) instead of
+            # spawning a replacement we were about to drain anyway.
+            self.logger.error(
+                "session %s crashed mid-retire (%s) — settling retire",
+                session.label, exc,
+            )
+            self._retire_crashed(session, close_client=False)
+            return
         if (
             self._session_factory is not None
             and self._restarts < self._max_restarts
@@ -197,6 +311,77 @@ class ServeDriver(LogMixin):
             s.shutdown()
 
     # -- the session supervisor --------------------------------------------
+    def _recover_inflight(self, dead: ServeSession) -> List[JobArrival]:
+        """Harvest a dead/retiring-crashed session's recoverable work
+        (cv held): un-injected inbox arrivals keep their original
+        timestamps and app objects; live (possibly partially-run) jobs
+        are resubmitted as clones — the dead session's world is gone, so
+        their execution restarts, but their admission capacity is
+        retained (see class docstring).  Jobs that terminated inside the
+        dead session but were never reaped are settled here — the
+        abandoned thread's late reap is ignored by ``on_completed``, so
+        skipping them would leak a queue slot per restart.  Pending
+        preempt requests addressed to the dead session resolve as
+        misses."""
+        lost: List[JobArrival] = []
+        while True:
+            try:
+                item = dead._inbox.get_nowait()
+            except _pyqueue.Empty:
+                break
+            if item is STOP:
+                continue
+            if isinstance(item, PreemptRequest):
+                self._preempt_outstanding -= 1
+                self.slo.count("preempt_misses")
+                rec = self._inflight.get(item.app.id)
+                if rec is not None:
+                    rec.requested = False
+                continue
+            lost.append(item)
+        for app in dead._live:
+            rec = self._inflight.pop(app.id, None)
+            tier = (
+                rec.tier if rec is not None
+                else int(getattr(app, "_serve_tier", 0))
+            )
+            if app.is_finished or getattr(app, "failed", False):
+                self.queue.release()
+                key = "completed" if app.is_finished else "failed_jobs"
+                self.slo.count(key)
+                self.slo.count_tier(tier, key)
+                continue
+            ts = getattr(app, "_serve_admit_ts", 0.0)
+            clone = app.clone()
+            if rec is not None:
+                rec.app = clone
+                rec.requested = False
+                self._inflight[clone.id] = rec
+            lost.append(
+                JobArrival(
+                    ts, clone, tier=tier,
+                    tenant=getattr(app, "_serve_tenant", "default"),
+                )
+            )
+        return lost
+
+    def _requeue(self, lost: List[JobArrival]) -> None:
+        """Route recovered jobs back into the pool (cv held), submission
+        times never before the release frontier's next tick (a
+        readmission cannot land in a session's past)."""
+        floor_t = (
+            self._released if self._released != float("inf") else None
+        )
+        for arr in lost:
+            ts = (
+                arr.ts if floor_t is None
+                else max(arr.ts, self._next_tick(floor_t))
+            )
+            self.slo.count("requeued")
+            self._route(
+                JobArrival(ts, arr.app, tier=arr.tier, tenant=arr.tenant)
+            )
+
     def _restart_session(self, dead: ServeSession,
                          close_client: bool) -> None:
         """Replace a crashed/stalled session: requeue its in-flight jobs
@@ -220,65 +405,18 @@ class ServeDriver(LogMixin):
             self._abandoned.append(dead)
             self.slo.count("session_restarts")
             idx = self.sessions.index(dead)
-            # In-flight work to recover: arrivals routed but never
-            # injected keep their original timestamps; live (possibly
-            # partially-run) jobs are resubmitted as clones — the dead
-            # session's world is gone, so their execution restarts, but
-            # their admission capacity is retained (see class docstring).
-            lost: List[JobArrival] = []
-            while True:
-                try:
-                    item = dead._inbox.get_nowait()
-                except _pyqueue.Empty:
-                    break
-                if item is not STOP:
-                    lost.append(item)
-            for app in dead._live:
-                if app.is_finished or getattr(app, "failed", False):
-                    # Terminated inside the dead session but never reaped
-                    # (the crash/stall hit between the state flip and
-                    # _reap_completions): settle its admission capacity
-                    # HERE — the abandoned thread's late reap is ignored
-                    # by on_completed, so skipping it would leak a queue
-                    # slot per restart.
-                    self.queue.release()
-                    self.slo.count(
-                        "completed" if app.is_finished else "failed_jobs"
-                    )
-                    continue
-                ts = getattr(app, "_serve_admit_ts", 0.0)
-                lost.append(JobArrival(ts, app.clone()))
+            lost = self._recover_inflight(dead)
             self._reoffer_spilled()
             new = self._session_factory(f"{dead.label}-r{self._restarts}")
-            new._driver = self
             new.slot = dead.slot
-            new.slo = self.slo
-            new.scheduler.slo = self.slo
             self.sessions[idx] = new
-            client = None
-            if self.batcher is not None:
-                client = self.batcher.respawn_client()
-                new.policy.enable_batching(client)
-            new._client = client
-            thread = threading.Thread(
-                target=new.loop, args=(client,),
-                name=f"serve-{new.label}", daemon=True,
-            )
-            self._threads.append((new, thread))
-            thread.start()
-            # Requeue: submission times never before the release
-            # frontier's next tick (a readmission cannot land in the new
-            # session's past).
-            floor_t = (
-                self._released if self._released != float("inf") else None
-            )
-            for arr in lost:
-                ts = (
-                    arr.ts if floor_t is None
-                    else max(arr.ts, self._next_tick(floor_t))
-                )
-                self.slo.count("requeued")
-                new.offer(JobArrival(ts, arr.app))
+            self._wire_and_start(new)
+            self._requeue(lost)
+            if self._draining:
+                # The stream-end STOPs already went out; this
+                # replacement must stop itself once the requeued jobs
+                # (FIFO ahead of the STOP in its inbox) have drained.
+                new.shutdown()
             self._cv.notify_all()
         # Unblock the dead session outside the lock: wake it if parked on
         # its inbox (it sees ``abandoned`` and exits), and reclaim its
@@ -286,6 +424,28 @@ class ServeDriver(LogMixin):
         dead.shutdown()
         if close_client and getattr(dead, "_client", None) is not None:
             dead._client.close()
+
+    def _wire_and_start(self, new: ServeSession) -> None:
+        """Attach a factory session to the service and start its thread
+        (cv held): service-wide SLO meter, a FRESH batcher slot when the
+        pool is batched, thread registration.  Shared by the supervisor
+        restart path and the autoscaler grow path — pool membership
+        (``self.sessions``) is the caller's business."""
+        new._driver = self
+        new.slo = self.slo
+        new.scheduler.slo = self.slo
+        client = None
+        if self.batcher is not None:
+            client = self.batcher.respawn_client()
+            new.policy.enable_batching(client)
+            new.slot = client.slot
+        new._client = client
+        thread = threading.Thread(
+            target=new.loop, args=(client,),
+            name=f"serve-{new.label}", daemon=True,
+        )
+        self._threads.append((new, thread))
+        thread.start()
 
     def _watchdog(self) -> None:
         """Stall detector: a session with live work whose event loop has
@@ -301,6 +461,14 @@ class ServeDriver(LogMixin):
                 if s.abandoned or s.error is not None or not s._live:
                     continue
                 if now - s.last_progress <= self.stall_timeout:
+                    continue
+                if s.retiring:
+                    # Wedged mid-retire: settle the retire, requeue.
+                    self.logger.error(
+                        "session %s stalled mid-retire — settling",
+                        s.label,
+                    )
+                    self._retire_crashed(s, close_client=True)
                     continue
                 if (
                     self._session_factory is None
@@ -320,55 +488,322 @@ class ServeDriver(LogMixin):
                 )
                 self._restart_session(s, close_client=True)
 
+    # -- autoscaler pool surgery -------------------------------------------
+    def pool_size(self) -> int:
+        """Sessions currently accepting work (retiring excluded)."""
+        with self._cv:
+            return len(
+                [s for s in self.sessions if not s.retiring]
+            )
+
+    def grow_pool(self, reason: str = "") -> bool:
+        """Add one factory session to the pool (autoscaler thread)."""
+        with self._cv:
+            if (
+                self._stop or self._draining
+                or self._session_factory is None
+            ):
+                return False
+            # Un-retire in preference to spawning: a session still
+            # draining is warm capacity we were about to throw away.
+            for s in self.sessions:
+                if s.retiring and not s._retired and not s.abandoned:
+                    s.retiring = False
+                    self.slo.count("scale_up_events")
+                    self.logger.info(
+                        "autoscaler un-retired %s (%s)", s.label, reason
+                    )
+                    self._cv.notify_all()
+                    return True
+            self._n_grown += 1
+            new = self._session_factory(f"scale-{self._n_grown}")
+            new.slot = len(self.sessions)
+            self.sessions.append(new)
+            self._wire_and_start(new)
+            self.slo.count("scale_up_events")
+            self.logger.info(
+                "autoscaler grew pool to %d (%s)",
+                len(self.sessions), reason,
+            )
+            self._cv.notify_all()
+        return True
+
+    def begin_retire(self) -> Optional[ServeSession]:
+        """Mark the least-loaded session retiring (drain-then-retire);
+        the router stops feeding it immediately, the autoscaler
+        finalizes once its live set drains.  Returns the victim, or
+        None when no session can be spared."""
+        with self._cv:
+            active = [
+                s for s in self.sessions
+                if not s.retiring and not s.abandoned
+            ]
+            if self._stop or len(active) <= 1:
+                return None
+            victim = min(
+                active, key=lambda s: (s.load, -s.slot)
+            )
+            victim.retiring = True
+            self.slo.count("scale_down_events")
+            self._cv.notify_all()
+            return victim
+
+    def finish_drained_retires(self) -> int:
+        """Finalize every retiring session whose drain completed: STOP
+        its loop (closing its batcher slot), move it to the retired
+        list.  Idempotent; returns how many were finalized."""
+        done: List[ServeSession] = []
+        with self._cv:
+            for s in list(self.sessions):
+                if (
+                    s.retiring and not s._retired and not s.abandoned
+                    and not s._live and s._inbox.empty()
+                ):
+                    s._retired = True
+                    self.sessions.remove(s)
+                    self._retired.append(s)
+                    done.append(s)
+            if done:
+                self._cv.notify_all()
+        for s in done:
+            s.shutdown()
+        return len(done)
+
+    def _retire_crashed(self, dead: ServeSession,
+                        close_client: bool) -> None:
+        """A retiring session crashed/stalled before its drain finished:
+        complete the retire exactly once — requeue its in-flight jobs
+        onto the surviving pool (capacity retained, same contract as a
+        supervisor restart) and retire the slot, WITHOUT spawning a
+        replacement (the pool was shrinking)."""
+        with self._cv:
+            if self._stop or dead.abandoned or dead._retired:
+                return
+            dead.abandoned = True
+            dead._retired = True
+            self._abandoned.append(dead)
+            if dead in self.sessions:
+                self.sessions.remove(dead)
+            lost = self._recover_inflight(dead)
+            self._requeue(lost)
+            self._reoffer_spilled()
+            self._cv.notify_all()
+        dead.shutdown()
+        if close_client and getattr(dead, "_client", None) is not None:
+            dead._client.close()
+
+    # -- in-queue preemption -----------------------------------------------
+    def _try_preempt(self, tier: int) -> bool:
+        """Request preemption of the least important, youngest
+        admitted-but-unplaced job of a tier strictly below ``tier``
+        (cv held).  Returns True when a request was dispatched."""
+        victim: Optional[_Inflight] = None
+        for rec in self._inflight.values():
+            if (
+                rec.tier <= tier or rec.requested or not rec.preemptible
+                or rec.session is None or rec.session.abandoned
+            ):
+                continue
+            if victim is None or (rec.tier, rec.seq) > (
+                victim.tier, victim.seq
+            ):
+                victim = rec
+        if victim is None:
+            return False
+        victim.requested = True
+        self._preempt_outstanding += 1
+        self.slo.count("preempt_requests")
+        victim.session.request_preempt(victim.app)
+        self._cv.notify_all()
+        return True
+
+    def on_preempt_result(self, session: ServeSession, app, ok: bool,
+                          sim_now: float) -> None:
+        """A session answered a preempt request (session thread).  A hit
+        frees the victim's capacity and requeues it to the spill buffer
+        (metered ``preempted``/``preempt_requeued``, NOT as a fresh
+        spill); a miss marks the record non-preemptible so the victim
+        search never retries it."""
+        with self._cv:
+            self._preempt_outstanding -= 1
+            rec = self._inflight.get(app.id)
+            if rec is None:
+                # Completed (and settled) before the request landed.
+                self.slo.count("preempt_misses")
+                self._cv.notify_all()
+                return
+            rec.requested = False
+            if not ok:
+                rec.preemptible = False
+                self.slo.count("preempt_misses")
+                self._cv.notify_all()
+                return
+            del self._inflight[app.id]
+            self.queue.release()
+            self.slo.count("preempted")
+            self.slo.count_tier(rec.tier, "preempted")
+            # Requeue-to-spill with the ORIGINAL arrival timestamp; the
+            # re-offer path floors it to the next grid tick when it
+            # finally readmits.  The app object is reused as-is — it
+            # never executed (that is what made it a victim), so no
+            # session state refers to it.
+            self.queue.spill(
+                JobArrival(rec.ts, rec.app, tier=rec.tier,
+                           tenant=rec.tenant),
+                count=False,
+            )
+            self.slo.count("preempt_requeued")
+            self._cv.notify_all()
+
+    def shed_pressure(self, tier: int) -> bool:
+        """Autoscaler tap: at g_max with the SLO still breached, preempt
+        one admitted-but-unplaced job below ``tier``."""
+        if not self.preempt:
+            return False
+        with self._cv:
+            if self._stop:
+                return False
+            return self._try_preempt(tier)
+
+    # -- spill + routing ---------------------------------------------------
     def _reoffer_spilled(self, after_sim: Optional[float] = None) -> None:
-        """Drain the spill buffer into freed capacity (cv held).  A
-        spilled job's submission lands no earlier than the scheduler
-        grid point after the instant that freed its slot — the "spill to
-        next tick" contract.  ``after_sim`` is the freeing completion's
-        sim time; the belt-and-braces call sites without one (capacity
-        cannot actually be free there — every release re-offers
-        immediately) fall back to the release frontier so a readmission
-        can never land in a session's past."""
-        while self.queue.spilled and not self.queue.full:
-            arr = self.queue.spilled.popleft()
+        """Drain the spill buffer into freed capacity (cv held), in
+        (tier, original arrival order).  A spilled job's submission
+        lands no earlier than the scheduler grid point after the instant
+        that freed its slot — the "spill to next tick" contract.
+        ``after_sim`` is the freeing completion's sim time; the
+        belt-and-braces call sites without one fall back to the release
+        frontier so a readmission can never land in a session's past.
+        While an admission is parked waiting for capacity, tiers less
+        important than it stay spilled — the head check suffices because
+        the buffer is tier-ordered."""
+        while self.queue.spilled:
+            arr = self.queue.peek_spill()
+            if (
+                self._waiting_tier is not None
+                and arr.tier > self._waiting_tier
+            ):
+                break
+            if not self.queue.has_room(arr.tier):
+                break
+            self.queue.pop_spill()
             floor_t = after_sim
             if floor_t is None and self._released != float("inf"):
                 floor_t = self._released
             if floor_t is not None:
                 arr = JobArrival(
-                    max(arr.ts, self._next_tick(floor_t)), arr.app
+                    max(arr.ts, self._next_tick(floor_t)), arr.app,
+                    tier=arr.tier, tenant=arr.tenant,
                 )
             self.queue.readmit(arr)
+            self._register_inflight(arr)
             self._route(arr)
 
-    # -- admission + routing ----------------------------------------------
+    def _register_inflight(self, arrival: JobArrival) -> None:
+        """Ledger a freshly admitted/readmitted arrival (cv held)."""
+        self._admit_seq += 1
+        self._inflight[arrival.app.id] = _Inflight(
+            arrival.app, arrival.ts, arrival.tier, arrival.tenant,
+            self._admit_seq,
+        )
+
     def _route(self, arrival: JobArrival) -> None:
-        target = self.sessions[self._rr % len(self.sessions)]
-        self._rr += 1
+        eligible = [
+            s for s in self.sessions
+            if not s.retiring and not s.abandoned
+        ]
+        if not eligible:  # every session retiring: least bad fallback
+            eligible = [s for s in self.sessions if not s.abandoned]
+        if not eligible:
+            eligible = self.sessions
+        if self.routing == "least_loaded":
+            # Primary: queued + live jobs; tie-break: recent decision
+            # latency EWMA, then slot order (deterministic given equal
+            # telemetry — which is why "rr" stays the parity default).
+            target = min(
+                eligible,
+                key=lambda s: (s.load, s.recent_decision_s, s.slot),
+            )
+        else:
+            target = eligible[self._rr % len(eligible)]
+            self._rr += 1
+        rec = self._inflight.get(arrival.app.id)
+        if rec is not None:
+            rec.session = target
         target.offer(arrival)
         self._cv.notify_all()
 
+    # -- admission ---------------------------------------------------------
     def _admit(self, arrival: JobArrival) -> None:
+        tier = int(getattr(arrival, "tier", 0))
         with self._cv:
             # An arrival at ts proves the stream silent before ts: time
             # may flow to it even while admission deliberates.
             self._release_to(arrival.ts)
             self._reoffer_spilled()
-            status = self.queue.offer(arrival)
-            while (
-                status == BLOCKED and not self._stop and not self._errors
+            if (
+                self.preempt
+                and not self.queue.has_room(tier)
+                and not self._stop
             ):
-                self.slo.count("blocked_waits")
+                self._preempt_for(tier)
+            status = self.queue.offer(arrival)
+            try:
+                self._waiting_tier = tier
+                while (
+                    status == BLOCKED
+                    and not self._stop and not self._errors
+                ):
+                    self.slo.count("blocked_waits")
+                    notified = self._cv.wait(timeout=self._BLOCK_POLL_S)
+                    if not notified and self._released != float("inf"):
+                        # No completion freed capacity: advance sim time
+                        # one tick so in-flight work can progress.
+                        self._release_to(
+                            self._next_tick(self._released)
+                        )
+                    if self.preempt and not self.queue.has_room(tier):
+                        # Keep one preempt request in flight while
+                        # victims remain — block-policy high tiers drain
+                        # the low tiers rather than waiting them out.
+                        if self._preempt_outstanding == 0:
+                            self._try_preempt(tier)
+                    if self.queue.has_room(tier):
+                        self.queue.readmit(arrival)
+                        status = ADMITTED
+            finally:
+                self._waiting_tier = None
+            if status == ADMITTED:
+                self._register_inflight(arrival)
+                self._route(arrival)
+
+    def _preempt_for(self, tier: int) -> None:
+        """The preempt dance (cv held): keep a preemption in flight and
+        wait — bounded by ``preempt_timeout`` wall seconds — until the
+        arrival's tier has room or victims run out.  Falls back to the
+        tier's configured backpressure policy on exhaustion."""
+        deadline = time.perf_counter() + self.preempt_timeout
+        self._waiting_tier = tier
+        try:
+            requested = self._try_preempt(tier)
+            while (
+                requested
+                and not self.queue.has_room(tier)
+                and not self._stop and not self._errors
+                and time.perf_counter() < deadline
+            ):
                 notified = self._cv.wait(timeout=self._BLOCK_POLL_S)
                 if not notified and self._released != float("inf"):
-                    # No completion freed capacity: advance sim time one
-                    # tick so in-flight work can progress toward one.
+                    # Victim sessions may be gated: let sim time flow so
+                    # their threads reach the preempt request.
                     self._release_to(self._next_tick(self._released))
-                if not self.queue.full:
-                    self.queue.readmit(arrival)
-                    status = ADMITTED
-            if status == ADMITTED:
-                self._route(arrival)
+                if (
+                    self._preempt_outstanding == 0
+                    and not self.queue.has_room(tier)
+                ):
+                    requested = self._try_preempt(tier)
+        finally:
+            self._waiting_tier = None
 
     def _produce(self, arrivals: Iterable[JobArrival],
                  pace: Optional[float]) -> None:
@@ -401,6 +836,7 @@ class ServeDriver(LogMixin):
         finally:
             with self._cv:
                 self._release_to(float("inf"))
+                self._draining = True
             for s in self.sessions:
                 s.shutdown()
 
@@ -431,6 +867,7 @@ class ServeDriver(LogMixin):
             clients = [self.batcher.client() for _ in self.sessions]
             for s, c in zip(self.sessions, clients):
                 s.policy.enable_batching(c)
+            self.slo.attach_dispatch_stats(self.batcher.stats)
         for s, c in zip(self.sessions, clients):
             s._client = c
             self._threads.append(
@@ -450,6 +887,9 @@ class ServeDriver(LogMixin):
                 target=self._watchdog, name="serve-watchdog", daemon=True,
             )
             watchdog.start()
+        if self.autoscale is not None:
+            self._autoscaler = SloAutoscaler(self, self.autoscale)
+            self._autoscaler.start()
         producer = threading.Thread(
             target=self._produce, args=(arrivals, pace),
             name="serve-producer", daemon=True,
@@ -477,8 +917,12 @@ class ServeDriver(LogMixin):
         self._watch_stop.set()
         if watchdog is not None:
             watchdog.join()
+        if self._autoscaler is not None:
+            self._autoscaler.stop()
         errors = self._errors + [
-            s.error for s in self.sessions if s.error is not None
+            s.error
+            for s in self.sessions + self._retired
+            if s.error is not None
         ]
         if errors:
             raise errors[0]
@@ -490,11 +934,53 @@ class ServeDriver(LogMixin):
             "backpressure": self.queue.policy,
             "queue_depth": self.queue.depth,
             "flush_after_s": self.flush_after,
+            "routing": self.routing,
+            "preempt": self.preempt,
+            "tier_reserve": (
+                list(self.queue.tier_reserve)
+                if self.queue.tier_reserve else None
+            ),
+            "tier_policies": (
+                list(self.queue.tier_policies)
+                if self.queue.tier_policies else None
+            ),
             "restarts": self._restarts,
+            "pool": {
+                "final": len(self.sessions),
+                "grown": self._n_grown,
+                "retired": len(self._retired),
+                "abandoned": len(self._abandoned),
+            },
+            "autoscaler": (
+                {
+                    "g_min": self.autoscale.g_min,
+                    "g_max": self.autoscale.g_max,
+                    "slo_p99_s": self.autoscale.slo_p99_s,
+                    "events": list(self._autoscaler.events),
+                }
+                if self._autoscaler is not None else None
+            ),
             "slo": self.slo.snapshot(),
             "batcher": dict(self.batcher.stats) if self.batcher else None,
-            "per_session": [s.summary() for s in self.sessions],
+            "per_session": [
+                s.summary() for s in self.sessions + self._retired
+            ],
         }
+
+    def audit(self, context: str = "serve drain") -> None:
+        """Raise ``AuditError`` unless the drained service satisfies the
+        serve conservation law (``infra/audit.py::audit_serve``): every
+        admitted or preempted job terminated exactly once, capacity and
+        spill fully drained, and every surviving session's world passes
+        the cluster/conservation/billing audits."""
+        from pivot_tpu.infra.audit import AuditError, audit_serve
+
+        violations = audit_serve(self)
+        if violations:
+            raise AuditError(
+                f"serve state corrupted ({context}):\n  "
+                + "\n  ".join(violations)
+            )
 
 
 def closed_loop_source(

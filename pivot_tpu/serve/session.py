@@ -44,10 +44,24 @@ from pivot_tpu.utils import LogMixin
 
 from pivot_tpu.serve.arrivals import JobArrival
 
-__all__ = ["STOP", "ServeSession"]
+__all__ = ["STOP", "PreemptRequest", "ServeSession"]
 
 #: Inbox sentinel: the driver ends a session's loop with this.
 STOP = object()
+
+
+class PreemptRequest:
+    """Driver → session mailbox message: cancel ``app`` if it is still
+    admitted-but-unplaced here (in-queue preemption).  Delivered through
+    the inbox so it executes on the session thread — the only thread
+    allowed to mutate this session's event kernel — and FIFO-after any
+    arrival it targets.  The session answers via
+    ``driver.on_preempt_result`` either way (hit or miss)."""
+
+    __slots__ = ("app",)
+
+    def __init__(self, app):
+        self.app = app
 
 
 def _is_batchable(policy) -> bool:
@@ -95,6 +109,17 @@ class ServeSession(LogMixin):
         #: Set by the supervisor when this session is declared dead and
         #: replaced; an abandoned session's late callbacks are ignored.
         self.abandoned = False
+        #: Drain-then-retire state (autoscaler scale-down): ``retiring``
+        #: stops the router sending new work here; ``_retired`` guards
+        #: the retire from ever being finalized twice (the finalize path
+        #: and a crash-during-drain race on it under the driver's lock).
+        self.retiring = False
+        self._retired = False
+        #: EWMA of recent decision latency (wall s) — the routing
+        #: tie-breaker for least-loaded dispatch.  Written only by this
+        #: session's decision tap, read by the router (stale reads are
+        #: fine: it is a heuristic, not a correctness input).
+        self.recent_decision_s = 0.0
         self._kernel_failures_seen = 0
 
         # Mirror ExperimentRun.run()'s construction exactly — the parity
@@ -146,6 +171,20 @@ class ServeSession(LogMixin):
             # service-wide SLO meter after construction.
             self.slo.record_decision(dt, int(arr.shape[0]),
                                      int((arr >= 0).sum()))
+            # Per-tier attribution: the batch's latency counts toward
+            # every tier with work in it (mixed-tier ticks are the
+            # norm — a tier's histogram must see the latency its jobs
+            # actually experienced).  Tier counts weight by tasks.
+            tier_tasks = {}
+            for t in ctx.tasks:
+                tier = int(getattr(t.application, "_serve_tier", 0))
+                tier_tasks[tier] = tier_tasks.get(tier, 0) + 1
+            for tier, n in tier_tasks.items():
+                self.slo.record_decision_tier(tier, dt, n_tasks=n)
+            # Routing telemetry: EWMA over this session's recent calls.
+            self.recent_decision_s = (
+                0.8 * self.recent_decision_s + 0.2 * dt
+            )
             # Degradation telemetry (device policies only): surface
             # kernel failures absorbed by the CPU-twin fallback and
             # ticks served degraded (``sched/tpu.py`` degrade_after).
@@ -166,6 +205,18 @@ class ServeSession(LogMixin):
         """Route one admitted arrival to this session (driver thread)."""
         self._inbox.put(arrival)
 
+    def request_preempt(self, app) -> None:
+        """Ask this session (driver thread) to cancel an admitted-but-
+        unplaced app; answered asynchronously on the session thread."""
+        self._inbox.put(PreemptRequest(app))
+
+    @property
+    def load(self) -> int:
+        """Routing load signal: queued + live jobs on this session.
+        Approximate by design (both ends mutate concurrently) — the
+        least-loaded router only needs relative ordering."""
+        return self._inbox.qsize() + len(self._live)
+
     def shutdown(self) -> None:
         self._inbox.put(STOP)
 
@@ -182,6 +233,9 @@ class ServeSession(LogMixin):
                     client.set_idle(False)
                 if item is STOP or self.abandoned:
                     break
+                if isinstance(item, PreemptRequest):
+                    self._handle_preempt(item.app)
+                    continue
                 self._inject(item)
                 self._drain(client)
         except BaseException as exc:  # noqa: BLE001 — surfaced by driver
@@ -203,24 +257,61 @@ class ServeSession(LogMixin):
                 # Re-queue so the outer loop sees it after the drain.
                 self._inbox.put(item)
                 return
+            if isinstance(item, PreemptRequest):
+                self._handle_preempt(item.app)
+                continue
             self._inject(item)
+
+    def _handle_preempt(self, app) -> None:
+        """Serve one preemption request on the session thread.  A hit
+        requires the app to still be admitted-but-unplaced: either its
+        submission callback has not fired yet (cancel it — the scheduler
+        never saw the app) or every materialized task is still NASCENT
+        (``GlobalScheduler.withdraw``).  Anything else — placed, running,
+        finished, already reaped — is a miss; the job keeps its capacity
+        and terminates through the normal paths."""
+        ok = False
+        if app in self._live:
+            cb = getattr(app, "_serve_submit_cb", None)
+            if cb is not None:
+                # Submission still pending on the heap: cancel in place.
+                cb.cancel()
+                app._serve_submit_cb = None
+                ok = True
+            else:
+                ok = self.scheduler.withdraw(app)
+            if ok:
+                self._live.remove(app)
+                self._injected.remove(app)
+        if self._driver is not None:
+            self._driver.on_preempt_result(self, app, ok, self.env.now)
 
     def _inject(self, arrival: JobArrival) -> None:
         """Enter one job: submission scheduled at its sim-time instant,
         or immediately (a recorded *late injection*) when the session's
         clock has already passed it."""
         env = self.env
-        self._live.append(arrival.app)
-        self._injected.append(arrival.app)
-        arrival.app._serve_admit_ts = arrival.ts
+        app = arrival.app
+        self._live.append(app)
+        self._injected.append(app)
+        app._serve_admit_ts = arrival.ts
+        app._serve_tier = int(getattr(arrival, "tier", 0))
+        app._serve_tenant = getattr(arrival, "tenant", "default")
         if arrival.ts >= env.now:
-            env.schedule_callback_at(
-                arrival.ts,
-                lambda app=arrival.app: self.scheduler.submit(app),
+            # The callback handle rides on the app so an in-queue
+            # preemption arriving before it fires can cancel the
+            # submission outright (the cheapest possible victim).
+            def _submit(app=app):
+                app._serve_submit_cb = None
+                self.scheduler.submit(app)
+
+            app._serve_submit_cb = env.schedule_callback_at(
+                arrival.ts, _submit
             )
         else:
+            app._serve_submit_cb = None
             self.slo.count("late_injections")
-            self.scheduler.submit(arrival.app)
+            self.scheduler.submit(app)
 
     def _work_pending(self) -> bool:
         return bool(self._live)
@@ -264,7 +355,10 @@ class ServeSession(LogMixin):
             if app.is_finished:
                 self.completed.append(app)
                 admit_ts = getattr(app, "_serve_admit_ts", app.start_time)
-                self.slo.record_sojourn(max(app.end_time - admit_ts, 0.0))
+                self.slo.record_sojourn(
+                    max(app.end_time - admit_ts, 0.0),
+                    tier=int(getattr(app, "_serve_tier", 0)),
+                )
             else:
                 # Dead-lettered by retry governance: the job terminates
                 # as failed — its admission capacity is still released

@@ -117,9 +117,12 @@ def test_lockstep_grid_smoke_and_stats_keys():
 
     assert set(stats) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
-        "deadline_flushes", "single_fast_path",
+        "deadline_flushes", "single_fast_path", "respawns",
+        "retired_slots",
     }
     assert stats["runs"] == 2
+    assert stats["respawns"] == 0  # no supervisor/autoscaler in a grid
+    assert stats["retired_slots"] == 2  # every run closed its slot
     assert stats["device_calls"] <= stats["dispatches"]
     assert stats["deadline_flushes"] == 0  # grid mode: quiescence-only
     for g in range(2):

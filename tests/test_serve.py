@@ -233,6 +233,66 @@ def test_block_backpressure_is_lossless():
     assert c["blocked_waits"] > 0, "depth 2 never blocked the producer"
 
 
+def test_spill_reoffers_preserve_arrival_order():
+    """Regression for the spill re-offer ordering guarantee: spilled
+    arrivals re-enter at completion boundaries in ORIGINAL arrival
+    order, even as fresh arrivals interleave with re-offers.  At depth 1
+    on one session jobs execute strictly one at a time, so the service's
+    completion order is exactly its (re)admission order — any re-offer
+    reordering would show up here."""
+    reset_ids()
+    sessions = _sessions(1, _numpy_policy)
+    driver = ServeDriver(sessions, queue_depth=1, backpressure="spill")
+    completion_order = []
+    driver.add_completion_hook(
+        lambda _s, app, _now: completion_order.append(app.id)
+    )
+    make_app = synthetic_app_factory(seed=5, runtime=(20.0, 40.0))
+    arrs = list(
+        poisson_arrivals(rate=1.0, n_jobs=7, seed=2, make_app=make_app)
+    )
+    report = driver.run(iter(arrs))
+    c = report["slo"]["counters"]
+    assert c["spilled"] > 0, "depth 1 never spilled — regression untested"
+    assert c["completed"] == 7
+    assert completion_order == [a.app.id for a in arrs]
+
+
+def test_slo_snapshot_schema_has_dispatch_mix():
+    """The SLO snapshot surfaces the dispatch-path mix under the
+    documented ``DispatchBatcher.stats`` key set — zeros for an
+    unbatched service, the live stats (including ``single_fast_path``)
+    for a batched one — so soak reports and bench rows can attribute
+    how placements reached the device."""
+    from pivot_tpu.infra.meter import SloMeter
+
+    fresh = SloMeter().snapshot()
+    assert set(fresh["dispatch"]) == set(SloMeter.DISPATCH_KEYS)
+    assert set(SloMeter.DISPATCH_KEYS) == {
+        "runs", "dispatches", "device_calls", "coalesced", "max_group",
+        "deadline_flushes", "single_fast_path", "respawns",
+        "retired_slots",
+    }
+    assert all(v == 0 for v in fresh["dispatch"].values())
+    assert fresh["tiers"] == {}
+
+    reset_ids()
+    sessions = _sessions(2, _device_policy)
+    driver = ServeDriver(sessions, queue_depth=16, backpressure="shed",
+                         flush_after=0.5)
+    report = driver.run(poisson_arrivals(rate=0.2, n_jobs=6, seed=4))
+    snap = report["slo"]
+    assert set(snap["dispatch"]) == set(SloMeter.DISPATCH_KEYS)
+    # The snapshot mirrors the batcher's stats dict exactly.
+    for k in SloMeter.DISPATCH_KEYS:
+        assert snap["dispatch"][k] == report["batcher"][k], k
+    assert snap["dispatch"]["dispatches"] > 0
+    # Single-tenant traffic still lands per-tier telemetry under tier 0.
+    assert set(snap["tiers"]) == {"0"}
+    t0 = snap["tiers"]["0"]["counters"]
+    assert t0["admitted"] == t0["completed"] == 6
+
+
 def test_closed_loop_load_generator():
     """The closed-loop generator keeps C jobs in flight: each completion
     injects the next job until n_jobs have been served."""

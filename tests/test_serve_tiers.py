@@ -1,0 +1,544 @@
+"""Multi-tenant serving under pressure (round 9).
+
+The acceptance bars: tier-ordered backpressure (reservations + per-tier
+policies shed/spill low tiers first), in-queue preemption of
+admitted-but-unplaced low-tier jobs by high-tier arrivals, least-loaded
+routing, the SLO-driven autoscaler (grow on breach, drain-then-retire
+on calm, crash-during-drain settled exactly once), and the headline —
+a seeded mixed-tier chaos soak at ≥10× the PR-2 bench arrival rate
+whose invariant is SpotServe's "degrade, never fail": tier 0 within its
+SLO with zero sheds while the lower tiers absorb every shed and
+preemption, refereed by ``infra/audit.py::audit_serve``.
+"""
+
+import time as _time
+
+import numpy as np
+import pytest
+
+from pivot_tpu.infra.faults import ChaosSchedule, FaultInjector
+from pivot_tpu.sched import HostCircuitBreaker, RetryPolicy
+from pivot_tpu.serve import (
+    AdmissionQueue,
+    AutoscaleConfig,
+    JobArrival,
+    ServeDriver,
+    ServeSession,
+    mixed_tier_arrivals,
+    poisson_arrivals,
+    synthetic_app_factory,
+    trace_arrivals,
+)
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.utils.config import (
+    ClusterConfig,
+    PolicyConfig,
+    build_cluster,
+    make_policy,
+)
+
+#: The PR-2 ``serve_stream`` bench arrival rate — the soak must run at
+#: ≥ 10× this (the ROADMAP item 3 / ISSUE acceptance bar).
+PR2_BENCH_RATE = 0.25
+SOAK_RATE = 2.5
+
+
+def _numpy_policy():
+    return make_policy(
+        PolicyConfig(
+            name="cost-aware", device="numpy",
+            sort_tasks=True, sort_hosts=True,
+        )
+    )
+
+
+def _session(label, n_hosts=8, seed=0, retry=None, breaker=None,
+             interval=5.0, decision_sleep=0.0):
+    policy = _numpy_policy()
+    if decision_sleep:
+        # Stretch the RAW policy before the session's decision tap wraps
+        # it, so the tap (and the SLO meter) measures the stretch — the
+        # latency-breach injection vector for autoscaler tests.
+        orig = policy.place
+
+        def slow(ctx):
+            _time.sleep(decision_sleep)
+            return orig(ctx)
+
+        policy.place = slow
+    return ServeSession(
+        label,
+        build_cluster(ClusterConfig(n_hosts=n_hosts, seed=0)),
+        policy,
+        seed=seed,
+        interval=interval,
+        retry=retry,
+        breaker=breaker,
+    )
+
+
+def _sessions(n, **kw):
+    return [_session(f"s{g}", **kw) for g in range(n)]
+
+
+# -- tier-aware admission ----------------------------------------------------
+
+
+def test_tier_reservations_shed_low_tiers_first():
+    """Per-tier depth reservations: with ``reserve=(0, 2)`` the low tier
+    sees a shorter queue, so under pressure every shed lands on tier 1
+    while tier 0 keeps admitting into the reserved headroom."""
+    reset_ids()
+    driver = ServeDriver(
+        _sessions(1), queue_depth=4, backpressure="shed",
+        tier_reserve=(0, 2),
+    )
+    make_app = synthetic_app_factory(seed=5, runtime=(300.0, 400.0))
+    # Long jobs: nothing completes inside the burst, so in-flight climbs
+    # monotonically — tier 1 saturates its effective depth (4−2=2) after
+    # two admissions, tier 0 keeps admitting into the reserved headroom.
+    arrs = []
+    t = 0.0
+    for tier in (1, 1, 0, 1, 0, 1, 1, 1):
+        t += 0.1
+        arrs.append(JobArrival(t, make_app(), tier=tier))
+    report = driver.run(iter(arrs))
+    tiers = report["slo"]["tiers"]
+    assert tiers["0"]["counters"]["shed"] == 0
+    assert tiers["1"]["counters"]["shed"] > 0
+    assert (
+        report["slo"]["counters"]["shed"]
+        == tiers["1"]["counters"]["shed"]
+    )
+    driver.audit()
+
+
+def test_tier_policies_spill_high_shed_low_preserving_order():
+    """Mixed per-tier backpressure: tier 0 spills (lossless), tier 1
+    sheds — and the spill re-offer path hands tier-0 arrivals back in
+    their ORIGINAL arrival order even with shed traffic interleaved."""
+    reset_ids()
+    sessions = _sessions(1)
+    driver = ServeDriver(
+        sessions, queue_depth=2, backpressure="shed",
+        tier_policies=("spill", "shed"),
+    )
+    completion_order = []
+    driver.add_completion_hook(
+        lambda _s, app, _now: completion_order.append(app.id)
+    )
+    make_app = synthetic_app_factory(seed=3, runtime=(150.0, 250.0))
+    arrs = []
+    t = 0.0
+    for i in range(10):
+        t += 0.2
+        arrs.append(JobArrival(t, make_app(), tier=i % 2))
+    report = driver.run(iter(arrs))
+    tiers = report["slo"]["tiers"]
+    assert tiers["0"]["counters"]["shed"] == 0
+    assert tiers["0"]["counters"]["spilled"] > 0
+    assert tiers["1"]["counters"]["shed"] > 0
+    assert tiers["1"]["counters"]["spilled"] == 0
+    # Every tier-0 job completed, in arrival order (depth-2 single
+    # session serves nearly serially; order inversions would interleave
+    # ids here).  Tier-1 completions are the admitted subset, in order.
+    t0_ids = [a.app.id for a in arrs if a.tier == 0]
+    assert [i for i in completion_order if i in set(t0_ids)] == t0_ids
+    driver.audit()
+
+
+def test_admission_queue_spill_buffer_is_tier_then_arrival_ordered():
+    """Unit: the spill buffer pops (tier, original arrival timestamp) —
+    most important tier first, arrival order within a tier — regardless
+    of INSERTION order.  The insertion-order case matters for
+    preemption: a victim requeued after a later-arrived same-tier job
+    spilled must still re-enter at its original arrival position."""
+    q = AdmissionQueue(2, "spill")
+    a = JobArrival(1.0, None, tier=2)
+    b = JobArrival(2.0, None, tier=0)
+    c = JobArrival(3.0, None, tier=2)
+    d = JobArrival(4.0, None, tier=1)
+    for arr in (a, b, c, d):
+        q.spill(arr)
+    # The preemption shape: tier-2 victim from ts=0.5 spills LAST (its
+    # preemption happened after a/c arrived and spilled) yet re-offers
+    # FIRST within tier 2.
+    victim = JobArrival(0.5, None, tier=2)
+    q.spill(victim, count=False)
+    assert [q.pop_spill() for _ in range(5)] == [b, d, victim, a, c]
+    assert not q.spilled
+
+
+# -- in-queue preemption -----------------------------------------------------
+
+
+def test_high_tier_arrival_preempts_unplaced_low_tier():
+    """The preemption path end to end: a tier-0 arrival meeting a full
+    queue cancels the youngest admitted-but-unplaced tier-1 job (its
+    submission lies beyond the release frontier, so it is provably
+    unplaced), takes its capacity, and the victim re-enters via the
+    spill buffer and still completes — nothing is lost, and the audit's
+    conservation law (every admission terminates exactly once) holds."""
+    reset_ids()
+    sessions = _sessions(1)
+    driver = ServeDriver(
+        sessions, queue_depth=2, backpressure="shed",
+        tier_policies=("block", "shed"), preempt=True,
+    )
+    make_app = synthetic_app_factory(seed=9, runtime=(5.0, 15.0))
+    # Two tier-1 victims admitted with far-future submissions (the
+    # frontier stays at 1.4 until the stream ends), then the tier-0
+    # arrival that needs one of their slots.
+    arrs = [
+        JobArrival(50.0, make_app(), tier=1),
+        JobArrival(51.0, make_app(), tier=1),
+        JobArrival(1.4, make_app(), tier=0),
+    ]
+    report = driver.run(iter(arrs))
+    c = report["slo"]["counters"]
+    assert c["preempt_requests"] >= 1
+    assert c["preempted"] == 1
+    assert c["preempt_requeued"] == 1
+    assert c["shed"] == 0
+    assert c["completed"] == 3  # victim re-entered and finished
+    tiers = report["slo"]["tiers"]
+    assert tiers["0"]["counters"]["preempted"] == 0
+    assert tiers["1"]["counters"]["preempted"] == 1
+    # The victim's re-admission is a fresh admitted count: 2 originals
+    # + 1 re-entry.
+    assert tiers["1"]["counters"]["admitted"] == 3
+    driver.audit()
+
+
+def test_preempt_miss_on_placed_job_falls_back():
+    """A preemption request that finds its victim already placed (or
+    running) is a MISS: the victim keeps its capacity, the arrival
+    falls back to its tier's policy, and nothing double-terminates."""
+    reset_ids()
+    sessions = _sessions(1)
+    driver = ServeDriver(
+        sessions, queue_depth=1, backpressure="shed",
+        tier_policies=("shed", "shed"), preempt=True,
+        preempt_timeout=0.3,
+    )
+    make_app = synthetic_app_factory(seed=7, runtime=(30.0, 40.0))
+    victim_app = make_app()
+
+    def arrivals():
+        yield JobArrival(1.0, victim_app, tier=1)
+        # A doomed tier-1 arrival at ts=39: shed on the spot (depth 1),
+        # but its timestamp advances the release frontier so the session
+        # steps through the tick that PLACES the victim's source tasks.
+        yield JobArrival(39.0, make_app(), tier=1)
+        deadline = _time.time() + 10.0
+        while _time.time() < deadline and all(
+            t.is_nascent
+            for g in victim_app.groups for t in g.tasks
+        ):
+            _time.sleep(0.005)
+        # Victim now has running work: the tier-0 arrival's preemption
+        # must MISS and fall back to its tier policy.
+        yield JobArrival(40.0, make_app(), tier=0)
+
+    report = driver.run(arrivals())
+    c = report["slo"]["counters"]
+    assert c["preempted"] == 0
+    assert c["preempt_misses"] >= 1
+    tiers = report["slo"]["tiers"]
+    assert tiers["0"]["counters"]["shed"] == 1  # fell back to shed
+    assert tiers["1"]["counters"]["shed"] == 1  # the ts=39 probe
+    assert tiers["1"]["counters"]["completed"] == 1
+    driver.audit()
+
+
+# -- routing -----------------------------------------------------------------
+
+
+def test_least_loaded_routing_balances_by_inbox_depth():
+    """Least-loaded routing sends a burst to the emptier sessions first
+    (round-robin would alternate regardless of backlog).  Pin one
+    session's load high via a pre-routed backlog and assert the burst
+    lands elsewhere."""
+    reset_ids()
+    sessions = _sessions(3)
+    driver = ServeDriver(
+        sessions, queue_depth=32, backpressure="shed",
+        routing="least_loaded",
+    )
+    make_app = synthetic_app_factory(seed=2, runtime=(5.0, 20.0))
+    report = driver.run(
+        poisson_arrivals(rate=0.3, n_jobs=9, seed=6, make_app=make_app)
+    )
+    assert report["routing"] == "least_loaded"
+    served = [s.summary()["n_apps"] for s in driver.sessions]
+    assert sum(served) == 9
+    # Balance: no session starves while another hoards the stream.
+    assert max(served) - min(served) <= 3
+    driver.audit()
+
+
+# -- autoscaler --------------------------------------------------------------
+
+
+def test_autoscaler_grows_pool_on_slo_breach():
+    """Sustained p99 over target grows the pool toward g_max on fresh
+    batcher-style slots (factory sessions), and the scaling-event log +
+    counters record every move."""
+    reset_ids()
+    sessions = [_session("s0", decision_sleep=0.03)]
+
+    def factory(label):
+        return _session(label, decision_sleep=0.03)
+
+    driver = ServeDriver(
+        sessions, queue_depth=16, backpressure="shed",
+        session_factory=factory,
+        autoscale=AutoscaleConfig(
+            g_min=1, g_max=3, slo_p99_s=0.005, check_interval_s=0.03,
+            breach_checks=2, calm_checks=50, cooldown_s=0.05,
+        ),
+    )
+    make_app = synthetic_app_factory(seed=4, runtime=(5.0, 15.0))
+    report = driver.run(
+        poisson_arrivals(rate=0.4, n_jobs=14, seed=8, make_app=make_app)
+    )
+    c = report["slo"]["counters"]
+    assert c["completed"] == 14 and c["shed"] == 0
+    assert c["scale_up_events"] >= 1
+    assert report["pool"]["final"] > 1
+    assert report["autoscaler"]["events"], "no scaling event logged"
+    assert any(
+        e["action"] == "grow" for e in report["autoscaler"]["events"]
+    )
+    driver.audit()
+
+
+def test_autoscaler_drains_and_retires_on_calm():
+    """Sustained calm shrinks the pool toward g_min via drain-then-
+    retire: the victim stops receiving work, finishes its live jobs,
+    and its slot is closed — no job is lost or moved mid-flight."""
+    reset_ids()
+    sessions = _sessions(3)
+    driver = ServeDriver(
+        sessions, queue_depth=16, backpressure="shed",
+        autoscale=AutoscaleConfig(
+            g_min=1, g_max=3, slo_p99_s=0.5, check_interval_s=0.02,
+            breach_checks=50, calm_checks=2, shrink_factor=0.9,
+            cooldown_s=0.02,
+        ),
+    )
+    make_app = synthetic_app_factory(seed=4, runtime=(5.0, 10.0))
+    # Pace the stream so the service stays up ~1 wall-second — the calm
+    # windows the shrink hysteresis needs.
+    report = driver.run(
+        poisson_arrivals(rate=0.5, n_jobs=10, seed=3, make_app=make_app),
+        pace=30.0,
+    )
+    c = report["slo"]["counters"]
+    assert c["completed"] == 10 and c["shed"] == 0
+    assert c["scale_down_events"] >= 1
+    assert report["pool"]["retired"] >= 1
+    assert report["pool"]["final"] < 3
+    driver.audit()
+
+
+def test_session_crash_during_scale_down_drain_settles_once():
+    """Satellite: a session that crashes DURING its scale-down drain
+    must not double-retire its slot or strand its in-flight jobs — the
+    retire-crash path requeues them onto the surviving pool (admission
+    capacity retained) and finalizes the retire exactly once."""
+    reset_ids()
+    sessions = _sessions(2)
+
+    # Session 1's placement raises once it has been marked retiring —
+    # the crash lands mid-drain by construction.
+    orig = sessions[1].policy.place
+
+    def crash_when_retiring(ctx):
+        if sessions[1].retiring:
+            raise RuntimeError("injected crash during retire drain")
+        return orig(ctx)
+
+    sessions[1].policy.place = crash_when_retiring
+    driver = ServeDriver(sessions, queue_depth=8, backpressure="shed")
+    make_app = synthetic_app_factory(seed=6, runtime=(10.0, 20.0))
+
+    def arrivals():
+        yield JobArrival(1.0, make_app())   # rr -> session 0
+        yield JobArrival(1.2, make_app())   # rr -> session 1
+        # Session 1 now holds a live, unfinished job: begin its retire
+        # (the router stops feeding it), then let its next placement
+        # tick crash it mid-drain.
+        sessions[1].retiring = True
+        yield JobArrival(2.0, make_app())   # routes to session 0 only
+
+    report = driver.run(arrivals())
+    c = report["slo"]["counters"]
+    assert c["completed"] == 3, "the crashed drain stranded a job"
+    assert c["requeued"] >= 1
+    assert report["restarts"] == 0  # settled as a retire, not a restart
+    assert sessions[1]._retired and sessions[1].abandoned
+    assert report["pool"]["final"] == 1
+    assert report["pool"]["abandoned"] == 1
+    # Idempotence: a late finalize sweep must not retire it again.
+    assert driver.finish_drained_retires() == 0
+    driver.audit()
+
+
+# -- arrival-source validation (satellite) -----------------------------------
+
+
+def test_poisson_rate_validation_is_eager():
+    with pytest.raises(ValueError, match="rate must be positive"):
+        poisson_arrivals(0.0, 5)
+    with pytest.raises(ValueError, match="rate must be positive"):
+        poisson_arrivals(-1.0, None)
+
+
+def test_mixed_tier_weights_validation():
+    with pytest.raises(ValueError, match="rate must be positive"):
+        mixed_tier_arrivals(0.0, 5, (1.0, 1.0))
+    with pytest.raises(ValueError, match="weights"):
+        mixed_tier_arrivals(1.0, 5, ())
+    with pytest.raises(ValueError, match="weights"):
+        mixed_tier_arrivals(1.0, 5, (0.0, 0.0))
+    with pytest.raises(ValueError, match="weights"):
+        mixed_tier_arrivals(1.0, 5, (1.0, -0.5))
+
+
+def test_trace_arrivals_validation_is_eager(tmp_path):
+    trace = "data/jobs/jobs-5000-200-172800-259200.npz"
+    with pytest.raises(ValueError, match="rate must be positive"):
+        trace_arrivals(trace, n_apps=4, rate=0.0)
+    empty = tmp_path / "empty.yaml"
+    empty.write_text("[]\n")
+    with pytest.raises(ValueError, match="empty"):
+        trace_arrivals(str(empty))
+
+
+# -- bench smoke -------------------------------------------------------------
+
+
+def test_bench_serve_tiers_smoke():
+    """Tier-1 smoke of the ``serve_tiers`` bench row at tiny scale: both
+    arms (fixed pool, autoscaled) build, serve the mixed-tier stream,
+    pass the serve audit, and report per-tier percentiles + the
+    dispatch-path mix."""
+    from conftest import load_root_module
+
+    bench = load_root_module("bench")
+    row = bench._bench_serve_tiers(
+        n_jobs=10, rate=2.5, n_hosts=8, queue_depth=6,
+        fixed_sessions=2, g_min=1, g_max=2,
+    )
+    assert set(row) >= {
+        "jobs", "arrival_rate", "tier_mix", "slo_p99_ms", "fixed_pool",
+        "autoscaled",
+    }
+    for arm_name in ("fixed_pool", "autoscaled"):
+        arm = row[arm_name]
+        assert arm["decisions_per_sec"] > 0, arm_name
+        assert arm["completed"] > 0
+        assert "0" in arm["tiers"]
+        t0 = arm["tiers"]["0"]
+        assert t0["shed"] == 0 and t0["preempted"] == 0
+        assert t0["p99_ms"] >= t0["p50_ms"] > 0
+        assert set(arm["dispatch"]) == {
+            "runs", "dispatches", "device_calls", "coalesced",
+            "max_group", "deadline_flushes", "single_fast_path",
+            "respawns", "retired_slots",
+        }
+    assert "scale_events" in row["autoscaled"]
+
+
+# -- the chaos soak (the acceptance) -----------------------------------------
+
+
+def _soak_schedule(cluster, seed):
+    """Host loss + stragglers + spot preemptions against this session's
+    cluster topology (targets are per-cluster host ids, so each session
+    gets its own same-seeded plan)."""
+    return ChaosSchedule.generate(
+        cluster, seed=seed, horizon=50.0,
+        n_domain_outages=1, domain_level="zone", outage_duration=20.0,
+        n_preemptions=2, preempt_lead=5.0, preempt_outage=25.0,
+        n_stragglers=2, straggler_factor=3.0, straggler_duration=15.0,
+    )
+
+
+def test_mixed_tier_chaos_soak_degrade_never_fail():
+    """THE acceptance soak: a seeded chaos schedule (zone outage, spot
+    preemptions, stragglers) hits every session's cluster while a
+    mixed-tier stream arrives at 10× the PR-2 bench rate into a queue
+    too small for it.  The SpotServe invariant must hold: tier 0 is
+    never shed, never dead-lettered, meets its p99 decision-latency SLO,
+    and every shed and preemption is absorbed by tiers 1–2 — while the
+    serve conservation audit proves no job was lost or double-settled
+    anywhere (preempted jobs terminate exactly once)."""
+    assert SOAK_RATE >= 10 * PR2_BENCH_RATE
+    SLO_P99_S = 0.5  # generous for CI wall-clock noise; breach = failure
+    reset_ids()
+    retry = RetryPolicy(
+        max_retries=12, base=0.5, seed=7,
+        # Tier-aware budgets: serving retries forever (it must never
+        # dead-letter), batch gets the standard budget, best-effort half.
+        tier_max_retries=(None, 12, 6),
+    )
+    make_sess = lambda label: _session(  # noqa: E731
+        label, n_hosts=10,
+        retry=retry, breaker=HostCircuitBreaker(k=3, cooldown=30.0),
+    )
+    sessions = [make_sess(f"soak{g}") for g in range(3)]
+    injectors = []
+    for i, s in enumerate(sessions):
+        schedule = _soak_schedule(s.cluster, seed=13 + i)
+        injectors.append(
+            FaultInjector(s.cluster, seed=0).apply_schedule(schedule)
+        )
+    driver = ServeDriver(
+        sessions,
+        queue_depth=10,
+        backpressure="shed",
+        tier_reserve=(0, 2, 4),
+        tier_policies=("spill", "shed", "shed"),
+        routing="least_loaded",
+        preempt=True,
+        session_factory=make_sess,
+        max_restarts=2,
+        autoscale=AutoscaleConfig(
+            g_min=2, g_max=5, slo_p99_s=SLO_P99_S,
+            check_interval_s=0.05, calm_checks=8,
+        ),
+    )
+    stream = mixed_tier_arrivals(
+        SOAK_RATE, 60, weights=(0.25, 0.35, 0.40), seed=7,
+        make_app=synthetic_app_factory(seed=11, runtime=(5.0, 30.0)),
+    )
+    report = driver.run(stream)
+
+    assert any(inj.log for inj in injectors), "chaos injected nothing"
+    snap = report["slo"]
+    tiers = snap["tiers"]
+    c0 = tiers["0"]["counters"]
+    # Degrade: pressure really happened, and landed on tiers 1-2 only.
+    absorbed = sum(
+        tiers[t]["counters"]["shed"] + tiers[t]["counters"]["preempted"]
+        for t in tiers if t != "0"
+    )
+    assert absorbed > 0, "soak exerted no pressure — not a soak"
+    # Never fail: tier 0 lossless and within SLO.
+    assert c0["shed"] == 0
+    assert c0["preempted"] == 0
+    assert c0["failed_jobs"] == 0
+    assert c0["completed"] == c0["admitted"] > 0
+    p99 = tiers["0"]["decision_latency_s"]["p99"]
+    assert 0 < p99 <= SLO_P99_S, (
+        f"tier-0 p99 decision latency {p99:.4f}s breaches the "
+        f"{SLO_P99_S}s SLO"
+    )
+    assert snap["counters"]["shed"] == sum(
+        tiers[t]["counters"]["shed"] for t in tiers
+    )
+    # The referee: every admitted/preempted job terminated exactly once,
+    # every surviving session's world conserves tasks and billing.
+    driver.audit(context="mixed-tier chaos soak")

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# CI smoke lane (round 9): the resilience gates every PR must pass,
+# wired so nobody has to remember to run them.
+#
+#   1. tier-1 quick chaos soak + replay determinism (the seeded
+#      acceptance twins in tests/test_chaos.py);
+#   2. hot-path host-sync lint (tools/hotpath_lint.py — bans blocking
+#      device fetches in the tick driver / kernel cores / rollout body);
+#   3. chaos replay determinism against the COMMITTED seed schedule
+#      (data/chaos/ci_seed.json): regenerating the schedule from its
+#      seed must reproduce it bit-for-bit, and two replays of it must
+#      produce identical audit reports.
+#
+# Usage: tools/ci_smoke.sh   (or: make smoke)
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+SEED_FILE=data/chaos/ci_seed.json
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+echo "== [1/3] quick chaos soak + replay determinism (tier-1 twins) =="
+python -m pytest tests/test_chaos.py -q -m 'not slow' \
+    -k 'soak_quick or replay_determinism' -p no:cacheprovider
+
+echo "== [2/3] hot-path host-sync lint =="
+python tools/hotpath_lint.py
+
+echo "== [3/3] chaos replay determinism on the committed seed =="
+# Schedule generation is a pure function of (topology, seed, params):
+# regenerate and diff against the committed artifact.
+python tools/chaos_replay.py generate --seed 7 --hosts 12 \
+    --zone-outages 1 --preemptions 2 --stragglers 1 --partitions 1 \
+    --horizon 400 --out "$TMP/regen.json"
+python tools/chaos_replay.py diff "$SEED_FILE" "$TMP/regen.json"
+# Replay is deterministic: two runs of the committed schedule on the
+# same seeded world must produce identical audit reports.
+python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
+    --seed 7 --out "$TMP/report_a.json"
+python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
+    --seed 7 --out "$TMP/report_b.json"
+python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
+
+echo "smoke lane: all green"
