@@ -4,8 +4,9 @@
 .PHONY: smoke tier1 bench
 
 # The per-PR resilience gate: quick chaos soak, hot-path host-sync
-# lint, and chaos replay determinism against the committed seed
-# (data/chaos/ci_seed.json).  ~1 minute; see tools/ci_smoke.sh.
+# lint, chaos replay determinism against the committed seed
+# (data/chaos/ci_seed.json), and sharded-placement parity on a forced
+# 8-device CPU mesh.  ~2 minutes; see tools/ci_smoke.sh.
 smoke:
 	tools/ci_smoke.sh
 

@@ -1065,6 +1065,258 @@ def _bench_serve_tiers_in_child(timeout_s: int = 420) -> dict:
     return _run_row_in_child("PIVOT_BENCH_SERVE_TIERS_CHILD", timeout_s)
 
 
+# -- shard_place row: pod-scale host-sharded placement (ops/shard.py) -------
+#
+# Weak-scaling protocol: per-shard host count H0 held fixed while the
+# shard count S grows, so per-device work is constant and the wall-clock
+# ratio wall_1(H0) / wall_S(S*H0) is the weak-scaling efficiency.  Every
+# arm runs in its OWN child process because the CPU mesh only exists via
+# --xla_force_host_platform_device_count, which XLA reads once per
+# process, before the first jax import (the serve rows' child-isolation
+# pattern, plus per-arm device-count pinning).
+#
+# On a shared-bus VM the raw ratio conflates two causes: the machine's
+# parallel capacity (two timesharing cores contending on one memory bus
+# — probed by the REFEREE arm: S independent single-device kernels in S
+# processes, zero communication) and the actual cost of the mesh
+# collectives (the two-stage argmin rendezvous every placement step).
+# The row reports the full decomposition —
+#
+#   raw_weak_eff     = idle wall / sharded wall
+#   hw_parallel_eff  = idle wall / referee wall   (the box, not the code)
+#   collective_eff   = referee wall / sharded wall (the code, not the box)
+#
+# and gates on collective_eff: it is the only one of the three the
+# sharding design answers for, and on real per-device-memory hardware
+# (one HBM per chip) referee == idle, so the definitions coincide.
+
+_SHARD_T = 256              #: ready tasks per placement call (fixed T)
+_SHARD_H0 = 98304           #: per-shard hosts for the weak-scaling pair
+_SHARD_SWEEP_H0 = (32768, 65536, 98304)  #: S-fixed scale curve (H = S*H0)
+_SHARD_CPU_FLAGS = "--xla_cpu_multi_thread_eigen=false"
+
+
+def _shard_arm_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SHARD_ARM=<json>``): time ONE
+    (S, H0) best-fit placement arm and print ONE JSON line.  S=1 runs
+    the single-device slim kernel (the oracle the parity suite pins the
+    sharded pass to); S>1 runs ``best_fit_kernel_sharded`` on a
+    host-only mesh.  Best-of-7 walls, per-call scalar-fetch barrier."""
+    cfg = json.loads(os.environ["PIVOT_BENCH_SHARD_ARM"])
+    s = int(cfg["s"])
+    h0 = int(cfg["h0"])
+    t = int(cfg.get("t", _SHARD_T))
+    if cfg.get("force_devices"):
+        # Must land before the first jax import: XLA reads the forced
+        # device count exactly once per process.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={s} "
+            + _SHARD_CPU_FLAGS
+        )
+    jax = _child_backend_setup()
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from pivot_tpu.ops.kernels import best_fit_kernel
+    from pivot_tpu.ops.shard import best_fit_kernel_sharded
+    from pivot_tpu.parallel.mesh import host_sharded_mesh
+
+    n_dev = len(jax.devices())
+    if s > n_dev:
+        print(json.dumps({
+            "error": f"need {s} devices, backend has {n_dev}",
+            "n_devices": n_dev, "backend": jax.default_backend(),
+        }), flush=True)
+        return
+    rng = np.random.default_rng(0)
+    B = ((t + 63) // 64) * 64
+    H = s * h0
+    avail = jnp.asarray(rng.uniform(2.0, 16.0, (H, 4)).astype(np.float32))
+    dem = jnp.asarray(rng.uniform(0.1, 1.0, (B, 4)).astype(np.float32))
+    valid = jnp.asarray(np.arange(B) < t)
+    if s == 1:
+        call = lambda: best_fit_kernel(avail, dem, valid, phase2="slim")[0]
+    else:
+        mesh = host_sharded_mesh(s)
+        call = lambda: best_fit_kernel_sharded(mesh, avail, dem, valid)[0]
+    fetch = lambda p: int(np.asarray(p).sum())
+    fetch(call())  # compile + warm
+    best = float("inf")
+    for _ in range(7):
+        t0 = time.perf_counter()
+        fetch(call())
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({
+        "s": s, "h": H, "t": t, "wall_s": round(best, 5),
+        "decisions_per_s": round(t / best, 1),
+        "hostrows_per_s_per_device": round(t * h0 / best, 1),
+        "backend": jax.default_backend(), "n_devices": n_dev,
+    }), flush=True)
+
+
+def _spawn_shard_arm(cfg: dict):
+    import subprocess
+
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env={**os.environ, "PIVOT_BENCH_SHARD_ARM": json.dumps(cfg)},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _collect_shard_arm(proc, timeout_s: int = 300) -> dict:
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except Exception as exc:  # noqa: BLE001 — arm-level isolation
+        proc.kill()
+        proc.communicate()
+        return {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    if proc.returncode != 0:
+        lines = [
+            ln for ln in (out.strip().splitlines() + err.strip().splitlines())
+            if ln.strip()
+        ]
+        return {"error": f"arm rc={proc.returncode}: {(lines or [''])[-1][:300]}"}
+    try:
+        return json.loads(out.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001 — arm-level isolation
+        return {"error": f"unparseable arm output: {exc}"[:300]}
+
+
+def _best_of(run_once, launches: int) -> dict:
+    """Best-of-``launches`` runs of a thunk returning ``{"wall_s": ...}``
+    or ``{"error": ...}``: keep the minimum wall, or the first error if
+    no launch succeeds.  Whole launches are the repeat unit because
+    thread/core placement is decided per process on this box — a single
+    launch can land unlucky for its entire life (bimodal walls), which
+    within-process repeats cannot average away."""
+    best = None
+    for _ in range(launches):
+        row = run_once()
+        if "error" in row:
+            best = best if best is not None else row
+            continue
+        if best is None or "error" in best or row["wall_s"] < best["wall_s"]:
+            best = row
+    return best if best is not None else {"error": "no launches"}
+
+
+def _run_shard_arm(cfg: dict, launches: int = 1, timeout_s: int = 300) -> dict:
+    """Best-of-``launches`` child runs of one arm (see ``_best_of``)."""
+    return _best_of(
+        lambda: _collect_shard_arm(_spawn_shard_arm(cfg), timeout_s),
+        launches,
+    )
+
+
+def _bench_shard_place() -> dict:
+    """The pod-scale sharded-placement row (header comment above)."""
+    t = _SHARD_T
+    cpu_mode = os.environ.get("PIVOT_BENCH_BACKEND", "") == "cpu"
+    if cpu_mode:
+        n_shards, force = 2, True
+    else:
+        # Accelerator path: a cheap probe arm reports the real device
+        # count; a single-device backend (the usual tunnel shape) cannot
+        # run a ≥2-shard mesh at all, which is exactly the point of the
+        # CPU-mesh arms — record why and bail.
+        probe = _run_shard_arm(
+            dict(s=1, h0=4096, t=t, force_devices=False), timeout_s=240
+        )
+        if "error" in probe:
+            return {"policy": "best-fit", "error": probe["error"]}
+        n_dev = int(probe.get("n_devices", 1))
+        if n_dev < 2:
+            return {
+                "policy": "best-fit", "t": t,
+                "backend": probe.get("backend"),
+                "skipped": (
+                    f"single-device backend (n_devices={n_dev}); the "
+                    "CPU-mesh arms run under PIVOT_BENCH_BACKEND=cpu"
+                ),
+            }
+        n_shards, force = min(n_dev, 8), False
+    h0 = _SHARD_H0
+    # Best-of-2 launches for every arm that feeds an efficiency column —
+    # one unlucky core placement would otherwise skew the whole row.
+    idle = _run_shard_arm(
+        dict(s=1, h0=h0, t=t, force_devices=force), launches=2
+    )
+    sharded = _run_shard_arm(
+        dict(s=n_shards, h0=h0, t=t, force_devices=force), launches=2
+    )
+    row = {
+        "policy": "best-fit",
+        "phase2": "slim step, two-stage sharded reduce",
+        "t": t, "h0_per_shard": h0, "n_shards": n_shards,
+        "flags": _SHARD_CPU_FLAGS if force else "",
+        "idle_baseline": idle, "sharded": sharded,
+        "eff_definition": (
+            "collective_eff = referee/sharded walls; referee = S "
+            "independent single-device kernels in S processes (joint "
+            "completion) — the zero-communication ceiling of this "
+            "shared-bus box.  hw_parallel_eff = idle/referee is the "
+            "box, not the code; on per-device-memory hardware "
+            "referee == idle and collective_eff == raw_weak_eff."
+        ),
+    }
+    if "error" in idle or "error" in sharded:
+        row["error"] = idle.get("error") or sharded.get("error")
+        return row
+    row["raw_weak_eff"] = round(idle["wall_s"] / sharded["wall_s"], 3)
+    if cpu_mode:
+
+        def referee_once():
+            procs = [
+                _spawn_shard_arm(dict(s=1, h0=h0, t=t, force_devices=True))
+                for _ in range(n_shards)
+            ]
+            rows = [_collect_shard_arm(p) for p in procs]
+            errs = [r for r in rows if "error" in r]
+            if errs:
+                return errs[0]
+            return {"wall_s": max(r["wall_s"] for r in rows)}  # joint
+
+        referee = _best_of(referee_once, launches=2)
+        if "error" in referee:
+            row["referee_error"] = referee["error"]
+            row["weak_scaling_eff"] = row["raw_weak_eff"]
+        else:
+            row["referee_wall_s"] = referee["wall_s"]
+            row["hw_parallel_eff"] = round(
+                idle["wall_s"] / referee["wall_s"], 3
+            )
+            row["collective_eff"] = round(
+                referee["wall_s"] / sharded["wall_s"], 3
+            )
+            row["weak_scaling_eff"] = row["collective_eff"]
+    else:
+        # Real multi-device backend: per-device memory, no shared bus —
+        # the raw ratio already isolates the collectives.
+        row["collective_eff"] = row["raw_weak_eff"]
+        row["weak_scaling_eff"] = row["raw_weak_eff"]
+    row["meets_70pct"] = bool(row["weak_scaling_eff"] >= 0.70)
+    # S-fixed scale curve: the absolute-H ladder (64k–196k hosts on the
+    # 2-shard CPU mesh) the single-device arm never climbs in-tree.
+    sweep = []
+    for h0s in _SHARD_SWEEP_H0:
+        if h0s == h0:
+            sweep.append({k: sharded[k] for k in (
+                "s", "h", "wall_s", "decisions_per_s",
+                "hostrows_per_s_per_device",
+            ) if k in sharded})
+            continue
+        r = _run_shard_arm(dict(s=n_shards, h0=h0s, t=t, force_devices=force))
+        sweep.append(r if "error" in r else {k: r[k] for k in (
+            "s", "h", "wall_s", "decisions_per_s",
+            "hostrows_per_s_per_device",
+        ) if k in r})
+    row["h_sweep"] = sweep
+    return row
+
+
 # (probe timeout s, sleep-before s): ~7 min worst-case total. A wedged
 # single-tenant tunnel recovers on operator timescales, so one 150 s shot
 # (round 1) under-samples it; spreading attempts across the bench runtime
@@ -1177,6 +1429,9 @@ def _bench_saturated_in_child(timeout_s: int = 420) -> dict:
 
 
 def main() -> None:
+    if os.environ.get("PIVOT_BENCH_SHARD_ARM"):
+        _shard_arm_child()
+        return
     if os.environ.get("PIVOT_BENCH_SATURATED_CHILD"):
         _saturated_child()
         return
@@ -1285,6 +1540,13 @@ def main() -> None:
     # the record.
     serve_stream = _bench_serve_in_child()
     serve_tiers = _bench_serve_tiers_in_child()
+    # Pod-scale sharded placement, also all-children (each arm pins its
+    # own forced device count) and serialized before this process's PJRT
+    # client exists.
+    try:
+        shard_place = _bench_shard_place()
+    except Exception as exc:  # noqa: BLE001 — row-level isolation
+        shard_place = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     import jax
 
@@ -1419,6 +1681,7 @@ def main() -> None:
         "fused_tick": fused_tick,
         "serve_stream": serve_stream,
         "serve_tiers": serve_tiers,
+        "shard_place": shard_place,
         **(
             {"ensemble_saturated": ens_saturated} if ens_saturated else {}
         ),

@@ -72,7 +72,8 @@ def replay_schedule(
     scheduler.stop()
 
 
-def run_grid_lockstep(runs, stats_out: Optional[dict] = None) -> list:
+def run_grid_lockstep(runs, stats_out: Optional[dict] = None,
+                      mesh=None) -> list:
     """Advance several :class:`ExperimentRun`\\ s tick-synchronously through
     one cross-run dispatch batcher (``pivot_tpu.sched.batch``).
 
@@ -110,6 +111,12 @@ def run_grid_lockstep(runs, stats_out: Optional[dict] = None) -> list:
       * ``deadline_flushes`` — partial flushes forced by a flush
         deadline (always 0 here: the grid driver runs quiescence-only;
         the serving layer's batcher sets a deadline).
+
+    ``mesh`` (``parallel.mesh.replica_mesh``) shards each coalesced
+    flush's stacked [G] axis over the mesh's ``replica`` axis, so
+    co-pending runs execute on distinct devices — bit-identical results
+    (``sched/batch.py``); ``stats_out['mesh_dispatches']`` counts the
+    flushes that actually sharded.
     """
     import threading
 
@@ -146,7 +153,7 @@ def run_grid_lockstep(runs, stats_out: Optional[dict] = None) -> list:
     # Initialize the backend once, here, before any run thread touches
     # jax — concurrent first-touch PJRT client creation is not safe.
     jax.default_backend()
-    batcher = DispatchBatcher(len(batchable))
+    batcher = DispatchBatcher(len(batchable), mesh=mesh)
     errors: list = [None] * len(batchable)
 
     def work(slot, idx, run, client):

@@ -429,8 +429,8 @@ class SloMeter(LogMixin):
     #: the device: coalesced flushes vs the single-live-slot fast path.
     DISPATCH_KEYS = (
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
-        "deadline_flushes", "single_fast_path", "respawns",
-        "retired_slots",
+        "deadline_flushes", "single_fast_path", "mesh_dispatches",
+        "respawns", "retired_slots",
     )
 
     #: Per-tier counter keys (each tier's section of the snapshot).

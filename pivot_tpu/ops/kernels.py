@@ -318,8 +318,9 @@ def _cost_aware_scan(
     first_fit = bin_pack == "first-fit"
     base_counts = base_task_counts.astype(avail.dtype)
     # [Z, H] round-trip tables: anchor-zone z ↔ each host.
-    cost_rt = cost_zz[:, host_zone] + cost_zz[host_zone, :].T
-    bw_rt = bw_zz[:, host_zone] + bw_zz[host_zone, :].T
+    cost_rt, bw_rt, _ = _ca_phase1(
+        cost_zz, bw_zz, host_zone, base_counts, prescale_decay=False
+    )
 
     def group_score(avail, cost_row, bw_row):
         if not sort_hosts:
@@ -439,6 +440,37 @@ def _scan_swap(body, avail, xs):
 # ---------------------------------------------------------------------------
 # Two-phase machinery
 # ---------------------------------------------------------------------------
+
+
+def _ca_phase1(cost_zz, bw_zz, host_zone, base_counts, prescale_decay):
+    """Cost-aware phase-1 tables for a host block: the ``[Z, H]``
+    round-trip topology tables and the (optional) exact host-decay
+    prescale of the cost table.  ``host_zone``/``base_counts`` may be the
+    full ``[H]`` vectors or one shard's contiguous block — every output
+    element depends only on its own host column, so the sharded kernels
+    (``ops/shard.py``) call this on their local block and get the exact
+    same elements the single-device kernels compute, bit for bit."""
+    cost_rt = cost_zz[:, host_zone] + cost_zz[host_zone, :].T
+    bw_rt = bw_zz[:, host_zone] + bw_zz[host_zone, :].T
+    if prescale_decay:
+        num_rt = cost_rt * jnp.maximum(base_counts, 1.0)[None, :]
+    else:
+        num_rt = cost_rt
+    return cost_rt, bw_rt, num_rt
+
+
+def _ca_group_score(num_row, avail, bw_row):
+    """The cost-aware first-fit group score row ``num / (‖avail‖·bw)``
+    over a host block — shared verbatim by the slim phase-2 body and the
+    sharded kernels so the two can never round differently."""
+    return num_row / (_norms(avail) * bw_row)
+
+
+def _ca_best_fit_score(cost_row, avail, demand, decay, bw_row):
+    """The cost-aware best-fit per-task score ``cost·‖avail−d‖·decay/bw``
+    over a host block — shared like :func:`_ca_group_score`."""
+    residual = _norms(avail - demand)
+    return cost_row * residual * decay / bw_row
 
 
 def _resolve_phase2(phase2):
@@ -882,14 +914,12 @@ def cost_aware_impl(
     track_extra = (not first_fit) and host_decay
 
     # ---- phase 1 ----
-    cost_rt = cost_zz[:, host_zone] + cost_zz[host_zone, :].T
-    bw_rt = bw_zz[:, host_zone] + bw_zz[host_zone, :].T
-    if first_fit and sort_hosts and host_decay:
-        # Exact hoist of the group score's (cost_row * decay) product:
-        # prescaling the table rows multiplies the same two operands.
-        num_rt = cost_rt * jnp.maximum(base_counts, 1.0)[None, :]
-    else:
-        num_rt = cost_rt
+    # Exact hoist of the group score's (cost_row * decay) product:
+    # prescaling the table rows multiplies the same two operands.
+    cost_rt, bw_rt, num_rt = _ca_phase1(
+        cost_zz, bw_zz, host_zone, base_counts,
+        first_fit and sort_hosts and host_decay,
+    )
     iota_h = jnp.arange(H, dtype=dtype)
     n_eff = _effective_len(valid)
 
@@ -911,8 +941,10 @@ def cost_aware_impl(
                     # costs like the scan form.
                     frozen = lax.cond(
                         new_group[j],
-                        lambda a: num_rt[anchor_zone[j]]
-                        / (_norms(a) * bw_row_at(anchor_zone[j], ri[j])),
+                        lambda a: _ca_group_score(
+                            num_rt[anchor_zone[j]], a,
+                            bw_row_at(anchor_zone[j], ri[j]),
+                        ),
                         lambda a: frozen,
                         avail,
                     )
@@ -921,14 +953,13 @@ def cost_aware_impl(
                 fit = _fits(avail, demand, strict=True) & valid_j
                 h = jnp.argmin(jnp.where(fit, frozen, big))
             else:
-                residual = _norms(avail - demand)
                 decay = (
                     jnp.maximum(base_counts + extra.astype(dtype), 1.0)
                     if host_decay else 1.0
                 )
-                per_task = (
-                    cost_rt[anchor_zone[j]] * residual * decay
-                    / bw_row_at(anchor_zone[j], ri[j])
+                per_task = _ca_best_fit_score(
+                    cost_rt[anchor_zone[j]], avail, demand, decay,
+                    bw_row_at(anchor_zone[j], ri[j]),
                 )
                 fit = _fits(avail, demand, strict=False) & valid_j
                 h = jnp.argmin(jnp.where(fit, per_task, big))

@@ -1,0 +1,1149 @@
+"""Host-axis-sharded placement kernels — pod-scale clusters over a mesh.
+
+Everything before this module fits one chip: H ≤ 1024 hosts, every state
+array device-resident on a single device.  Borg-scale cells (Verma et
+al., PAPERS.md) are 10k–100k hosts — working sets no single chip
+comfortably holds, and per-step host-axis compute a single core pays
+alone.  This module partitions the **host axis** of the placement hot
+path over the ``host`` axis of a ``jax.sharding.Mesh``
+(``parallel/mesh.py``): the ``[H, 4]`` availability carry, the
+live/quarantine mask, the phase-1 score rows, and the host-decay
+counters all live shard-resident (``[H/S, ...]`` per device), and each
+sequential placement step runs its O(H) fit/score work shard-parallel
+with a tiny O(S) collective to pick the winner.
+
+**The two-stage argmin.**  The single-device kernels select a host with
+``jnp.argmin(where(fit, score, inf))`` — minimum score, ties to the
+LOWEST host index (the shared tie rule across numpy policies and
+kernels).  Sharded, the selection runs in two stages:
+
+  1. every shard takes a **local argmin** over its block (ties → lowest
+     local index) and forms the pair ``(score_min, local_argmin +
+     shard_offset)``;
+  2. an ``all_gather`` of the S pairs + an argmin over the gathered
+     scores (ties → lowest shard index) picks the winner.
+
+Because the mesh shards the host axis into *contiguous index blocks*
+(shard s owns hosts ``[s·H/S, (s+1)·H/S)``), lower shard ⇒ strictly
+lower global indices, so stage 2's first-occurrence tie-break composes
+with stage 1's into exactly "minimum ``(score, global_host_index)``" —
+the flat argmin's rule, preserved bit for bit.  The score elements
+themselves are computed per host by the SAME shared helpers the
+single-device kernels use (``ops/kernels.py`` ``_ca_phase1`` /
+``_ca_group_score`` / ``_ca_best_fit_score`` / ``_fits`` / ``_norms``),
+each depending only on its own host column, so sharding cannot move a
+rounding.  The opportunistic arm's k-th-fitting-host rank is an integer
+cumsum, decomposed as local cumsum + exclusive prefix of shard totals —
+exact.  ``first-fit``'s lowest-index-fit is a ``pmin`` over per-shard
+first-fit candidates.  See docs/ARCHITECTURE.md ("Sharded placement")
+for the full tie-break argument.
+
+**Phase-2 modes.**  ``phase2 in ("auto", "scan", "slim")`` all resolve
+to the per-step pass: the slim-style early-exit loop (stop at the last
+valid task) with one two-stage reduce per task.  ``phase2 = int C``
+selects the **sharded speculative chunk commit**: the per-step pass's
+collective rendezvous is the whole per-step cost once the local blocks
+are small, so the chunked pass amortizes it to O(1) batched reduces per
+C-task chunk — speculate every position against chunk-entry state,
+replay the exact carry fold shard-locally, re-decide all C positions
+against their exact prefixes in one gathered reduce, commit through the
+first disagreement (``kernels._speculate_commit``'s induction, so
+placements and availability cannot differ from the per-step pass).
+Every mode is bit-identical to every single-device mode;
+``tests/test_shard.py`` sweeps the parity against each.
+
+**Fused spans.**  :func:`sharded_fused_tick_run` is the host-sharded
+twin of ``ops.tickloop.fused_tick_run``: K simulator ticks as one
+device program with the sharded ``[H/S, 4]`` availability carry (and the
+sharded host-decay counters) staying device-resident between ticks.
+The slot-axis algebra — ready-batch assembly, kernel-stream ordering,
+wait-stack rebuild — is imported from ``ops.tickloop`` verbatim and
+computed redundantly on every shard (it is O(B), replicated state), so
+the two drivers cannot drift.
+
+Layout contract: ``H`` must divide evenly by the mesh's host-axis size
+(pad the cluster with DOWN-sentinel hosts otherwise — a ``-1``
+availability row can never be selected).  All kernels are cached per
+(mesh, static config) and are bit-identical to the single-device
+oracles on every backend — the bar ``tests/test_shard.py`` holds them
+to at H=1024 on the forced 8-device CPU mesh.
+
+Host-sync discipline: no host fetch may appear in any sharded pass or
+the sharded span driver — enforced by ``tools/hotpath_lint.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # jax >= 0.6 top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # the 0.4.x layout this image ships
+    from jax.experimental.shard_map import shard_map as _shard_map
+from jax.sharding import PartitionSpec as P
+
+from pivot_tpu.ops.kernels import (
+    _apply_live,
+    _bump_count,
+    _ca_best_fit_score,
+    _ca_group_score,
+    _ca_phase1,
+    _effective_len,
+    _fits,
+    _norms,
+    _pad_chunk,
+    _place,
+    _resolve_phase2,
+)
+from pivot_tpu.ops.tickloop import (
+    SpanResult,
+    _span_group_entries,
+    _span_ready_batch,
+    _span_requeue,
+    _span_stream_order,
+)
+from pivot_tpu.parallel.mesh import host_axis_size
+
+__all__ = [
+    "HOST_AXIS",
+    "best_fit_kernel_sharded",
+    "cost_aware_kernel_sharded",
+    "first_fit_kernel_sharded",
+    "opportunistic_kernel_sharded",
+    "sharded_fused_tick_run",
+]
+
+#: Mesh axis the host dimension shards over (``parallel.mesh.build_mesh``
+#: axis_names convention).
+HOST_AXIS = "host"
+
+#: Integer sentinel above any host index — the "no candidate" rung of the
+#: pmin reduces (1 << 30 like the kernels' fill-capacity clip).
+_NO_HOST = 1 << 30
+
+
+def _check_host_axis(H: int, mesh) -> int:
+    n = host_axis_size(mesh)
+    if H % n:
+        raise ValueError(
+            f"host axis H={H} does not divide over the mesh's {n} host "
+            f"shards — pad the cluster with DOWN-sentinel hosts (a -1 "
+            f"availability row is never selected) to a multiple of {n}"
+        )
+    return n
+
+
+def _sharded_mode(phase2):
+    """Resolve ``phase2`` for the sharded passes: the scan/slim family
+    collapses to the per-step pass ("step"); an int chunk size selects
+    the sharded chunk commit (module docstring)."""
+    mode = _resolve_phase2(phase2)
+    return mode if isinstance(mode, int) else "step"
+
+
+# ---------------------------------------------------------------------------
+# Two-stage reduces (the collective core — every helper here runs INSIDE a
+# shard_map region and is a hotpath-lint target)
+# ---------------------------------------------------------------------------
+
+
+def _shard_offset(h_local: int):
+    """This shard's first global host index (contiguous block layout)."""
+    return (lax.axis_index(HOST_AXIS) * h_local).astype(jnp.int32)
+
+
+def _two_stage_argmin(masked, any_fit, offset):
+    """Exact decomposition of ``jnp.argmin(masked_global)`` + ``ok``.
+
+    Stage 1: local argmin over this shard's block (ties → lowest local
+    index).  Stage 2: all-gather the S ``(min_score, global_index)``
+    pairs and argmin over the scores — first occurrence wins, i.e. the
+    lowest shard, whose candidate has the lowest global index among the
+    tied shard minima (contiguous blocks).  Composition = minimum
+    ``(score, global_host_index)``, the flat argmin's tie rule, exactly.
+    ``ok`` is the global fit flag (any shard saw a fit); ``h`` is 0 when
+    nothing fits, mirroring ``argmin`` of an all-inf row.
+    """
+    li = jnp.argmin(masked).astype(jnp.int32)
+    lmin = masked[li]
+    # ONE packed gather per step, not three: on a sequential chain the
+    # collective's cost is per-rendezvous latency, not bytes, so the
+    # (score, index, any-fit) triple rides one [3] vector.  The index
+    # converts through the score dtype exactly (f32 holds integers to
+    # 2^24 — far beyond any host count this repo targets; f64 beyond
+    # 2^53), asserted by the parity suite.
+    packed = jnp.stack([
+        lmin,
+        (li + offset).astype(masked.dtype),
+        any_fit.astype(masked.dtype),
+    ])
+    g = lax.all_gather(packed, HOST_AXIS)       # [S, 3]
+    s = jnp.argmin(g[:, 0])
+    ok = jnp.any(g[:, 2] > 0)
+    return jnp.where(ok, g[s, 1].astype(jnp.int32), 0), ok
+
+
+def _first_index_of(fit, offset):
+    """Lowest GLOBAL index with ``fit`` True — the sharded form of
+    ``argmax(fit)`` + ``any(fit)`` (first-fit's selection): per-shard
+    first fit, then a ``pmin`` over the global candidates."""
+    lh = jnp.argmax(fit).astype(jnp.int32)
+    cand = jnp.where(jnp.any(fit), lh + offset,
+                     jnp.asarray(_NO_HOST, jnp.int32))
+    h = lax.pmin(cand, HOST_AXIS)
+    ok = h < _NO_HOST
+    return jnp.where(ok, h, 0), ok
+
+
+def _opportunistic_pick(fit, u_j, offset, n_shards):
+    """The k-th fitting host (k = ⌊u·n_fit⌋) under sharding: global
+    ``n_fit`` and the 1-based cumulative rank decompose as local integer
+    cumsums plus the exclusive prefix of shard totals — exact.  The
+    (unique) matching host reduces by pmin like first-fit."""
+    c = jnp.sum(fit.astype(jnp.int32))
+    counts = lax.all_gather(c, HOST_AXIS)       # [S]
+    n_fit = jnp.sum(counts)
+    my = lax.axis_index(HOST_AXIS)
+    prefix = jnp.sum(
+        jnp.where(jnp.arange(n_shards) < my, counts, 0)
+    )
+    k = jnp.minimum((u_j * n_fit).astype(jnp.int32), n_fit - 1)
+    rank = jnp.cumsum(fit.astype(jnp.int32)) + prefix
+    match = fit & (rank == k + 1)
+    lh = jnp.argmax(match).astype(jnp.int32)
+    cand = jnp.where(jnp.any(match), lh + offset,
+                     jnp.asarray(_NO_HOST, jnp.int32))
+    h = lax.pmin(cand, HOST_AXIS)
+    ok = n_fit > 0
+    return jnp.where(ok, h, 0), ok
+
+
+def _place_local(avail, demand, h, ok, offset):
+    """One shard's slice of the global ``_place``: decrement the winning
+    row only on the shard that owns it — the same arithmetic on the same
+    element the flat update performs; every other shard is a no-op."""
+    h_local = h - offset
+    local = ok & (h_local >= 0) & (h_local < avail.shape[0])
+    return _place(avail, demand, jnp.where(local, h_local, 0), local)
+
+
+def _bump_local(counts, h, ok, offset):
+    """Shard-local slice of ``_bump_count`` (best-fit live decay)."""
+    h_local = h - offset
+    local = ok & (h_local >= 0) & (h_local < counts.shape[0])
+    return _bump_count(counts, jnp.where(local, h_local, 0), local)
+
+
+def _two_stage_argmin_rows(masked_rows, any_rows, offset):
+    """Batched :func:`_two_stage_argmin`: C independent argmin rows
+    reduced in ONE packed gather ([S, C, 3]) — the collective backbone
+    of the sharded chunk commit, where per-task rendezvous would eat the
+    whole weak-scaling budget.  Exact per row by the same tie-break
+    composition."""
+    C = masked_rows.shape[0]
+    li = jnp.argmin(masked_rows, axis=1).astype(jnp.int32)      # [C]
+    lmin = jnp.take_along_axis(masked_rows, li[:, None], axis=1)[:, 0]
+    packed = jnp.stack([
+        lmin,
+        (li + offset).astype(masked_rows.dtype),
+        any_rows.astype(masked_rows.dtype),
+    ], axis=1)                                                  # [C, 3]
+    g = lax.all_gather(packed, HOST_AXIS)                       # [S, C, 3]
+    s = jnp.argmin(g[:, :, 0], axis=0)                          # [C]
+    ok = jnp.any(g[:, :, 2] > 0, axis=0)
+    h = g[s, jnp.arange(C), 1].astype(jnp.int32)
+    return jnp.where(ok, h, 0), ok
+
+
+def _first_index_of_rows(fit_rows, offset):
+    """Batched :func:`_first_index_of`: C first-fit rows in one pmin."""
+    lh = jnp.argmax(fit_rows, axis=1).astype(jnp.int32)
+    cand = jnp.where(jnp.any(fit_rows, axis=1), lh + offset,
+                     jnp.asarray(_NO_HOST, jnp.int32))
+    h = lax.pmin(cand, HOST_AXIS)
+    ok = h < _NO_HOST
+    return jnp.where(ok, h, 0), ok
+
+
+def _opportunistic_pick_rows(fit_rows, u_c, offset, n_shards):
+    """Batched :func:`_opportunistic_pick`: one [C]-row gather for the
+    shard fit totals + one pmin for the winners."""
+    C = fit_rows.shape[0]
+    c = jnp.sum(fit_rows.astype(jnp.int32), axis=1)             # [C]
+    counts = lax.all_gather(c, HOST_AXIS)                       # [S, C]
+    n_fit = jnp.sum(counts, axis=0)
+    my = lax.axis_index(HOST_AXIS)
+    prefix = jnp.sum(
+        jnp.where((jnp.arange(n_shards) < my)[:, None], counts, 0), axis=0
+    )
+    k = jnp.minimum((u_c * n_fit).astype(jnp.int32), n_fit - 1)
+    rank = jnp.cumsum(fit_rows.astype(jnp.int32), axis=1) + prefix[:, None]
+    match = fit_rows & (rank == (k + 1)[:, None])
+    lh = jnp.argmax(match, axis=1).astype(jnp.int32)
+    cand = jnp.where(jnp.any(match, axis=1), lh + offset,
+                     jnp.asarray(_NO_HOST, jnp.int32))
+    h = lax.pmin(cand, HOST_AXIS)
+    ok = n_fit > 0
+    return jnp.where(ok, h, 0), ok
+
+
+# ---------------------------------------------------------------------------
+# Sharded sequential passes (run INSIDE shard_map; avail is the local block)
+# ---------------------------------------------------------------------------
+
+
+def _carry_free_sharded_pass(avail, demands, valid, n_eff, decide):
+    """Sharded analog of ``kernels._slim_drive``: early-exit sequential
+    loop over tasks, ``decide(avail, j, demand) -> (h_global, ok)``
+    already globally reduced; the placement write and the availability
+    fold follow the slim driver's protocol exactly."""
+    B = demands.shape[0]
+    offset = _shard_offset(avail.shape[0])
+
+    def body(st):
+        j, placements, avail = st
+        demand = demands[j]
+        h, ok = decide(avail, j, demand)
+        ok = ok & (j < n_eff)
+        avail = _place_local(avail, demand, h, ok, offset)
+        jj = jnp.where(j < n_eff, j, B)
+        placements = placements.at[jj].set(
+            jnp.where(ok, h, -1).astype(jnp.int32), mode="drop"
+        )
+        return j + 1, placements, avail
+
+    st0 = (jnp.asarray(0, jnp.int32), jnp.full((B,), -1, jnp.int32), avail)
+    _, placements, avail = lax.while_loop(lambda st: st[0] < n_eff, body, st0)
+    return placements, avail
+
+
+def _opportunistic_sharded_pass(avail, demands, valid, uniforms, n_eff,
+                                n_shards):
+    offset = _shard_offset(avail.shape[0])
+
+    def decide(avail, j, demand):
+        fit = _fits(avail, demand, strict=False) & valid[j]
+        return _opportunistic_pick(fit, uniforms[j], offset, n_shards)
+
+    return _carry_free_sharded_pass(avail, demands, valid, n_eff, decide)
+
+
+def _first_fit_sharded_pass(avail, demands, valid, n_eff, strict):
+    offset = _shard_offset(avail.shape[0])
+
+    def decide(avail, j, demand):
+        fit = _fits(avail, demand, strict) & valid[j]
+        return _first_index_of(fit, offset)
+
+    return _carry_free_sharded_pass(avail, demands, valid, n_eff, decide)
+
+
+def _best_fit_sharded_pass(avail, demands, valid, n_eff):
+    offset = _shard_offset(avail.shape[0])
+    big = jnp.asarray(jnp.inf, avail.dtype)
+
+    def decide(avail, j, demand):
+        fit = _fits(avail, demand, strict=True) & valid[j]
+        residual = _norms(avail - demand)
+        return _two_stage_argmin(
+            jnp.where(fit, residual, big), jnp.any(fit), offset
+        )
+
+    return _carry_free_sharded_pass(avail, demands, valid, n_eff, decide)
+
+
+# ---------------------------------------------------------------------------
+# Sharded speculative chunk commit (phase2 = int C)
+#
+# The per-step passes above pay one collective rendezvous PER TASK — exact,
+# but on a sequential chain the rendezvous latency is the whole per-step
+# cost at scale.  The chunked pass amortizes it to O(1) collectives per
+# C-task chunk using the SAME exactness induction as the single-device
+# speculative chunk commit (``kernels._speculate_commit``):
+#
+#   1. speculate every chunk position against CHUNK-ENTRY state (one
+#      batched two-stage reduce — speculation quality only moves the
+#      commit boundary, never a placement);
+#   2. replay the exact [H/S, 4] carry fold over the speculated
+#      placements SHARD-LOCALLY (each shard folds only its own rows — the
+#      same ``_place`` ops as the flat fold, zero collectives);
+#   3. re-decide every position against its exact prefix state in ONE
+#      batched two-stage reduce;
+#   4. commit through the first speculation/re-decision disagreement.
+#
+# A committed position's decision is always the re-decision under the
+# exact prefix fold, so placements and availability are bit-identical to
+# the per-step pass (and the flat oracles) by the same induction.
+# ---------------------------------------------------------------------------
+
+
+def _sharded_chunk_drive(avail, demands, valid, n_eff, C, decide_rows,
+                         offset):
+    """Sharded analog of ``kernels._chunk_drive`` for the carry-free
+    policies.  ``decide_rows(a_rows [C, H/S, 4], dem_c, valid_c, pos)
+    -> (h [C] global, ok [C])`` must be the exact batched per-position
+    decision (one collective inside); speculation calls it on
+    chunk-entry rows, the recheck on the exact prefix rows."""
+    B = demands.shape[0]
+    demP, validP = _pad_chunk(demands, C), _pad_chunk(valid, C)
+    idx = jnp.arange(C, dtype=jnp.int32)
+
+    def body(st):
+        pos, placements, avail = st
+        dem_c = lax.dynamic_slice_in_dim(demP, pos, C)
+        valid_c = lax.dynamic_slice_in_dim(validP, pos, C)
+        h_s, ok_s = decide_rows(
+            jnp.broadcast_to(avail, (C,) + avail.shape), dem_c, valid_c, pos
+        )
+        ok_s = ok_s & valid_c
+        h_s = jnp.where(ok_s, h_s, 0)
+
+        def substep(a, x):
+            h, ok, d = x
+            return _place_local(a, d, h, ok, offset), a
+
+        _, a_pre = lax.scan(substep, avail, (h_s, ok_s, dem_c))
+        h_c, ok_c = decide_rows(a_pre, dem_c, valid_c, pos)
+        ok_c = ok_c & valid_c
+        p_c = jnp.where(ok_c, h_c, -1).astype(jnp.int32)
+        p_s = jnp.where(ok_s, h_s, -1).astype(jnp.int32)
+        fc = jnp.min(jnp.where(p_c != p_s, idx, C))
+        n_commit = jnp.minimum(fc + 1, C)
+        placements = lax.dynamic_update_slice_in_dim(placements, p_c, pos, 0)
+        cm = jnp.minimum(n_commit - 1, C - 1)
+        new_avail = _place_local(
+            a_pre[cm], dem_c[cm], h_c[cm], ok_c[cm], offset
+        )
+        return pos + n_commit, placements, new_avail
+
+    st0 = (jnp.asarray(0, jnp.int32), jnp.full((B + C,), -1, jnp.int32),
+           avail)
+    _, placements, avail = lax.while_loop(lambda st: st[0] < n_eff, body, st0)
+    return placements[:B], avail
+
+
+def _opportunistic_sharded_chunk(avail, demands, valid, uniforms, n_eff, C,
+                                 n_shards):
+    offset = _shard_offset(avail.shape[0])
+    uP = _pad_chunk(uniforms, C)
+
+    def decide_rows(a_rows, dem_c, valid_c, pos):
+        u_c = lax.dynamic_slice_in_dim(uP, pos, C)
+        fit = jnp.all(a_rows >= dem_c[:, None, :], axis=2) & valid_c[:, None]
+        return _opportunistic_pick_rows(fit, u_c, offset, n_shards)
+
+    return _sharded_chunk_drive(
+        avail, demands, valid, n_eff, C, decide_rows, offset
+    )
+
+
+def _first_fit_sharded_chunk(avail, demands, valid, n_eff, C, strict):
+    offset = _shard_offset(avail.shape[0])
+
+    def decide_rows(a_rows, dem_c, valid_c, pos):
+        fit = (
+            jnp.all(a_rows > dem_c[:, None, :], axis=2) if strict
+            else jnp.all(a_rows >= dem_c[:, None, :], axis=2)
+        )
+        return _first_index_of_rows(fit & valid_c[:, None], offset)
+
+    return _sharded_chunk_drive(
+        avail, demands, valid, n_eff, C, decide_rows, offset
+    )
+
+
+def _best_fit_sharded_chunk(avail, demands, valid, n_eff, C):
+    offset = _shard_offset(avail.shape[0])
+    big = jnp.asarray(jnp.inf, avail.dtype)
+
+    def decide_rows(a_rows, dem_c, valid_c, pos):
+        fit = jnp.all(a_rows > dem_c[:, None, :], axis=2) & valid_c[:, None]
+        residual = _norms(a_rows - dem_c[:, None, :])
+        return _two_stage_argmin_rows(
+            jnp.where(fit, residual, big), jnp.any(fit, axis=1), offset
+        )
+
+    return _sharded_chunk_drive(
+        avail, demands, valid, n_eff, C, decide_rows, offset
+    )
+
+
+def _cost_aware_sharded_pass(
+    avail,
+    demands,
+    valid,
+    new_group,
+    anchor_zone,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    base_task_counts,
+    n_eff,
+    bin_pack,
+    sort_hosts,
+    host_decay,
+):
+    """Sharded cost-aware sequential pass — the slim body of
+    ``kernels.cost_aware_impl`` with every host-row expression evaluated
+    on the local block through the SHARED phase-1/score helpers and the
+    argmin swapped for the two-stage reduce.  ``host_zone`` and
+    ``base_task_counts`` are this shard's blocks."""
+    B = demands.shape[0]
+    Hl = avail.shape[0]
+    offset = _shard_offset(Hl)
+    first_fit = bin_pack == "first-fit"
+    big = jnp.asarray(jnp.inf, avail.dtype)
+    dtype = avail.dtype
+    base_counts = base_task_counts.astype(dtype)
+    track_extra = (not first_fit) and host_decay
+
+    cost_rt, bw_rt, num_rt = _ca_phase1(
+        cost_zz, bw_zz, host_zone, base_counts,
+        first_fit and sort_hosts and host_decay,
+    )
+    # Identity host order = the GLOBAL index as a float (exact for any
+    # plausible H) — the sort_hosts=False score row, shard's slice.
+    iota_h = jnp.arange(Hl, dtype=dtype) + offset.astype(dtype)
+
+    def body(st):
+        j, placements, avail, frozen, extra = st
+        demand = demands[j]
+        valid_j = valid[j] & (j < n_eff)
+        if first_fit:
+            if sort_hosts:
+                frozen = lax.cond(
+                    new_group[j],
+                    lambda a: _ca_group_score(
+                        num_rt[anchor_zone[j]], a, bw_rt[anchor_zone[j]]
+                    ),
+                    lambda a: frozen,
+                    avail,
+                )
+            else:
+                frozen = jnp.where(new_group[j], iota_h, frozen)
+            fit = _fits(avail, demand, strict=True) & valid_j
+            h, ok = _two_stage_argmin(
+                jnp.where(fit, frozen, big), jnp.any(fit), offset
+            )
+        else:
+            decay = (
+                jnp.maximum(base_counts + extra.astype(dtype), 1.0)
+                if host_decay else 1.0
+            )
+            per_task = _ca_best_fit_score(
+                cost_rt[anchor_zone[j]], avail, demand, decay,
+                bw_rt[anchor_zone[j]],
+            )
+            fit = _fits(avail, demand, strict=False) & valid_j
+            h, ok = _two_stage_argmin(
+                jnp.where(fit, per_task, big), jnp.any(fit), offset
+            )
+        avail = _place_local(avail, demand, h, ok, offset)
+        if track_extra:
+            extra = _bump_local(extra, h, ok, offset)
+        jj = jnp.where(j < n_eff, j, B)
+        placements = placements.at[jj].set(
+            jnp.where(ok, h, -1).astype(jnp.int32), mode="drop"
+        )
+        return j + 1, placements, avail, frozen, extra
+
+    st0 = (jnp.asarray(0, jnp.int32), jnp.full((B,), -1, jnp.int32),
+           avail, jnp.zeros(Hl, dtype), jnp.zeros(Hl, jnp.int32))
+    _, placements, avail, _, _ = lax.while_loop(
+        lambda st: st[0] < n_eff, body, st0
+    )
+    return placements, avail
+
+
+def _cost_aware_sharded_chunk_pass(
+    avail,
+    demands,
+    valid,
+    new_group,
+    anchor_zone,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    base_task_counts,
+    n_eff,
+    C,
+    bin_pack,
+    sort_hosts,
+    host_decay,
+):
+    """Sharded cost-aware chunk commit — the chunk body of
+    ``kernels.cost_aware_impl`` with shard-local score/fold arithmetic,
+    the batched two-stage reduce for both the speculation and the exact
+    re-decision, and decide-against-chunk-entry speculation (the fill
+    model's job is commit width only; the re-decision referees either
+    way).  First-fit keeps the single-device form's segment capping: the
+    commit never crosses the chunk's SECOND group entry, and the exact
+    entry score row is recomputed from the exact prefix state."""
+    B = demands.shape[0]
+    Hl = avail.shape[0]
+    offset = _shard_offset(Hl)
+    first_fit = bin_pack == "first-fit"
+    big = jnp.asarray(jnp.inf, avail.dtype)
+    dtype = avail.dtype
+    base_counts = base_task_counts.astype(dtype)
+    track_extra = (not first_fit) and host_decay
+
+    cost_rt, bw_rt, num_rt = _ca_phase1(
+        cost_zz, bw_zz, host_zone, base_counts,
+        first_fit and sort_hosts and host_decay,
+    )
+    iota_h = jnp.arange(Hl, dtype=dtype) + offset.astype(dtype)
+    demP, validP, ngP = (_pad_chunk(x, C) for x in (demands, valid, new_group))
+    azP = _pad_chunk(anchor_zone, C)
+    idx = jnp.arange(C, dtype=jnp.int32)
+
+    def body(st):
+        pos, placements, avail, frozen, extra = st
+        dem_c = lax.dynamic_slice_in_dim(demP, pos, C)
+        valid_c = lax.dynamic_slice_in_dim(validP, pos, C)
+        ng_c = lax.dynamic_slice_in_dim(ngP, pos, C)
+        az_c = lax.dynamic_slice_in_dim(azP, pos, C)
+
+        if first_fit:
+            e1 = jnp.min(jnp.where(ng_c, idx, C))
+            e2 = jnp.min(jnp.where(ng_c & (idx > e1), idx, C))
+            e1c = jnp.minimum(e1, C - 1)
+            az_e1 = az_c[e1c]
+            seg = (idx >= e1)[:, None]
+
+            def score_rows_for(entry_avail):
+                if sort_hosts:
+                    row = _ca_group_score(
+                        num_rt[az_e1], entry_avail, bw_rt[az_e1]
+                    )
+                else:
+                    row = iota_h
+                return jnp.where(seg, row[None], frozen[None]), row
+
+            def decide(a_rows, score_rows):
+                fit = jnp.all(a_rows > dem_c[:, None, :], axis=2)
+                fit = fit & valid_c[:, None]
+                return _two_stage_argmin_rows(
+                    jnp.where(fit, score_rows, big),
+                    jnp.any(fit, axis=1), offset,
+                )
+
+            spec_rows, _ = score_rows_for(avail)
+            h_s, ok_s = decide(
+                jnp.broadcast_to(avail, (C, Hl, 4)), spec_rows
+            )
+            commit_cap = e2
+        else:
+            cost_rows = cost_rt[az_c]                   # [C, H/S]
+            bw_rows = bw_rt[az_c]
+
+            def decide_bf(a_rows, ex_rows):
+                fit = jnp.all(a_rows >= dem_c[:, None, :], axis=2)
+                fit = fit & valid_c[:, None]
+                residual = _norms(a_rows - dem_c[:, None, :])
+                decay = (
+                    jnp.maximum(base_counts[None] + ex_rows.astype(dtype),
+                                1.0)
+                    if host_decay else 1.0
+                )
+                cand = cost_rows * residual * decay / bw_rows
+                return _two_stage_argmin_rows(
+                    jnp.where(fit, cand, big), jnp.any(fit, axis=1), offset
+                )
+
+            h_s, ok_s = decide_bf(
+                jnp.broadcast_to(avail, (C, Hl, 4)),
+                jnp.broadcast_to(extra, (C, Hl)),
+            )
+            commit_cap = jnp.asarray(C, jnp.int32)
+        ok_s = ok_s & valid_c
+        h_s = jnp.where(ok_s, h_s, 0)
+
+        # Exact shard-local replay of the carry fold (and the best-fit
+        # decay counter) over the speculated placements — PRE-states.
+        def substep(carry, x):
+            a, ex = carry
+            h, ok, d = x
+            a2 = _place_local(a, d, h, ok, offset)
+            ex2 = _bump_local(ex, h, ok, offset) if track_extra else ex
+            return (a2, ex2), (a, ex)
+
+        (_, _), (a_pre, ex_pre) = lax.scan(
+            substep, (avail, extra), (h_s, ok_s, dem_c)
+        )
+        if first_fit:
+            check_rows, row_check = score_rows_for(a_pre[e1c])
+            h_c, ok_c = decide(a_pre, check_rows)
+        else:
+            h_c, ok_c = decide_bf(a_pre, ex_pre)
+        ok_c = ok_c & valid_c
+        p_c = jnp.where(ok_c, h_c, -1).astype(jnp.int32)
+        p_s = jnp.where(ok_s, h_s, -1).astype(jnp.int32)
+        fc = jnp.min(jnp.where(p_c != p_s, idx, C))
+        n_commit = jnp.minimum(jnp.minimum(fc + 1, commit_cap), C)
+        n_commit = jnp.maximum(n_commit, 1)
+        placements = lax.dynamic_update_slice_in_dim(placements, p_c, pos, 0)
+        cm = jnp.minimum(n_commit - 1, C - 1)
+        new_avail = _place_local(
+            a_pre[cm], dem_c[cm], h_c[cm], ok_c[cm], offset
+        )
+        new_extra = (
+            _bump_local(ex_pre[cm], h_c[cm], ok_c[cm], offset)
+            if track_extra else extra
+        )
+        if first_fit:
+            new_frozen = jnp.where(e1 < n_commit, row_check, frozen)
+        else:
+            new_frozen = frozen
+        return pos + n_commit, placements, new_avail, new_frozen, new_extra
+
+    st0 = (jnp.asarray(0, jnp.int32), jnp.full((B + C,), -1, jnp.int32),
+           avail, jnp.zeros(Hl, dtype), jnp.zeros(Hl, jnp.int32))
+    _, placements, avail, _, _ = lax.while_loop(
+        lambda st: st[0] < n_eff, body, st0
+    )
+    return placements[:B], avail
+
+
+# ---------------------------------------------------------------------------
+# Public sharded kernels (cached jitted shard_map per (mesh, config))
+# ---------------------------------------------------------------------------
+
+_HOST_VEC = P(HOST_AXIS)          # [H] arrays: live mask, host_zone, counts
+_HOST_MAT = P(HOST_AXIS, None)    # [H, 4] availability
+_REP = P(None)                    # replicated task-axis operands
+
+
+def _live_specs(has_live):
+    return (_HOST_VEC,) if has_live else ()
+
+
+@functools.lru_cache(maxsize=None)
+def _opportunistic_sharded_fn(mesh, mode, has_live):
+    n = host_axis_size(mesh)
+
+    def fn(avail, demands, valid, uniforms, *rest):
+        live = rest[0] if has_live else None
+        avail, restore = _apply_live(avail, live)
+        n_eff = _effective_len(valid)
+        if mode == "step":
+            p, a = _opportunistic_sharded_pass(
+                avail, demands, valid, uniforms, n_eff, n
+            )
+        else:
+            p, a = _opportunistic_sharded_chunk(
+                avail, demands, valid, uniforms, n_eff,
+                min(mode, demands.shape[0]), n,
+            )
+        return p, restore(a)
+
+    return jax.jit(_shard_map(
+        fn, mesh=mesh,
+        in_specs=(_HOST_MAT, P(None, None), _REP, _REP) + _live_specs(has_live),
+        out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+def opportunistic_kernel_sharded(mesh, avail, demands, valid, uniforms,
+                                 phase2="auto", live=None):
+    """Host-sharded :func:`kernels.opportunistic_impl` — bit-identical to
+    the single-device kernel in every ``phase2`` mode (the sharded pass
+    is mode-collapsed; see the module docstring)."""
+    mode = _sharded_mode(phase2)
+    _check_host_axis(avail.shape[0], mesh)
+    if demands.shape[0] == 0:
+        return jnp.zeros((0,), jnp.int32), avail
+    args = (avail, demands, valid, uniforms)
+    if live is not None:
+        args = args + (live,)
+    return _opportunistic_sharded_fn(mesh, mode, live is not None)(*args)
+
+
+@functools.lru_cache(maxsize=None)
+def _first_fit_sharded_fn(mesh, mode, strict, has_live):
+    def fn(avail, demands, valid, *rest):
+        live = rest[0] if has_live else None
+        avail, restore = _apply_live(avail, live)
+        n_eff = _effective_len(valid)
+        if mode == "step":
+            p, a = _first_fit_sharded_pass(
+                avail, demands, valid, n_eff, strict
+            )
+        else:
+            p, a = _first_fit_sharded_chunk(
+                avail, demands, valid, n_eff,
+                min(mode, demands.shape[0]), strict,
+            )
+        return p, restore(a)
+
+    return jax.jit(_shard_map(
+        fn, mesh=mesh,
+        in_specs=(_HOST_MAT, P(None, None), _REP) + _live_specs(has_live),
+        out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+def first_fit_kernel_sharded(mesh, avail, demands, valid, strict=False,
+                             totals=None, phase2="auto", live=None):
+    """Host-sharded :func:`kernels.first_fit_impl`.  ``totals`` (the
+    chunked form's speculation pre-filter) is accepted and ignored — the
+    sharded pass has no speculation to steer, and the pre-filter can
+    never change a placement by contract."""
+    mode = _sharded_mode(phase2)
+    _check_host_axis(avail.shape[0], mesh)
+    if demands.shape[0] == 0:
+        return jnp.zeros((0,), jnp.int32), avail
+    args = (avail, demands, valid)
+    if live is not None:
+        args = args + (live,)
+    return _first_fit_sharded_fn(
+        mesh, mode, bool(strict), live is not None
+    )(*args)
+
+
+@functools.lru_cache(maxsize=None)
+def _best_fit_sharded_fn(mesh, mode, has_live):
+    def fn(avail, demands, valid, *rest):
+        live = rest[0] if has_live else None
+        avail, restore = _apply_live(avail, live)
+        n_eff = _effective_len(valid)
+        if mode == "step":
+            p, a = _best_fit_sharded_pass(avail, demands, valid, n_eff)
+        else:
+            p, a = _best_fit_sharded_chunk(
+                avail, demands, valid, n_eff, min(mode, demands.shape[0])
+            )
+        return p, restore(a)
+
+    return jax.jit(_shard_map(
+        fn, mesh=mesh,
+        in_specs=(_HOST_MAT, P(None, None), _REP) + _live_specs(has_live),
+        out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+def best_fit_kernel_sharded(mesh, avail, demands, valid, totals=None,
+                            phase2="auto", live=None):
+    """Host-sharded :func:`kernels.best_fit_impl` (``totals`` accepted
+    and ignored like :func:`first_fit_kernel_sharded`)."""
+    mode = _sharded_mode(phase2)
+    _check_host_axis(avail.shape[0], mesh)
+    if demands.shape[0] == 0:
+        return jnp.zeros((0,), jnp.int32), avail
+    args = (avail, demands, valid)
+    if live is not None:
+        args = args + (live,)
+    return _best_fit_sharded_fn(mesh, mode, live is not None)(*args)
+
+
+@functools.lru_cache(maxsize=None)
+def _cost_aware_sharded_fn(mesh, mode, bin_pack, sort_hosts, host_decay,
+                           has_live):
+    def fn(avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
+           host_zone, base_task_counts, *rest):
+        live = rest[0] if has_live else None
+        avail, restore = _apply_live(avail, live)
+        n_eff = _effective_len(valid)
+        if mode == "step":
+            p, a = _cost_aware_sharded_pass(
+                avail, demands, valid, new_group, anchor_zone, cost_zz,
+                bw_zz, host_zone, base_task_counts, n_eff,
+                bin_pack, sort_hosts, host_decay,
+            )
+        else:
+            p, a = _cost_aware_sharded_chunk_pass(
+                avail, demands, valid, new_group, anchor_zone, cost_zz,
+                bw_zz, host_zone, base_task_counts, n_eff,
+                min(mode, demands.shape[0]), bin_pack, sort_hosts,
+                host_decay,
+            )
+        return p, restore(a)
+
+    return jax.jit(_shard_map(
+        fn, mesh=mesh,
+        in_specs=(
+            _HOST_MAT, P(None, None), _REP, _REP, _REP,
+            P(None, None), P(None, None), _HOST_VEC, _HOST_VEC,
+        ) + _live_specs(has_live),
+        out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+def cost_aware_kernel_sharded(
+    mesh,
+    avail,
+    demands,
+    valid,
+    new_group,
+    anchor_zone,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    base_task_counts,
+    bin_pack: str = "first-fit",
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    rt_bw_rows=None,
+    rt_bw_idx=None,
+    totals=None,
+    phase2="auto",
+    live=None,
+):
+    """Host-sharded :func:`kernels.cost_aware_impl` — same argument
+    contract minus the realtime-bandwidth rows (live route-queue samples
+    are per-tick host state the mesh cannot hold; the device policy
+    declines sharding for ``realtime_bw`` like it declines spans)."""
+    mode = _sharded_mode(phase2)
+    if rt_bw_rows is not None or rt_bw_idx is not None:
+        raise ValueError(
+            "realtime_bw has no sharded form — the per-tick sampled "
+            "[G, H] rows would reshard every dispatch; use the "
+            "single-device kernel for realtime scoring"
+        )
+    _check_host_axis(avail.shape[0], mesh)
+    if demands.shape[0] == 0:
+        return jnp.zeros((0,), jnp.int32), avail
+    args = (avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
+            host_zone, base_task_counts)
+    if live is not None:
+        args = args + (live,)
+    return _cost_aware_sharded_fn(
+        mesh, mode, bin_pack, bool(sort_hosts), bool(host_decay),
+        live is not None,
+    )(*args)
+
+
+# ---------------------------------------------------------------------------
+# Sharded fused span driver (the tickloop twin)
+# ---------------------------------------------------------------------------
+
+
+def _sharded_span_body(
+    avail,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    uniforms,
+    sort_norm,
+    anchor_zone,
+    bucket_id,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    base_task_counts,
+    live,
+    *,
+    policy: str,
+    n_ticks: int,
+    n_shards: int,
+    strict: bool,
+    decreasing: bool,
+    bin_pack: str,
+    sort_tasks: bool,
+    sort_hosts: bool,
+    host_decay: bool,
+):
+    """Per-shard body of :func:`sharded_fused_tick_run` — the tick loop
+    of ``tickloop._fused_tick_run_impl`` with the kernel step served by
+    the sharded passes and the ``[H]`` carries ([H/S, 4] availability,
+    [H/S] span-cumulative decay counts) shard-local.  All [B] slot-axis
+    state is replicated and computed via the SHARED span algebra
+    helpers, identically on every shard."""
+    B = demands.shape[0]
+    Hl = avail.shape[0]
+    K = n_ticks
+    avail, restore = _apply_live(avail, live)
+    offset = _shard_offset(Hl)
+    iota_b = jnp.arange(B, dtype=jnp.int32)
+    big = jnp.asarray(2 * B + 2, jnp.int32)
+
+    def cond(st):
+        k, done = st[0], st[1]
+        return (k < n_ticks_dyn) & ~done
+
+    def body(st):
+        k, done, stackpos, n_stack, avail, cum, p_out, nr_out, np_out = st
+        alive = (k < n_ticks_dyn) & ~done
+
+        batch_pos, in_batch, t_k, _arriving = _span_ready_batch(
+            arrive, k, stackpos, n_stack, big
+        )
+        order = _span_stream_order(
+            policy, decreasing, sort_tasks, in_batch, batch_pos,
+            sort_norm, bucket_id, iota_b, big,
+        )
+        dem_p = demands[order]
+        valid_p = in_batch[order]
+        n_eff = _effective_len(valid_p)
+
+        if policy == "opportunistic":
+            p_ord, new_avail = _opportunistic_sharded_pass(
+                avail, dem_p, valid_p, uniforms[k], n_eff, n_shards
+            )
+        elif policy == "first-fit":
+            p_ord, new_avail = _first_fit_sharded_pass(
+                avail, dem_p, valid_p, n_eff, strict
+            )
+        elif policy == "best-fit":
+            p_ord, new_avail = _best_fit_sharded_pass(
+                avail, dem_p, valid_p, n_eff
+            )
+        else:  # cost-aware
+            ng_p = _span_group_entries(bucket_id, order, iota_b)
+            p_ord, new_avail = _cost_aware_sharded_pass(
+                avail, dem_p, valid_p, ng_p, anchor_zone[order],
+                cost_zz, bw_zz, host_zone, base_task_counts + cum,
+                n_eff, bin_pack, sort_hosts, host_decay,
+            )
+        row = jnp.full((B,), -1, jnp.int32).at[order].set(
+            p_ord.astype(jnp.int32)
+        )
+        placed = row >= 0
+        n_placed = jnp.sum(placed.astype(jnp.int32)).astype(jnp.int32)
+
+        new_stackpos, new_n_stack = _span_requeue(
+            decreasing, in_batch, placed, batch_pos, order, iota_b, big
+        )
+
+        # Span-cumulative resident-task counts, this shard's slice: a
+        # placement on host h bumps only its owner's block.
+        row_local = row - offset
+        mine = placed & (row_local >= 0) & (row_local < Hl)
+        cum_new = cum.at[jnp.where(mine, row_local, Hl)].add(
+            mine.astype(jnp.int32), mode="drop"
+        )
+
+        future = jnp.any((arrive > k) & (arrive < n_ticks_dyn))
+        done_new = ~future & ((new_n_stack == 0) | (n_placed == 0))
+
+        kk = jnp.where(alive, k, K)
+        return (
+            k + 1,
+            jnp.where(alive, done_new, done),
+            jnp.where(alive, new_stackpos, stackpos),
+            jnp.where(alive, new_n_stack, n_stack),
+            jnp.where(alive, new_avail, avail),
+            jnp.where(alive, cum_new, cum),
+            p_out.at[kk].set(jnp.where(alive, row, -1), mode="drop"),
+            nr_out.at[kk].set(t_k, mode="drop"),
+            np_out.at[kk].set(n_placed, mode="drop"),
+        )
+
+    st0 = (
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(False),
+        jnp.full((B,), -1, jnp.int32),
+        jnp.asarray(0, jnp.int32),
+        avail,
+        jnp.zeros((Hl,), jnp.int32),
+        jnp.full((K, B), -1, jnp.int32),
+        jnp.zeros((K,), jnp.int32),
+        jnp.zeros((K,), jnp.int32),
+    )
+    k, _done, stackpos, n_stack, avail, _cum, p_out, nr_out, np_out = (
+        lax.while_loop(cond, body, st0)
+    )
+    return SpanResult(
+        p_out, nr_out, np_out, k, n_stack, stackpos, restore(avail)
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
+                     sort_tasks, sort_hosts, host_decay):
+    n = host_axis_size(mesh)
+
+    def fn(avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
+           anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
+           base_task_counts, live):
+        return _sharded_span_body(
+            avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
+            anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
+            base_task_counts, live,
+            policy=policy, n_ticks=n_ticks, n_shards=n, strict=strict,
+            decreasing=decreasing, bin_pack=bin_pack,
+            sort_tasks=sort_tasks, sort_hosts=sort_hosts,
+            host_decay=host_decay,
+        )
+
+    return jax.jit(_shard_map(
+        fn, mesh=mesh,
+        in_specs=(
+            _HOST_MAT,        # avail
+            P(None, None),    # demands
+            _REP,             # arrive
+            P(),              # n_ticks_dyn
+            P(None, None),    # uniforms (or None)
+            _REP,             # sort_norm (or None)
+            _REP,             # anchor_zone (or None)
+            _REP,             # bucket_id (or None)
+            P(None, None),    # cost_zz (or None)
+            P(None, None),    # bw_zz (or None)
+            _HOST_VEC,        # host_zone (or None)
+            _HOST_VEC,        # base_task_counts (or None)
+            _HOST_VEC,        # live (or None)
+        ),
+        out_specs=SpanResult(
+            placements=P(None, None),
+            n_ready=_REP,
+            n_placed=_REP,
+            ticks_run=P(),
+            n_stack_final=P(),
+            stackpos=_REP,
+            avail=_HOST_MAT,
+        ),
+        check_rep=False,
+    ))
+
+
+def sharded_fused_tick_run(
+    mesh,
+    avail,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    *,
+    policy: str,
+    n_ticks: int,
+    uniforms=None,
+    sort_norm=None,
+    anchor_zone=None,
+    bucket_id=None,
+    cost_zz=None,
+    bw_zz=None,
+    host_zone=None,
+    base_task_counts=None,
+    totals=None,
+    live=None,
+    strict: bool = False,
+    decreasing: bool = False,
+    bin_pack: str = "first-fit",
+    sort_tasks: bool = False,
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    phase2="auto",
+) -> SpanResult:
+    """Host-sharded :func:`tickloop.fused_tick_run` — same contract,
+    same :class:`SpanResult`, the ``[H, 4]`` carry kept shard-resident
+    between ticks.  Bit-identical to the single-device driver (and so to
+    :func:`tickloop.reference_tick_run`) on every input the parity suite
+    sweeps.  ``totals``/``phase2`` accepted for signature compatibility
+    (speculation-free pass; every mode is bit-identical)."""
+    _resolve_phase2(phase2)
+    _check_host_axis(avail.shape[0], mesh)
+    return _sharded_span_fn(
+        mesh, policy, n_ticks, bool(strict), bool(decreasing), bin_pack,
+        bool(sort_tasks), bool(sort_hosts), bool(host_decay),
+    )(
+        avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
+        anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
+        base_task_counts, live,
+    )

@@ -93,6 +93,97 @@ def span_bucket(k: int) -> int:
     return ((k + 31) // 32) * 32
 
 
+# ---------------------------------------------------------------------------
+# Span slot-axis algebra — shared by the single-device driver below and the
+# host-sharded driver (``ops/shard.py``).  These operate only on replicated
+# [B] slot-axis state, never on the [H] host axis, so the sharded driver
+# reuses them verbatim (every device computes identical values) and the two
+# drivers cannot drift.  All three are hotpath-lint targets.
+# ---------------------------------------------------------------------------
+
+
+def _span_ready_batch(arrive, k, stackpos, n_stack, big):
+    """Tick ``k``'s ready batch: LIFO re-drain of the wait stack (reverse
+    stack order), then the tick's arriving cohort in delivery order —
+    exactly the dispatch loop's drain sequence.  Returns ``(batch_pos
+    [B] i32, in_batch [B] bool, t_k scalar i32, arriving [B] bool)``."""
+    arriving = arrive == k
+    arr_rank = jnp.cumsum(arriving.astype(jnp.int32)) - 1
+    in_stack = stackpos >= 0
+    batch_pos = jnp.where(
+        in_stack,
+        n_stack - 1 - stackpos,
+        jnp.where(arriving, n_stack + arr_rank, big),
+    ).astype(jnp.int32)
+    in_batch = in_stack | arriving
+    t_k = (n_stack + jnp.sum(arriving.astype(jnp.int32))).astype(jnp.int32)
+    return batch_pos, in_batch, t_k, arriving
+
+
+def _span_stream_order(policy, decreasing, sort_tasks, in_batch, batch_pos,
+                       sort_norm, bucket_id, iota_b, big):
+    """Kernel-stream order (ties resolved by batch position, which is
+    unique — every sort is total, no stability needed):
+      * batch-order arms: the batch order itself;
+      * decreasing VBP arms: demand-norm-descending over the batch
+        (``sort_norm`` is the HOST-computed f64 norm, the same values
+        ``_sort_decreasing`` keys on — recomputing norms device-side
+        could round a tie differently);
+      * cost-aware: anchor buckets in first-seen batch order
+        (``bucket_id`` is the host-resolved anchor identity — buckets
+        have unique first-seen positions, so groups are contiguous
+        after the sort), batch-ordered or norm-descending within a
+        bucket."""
+    B = iota_b.shape[0]
+    inactive = (~in_batch).astype(jnp.int32)
+    if policy == "cost-aware":
+        bf_bucket = jax.ops.segment_min(
+            jnp.where(in_batch, batch_pos, big),
+            bucket_id,
+            num_segments=B,
+        )
+        bfirst = bf_bucket[bucket_id]
+        key3 = -sort_norm if sort_tasks else batch_pos
+        return lax.sort(
+            (inactive, bfirst, key3, batch_pos, iota_b), num_keys=4
+        )[-1]
+    if decreasing:
+        return lax.sort(
+            (inactive, -sort_norm, batch_pos, iota_b), num_keys=3
+        )[-1]
+    return lax.sort((inactive, batch_pos, iota_b), num_keys=2)[-1]
+
+
+def _span_group_entries(bucket_id, order, iota_b):
+    """Per-position group-entry flags of the permuted cost-aware stream
+    (buckets are contiguous after :func:`_span_stream_order`)."""
+    b_p = bucket_id[order]
+    return jnp.where(iota_b == 0, True, b_p != jnp.roll(b_p, 1))
+
+
+def _span_requeue(decreasing, in_batch, placed, batch_pos, order, iota_b,
+                  big):
+    """Wait-stack rebuild: unplaced batch members re-enter in VISIT order
+    — the kernel-stream order for the decreasing VBP arms (the reference
+    consumes ``schedule()``'s sorted return list), the batch order for
+    everything else (cost-aware's bucket sort happens on a copy; its
+    return order is the batch).  Returns ``(new_stackpos [B] i32,
+    new_n_stack scalar i32)``."""
+    B = iota_b.shape[0]
+    if decreasing:
+        visit_pos = jnp.zeros((B,), jnp.int32).at[order].set(iota_b)
+    else:
+        visit_pos = batch_pos
+    unplaced = in_batch & ~placed
+    srt = lax.sort(
+        (jnp.where(unplaced, visit_pos, big), iota_b), num_keys=1
+    )[1]
+    ranks = jnp.zeros((B,), jnp.int32).at[srt].set(iota_b)
+    new_stackpos = jnp.where(unplaced, ranks, -1)
+    new_n_stack = jnp.sum(unplaced.astype(jnp.int32)).astype(jnp.int32)
+    return new_stackpos, new_n_stack
+
+
 class SpanResult(NamedTuple):
     """One fused span's outputs (axes: K = tick bucket, B = slot bucket).
 
@@ -158,52 +249,16 @@ def _fused_tick_run_impl(
         # spans) must be inert: every state write below gates on alive.
         alive = (k < n_ticks_dyn) & ~done
 
-        # 1. This tick's ready batch: LIFO re-drain of the wait stack
-        #    (reverse stack order), then the tick's arriving cohort in
-        #    delivery order — exactly the dispatch loop's drain sequence.
-        arriving = arrive == k
-        arr_rank = jnp.cumsum(arriving.astype(jnp.int32)) - 1
-        in_stack = stackpos >= 0
-        batch_pos = jnp.where(
-            in_stack,
-            n_stack - 1 - stackpos,
-            jnp.where(arriving, n_stack + arr_rank, big),
-        ).astype(jnp.int32)
-        in_batch = in_stack | arriving
-        t_k = (n_stack + jnp.sum(arriving.astype(jnp.int32))).astype(
-            jnp.int32
+        # 1. This tick's ready batch (shared algebra, ``_span_ready_batch``).
+        batch_pos, in_batch, t_k, _arriving = _span_ready_batch(
+            arrive, k, stackpos, n_stack, big
         )
 
-        # 2. Kernel-stream order (ties resolved by batch position, which
-        #    is unique — every sort below is total, no stability needed):
-        #      * batch-order arms: the batch order itself;
-        #      * decreasing VBP arms: demand-norm-descending over the
-        #        batch (``sort_norm`` is the HOST-computed f64 norm, the
-        #        same values ``_sort_decreasing`` keys on — recomputing
-        #        norms device-side could round a tie differently);
-        #      * cost-aware: anchor buckets in first-seen batch order
-        #        (``bucket_id`` is the host-resolved anchor identity —
-        #        buckets have unique first-seen positions, so groups are
-        #        contiguous after the sort), batch-ordered or
-        #        norm-descending within a bucket.
-        inactive = (~in_batch).astype(jnp.int32)
-        if policy == "cost-aware":
-            bf_bucket = jax.ops.segment_min(
-                jnp.where(in_batch, batch_pos, big),
-                bucket_id,
-                num_segments=B,
-            )
-            bfirst = bf_bucket[bucket_id]
-            key3 = -sort_norm if sort_tasks else batch_pos
-            order = lax.sort(
-                (inactive, bfirst, key3, batch_pos, iota_b), num_keys=4
-            )[-1]
-        elif decreasing:
-            order = lax.sort(
-                (inactive, -sort_norm, batch_pos, iota_b), num_keys=3
-            )[-1]
-        else:
-            order = lax.sort((inactive, batch_pos, iota_b), num_keys=2)[-1]
+        # 2. Kernel-stream order (shared algebra, ``_span_stream_order``).
+        order = _span_stream_order(
+            policy, decreasing, sort_tasks, in_batch, batch_pos,
+            sort_norm, bucket_id, iota_b, big,
+        )
         dem_p = demands[order]
         valid_p = in_batch[order]
 
@@ -228,8 +283,7 @@ def _fused_tick_run_impl(
                 avail, dem_p, valid_p, totals=totals, phase2=phase2
             )
         else:  # cost-aware
-            b_p = bucket_id[order]
-            ng_p = jnp.where(iota_b == 0, True, b_p != jnp.roll(b_p, 1))
+            ng_p = _span_group_entries(bucket_id, order, iota_b)
             p_ord, new_avail = cost_aware_impl(
                 avail,
                 dem_p,
@@ -252,23 +306,10 @@ def _fused_tick_run_impl(
         placed = row >= 0
         n_placed = jnp.sum(placed.astype(jnp.int32)).astype(jnp.int32)
 
-        # 4. Wait-stack rebuild: unplaced batch members re-enter in VISIT
-        #    order — the kernel-stream order for the decreasing VBP arms
-        #    (the reference consumes ``schedule()``'s sorted return
-        #    list), the batch order for everything else (cost-aware's
-        #    bucket sort happens on a copy; its return order is the
-        #    batch).
-        if decreasing:
-            visit_pos = jnp.zeros((B,), jnp.int32).at[order].set(iota_b)
-        else:
-            visit_pos = batch_pos
-        unplaced = in_batch & ~placed
-        srt = lax.sort(
-            (jnp.where(unplaced, visit_pos, big), iota_b), num_keys=1
-        )[1]
-        ranks = jnp.zeros((B,), jnp.int32).at[srt].set(iota_b)
-        new_stackpos = jnp.where(unplaced, ranks, -1)
-        new_n_stack = jnp.sum(unplaced.astype(jnp.int32)).astype(jnp.int32)
+        # 4. Wait-stack rebuild (shared algebra, ``_span_requeue``).
+        new_stackpos, new_n_stack = _span_requeue(
+            decreasing, in_batch, placed, batch_pos, order, iota_b, big
+        )
 
         # 5. Span-cumulative resident-task counts (the host-decay base
         #    grows by one per placement, mirroring Host.n_tasks at

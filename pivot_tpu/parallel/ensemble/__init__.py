@@ -332,6 +332,14 @@ def sharded_rollout(
     downstream ensemble statistics (means/quantiles over replicas) become
     psums over ICI.  Fault parameters as in :func:`rollout`.
     """
+    n_rep_axis = int(mesh.shape["replica"])
+    if n_replicas % n_rep_axis:
+        raise ValueError(
+            f"n_replicas={n_replicas} does not divide over the mesh's "
+            f"{n_rep_axis} replica shards — NamedSharding partitions the "
+            f"[R] axis into equal contiguous blocks; round the ensemble "
+            f"up to a multiple of {n_rep_axis}"
+        )
     fn = _sharded_rollout_fn(
         mesh, n_replicas, tick, max_ticks, perturb, n_faults, fault_horizon,
         mttr, policy, congestion, realtime_scoring, tick_order,
@@ -375,7 +383,7 @@ def shard_sweep(sweep_fn, fallback_segment_ticks=None, force_mesh=False,
     """
     import inspect
 
-    from pivot_tpu.parallel.mesh import build_mesh
+    from pivot_tpu.parallel.mesh import replica_mesh
     from pivot_tpu.utils import get_logger
 
     n_dev = len(jax.devices())
@@ -413,7 +421,7 @@ def shard_sweep(sweep_fn, fallback_segment_ticks=None, force_mesh=False,
         if fallback_segment_ticks is not None:
             static_kw.setdefault("segment_ticks", fallback_segment_ticks)
         return functools.partial(sweep_fn, **static_kw)
-    mesh = build_mesh(n_dev, ("replica", "host"))
+    mesh = replica_mesh(n_dev)
     return jax.jit(
         functools.partial(sweep_fn, **static_kw),
         out_shardings=sweep_out_shardings(mesh),

@@ -22,7 +22,13 @@ from typing import Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["build_mesh", "build_hybrid_mesh"]
+__all__ = [
+    "build_mesh",
+    "build_hybrid_mesh",
+    "host_axis_size",
+    "host_sharded_mesh",
+    "replica_mesh",
+]
 
 
 def build_mesh(
@@ -49,6 +55,35 @@ def build_mesh(
 
     grid = np.array(devs).reshape(n // hp, hp)
     return Mesh(grid, axis_names)
+
+
+def replica_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Replica-only (data-parallel) mesh: every device on the ``replica``
+    axis, host axis = 1 — the layout for Monte-Carlo ensembles and the
+    cross-run dispatch batcher's [G] axis (``sched/batch.py``), where
+    rows are embarrassingly parallel and ICI traffic is zero."""
+    return build_mesh(n_devices, ("replica", "host"), host_parallel=1,
+                      devices=devices)
+
+
+def host_sharded_mesh(
+    n_shards: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Host-only (model-parallel) mesh: every selected device on the
+    ``host`` axis, replica axis = 1 — the layout for pod-scale sharded
+    placement (``ops/shard.py``), where one cluster's ``[H]`` state is
+    partitioned into contiguous index blocks across devices and the
+    per-step argmin runs as a two-stage sharded reduce."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = n_shards if n_shards is not None else len(devs)
+    return build_mesh(n, ("replica", "host"), host_parallel=n, devices=devs)
+
+
+def host_axis_size(mesh: Mesh) -> int:
+    """Size of ``mesh``'s host axis (1 on a replica-only mesh)."""
+    return int(mesh.shape["host"])
 
 
 def build_hybrid_mesh(

@@ -115,14 +115,24 @@ def group_bucket(g: int) -> int:
 
 
 @functools.lru_cache(maxsize=256)
-def _batched_fn(kernel, static_items: tuple, n_args: int, kw_keys: tuple):
+def _batched_fn(kernel, static_items: tuple, n_args: int, kw_keys: tuple,
+                mesh=None):
     """jit(vmap(kernel)) closed over the static config — cached per
-    (kernel, static kwargs, array-kwarg names); jit's own cache keys the
-    shapes, so this is one entry per kernel configuration, one XLA
-    program per (G-bucket, input-shape) combination.  The signature is
-    flat positional leaves (arguments first, array-kwargs in ``kw_keys``
-    order after) — nested container pytrees cost measurably more per
-    dispatch, and per-dispatch overhead is this module's whole subject."""
+    (kernel, static kwargs, array-kwarg names, mesh); jit's own cache
+    keys the shapes, so this is one entry per kernel configuration, one
+    XLA program per (G-bucket, input-shape) combination.  The signature
+    is flat positional leaves (arguments first, array-kwargs in
+    ``kw_keys`` order after) — nested container pytrees cost measurably
+    more per dispatch, and per-dispatch overhead is this module's whole
+    subject.
+
+    With ``mesh``, every stacked operand's leading [G] axis is sharded
+    over the mesh's ``replica`` axis (``in_shardings``), so XLA
+    partitions the vmapped program row-wise: co-pending runs execute on
+    DISTINCT devices instead of queueing on one.  Rows never
+    communicate (the kernels are per-row pure), so partitioning cannot
+    change a row's op sequence — bit-identical outputs, asserted by
+    ``tests/test_shard.py``."""
     static_kw = dict(static_items)
 
     def call(*cols):
@@ -132,7 +142,21 @@ def _batched_fn(kernel, static_items: tuple, n_args: int, kw_keys: tuple):
             **static_kw,
         )
 
-    return jax.jit(jax.vmap(call))
+    if mesh is None:
+        return jax.jit(jax.vmap(call))
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shard = NamedSharding(mesh, PartitionSpec("replica"))
+    return jax.jit(jax.vmap(call), in_shardings=shard, out_shardings=shard)
+
+
+def _replica_mesh_for(mesh, gb: int):
+    """The mesh to shard a ``gb``-row batch over, or None: the replica
+    axis must divide the padded group bucket (contiguous row blocks per
+    device), and a 1-row batch has nothing to spread."""
+    if mesh is None or gb <= 1:
+        return None
+    return mesh if gb % int(mesh.shape["replica"]) == 0 else None
 
 
 def _to_host(tree):
@@ -143,6 +167,7 @@ def batch_execute(
     kernel,
     requests: Sequence[Tuple[tuple, dict]],
     static_kw: Optional[dict] = None,
+    mesh=None,
 ) -> list:
     """Serve N same-shaped kernel requests as one vmapped device dispatch.
 
@@ -164,6 +189,14 @@ def batch_execute(
     and the redundant bytes ride INSIDE the one batched call — a few KB
     of [Z, Z] tables against the ~78 ms per-call floor being amortized,
     no extra round-trip.
+
+    ``mesh`` shards the stacked [G] axis over the mesh's ``replica``
+    axis (``parallel.mesh.replica_mesh``), so the G rows execute on
+    distinct devices — the multi-chip rung above same-device vmap.
+    Falls back to the unsharded program when the padded group bucket
+    does not divide the replica axis (row blocks must be contiguous)
+    or the batch is a single request; bit-identical either way (rows
+    never communicate).
     """
     static_kw = static_kw or {}
     g = len(requests)
@@ -190,7 +223,8 @@ def batch_execute(
     kw_keys = tuple(sorted(requests[0][1]))
     kw_cols = tuple(stack([r[1][k] for r in requests]) for k in kw_keys)
     fn = _batched_fn(
-        kernel, tuple(sorted(static_kw.items())), len(args_cols), kw_keys
+        kernel, tuple(sorted(static_kw.items())), len(args_cols), kw_keys,
+        _replica_mesh_for(mesh, gb),
     )
     out = _to_host(fn(*args_cols, *kw_cols))
     return [
@@ -329,14 +363,17 @@ class DispatchBatcher:
     ``deadline_flushes`` (partial flushes forced by ``flush_after``),
     ``single_fast_path`` (calls served synchronously on the owning
     thread because theirs was the only live slot — no queue hand-off,
-    no coordinator hop), and the pool-resize pair ``respawns`` (slots
+    no coordinator hop), ``mesh_dispatches`` (device calls whose [G]
+    axis sharded over the replica mesh — multi-chip coalesced
+    flushes), and the pool-resize pair ``respawns`` (slots
     opened beyond the construction-time count: supervisor restarts and
     autoscaler growth) / ``retired_slots`` (slots closed for good:
     finished runs, drained-and-retired or crashed sessions).  At any
     instant ``live_slots == runs − retired_slots``.
     """
 
-    def __init__(self, n_slots: int, flush_after: Optional[float] = None):
+    def __init__(self, n_slots: int, flush_after: Optional[float] = None,
+                 mesh: Optional[object] = None):
         if n_slots < 1:
             raise ValueError("DispatchBatcher needs at least one slot")
         if flush_after is not None and flush_after <= 0:
@@ -346,6 +383,11 @@ class DispatchBatcher:
         self._open = n_slots
         self._idle = 0
         self._flush_after = flush_after
+        #: Replica-axis mesh (``parallel.mesh.replica_mesh``): coalesced
+        #: flushes shard their stacked [G] axis over it so co-pending
+        #: runs land on distinct devices (see :func:`batch_execute`).
+        #: ``None`` (default) keeps the single-device vmap program.
+        self._mesh = mesh
         self._pending: List[_Request] = []
         self._clients = 0
         self.stats: Dict[str, int] = {
@@ -356,6 +398,9 @@ class DispatchBatcher:
             "max_group": 0,
             "deadline_flushes": 0,
             "single_fast_path": 0,
+            #: Device calls whose [G] axis actually sharded over the
+            #: replica mesh (mesh set AND the bucket divided the axis).
+            "mesh_dispatches": 0,
         }
         #: Pool-resize accounting (serving autoscaler + supervisor):
         #: slots opened beyond the construction-time count and slots
@@ -469,11 +514,16 @@ class DispatchBatcher:
                 )
                 if len(reqs) > 1:
                     self.stats["coalesced"] += len(reqs)
+                if _replica_mesh_for(
+                    self._mesh, group_bucket(len(reqs))
+                ) is not None:
+                    self.stats["mesh_dispatches"] += 1
                 try:
                     outs = batch_execute(
                         reqs[0].kernel,
                         [(r.args, r.arr_kw) for r in reqs],
                         reqs[0].static_kw,
+                        mesh=self._mesh,
                     )
                 except BaseException as exc:  # noqa: BLE001 — deliver, don't hang
                     for r in reqs:

@@ -49,6 +49,7 @@ paying the link when the tick cannot amortize it.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import List, Optional
 
@@ -63,6 +64,14 @@ from pivot_tpu.ops.kernels import (
     first_fit_kernel,
     opportunistic_kernel,
 )
+from pivot_tpu.ops.shard import (
+    best_fit_kernel_sharded,
+    cost_aware_kernel_sharded,
+    first_fit_kernel_sharded,
+    opportunistic_kernel_sharded,
+    sharded_fused_tick_run,
+)
+from pivot_tpu.parallel.mesh import host_axis_size
 from pivot_tpu.ops.pallas_kernels import (
     cost_aware_pallas,
     cost_aware_pallas_batched,
@@ -198,6 +207,10 @@ class _DevicePolicyBase(Policy):
         # is attached, every device-kernel call routes through it so G
         # concurrently-stepped runs share one vmapped dispatch per tick.
         self._batch_client = None
+        # Pod-scale host sharding (ops/shard.py): when a mesh is enabled,
+        # every placement dispatch — per-tick kernels AND fused spans —
+        # runs host-sharded over the mesh's ``host`` axis.
+        self._mesh = None
         self._topology_host: Optional[DeviceTopology] = None
         self._cpu_twin: Optional[Policy] = None  # set by subclasses
         self._cpu_cell_cost = self._CELL_COST_SEED
@@ -217,6 +230,8 @@ class _DevicePolicyBase(Policy):
         _enable_compilation_cache()
         self.topology = DeviceTopology.from_cluster(scheduler.cluster, self.dtype)
         self._topology_host = None  # rebind = new cluster; drop the host cache
+        if self._mesh is not None:
+            self._check_mesh_hosts(self._mesh)  # rebind = new H; re-validate
         if self._cpu_twin is not None:
             self._cpu_twin.bind(scheduler)
         if self.adaptive:
@@ -238,7 +253,75 @@ class _DevicePolicyBase(Policy):
                 "cross-run batching needs deterministic dispatch — "
                 "construct the policy with adaptive=False"
             )
+        if self._mesh is not None:
+            raise ValueError(
+                "cross-run batching and host sharding are mutually "
+                "exclusive on one policy: the batcher's program is "
+                "vmap(kernel) over the run axis, which would need a "
+                "replica x host 2-D partitioning of every dispatch — "
+                "shard the batcher's [G] axis over the mesh's replica "
+                "axis instead (DispatchBatcher(mesh=...), sched/batch.py)"
+            )
         self._batch_client = client
+
+    # -- pod-scale host sharding (round 10, ``ops/shard.py``) --------------
+    def enable_sharding(self, mesh) -> None:
+        """Partition the placement hot path's host axis over ``mesh``'s
+        ``host`` axis: the [H, 4] availability snapshot, the quarantine
+        mask, and every per-step score row live shard-resident, and the
+        phase-2 argmin runs as the two-stage (score, global-index)
+        reduce — bit-identical placements to the single-device kernels
+        (``tests/test_shard.py``).  Fused spans ride the sharded span
+        driver with the carry staying shard-resident between ticks.
+
+        Requires deterministic routing (no adaptive twin — its latency
+        model prices a single-device program) and the scan-family
+        kernels (no Pallas, no realtime-bw rows); mutually exclusive
+        with cross-run batching (see :meth:`enable_batching`).
+        """
+        if self.adaptive:
+            raise ValueError(
+                "host sharding needs deterministic dispatch — construct "
+                "the policy with adaptive=False"
+            )
+        if self._batch_client is not None:
+            raise ValueError(
+                "host sharding and cross-run batching are mutually "
+                "exclusive — see enable_batching"
+            )
+        if getattr(self, "use_pallas", False):
+            raise ValueError(
+                "the Pallas kernel keeps the whole tick in one core's "
+                "VMEM — it has no sharded form; drop use_pallas=True"
+            )
+        if getattr(self, "realtime_bw", False):
+            raise ValueError(
+                "realtime_bw has no sharded form (per-tick sampled "
+                "[G, H] rows would reshard every dispatch)"
+            )
+        if host_axis_size(mesh) < 1:
+            raise ValueError("mesh has an empty host axis")
+        if self.topology is not None:
+            self._check_mesh_hosts(mesh)
+        self._mesh = mesh
+
+    def _check_mesh_hosts(self, mesh) -> None:
+        H = self.topology.n_hosts
+        n = host_axis_size(mesh)
+        if H % n:
+            raise ValueError(
+                f"cluster has H={H} hosts, not divisible over the "
+                f"mesh's {n} host shards — pad the cluster to a "
+                f"multiple of {n} hosts"
+            )
+
+    def _kernel_for(self, kernel, sharded_kernel):
+        """The dispatch rung for one placement call: the single-device
+        kernel (through the cross-run batcher when attached), or its
+        host-sharded twin when a mesh is enabled."""
+        if self._mesh is None:
+            return functools.partial(self._call_kernel, kernel)
+        return functools.partial(sharded_kernel, self._mesh)
 
     def _call_kernel(self, kernel, *args, **kw):
         """Kernel-call indirection: direct when unbatched, through the
@@ -381,15 +464,25 @@ class _DevicePolicyBase(Policy):
         live = ctx.live_mask
         if live is not None:
             kw["live"] = self._stage(live)
-        res = self._call_kernel(
-            fused_tick_run,
+        span_args = (
             self._stage(ctx.avail, self.dtype),
             self._stage(dem),
             self._stage(arrive),
             np.int32(k_dyn),
-            n_ticks=K,
-            **kw,
         )
+        if self._mesh is not None:
+            # Host-sharded span driver: the [H/S, 4] carry stays
+            # shard-resident between ticks; bit-identical by the span
+            # parity suite.  Not routed through the batcher — sharding
+            # and cross-run batching are mutually exclusive (see
+            # enable_sharding).
+            res = sharded_fused_tick_run(
+                self._mesh, *span_args, n_ticks=K, **kw
+            )
+        else:
+            res = self._call_kernel(
+                fused_tick_run, *span_args, n_ticks=K, **kw
+            )
         # ONE host fetch — the placements matrix is the span's entire
         # host-visible output (meters derive from it in the replay).
         return _SpanOutcome(np.asarray(res.placements))
@@ -580,9 +673,10 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
         avail, dem, valid = self._padded(ctx)
         u = np.zeros(valid.shape[0], dtype=np.float64)
         u[:T] = tick_uniforms(ctx.scheduler.seed or 0, ctx.tick_seq, T)
-        placements, _ = self._call_kernel(
-            opportunistic_kernel, avail, dem, valid,
-            self._stage(u, self.dtype),
+        placements, _ = self._kernel_for(
+            opportunistic_kernel, opportunistic_kernel_sharded
+        )(
+            avail, dem, valid, self._stage(u, self.dtype),
             phase2=self.phase2, live=self._live_arg(ctx),
         )
         return self._unpad(placements, T)
@@ -613,8 +707,10 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
             order = _sort_decreasing(ctx.demands, list(range(T)))
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:17)
         avail, dem, valid = self._padded(ctx, order)
-        placements, _ = self._call_kernel(
-            first_fit_kernel, avail, dem, valid, strict=False,
+        placements, _ = self._kernel_for(
+            first_fit_kernel, first_fit_kernel_sharded
+        )(
+            avail, dem, valid, strict=False,
             totals=self._staged_topology().totals,
             phase2=self.phase2, live=self._live_arg(ctx),
         )
@@ -667,8 +763,10 @@ class TpuBestFitPolicy(_DevicePolicyBase):
             order = _sort_decreasing(ctx.demands, list(range(T)))
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:42)
         avail, dem, valid = self._padded(ctx, order)
-        placements, _ = self._call_kernel(
-            best_fit_kernel, avail, dem, valid,
+        placements, _ = self._kernel_for(
+            best_fit_kernel, best_fit_kernel_sharded
+        )(
+            avail, dem, valid,
             totals=self._staged_topology().totals,
             phase2=self.phase2, live=self._live_arg(ctx),
         )
@@ -941,11 +1039,14 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 # use_pallas=True + realtime_bw is rejected in __init__).
                 and not self.realtime_bw
             )
-        if self._batch_client is not None:
+        if self._batch_client is not None or self._mesh is not None:
             # The batcher's program is vmap(scan kernel): the Pallas
             # greedy kernel batches replicas along its own sublane axis
             # (cost_aware_pallas_batched) and cannot ride a run axis too.
-            # Explicit use_pallas=True is rejected at enable_batching.
+            # The sharded tier likewise has no Pallas form (one core's
+            # VMEM cannot hold the sharded tick).  Explicit
+            # use_pallas=True is rejected at enable_batching /
+            # enable_sharding.
             use_pallas = False
         kw = {}
         if group_rows is not None:
@@ -962,7 +1063,10 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             idx[:T] = row_idx
             kw["rt_bw_rows"] = self._stage(rows, self.dtype)
             kw["rt_bw_idx"] = self._stage(idx)
-        kernel = cost_aware_pallas if use_pallas else cost_aware_kernel
+        if use_pallas:
+            call = functools.partial(self._call_kernel, cost_aware_pallas)
+        else:
+            call = self._kernel_for(cost_aware_kernel, cost_aware_kernel_sharded)
         live_arg = self._live_arg(ctx)
         if live_arg is not None:
             # Both kernel arms accept the quarantine mask; omit it when
@@ -976,8 +1080,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             # change a placement (ops/kernels.py).
             kw["totals"] = topo.totals
             kw["phase2"] = self.phase2
-        placements, _ = self._call_kernel(
-            kernel,
+        placements, _ = call(
             avail,
             dem,
             valid,

@@ -102,6 +102,29 @@ def enable_compilation_cache() -> None:
         )
 
 
+#: stderr substrings that mark XLA:CPU AOT cache-portability noise — the
+#: persistent compilation cache replaying an executable compiled on a
+#: machine with different CPU features logs a screen-filling
+#: feature-matrix "error" per load (``cpu_aot_loader.cc``) that is
+#: advisory on this fleet (the fallback recompiles).  Multichip capture
+#: artifacts record stderr tails; these lines would drown the signal.
+_XLA_AOT_NOISE = ("cpu_aot_loader", "XLA:CPU AOT")
+
+
+def filter_xla_aot_noise(text: str) -> str:
+    """Drop the XLA:CPU AOT feature-mismatch log lines from ``text``
+    (artifact stderr tails), keeping every other line — and the
+    trailing newline, so re-emitting with ``end=''`` cannot glue the
+    last kept line onto the caller's next write."""
+    kept = "\n".join(
+        ln for ln in text.splitlines()
+        if not any(m in ln for m in _XLA_AOT_NOISE)
+    )
+    if kept and text.endswith("\n"):
+        kept += "\n"
+    return kept
+
+
 def pin_virtual_cpu_mesh(n_devices: int) -> bool:
     """Pin this process to an ``n_devices`` virtual-CPU JAX backend.
 

@@ -158,6 +158,14 @@ _SLOW_TESTS = {
         "test_full_sim_parity_opportunistic",
     ],
     "test_sensitivity.py": ["test_cli_sensitivity_paired_experiment"],
+    "test_shard.py": [
+        # Quick twins in tier 1: test_sharded_parity_h1024 (the H=1024
+        # acceptance), test_sharded_span_parity_quick,
+        # test_sharded_span_h1024_quick, the contended/full-flag-grid
+        # smalls.  The K-sweep also carries the ``fused`` marker.
+        "test_sharded_parity_sweep_full",
+        "test_sharded_span_parity_sweep_full",
+    ],
     "test_tickloop.py": [
         # Quick twins in tier 1: test_fused_span_parity_quick,
         # test_fused_span_parity_live_mask_quick,

@@ -117,7 +117,8 @@ def test_lockstep_grid_smoke_and_stats_keys():
 
     assert set(stats) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
-        "deadline_flushes", "single_fast_path", "respawns",
+        "deadline_flushes", "single_fast_path", "mesh_dispatches",
+        "respawns",
         "retired_slots",
     }
     assert stats["runs"] == 2

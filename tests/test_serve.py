@@ -270,7 +270,8 @@ def test_slo_snapshot_schema_has_dispatch_mix():
     assert set(fresh["dispatch"]) == set(SloMeter.DISPATCH_KEYS)
     assert set(SloMeter.DISPATCH_KEYS) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
-        "deadline_flushes", "single_fast_path", "respawns",
+        "deadline_flushes", "single_fast_path", "mesh_dispatches",
+        "respawns",
         "retired_slots",
     }
     assert all(v == 0 for v in fresh["dispatch"].values())

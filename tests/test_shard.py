@@ -1,0 +1,539 @@
+"""Pod-scale sharded placement parity (round 10, ``ops/shard.py``).
+
+The acceptance bar: sharded placement is **bit-identical** to the
+single-device oracle at H=1024 — all four policies × every phase-2 mode
+(scan oracle / slim / speculative chunk commit) × live masks, including
+fused spans — verified on the conftest-forced 8-device CPU mesh with
+x64 on.  Both sharded passes run per sweep — the per-step pass
+(``phase2="auto"``) and the sharded chunk commit (``phase2=int``, the
+collective-amortizing pod-scale mode) — and each is asserted against
+EACH single-device mode's output; a single-device mode that drifted
+from its own oracle would be caught by ``test_two_phase.py`` first, and
+a sharded drift from any of them is caught here.
+
+Also covered: the replica-axis sharding of the cross-run batcher
+(``sched/batch.py`` ``mesh=``), the ``enable_sharding`` policy tier in
+``sched/tpu.py`` (per-tick and full-DES parity, validation), and the
+ensemble replica-shard divisibility guard.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from test_two_phase import CA_MODES, contended_inputs, make_inputs
+
+from pivot_tpu.ops.kernels import (
+    best_fit_kernel,
+    cost_aware_kernel,
+    first_fit_kernel,
+    opportunistic_kernel,
+)
+from pivot_tpu.ops.shard import (
+    best_fit_kernel_sharded,
+    cost_aware_kernel_sharded,
+    first_fit_kernel_sharded,
+    opportunistic_kernel_sharded,
+    sharded_fused_tick_run,
+)
+from pivot_tpu.ops.tickloop import (
+    fused_tick_run,
+    reference_tick_run,
+    span_bucket,
+)
+from pivot_tpu.parallel.mesh import host_sharded_mesh, replica_mesh
+
+MESH = host_sharded_mesh(8)
+
+#: The phase-2 modes every sharded output is held against (each is
+#: bit-identical to the others by the two-phase contract; asserting all
+#: three pins the sharded pass to the whole family).
+PHASE2_MODES = ("scan", "slim", 8)
+
+
+def _live_mask(H, seed=0):
+    rng = np.random.default_rng(seed)
+    live = np.ones(H, bool)
+    live[rng.choice(H, size=max(H // 4, 1), replace=False)] = False
+    return jnp.asarray(live)
+
+
+def _assert_pair(name, single, sharded):
+    p_s, a_s = single
+    p_h, a_h = sharded
+    assert np.array_equal(np.asarray(p_s), np.asarray(p_h)), (
+        name, np.asarray(p_s)[:12].tolist(), np.asarray(p_h)[:12].tolist()
+    )
+    assert np.array_equal(np.asarray(a_s), np.asarray(a_h)), (name, "avail")
+
+
+def _sweep_policy(policy, x, phase2_modes=PHASE2_MODES, live_opts=(None, "m"),
+                  ca_modes=(CA_MODES[0], CA_MODES[4]),
+                  sharded_phase2=("auto", 8)):
+    """One policy's sharded output vs the single-device kernel in every
+    requested phase-2 mode × live option.  The sharded pass runs once
+    per (live option, sharded mode) — ``"auto"`` is the per-step pass,
+    an int the sharded chunk commit — and each single-device mode's
+    oracle output is compared against every sharded mode's (all are
+    bit-identical by contract, so the comparison is all-pairs)."""
+    H = int(x["avail"].shape[0])
+    ca_args = (x["avail"], x["dem"], x["valid"], x["ng"], x["az"], x["cost"],
+               x["bw"], x["hz"], x["counts"])
+    for lv_opt in live_opts:
+        lv = _live_mask(H) if lv_opt else None
+        if policy == "opportunistic":
+            shardeds = {
+                sp2: opportunistic_kernel_sharded(
+                    MESH, x["avail"], x["dem"], x["valid"], x["u"],
+                    phase2=sp2, live=lv,
+                ) for sp2 in sharded_phase2
+            }
+            singles = {
+                p2: opportunistic_kernel(
+                    x["avail"], x["dem"], x["valid"], x["u"], phase2=p2,
+                    live=lv,
+                ) for p2 in phase2_modes
+            }
+        elif policy == "first_fit":
+            shardeds = {
+                sp2: first_fit_kernel_sharded(
+                    MESH, x["avail"], x["dem"], x["valid"],
+                    totals=x["totals"], phase2=sp2, live=lv,
+                ) for sp2 in sharded_phase2
+            }
+            singles = {
+                p2: first_fit_kernel(
+                    x["avail"], x["dem"], x["valid"], totals=x["totals"],
+                    phase2=p2, live=lv,
+                ) for p2 in phase2_modes
+            }
+        elif policy == "best_fit":
+            shardeds = {
+                sp2: best_fit_kernel_sharded(
+                    MESH, x["avail"], x["dem"], x["valid"],
+                    totals=x["totals"], phase2=sp2, live=lv,
+                ) for sp2 in sharded_phase2
+            }
+            singles = {
+                p2: best_fit_kernel(
+                    x["avail"], x["dem"], x["valid"], totals=x["totals"],
+                    phase2=p2, live=lv,
+                ) for p2 in phase2_modes
+            }
+        else:  # cost-aware, swept over ca_modes
+            for mode in ca_modes:
+                shardeds = {
+                    sp2: cost_aware_kernel_sharded(
+                        MESH, *ca_args, **mode, phase2=sp2, live=lv
+                    ) for sp2 in sharded_phase2
+                }
+                for p2 in phase2_modes:
+                    single = cost_aware_kernel(
+                        *ca_args, **mode, totals=x["totals"], phase2=p2,
+                        live=lv,
+                    )
+                    for sp2, sharded in shardeds.items():
+                        _assert_pair(
+                            f"ca:{mode}:{p2}:sh{sp2}:live={bool(lv_opt)}",
+                            single, sharded,
+                        )
+            continue
+        for p2, single in singles.items():
+            for sp2, sharded in shardeds.items():
+                _assert_pair(
+                    f"{policy}:{p2}:sh{sp2}:live={bool(lv_opt)}", single,
+                    sharded,
+                )
+
+
+# --------------------------------------------------------------------------
+# Kernel-level parity — the H=1024 acceptance (tier 1, one test per policy
+# to stay inside the per-test budget)
+# --------------------------------------------------------------------------
+
+
+def _h1024_inputs():
+    return make_inputs(11, T=96, H=1024, B=128, group_size=8)
+
+
+@pytest.mark.parametrize(
+    "policy", ["opportunistic", "first_fit", "best_fit", "cost_aware"]
+)
+def test_sharded_parity_h1024(policy):
+    """ISSUE-8 acceptance: sharded placement bit-identical to the
+    single-device oracle at H=1024 across {scan, slim, chunk} × live
+    masks, on the forced 8-device CPU mesh."""
+    _sweep_policy(policy, _h1024_inputs())
+
+
+def test_sharded_parity_contended_small():
+    """Adversarial single-fit contention (every task fits exactly one
+    host): the two-stage reduce must pick the SAME only-fit host the
+    flat argmin does, every step."""
+    x = contended_inputs(48, 16)
+    for policy in ("opportunistic", "first_fit", "best_fit", "cost_aware"):
+        _sweep_policy(policy, x, phase2_modes=("slim",),
+                      ca_modes=(CA_MODES[0], CA_MODES[3]))
+
+
+def test_sharded_parity_all_ca_flag_grid_small():
+    """Full cost-aware flag grid (both bin-packs × sort_hosts ×
+    host_decay) at a small shape — the H=1024 test restricts the grid to
+    bound compile count."""
+    x = make_inputs(5, T=40, H=64, B=64, group_size=5)
+    _sweep_policy("cost_aware", x, phase2_modes=("slim",),
+                  ca_modes=tuple(CA_MODES))
+
+
+def test_sharded_masked_hosts_excluded_and_untouched():
+    """Mask invariants under sharding: no placement lands on a masked
+    host, and masked hosts' availability rows pass through untouched."""
+    x = make_inputs(2, T=48, H=64, B=64, group_size=5)
+    live = _live_mask(64)
+    live_np = np.asarray(live)
+    p, a = first_fit_kernel_sharded(
+        MESH, x["avail"], x["dem"], x["valid"], live=live
+    )
+    placed = np.asarray(p)
+    placed = placed[placed >= 0]
+    assert live_np[placed].all()
+    assert np.array_equal(
+        np.asarray(a)[~live_np], np.asarray(x["avail"])[~live_np]
+    )
+
+
+def test_sharded_kernel_validation():
+    x = make_inputs(0, T=8, H=12, B=16)  # 12 % 8 != 0
+    with pytest.raises(ValueError, match="does not divide"):
+        first_fit_kernel_sharded(MESH, x["avail"], x["dem"], x["valid"])
+    x = make_inputs(0, T=8, H=16, B=16)
+    with pytest.raises(ValueError, match="phase2"):
+        first_fit_kernel_sharded(
+            MESH, x["avail"], x["dem"], x["valid"], phase2="bogus"
+        )
+    with pytest.raises(ValueError, match="realtime"):
+        cost_aware_kernel_sharded(
+            MESH, x["avail"], x["dem"], x["valid"], x["ng"], x["az"],
+            x["cost"], x["bw"], x["hz"][:16], x["counts"][:16],
+            rt_bw_rows=jnp.ones((2, 16)),
+            rt_bw_idx=jnp.zeros(16, jnp.int32),
+        )
+
+
+def test_sharded_empty_batch_passthrough():
+    x = make_inputs(0, T=0, H=16, B=0)
+    p, a = best_fit_kernel_sharded(MESH, x["avail"], x["dem"], x["valid"])
+    assert p.shape == (0,)
+    assert np.array_equal(np.asarray(a), np.asarray(x["avail"]))
+
+
+@pytest.mark.parametrize(
+    "policy", ["opportunistic", "first_fit", "best_fit", "cost_aware"]
+)
+def test_sharded_parity_sweep_full(policy):
+    """Slow full sweep: material T in the 2048 bucket at H=1024, all
+    chunk sizes, the wider cost-aware grid."""
+    x = make_inputs(3, T=600, H=1024, B=2048, group_size=16)
+    _sweep_policy(
+        policy, x, phase2_modes=("scan", "slim", 1, 64),
+        ca_modes=(CA_MODES[0], CA_MODES[3]),
+        sharded_phase2=("auto", 1, 64),
+    )
+
+
+# --------------------------------------------------------------------------
+# Sharded fused spans
+# --------------------------------------------------------------------------
+
+_H_SPAN, _B_SPAN = 16, 32
+_Z = 3
+
+_SPAN_CONFIGS = {
+    "opportunistic": dict(policy="opportunistic"),
+    "first_fit": dict(policy="first-fit", strict=False),
+    "first_fit_decreasing": dict(
+        policy="first-fit", strict=False, decreasing=True
+    ),
+    "best_fit": dict(policy="best-fit"),
+    "cost_aware_ff": dict(policy="cost-aware", bin_pack="first-fit",
+                          sort_tasks=True),
+    "cost_aware_bf_decay": dict(policy="cost-aware", bin_pack="best-fit",
+                                host_decay=True),
+}
+
+
+def _span_inputs(H, B, k_max, seed=0):
+    rng = np.random.default_rng(seed)
+    avail = rng.uniform(1, 6, (H, 4))
+    dem = rng.uniform(0.3, 2.5, (B, 4))
+    arrive = np.zeros(B, np.int32)
+    arrive[B - 12:B - 6] = 2
+    arrive[B - 6:] = 5
+    norms = np.sqrt((dem * dem).sum(1))
+    uniforms = jnp.asarray(rng.random((k_max, B)))
+    tables = dict(
+        cost_zz=jnp.asarray(rng.uniform(0.01, 0.2, (_Z, _Z))),
+        bw_zz=jnp.asarray(rng.uniform(50, 500, (_Z, _Z))),
+        host_zone=jnp.asarray(rng.integers(0, _Z, H), dtype=jnp.int32),
+        base_task_counts=jnp.asarray(
+            rng.integers(0, 3, H), dtype=jnp.int32
+        ),
+        anchor_zone=jnp.asarray(rng.integers(0, _Z, B).astype(np.int32)),
+        bucket_id=jnp.asarray(rng.integers(0, 5, B).astype(np.int32)),
+    )
+    return avail, dem, arrive, norms, uniforms, tables
+
+
+def _assert_span_parity(config_kw, n_ticks, H=_H_SPAN, B=_B_SPAN, live=None,
+                        seed=0, check_reference=True):
+    K = span_bucket(n_ticks)
+    avail, dem, arrive, norms, uniforms, tables = _span_inputs(
+        H, B, K, seed
+    )
+    kw = dict(config_kw)
+    kw["uniforms"] = uniforms if kw["policy"] == "opportunistic" else None
+    kw["sort_norm"] = jnp.asarray(norms)
+    if kw["policy"] == "cost-aware":
+        kw.update(tables)
+    kw["live"] = live
+    args = (jnp.asarray(avail), jnp.asarray(dem), jnp.asarray(arrive),
+            jnp.asarray(n_ticks, jnp.int32))
+    res_sh = sharded_fused_tick_run(MESH, *args, n_ticks=K, **kw)
+    res_1d = fused_tick_run(*args, n_ticks=K, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(res_sh.placements), np.asarray(res_1d.placements)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_sh.avail), np.asarray(res_1d.avail)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_sh.n_placed), np.asarray(res_1d.n_placed)
+    )
+    assert int(res_sh.ticks_run) == int(res_1d.ticks_run)
+    assert int(res_sh.n_stack_final) == int(res_1d.n_stack_final)
+    if check_reference:
+        ref_p, _nr, _np_, ref_avail = reference_tick_run(
+            avail, dem, arrive, K, **kw
+        )
+        np.testing.assert_array_equal(np.asarray(res_sh.placements), ref_p)
+        np.testing.assert_array_equal(np.asarray(res_sh.avail), ref_avail)
+
+
+@pytest.mark.parametrize("config", sorted(_SPAN_CONFIGS))
+def test_sharded_span_parity_quick(config):
+    """Tier-1: every span policy config, mid-span cohorts, sharded vs
+    the single-device driver vs the sequential referee."""
+    _assert_span_parity(_SPAN_CONFIGS[config], n_ticks=8)
+
+
+def test_sharded_span_live_mask_quick():
+    live = np.ones(_H_SPAN, bool)
+    live[3] = live[10] = False
+    _assert_span_parity(
+        _SPAN_CONFIGS["cost_aware_ff"], n_ticks=8, live=jnp.asarray(live)
+    )
+    _assert_span_parity(
+        _SPAN_CONFIGS["first_fit"], n_ticks=8, live=jnp.asarray(live)
+    )
+
+
+def test_sharded_span_h1024_quick():
+    """The acceptance span shape: H=1024 fused spans, sharded vs the
+    single-device driver (itself referee-pinned by test_tickloop)."""
+    _assert_span_parity(
+        _SPAN_CONFIGS["first_fit"], n_ticks=8, H=1024,
+        check_reference=False,
+    )
+
+
+@pytest.mark.fused
+@pytest.mark.parametrize("config", sorted(_SPAN_CONFIGS))
+@pytest.mark.parametrize("n_ticks", [1, 2, 4, 8, 16])
+def test_sharded_span_parity_sweep_full(config, n_ticks):
+    """Slow K-sweep across every span policy config."""
+    _assert_span_parity(_SPAN_CONFIGS[config], n_ticks)
+
+
+# --------------------------------------------------------------------------
+# Replica-axis batcher sharding (sched/batch.py mesh=)
+# --------------------------------------------------------------------------
+
+
+def _ca_requests(n, H=16, T=12):
+    from conftest import load_root_module
+
+    bench = load_root_module("bench")
+    reqs = []
+    for g in range(n):
+        ctx = bench._build_batch(H, T, seed=g)
+        topo, dem, valid, ng, az = bench._cost_aware_tick_args(ctx, rng_seed=g)
+        counts = np.zeros(H, dtype=np.int32)
+        topo_np = tuple(
+            np.asarray(a) for a in (topo.cost, topo.bw, topo.host_zone)
+        )
+        reqs.append((
+            (ctx.avail.astype(np.float64), dem.astype(np.float64), valid,
+             ng, az) + topo_np + (counts,),
+            {},
+        ))
+    return reqs
+
+
+def test_batch_execute_replica_mesh_parity():
+    """A mesh-sharded coalesced flush is bit-identical to the unsharded
+    vmap program row for row; a group whose bucket does not divide the
+    replica axis falls back (still bit-identical)."""
+    from pivot_tpu.sched.batch import batch_execute
+
+    mesh = replica_mesh(8)
+    mode = dict(bin_pack="first-fit", sort_hosts=True, host_decay=False)
+    reqs = _ca_requests(8)
+    plain = [p for p, _ in batch_execute(cost_aware_kernel, reqs, mode)]
+    sharded = [
+        p for p, _ in batch_execute(cost_aware_kernel, reqs, mode, mesh=mesh)
+    ]
+    for r, (a, b) in enumerate(zip(plain, sharded)):
+        assert np.array_equal(a, b), r
+    # 3 requests pad to the 4-bucket, which 8 does not divide → fallback.
+    reqs3 = reqs[:3]
+    plain3 = [p for p, _ in batch_execute(cost_aware_kernel, reqs3, mode)]
+    fall3 = [
+        p for p, _ in batch_execute(cost_aware_kernel, reqs3, mode, mesh=mesh)
+    ]
+    for r, (a, b) in enumerate(zip(plain3, fall3)):
+        assert np.array_equal(a, b), r
+
+
+def test_replica_mesh_for_divisibility():
+    from pivot_tpu.sched.batch import _replica_mesh_for
+
+    mesh = replica_mesh(8)
+    assert _replica_mesh_for(None, 8) is None
+    assert _replica_mesh_for(mesh, 1) is None
+    assert _replica_mesh_for(mesh, 4) is None  # 4 % 8 != 0
+    assert _replica_mesh_for(mesh, 8) is mesh
+    assert _replica_mesh_for(mesh, 16) is mesh
+    half = replica_mesh(2)
+    assert _replica_mesh_for(half, 4) is half
+
+
+# --------------------------------------------------------------------------
+# Policy tier (sched/tpu.py enable_sharding)
+# --------------------------------------------------------------------------
+
+
+def _bench_ctx(H, T, seed=3):
+    from conftest import load_root_module
+
+    return load_root_module("bench")._build_batch(H, T, seed=seed)
+
+
+def test_policy_enable_sharding_place_parity():
+    """``enable_sharding`` serves bit-identical placements through the
+    full policy path (grouping, padding, staging, unpadding)."""
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+
+    ctx = _bench_ctx(64, 40)
+    single = TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True)
+    single.bind(ctx.scheduler)
+    p_single = single.place(ctx)
+
+    ctx2 = _bench_ctx(64, 40)
+    sharded = TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True)
+    sharded.enable_sharding(MESH)
+    sharded.bind(ctx2.scheduler)
+    p_sharded = sharded.place(ctx2)
+    np.testing.assert_array_equal(p_single, p_sharded)
+
+
+def test_enable_sharding_validation():
+    from pivot_tpu.sched.batch import DispatchBatcher
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy, TpuFirstFitPolicy
+
+    with pytest.raises(ValueError, match="adaptive"):
+        TpuFirstFitPolicy(adaptive=True).enable_sharding(MESH)
+    with pytest.raises(ValueError, match="Pallas"):
+        TpuCostAwarePolicy(use_pallas=True).enable_sharding(MESH)
+    with pytest.raises(ValueError, match="realtime"):
+        TpuCostAwarePolicy(realtime_bw=True).enable_sharding(MESH)
+    # Sharding and cross-run batching are mutually exclusive, both ways.
+    batcher = DispatchBatcher(1)
+    pol = TpuFirstFitPolicy()
+    pol.enable_batching(batcher.client())
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        pol.enable_sharding(MESH)
+    pol2 = TpuFirstFitPolicy()
+    pol2.enable_sharding(MESH)
+    with pytest.raises(ValueError, match="replica axis"):
+        pol2.enable_batching(DispatchBatcher(1).client())
+    # H must divide the host axis — caught at bind.
+    pol3 = TpuFirstFitPolicy()
+    pol3.enable_sharding(MESH)
+    ctx = _bench_ctx(12, 8)  # 12 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        pol3.bind(ctx.scheduler)
+
+
+def test_policy_sharded_des_full_sim_parity():
+    """End to end: a full DES simulation with the sharded tier (fused
+    spans on) is bit-identical to the single-device run — placements,
+    app end times, tick counts, meter totals — and spans engage."""
+    from test_tickloop import _build_cluster, _chain_apps
+
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.infra.meter import Meter
+    from pivot_tpu.sched import GlobalScheduler
+    from pivot_tpu.sched.tpu import TpuFirstFitPolicy
+    from pivot_tpu.utils import reset_ids
+
+    def run(mesh):
+        reset_ids()
+        env = Environment()
+        meta = ResourceMetadata(seed=0)
+        meter = Meter(env, meta)
+        cluster = _build_cluster(env, meter, n_hosts=8)
+        policy = TpuFirstFitPolicy()
+        if mesh is not None:
+            policy.enable_sharding(mesh)
+        sched = GlobalScheduler(
+            env, cluster, policy, seed=3, meter=meter, fuse_spans=True
+        )
+        cluster.start()
+        sched.start()
+        apps = _chain_apps(2)
+        for a in apps:
+            sched.submit(a)
+        sched.stop()
+        env.run()
+        placements = sorted(
+            (t.id, t.placement)
+            for a in apps for g in a.groups for t in g.tasks
+        )
+        return (
+            placements,
+            [a.end_time for a in apps],
+            sched._tick_seq,
+            meter.total_scheduling_ops,
+            env.now,
+        ), sched.span_stats
+
+    sharded, stats = run(MESH)
+    plain, _ = run(None)
+    assert sharded == plain
+    assert stats["fused_spans"] > 0 or stats["ff_ticks"] > 0
+
+
+def test_sharded_rollout_divisibility_error():
+    """The ensemble replica axis must divide the mesh's replica shards —
+    eager, friendly error instead of a mid-program XLA failure."""
+    from pivot_tpu.parallel.ensemble import sharded_rollout
+
+    mesh = replica_mesh(8)
+    with pytest.raises(ValueError, match="replica shards"):
+        sharded_rollout(
+            mesh, None, None, None, None, None, n_replicas=12
+        )

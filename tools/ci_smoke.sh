@@ -9,7 +9,13 @@
 #   3. chaos replay determinism against the COMMITTED seed schedule
 #      (data/chaos/ci_seed.json): regenerating the schedule from its
 #      seed must reproduce it bit-for-bit, and two replays of it must
-#      produce identical audit reports.
+#      produce identical audit reports;
+#   4. sharded-placement parity on a forced 8-device CPU mesh (round
+#      10): the host-sharded kernels and span driver must stay
+#      bit-identical to the single-device oracles without any TPU in
+#      the loop — the quick tier-1 twins of tests/test_shard.py, with
+#      the device-count flag pinned here explicitly so the lane stays
+#      self-contained even if conftest's pin moves.
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -21,14 +27,14 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/3] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/4] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/3] hot-path host-sync lint =="
+echo "== [2/4] hot-path host-sync lint =="
 python tools/hotpath_lint.py
 
-echo "== [3/3] chaos replay determinism on the committed seed =="
+echo "== [3/4] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -42,5 +48,14 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
 python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
+
+echo "== [4/4] sharded-placement parity on a forced 8-device CPU mesh =="
+# Small-H quick twins + the H=1024 acceptance + the sharded span driver:
+# bit-parity with the single-device oracles, exercised on every run
+# without a TPU.  (conftest pins the same mesh; the explicit flag keeps
+# this lane standalone.)
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+python -m pytest tests/test_shard.py tests/test_mesh.py -q -m 'not slow' \
+    -k 'parity or span or mesh' -p no:cacheprovider
 
 echo "smoke lane: all green"

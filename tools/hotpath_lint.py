@@ -41,7 +41,15 @@ from typing import Dict, List, NamedTuple, Sequence
 #: Registered hot paths: repo-relative file → function names whose whole
 #: bodies must stay host-sync-free.
 DEFAULT_TARGETS: Dict[str, Sequence[str]] = {
-    "pivot_tpu/ops/tickloop.py": ["_fused_tick_run_impl"],
+    "pivot_tpu/ops/tickloop.py": [
+        "_fused_tick_run_impl",
+        # Span slot-axis algebra shared with the sharded driver (round
+        # 10 factoring) — still loop-body code, still host-sync-banned.
+        "_span_ready_batch",
+        "_span_stream_order",
+        "_span_group_entries",
+        "_span_requeue",
+    ],
     "pivot_tpu/ops/kernels.py": [
         "opportunistic_impl",
         "first_fit_impl",
@@ -54,6 +62,36 @@ DEFAULT_TARGETS: Dict[str, Sequence[str]] = {
         "_slim_drive",
         "_chunk_drive",
         "_speculate_commit",
+        # Shared cost-aware phase-1/score helpers (used by the sharded
+        # kernels too).
+        "_ca_phase1",
+        "_ca_group_score",
+        "_ca_best_fit_score",
+    ],
+    # Round 10: the host-sharded kernel bodies and the shard_map
+    # two-stage reduce — a host sync here would serialize every
+    # sequential step across the whole mesh, the worst possible place
+    # for the floor to creep back in.
+    "pivot_tpu/ops/shard.py": [
+        "_two_stage_argmin",
+        "_two_stage_argmin_rows",
+        "_first_index_of",
+        "_first_index_of_rows",
+        "_opportunistic_pick",
+        "_opportunistic_pick_rows",
+        "_place_local",
+        "_bump_local",
+        "_carry_free_sharded_pass",
+        "_opportunistic_sharded_pass",
+        "_first_fit_sharded_pass",
+        "_best_fit_sharded_pass",
+        "_cost_aware_sharded_pass",
+        "_sharded_chunk_drive",
+        "_opportunistic_sharded_chunk",
+        "_first_fit_sharded_chunk",
+        "_best_fit_sharded_chunk",
+        "_cost_aware_sharded_chunk_pass",
+        "_sharded_span_body",
     ],
     "pivot_tpu/parallel/ensemble/tick.py": ["_rollout_segment"],
 }

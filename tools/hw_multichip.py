@@ -1,0 +1,277 @@
+"""Round-6 multichip capture: the real sharded-placement run.
+
+Rounds 1–5 filled the ``MULTICHIP_r0N.json`` slots with
+``dryrun_multichip`` placeholder output — compile-and-partition smoke of
+GSPMD annotations around the *single-device* kernel.  Round 10 shipped
+the real thing (``ops/shard.py``: shard-resident [H, 4] carry, two-stage
+argmin reduce, sharded chunk commit, sharded span driver), so this
+campaign captures what the artifact slot always wanted:
+
+  * **parity flag** — sharded placement vs the single-device oracle at
+    H=1024, all four policies × sharded phase-2 modes (per-step AND
+    chunk commit) × live masks, plus the sharded fused-span driver vs
+    the single-device span driver and the sequential referee.  Bitwise.
+  * **scale curve** — decisions/s at H ∈ {4k, 16k, 64k, 102k} on the
+    8-shard mesh: the Borg-cell ladder (Verma et al., PAPERS.md) whose
+    upper rungs have no single-chip arm at all in this repo's history.
+
+One JSON document on stdout AND written to ``MULTICHIP_r06.json`` at the
+repo root.  The measuring child runs on a pinned 8-virtual-device CPU
+mesh (``--xla_force_host_platform_device_count``, read once per process
+— hence the parent/child split); its stderr tail is recorded with the
+XLA:CPU AOT feature-mismatch spam filtered out
+(``pivot_tpu.utils.filter_xla_aot_noise``) so the artifact tail carries
+signal, not portability matrices.
+
+Usage: python tools/hw_multichip.py [--devices 8] [--quick] [--no-write]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+#: The Borg-cell scale ladder (total hosts over the mesh) — every value
+#: divides the 8-shard mesh; 102400 is the 100k+ rung.
+SCALE_H = (4096, 16384, 65536, 102400)
+
+
+# ---------------------------------------------------------------------------
+# Child: runs pinned to the virtual CPU mesh, prints one JSON line
+# ---------------------------------------------------------------------------
+
+
+def _mask(H, rng):
+    import numpy as np
+
+    live = np.ones(H, bool)
+    live[rng.choice(H, size=H // 4, replace=False)] = False
+    return live
+
+
+def _parity(n_devices: int, quick: bool) -> dict:
+    """Bitwise sharded-vs-oracle parity at H=1024 (the tests' bar),
+    re-asserted in this capture process so the artifact flag is a
+    measurement, not a pointer at CI."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from pivot_tpu.ops import kernels as K
+    from pivot_tpu.ops import shard as S
+    from pivot_tpu.parallel.mesh import host_sharded_mesh
+
+    mesh = host_sharded_mesh(n_devices)
+    H, T, B, Z = 1024, 96, 128, 7
+    rng = np.random.default_rng(5)
+    avail = jnp.asarray(rng.uniform(1, 8, (H, 4)).astype(np.float32))
+    dem = np.zeros((B, 4), np.float32)
+    dem[:T] = rng.uniform(0.2, 2.0, (T, 4))
+    dem = jnp.asarray(dem)
+    valid = jnp.asarray(np.arange(B) < T)
+    u = jnp.asarray(rng.random(B).astype(np.float32))
+    ng = jnp.asarray((np.arange(B) % 8 == 0) & (np.arange(B) < T))
+    az = jnp.asarray((rng.integers(0, Z, B)).astype(np.int32))
+    cost = jnp.asarray(rng.uniform(0, 0.1, (Z, Z)).astype(np.float32))
+    bw = jnp.asarray(rng.uniform(50, 500, (Z, Z)).astype(np.float32))
+    hz = jnp.asarray((np.arange(H) % Z).astype(np.int32))
+    counts = jnp.asarray(rng.integers(0, 3, H).astype(np.int32))
+    live = jnp.asarray(_mask(H, rng))
+
+    pairs = []  # (name, single_fn(phase2, live), sharded_fn(phase2, live))
+    pairs.append((
+        "opportunistic",
+        lambda p2, lv: K.opportunistic_kernel(
+            avail, dem, valid, u, phase2=p2, live=lv),
+        lambda p2, lv: S.opportunistic_kernel_sharded(
+            mesh, avail, dem, valid, u, phase2=p2, live=lv),
+    ))
+    pairs.append((
+        "first-fit",
+        lambda p2, lv: K.first_fit_kernel(
+            avail, dem, valid, phase2=p2, live=lv),
+        lambda p2, lv: S.first_fit_kernel_sharded(
+            mesh, avail, dem, valid, phase2=p2, live=lv),
+    ))
+    pairs.append((
+        "best-fit",
+        lambda p2, lv: K.best_fit_kernel(
+            avail, dem, valid, phase2=p2, live=lv),
+        lambda p2, lv: S.best_fit_kernel_sharded(
+            mesh, avail, dem, valid, phase2=p2, live=lv),
+    ))
+    ca = (dem, valid, ng, az, cost, bw, hz, counts)
+    for mode in (
+        dict(bin_pack="first-fit", sort_hosts=True, host_decay=False),
+        dict(bin_pack="best-fit", sort_hosts=False, host_decay=True),
+    ):
+        pairs.append((
+            f"cost-aware:{mode['bin_pack']}",
+            lambda p2, lv, m=mode: K.cost_aware_kernel(
+                avail, *ca, **m, phase2=p2, live=lv),
+            lambda p2, lv, m=mode: S.cost_aware_kernel_sharded(
+                mesh, avail, *ca, **m, phase2=p2, live=lv),
+        ))
+    if quick:
+        pairs = pairs[2:4]
+
+    checked, mismatches = 0, []
+    for name, single, sharded in pairs:
+        for lv in (None, live):
+            oracle = single("scan", lv)
+            for sp2 in ("auto", 8):
+                got = sharded(sp2, lv)
+                checked += 1
+                same = all(
+                    np.array_equal(np.asarray(a), np.asarray(b))
+                    for a, b in zip(oracle, got)
+                )
+                if not same:
+                    mismatches.append(f"{name}:sh{sp2}:live={lv is not None}")
+
+    # Sharded span driver vs single-device driver vs sequential referee.
+    from pivot_tpu.ops.tickloop import (
+        fused_tick_run,
+        reference_tick_run,
+        span_bucket,
+    )
+
+    Kt = span_bucket(6)
+    arrive = np.zeros(B, np.int32)
+    arrive[B - 16:] = 2
+    span_kw = dict(
+        policy="cost-aware", uniforms=None,
+        sort_norm=jnp.asarray(np.sqrt(np.asarray(dem * dem).sum(1))),
+        anchor_zone=az, bucket_id=jnp.asarray(
+            rng.integers(0, 4, B).astype(np.int32)),
+        cost_zz=cost, bw_zz=bw, host_zone=hz, base_task_counts=counts,
+        live=live, bin_pack="first-fit", sort_hosts=True, host_decay=False,
+    )
+    span_args = (avail, dem, jnp.asarray(arrive), jnp.asarray(6, jnp.int32))
+    r_sh = S.sharded_fused_tick_run(mesh, *span_args, n_ticks=Kt, **span_kw)
+    r_1d = fused_tick_run(*span_args, n_ticks=Kt, **span_kw)
+    ref_p, _nr, _np_, ref_avail = reference_tick_run(
+        np.asarray(avail), np.asarray(dem), arrive, Kt, **span_kw
+    )
+    checked += 1
+    if not (
+        np.array_equal(np.asarray(r_sh.placements), np.asarray(r_1d.placements))
+        and np.array_equal(np.asarray(r_sh.placements), ref_p)
+        and np.array_equal(np.asarray(r_sh.avail), np.asarray(r_1d.avail))
+        and np.array_equal(np.asarray(r_sh.avail), ref_avail)
+    ):
+        mismatches.append("span:cost-aware")
+    return {
+        "h": H, "t": T, "combos_checked": checked,
+        "ok": not mismatches,
+        **({"mismatches": mismatches} if mismatches else {}),
+    }
+
+
+def _scale_curve(n_devices: int, quick: bool) -> list:
+    """Best-fit sharded per-step decisions/s up the host ladder."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from pivot_tpu.ops.shard import best_fit_kernel_sharded
+    from pivot_tpu.parallel.mesh import host_sharded_mesh
+
+    mesh = host_sharded_mesh(n_devices)
+    T = B = 256
+    rng = np.random.default_rng(0)
+    dem = jnp.asarray(rng.uniform(0.1, 1.0, (B, 4)).astype(np.float32))
+    valid = jnp.asarray(np.ones(B, bool))
+    rows = []
+    ladder = SCALE_H[::3] if quick else SCALE_H
+    for H in ladder:
+        avail = jnp.asarray(rng.uniform(2, 16, (H, 4)).astype(np.float32))
+        call = lambda: best_fit_kernel_sharded(mesh, avail, dem, valid)[0]
+        int(np.asarray(call()).sum())  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            int(np.asarray(call()).sum())
+            best = min(best, time.perf_counter() - t0)
+        rows.append({
+            "h": H, "h_per_shard": H // n_devices, "t": T,
+            "wall_s": round(best, 5),
+            "decisions_per_s": round(T / best, 1),
+        })
+    return rows
+
+
+def _child(n_devices: int, quick: bool) -> None:
+    import jax
+
+    doc = {
+        "backend": jax.default_backend(),
+        "n_devices_seen": len(jax.devices()),
+        "parity": _parity(n_devices, quick),
+        "scale_curve": _scale_curve(n_devices, quick),
+    }
+    print(json.dumps(doc), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Parent: pins the child env, filters the tail, writes the artifact
+# ---------------------------------------------------------------------------
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--no-write", action="store_true",
+                    help="print the document only")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    ns = ap.parse_args()
+
+    if ns.child:
+        _child(ns.devices, ns.quick)
+        return
+
+    import subprocess
+
+    from pivot_tpu.utils import filter_xla_aot_noise, virtual_cpu_env
+
+    t0 = time.time()
+    env = dict(os.environ, **virtual_cpu_env(ns.devices))
+    argv = [sys.executable, os.path.abspath(__file__), "--child",
+            "--devices", str(ns.devices)]
+    if ns.quick:
+        argv.append("--quick")
+    doc = {"n_devices": ns.devices, "ok": False, "skipped": False}
+    try:
+        proc = subprocess.run(
+            argv, env=env, cwd=ROOT, capture_output=True, text=True,
+            timeout=1800,
+        )
+        doc["rc"] = proc.returncode
+        tail = filter_xla_aot_noise(proc.stderr)[-1500:]
+        if proc.returncode == 0:
+            child = json.loads(proc.stdout.strip().splitlines()[-1])
+            doc.update(child)
+            doc["ok"] = bool(child.get("parity", {}).get("ok"))
+        doc["tail"] = tail
+    except Exception as exc:  # noqa: BLE001 — partial artifacts count
+        doc["rc"] = -1
+        doc["tail"] = f"{type(exc).__name__}: {exc}"[:600]
+    doc["wall_s"] = round(time.time() - t0, 1)
+    out = json.dumps(doc, indent=2)
+    print(out)
+    if not ns.no_write:
+        path = os.path.join(ROOT, "MULTICHIP_r06.json")
+        with open(path, "w") as f:
+            f.write(out + "\n")
+    sys.exit(0 if doc["ok"] else 2)
+
+
+if __name__ == "__main__":
+    main()
