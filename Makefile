@@ -5,8 +5,10 @@
 
 # The per-PR resilience gate: quick chaos soak, hot-path host-sync
 # lint, chaos replay determinism against the committed seed
-# (data/chaos/ci_seed.json), and sharded-placement parity on a forced
-# 8-device CPU mesh.  ~2 minutes; see tools/ci_smoke.sh.
+# (data/chaos/ci_seed.json), sharded-placement parity on a forced
+# 8-device CPU mesh, and the spot-market survival soak + market replay
+# determinism against data/market/ci_seed.json.  ~3 minutes; see
+# tools/ci_smoke.sh.
 smoke:
 	tools/ci_smoke.sh
 

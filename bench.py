@@ -983,6 +983,100 @@ def _bench_serve_tiers(
     }
 
 
+def _bench_spot_survival(
+    n_hosts: int = 12,
+    seed: int = 3,
+    n_apps: int = 10,
+    risk_weight: float = 1.0,
+    rework_cost: float = 50.0,
+) -> dict:
+    """Spot-market survival row (round 11, ``infra/market.py``): the
+    same seeded :class:`MarketSchedule` — discounted-but-hazardous spot
+    zones next to calm on-demand ones, piecewise-constant prices and
+    hazards — played by three arms of the cost-aware scheduler over the
+    IDENTICAL hazard-drawn preemption plan:
+
+      * ``hazard_blind`` — risk_weight 0, reactive recovery only (the
+        pre-market scheduler: packs onto the cheap/evictable pool);
+      * ``proactive_only`` — still hazard-blind at placement, but the
+        preemption warning triggers drain → migrate → restart
+        (``GlobalScheduler.on_preempt_warning``): isolates what the
+        survival machinery alone buys;
+      * ``risk_aware`` — risk term in every score AND proactive drain
+        (the Bamboo/SpotServe shape).
+
+    Headline columns: cost per completed task (price-trace-integrated
+    instance cost + egress over completions), dead-letter rate, wasted
+    rework seconds.  ``meets_survival`` asserts the acceptance
+    inequality — risk_aware strictly below hazard_blind on BOTH
+    headline metrics.  Pure-DES row: runs identically on any backend.
+    """
+    from pivot_tpu.experiments.spot import run_spot_arm, spot_market
+
+    market = spot_market(n_hosts, seed=seed)
+    kw = dict(n_hosts=n_hosts, seed=seed, n_apps=n_apps)
+    n_preemptions = {}
+
+    def arm(label, **extra):
+        r = run_spot_arm(market, **kw, **extra)
+        n_preemptions[label] = r["n_preemptions"]
+        cpt = r["cost_per_completed_task"]  # None when nothing completed
+        return {
+            "cost_per_completed_task": (
+                round(cpt, 6) if cpt is not None else None
+            ),
+            "dead_letter_rate": round(r["dead_letter_rate"], 4),
+            "completed": r["n_completed_tasks"],
+            "tasks": r["n_tasks"],
+            "rework_seconds": round(r["rework_seconds"], 1),
+            "instance_cost": round(r["instance_cost"], 5),
+            "egress_cost": round(r["egress_cost"], 5),
+            "n_migrated": r["n_migrated"],
+            "n_proactive_restarts": r["n_proactive_restarts"],
+            "audit_violations": r["audit_violations"],
+        }
+
+    blind = arm("hazard_blind")
+    proactive = arm("proactive_only", proactive=True)
+    aware = arm(
+        "risk_aware", risk_weight=risk_weight, rework_cost=rework_cost,
+        proactive=True,
+    )
+    # Identical across arms by construction (the plan is a pure function
+    # of topology × market × seed); a divergence makes the three-way
+    # comparison unattributable, so it fails meets_survival outright.
+    plans_identical = len(set(n_preemptions.values())) == 1
+    return {
+        "h": n_hosts,
+        "apps": n_apps,
+        "plans_identical": plans_identical,
+        "n_preemptions_planned": (
+            n_preemptions["hazard_blind"]
+            if plans_identical
+            else n_preemptions
+        ),
+        "hot_zones": len(market.meta.get("hot_zones", [])),
+        "risk_weight": risk_weight,
+        "rework_cost": rework_cost,
+        "hazard_blind": blind,
+        "proactive_only": proactive,
+        "risk_aware": aware,
+        "meets_survival": bool(
+            plans_identical
+            and aware["cost_per_completed_task"] is not None
+            and blind["cost_per_completed_task"] is not None
+            and aware["cost_per_completed_task"]
+            < blind["cost_per_completed_task"]
+            and aware["dead_letter_rate"] < blind["dead_letter_rate"]
+        ),
+        "audits_clean": not (
+            blind["audit_violations"]
+            or proactive["audit_violations"]
+            or aware["audit_violations"]
+        ),
+    }
+
+
 def _child_backend_setup():
     """Shared child preamble: apply the parent's ``PIVOT_BENCH_BACKEND``
     override explicitly (ignoring it would silently contradict the
@@ -1601,6 +1695,13 @@ def main() -> None:
         fused_tick = _bench_fused_tick()
     except Exception as exc:  # noqa: BLE001 — row-level isolation
         fused_tick = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    # Round-11 acceptance row: the spot-market survival game — pure DES
+    # (CPU policies, no device dispatch), so it measures the same thing
+    # on every backend.
+    try:
+        spot_survival = _bench_spot_survival()
+    except Exception as exc:  # noqa: BLE001 — row-level isolation
+        spot_survival = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     if backend != "tpu":
         # The Pallas variants cannot run on the fallback backend, so the
         # official record would otherwise exercise one kernel (VERDICT
@@ -1682,6 +1783,7 @@ def main() -> None:
         "serve_stream": serve_stream,
         "serve_tiers": serve_tiers,
         "shard_place": shard_place,
+        "spot_survival": spot_survival,
         **(
             {"ensemble_saturated": ens_saturated} if ens_saturated else {}
         ),

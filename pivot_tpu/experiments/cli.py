@@ -474,6 +474,13 @@ def parse_args(argv=None):
     sens.add_argument("--des-seeds", type=int, default=5,
                       help="paired (gated vs baseline) DES runs at this "
                            "many consecutive seeds")
+    sens.add_argument("--market", default=None, metavar="FILE",
+                      help="attach a saved MarketSchedule "
+                           "(tools/market_replay.py generate): both arms "
+                           "score egress against the time-varying "
+                           "price-scaled cost tensor — the round-11 "
+                           "environment axis for the gate's "
+                           "sign-stability")
     srv = sub.add_parser(
         "serve",
         help="online serving layer: stream Poisson/trace job arrivals "
@@ -997,6 +1004,11 @@ def run_sensitivity(args) -> dict:
 
     trace = _list_traces(args.job_dir, 1)[0]
     policy_name = getattr(args, "policy", "cost-aware")
+    market = None
+    if getattr(args, "market", None):
+        from pivot_tpu.infra.market import MarketSchedule
+
+        market = MarketSchedule.load(args.market)
     # Recorded in the report: a reader comparing against the calibrate /
     # overall arms must be able to see which packing variant ran (VBP is
     # first-fit DEcreasing per the reference, config.py:111; best-fit's
@@ -1037,6 +1049,7 @@ def run_sensitivity(args) -> dict:
             cluster, pol, trace,
             output_size_scale_factor=args.scale_factor,
             n_apps=args.num_apps, seed=seed, interval=5.0,
+            market=market,
         )
         t0 = time.perf_counter()
         summary = run.run()
@@ -1080,6 +1093,8 @@ def run_sensitivity(args) -> dict:
     report = {
         "trace": trace,
         "policy": policy_name,
+        **({"market": os.path.abspath(args.market)}
+           if getattr(args, "market", None) else {}),
         **({"decreasing": decreasing} if decreasing is not None else {}),
         "n_hosts": args.n_hosts,
         "n_apps": args.num_apps,

@@ -19,6 +19,7 @@ reference pays once per OS process.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Optional
@@ -211,6 +212,7 @@ class ExperimentRun(LogMixin):
         identity: Optional[dict] = None,
         audit: bool = False,
         schedule: Optional[TraceSchedule] = None,
+        market=None,
     ):
         self.label = label
         self.cluster = cluster
@@ -238,6 +240,11 @@ class ExperimentRun(LogMixin):
         self.tracer: Optional[Tracer] = None
         self.identity = identity
         self.audit = audit
+        #: Optional spot-market environment (``infra/market.py``):
+        #: attached to the scheduler so placement scores with the
+        #: time-varying cost matrix and — for risk-aware policies — the
+        #: per-tick hazard vector.  None keeps the static world.
+        self.market = market
 
     def run_identity(self) -> dict:
         """What makes this run *this* run — compared on grid resume.
@@ -255,6 +262,13 @@ class ExperimentRun(LogMixin):
             "n_apps": self.n_apps,
             "seed": self.seed,
             "scale_factor": self.output_size_scale_factor,
+            # Content digest, not object identity: a market changes
+            # placements and costs, so a market-free and a market run of
+            # the same label must not compare as the same run.
+            "market": (
+                hashlib.sha256(self.market.dumps().encode()).hexdigest()
+                if self.market is not None else None
+            ),
         }
 
     def run(self) -> dict:
@@ -271,6 +285,7 @@ class ExperimentRun(LogMixin):
             meter=meter,
             tracer=self.tracer,
             fuse_spans=self.fuse_spans,
+            market=self.market,
         )
         if self._schedule is not None:
             schedule = self._schedule

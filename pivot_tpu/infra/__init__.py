@@ -264,14 +264,14 @@ class Host(Node):
                 # bandwidth from live transfers on shared routes.
                 for route, evt in zip(routes, done_events):
                     route.cancel(evt)
-                return self._conclude_aborted(task)
+                return self._conclude_aborted(task, pull_start)
             if meter:
                 self._record_transfer(task, preds, routes, pull_start)
 
         # Timed compute (stretched while the host straggles).
         fired = yield env.any_of([env.timeout(task.runtime * self.slowdown), abort])
         if fired is abort:
-            return self._conclude_aborted(task)
+            return self._conclude_aborted(task, pull_start)
 
         resource.release(group.cpus, group.mem, group.disk, group.gpus)
         self._tasks.discard(task)
@@ -298,14 +298,19 @@ class Host(Node):
                 return store.id
         return pred.placement
 
-    def _conclude_aborted(self, task: Task) -> bool:
-        """Host died under this task: no resource refund (the machine is
-        gone; ``recover`` resets capacity wholesale), but the meter interval
-        closes so instance-hours stay correct."""
+    def _conclude_aborted(self, task: Task, started: float) -> bool:
+        """This execution aborted mid-flight (host death, or a proactive
+        ``FastExecutor.evict_task``): no resource refund here — a dead
+        machine's capacity resets wholesale on ``recover``, and
+        ``evict_task`` refunds BEFORE triggering the abort — but the
+        meter interval closes so
+        instance-hours stay correct, and the wasted work since ``started``
+        is billed as rework (the spot-survival cost accounting)."""
         self._tasks.discard(task)
         self._aborts.pop(task, None)
         if self.meter:
             self.meter.host_check_out(self)
+            self.meter.add_rework(self.env.now - started)
         return False
 
     def fail(self) -> None:

@@ -184,14 +184,18 @@ def audit_conservation(scheduler, apps) -> List[str]:
     Returns human-readable violations (empty = the law holds).
     """
     violations: List[str] = []
+    # Keyed by (app, task): task ids are group-local ("src/1") and
+    # collide across apps — a bare-task_id ledger would count app A's
+    # dead letter against app B's finished twin of the same name.
     dead_ids = {}
     for entry in scheduler.dead_letters:
-        if entry.task_id in dead_ids:
+        key = (entry.app_id, entry.task_id)
+        if key in dead_ids:
             violations.append(
-                f"task {entry.task_id}: multiple dead-letter records "
-                "(terminated more than once)"
+                f"task {entry.task_id} (app {entry.app_id}): multiple "
+                "dead-letter records (terminated more than once)"
             )
-        dead_ids[entry.task_id] = entry
+        dead_ids[key] = entry
     retry = scheduler.retry
     if retry is not None:
         for entry in scheduler.dead_letters:
@@ -212,9 +216,10 @@ def audit_conservation(scheduler, apps) -> List[str]:
         for group in app.groups:
             for task in group.tasks:
                 state = task.state.value
+                key = (app.id, task.id)
                 if task.is_dead:
-                    seen_dead.add(task.id)
-                    if task.id not in dead_ids:
+                    seen_dead.add(key)
+                    if key not in dead_ids:
                         violations.append(
                             f"task {task.id}: DEAD with no dead-letter record"
                         )
@@ -224,9 +229,10 @@ def audit_conservation(scheduler, apps) -> List[str]:
                             f"{app.id} not marked failed"
                         )
                 elif task.is_finished:
-                    if task.id in dead_ids:
+                    if key in dead_ids:
                         violations.append(
-                            f"task {task.id}: both finished and dead-lettered"
+                            f"task {task.id} (app {app.id}): both finished "
+                            "and dead-lettered"
                         )
                 elif state in ("submitted", "running"):
                     violations.append(
@@ -238,10 +244,11 @@ def audit_conservation(scheduler, apps) -> List[str]:
                         f"task {task.id}: nascent in a live app after the "
                         "run drained (lost before placement)"
                     )
-    for task_id in dead_ids:
-        if task_id not in seen_dead:
+    for app_id, task_id in dead_ids:
+        if (app_id, task_id) not in seen_dead:
             violations.append(
-                f"dead-letter record for {task_id} but task not DEAD"
+                f"dead-letter record for {task_id} (app {app_id}) but "
+                "task not DEAD"
             )
     violations.extend(scheduler.placement_violations)
     return violations
@@ -279,6 +286,14 @@ def audit_meter(meter, at_end: bool = True) -> List[str]:
         if t < 0:
             violations.append(f"negative scheduling turnover {t:.6g}")
             break
+    # Rework accounting (spot survival): wasted task-seconds of aborted
+    # executions.  Per-TASK time, so concurrency can legitimately push it
+    # past the busy-interval wall clock (intervals merge co-resident
+    # tasks) — but it can never be negative, and a world with no aborts
+    # must bill zero rework.
+    rework = getattr(meter, "rework_seconds", 0.0)
+    if rework < 0:
+        violations.append(f"negative rework accounting {rework:.6g}")
     return violations
 
 

@@ -205,6 +205,20 @@ class FastExecutor(LogMixin):
         self.cluster.notify_q.put((True, task))
 
     # -- faults ------------------------------------------------------------
+    def _abort_exec(self, ex, task, host, now: float) -> None:
+        """Shared crash/eviction teardown for one resident execution:
+        cancel staging, close the meter interval, bill the wasted work as
+        rework, surface ``(False, task)`` to the governed retry loop."""
+        ex.aborted = True
+        for route, done in zip(ex.routes, ex.dones):
+            route.cancel(done)
+        host._tasks.discard(task)
+        if host.meter:
+            host.meter.host_check_out(host)
+            host.meter.add_rework(now - ex.pull_start)
+        ex.preds = ex.routes = ex.dones = ()
+        self.cluster.notify_q.put((False, task))
+
     def abort_host(self, host) -> None:
         """Host crashed: abort every resident execution (``Host.fail``)."""
         live = self._resident.pop(host.id, None)
@@ -217,14 +231,55 @@ class FastExecutor(LogMixin):
                 # event outruns the abort race — let the conclusion land.
                 self._resident.setdefault(host.id, {})[task] = ex
                 continue
-            ex.aborted = True
-            for route, done in zip(ex.routes, ex.dones):
-                route.cancel(done)
-            host._tasks.discard(task)
-            if host.meter:
-                host.meter.host_check_out(host)
-            ex.preds = ex.routes = ex.dones = ()
-            self.cluster.notify_q.put((False, task))
+            self._abort_exec(ex, task, host, now)
+
+    def evict_task(self, task, host) -> bool:
+        """Proactively abort ONE resident execution on a LIVE host — the
+        spot-drain restart path (``GlobalScheduler.on_preempt_warning``).
+        Unlike :meth:`abort_host`, the machine keeps running, so the
+        task's capacity IS refunded; the execution aborts exactly like a
+        crash otherwise (staging cancelled, meter interval closed, the
+        wasted work billed as rework, ``(False, task)`` surfaced for the
+        governed retry loop).  Returns False — and touches nothing —
+        when the task is not live here or its conclusion is already due
+        (evicting a completed execution would turn a free success into a
+        retry)."""
+        live = self._resident.get(host.id)
+        ex = live.get(task) if live else None
+        if ex is None or ex.aborted or not host.up:
+            return False
+        now = self.env.now
+        if ex.conclude_at is not None and ex.conclude_at <= now:
+            return False
+        group = task.group
+        host.resource.release(group.cpus, group.mem, group.disk, group.gpus)
+        live.pop(task, None)
+        if not live:
+            del self._resident[host.id]
+        self._abort_exec(ex, task, host, now)
+        return True
+
+    def evict_doomed(self, host, deadline: float) -> List:
+        """Evict every resident execution that provably cannot conclude
+        before ``deadline`` (the preemption abort instant): compute-phase
+        executions with ``conclude_at`` past it, and staging executions
+        whose compute alone would overrun.  Residents that fit inside
+        the lead are left to drain out.  Returns the evicted tasks."""
+        live = self._resident.get(host.id)
+        if not live:
+            return []
+        now = self.env.now
+        doomed = []
+        for task, ex in list(live.items()):
+            if ex.aborted:
+                continue
+            if ex.conclude_at is None:
+                eta = now + task.runtime * host.slowdown
+            else:
+                eta = ex.conclude_at
+            if eta > deadline and self.evict_task(task, host):
+                doomed.append(task)
+        return doomed
 
     # -- introspection -----------------------------------------------------
     def resident(self, host) -> List[Tuple[object, bool]]:

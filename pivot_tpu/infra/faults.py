@@ -66,7 +66,9 @@ from pivot_tpu.des import Environment
 from pivot_tpu.utils import LogMixin
 from pivot_tpu.utils.trace import NULL_TRACER, Tracer
 
-__all__ = ["ChaosEvent", "ChaosSchedule", "FaultInjector"]
+__all__ = [
+    "ChaosEvent", "ChaosSchedule", "FaultInjector", "check_schema_header",
+]
 
 
 class FaultInjector(LogMixin):
@@ -98,6 +100,18 @@ class FaultInjector(LogMixin):
         # cluster route hook (installed on first partition).
         self._partitions: set = set()
         self._partition_hook_installed = False
+        # Called with the Host at each spot-preemption WARNING instant
+        # (after ``Host.draining`` is set): the proactive-survival hook
+        # point — the scheduler registers its drain/migrate handler here
+        # (``GlobalScheduler.on_preempt_warning``).  Empty by default, so
+        # reactive worlds are untouched.
+        self._warning_hooks: List = []
+
+    def add_warning_hook(self, hook) -> None:
+        """Register ``hook(host, lead)`` to run at every spot-preemption
+        warning instant, after the host's drain flag is set (``lead`` is
+        the seconds until the abort fires)."""
+        self._warning_hooks.append(hook)
 
     # -- host faults -----------------------------------------------------
     def fail_host(self, host_id: str, at: float, duration: Optional[float] = None):
@@ -241,6 +255,8 @@ class FaultInjector(LogMixin):
                 "host", "preempt_warning", self.env.now, id=host.id,
                 lead=lead,
             )
+            for hook in self._warning_hooks:
+                hook(host, lead)
 
         self.env.schedule_callback_at(at, _warn)
         self.fail_host(host_id, at + lead, outage)
@@ -469,6 +485,22 @@ class FaultInjector(LogMixin):
 # ---------------------------------------------------------------------------
 
 
+def check_schema_header(d: dict, schema: str, version: int, kind: str):
+    """Validate the self-describing ``schema``/``schema_version`` header
+    shared by :class:`ChaosSchedule` and ``MarketSchedule`` files — one
+    implementation so the two loaders cannot drift.  Files without a
+    ``schema`` field (pre-round-11) are accepted; ``version`` is the
+    legacy fallback key."""
+    got = d.get("schema")
+    if got is not None and got != schema:
+        raise ValueError(
+            f"not a {kind} file: schema {got!r} (expected {schema!r})"
+        )
+    got_v = d.get("schema_version", d.get("version", 1))
+    if got_v != version:
+        raise ValueError(f"unsupported {kind} schema_version {got_v!r}")
+
+
 @dataclass(frozen=True)
 class ChaosEvent:
     """One fault in a :class:`ChaosSchedule`.
@@ -518,9 +550,21 @@ class ChaosEvent:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ChaosEvent":
+        # Eager schema validation: a malformed schedule file must fail at
+        # load with a message naming the broken event, not deep inside
+        # apply_schedule / replay (where a KeyError names nothing).
+        for key in ("kind", "at", "target"):
+            if key not in d:
+                raise ValueError(f"chaos event missing {key!r}: {d!r}")
+        try:
+            at = float(d["at"])
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"chaos event time must be a number, got {d['at']!r}"
+            ) from None
         return cls(
             kind=d["kind"],
-            at=float(d["at"]),
+            at=at,
             target=str(d["target"]),
             duration=(None if d.get("duration") is None else float(d["duration"])),
             lead=float(d.get("lead", 0.0)),
@@ -550,6 +594,7 @@ class ChaosSchedule:
     run to the original's fault log and final meter snapshot.
     """
 
+    SCHEMA = "chaos-schedule"
     VERSION = 1
 
     def __init__(
@@ -581,6 +626,13 @@ class ChaosSchedule:
     # -- (de)serialization -------------------------------------------------
     def to_dict(self) -> dict:
         return {
+            # Self-describing header (shared convention with
+            # MarketSchedule, via ``check_schema_header``): a chaos file
+            # handed to the market loader — or vice versa — fails at load
+            # with a schema message, not with an opaque shape error
+            # later.  ``version`` is kept for pre-round-11 files.
+            "schema": self.SCHEMA,
+            "schema_version": self.VERSION,
             "version": self.VERSION,
             "seed": self.seed,
             "meta": self.meta,
@@ -589,10 +641,7 @@ class ChaosSchedule:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ChaosSchedule":
-        if d.get("version", 1) != cls.VERSION:
-            raise ValueError(
-                f"unsupported ChaosSchedule version {d.get('version')!r}"
-            )
+        check_schema_header(d, cls.SCHEMA, cls.VERSION, "ChaosSchedule")
         return cls(
             [ChaosEvent.from_dict(e) for e in d.get("events", ())],
             seed=d.get("seed"),
@@ -616,11 +665,22 @@ class ChaosSchedule:
             return cls.loads(f.read())
 
     def diff(self, other: "ChaosSchedule") -> List[str]:
-        """Human-readable event diff (empty = identical fault plans)."""
-        mine = {e.describe() for e in self.events}
-        theirs = {e.describe() for e in other.events}
-        out = [f"- {d}" for d in sorted(mine - theirs)]
-        out += [f"+ {d}" for d in sorted(theirs - mine)]
+        """Human-readable event diff (empty = identical fault plans).
+        Multiplicity-aware: a plan with an event twice vs once IS a
+        diff (a set-based compare would silently call them identical)."""
+        def counted(events) -> Dict[str, int]:
+            out: Dict[str, int] = {}
+            for e in events:
+                key = e.describe()
+                out[key] = out.get(key, 0) + 1
+            return out
+
+        mine, theirs = counted(self.events), counted(other.events)
+        out = []
+        for key in sorted(set(mine) | set(theirs)):
+            n_m, n_t = mine.get(key, 0), theirs.get(key, 0)
+            out += [f"- {key}"] * max(n_m - n_t, 0)
+            out += [f"+ {key}"] * max(n_t - n_m, 0)
         return out
 
     # -- generation --------------------------------------------------------

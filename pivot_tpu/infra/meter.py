@@ -48,6 +48,12 @@ class Meter(LogMixin):
         self._data_transfers: List[dict] = []
         self._sched_turnovers: List[float] = []
         self._n_sched_ops = 0
+        # Wasted sim-seconds of aborted executions (host crashes, spot
+        # preemptions, proactive evictions) — the rework half of the
+        # spot-survival cost accounting.  Always inside some billed busy
+        # interval, so rework is a breakdown of instance-hours, never an
+        # addition to them (audit_meter checks exactly that).
+        self._rework_s = 0.0
         # Native network engines whose per-route stats replace per-slot
         # logs (``NativeNetworkEngine.metered_route_stats``).
         self._native_sources: List[object] = []
@@ -187,6 +193,19 @@ class Meter(LogMixin):
     def increment_scheduling_ops(self, n_ops: int) -> None:
         self._n_sched_ops += n_ops
 
+    def add_rework(self, seconds: float) -> None:
+        """Sim-seconds of work an aborted execution wasted (staging +
+        compute since its admission) — fed by every abort path (crash,
+        spot abort, proactive eviction), both executor backends.
+        Accumulated unclamped: a negative delta is an accounting bug, and
+        ``audit_meter``'s negative-rework check is what must catch it."""
+        self._rework_s += float(seconds)
+
+    @property
+    def rework_seconds(self) -> float:
+        """Total wasted compute-seconds across aborted executions."""
+        return self._rework_s
+
     _USAGE_DIMS = {"cpus": 1, "mem": 2, "disk": 3, "gpus": 4}
 
     def _track_resource_usage(self, host) -> None:
@@ -245,6 +264,7 @@ class Meter(LogMixin):
         return {
             "egress_cost": self.total_network_traffic_cost,
             "cum_instance_hours": self.cumulative_instance_hours,
+            "rework_seconds": self._rework_s,
             "avg_congestion_delay": self.average_congestion_delay,
             "total_scheduling_ops": self._n_sched_ops,
             "avg_scheduling_turnover": self.average_scheduling_turnover,

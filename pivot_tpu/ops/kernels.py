@@ -183,6 +183,55 @@ def _norms(mat: jax.Array) -> jax.Array:
     return jnp.sqrt(jnp.sum(mat * mat, axis=-1))
 
 
+# -- the risk term (round 11, ``infra/market.py``) ---------------------------
+#
+# ``risk`` is the optional [H] eviction-risk penalty vector
+# (``risk_weight × hazard × rework_cost``, resolved host-side by
+# ``sched.policies.resolve_risk``).  It is fused into phase-1 scoring by
+# the SHARED cross-backend rule the CPU policies implement:
+#
+#   * score-based selections (best-fit residual, cost-aware scores) add
+#     it: ``score += risk``;
+#   * index-ordered selections (plain first-fit; cost-aware first-fit
+#     with ``sort_hosts=False``) replace the index order with the
+#     lexicographic ``(risk, host index)`` order — the masked argmin over
+#     a score of ``risk`` gives exactly this (ties → lowest index);
+#   * the opportunistic random choice restricts to the minimum-risk tier
+#     of fitting hosts (same Philox draw, narrower support).
+#
+# ``risk=None`` (the default everywhere) is the identity: no risk op is
+# traced, so all existing callers keep today's compiled programs — and
+# today's outputs — bit for bit.  The helpers below are the single
+# definition of each rule, shared by scan / slim / chunk forms and (via
+# import) the host-sharded kernels, so no two backends can drift.
+
+
+def _risk_restrict(fit, risk):
+    """Opportunistic rule: narrow ``fit`` ([H] or [C, H]) to its
+    minimum-risk tier (no-op when nothing fits: the masked min is +inf,
+    which no finite risk equals)."""
+    if risk is None:
+        return fit
+    rmin = jnp.min(_risk_key(fit, risk), axis=-1, keepdims=True)
+    return fit & (risk == rmin)
+
+
+def _risk_score(score, risk):
+    """Score rule: ``score += risk`` (broadcasts over a [C, H] block)."""
+    if risk is None:
+        return score
+    return score + risk
+
+
+def _risk_key(fit, risk):
+    """Index-order rule: the masked-argmin key for lexicographic
+    (risk, index) selection — +inf where nothing fits, so any argmin's
+    lowest-index tie-break yields exactly (risk, index) order over the
+    fitting set.  Shared by the flat scans, slim/chunk phase 2, and the
+    sharded two-stage reduces."""
+    return jnp.where(fit, risk, jnp.asarray(jnp.inf, risk.dtype))
+
+
 def _place(avail, demand, h, ok):
     """Decrement row ``h`` by ``demand`` when ``ok`` (no-op otherwise).
 
@@ -228,10 +277,11 @@ def _bump_count(counts, h, ok):
 # ---------------------------------------------------------------------------
 
 
-def _opportunistic_scan(avail, demands, valid, uniforms):
+def _opportunistic_scan(avail, demands, valid, uniforms, risk=None):
     def body(avail, x):
         demand, valid_i, u = x
         fit = _fits(avail, demand, strict=False) & valid_i
+        fit = _risk_restrict(fit, risk)
         n_fit = jnp.sum(fit)
         k = jnp.minimum((u * n_fit).astype(jnp.int32), n_fit - 1)
         rank = jnp.cumsum(fit)  # 1-based rank among fitting hosts
@@ -243,24 +293,30 @@ def _opportunistic_scan(avail, demands, valid, uniforms):
 
 
 @jax.jit
-def opportunistic_kernel_ref(avail, demands, valid, uniforms, live=None):
+def opportunistic_kernel_ref(avail, demands, valid, uniforms, live=None,
+                             risk=None):
     """Uniformly random fitting host per task (ref opportunistic.py:11-20).
 
     The k-th fitting host (k = ⌊u·n_fit⌋) is selected via a cumulative-sum
     rank match — no host list materialization.  ``live`` is the optional
-    [H] quarantine mask (:func:`_apply_live`).
+    [H] quarantine mask (:func:`_apply_live`); ``risk`` the optional [H]
+    eviction-risk vector (minimum-risk-tier rule, module comment above).
     Returns ([T] int32 placements, [H,4] new availability).
     """
     avail, restore = _apply_live(avail, live)
-    p, a = _opportunistic_scan(avail, demands, valid, uniforms)
+    p, a = _opportunistic_scan(avail, demands, valid, uniforms, risk)
     return p, restore(a)
 
 
-def _first_fit_scan(avail, demands, valid, strict):
+def _first_fit_scan(avail, demands, valid, strict, risk=None):
     def body(avail, x):
         demand, valid_i = x
         fit = _fits(avail, demand, strict) & valid_i
-        h = jnp.argmax(fit)
+        if risk is None:
+            h = jnp.argmax(fit)
+        else:
+            # Risk-aware first fit: lexicographic (risk, index) order.
+            h = jnp.argmin(_risk_key(fit, risk))
         ok = jnp.any(fit)
         return _place(avail, demand, h, ok), jnp.where(ok, h, -1).astype(jnp.int32)
 
@@ -268,20 +324,21 @@ def _first_fit_scan(avail, demands, valid, strict):
 
 
 @functools.partial(jax.jit, static_argnames=("strict",))
-def first_fit_kernel_ref(avail, demands, valid, strict=False, live=None):
+def first_fit_kernel_ref(avail, demands, valid, strict=False, live=None,
+                         risk=None):
     """Lowest-index fitting host per task (ref vbp.py:6-29)."""
     avail, restore = _apply_live(avail, live)
-    p, a = _first_fit_scan(avail, demands, valid, strict)
+    p, a = _first_fit_scan(avail, demands, valid, strict, risk)
     return p, restore(a)
 
 
-def _best_fit_scan(avail, demands, valid):
+def _best_fit_scan(avail, demands, valid, risk=None):
     big = jnp.asarray(jnp.inf, avail.dtype)
 
     def body(avail, x):
         demand, valid_i = x
         fit = _fits(avail, demand, strict=True) & valid_i
-        residual = _norms(avail - demand)
+        residual = _risk_score(_norms(avail - demand), risk)
         h = jnp.argmin(jnp.where(fit, residual, big))
         ok = jnp.any(fit)
         return _place(avail, demand, h, ok), jnp.where(ok, h, -1).astype(jnp.int32)
@@ -290,10 +347,10 @@ def _best_fit_scan(avail, demands, valid):
 
 
 @jax.jit
-def best_fit_kernel_ref(avail, demands, valid, live=None):
+def best_fit_kernel_ref(avail, demands, valid, live=None, risk=None):
     """Min residual-L2 host among strict fits (ref vbp.py:32-49)."""
     avail, restore = _apply_live(avail, live)
-    p, a = _best_fit_scan(avail, demands, valid)
+    p, a = _best_fit_scan(avail, demands, valid, risk)
     return p, restore(a)
 
 
@@ -312,6 +369,7 @@ def _cost_aware_scan(
     host_decay,
     rt_bw_rows,
     rt_bw_idx,
+    risk=None,
 ):
     H = avail.shape[0]
     big = jnp.asarray(jnp.inf, avail.dtype)
@@ -324,9 +382,12 @@ def _cost_aware_scan(
 
     def group_score(avail, cost_row, bw_row):
         if not sort_hosts:
+            if risk is not None:
+                # Index-ordered selection → lexicographic (risk, index).
+                return risk
             return jnp.arange(H, dtype=avail.dtype)  # identity host order
         decay = jnp.maximum(base_counts, 1.0) if host_decay else 1.0
-        return cost_row * decay / (_norms(avail) * bw_row)
+        return _risk_score(cost_row * decay / (_norms(avail) * bw_row), risk)
 
     def body(carry, x):
         avail, frozen_score, extra = carry
@@ -351,7 +412,7 @@ def _cost_aware_scan(
                 if host_decay
                 else 1.0
             )
-            per_task = cost_row * residual * decay / bw_row
+            per_task = _risk_score(cost_row * residual * decay / bw_row, risk)
             fit = _fits(avail, demand, strict=False) & valid_i
             h = jnp.argmin(jnp.where(fit, per_task, big))
         ok = jnp.any(fit)
@@ -394,6 +455,7 @@ def cost_aware_kernel_ref(
     rt_bw_rows=None,
     rt_bw_idx=None,
     live=None,
+    risk=None,
 ):
     """The PIVOT cost-aware placement (ref cost_aware.py:28-127), fused —
     the reference-shaped scan, retained as the parity oracle.
@@ -427,7 +489,7 @@ def cost_aware_kernel_ref(
     p, a = _cost_aware_scan(
         avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
         host_zone, base_task_counts, bin_pack, sort_hosts, host_decay,
-        rt_bw_rows, rt_bw_idx,
+        rt_bw_rows, rt_bw_idx, risk,
     )
     return p, restore(a)
 
@@ -696,18 +758,20 @@ def _chunk_drive(avail, demands, valid, n_eff, C, speculate, recheck):
 
 
 def opportunistic_impl(avail, demands, valid, uniforms, phase2="auto",
-                       live=None):
+                       live=None, risk=None):
     """Uniformly random fitting host per task (ref opportunistic.py:11-20),
     two-phase form — see the module docstring for the ``phase2`` modes.
     Bit-identical to :func:`opportunistic_kernel_ref` in every mode.
     No ``totals`` pre-filter input: the random choice has no fill model
     to steer, so the operand would be dead weight on the dispatch path.
-    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`).
+    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`);
+    ``risk`` the optional [H] eviction-risk vector (minimum-risk-tier
+    rule — same Philox draw, narrower support).
     Returns ([T] int32 placements, [H,4] new availability)."""
     mode = _resolve_phase2(phase2)
     avail, restore = _apply_live(avail, live)
     if mode == "scan":
-        p, a = _opportunistic_scan(avail, demands, valid, uniforms)
+        p, a = _opportunistic_scan(avail, demands, valid, uniforms, risk)
         return p, restore(a)
     B = demands.shape[0]
     if B == 0:
@@ -717,6 +781,7 @@ def opportunistic_impl(avail, demands, valid, uniforms, phase2="auto",
     if mode == "slim":
         def decide_row(avail, j, demand):
             fit = _fits(avail, demand, strict=False) & valid[j]
+            fit = _risk_restrict(fit, risk)
             n_fit = jnp.sum(fit)
             k = jnp.minimum((uniforms[j] * n_fit).astype(jnp.int32), n_fit - 1)
             rank = jnp.cumsum(fit)
@@ -733,6 +798,7 @@ def opportunistic_impl(avail, demands, valid, uniforms, phase2="auto",
         u_c = lax.dynamic_slice_in_dim(uP, pos, C)
         fit = jnp.all(avail_c >= dem_c[:, None, :], axis=2)
         fit = fit & valid_c[:, None]
+        fit = _risk_restrict(fit, risk)
         n_fit = jnp.sum(fit, axis=1)
         k = jnp.minimum((u_c * n_fit).astype(jnp.int32), n_fit - 1)
         rank = jnp.cumsum(fit, axis=1)
@@ -758,14 +824,16 @@ opportunistic_kernel = jax.jit(
 
 
 def first_fit_impl(avail, demands, valid, strict=False, totals=None,
-                   phase2="auto", live=None):
+                   phase2="auto", live=None, risk=None):
     """Lowest-index fitting host per task (ref vbp.py:6-29), two-phase
     form.  Bit-identical to :func:`first_fit_kernel_ref` in every mode.
-    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`)."""
+    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`);
+    ``risk`` the optional [H] eviction-risk vector — the index order
+    becomes the lexicographic (risk, index) order (module comment)."""
     mode = _resolve_phase2(phase2)
     avail, restore = _apply_live(avail, live)
     if mode == "scan":
-        p, a = _first_fit_scan(avail, demands, valid, strict)
+        p, a = _first_fit_scan(avail, demands, valid, strict, risk)
         return p, restore(a)
     B = demands.shape[0]
     if B == 0:
@@ -775,19 +843,25 @@ def first_fit_impl(avail, demands, valid, strict=False, totals=None,
     if mode == "slim":
         def decide_row(avail, j, demand):
             fit = _fits(avail, demand, strict) & valid[j]
-            return jnp.argmax(fit), jnp.any(fit)
+            if risk is None:
+                return jnp.argmax(fit), jnp.any(fit)
+            return jnp.argmin(_risk_key(fit, risk)), jnp.any(fit)
 
         p, a = _slim_drive(avail, demands, n_eff, decide_row)
         return p, restore(a)
 
     def speculate(avail, dem_c, valid_c, pos):
         # Fill speculation in host-index order (first-fit's score IS the
-        # index); capacity from the leading demand — identical-demand
-        # runs (task-group instances) commit whole chunks.
+        # index — or the risk vector when the risk term engages); capacity
+        # from the leading demand — identical-demand runs (task-group
+        # instances) commit whole chunks.
         C = dem_c.shape[0]
         viable = _static_viable(totals, dem_c[0], strict)
         caps = _fill_capacity(avail, dem_c[0], strict, viable)
-        return _fill_pick_by_index(caps, jnp.arange(C, dtype=jnp.int32))
+        ranks = jnp.arange(C, dtype=jnp.int32)
+        if risk is None:
+            return _fill_pick_by_index(caps, ranks)
+        return _fill_pick(risk, caps, ranks)
 
     def recheck(a_pre, dem_c, valid_c, pos):
         fit = (
@@ -795,7 +869,11 @@ def first_fit_impl(avail, demands, valid, strict=False, totals=None,
             else jnp.all(a_pre >= dem_c[:, None, :], axis=2)
         )
         fit = fit & valid_c[:, None]
-        return jnp.argmax(fit, axis=1).astype(jnp.int32), jnp.any(fit, axis=1)
+        if risk is None:
+            h = jnp.argmax(fit, axis=1)
+        else:
+            h = jnp.argmin(_risk_key(fit, risk), axis=1)
+        return h.astype(jnp.int32), jnp.any(fit, axis=1)
 
     p, a = _chunk_drive(
         avail, demands, valid, n_eff, min(mode, B), speculate, recheck
@@ -809,14 +887,15 @@ first_fit_kernel = jax.jit(
 
 
 def best_fit_impl(avail, demands, valid, totals=None, phase2="auto",
-                  live=None):
+                  live=None, risk=None):
     """Min residual-L2 host among strict fits (ref vbp.py:32-49), two-phase
     form.  Bit-identical to :func:`best_fit_kernel_ref` in every mode.
-    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`)."""
+    ``live`` is the optional [H] quarantine mask (:func:`_apply_live`);
+    ``risk`` the optional [H] eviction-risk vector (``score += risk``)."""
     mode = _resolve_phase2(phase2)
     avail, restore = _apply_live(avail, live)
     if mode == "scan":
-        p, a = _best_fit_scan(avail, demands, valid)
+        p, a = _best_fit_scan(avail, demands, valid, risk)
         return p, restore(a)
     B = demands.shape[0]
     if B == 0:
@@ -827,7 +906,7 @@ def best_fit_impl(avail, demands, valid, totals=None, phase2="auto",
     if mode == "slim":
         def decide_row(avail, j, demand):
             fit = _fits(avail, demand, strict=True) & valid[j]
-            residual = _norms(avail - demand)
+            residual = _risk_score(_norms(avail - demand), risk)
             return jnp.argmin(jnp.where(fit, residual, big)), jnp.any(fit)
 
         p, a = _slim_drive(avail, demands, n_eff, decide_row)
@@ -841,12 +920,12 @@ def best_fit_impl(avail, demands, valid, totals=None, phase2="auto",
         C = dem_c.shape[0]
         viable = _static_viable(totals, dem_c[0], strict=True)
         caps = _fill_capacity(avail, dem_c[0], strict=True, viable=viable)
-        resid0 = _norms(avail - dem_c[0][None, :])
+        resid0 = _risk_score(_norms(avail - dem_c[0][None, :]), risk)
         return _fill_pick(resid0, caps, jnp.arange(C, dtype=jnp.int32))
 
     def recheck(a_pre, dem_c, valid_c, pos):
         fit = jnp.all(a_pre > dem_c[:, None, :], axis=2) & valid_c[:, None]
-        residual = _norms(a_pre - dem_c[:, None, :])
+        residual = _risk_score(_norms(a_pre - dem_c[:, None, :]), risk)
         h = jnp.argmin(jnp.where(fit, residual, big), axis=1)
         return h.astype(jnp.int32), jnp.any(fit, axis=1)
 
@@ -877,12 +956,16 @@ def cost_aware_impl(
     totals=None,
     phase2="auto",
     live=None,
+    risk=None,
 ):
     """The PIVOT cost-aware placement (ref cost_aware.py:28-127), two-phase
     form — argument contract as :func:`cost_aware_kernel_ref`, plus the
     phase-1 ``totals`` pre-filter, the static ``phase2`` mode selector
-    (module docstring), and the optional [H] quarantine mask ``live``
-    (:func:`_apply_live`).  Bit-identical to the oracle in every mode.
+    (module docstring), the optional [H] quarantine mask ``live``
+    (:func:`_apply_live`), and the optional [H] eviction-risk vector
+    ``risk`` (``score += risk``; the ``sort_hosts=False`` index order
+    becomes lexicographic (risk, index)).  Bit-identical to the oracle
+    in every mode.
 
     Phase-1 hoists here: the ``[Z, H]`` round-trip tables (already
     pre-scan), the host-decay prescale of the cost table (exact: the same
@@ -901,7 +984,7 @@ def cost_aware_impl(
         p, a = _cost_aware_scan(
             avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
             host_zone, base_task_counts, bin_pack, sort_hosts, host_decay,
-            rt_bw_rows, rt_bw_idx,
+            rt_bw_rows, rt_bw_idx, risk,
         )
         return p, restore(a)
     B, H = demands.shape[0], avail.shape[0]
@@ -941,15 +1024,19 @@ def cost_aware_impl(
                     # costs like the scan form.
                     frozen = lax.cond(
                         new_group[j],
-                        lambda a: _ca_group_score(
+                        lambda a: _risk_score(_ca_group_score(
                             num_rt[anchor_zone[j]], a,
                             bw_row_at(anchor_zone[j], ri[j]),
-                        ),
+                        ), risk),
                         lambda a: frozen,
                         avail,
                     )
                 else:
-                    frozen = jnp.where(new_group[j], iota_h, frozen)
+                    frozen = jnp.where(
+                        new_group[j],
+                        iota_h if risk is None else risk,
+                        frozen,
+                    )
                 fit = _fits(avail, demand, strict=True) & valid_j
                 h = jnp.argmin(jnp.where(fit, frozen, big))
             else:
@@ -957,10 +1044,10 @@ def cost_aware_impl(
                     jnp.maximum(base_counts + extra.astype(dtype), 1.0)
                     if host_decay else 1.0
                 )
-                per_task = _ca_best_fit_score(
+                per_task = _risk_score(_ca_best_fit_score(
                     cost_rt[anchor_zone[j]], avail, demand, decay,
                     bw_row_at(anchor_zone[j], ri[j]),
-                )
+                ), risk)
                 fit = _fits(avail, demand, strict=False) & valid_j
                 h = jnp.argmin(jnp.where(fit, per_task, big))
             ok = jnp.any(fit)
@@ -1006,9 +1093,12 @@ def cost_aware_impl(
             az_e1, ri_e1 = az_c[e1c], ri_c[e1c]
 
             if sort_hosts:
-                row_spec = num_rt[az_e1] / (
-                    _norms(avail) * bw_row_at(az_e1, ri_e1)
+                row_spec = _risk_score(
+                    num_rt[az_e1] / (_norms(avail) * bw_row_at(az_e1, ri_e1)),
+                    risk,
                 )
+            elif risk is not None:
+                row_spec = risk
             else:
                 row_spec = iota_h
             viableA = _static_viable(totals, dem_c[0], strict=True)
@@ -1029,9 +1119,14 @@ def cost_aware_impl(
 
             def recheck(a_pre, _ex):
                 if sort_hosts:
-                    row_check = num_rt[az_e1] / (
-                        _norms(a_pre[e1c]) * bw_row_at(az_e1, ri_e1)
+                    row_check = _risk_score(
+                        num_rt[az_e1] / (
+                            _norms(a_pre[e1c]) * bw_row_at(az_e1, ri_e1)
+                        ),
+                        risk,
                     )
+                elif risk is not None:
+                    row_check = risk
                 else:
                     row_check = iota_h
                 score_rows = jnp.where(
@@ -1048,7 +1143,9 @@ def cost_aware_impl(
             resid0 = _norms(avail - dem_c[0][None, :])
             dec0 = jnp.maximum(base_counts + extra.astype(dtype), 1.0) \
                 if host_decay else 1.0
-            row_spec = cost_rows[0] * resid0 * dec0 / bw_rows[0]
+            row_spec = _risk_score(
+                cost_rows[0] * resid0 * dec0 / bw_rows[0], risk
+            )
             viable0 = _static_viable(totals, dem_c[0], strict=False)
             caps = _fill_capacity(avail, dem_c[0], False, viable0)
             h_s, ok_s = _fill_pick(row_spec, caps, idx)
@@ -1064,7 +1161,9 @@ def cost_aware_impl(
                     jnp.maximum(base_counts[None] + ex_pre.astype(dtype), 1.0)
                     if host_decay else 1.0
                 )
-                cand = cost_rows * residual * decay / bw_rows
+                cand = _risk_score(
+                    cost_rows * residual * decay / bw_rows, risk
+                )
                 h = jnp.argmin(jnp.where(fit, cand, big), axis=1)
                 return h.astype(jnp.int32), jnp.any(fit, axis=1)
 

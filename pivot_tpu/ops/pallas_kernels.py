@@ -85,6 +85,7 @@ def cost_aware_pallas(
     host_decay: bool = False,
     interpret: bool = False,
     live=None,
+    risk=None,
 ):
     """Drop-in Pallas replacement for ``kernels.cost_aware_kernel``.
 
@@ -92,10 +93,14 @@ def cost_aware_pallas(
     same greedy semantics; ``interpret=True`` runs the Mosaic interpreter
     (CPU parity tests).  ``live`` is the optional [H] quarantine mask
     (False = host excluded from placement — same contract as the scan
-    kernels' ``live``).  The single-replica case of
-    :func:`cost_aware_pallas_batched` — one greedy body serves both, so
-    the policy semantics (fit predicates, score formulas, tie rule)
-    cannot drift between the batched and unbatched forms.
+    kernels' ``live``); ``risk`` the optional [H] eviction-risk vector
+    fused into the phase-1 scores by the shared rule (``score += risk``;
+    the ``sort_hosts=False`` lane order becomes lexicographic
+    (risk, lane) — same contract as the scan kernels' ``risk``).  The
+    single-replica case of :func:`cost_aware_pallas_batched` — one
+    greedy body serves both, so the policy semantics (fit predicates,
+    score formulas, tie rule) cannot drift between the batched and
+    unbatched forms.
     """
     placements, avail_out = cost_aware_pallas_batched(
         avail[None],
@@ -113,6 +118,7 @@ def cost_aware_pallas(
         block_replicas=1,
         interpret=interpret,
         live=live,
+        risk=risk,
     )
     return placements[0], avail_out[0]
 
@@ -124,6 +130,7 @@ def _greedy_body_batched(
     chunk: int,
     RB: int,
     Hp: int,
+    has_risk: bool = False,
 ):
     """Replica-batched kernel body: ``RB`` replicas ride the sublane axis.
 
@@ -145,12 +152,15 @@ def _greedy_body_batched(
         cost_rows,  # [chunk, Hp] f32 VMEM (phase-1 per-task cost rows)
         bw_rows,  # [chunk, Hp] f32 VMEM (phase-1 per-task bw rows)
         base_row,  # [1, Hp] f32 VMEM
-        avail_in,  # [1, 4*RB, Hp] f32 VMEM (resource-major replica slabs)
-        place_out,  # [1, RB, chunk] i32 VMEM out
-        avail_out,  # [1, 4*RB, Hp] f32 VMEM out (revisited across chunks)
-        score_ref,  # [RB, Hp] f32 VMEM scratch (frozen group scores)
-        extra_ref,  # [RB, Hp] f32 VMEM scratch (best-fit live counters)
+        *refs,  # [risk_row [1, Hp] f32 VMEM (has_risk only)], avail_in,
+        #         place_out, avail_out, score_ref, extra_ref
     ):
+        if has_risk:
+            (risk_row, avail_in, place_out, avail_out,
+             score_ref, extra_ref) = refs
+        else:
+            avail_in, place_out, avail_out, score_ref, extra_ref = refs
+            risk_row = None
         tc = pl.program_id(1)
         lane = jax.lax.broadcasted_iota(jnp.int32, (RB, Hp), 1)
         lane_f = lane.astype(jnp.float32)
@@ -181,7 +191,20 @@ def _greedy_body_batched(
                         decay = (
                             jnp.maximum(base_row[:], 1.0) if host_decay else 1.0
                         )
-                        score_ref[:] = cost_row * decay / (norms * bw_row)
+                        score = cost_row * decay / (norms * bw_row)
+                        if has_risk:
+                            # Shared risk rule: score += risk (the risk
+                            # term is availability-independent, so adding
+                            # at freeze time == adding at selection time).
+                            score = score + risk_row[:]
+                        score_ref[:] = score
+                    elif has_risk:
+                        # Index-ordered selection → lexicographic
+                        # (risk, lane): the min-lane tie-break below
+                        # supplies the second key.
+                        score_ref[:] = jnp.broadcast_to(
+                            risk_row[:], (RB, Hp)
+                        )
                     else:
                         score_ref[:] = lane_f
 
@@ -198,6 +221,8 @@ def _greedy_body_batched(
                     else 1.0
                 )
                 per_task = cost_row * residual * decay / bw_row
+                if has_risk:
+                    per_task = per_task + risk_row[:]
                 fit = (
                     (a[0] >= d[0]) & (a[1] >= d[1]) & (a[2] >= d[2]) & (a[3] >= d[3])
                 )
@@ -248,6 +273,7 @@ def cost_aware_pallas_batched(
     block_replicas: Optional[int] = None,
     interpret: bool = False,
     live=None,
+    risk=None,
 ):
     """Replica-batched greedy pass: ``R`` Monte-Carlo replicas, one kernel.
 
@@ -375,6 +401,13 @@ def cost_aware_pallas_batched(
     base_row = jnp.pad(
         base_task_counts.astype(f32).reshape(1, H), ((0, 0), (0, Hp - H))
     )
+    has_risk = risk is not None
+    if has_risk:
+        # [1, Hp] risk row; padding lanes get 0 — they are unselectable
+        # anyway (avail = -1e30 fails every fit test).
+        risk_row = jnp.pad(
+            risk.astype(f32).reshape(1, H), ((0, 0), (0, Hp - H))
+        )
 
     grid = (Rb, Tp // chunk)
     smem_chunk = lambda w: pl.BlockSpec(  # noqa: E731
@@ -391,6 +424,7 @@ def cost_aware_pallas_batched(
             chunk=chunk,
             RB=RB,
             Hp=Hp,
+            has_risk=has_risk,
         ),
         grid=grid,
         in_specs=[
@@ -406,6 +440,7 @@ def cost_aware_pallas_batched(
                 memory_space=pltpu.VMEM,
             ),
             whole((1, Hp)),  # base counts
+        ] + ([whole((1, Hp))] if has_risk else []) + [  # risk row
             pl.BlockSpec(
                 (1, 4 * RB, Hp), lambda rb, tc: (rb, 0, 0),
                 memory_space=pltpu.VMEM,
@@ -430,7 +465,10 @@ def cost_aware_pallas_batched(
             pltpu.VMEM((RB, Hp), f32),  # best-fit live counters
         ],
         interpret=interpret,
-    )(dem, val, ng, cost_rows, bw_rows, base_row, a)
+    )(
+        dem, val, ng, cost_rows, bw_rows, base_row,
+        *((risk_row,) if has_risk else ()), a,
+    )
 
     placements = placements.reshape(Rp, Tp)[:R, :T]
     avail_out = jnp.transpose(

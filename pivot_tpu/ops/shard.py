@@ -98,6 +98,8 @@ from pivot_tpu.ops.kernels import (
     _pad_chunk,
     _place,
     _resolve_phase2,
+    _risk_key,
+    _risk_score,
 )
 from pivot_tpu.ops.tickloop import (
     SpanResult,
@@ -222,6 +224,31 @@ def _opportunistic_pick(fit, u_j, offset, n_shards):
     return jnp.where(ok, h, 0), ok
 
 
+def _risk_restrict_sharded(fit, risk):
+    """Sharded opportunistic risk rule (round 11, ``infra/market.py``):
+    narrow ``fit`` to the GLOBAL minimum-risk tier of fitting hosts.
+    ``risk`` is this shard's [H/S] block; one ``pmin`` finds the global
+    tier bound.  No-op when nothing fits anywhere — every shard's masked
+    min stays +inf, which no finite risk equals.  Mirrors the
+    single-device ``kernels._risk_restrict`` exactly (equality against
+    the same float value, computed by the same min tree shape per
+    shard)."""
+    if risk is None:
+        return fit
+    local = jnp.min(_risk_key(fit, risk))
+    rmin = lax.pmin(local, HOST_AXIS)
+    return fit & (risk == rmin)
+
+
+def _risk_restrict_sharded_rows(fit_rows, risk):
+    """Batched :func:`_risk_restrict_sharded`: C rows, one [C] pmin."""
+    if risk is None:
+        return fit_rows
+    local = jnp.min(_risk_key(fit_rows, risk[None]), axis=1)
+    rmin = lax.pmin(local, HOST_AXIS)
+    return fit_rows & (risk[None] == rmin[:, None])
+
+
 def _place_local(avail, demand, h, ok, offset):
     """One shard's slice of the global ``_place``: decrement the winning
     row only on the shard that owns it — the same arithmetic on the same
@@ -322,33 +349,39 @@ def _carry_free_sharded_pass(avail, demands, valid, n_eff, decide):
 
 
 def _opportunistic_sharded_pass(avail, demands, valid, uniforms, n_eff,
-                                n_shards):
+                                n_shards, risk=None):
     offset = _shard_offset(avail.shape[0])
 
     def decide(avail, j, demand):
         fit = _fits(avail, demand, strict=False) & valid[j]
+        fit = _risk_restrict_sharded(fit, risk)
         return _opportunistic_pick(fit, uniforms[j], offset, n_shards)
 
     return _carry_free_sharded_pass(avail, demands, valid, n_eff, decide)
 
 
-def _first_fit_sharded_pass(avail, demands, valid, n_eff, strict):
+def _first_fit_sharded_pass(avail, demands, valid, n_eff, strict, risk=None):
     offset = _shard_offset(avail.shape[0])
 
     def decide(avail, j, demand):
         fit = _fits(avail, demand, strict) & valid[j]
-        return _first_index_of(fit, offset)
+        if risk is None:
+            return _first_index_of(fit, offset)
+        # Risk-aware first fit: lexicographic (risk, global index) — the
+        # two-stage argmin's composed tie rule gives it exactly (module
+        # docstring), mirroring the flat kernels' masked argmin of risk.
+        return _two_stage_argmin(_risk_key(fit, risk), jnp.any(fit), offset)
 
     return _carry_free_sharded_pass(avail, demands, valid, n_eff, decide)
 
 
-def _best_fit_sharded_pass(avail, demands, valid, n_eff):
+def _best_fit_sharded_pass(avail, demands, valid, n_eff, risk=None):
     offset = _shard_offset(avail.shape[0])
     big = jnp.asarray(jnp.inf, avail.dtype)
 
     def decide(avail, j, demand):
         fit = _fits(avail, demand, strict=True) & valid[j]
-        residual = _norms(avail - demand)
+        residual = _risk_score(_norms(avail - demand), risk)
         return _two_stage_argmin(
             jnp.where(fit, residual, big), jnp.any(fit), offset
         )
@@ -427,13 +460,14 @@ def _sharded_chunk_drive(avail, demands, valid, n_eff, C, decide_rows,
 
 
 def _opportunistic_sharded_chunk(avail, demands, valid, uniforms, n_eff, C,
-                                 n_shards):
+                                 n_shards, risk=None):
     offset = _shard_offset(avail.shape[0])
     uP = _pad_chunk(uniforms, C)
 
     def decide_rows(a_rows, dem_c, valid_c, pos):
         u_c = lax.dynamic_slice_in_dim(uP, pos, C)
         fit = jnp.all(a_rows >= dem_c[:, None, :], axis=2) & valid_c[:, None]
+        fit = _risk_restrict_sharded_rows(fit, risk)
         return _opportunistic_pick_rows(fit, u_c, offset, n_shards)
 
     return _sharded_chunk_drive(
@@ -441,7 +475,8 @@ def _opportunistic_sharded_chunk(avail, demands, valid, uniforms, n_eff, C,
     )
 
 
-def _first_fit_sharded_chunk(avail, demands, valid, n_eff, C, strict):
+def _first_fit_sharded_chunk(avail, demands, valid, n_eff, C, strict,
+                             risk=None):
     offset = _shard_offset(avail.shape[0])
 
     def decide_rows(a_rows, dem_c, valid_c, pos):
@@ -449,20 +484,25 @@ def _first_fit_sharded_chunk(avail, demands, valid, n_eff, C, strict):
             jnp.all(a_rows > dem_c[:, None, :], axis=2) if strict
             else jnp.all(a_rows >= dem_c[:, None, :], axis=2)
         )
-        return _first_index_of_rows(fit & valid_c[:, None], offset)
+        fit = fit & valid_c[:, None]
+        if risk is None:
+            return _first_index_of_rows(fit, offset)
+        return _two_stage_argmin_rows(
+            _risk_key(fit, risk[None]), jnp.any(fit, axis=1), offset
+        )
 
     return _sharded_chunk_drive(
         avail, demands, valid, n_eff, C, decide_rows, offset
     )
 
 
-def _best_fit_sharded_chunk(avail, demands, valid, n_eff, C):
+def _best_fit_sharded_chunk(avail, demands, valid, n_eff, C, risk=None):
     offset = _shard_offset(avail.shape[0])
     big = jnp.asarray(jnp.inf, avail.dtype)
 
     def decide_rows(a_rows, dem_c, valid_c, pos):
         fit = jnp.all(a_rows > dem_c[:, None, :], axis=2) & valid_c[:, None]
-        residual = _norms(a_rows - dem_c[:, None, :])
+        residual = _risk_score(_norms(a_rows - dem_c[:, None, :]), risk)
         return _two_stage_argmin_rows(
             jnp.where(fit, residual, big), jnp.any(fit, axis=1), offset
         )
@@ -486,12 +526,16 @@ def _cost_aware_sharded_pass(
     bin_pack,
     sort_hosts,
     host_decay,
+    risk=None,
 ):
     """Sharded cost-aware sequential pass — the slim body of
     ``kernels.cost_aware_impl`` with every host-row expression evaluated
     on the local block through the SHARED phase-1/score helpers and the
-    argmin swapped for the two-stage reduce.  ``host_zone`` and
-    ``base_task_counts`` are this shard's blocks."""
+    argmin swapped for the two-stage reduce.  ``host_zone``,
+    ``base_task_counts``, and the optional ``risk`` vector are this
+    shard's blocks (the shared risk rules: ``score += risk``; the
+    ``sort_hosts=False`` index order becomes lexicographic
+    (risk, global index) via the two-stage argmin)."""
     B = demands.shape[0]
     Hl = avail.shape[0]
     offset = _shard_offset(Hl)
@@ -517,14 +561,18 @@ def _cost_aware_sharded_pass(
             if sort_hosts:
                 frozen = lax.cond(
                     new_group[j],
-                    lambda a: _ca_group_score(
+                    lambda a: _risk_score(_ca_group_score(
                         num_rt[anchor_zone[j]], a, bw_rt[anchor_zone[j]]
-                    ),
+                    ), risk),
                     lambda a: frozen,
                     avail,
                 )
             else:
-                frozen = jnp.where(new_group[j], iota_h, frozen)
+                frozen = jnp.where(
+                    new_group[j],
+                    iota_h if risk is None else risk,
+                    frozen,
+                )
             fit = _fits(avail, demand, strict=True) & valid_j
             h, ok = _two_stage_argmin(
                 jnp.where(fit, frozen, big), jnp.any(fit), offset
@@ -534,10 +582,10 @@ def _cost_aware_sharded_pass(
                 jnp.maximum(base_counts + extra.astype(dtype), 1.0)
                 if host_decay else 1.0
             )
-            per_task = _ca_best_fit_score(
+            per_task = _risk_score(_ca_best_fit_score(
                 cost_rt[anchor_zone[j]], avail, demand, decay,
                 bw_rt[anchor_zone[j]],
-            )
+            ), risk)
             fit = _fits(avail, demand, strict=False) & valid_j
             h, ok = _two_stage_argmin(
                 jnp.where(fit, per_task, big), jnp.any(fit), offset
@@ -574,6 +622,7 @@ def _cost_aware_sharded_chunk_pass(
     bin_pack,
     sort_hosts,
     host_decay,
+    risk=None,
 ):
     """Sharded cost-aware chunk commit — the chunk body of
     ``kernels.cost_aware_impl`` with shard-local score/fold arithmetic,
@@ -617,9 +666,11 @@ def _cost_aware_sharded_chunk_pass(
 
             def score_rows_for(entry_avail):
                 if sort_hosts:
-                    row = _ca_group_score(
+                    row = _risk_score(_ca_group_score(
                         num_rt[az_e1], entry_avail, bw_rt[az_e1]
-                    )
+                    ), risk)
+                elif risk is not None:
+                    row = risk
                 else:
                     row = iota_h
                 return jnp.where(seg, row[None], frozen[None]), row
@@ -650,7 +701,9 @@ def _cost_aware_sharded_chunk_pass(
                                 1.0)
                     if host_decay else 1.0
                 )
-                cand = cost_rows * residual * decay / bw_rows
+                cand = _risk_score(
+                    cost_rows * residual * decay / bw_rows, risk
+                )
                 return _two_stage_argmin_rows(
                     jnp.where(fit, cand, big), jnp.any(fit, axis=1), offset
                 )
@@ -718,151 +771,175 @@ _HOST_MAT = P(HOST_AXIS, None)    # [H, 4] availability
 _REP = P(None)                    # replicated task-axis operands
 
 
-def _live_specs(has_live):
-    return (_HOST_VEC,) if has_live else ()
+def _opt_specs(has_live, has_risk):
+    """Trailing in_specs for the optional [H] operands, in the fixed
+    (live, risk) order the wrappers append them."""
+    return (_HOST_VEC,) * (int(has_live) + int(has_risk))
+
+
+def _opt_args(live, risk):
+    """The optional [H] operands, appended in (live, risk) order."""
+    return tuple(a for a in (live, risk) if a is not None)
+
+
+def _opt_unpack(rest, has_live, has_risk):
+    """Unpack ``*rest`` back into (live, risk)."""
+    it = iter(rest)
+    live = next(it) if has_live else None
+    risk = next(it) if has_risk else None
+    return live, risk
 
 
 @functools.lru_cache(maxsize=None)
-def _opportunistic_sharded_fn(mesh, mode, has_live):
+def _opportunistic_sharded_fn(mesh, mode, has_live, has_risk):
     n = host_axis_size(mesh)
 
     def fn(avail, demands, valid, uniforms, *rest):
-        live = rest[0] if has_live else None
+        live, risk = _opt_unpack(rest, has_live, has_risk)
         avail, restore = _apply_live(avail, live)
         n_eff = _effective_len(valid)
         if mode == "step":
             p, a = _opportunistic_sharded_pass(
-                avail, demands, valid, uniforms, n_eff, n
+                avail, demands, valid, uniforms, n_eff, n, risk
             )
         else:
             p, a = _opportunistic_sharded_chunk(
                 avail, demands, valid, uniforms, n_eff,
-                min(mode, demands.shape[0]), n,
+                min(mode, demands.shape[0]), n, risk,
             )
         return p, restore(a)
 
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(_HOST_MAT, P(None, None), _REP, _REP) + _live_specs(has_live),
+        in_specs=(_HOST_MAT, P(None, None), _REP, _REP)
+        + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
         check_rep=False,
     ))
 
 
 def opportunistic_kernel_sharded(mesh, avail, demands, valid, uniforms,
-                                 phase2="auto", live=None):
+                                 phase2="auto", live=None, risk=None):
     """Host-sharded :func:`kernels.opportunistic_impl` — bit-identical to
     the single-device kernel in every ``phase2`` mode (the sharded pass
-    is mode-collapsed; see the module docstring)."""
+    is mode-collapsed; see the module docstring).  ``risk`` (optional
+    [H] eviction-risk vector, round 11) narrows the random choice to the
+    global minimum-risk tier — same Philox draw, narrower support."""
     mode = _sharded_mode(phase2)
     _check_host_axis(avail.shape[0], mesh)
     if demands.shape[0] == 0:
         return jnp.zeros((0,), jnp.int32), avail
-    args = (avail, demands, valid, uniforms)
-    if live is not None:
-        args = args + (live,)
-    return _opportunistic_sharded_fn(mesh, mode, live is not None)(*args)
+    args = (avail, demands, valid, uniforms) + _opt_args(live, risk)
+    return _opportunistic_sharded_fn(
+        mesh, mode, live is not None, risk is not None
+    )(*args)
 
 
 @functools.lru_cache(maxsize=None)
-def _first_fit_sharded_fn(mesh, mode, strict, has_live):
+def _first_fit_sharded_fn(mesh, mode, strict, has_live, has_risk):
     def fn(avail, demands, valid, *rest):
-        live = rest[0] if has_live else None
+        live, risk = _opt_unpack(rest, has_live, has_risk)
         avail, restore = _apply_live(avail, live)
         n_eff = _effective_len(valid)
         if mode == "step":
             p, a = _first_fit_sharded_pass(
-                avail, demands, valid, n_eff, strict
+                avail, demands, valid, n_eff, strict, risk
             )
         else:
             p, a = _first_fit_sharded_chunk(
                 avail, demands, valid, n_eff,
-                min(mode, demands.shape[0]), strict,
+                min(mode, demands.shape[0]), strict, risk,
             )
         return p, restore(a)
 
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(_HOST_MAT, P(None, None), _REP) + _live_specs(has_live),
+        in_specs=(_HOST_MAT, P(None, None), _REP)
+        + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
         check_rep=False,
     ))
 
 
 def first_fit_kernel_sharded(mesh, avail, demands, valid, strict=False,
-                             totals=None, phase2="auto", live=None):
+                             totals=None, phase2="auto", live=None,
+                             risk=None):
     """Host-sharded :func:`kernels.first_fit_impl`.  ``totals`` (the
     chunked form's speculation pre-filter) is accepted and ignored — the
     sharded pass has no speculation to steer, and the pre-filter can
-    never change a placement by contract."""
+    never change a placement by contract.  ``risk`` swaps the index
+    order for the lexicographic (risk, global index) order."""
     mode = _sharded_mode(phase2)
     _check_host_axis(avail.shape[0], mesh)
     if demands.shape[0] == 0:
         return jnp.zeros((0,), jnp.int32), avail
-    args = (avail, demands, valid)
-    if live is not None:
-        args = args + (live,)
+    args = (avail, demands, valid) + _opt_args(live, risk)
     return _first_fit_sharded_fn(
-        mesh, mode, bool(strict), live is not None
+        mesh, mode, bool(strict), live is not None, risk is not None
     )(*args)
 
 
 @functools.lru_cache(maxsize=None)
-def _best_fit_sharded_fn(mesh, mode, has_live):
+def _best_fit_sharded_fn(mesh, mode, has_live, has_risk):
     def fn(avail, demands, valid, *rest):
-        live = rest[0] if has_live else None
+        live, risk = _opt_unpack(rest, has_live, has_risk)
         avail, restore = _apply_live(avail, live)
         n_eff = _effective_len(valid)
         if mode == "step":
-            p, a = _best_fit_sharded_pass(avail, demands, valid, n_eff)
+            p, a = _best_fit_sharded_pass(
+                avail, demands, valid, n_eff, risk
+            )
         else:
             p, a = _best_fit_sharded_chunk(
-                avail, demands, valid, n_eff, min(mode, demands.shape[0])
+                avail, demands, valid, n_eff,
+                min(mode, demands.shape[0]), risk,
             )
         return p, restore(a)
 
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(_HOST_MAT, P(None, None), _REP) + _live_specs(has_live),
+        in_specs=(_HOST_MAT, P(None, None), _REP)
+        + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
         check_rep=False,
     ))
 
 
 def best_fit_kernel_sharded(mesh, avail, demands, valid, totals=None,
-                            phase2="auto", live=None):
+                            phase2="auto", live=None, risk=None):
     """Host-sharded :func:`kernels.best_fit_impl` (``totals`` accepted
-    and ignored like :func:`first_fit_kernel_sharded`)."""
+    and ignored like :func:`first_fit_kernel_sharded`; ``risk`` adds the
+    shared ``score += risk`` term)."""
     mode = _sharded_mode(phase2)
     _check_host_axis(avail.shape[0], mesh)
     if demands.shape[0] == 0:
         return jnp.zeros((0,), jnp.int32), avail
-    args = (avail, demands, valid)
-    if live is not None:
-        args = args + (live,)
-    return _best_fit_sharded_fn(mesh, mode, live is not None)(*args)
+    args = (avail, demands, valid) + _opt_args(live, risk)
+    return _best_fit_sharded_fn(
+        mesh, mode, live is not None, risk is not None
+    )(*args)
 
 
 @functools.lru_cache(maxsize=None)
 def _cost_aware_sharded_fn(mesh, mode, bin_pack, sort_hosts, host_decay,
-                           has_live):
+                           has_live, has_risk):
     def fn(avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
            host_zone, base_task_counts, *rest):
-        live = rest[0] if has_live else None
+        live, risk = _opt_unpack(rest, has_live, has_risk)
         avail, restore = _apply_live(avail, live)
         n_eff = _effective_len(valid)
         if mode == "step":
             p, a = _cost_aware_sharded_pass(
                 avail, demands, valid, new_group, anchor_zone, cost_zz,
                 bw_zz, host_zone, base_task_counts, n_eff,
-                bin_pack, sort_hosts, host_decay,
+                bin_pack, sort_hosts, host_decay, risk,
             )
         else:
             p, a = _cost_aware_sharded_chunk_pass(
                 avail, demands, valid, new_group, anchor_zone, cost_zz,
                 bw_zz, host_zone, base_task_counts, n_eff,
                 min(mode, demands.shape[0]), bin_pack, sort_hosts,
-                host_decay,
+                host_decay, risk,
             )
         return p, restore(a)
 
@@ -871,7 +948,7 @@ def _cost_aware_sharded_fn(mesh, mode, bin_pack, sort_hosts, host_decay,
         in_specs=(
             _HOST_MAT, P(None, None), _REP, _REP, _REP,
             P(None, None), P(None, None), _HOST_VEC, _HOST_VEC,
-        ) + _live_specs(has_live),
+        ) + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
         check_rep=False,
     ))
@@ -896,11 +973,15 @@ def cost_aware_kernel_sharded(
     totals=None,
     phase2="auto",
     live=None,
+    risk=None,
 ):
     """Host-sharded :func:`kernels.cost_aware_impl` — same argument
     contract minus the realtime-bandwidth rows (live route-queue samples
     are per-tick host state the mesh cannot hold; the device policy
-    declines sharding for ``realtime_bw`` like it declines spans)."""
+    declines sharding for ``realtime_bw`` like it declines spans).
+    ``risk`` is this PR's optional [H] eviction-risk vector, applied by
+    the shared rules (``score += risk``; ``sort_hosts=False`` order →
+    lexicographic (risk, global index))."""
     mode = _sharded_mode(phase2)
     if rt_bw_rows is not None or rt_bw_idx is not None:
         raise ValueError(
@@ -912,12 +993,10 @@ def cost_aware_kernel_sharded(
     if demands.shape[0] == 0:
         return jnp.zeros((0,), jnp.int32), avail
     args = (avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
-            host_zone, base_task_counts)
-    if live is not None:
-        args = args + (live,)
+            host_zone, base_task_counts) + _opt_args(live, risk)
     return _cost_aware_sharded_fn(
         mesh, mode, bin_pack, bool(sort_hosts), bool(host_decay),
-        live is not None,
+        live is not None, risk is not None,
     )(*args)
 
 
@@ -940,6 +1019,9 @@ def _sharded_span_body(
     host_zone,
     base_task_counts,
     live,
+    risk_rows,
+    cost_stack,
+    cost_seg,
     *,
     policy: str,
     n_ticks: int,
@@ -956,7 +1038,11 @@ def _sharded_span_body(
     the sharded passes and the ``[H]`` carries ([H/S, 4] availability,
     [H/S] span-cumulative decay counts) shard-local.  All [B] slot-axis
     state is replicated and computed via the SHARED span algebra
-    helpers, identically on every shard."""
+    helpers, identically on every shard.  The market operands follow the
+    tickloop contract: ``risk_rows`` is the [K, H] per-tick risk stack
+    (host axis sharded → this shard sees its [K, H/S] block),
+    ``cost_stack``/``cost_seg`` the replicated [P, Z, Z] price-scaled
+    cost tensor and its per-tick [K] segment-index row."""
     B = demands.shape[0]
     Hl = avail.shape[0]
     K = n_ticks
@@ -983,25 +1069,31 @@ def _sharded_span_body(
         dem_p = demands[order]
         valid_p = in_batch[order]
         n_eff = _effective_len(valid_p)
+        # Per-tick market state (tickloop contract): this tick's [H/S]
+        # risk block and — cost-aware — its [Z, Z] price slice.  Both
+        # None in market-free worlds: the traced program is unchanged.
+        risk_k = None if risk_rows is None else risk_rows[k]
+        cost_k = cost_zz if cost_stack is None else cost_stack[cost_seg[k]]
 
         if policy == "opportunistic":
             p_ord, new_avail = _opportunistic_sharded_pass(
-                avail, dem_p, valid_p, uniforms[k], n_eff, n_shards
+                avail, dem_p, valid_p, uniforms[k], n_eff, n_shards,
+                risk_k,
             )
         elif policy == "first-fit":
             p_ord, new_avail = _first_fit_sharded_pass(
-                avail, dem_p, valid_p, n_eff, strict
+                avail, dem_p, valid_p, n_eff, strict, risk_k
             )
         elif policy == "best-fit":
             p_ord, new_avail = _best_fit_sharded_pass(
-                avail, dem_p, valid_p, n_eff
+                avail, dem_p, valid_p, n_eff, risk_k
             )
         else:  # cost-aware
             ng_p = _span_group_entries(bucket_id, order, iota_b)
             p_ord, new_avail = _cost_aware_sharded_pass(
                 avail, dem_p, valid_p, ng_p, anchor_zone[order],
-                cost_zz, bw_zz, host_zone, base_task_counts + cum,
-                n_eff, bin_pack, sort_hosts, host_decay,
+                cost_k, bw_zz, host_zone, base_task_counts + cum,
+                n_eff, bin_pack, sort_hosts, host_decay, risk_k,
             )
         row = jnp.full((B,), -1, jnp.int32).at[order].set(
             p_ord.astype(jnp.int32)
@@ -1063,11 +1155,11 @@ def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
 
     def fn(avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
            anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
-           base_task_counts, live):
+           base_task_counts, live, risk_rows, cost_stack, cost_seg):
         return _sharded_span_body(
             avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
             anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
-            base_task_counts, live,
+            base_task_counts, live, risk_rows, cost_stack, cost_seg,
             policy=policy, n_ticks=n_ticks, n_shards=n, strict=strict,
             decreasing=decreasing, bin_pack=bin_pack,
             sort_tasks=sort_tasks, sort_hosts=sort_hosts,
@@ -1090,6 +1182,9 @@ def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
             _HOST_VEC,        # host_zone (or None)
             _HOST_VEC,        # base_task_counts (or None)
             _HOST_VEC,        # live (or None)
+            P(None, HOST_AXIS),   # risk_rows [K, H] (or None)
+            P(None, None, None),  # cost_stack [P, Z, Z] (or None)
+            _REP,                 # cost_seg [K] (or None)
         ),
         out_specs=SpanResult(
             placements=P(None, None),
@@ -1123,6 +1218,9 @@ def sharded_fused_tick_run(
     base_task_counts=None,
     totals=None,
     live=None,
+    risk_rows=None,
+    cost_stack=None,
+    cost_seg=None,
     strict: bool = False,
     decreasing: bool = False,
     bin_pack: str = "first-fit",
@@ -1136,7 +1234,10 @@ def sharded_fused_tick_run(
     between ticks.  Bit-identical to the single-device driver (and so to
     :func:`tickloop.reference_tick_run`) on every input the parity suite
     sweeps.  ``totals``/``phase2`` accepted for signature compatibility
-    (speculation-free pass; every mode is bit-identical)."""
+    (speculation-free pass; every mode is bit-identical).  The market
+    operands (``risk_rows`` [K, H], ``cost_stack`` [P, Z, Z],
+    ``cost_seg`` [K]) follow :func:`tickloop.fused_tick_run`'s contract;
+    ``risk_rows`` rides the host axis like ``live``."""
     _resolve_phase2(phase2)
     _check_host_axis(avail.shape[0], mesh)
     return _sharded_span_fn(
@@ -1145,5 +1246,5 @@ def sharded_fused_tick_run(
     )(
         avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
         anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
-        base_task_counts, live,
+        base_task_counts, live, risk_rows, cost_stack, cost_seg,
     )

@@ -221,6 +221,9 @@ def _fused_tick_run_impl(
     base_task_counts,
     totals,
     live,
+    risk_rows,
+    cost_stack,
+    cost_seg,
     *,
     policy: str,
     n_ticks: int,
@@ -261,6 +264,14 @@ def _fused_tick_run_impl(
         )
         dem_p = demands[order]
         valid_p = in_batch[order]
+        # Per-tick market state (round 11, ``infra/market.py``): the
+        # tick's [H] risk row and — for cost-aware — the tick's [Z, Z]
+        # slice of the [P, Z, Z] price-scaled cost tensor, indexed by the
+        # per-span [K] time-index row (the same pattern as the Philox
+        # uniform rows).  Both None in market-free worlds: the traced
+        # program is unchanged bit for bit.
+        risk_k = None if risk_rows is None else risk_rows[k]
+        cost_k = cost_zz if cost_stack is None else cost_stack[cost_seg[k]]
 
         # 3. One two-phase kernel core — the same ops the per-tick jitted
         #    path runs, so placements are bit-identical to a single-tick
@@ -271,16 +282,18 @@ def _fused_tick_run_impl(
             # position j — identical to the sequential path's per-tick
             # stream (prefix property of the counter-based generator).
             p_ord, new_avail = opportunistic_impl(
-                avail, dem_p, valid_p, uniforms[k], phase2=phase2
+                avail, dem_p, valid_p, uniforms[k], phase2=phase2,
+                risk=risk_k,
             )
         elif policy == "first-fit":
             p_ord, new_avail = first_fit_impl(
                 avail, dem_p, valid_p, strict=strict, totals=totals,
-                phase2=phase2,
+                phase2=phase2, risk=risk_k,
             )
         elif policy == "best-fit":
             p_ord, new_avail = best_fit_impl(
-                avail, dem_p, valid_p, totals=totals, phase2=phase2
+                avail, dem_p, valid_p, totals=totals, phase2=phase2,
+                risk=risk_k,
             )
         else:  # cost-aware
             ng_p = _span_group_entries(bucket_id, order, iota_b)
@@ -290,7 +303,7 @@ def _fused_tick_run_impl(
                 valid_p,
                 ng_p,
                 anchor_zone[order],
-                cost_zz,
+                cost_k,
                 bw_zz,
                 host_zone,
                 base_task_counts + cum,
@@ -299,6 +312,7 @@ def _fused_tick_run_impl(
                 host_decay=host_decay,
                 totals=totals,
                 phase2=phase2,
+                risk=risk_k,
             )
         row = jnp.full((B,), -1, jnp.int32).at[order].set(
             p_ord.astype(jnp.int32)
@@ -388,6 +402,9 @@ def fused_tick_run(
     base_task_counts=None,
     totals=None,
     live=None,
+    risk_rows=None,
+    cost_stack=None,
+    cost_seg=None,
     strict: bool = False,
     decreasing: bool = False,
     bin_pack: str = "first-fit",
@@ -416,6 +433,16 @@ def fused_tick_run(
       cost_zz/bw_zz/host_zone/base_task_counts/totals — the cost-aware
                                topology operands (``DeviceTopology``)
       live             [H]     span-constant quarantine mask (or None)
+      risk_rows        [K, H]  per-tick eviction-risk rows (the market's
+                               hazard × risk_weight × rework_cost at each
+                               span instant — one row per tick, like the
+                               Philox uniform rows; or None)
+      cost_stack       [P, Z, Z] price-scaled egress-cost tensor
+                               (``MarketSchedule.cost_tensor``; or None —
+                               ``cost_zz`` then serves every tick)
+      cost_seg         [K] i32 per-tick segment index into ``cost_stack``
+                               (``MarketSchedule.segment_indices`` of the
+                               span grid — the per-span time-index row)
 
     Static config mirrors the per-tick kernels (``strict``/``decreasing``
     for the VBP arms, ``bin_pack``/``sort_tasks``/``sort_hosts``/
@@ -439,6 +466,9 @@ def fused_tick_run(
         base_task_counts,
         totals,
         live,
+        risk_rows,
+        cost_stack,
+        cost_seg,
         policy=policy,
         n_ticks=n_ticks,
         strict=strict,
@@ -468,6 +498,9 @@ def reference_tick_run(
     base_task_counts=None,
     totals=None,
     live=None,
+    risk_rows=None,
+    cost_stack=None,
+    cost_seg=None,
     strict: bool = False,
     decreasing: bool = False,
     bin_pack: str = "first-fit",
@@ -480,7 +513,10 @@ def reference_tick_run(
     semantics driven tick by tick with ONE public (jitted) kernel call
     per tick and the wait-stack algebra in plain Python — i.e. exactly
     what the per-tick dispatch path pays, which is also what ``bench.py``
-    ``fused_tick`` times it against.  Returns ``(placements [K, B] i64,
+    ``fused_tick`` times it against.  The market operands
+    (``risk_rows``/``cost_stack``/``cost_seg``) follow the driver's
+    contract: tick ``k`` scores with ``risk_rows[k]`` and — cost-aware —
+    ``cost_stack[cost_seg[k]]``.  Returns ``(placements [K, B] i64,
     n_ready [K], n_placed [K], avail [H, 4])`` as host numpy, with the
     no-op tail rows materialized (so outputs compare 1:1 against a
     :class:`SpanResult` whose tail the device loop skipped).
@@ -534,6 +570,11 @@ def reference_tick_run(
         valid_p = np.zeros(B, dtype=bool)
         valid_p[: len(order)] = True
         kw = dict(phase2=phase2, live=live)
+        if risk_rows is not None:
+            kw["risk"] = jnp.asarray(np.asarray(risk_rows)[k])
+        cost_k = cost_zz
+        if cost_stack is not None:
+            cost_k = jnp.asarray(cost_stack)[int(np.asarray(cost_seg)[k])]
         if policy == "opportunistic":
             p_ord, avail = opportunistic_kernel(
                 avail, jnp.asarray(dem_p), jnp.asarray(valid_p),
@@ -563,7 +604,7 @@ def reference_tick_run(
                 jnp.asarray(valid_p),
                 jnp.asarray(ng_p),
                 jnp.asarray(az_p),
-                cost_zz,
+                cost_k,
                 bw_zz,
                 host_zone,
                 base_task_counts + jnp.asarray(cum),

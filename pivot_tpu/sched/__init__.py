@@ -101,6 +101,8 @@ class TickContext:
         self._host_task_counts: Optional[np.ndarray] = None
         self._live_mask: Optional[np.ndarray] = None
         self._live_mask_set = False
+        self._hazard: Optional[np.ndarray] = None
+        self._hazard_set = False
         # Policies that iterate the batch in a different order than given
         # (the VBP decreasing arms) record it here: the reference's tick
         # loop consumes ``schedule(ready_q)``'s RETURN list — the sorted
@@ -162,6 +164,38 @@ class TickContext:
                 mask[i] = False
         self._live_mask = mask
         return mask
+
+    @property
+    def hazard_vector(self) -> Optional[np.ndarray]:
+        """[H] per-host spot-preemption hazard (events/host/sim-second) at
+        this tick's instant, gathered from the scheduler's
+        :class:`~pivot_tpu.infra.market.MarketSchedule` through the
+        cluster's host→zone map — the feed of the risk-aware scoring term
+        (``policies.resolve_risk``).  ``None`` when the scheduler carries
+        no market environment: the exact pre-market code path, no hazard
+        arrays anywhere downstream."""
+        if not self._hazard_set:
+            self._hazard_set = True
+            market = getattr(self.scheduler, "market", None)
+            if market is not None:
+                self._hazard = market.hazard_vector(
+                    self.env_now, self.host_zones
+                )
+        return self._hazard
+
+    @property
+    def cost_matrix(self) -> np.ndarray:
+        """The tick's ``[Z, Z]`` egress-cost matrix: the market-scaled
+        slice of the ``[P, Z, Z]`` tensor when a
+        :class:`~pivot_tpu.infra.market.MarketSchedule` is attached
+        (``MarketSchedule.cost_matrix_at`` — cached per segment, so ticks
+        inside one price segment share the identical ndarray), else the
+        static ``meta.cost_matrix`` object itself — bit-identical to
+        every pre-market caller."""
+        market = getattr(self.scheduler, "market", None)
+        if market is None:
+            return self.meta.cost_matrix
+        return market.cost_matrix_at(self.env_now, self.meta)
 
 
 class Policy(LogMixin):
@@ -323,6 +357,7 @@ class GlobalScheduler(LogMixin):
         breaker: Optional[HostCircuitBreaker] = None,
         slo: Optional[SloMeter] = None,
         fuse_spans: bool = True,
+        market=None,
     ):
         self.env = env
         self.cluster = cluster
@@ -331,6 +366,19 @@ class GlobalScheduler(LogMixin):
         self.seed = seed
         self.meter = meter
         self.tracer = tracer or NULL_TRACER
+        #: Spot-market environment (``infra/market.py``): per-zone
+        #: time-varying price multipliers and preemption hazards.  When
+        #: set, every :class:`TickContext` exposes the tick's [H] hazard
+        #: vector (risk-aware scoring) and the market-scaled egress-cost
+        #: matrix.  ``None`` (default) keeps the static-world code paths
+        #: bit-identical to pre-market behavior.
+        self.market = market
+        if market is not None and getattr(cluster, "meta", None) is not None:
+            # Eager catalog check: a schedule generated against a different
+            # locality file would otherwise surface deep inside a tick as
+            # an IndexError (hazard gather) or, worse, silently score every
+            # host with the wrong zone's hazard.
+            market.check_zones(cluster.meta)
         #: Retry governance (``sched/retry.py``) — both None by default,
         #: which preserves the reference-parity resubmit-forever loop
         #: bit for bit.  ``slo`` (serving layer) receives shed reasons
@@ -345,6 +393,12 @@ class GlobalScheduler(LogMixin):
         #: Placements that landed on a down or quarantined host — the
         #: invariant auditor asserts this stays empty (infra/audit.py).
         self.placement_violations: List[str] = []
+        #: Proactive-survival counters (``on_preempt_warning``): queued
+        #: tasks migrated off a draining host before starting, and doomed
+        #: running tasks restarted at the warning instead of wasting the
+        #: whole lead window.
+        self.n_migrated = 0
+        self.n_proactive_restarts = 0
         self._attempts: Dict[Task, int] = {}  # failures per live task
         self._failed_apps: set = set()
         self.randomizer = np.random.RandomState(seed)
@@ -478,6 +532,81 @@ class GlobalScheduler(LogMixin):
         self._span_epoch += 1
         self.tracer.emit("app", "withdrawn", self.env.now, id=app.id)
         return True
+
+    # -- proactive spot survival (round 11, ``infra/market.py``) -----------
+    def enable_proactive_drain(self, injector) -> None:
+        """Register this scheduler's proactive-survival handler on a
+        :class:`~pivot_tpu.infra.faults.FaultInjector`: every
+        spot-preemption *warning* (after ``Host.draining`` is set, so the
+        live mask already excludes the host from new placements) runs
+        :meth:`on_preempt_warning`.  Without this call the scheduler is
+        purely reactive — the warning drains, the abort kills, the retry
+        loop restarts — which is the hazard-blind baseline the
+        ``spot_survival`` bench compares against."""
+        if getattr(self.cluster, "executor", None) is None:
+            # Only the 'fast' executor backend exposes eviction; on the
+            # 'process' backend warnings still migrate queued tasks, but
+            # doomed residents burn their whole lead window — results
+            # diverge from the 'fast' backend.
+            self.logger.warning(
+                "proactive drain: cluster executor backend has no "
+                "eviction support (ClusterConfig.executor != 'fast'); "
+                "doomed running tasks will not be restarted early"
+            )
+        injector.add_warning_hook(self.on_preempt_warning)
+
+    def on_preempt_warning(self, host, lead: float = 0.0) -> None:
+        """The drain → migrate → restart half of spot survival (Bamboo /
+        SpotServe shape, PAPERS.md), run at the preemption WARNING:
+
+          * **migrate**: tasks already *placed* on the doomed host but not
+            yet started (sitting in the cluster's dispatch queue) are
+            pulled back to NASCENT and resubmitted for a re-decision next
+            tick — they never touch the host, consume no retry attempt,
+            and re-place with the drain mask (and the risk term) active;
+          * **restart**: running residents that provably cannot conclude
+            before the abort (``now + lead``) are evicted NOW — capacity
+            refunded (the machine is alive), the execution aborted, and
+            the task surfaced as a governed retry — instead of burning
+            the whole lead window on doomed compute that the abort would
+            waste anyway (the reactive arm's rework bill).
+
+        Residents that CAN finish inside the lead are left to drain out —
+        evicting them would turn free completions into retries.  The
+        scheduler-visible mutations bump the span epoch, so any fused
+        span speculating over this instant aborts exactly."""
+        env = self.env
+        # Migrate queued-not-yet-started tasks back to a re-decision.
+        dispatch_q = self.cluster.dispatch_q
+        mine = [
+            t for t in dispatch_q.items
+            if isinstance(t, Task) and t.placement == host.id
+        ]
+        if mine:
+            dispatch_q.items[:] = [
+                t for t in dispatch_q.items if t not in mine
+            ]
+            for task in mine:
+                task.set_nascent()
+                task.placement = None
+                self.submit_q.put(task)
+                self.n_migrated += 1
+                self.tracer.emit(
+                    "task", "migrated", env.now, id=task.id, host=host.id
+                )
+            self._span_epoch += 1
+        # Restart doomed running residents under the retry governor.
+        executor = getattr(self.cluster, "executor", None)
+        if executor is not None and lead >= 0:
+            evicted = executor.evict_doomed(host, env.now + lead)
+            if evicted:
+                self.n_proactive_restarts += len(evicted)
+                for task in evicted:
+                    self.tracer.emit(
+                        "task", "proactive_restart", env.now,
+                        id=task.id, host=host.id,
+                    )
+                self._span_epoch += 1
 
     # -- the tick loop ---------------------------------------------------
     def _dispatch_loop(self):

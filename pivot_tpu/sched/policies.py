@@ -51,7 +51,45 @@ __all__ = [
     "BestFitPolicy",
     "CostAwarePolicy",
     "fold_quarantine",
+    "resolve_risk",
 ]
+
+
+def resolve_risk(ctx: TickContext, risk_weight: float,
+                 rework_cost: float) -> Optional[np.ndarray]:
+    """The tick's ``[H]`` risk penalty vector — ``risk_weight × hazard ×
+    rework_cost`` per host, where ``hazard`` is the spot-market's per-host
+    preemption rate at the tick instant (``TickContext.hazard_vector``)
+    and ``rework_cost`` prices the expected loss of a placement on an
+    evicted host (lost compute-seconds × restart overhead, a scalar knob).
+
+    Returns ``None`` — the exact-bit-parity path, no risk ops traced or
+    evaluated anywhere downstream — when the weight is zero, there is no
+    market environment, or every hazard is zero.  One resolver shared by
+    the CPU policies and the device wrappers, so the two sides can never
+    disagree about when the risk term engages.
+
+    How the vector is consumed (the shared cross-backend rule, mirrored
+    exactly by ``ops/kernels.py``):
+
+      * score-based selections (best-fit residual, cost-aware scores)
+        add it: ``score += risk``;
+      * index-ordered selections (plain first-fit; cost-aware first-fit
+        with ``sort_hosts=False``) replace the index order with the
+        lexicographic ``(risk, host index)`` order — the masked-argmin
+        tie rule gives exactly this for a score of ``risk``;
+      * the opportunistic random choice restricts to the minimum-risk
+        tier of fitting hosts (same Philox draw, narrower support).
+    """
+    if not risk_weight:
+        return None
+    hazard = ctx.hazard_vector
+    if hazard is None:
+        return None
+    risk = risk_weight * rework_cost * hazard
+    if not risk.any():
+        return None
+    return risk
 
 
 def fold_quarantine(ctx: TickContext) -> None:
@@ -113,12 +151,16 @@ class OpportunisticPolicy(Policy):
 
     name = "opportunistic"
 
-    def __init__(self, mode: str = "numpy"):
+    def __init__(self, mode: str = "numpy", risk_weight: float = 0.0,
+                 rework_cost: float = 1.0):
         assert mode in ("naive", "numpy")
         self.mode = mode
+        self.risk_weight = risk_weight
+        self.rework_cost = rework_cost
 
     def place(self, ctx: TickContext) -> np.ndarray:
         fold_quarantine(ctx)
+        risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         if self.mode == "naive":
@@ -127,6 +169,11 @@ class OpportunisticPolicy(Policy):
                 fits = [
                     h for h in range(ctx.n_hosts) if np.all(avail[h] >= demands[i])
                 ]
+                if fits and risk is not None:
+                    # Risk-aware: the random choice narrows to the
+                    # minimum-risk tier of fitting hosts (same draw).
+                    rmin = min(risk[h] for h in fits)
+                    fits = [h for h in fits if risk[h] == rmin]
                 if fits:
                     h = int(rnd.choice(fits))
                     avail[h] -= demands[i]
@@ -136,7 +183,9 @@ class OpportunisticPolicy(Policy):
             # Incremental fit mask over runs of identical demand vectors
             # (instances of one group are adjacent in submission order):
             # placing a task only mutates one host row, so only that mask
-            # entry can change for the next identical demand.
+            # entry can change for the next identical demand.  The risk
+            # tier is applied at SELECTION time against the cached mask,
+            # so the incremental update stays exact.
             prev_d = None
             mask = None
             for i in range(ctx.n_tasks):
@@ -147,6 +196,10 @@ class OpportunisticPolicy(Policy):
                 n_fit = int(mask.sum())
                 if n_fit:
                     fits = np.nonzero(mask)[0]
+                    if risk is not None:
+                        r = risk[fits]
+                        fits = fits[r == r.min()]
+                        n_fit = len(fits)
                     h = int(fits[min(int(u[i] * n_fit), n_fit - 1)])
                     avail[h] -= d
                     row = avail[h]
@@ -160,19 +213,37 @@ class FirstFitPolicy(Policy):
 
     name = "first_fit"
 
-    def __init__(self, decreasing: bool = False, mode: str = "numpy"):
+    def __init__(self, decreasing: bool = False, mode: str = "numpy",
+                 risk_weight: float = 0.0, rework_cost: float = 1.0):
         assert mode in ("naive", "numpy")
         self.decreasing = decreasing
         self.mode = mode
+        self.risk_weight = risk_weight
+        self.rework_cost = rework_cost
 
     def place(self, ctx: TickContext) -> np.ndarray:
         fold_quarantine(ctx)
+        risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         idxs = list(range(ctx.n_tasks))
         if self.decreasing:
             idxs = _sort_decreasing(demands, idxs)
             ctx.visit_order = idxs  # ref returns the sorted list (vbp.py:17)
+        if risk is not None:
+            # Risk-aware first fit: the host visit order becomes the
+            # lexicographic (risk, index) order — argmin over fits of the
+            # risk vector, ties to the lowest index (resolve_risk's
+            # shared rule; identical to the kernels' masked argmin).
+            for i in idxs:
+                d = demands[i]
+                mask = np.all(avail >= d, axis=1)
+                if not mask.any():
+                    continue
+                h = int(np.argmin(np.where(mask, risk, np.inf)))
+                avail[h] -= d
+                placements[i] = h
+            return placements
         if self.mode == "naive":
             for i in idxs:
                 for h in range(ctx.n_hosts):
@@ -213,13 +284,17 @@ class BestFitPolicy(Policy):
 
     name = "best_fit"
 
-    def __init__(self, decreasing: bool = False, mode: str = "numpy"):
+    def __init__(self, decreasing: bool = False, mode: str = "numpy",
+                 risk_weight: float = 0.0, rework_cost: float = 1.0):
         assert mode in ("naive", "numpy")
         self.decreasing = decreasing
         self.mode = mode
+        self.risk_weight = risk_weight
+        self.rework_cost = rework_cost
 
     def place(self, ctx: TickContext) -> np.ndarray:
         fold_quarantine(ctx)
+        risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         idxs = list(range(ctx.n_tasks))
@@ -232,6 +307,8 @@ class BestFitPolicy(Policy):
                 for h in range(ctx.n_hosts):
                     if np.all(avail[h] > demands[i]):  # strict, ref :45
                         score = float(np.linalg.norm(avail[h] - demands[i]))
+                        if risk is not None:
+                            score = score + risk[h]
                         if score < best_score:
                             best, best_score = h, score
                 if best >= 0:
@@ -247,6 +324,8 @@ class BestFitPolicy(Policy):
                 if not _same_demand(d, prev_d):
                     mask = np.all(avail > d, axis=1)  # strict, ref :45
                     residual = _norms(avail - d)
+                    if risk is not None:
+                        residual = residual + risk  # score += risk term
                     residual[~mask] = np.inf
                     prev_d = d
                 h = int(np.argmin(residual))  # lowest index on ties
@@ -257,6 +336,8 @@ class BestFitPolicy(Policy):
                 if _row_fits_strict(row, d):
                     r = row - d  # same ops as _norms(avail - d) row-wise
                     residual[h] = np.sqrt(r[0] * r[0] + r[1] * r[1] + r[2] * r[2] + r[3] * r[3])
+                    if risk is not None:
+                        residual[h] = residual[h] + risk[h]
                 else:
                     residual[h] = np.inf
                 placements[i] = h
@@ -285,6 +366,8 @@ class CostAwarePolicy(Policy):
         realtime_bw: bool = False,
         host_decay: bool = False,
         mode: str = "numpy",
+        risk_weight: float = 0.0,
+        rework_cost: float = 1.0,
     ):
         assert bin_pack in ("first-fit", "best-fit")
         assert mode in ("naive", "numpy")
@@ -294,6 +377,8 @@ class CostAwarePolicy(Policy):
         self.realtime_bw = realtime_bw
         self.host_decay = host_decay
         self.mode = mode
+        self.risk_weight = risk_weight
+        self.rework_cost = rework_cost
 
     # -- grouping --------------------------------------------------------
     def group_tasks(
@@ -341,11 +426,17 @@ class CostAwarePolicy(Policy):
     def _roundtrip_vectors(
         self, ctx: TickContext, anchor
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """([H] roundtrip $ cost, [H] roundtrip bw) anchor↔host."""
+        """([H] roundtrip $ cost, [H] roundtrip bw) anchor↔host.
+
+        The cost matrix comes from ``ctx.cost_matrix`` — the market's
+        time-varying ``[Z, Z]`` slice when a spot-market environment is
+        attached, the static ``meta.cost_matrix`` object itself (same
+        ndarray, bit-identical scores) otherwise."""
         meta = ctx.meta
         az = meta.zone_index[anchor.locality]
         hz = ctx.host_zones
-        cost_rt = meta.cost_matrix[az, hz] + meta.cost_matrix[hz, az]
+        cm = ctx.cost_matrix
+        cost_rt = cm[az, hz] + cm[hz, az]
         if self.realtime_bw:
             bw_rt = np.array(
                 [
@@ -367,6 +458,7 @@ class CostAwarePolicy(Policy):
     # -- placement -------------------------------------------------------
     def place(self, ctx: TickContext) -> np.ndarray:
         fold_quarantine(ctx)
+        risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
         placements = np.full(ctx.n_tasks, -1, dtype=np.int64)
         avail, demands = ctx.avail, ctx.demands
         storage = ctx.cluster.storage
@@ -378,14 +470,19 @@ class CostAwarePolicy(Policy):
                 idxs = _sort_decreasing(demands, idxs)
             cost_rt, bw_rt = self._roundtrip_vectors(ctx, anchor)
             if self.bin_pack == "first-fit":
-                self._first_fit(ctx, idxs, avail, demands, cost_rt, bw_rt, placements)
+                self._first_fit(
+                    ctx, idxs, avail, demands, cost_rt, bw_rt, placements,
+                    risk,
+                )
             else:
                 self._best_fit(
-                    ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
+                    ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks,
+                    placements, risk,
                 )
         return placements
 
-    def _first_fit(self, ctx, idxs, avail, demands, cost_rt, bw_rt, placements) -> None:
+    def _first_fit(self, ctx, idxs, avail, demands, cost_rt, bw_rt,
+                   placements, risk=None) -> None:
         """Hosts sorted once per group by score, then greedy first strict fit
         (ref ``:99-127``; scores use availability at sort time).
 
@@ -401,7 +498,15 @@ class CostAwarePolicy(Policy):
                     * self._decay(ctx, _NO_EXTRA)
                     / (_norms(avail) * bw_rt)
                 )
+            if risk is not None:
+                score = score + risk  # the shared score += risk rule
             order = np.argsort(score, kind="stable")
+        elif risk is not None:
+            # sort_hosts=False is an index-ordered selection: the risk
+            # term replaces it with the lexicographic (risk, index) order
+            # (resolve_risk's shared rule — the kernels' masked argmin
+            # over a score of ``risk`` gives exactly this).
+            order = np.argsort(risk, kind="stable")
         else:
             order = np.arange(ctx.n_hosts)
         if self.mode == "naive":
@@ -448,10 +553,11 @@ class CostAwarePolicy(Policy):
                 start = p
 
     def _best_fit(
-        self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks, placements
+        self, ctx, idxs, avail, demands, cost_rt, bw_rt, extra_tasks,
+        placements, risk=None,
     ) -> None:
         """Per-task min of cost × residual × decay / bw among non-strict fits
-        (ref ``:63-97``)."""
+        (ref ``:63-97``); ``+ risk`` per host when the risk term engages."""
         if self.mode == "naive":
             for i in idxs:
                 best, best_score = -1, np.inf
@@ -465,6 +571,8 @@ class CostAwarePolicy(Policy):
                         else 1.0
                     )
                     score = cost_rt[h] * r * decay / bw_rt[h]
+                    if risk is not None:
+                        score = score + risk[h]
                     if score < best_score:
                         best, best_score = h, score
                 if best >= 0:
@@ -479,6 +587,8 @@ class CostAwarePolicy(Policy):
                 residual = _norms(avail - demands[i])
                 with np.errstate(divide="ignore", invalid="ignore"):
                     score = cost_rt * residual * self._decay(ctx, extra_tasks) / bw_rt
+                if risk is not None:
+                    score = score + risk  # the shared score += risk rule
                 score[~mask] = np.inf
                 h = int(np.argmin(score))
                 avail[h] -= demands[i]

@@ -84,6 +84,7 @@ from pivot_tpu.sched.policies import (
     FirstFitPolicy,
     OpportunisticPolicy,
     _sort_decreasing,
+    resolve_risk,
     resolve_root_anchor,
 )
 from pivot_tpu.sched.rand import tick_uniforms
@@ -180,10 +181,25 @@ class _DevicePolicyBase(Policy):
     _EXPLORE_MARGIN = 8.0
 
     def __init__(self, adaptive: bool = False, phase2="auto",
-                 degrade_after: Optional[int] = None):
+                 degrade_after: Optional[int] = None,
+                 risk_weight: float = 0.0, rework_cost: float = 1.0):
         self.topology: Optional[DeviceTopology] = None
         self._scheduler = None
         self.adaptive = adaptive
+        #: Risk-aware placement (``infra/market.py``): weight and scalar
+        #: rework price of the eviction-risk scoring term.  The per-tick
+        #: [H] vector is resolved host-side by the SAME
+        #: ``policies.resolve_risk`` the CPU policies use (0.0 weight, no
+        #: market, or an all-calm tick ⇒ None ⇒ the risk-free compiled
+        #: program — and today's outputs — bit for bit).
+        self.risk_weight = risk_weight
+        self.rework_cost = rework_cost
+        # Device-staged market state, reset at bind: per-segment [Z, Z]
+        # cost slices (per-tick dispatches) and the [P, Z, Z] stack
+        # (fused spans) — staged once per price segment / market, not
+        # per tick.
+        self._market_cost_dev: dict = {}
+        self._market_stack_dev = None
         #: Graceful degradation (serving self-healing, ``serve/driver``):
         #: after this many CONSECUTIVE device-kernel failures the policy
         #: permanently falls back to its CPU twin — the same numpy
@@ -230,6 +246,8 @@ class _DevicePolicyBase(Policy):
         _enable_compilation_cache()
         self.topology = DeviceTopology.from_cluster(scheduler.cluster, self.dtype)
         self._topology_host = None  # rebind = new cluster; drop the host cache
+        self._market_cost_dev = {}  # rebind = new market/meta; drop staging
+        self._market_stack_dev = None
         if self._mesh is not None:
             self._check_mesh_hosts(self._mesh)  # rebind = new H; re-validate
         if self._cpu_twin is not None:
@@ -368,6 +386,62 @@ class _DevicePolicyBase(Policy):
             return None
         return self._stage(live)
 
+    # -- spot-market risk & prices (``infra/market.py``) -------------------
+    def _risk_arg(self, ctx: TickContext):
+        """The tick's [H] eviction-risk vector staged for the kernels'
+        ``risk`` argument, or None when the term is disengaged
+        (``resolve_risk`` — the shared resolver, so the device kernels
+        and the CPU twins can never disagree about engagement)."""
+        risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
+        if risk is None:
+            return None
+        return self._stage(risk, self.dtype)
+
+    def _market_cost_arg(self, ctx: TickContext):
+        """The tick's ``[Z, Z]`` egress-cost operand: the bind-time
+        static matrix when no market is attached (today's buffers,
+        today's programs), else the market's price-scaled slice for this
+        tick's segment — staged once per segment and reused for every
+        tick inside it."""
+        market = getattr(ctx.scheduler, "market", None)
+        if market is None:
+            return self._staged_topology().cost
+        seg = market.segment(ctx.env_now)
+        buf = self._market_cost_dev.get(seg)
+        if buf is None:
+            buf = self._stage(
+                market.cost_matrix_at(ctx.env_now, ctx.meta), self.dtype
+            )
+            self._market_cost_dev[seg] = buf
+        return buf
+
+    def _span_market_kw(self, ctx: TickContext, plan, K: int) -> dict:
+        """The fused-span market operands (``ops/tickloop.py`` contract):
+        ``risk_rows`` — the [K, H] per-tick risk stack over the span's
+        exact grid instants (same per-tick values ``resolve_risk`` feeds
+        the per-tick path, so span service and per-tick fallback stay
+        placement-identical) — and, for the cost-aware arm, the
+        [P, Z, Z] price-scaled ``cost_stack`` plus the per-span [K]
+        ``cost_seg`` time-index row (the Philox-row pattern).  Empty dict
+        in market-free worlds."""
+        market = getattr(ctx.scheduler, "market", None)
+        if market is None:
+            return {}
+        kw = {}
+        k_dyn = plan.n_ticks
+        if self.risk_weight:
+            hz = ctx.host_zones
+            w = self.risk_weight * self.rework_cost
+            rows = np.zeros((K, len(hz)), dtype=np.float64)
+            # One vectorized [k_dyn] segment lookup + [k_dyn, H] zone
+            # gather — the same per-span time-index pattern as cost_seg —
+            # instead of k_dyn Python-level hazard_vector calls.
+            seg = market.segment_indices(np.asarray(plan.grid[:k_dyn]))
+            rows[:k_dyn] = w * market.hazard[seg][:, hz]
+            if rows.any():
+                kw["risk_rows"] = self._stage(rows, self.dtype)
+        return kw
+
     # -- graceful degradation ----------------------------------------------
     def _note_kernel_failure(self, exc: BaseException) -> None:
         self.kernel_failures += 1
@@ -464,6 +538,7 @@ class _DevicePolicyBase(Policy):
         live = ctx.live_mask
         if live is not None:
             kw["live"] = self._stage(live)
+        kw.update(self._span_market_kw(ctx, plan, K))
         span_args = (
             self._stage(ctx.avail, self.dtype),
             self._stage(dem),
@@ -651,9 +726,13 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
     name = "opportunistic_tpu"
 
     def __init__(self, adaptive: bool = False, phase2="auto",
-                 degrade_after=None):
-        super().__init__(adaptive, phase2, degrade_after)
-        self._cpu_twin = OpportunisticPolicy(mode="numpy")
+                 degrade_after=None, risk_weight: float = 0.0,
+                 rework_cost: float = 1.0):
+        super().__init__(adaptive, phase2, degrade_after,
+                         risk_weight, rework_cost)
+        self._cpu_twin = OpportunisticPolicy(
+            mode="numpy", risk_weight=risk_weight, rework_cost=rework_cost
+        )
 
     def _span_kw(self, ctx, plan, dem_host, B, K):
         # [K, B] positional Philox rows: tick k of the span consumes
@@ -678,6 +757,7 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
         )(
             avail, dem, valid, self._stage(u, self.dtype),
             phase2=self.phase2, live=self._live_arg(ctx),
+            risk=self._risk_arg(ctx),
         )
         return self._unpad(placements, T)
 
@@ -686,10 +766,15 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
     name = "first_fit_tpu"
 
     def __init__(self, decreasing: bool = False, adaptive: bool = False,
-                 phase2="auto", degrade_after=None):
-        super().__init__(adaptive, phase2, degrade_after)
+                 phase2="auto", degrade_after=None,
+                 risk_weight: float = 0.0, rework_cost: float = 1.0):
+        super().__init__(adaptive, phase2, degrade_after,
+                         risk_weight, rework_cost)
         self.decreasing = decreasing
-        self._cpu_twin = FirstFitPolicy(decreasing=decreasing, mode="numpy")
+        self._cpu_twin = FirstFitPolicy(
+            decreasing=decreasing, mode="numpy",
+            risk_weight=risk_weight, rework_cost=rework_cost,
+        )
 
     def _span_kw(self, ctx, plan, dem_host, B, K):
         return dict(
@@ -713,6 +798,7 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
             avail, dem, valid, strict=False,
             totals=self._staged_topology().totals,
             phase2=self.phase2, live=self._live_arg(ctx),
+            risk=self._risk_arg(ctx),
         )
         return self._unpad(placements, T, order)
 
@@ -729,10 +815,14 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
         if self.decreasing:
             order = _sort_decreasing(ctx.demands, list(range(ctx.n_tasks)))
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:17)
+        risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
+        risk_arg = None if risk is None else jnp.asarray(risk, self.dtype)
         return self._mc_sensitivity(
             ctx, order,
             lambda avail_r, dem, valid: jax.vmap(
-                lambda a: first_fit_kernel(a, dem, valid, strict=False)[0]
+                lambda a: first_fit_kernel(
+                    a, dem, valid, strict=False, risk=risk_arg
+                )[0]
             )(avail_r),
             n_replicas, perturb, seed,
         )
@@ -742,10 +832,15 @@ class TpuBestFitPolicy(_DevicePolicyBase):
     name = "best_fit_tpu"
 
     def __init__(self, decreasing: bool = False, adaptive: bool = False,
-                 phase2="auto", degrade_after=None):
-        super().__init__(adaptive, phase2, degrade_after)
+                 phase2="auto", degrade_after=None,
+                 risk_weight: float = 0.0, rework_cost: float = 1.0):
+        super().__init__(adaptive, phase2, degrade_after,
+                         risk_weight, rework_cost)
         self.decreasing = decreasing
-        self._cpu_twin = BestFitPolicy(decreasing=decreasing, mode="numpy")
+        self._cpu_twin = BestFitPolicy(
+            decreasing=decreasing, mode="numpy",
+            risk_weight=risk_weight, rework_cost=rework_cost,
+        )
 
     def _span_kw(self, ctx, plan, dem_host, B, K):
         return dict(
@@ -769,6 +864,7 @@ class TpuBestFitPolicy(_DevicePolicyBase):
             avail, dem, valid,
             totals=self._staged_topology().totals,
             phase2=self.phase2, live=self._live_arg(ctx),
+            risk=self._risk_arg(ctx),
         )
         return self._unpad(placements, T, order)
 
@@ -784,10 +880,12 @@ class TpuBestFitPolicy(_DevicePolicyBase):
         if self.decreasing:
             order = _sort_decreasing(ctx.demands, list(range(ctx.n_tasks)))
             ctx.visit_order = order  # ref returns the sorted list (vbp.py:42)
+        risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
+        risk_arg = None if risk is None else jnp.asarray(risk, self.dtype)
         return self._mc_sensitivity(
             ctx, order,
             lambda avail_r, dem, valid: jax.vmap(
-                lambda a: best_fit_kernel(a, dem, valid)[0]
+                lambda a: best_fit_kernel(a, dem, valid, risk=risk_arg)[0]
             )(avail_r),
             n_replicas, perturb, seed,
         )
@@ -814,8 +912,11 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         adaptive: bool = False,
         phase2="auto",
         degrade_after: Optional[int] = None,
+        risk_weight: float = 0.0,
+        rework_cost: float = 1.0,
     ):
-        super().__init__(adaptive, phase2, degrade_after)
+        super().__init__(adaptive, phase2, degrade_after,
+                         risk_weight, rework_cost)
         assert bin_pack in ("first-fit", "best-fit")
         if realtime_bw and use_pallas:
             raise ValueError(
@@ -849,6 +950,8 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             sort_hosts=sort_hosts,
             host_decay=host_decay,
             realtime_bw=realtime_bw,
+            risk_weight=risk_weight,
+            rework_cost=rework_cost,
         )
         self._cpu_twin = self._grouper
 
@@ -886,7 +989,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 az[i] = zone
                 bucket[i] = bi
         topo = self._staged_topology()
-        return dict(
+        kw = dict(
             policy="cost-aware",
             bin_pack=self.bin_pack,
             sort_tasks=self.sort_tasks,
@@ -904,6 +1007,23 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             totals=topo.totals,
             phase2=self.phase2,
         )
+        market = getattr(ctx.scheduler, "market", None)
+        if market is not None:
+            # Time-varying prices: the [P, Z, Z] stack (staged once per
+            # market) + this span's [K] segment-index row — tick k scores
+            # with cost_stack[cost_seg[k]], the per-tick path's
+            # ``cost_matrix_at`` slice exactly.
+            if self._market_stack_dev is None:
+                self._market_stack_dev = self._stage(
+                    market.cost_tensor(ctx.meta), self.dtype
+                )
+            seg = np.zeros(K, dtype=np.int32)
+            seg[: plan.n_ticks] = market.segment_indices(
+                plan.grid[: plan.n_ticks]
+            )
+            kw["cost_stack"] = self._market_stack_dev
+            kw["cost_seg"] = self._stage(seg)
+        return kw
 
     def _anchor_stream(self, ctx: TickContext):
         """The kernel's per-task anchor stream: ``(order, az_arr [B] i32,
@@ -993,7 +1113,10 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 valid,
                 jnp.asarray(ng_arr),
                 jnp.asarray(az_arr),
-                self.topology.cost,
+                # The tick's cost operand — the market-scaled slice when
+                # a spot market is attached, so replica 0 stays exactly
+                # the production decision.
+                jnp.asarray(self._market_cost_arg(ctx)),
                 self.topology.bw,
                 self.topology.host_zone,
                 jnp.asarray(ctx.host_task_counts, dtype=jnp.int32),
@@ -1003,6 +1126,9 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 sort_hosts=self.sort_hosts,
                 host_decay=self.host_decay,
             )
+            risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
+            if risk is not None:
+                kw["risk"] = jnp.asarray(risk, dtype=self.dtype)
             # Kernel choice mirrors _device_place exactly: an explicit
             # use_pallas override wins, and the auto default requires the
             # TPU backend AND f32 (the Pallas kernel is f32-only — an f64
@@ -1072,6 +1198,11 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             # Both kernel arms accept the quarantine mask; omit it when
             # all-live so the existing compiled programs keep serving.
             kw["live"] = live_arg
+        risk_arg = self._risk_arg(ctx)
+        if risk_arg is not None:
+            # Same pattern for the eviction-risk vector: omitted (None)
+            # whenever the term is disengaged (resolve_risk).
+            kw["risk"] = risk_arg
         topo = self._staged_topology()
         if not use_pallas:
             # Phase-1 demand-vs-total pre-filter (two-phase kernels only —
@@ -1086,7 +1217,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             valid,
             self._stage(ng_arr),
             self._stage(az_arr),
-            topo.cost,
+            self._market_cost_arg(ctx),
             topo.bw,
             topo.host_zone,
             self._stage(ctx.host_task_counts, jnp.int32),

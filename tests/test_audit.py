@@ -157,3 +157,48 @@ def test_worker_failure_propagates(tmp_path):
     )
     with pytest.raises(RuntimeError, match="worker run\\(s\\) failed"):
         cli._run_grid([bad], workers=2)
+
+
+def test_conservation_keys_dead_letters_by_app_and_task(meta):
+    """Regression (round 11): task ids are group-local ("src/1") and
+    collide across apps — the conservation audit must key dead letters
+    by (app, task), or app B's finished "src/1" reads as "both finished
+    and dead-lettered" the moment app A's "src/1" dies."""
+    from types import SimpleNamespace
+
+    from pivot_tpu.infra.audit import audit_conservation
+    from pivot_tpu.workload import Application, TaskGroup
+
+    def one_app(name):
+        g = TaskGroup("src", cpus=1, mem=128, runtime=10.0, instances=1)
+        app = Application(name, [g])
+        g.materialize_tasks()  # materialize src/1
+        return app, g
+
+    app_a, g_a = one_app("app-a")
+    app_b, g_b = one_app("app-b")
+    # App A's src/1 dead-letters (its app fails); app B's src/1 finishes.
+    g_a.tasks[0].set_dead()
+    app_a.failed = True
+    t_b = g_b.tasks[0]
+    t_b.set_submitted()
+    t_b.set_running()
+    t_b.set_finished()
+    scheduler = SimpleNamespace(
+        dead_letters=[SimpleNamespace(
+            task_id=g_a.tasks[0].id, app_id=app_a.id, tier=0,
+            reason="retry_budget", attempts=1,
+        )],
+        retry=None,
+        placement_violations=[],
+    )
+    violations = audit_conservation(scheduler, [app_a, app_b])
+    assert violations == [], violations
+    # And the (app, task) key still catches a REAL double-terminate.
+    t_b_record = SimpleNamespace(
+        task_id=t_b.id, app_id=app_b.id, tier=0,
+        reason="retry_budget", attempts=1,
+    )
+    scheduler.dead_letters.append(t_b_record)
+    violations = audit_conservation(scheduler, [app_a, app_b])
+    assert any("both finished and dead-lettered" in v for v in violations)

@@ -566,3 +566,112 @@ def test_degradation_disabled_raises(meta):
     sched.stop()
     with pytest.raises(RuntimeError, match="injected kernel fault"):
         env.run()
+
+
+# -- schedule-file hardening (round-11 satellites) ---------------------------
+
+
+def test_chaos_schedule_load_rejects_malformed():
+    """A malformed schedule FILE fails eagerly at load with a message
+    naming the broken field — never deep inside apply_schedule."""
+    import json
+
+    def load(events):
+        return ChaosSchedule.loads(json.dumps({
+            "schema": "chaos-schedule", "schema_version": 1,
+            "events": events,
+        }))
+
+    good = {"kind": "preemption", "at": 5.0, "target": "host-0"}
+    assert len(load([good])) == 1
+    with pytest.raises(ValueError, match="missing 'at'"):
+        load([{"kind": "preemption", "target": "host-0"}])
+    with pytest.raises(ValueError, match="missing 'kind'"):
+        load([{"at": 1.0, "target": "host-0"}])
+    with pytest.raises(ValueError, match="missing 'target'"):
+        load([{"kind": "preemption", "at": 1.0}])
+    with pytest.raises(ValueError, match="must be a number"):
+        load([dict(good, at="soon")])
+    with pytest.raises(ValueError, match="unknown chaos event kind"):
+        load([dict(good, kind="meteor_strike")])
+    with pytest.raises(ValueError, match=">= 0"):
+        load([dict(good, at=-3.0)])
+    with pytest.raises(ValueError, match="positive duration"):
+        load([{"kind": "straggler", "at": 1.0, "target": "host-0"}])
+
+
+def test_schedule_files_are_self_describing():
+    """Schema headers: a chaos file refuses the market loader and vice
+    versa; unsupported versions fail with a version message; legacy
+    (pre-round-11) files without the header still load."""
+    from pivot_tpu.infra.market import MarketSchedule
+
+    sched = ChaosSchedule(
+        [ChaosEvent("preemption", 1.0, "host-0", duration=60.0, lead=5.0)],
+        seed=7,
+    )
+    d = sched.to_dict()
+    assert d["schema"] == "chaos-schedule" and d["schema_version"] == 1
+    with pytest.raises(ValueError, match="not a MarketSchedule"):
+        MarketSchedule.from_dict(d)
+    market_d = {
+        "schema": "market-schedule", "schema_version": 1,
+        "times": [0.0], "zones": ["z"], "price": [[1.0]],
+        "hazard": [[0.0]],
+    }
+    with pytest.raises(ValueError, match="not a ChaosSchedule"):
+        ChaosSchedule.from_dict(market_d)
+    with pytest.raises(ValueError, match="version"):
+        ChaosSchedule.from_dict(dict(d, schema_version=42))
+    legacy = {"version": 1, "events": [e.to_dict() for e in sched.events]}
+    assert len(ChaosSchedule.from_dict(legacy)) == 1
+
+
+def test_chaos_diff_is_multiplicity_aware():
+    """An event present twice in one plan and once in the other IS a
+    diff (the old set-based compare silently called them identical)."""
+    ev = ChaosEvent("preemption", 1.0, "host-0", duration=60.0)
+    once = ChaosSchedule([ev])
+    twice = ChaosSchedule([ev, ev])
+    delta = once.diff(twice)
+    assert len(delta) == 1 and delta[0].startswith("+")
+    assert twice.diff(once)[0].startswith("-")
+    assert once.diff(ChaosSchedule([ev])) == []
+
+
+def test_chaos_replay_cli_diff_exits_nonzero_on_drift(tmp_path, meta):
+    """Satellite: the CI determinism step keys on ``chaos_replay diff``'s
+    return code — corrupting ONE event (schedules) or one fault-log
+    entry (reports) must flip it to non-zero."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import chaos_replay
+
+    env, cluster, _ = build(meta, [(4, 4096, 10, 0)] * 8)
+    sched = ChaosSchedule.generate(
+        cluster, seed=3, horizon=500.0, n_preemptions=2, n_stragglers=1,
+    )
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    sched.save(a)
+    sched.save(b)
+    assert chaos_replay.main(["diff", a, b]) == 0
+    d = sched.to_dict()
+    d["events"][0]["at"] += 1.0  # corrupt one event
+    with open(b, "w") as f:
+        json.dump(d, f)
+    assert chaos_replay.main(["diff", a, b]) == 1
+    # Run-report drift: one fault-log entry differs -> non-zero.
+    rep = {"fault_log": [[1.0, "host-0", "failed"]], "meter": {"x": 1}}
+    ra, rb = str(tmp_path / "ra.json"), str(tmp_path / "rb.json")
+    with open(ra, "w") as f:
+        json.dump(rep, f)
+    rep["fault_log"][0][0] = 2.0
+    with open(rb, "w") as f:
+        json.dump(rep, f)
+    assert chaos_replay.main(["diff", ra, ra]) == 0
+    assert chaos_replay.main(["diff", ra, rb]) == 1

@@ -175,3 +175,41 @@ def test_zone_round_robin_balance(meta):
     for h in cluster.hosts:
         counts[h.locality] = counts.get(h.locality, 0) + 1
     assert set(counts.values()) == {2}  # 62 hosts over 31 zones -> 2 each
+
+
+def test_filter_xla_aot_noise_pins_markers():
+    """Regression (round-11 satellite): the PR-8 multichip capture path
+    filters child stderr through ``filter_xla_aot_noise`` — pin the
+    filter against representative AOT-cache-mismatch lines so a marker
+    drift cannot silently start swallowing REAL errors."""
+    from pivot_tpu.utils import filter_xla_aot_noise
+
+    noise = [
+        # Representative XLA:CPU AOT feature-mismatch chatter (the
+        # shapes logged by this fleet's CPU fallback).
+        "2026-01-01 00:00:00.000000: W xla/service/cpu/cpu_aot_loader"
+        ".cc:120] Compiled-module CPU features mismatch; ignoring "
+        "AOT cache entry",
+        "W0000 00:00 cpu_aot_loader.cc] falling back to JIT compilation",
+        "XLA:CPU AOT compilation cache miss: target features differ",
+    ]
+    real = [
+        "Traceback (most recent call last):",
+        '  File "bench.py", line 1, in <module>',
+        "RuntimeError: device tunnel wedged",
+        "F0000 fatal_error.cc:10] check failed: something real",
+    ]
+    text = "\n".join(noise[:1] + real[:2] + noise[1:] + real[2:]) + "\n"
+    out = filter_xla_aot_noise(text)
+    for ln in noise:
+        assert ln not in out, f"noise survived: {ln!r}"
+    for ln in real:
+        assert ln in out, f"real error swallowed: {ln!r}"
+    # Trailing-newline contract: re-emitting with end='' cannot glue
+    # the last kept line onto the caller's next write.
+    assert out.endswith("\n")
+    # All-noise input collapses to empty (no stray newline).
+    assert filter_xla_aot_noise(noise[0] + "\n") == ""
+    # Pure pass-through when nothing matches.
+    clean = "ordinary stderr line\n"
+    assert filter_xla_aot_noise(clean) == clean

@@ -1,0 +1,824 @@
+"""Spot-market environment tests (round 11, ``pivot_tpu/infra/market.py``).
+
+Covers the whole ISSUE-9 stack:
+
+  * :class:`MarketSchedule` — generation determinism, JSON round-trip,
+    eager validation, segment lookup, the time-varying cost tensor, the
+    hazard-proportional preemption plan, and price-trace billing;
+  * the **risk term** — cross-backend bit-parity of the shared rules
+    (score += risk / lexicographic (risk, index) / minimum-risk-tier)
+    across the scan oracles, the slim and chunk two-phase forms, and
+    the fused span driver with its per-span market operands;
+  * the scheduler wiring — ``TickContext.hazard_vector`` /
+    ``cost_matrix``, ``resolve_risk`` gating (weight 0, no market, calm
+    tick ⇒ None ⇒ today's exact code path), proactive drain / migrate /
+    restart (``GlobalScheduler.on_preempt_warning``,
+    ``FastExecutor.evict_task``/``evict_doomed``) and rework billing;
+  * the acceptance soak — risk-aware + proactive strictly beats
+    hazard-blind on cost-per-completed-task AND dead-letter rate under
+    the identical market, audits clean, replay bit-deterministic;
+  * the ``tools/market_replay.py`` CLI, including the non-zero exit on
+    report drift the CI determinism step keys on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pivot_tpu.infra.faults import ChaosSchedule
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.market import MarketSchedule
+from pivot_tpu.ops.kernels import (
+    best_fit_kernel_ref,
+    best_fit_impl,
+    cost_aware_kernel_ref,
+    cost_aware_impl,
+    first_fit_kernel_ref,
+    first_fit_impl,
+    opportunistic_kernel_ref,
+    opportunistic_impl,
+)
+from pivot_tpu.ops.tickloop import (
+    fused_tick_run,
+    reference_tick_run,
+    span_bucket,
+)
+
+
+@pytest.fixture(scope="module")
+def meta():
+    return ResourceMetadata(seed=0)
+
+
+def small_market(meta, seed=5, horizon=400.0, **kw):
+    kw.setdefault("n_segments", 4)
+    kw.setdefault("hot_fraction", 0.3)
+    kw.setdefault("hot_hazard", 1e-2)
+    kw.setdefault("base_hazard", 1e-4)
+    return MarketSchedule.generate(meta, seed=seed, horizon=horizon, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MarketSchedule — the serializable environment
+# ---------------------------------------------------------------------------
+
+
+def test_market_generate_deterministic_and_roundtrip(meta):
+    m = small_market(meta)
+    assert m == small_market(meta)
+    assert small_market(meta, seed=6) != m
+    m2 = MarketSchedule.loads(m.dumps())
+    assert m2 == m and m.diff(m2) == []
+    # Floats survive the JSON trip bit-exactly (repr round-trip).
+    assert np.array_equal(m2.price, m.price)
+    assert np.array_equal(m2.hazard, m.hazard)
+    # diff localizes a perturbation.
+    d = m2.to_dict()
+    d["price"][1][2] *= 1.5
+    delta = m.diff(MarketSchedule.from_dict(d))
+    assert len(delta) == 1 and m.zones[2] in delta[0]
+
+
+def test_market_validation_eager():
+    zones = ["z0", "z1"]
+    ones = np.ones((2, 2))
+    with pytest.raises(ValueError, match="at least one segment"):
+        MarketSchedule([], zones, np.zeros((0, 2)), np.zeros((0, 2)))
+    with pytest.raises(ValueError, match=r"times\[0\]"):
+        MarketSchedule([1.0, 2.0], zones, ones, ones)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        MarketSchedule([0.0, 0.0], zones, ones, ones)
+    with pytest.raises(ValueError, match="price"):
+        MarketSchedule([0.0, 1.0], zones, -ones, ones)
+    with pytest.raises(ValueError, match="hazard"):
+        MarketSchedule([0.0, 1.0], zones, ones, np.full((2, 2), np.nan))
+    with pytest.raises(ValueError, match="segments x zones|must be"):
+        MarketSchedule([0.0, 1.0], zones, np.ones((3, 2)), ones)
+    # Self-describing files: wrong schema / version / missing keys.
+    good = MarketSchedule([0.0, 1.0], zones, ones, ones).to_dict()
+    assert good["schema"] == "market-schedule"
+    with pytest.raises(ValueError, match="schema"):
+        MarketSchedule.from_dict(dict(good, schema="chaos-schedule"))
+    with pytest.raises(ValueError, match="schema_version"):
+        MarketSchedule.from_dict(dict(good, schema_version=99))
+    bad = dict(good)
+    del bad["hazard"]
+    with pytest.raises(ValueError, match="hazard"):
+        MarketSchedule.from_dict(bad)
+    with pytest.raises(ValueError, match="n_segments"):
+        MarketSchedule.generate(None, seed=0, horizon=10.0, n_segments=0)
+
+
+def test_spot_schedule_requires_a_horizon():
+    """A schedule that records no horizon (hand-built / hand-edited file)
+    must refuse to draw a plan rather than silently fall back to
+    times[-1], which would make the final segment's window empty and
+    drop its share of the expected preemptions."""
+    m = MarketSchedule([0.0, 100.0], ["z0"], np.ones((2, 1)),
+                       np.ones((2, 1)))
+    with pytest.raises(ValueError, match="needs a horizon"):
+        m.spot_schedule(cluster=type("C", (), {"hosts": []})(), seed=0)
+
+
+def test_cost_matrix_cache_refreshes_on_meta_rebind(meta):
+    """Per-segment cost matrices are identity-cached per metadata object;
+    rebinding to a different meta (same zone catalog, different costs —
+    e.g. sequential sensitivity runs) must serve fresh matrices, never a
+    stale cache entry."""
+
+    class _Meta:
+        def __init__(self, zones, cost_matrix):
+            self.zones = zones
+            self.cost_matrix = cost_matrix
+
+    market = small_market(meta)
+    nz = len(market.zones)
+    m1 = _Meta(meta.zones, np.ones((nz, nz)))
+    m2 = _Meta(meta.zones, 2.0 * np.ones((nz, nz)))
+    a = market.cost_matrix_at(0.0, m1)
+    assert market.cost_matrix_at(0.0, m1) is a  # same meta: cache hit
+    b = market.cost_matrix_at(0.0, m2)
+    np.testing.assert_array_equal(b, 2.0 * a)
+
+
+def test_market_zone_catalog_mismatch_rejected_eagerly(meta):
+    """A schedule generated against a different zone catalog must fail
+    loudly at attach time (GlobalScheduler construction) and at the
+    hazard gather — not as a bare IndexError deep inside a tick, and
+    never as silently-wrong per-host hazards."""
+    wrong = MarketSchedule([0.0], ["z0", "z1"], np.ones((1, 2)),
+                           np.ones((1, 2)))
+    with pytest.raises(ValueError, match="zone"):
+        _market_world(meta, market=wrong)
+    # Direct hazard gather with out-of-catalog host zone indices.
+    with pytest.raises(ValueError, match="out of range"):
+        wrong.hazard_vector(0.0, [0, 1, 2])
+
+
+def test_proactive_drain_warns_without_eviction_backend(meta, caplog):
+    """On an executor backend with no eviction support the restart half
+    of proactive survival is inert; enabling it must say so instead of
+    silently diverging from the 'fast' backend."""
+    import logging
+
+    from pivot_tpu.infra.faults import FaultInjector
+
+    env, cluster, sched = _market_world(meta)
+    cluster.executor = None  # the 'process' backend shape
+    inj = FaultInjector(cluster, seed=0)
+    # The package logger sets propagate=False, so hook its logger directly.
+    logger = logging.getLogger("pivot_tpu.GlobalScheduler")
+    logger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.WARNING):
+            sched.enable_proactive_drain(inj)
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert any("eviction" in r.message for r in caplog.records)
+
+
+def test_market_segment_lookup_and_rows(meta):
+    m = MarketSchedule(
+        [0.0, 100.0, 250.0],
+        [repr(z) for z in meta.zones],
+        np.arange(3 * len(meta.zones), dtype=float).reshape(3, -1),
+        np.ones((3, len(meta.zones))),
+    )
+    assert m.segment(0.0) == 0
+    assert m.segment(99.9) == 0
+    assert m.segment(100.0) == 1
+    assert m.segment(1e9) == 2  # clamped past the last breakpoint
+    assert m.segment(-5.0) == 0  # clamped before the first
+    np.testing.assert_array_equal(
+        m.segment_indices([0.0, 120.0, 250.0, 400.0]),
+        np.array([0, 1, 2, 2], np.int32),
+    )
+    np.testing.assert_array_equal(m.price_row(120.0), m.price[1])
+    hz = np.array([0, 2, 1, 2])
+    np.testing.assert_array_equal(
+        m.hazard_vector(0.0, hz), m.hazard[0][hz]
+    )
+
+
+def test_market_cost_tensor_scales_by_source_zone(meta):
+    m = small_market(meta)
+    base = meta.cost_matrix
+    t = 150.0
+    p = m.segment(t)
+    mat = m.cost_matrix_at(t, meta)
+    np.testing.assert_array_equal(mat, base * m.price[p][:, None])
+    # Per-segment identity caching: ticks in one segment share the array.
+    assert m.cost_matrix_at(t + 1.0, meta) is mat
+    # The [P, Z, Z] stack agrees slice-by-slice with the per-tick lookup.
+    stack = m.cost_tensor(meta)
+    np.testing.assert_array_equal(stack[p], mat)
+    # Zone-catalog mismatch is an eager error, not silent misindexing.
+    other = MarketSchedule(
+        [0.0], ["bogus/zone/a"], np.ones((1, 1)), np.zeros((1, 1))
+    )
+    with pytest.raises(ValueError, match="zone"):
+        other.cost_matrix_at(0.0, meta)
+
+
+def _tiny_cluster(meta, n_hosts=8, seed=0):
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra import Cluster, Host, Storage
+    from pivot_tpu.utils import reset_ids
+
+    reset_ids()
+    env = Environment()
+    zones = meta.zones
+    hosts = [
+        Host(env, 4, 4096, 10, 0, locality=zones[i % 4])
+        for i in range(n_hosts)
+    ]
+    storage = [
+        Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)
+    ]
+    cluster = Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, route_mode="meta",
+        seed=seed,
+    )
+    return env, cluster
+
+
+def test_spot_schedule_hazard_proportional_and_deterministic(meta):
+    env, cluster = _tiny_cluster(meta)
+    m = small_market(meta, hot_hazard=5e-2, base_hazard=0.0)
+    plan = m.spot_schedule(cluster, seed=9, lead=12.0, outage=77.0)
+    plan2 = m.spot_schedule(cluster, seed=9, lead=12.0, outage=77.0)
+    assert plan.to_dict() == plan2.to_dict()  # pure function of inputs
+    assert plan.to_dict() != m.spot_schedule(cluster, seed=10).to_dict()
+    hot = set(m.meta["hot_zones"])
+    host_zone = {h.id: repr(h.locality) for h in cluster.hosts}
+    assert len(plan) > 0
+    for ev in plan.events:
+        assert ev.kind == "preemption"
+        assert ev.lead == 12.0 and ev.duration == 77.0
+        assert 0.0 <= ev.at <= 400.0
+        # base_hazard=0 ⇒ every victim sits in a hot zone.
+        assert host_zone[ev.target] in hot
+    # A zero-hazard market draws an empty plan; the schedule replays
+    # through the ChaosSchedule lifecycle (self-describing JSON).
+    calm = small_market(meta, hot_hazard=0.0, base_hazard=0.0)
+    assert len(calm.spot_schedule(cluster, seed=9)) == 0
+    assert ChaosSchedule.loads(plan.dumps()).to_dict() == plan.to_dict()
+    with pytest.raises(ValueError, match="lead"):
+        m.spot_schedule(cluster, seed=0, lead=-1.0)
+    with pytest.raises(ValueError, match="horizon"):
+        m.spot_schedule(cluster, seed=0, horizon=0.0)
+
+
+def test_billed_instance_cost_exact_piecewise_integral(meta):
+    env, cluster = _tiny_cluster(meta, n_hosts=2)
+    h0, h1 = cluster.hosts
+    zones = [repr(z) for z in meta.zones]
+    z0 = zones.index(repr(h0.locality))
+    price = np.ones((2, len(zones)))
+    price[0, z0] = 2.0  # segment [0, 100): host-0's zone at 2x
+    price[1, z0] = 0.5  # segment [100, inf): at 0.5x
+    m = MarketSchedule([0.0, 100.0], zones, price, np.zeros_like(price))
+
+    class FakeMeter:
+        _host_intervals = {h0: [[50.0, 150.0]], h1: [[0.0, 10.0]]}
+
+    z1 = zones.index(repr(h1.locality))
+    expect = (50.0 * 2.0 + 50.0 * 0.5) + 10.0 * price[0, z1]
+    got = m.billed_instance_cost(FakeMeter(), cluster, rate_per_hour=3600.0)
+    assert got == pytest.approx(expect, rel=1e-12)
+    # Open interval clamps to `end`.
+    FakeMeter._host_intervals = {h0: [[90.0]]}
+    got = m.billed_instance_cost(
+        FakeMeter(), cluster, rate_per_hour=3600.0, end=120.0
+    )
+    assert got == pytest.approx(10.0 * 2.0 + 20.0 * 0.5, rel=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# The risk term — cross-backend parity of the shared rules
+# ---------------------------------------------------------------------------
+
+H, T = 24, 20
+
+
+def _risk_inputs(seed=0, ties=True):
+    rng = np.random.default_rng(seed)
+    avail = jnp.asarray(rng.uniform(1, 6, (H, 4)))
+    dem = jnp.asarray(rng.uniform(0.3, 2.0, (T, 4)))
+    valid = jnp.ones(T, bool)
+    u = jnp.asarray(rng.random(T))
+    # A tiered risk vector WITH ties, so the min-risk-tier and the
+    # lexicographic tie-breaks are actually exercised.
+    risk = rng.choice([0.0, 0.4, 1.5], size=H) if ties else rng.random(H)
+    return avail, dem, valid, u, jnp.asarray(risk)
+
+
+def _ca_risk_args(seed=3):
+    rng = np.random.default_rng(seed)
+    Z = 4
+    return dict(
+        new_group=jnp.asarray(
+            np.arange(T) % 5 == 0
+        ),
+        anchor_zone=jnp.asarray(rng.integers(0, Z, T).astype(np.int32)),
+        cost_zz=jnp.asarray(rng.uniform(0.01, 0.2, (Z, Z))),
+        bw_zz=jnp.asarray(rng.uniform(50, 500, (Z, Z))),
+        host_zone=jnp.asarray(rng.integers(0, Z, H), dtype=jnp.int32),
+        base_task_counts=jnp.asarray(
+            rng.integers(0, 3, H), dtype=jnp.int32
+        ),
+    )
+
+
+def _pair_eq(name, a, b):
+    np.testing.assert_array_equal(
+        np.asarray(a[0]), np.asarray(b[0]), err_msg=f"{name}: placements"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a[1]), np.asarray(b[1]), err_msg=f"{name}: avail"
+    )
+
+
+@pytest.mark.parametrize("phase2", ["slim", 8])
+def test_risk_parity_two_phase_vs_scan_oracle(phase2):
+    """Every two-phase form scores risk identically to the scan oracle —
+    the cross-backend rule has exactly one behavior per policy."""
+    avail, dem, valid, u, risk = _risk_inputs()
+    _pair_eq(
+        f"opportunistic:{phase2}",
+        opportunistic_kernel_ref(avail, dem, valid, u, risk=risk),
+        opportunistic_impl(avail, dem, valid, u, phase2=phase2, risk=risk),
+    )
+    _pair_eq(
+        f"first_fit:{phase2}",
+        first_fit_kernel_ref(avail, dem, valid, risk=risk),
+        first_fit_impl(avail, dem, valid, phase2=phase2, risk=risk),
+    )
+    _pair_eq(
+        f"best_fit:{phase2}",
+        best_fit_kernel_ref(avail, dem, valid, risk=risk),
+        best_fit_impl(avail, dem, valid, phase2=phase2, risk=risk),
+    )
+    ca = _ca_risk_args()
+    for mode in (
+        dict(bin_pack="first-fit", sort_hosts=True),
+        dict(bin_pack="first-fit", sort_hosts=False),
+        dict(bin_pack="best-fit", host_decay=True),
+    ):
+        _pair_eq(
+            f"cost_aware:{mode}:{phase2}",
+            cost_aware_kernel_ref(avail, dem, valid, **ca, **mode,
+                                  risk=risk),
+            cost_aware_impl(avail, dem, valid, **ca, **mode,
+                            phase2=phase2, risk=risk),
+        )
+
+
+def test_risk_zero_vector_matches_risk_free_placements():
+    """An all-zero risk vector is semantically the identity: same
+    placements and availability as ``risk=None`` for every policy (the
+    traced program differs; the decisions cannot)."""
+    avail, dem, valid, u, _ = _risk_inputs()
+    zero = jnp.zeros(H, avail.dtype)
+    _pair_eq(
+        "opportunistic",
+        opportunistic_kernel_ref(avail, dem, valid, u),
+        opportunistic_kernel_ref(avail, dem, valid, u, risk=zero),
+    )
+    _pair_eq(
+        "first_fit",
+        first_fit_kernel_ref(avail, dem, valid),
+        first_fit_kernel_ref(avail, dem, valid, risk=zero),
+    )
+    _pair_eq(
+        "best_fit",
+        best_fit_kernel_ref(avail, dem, valid),
+        best_fit_kernel_ref(avail, dem, valid, risk=zero),
+    )
+    ca = _ca_risk_args()
+    for mode in (
+        dict(bin_pack="first-fit", sort_hosts=False),
+        dict(bin_pack="best-fit"),
+    ):
+        _pair_eq(
+            f"cost_aware:{mode}",
+            cost_aware_kernel_ref(avail, dem, valid, **ca, **mode),
+            cost_aware_kernel_ref(avail, dem, valid, **ca, **mode,
+                                  risk=zero),
+        )
+
+
+def test_risk_rules_semantics():
+    """Hand-checkable cases pin the three rules themselves (not just
+    form-vs-form agreement): min-risk-tier restriction, lexicographic
+    (risk, index) first fit, and the additive score shift."""
+    avail = jnp.asarray(np.tile([[4.0, 4.0, 4.0, 4.0]], (6, 1)))
+    dem = jnp.asarray([[1.0, 1.0, 1.0, 1.0]])
+    valid = jnp.ones(1, bool)
+    risk = jnp.asarray([3.0, 1.0, 1.0, 2.0, 1.0, 3.0])
+    # First fit: lowest risk wins, ties to the lowest index -> host 1.
+    p, _ = first_fit_kernel_ref(avail, dem, valid, risk=risk)
+    assert int(p[0]) == 1
+    # Opportunistic: any uniform lands inside the min-risk tier {1,2,4}.
+    for uval in (0.01, 0.5, 0.99):
+        p, _ = opportunistic_kernel_ref(
+            avail, dem, valid, jnp.asarray([uval]), risk=risk
+        )
+        assert int(p[0]) in (1, 2, 4)
+    # Best fit: equal residuals everywhere -> risk decides (host 1).
+    p, _ = best_fit_kernel_ref(avail, dem, valid, risk=risk)
+    assert int(p[0]) == 1
+    # score += risk can overturn a better residual: make host 0 the
+    # tightest fit but expensive in risk.
+    avail2 = jnp.asarray(np.tile([[4.0, 4.0, 4.0, 4.0]], (6, 1))).at[0].set(
+        jnp.asarray([1.5, 1.5, 1.5, 1.5])
+    )
+    # Host 0's residual is 5.0 tighter; a 10.0 risk premium overturns it.
+    steep = jnp.asarray([10.0, 1.0, 1.0, 2.0, 1.0, 3.0])
+    p_free, _ = best_fit_kernel_ref(avail2, dem, valid)
+    p_risk, _ = best_fit_kernel_ref(avail2, dem, valid, risk=steep)
+    assert int(p_free[0]) == 0 and int(p_risk[0]) == 1
+
+
+@pytest.mark.parametrize(
+    "policy_kw",
+    [
+        dict(policy="opportunistic"),
+        dict(policy="first-fit"),
+        dict(policy="best-fit", decreasing=True),
+        dict(policy="cost-aware", bin_pack="first-fit", sort_tasks=True),
+        dict(policy="cost-aware", bin_pack="best-fit", host_decay=True),
+    ],
+    ids=lambda kw: kw["policy"] + (
+        ":" + kw.get("bin_pack", "") if "bin_pack" in kw else ""
+    ),
+)
+def test_fused_span_market_parity(policy_kw):
+    """The fused span driver consumes the per-span market operands —
+    risk_rows [K, H] and (cost-aware) cost_stack[cost_seg[k]] — tick for
+    tick exactly as the per-tick referee does."""
+    K = 8
+    rng = np.random.default_rng(11)
+    B = 24
+    avail = rng.uniform(1, 6, (H, 4))
+    dem = rng.uniform(0.3, 2.2, (B, 4))
+    arrive = np.zeros(B, np.int32)
+    arrive[12:18] = 2
+    arrive[18:24] = 4
+    norms = np.sqrt((dem * dem).sum(1))
+    Kb = span_bucket(K)
+    risk_rows = jnp.asarray(
+        rng.choice([0.0, 0.3, 1.0], size=(Kb, H))
+    )
+    kw = dict(policy_kw)
+    kw["uniforms"] = (
+        jnp.asarray(rng.random((Kb, B)))
+        if kw["policy"] == "opportunistic" else None
+    )
+    kw["sort_norm"] = jnp.asarray(norms)
+    kw["risk_rows"] = risk_rows
+    if kw["policy"] == "cost-aware":
+        Z, P = 4, 3
+        ca = _ca_risk_args()
+        kw.update(
+            anchor_zone=jnp.asarray(
+                rng.integers(0, Z, B).astype(np.int32)
+            ),
+            bucket_id=jnp.asarray(rng.integers(0, 5, B).astype(np.int32)),
+            cost_zz=ca["cost_zz"],
+            bw_zz=ca["bw_zz"],
+            host_zone=ca["host_zone"],
+            base_task_counts=ca["base_task_counts"],
+            cost_stack=jnp.asarray(rng.uniform(0.01, 0.3, (P, Z, Z))),
+            cost_seg=jnp.asarray(
+                np.clip(np.arange(Kb) // 3, 0, P - 1).astype(np.int32)
+            ),
+        )
+    res = fused_tick_run(
+        jnp.asarray(avail), jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(K, jnp.int32), n_ticks=Kb, **kw,
+    )
+    ref_p, _ref_nr, ref_np, ref_avail = reference_tick_run(
+        avail, dem, arrive, Kb, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(res.placements), ref_p)
+    np.testing.assert_array_equal(np.asarray(res.avail), ref_avail)
+    np.testing.assert_array_equal(np.asarray(res.n_placed), ref_np)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler wiring — hazard vector, cost matrix, resolve_risk gating
+# ---------------------------------------------------------------------------
+
+
+def _market_world(meta, market=None, policy=None, retry=None, n_hosts=6):
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra import Cluster, Host, Storage
+    from pivot_tpu.infra.meter import Meter
+    from pivot_tpu.sched import GlobalScheduler
+    from pivot_tpu.sched.policies import FirstFitPolicy
+    from pivot_tpu.utils import reset_ids
+
+    reset_ids()
+    env = Environment()
+    meter = Meter(env, meta)
+    zones = meta.zones
+    hosts = [
+        Host(env, 4, 4096, 10, 0, locality=zones[i % 3], meter=meter)
+        for i in range(n_hosts)
+    ]
+    storage = [
+        Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)
+    ]
+    cluster = Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, meter=meter,
+        route_mode="meta", seed=0,
+    )
+    scheduler = GlobalScheduler(
+        env, cluster, policy or FirstFitPolicy(), interval=5,
+        seed=0, meter=meter, retry=retry, market=market,
+    )
+    cluster.start()
+    scheduler.start()
+    return env, cluster, scheduler
+
+
+def test_tick_context_market_properties(meta):
+    from pivot_tpu.sched import TickContext
+    from pivot_tpu.sched.policies import resolve_risk
+
+    market = small_market(meta)
+    env, cluster, sched = _market_world(meta, market=market)
+    ctx = TickContext(sched, [], 0)
+    hz = ctx.host_zones
+    np.testing.assert_array_equal(
+        ctx.hazard_vector, market.hazard_vector(env.now, hz)
+    )
+    assert ctx.cost_matrix is market.cost_matrix_at(env.now, meta)
+    # resolve_risk: engaged only when weight x hazard is live.
+    assert resolve_risk(ctx, 0.0, 10.0) is None
+    r = resolve_risk(ctx, 2.0, 10.0)
+    np.testing.assert_array_equal(r, 2.0 * 10.0 * ctx.hazard_vector)
+
+    # No market: the static world, no arrays anywhere.
+    env2, cluster2, sched2 = _market_world(meta, market=None)
+    ctx2 = TickContext(sched2, [], 0)
+    assert ctx2.hazard_vector is None
+    assert ctx2.cost_matrix is meta.cost_matrix
+    assert resolve_risk(ctx2, 5.0, 10.0) is None
+    # Market with zero hazard everywhere: also disengaged.
+    calm = small_market(meta, hot_hazard=0.0, base_hazard=0.0)
+    env3, _, sched3 = _market_world(meta, market=calm)
+    ctx3 = TickContext(sched3, [], 0)
+    assert resolve_risk(ctx3, 5.0, 10.0) is None
+
+
+def test_flat_market_is_cost_identity(meta):
+    """A price≡1 market leaves the cost matrix bit-identical to the
+    static table (x * 1.0 is exact), so attaching a flat market cannot
+    move any cost-aware score."""
+    zones = [repr(z) for z in meta.zones]
+    flat = MarketSchedule(
+        [0.0], zones, np.ones((1, len(zones))), np.zeros((1, len(zones)))
+    )
+    np.testing.assert_array_equal(
+        flat.cost_matrix_at(123.0, meta), meta.cost_matrix
+    )
+
+
+# ---------------------------------------------------------------------------
+# Proactive survival — drain, migrate, restart
+# ---------------------------------------------------------------------------
+
+
+def _one_task_app(runtime=50.0, instances=1):
+    from pivot_tpu.workload import Application, TaskGroup
+
+    g = TaskGroup("g", cpus=1, mem=128, runtime=runtime,
+                  instances=instances)
+    return Application(f"spotapp-{runtime}-{instances}", [g]), g
+
+
+def test_evict_doomed_restarts_long_tasks_only(meta):
+    """A resident whose conclusion provably overruns the abort deadline
+    is evicted at the warning (capacity refunded, rework billed, retry
+    surfaced); one that finishes inside the lead drains out untouched."""
+    from pivot_tpu.infra.faults import FaultInjector
+    from pivot_tpu.sched import RetryPolicy
+
+    env, cluster, sched = _market_world(
+        meta, retry=RetryPolicy(max_retries=3, base=1.0, seed=0)
+    )
+    inj = FaultInjector(cluster, seed=0)
+    sched.enable_proactive_drain(inj)
+    app_long, g_long = _one_task_app(runtime=300.0)
+    app_short, g_short = _one_task_app(runtime=1.0)
+    sched.submit(app_long)
+    sched.submit(app_short)
+    # Let both place and start, then fire a warning with a 20 s lead.
+    env.run(until=12.0)
+    running_hosts = {
+        t.placement for t in g_long.tasks + g_short.tasks
+    }
+    assert None not in running_hosts, "tasks did not place"
+    host_long = next(
+        h for h in cluster.hosts if h.id == g_long.tasks[0].placement
+    )
+    inj.preempt_host(host_long.id, at=15.0, lead=20.0, outage=60.0)
+    env.run(until=16.0)  # warning fired at 15.0
+    # The 300 s task cannot finish by 35.0 -> proactively restarted.
+    assert sched.n_proactive_restarts >= 1
+    assert cluster.env.now < 35.0
+    sched.stop()
+    env.run()
+    assert app_long.is_finished and app_short.is_finished
+    assert sched.meter.rework_seconds > 0.0
+    from pivot_tpu.infra.audit import audit_conservation
+
+    assert audit_conservation(sched, [app_long, app_short]) == []
+
+
+def test_preempt_warning_migrates_queued_tasks(meta):
+    """Tasks placed on the doomed host but still queued (not started)
+    are pulled back to NASCENT and resubmitted — no retry attempt
+    consumed, no rework billed for them."""
+    from pivot_tpu.sched import GlobalScheduler
+    from pivot_tpu.workload import TaskState
+
+    env, cluster, sched = _market_world(meta)
+    app, g = _one_task_app(runtime=30.0)
+    sched.submit(app)
+    env.run(until=6.0)
+    task = g.tasks[0]
+    # Rewind the dispatch: simulate the task still sitting in the
+    # dispatch queue with its placement decided.
+    host = next(h for h in cluster.hosts if h.id == task.placement)
+    # Drain the real execution state and park the task back in queue.
+    cluster.executor.evict_task(task, host)
+    task.set_nascent()
+    task.placement = host.id
+    cluster.dispatch_q.items.append(task)
+    epoch_before = sched._span_epoch
+    sched.on_preempt_warning(host, lead=10.0)
+    assert sched.n_migrated == 1
+    assert task not in cluster.dispatch_q.items
+    assert task.placement is None and task.state == TaskState.NASCENT
+    assert sched._span_epoch > epoch_before  # spans over this instant abort
+    sched.stop()
+    env.run()
+    assert app.is_finished
+
+
+def test_full_sim_market_parity_cpu_vs_device(meta):
+    """End-to-end under a LIVE market + risk term: the device policy
+    (kernels fed the staged hazard vector and the price-scaled cost
+    slice, spans fed the [K, H] risk rows + cost stack) produces the
+    same metrics as the numpy policy — the wiring twin of
+    ``test_kernels.test_full_sim_parity_cost_aware``."""
+    import jax.numpy as jnp2
+
+    from pivot_tpu.des import Environment
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+    from pivot_tpu.sched.policies import CostAwarePolicy
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+
+    market = small_market(meta, hot_hazard=2e-2, base_hazard=1e-3,
+                          horizon=100000.0)
+    gen = RandomClusterGenerator(
+        Environment(), (16, 16), (128 * 1024,) * 2, (100, 100), (1, 1),
+        meta=meta, seed=0,
+    )
+    cluster = gen.generate(16)
+    trace = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def run(policy):
+        s = ExperimentRun(
+            "mparity", cluster, policy, trace, n_apps=10, seed=9,
+            market=market,
+        ).run()
+        return (s["avg_runtime"], s["egress_cost"],
+                s["cum_instance_hours"])
+
+    m_cpu = run(CostAwarePolicy(
+        sort_tasks=True, sort_hosts=True, mode="numpy",
+        risk_weight=1.0, rework_cost=50.0,
+    ))
+    dev = TpuCostAwarePolicy(
+        sort_tasks=True, sort_hosts=True,
+        risk_weight=1.0, rework_cost=50.0,
+    )
+    dev.dtype = jnp2.float64
+    m_dev = run(dev)
+    assert m_cpu == m_dev
+
+
+# ---------------------------------------------------------------------------
+# The acceptance soak — and its replay determinism
+# ---------------------------------------------------------------------------
+
+
+def _ci_market_and_arms():
+    from pivot_tpu.experiments.spot import run_spot_arm, spot_market
+
+    market = spot_market(12, seed=3)
+    kw = dict(n_hosts=12, seed=3, n_apps=10)
+    blind = run_spot_arm(market, **kw)
+    aware = run_spot_arm(
+        market, risk_weight=1.0, rework_cost=50.0, proactive=True, **kw
+    )
+    return market, blind, aware
+
+
+def test_spot_survival_acceptance_quick():
+    """ISSUE-9 acceptance: under the identical MarketSchedule and the
+    identical hazard-drawn fault plan, risk-aware + proactive achieves
+    STRICTLY lower cost-per-completed-task and dead-letter rate than
+    the hazard-blind arm, with every audit (conservation, cluster,
+    billing incl. rework) clean in both worlds."""
+    market, blind, aware = _ci_market_and_arms()
+    assert blind["fault_log"] == aware["fault_log"][: len(blind["fault_log"])] or (
+        blind["n_preemptions"] == aware["n_preemptions"]
+    )
+    assert blind["audit_violations"] == []
+    assert aware["audit_violations"] == []
+    assert blind["n_preemptions"] > 0, "market drew no preemptions"
+    assert blind["rework_seconds"] > aware["rework_seconds"]
+    assert (
+        aware["cost_per_completed_task"]
+        < blind["cost_per_completed_task"]
+    )
+    assert aware["dead_letter_rate"] < blind["dead_letter_rate"]
+    # The survival machinery actually ran in the aware arm.
+    assert aware["n_proactive_restarts"] + aware["n_migrated"] > 0
+
+
+def test_spot_survival_replay_deterministic(tmp_path):
+    """Same (market, seed, arm) ⇒ bit-identical report: fault log, price
+    tensor, meter snapshot — through the JSON round trip."""
+    from pivot_tpu.experiments.spot import run_spot_arm, spot_market
+
+    market = spot_market(12, seed=3)
+    path = tmp_path / "market.json"
+    market.save(str(path))
+    loaded = MarketSchedule.load(str(path))
+    assert loaded == market
+    kw = dict(n_hosts=12, seed=3, n_apps=6)
+    a = run_spot_arm(market, **kw)
+    b = run_spot_arm(loaded, **kw)
+    assert json.dumps(a, sort_keys=True, default=float) == json.dumps(
+        b, sort_keys=True, default=float
+    )
+
+
+# ---------------------------------------------------------------------------
+# tools/market_replay.py — CLI and the CI determinism contract
+# ---------------------------------------------------------------------------
+
+
+def _market_cli(argv):
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import market_replay
+
+    return market_replay.main(argv)
+
+
+def test_market_replay_cli_roundtrip(tmp_path):
+    mpath = str(tmp_path / "m.json")
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    assert _market_cli(
+        ["generate", "--seed", "3", "--hosts", "12", "--out", mpath]
+    ) == 0
+    run = ["run", "--market", mpath, "--hosts", "12", "--seed", "3",
+           "--apps", "4"]
+    assert _market_cli(run + ["--out", a]) == 0
+    assert _market_cli(run + ["--out", b]) == 0
+    assert _market_cli(["diff", a, b]) == 0
+    # Corrupt one fault-log event: the diff MUST exit non-zero (the CI
+    # determinism step keys on the return code).
+    with open(b) as f:
+        rep = json.load(f)
+    if rep["fault_log"]:
+        rep["fault_log"][0][0] += 1.0
+    else:
+        rep["n_completed_tasks"] += 1
+    with open(b, "w") as f:
+        json.dump(rep, f)
+    assert _market_cli(["diff", a, b]) == 1
+    # Market-file diff: identical ⇒ 0, perturbed ⇒ 1.
+    m2 = str(tmp_path / "m2.json")
+    with open(mpath) as f:
+        md = json.load(f)
+    md["price"][0][0] *= 2.0
+    with open(m2, "w") as f:
+        json.dump(md, f)
+    assert _market_cli(["diff", mpath, m2]) == 1

@@ -280,3 +280,41 @@ def test_pallas_quarantine_mask_matches_scan():
         np.testing.assert_array_equal(
             np.asarray(am)[~live], np.asarray(args[0])[~live]
         )
+
+
+@pytest.mark.parametrize(
+    "mode",
+    [
+        dict(bin_pack="first-fit", sort_hosts=True, host_decay=False),
+        dict(bin_pack="first-fit", sort_hosts=False, host_decay=False),
+        dict(bin_pack="best-fit", sort_hosts=True, host_decay=True),
+    ],
+    ids=["ff-sorted", "ff-index", "bf-decay"],
+)
+def test_pallas_risk_matches_scan(mode):
+    """Round-11 eviction-risk vector (``infra/market.py``): the Pallas
+    kernel folds the [H] risk row by the shared rules — score += risk at
+    group freeze and per-task selection, lexicographic (risk, lane) for
+    the index-ordered ``sort_hosts=False`` arm — and must match the scan
+    kernel's placements bit for bit on identical f32 inputs, tiered
+    ties included."""
+    args = make_inputs(5, 90, 40)
+    rng = np.random.default_rng(17)
+    risk = jnp.asarray(
+        rng.choice([0.0, 0.4, 1.5], size=40), jnp.float32
+    )
+    p_ref, avail_ref = cost_aware_kernel(*args, **mode, risk=risk)
+    p_pal, avail_pal = cost_aware_pallas(
+        *args, **mode, risk=risk, interpret=True
+    )
+    assert p_ref.tolist() == p_pal.tolist()
+    np.testing.assert_allclose(
+        np.asarray(avail_ref), np.asarray(avail_pal), rtol=1e-6, atol=1e-5
+    )
+    # Zero risk row ≡ risk-free placements (the identity of the rule).
+    zero = jnp.zeros(40, jnp.float32)
+    p_free, _ = cost_aware_pallas(*args, **mode, interpret=True)
+    p_zero, _ = cost_aware_pallas(
+        *args, **mode, risk=zero, interpret=True
+    )
+    assert p_free.tolist() == p_zero.tolist()

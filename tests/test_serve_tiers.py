@@ -385,6 +385,56 @@ def test_session_crash_during_scale_down_drain_settles_once():
     driver.audit()
 
 
+def test_preempt_victim_requeued_onto_retiring_slot_settles_once():
+    """Satellite (round 11): the autoscaler's drain-then-retire racing
+    in-queue preemption.  A tier-1 victim is preempted and requeued to
+    the spill buffer; the session its re-admission lands on begins its
+    scale-down drain the same tick.  The drain must complete the
+    re-entered job (or hand it on) and finalize the retire exactly once
+    — ``audit_serve``'s conservation law (admitted == completed +
+    failed + preempted, spill empty, no double-settle) is the referee."""
+    reset_ids()
+    sessions = _sessions(2)
+    driver = ServeDriver(
+        sessions, queue_depth=2, backpressure="shed",
+        tier_policies=("block", "shed"), preempt=True,
+    )
+    make_app = synthetic_app_factory(seed=9, runtime=(5.0, 15.0))
+    victim_app = make_app()
+    seen = {"target": None, "offers": 0}
+
+    # Deterministic race: the victim's SECOND offer is its spill
+    # re-admission — mark that very slot retiring before the arrival
+    # even enters its inbox, so the drain begins with the re-entered
+    # job in hand.
+    for s in sessions:
+        def hooked(arrival, _s=s, _orig=s.offer):
+            if arrival.app is victim_app:
+                seen["offers"] += 1
+                if seen["offers"] == 2:
+                    _s.retiring = True
+                    seen["target"] = _s
+            return _orig(arrival)
+
+        s.offer = hooked
+
+    def arrivals():
+        yield JobArrival(50.0, make_app(), tier=1)
+        yield JobArrival(51.0, victim_app, tier=1)  # youngest -> victim
+        yield JobArrival(1.4, make_app(), tier=0)   # forces the preempt
+
+    report = driver.run(arrivals())
+    assert seen["target"] is not None, "victim re-admission never landed"
+    c = report["slo"]["counters"]
+    assert c["preempted"] == 1 and c["preempt_requeued"] == 1
+    assert c["completed"] == 3, "the retiring slot stranded the victim"
+    # The drained slot retires exactly once; a late sweep is a no-op.
+    driver.finish_drained_retires()
+    assert driver.finish_drained_retires() == 0
+    assert seen["target"]._retired
+    driver.audit()
+
+
 # -- arrival-source validation (satellite) -----------------------------------
 
 

@@ -357,6 +357,88 @@ def test_sharded_span_parity_sweep_full(config, n_ticks):
     _assert_span_parity(_SPAN_CONFIGS[config], n_ticks)
 
 
+def test_sharded_kernel_risk_parity():
+    """Round-11 eviction-risk vector (``infra/market.py``): the sharded
+    twins consume the [H] risk operand through the same shared rules as
+    the flat kernels — bit-identical placements for all four policies,
+    both sharded modes, with a TIERED vector so the min-risk-tier and
+    the lexicographic (risk, global index) tie-breaks are exercised
+    across shard boundaries."""
+    x = make_inputs(7, T=48, H=64, B=64, group_size=5)
+    rng = np.random.default_rng(13)
+    risk = jnp.asarray(rng.choice([0.0, 0.4, 1.5], size=64))
+    for sp2 in ("auto", 8):
+        _assert_pair(
+            f"opportunistic:risk:{sp2}",
+            opportunistic_kernel(
+                x["avail"], x["dem"], x["valid"], x["u"], phase2="slim",
+                risk=risk,
+            ),
+            opportunistic_kernel_sharded(
+                MESH, x["avail"], x["dem"], x["valid"], x["u"],
+                phase2=sp2, risk=risk,
+            ),
+        )
+        _assert_pair(
+            f"first_fit:risk:{sp2}",
+            first_fit_kernel(
+                x["avail"], x["dem"], x["valid"], phase2="slim", risk=risk
+            ),
+            first_fit_kernel_sharded(
+                MESH, x["avail"], x["dem"], x["valid"], phase2=sp2,
+                risk=risk,
+            ),
+        )
+        _assert_pair(
+            f"best_fit:risk:{sp2}",
+            best_fit_kernel(
+                x["avail"], x["dem"], x["valid"], phase2="slim", risk=risk
+            ),
+            best_fit_kernel_sharded(
+                MESH, x["avail"], x["dem"], x["valid"], phase2=sp2,
+                risk=risk,
+            ),
+        )
+        ca_args = (x["avail"], x["dem"], x["valid"], x["ng"], x["az"],
+                   x["cost"], x["bw"], x["hz"], x["counts"])
+        for mode in (CA_MODES[0], CA_MODES[3]):
+            _assert_pair(
+                f"ca:{mode}:risk:{sp2}",
+                cost_aware_kernel(
+                    *ca_args, **mode, phase2="slim", risk=risk
+                ),
+                cost_aware_kernel_sharded(
+                    MESH, *ca_args, **mode, phase2=sp2, risk=risk
+                ),
+            )
+
+
+def test_sharded_span_market_parity_quick():
+    """The sharded span driver consumes the round-11 market operands —
+    host-sharded [K, H] risk rows, replicated [P, Z, Z] cost stack +
+    [K] segment row — bit-identically to the single-device driver and
+    the sequential referee."""
+    K = span_bucket(8)
+    rng = np.random.default_rng(23)
+    risk_rows = jnp.asarray(
+        rng.choice([0.0, 0.3, 1.0], size=(K, _H_SPAN))
+    )
+    P = 3
+    market_kw = dict(
+        risk_rows=risk_rows,
+        cost_stack=jnp.asarray(rng.uniform(0.01, 0.3, (P, _Z, _Z))),
+        cost_seg=jnp.asarray(
+            np.clip(np.arange(K) // 3, 0, P - 1).astype(np.int32)
+        ),
+    )
+    _assert_span_parity(
+        dict(_SPAN_CONFIGS["cost_aware_ff"], **market_kw), n_ticks=8
+    )
+    _assert_span_parity(
+        dict(_SPAN_CONFIGS["first_fit"], risk_rows=risk_rows), n_ticks=8
+    )
+
+
 # --------------------------------------------------------------------------
 # Replica-axis batcher sharding (sched/batch.py mesh=)
 # --------------------------------------------------------------------------

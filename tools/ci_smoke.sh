@@ -15,7 +15,12 @@
 #      bit-identical to the single-device oracles without any TPU in
 #      the loop — the quick tier-1 twins of tests/test_shard.py, with
 #      the device-count flag pinned here explicitly so the lane stays
-#      self-contained even if conftest's pin moves.
+#      self-contained even if conftest's pin moves;
+#   5. spot-market survival (round 11): the quick spot soak (risk-aware
+#      + proactive strictly beats hazard-blind, audits clean) and
+#      MarketSchedule replay determinism against the COMMITTED seed
+#      market (data/market/ci_seed.json) — regeneration reproduces it
+#      bit-for-bit and two survival runs report identically.
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -27,14 +32,14 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/4] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/5] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/4] hot-path host-sync lint =="
+echo "== [2/5] hot-path host-sync lint =="
 python tools/hotpath_lint.py
 
-echo "== [3/4] chaos replay determinism on the committed seed =="
+echo "== [3/5] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -49,7 +54,7 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
-echo "== [4/4] sharded-placement parity on a forced 8-device CPU mesh =="
+echo "== [4/5] sharded-placement parity on a forced 8-device CPU mesh =="
 # Small-H quick twins + the H=1024 acceptance + the sharded span driver:
 # bit-parity with the single-device oracles, exercised on every run
 # without a TPU.  (conftest pins the same mesh; the explicit flag keeps
@@ -57,5 +62,25 @@ echo "== [4/4] sharded-placement parity on a forced 8-device CPU mesh =="
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_shard.py tests/test_mesh.py -q -m 'not slow' \
     -k 'parity or span or mesh' -p no:cacheprovider
+
+echo "== [5/5] spot soak + market replay determinism on the committed seed =="
+MARKET_SEED_FILE=data/market/ci_seed.json
+# The quick acceptance soak (tier-1 twin in tests/test_market.py).
+python -m pytest tests/test_market.py -q -m 'not slow' \
+    -k 'spot_survival' -p no:cacheprovider
+# Market generation is a pure function of (zone catalog, seed, params):
+# regenerate and diff against the committed artifact.
+python tools/market_replay.py generate --seed 3 --hosts 12 \
+    --horizon 600 --out "$TMP/market_regen.json"
+python tools/market_replay.py diff "$MARKET_SEED_FILE" "$TMP/market_regen.json"
+# Survival replay is deterministic: two risk-aware runs of the committed
+# market must report identically (fault log, costs, meter).
+python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
+    --seed 3 --risk-weight 1.0 --rework-cost 50 --proactive \
+    --out "$TMP/spot_a.json"
+python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
+    --seed 3 --risk-weight 1.0 --rework-cost 50 --proactive \
+    --out "$TMP/spot_b.json"
+python tools/market_replay.py diff "$TMP/spot_a.json" "$TMP/spot_b.json"
 
 echo "smoke lane: all green"
