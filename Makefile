@@ -1,16 +1,23 @@
 # Developer/CI entry points.  Everything runs on the CPU backend
 # (JAX_PLATFORMS=cpu) — the TPU chip is bench.py's business only.
 
-.PHONY: smoke tier1 bench
+.PHONY: smoke tier1 bench lint
 
-# The per-PR resilience gate: quick chaos soak, hot-path host-sync
-# lint, chaos replay determinism against the committed seed
+# The per-PR resilience gate: quick chaos soak, the graftcheck static-
+# analysis suite (backend knob parity, determinism, thread-guard,
+# host-sync), chaos replay determinism against the committed seed
 # (data/chaos/ci_seed.json), sharded-placement parity on a forced
 # 8-device CPU mesh, and the spot-market survival soak + market replay
 # determinism against data/market/ci_seed.json.  ~3 minutes; see
 # tools/ci_smoke.sh.
 smoke:
 	tools/ci_smoke.sh
+
+# Standalone static analysis (no JAX import, sub-second): the four
+# graftcheck passes + the legacy hotpath CLI contract.
+lint:
+	python tools/graftcheck.py
+	python tools/hotpath_lint.py
 
 # The full quick test tier (ROADMAP.md "Tier-1 verify").
 tier1:

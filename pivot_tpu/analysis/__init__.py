@@ -1,0 +1,357 @@
+"""graftcheck: the repo-wide static-analysis suite.
+
+The repo's three load-bearing invariants are enforced at runtime by the
+parity/replay/soak test suites — but each of them has a *static* shadow
+that can be proven before any test runs, and history says the runtime
+net has holes exactly where a PR threads a new knob or a new thread:
+
+  * **backend feature-parity** (``rules/backend-parity``) — every
+    scheduling knob (live mask, risk vector, cost tensor, totals,
+    phase-2 selector, …) must reach every declared form of its kernel
+    family: the scan oracle, the two-phase ``*_impl``, the Pallas
+    kernel, the sharded twin, the fused span drivers, and the
+    ``sched/tpu.py`` routing layer.  PR 9 threaded ``risk``/
+    ``cost_tensor`` through seven forms by hand; this pass makes the
+    eighth time a compile-time error instead of a reviewer's diff hunt.
+  * **determinism** (``rules/determinism``) — seeded replay is
+    bit-identical only while the sim/replay-critical modules (``des/``,
+    ``infra/faults.py``, ``infra/market.py``, ``sched/``, ``ops/``)
+    never read a wall clock, never touch global RNG state, and never
+    iterate a hash-ordered set.  One ``time.time()`` breaks the
+    replay contract that ``chaos_replay``/``market_replay`` audit.
+  * **thread-guard** (``rules/thread-guard``) — the threaded serve/
+    batch layer serializes its shared state behind declared condition
+    variables; this pass checks every access of a declared guarded
+    field lexically sits under ``with self.<lock>:``.
+  * **host-sync** (``rules/host-sync``) — the PR-6 hot-path lint,
+    migrated into the framework with naming-convention auto-discovery
+    replacing the hand-maintained target dict.
+
+Framework pieces shared by every pass: :class:`Finding`, the rule
+registry (:data:`REGISTRY`), ``# graftcheck: ignore[rule] -- reason``
+suppressions (reason REQUIRED; a suppression that matches no finding is
+itself a finding — stale suppressions rot into lies), and the
+:func:`run` driver behind both CLIs (``tools/graftcheck.py`` and
+``python -m pivot_tpu.analysis``).
+
+Suppression contract: the comment suppresses findings of the named
+rule(s) on its own line, on the line directly below (the
+comment-above form), or — when it trails a later line of a multi-line
+*simple* statement — at that statement's first line, where findings
+anchor::
+
+    t0 = time.perf_counter()  # graftcheck: ignore[determinism] -- why
+
+    # graftcheck: ignore[thread-guard] -- snapshot read; see docstring
+    for s in list(self.sessions):
+
+The meta-rule ``suppression`` (bad or stale suppression comments) is
+not itself suppressible.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "REGISTRY",
+    "repo_root",
+    "run",
+    "main",
+]
+
+
+class Finding(NamedTuple):
+    """One static-analysis violation, repo-relative."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """A parsed source file: text, lines, AST — parsed once per run."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath
+        with open(abspath) as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=abspath)
+
+
+class _Cache:
+    """Per-run SourceFile cache so passes sharing files parse once."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._files: Dict[str, SourceFile] = {}
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        if rel not in self._files:
+            abspath = os.path.join(self.root, rel)
+            if not os.path.isfile(abspath):
+                self._files[rel] = None
+            else:
+                self._files[rel] = SourceFile(abspath, rel)
+        return self._files[rel]
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+#: ``# graftcheck: ignore[rule1,rule2] -- reason`` (reason mandatory).
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*ignore\[(?P<rules>[^\]]*)\]"
+    r"(?:\s*--\s*(?P<reason>\S.*))?"
+)
+
+
+class Suppression(NamedTuple):
+    path: str
+    line: int
+    rules: Tuple[str, ...]
+    reason: Optional[str]
+
+
+def find_suppressions(src: SourceFile) -> List[Suppression]:
+    """Suppression comments in ``src`` — matched against actual COMMENT
+    tokens, not raw lines, so suppression syntax *quoted* inside a
+    docstring or string literal (e.g. documentation of the idiom) is
+    never parsed as a live suppression."""
+    import io
+    import tokenize
+
+    out: List[Suppression] = []
+    try:
+        tokens = list(
+            tokenize.generate_tokens(io.StringIO(src.text).readline)
+        )
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        # The file ast-parsed, so this should be unreachable; fail
+        # open (no suppressions) rather than crash the run.
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m is None:
+            continue
+        rules = tuple(
+            r.strip() for r in m.group("rules").split(",") if r.strip()
+        )
+        out.append(
+            Suppression(src.path, tok.start[0], rules, m.group("reason"))
+        )
+    return out
+
+
+_COMPOUND_STMTS = (
+    ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.If,
+    ast.For, ast.AsyncFor, ast.While, ast.With, ast.AsyncWith, ast.Try,
+)
+
+
+def _suppression_scope(
+    sup: Suppression, src: Optional[SourceFile]
+) -> Set[int]:
+    """Line numbers a suppression covers: its own line, the next line,
+    and the FULL span of the closest SIMPLE statement it attaches to —
+    either the one its line sits inside (a trailing comment on any line
+    of a multi-line call) or the one starting directly below it (the
+    comment-above form over a multi-line statement, whose findings can
+    anchor on inner lines).  Compound statements are excluded so a
+    comment inside a function body cannot blanket the whole def."""
+    cover = {sup.line, sup.line + 1}
+    if src is not None:
+        best = None  # innermost simple statement containing sup.line
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.stmt) or isinstance(
+                node, _COMPOUND_STMTS
+            ):
+                continue
+            end = node.end_lineno or node.lineno
+            if node.lineno <= sup.line <= end:
+                if best is None or node.lineno > best[0]:
+                    best = (node.lineno, end)
+            elif node.lineno == sup.line + 1:
+                # Comment-above form: cover the whole statement below.
+                cover.update(range(node.lineno, end + 1))
+        if best is not None:
+            cover.update(range(best[0], best[1] + 1))
+    return cover
+
+
+# ---------------------------------------------------------------------------
+# Registry + runner
+# ---------------------------------------------------------------------------
+
+def _registry():
+    # Imported lazily so ``import pivot_tpu.analysis`` stays cheap and
+    # the pass modules can import framework types from here.
+    from pivot_tpu.analysis import determinism, hostsync, parity, threadguard
+
+    return {
+        parity.RULE: parity,
+        determinism.RULE: determinism,
+        threadguard.RULE: threadguard,
+        hostsync.RULE: hostsync,
+    }
+
+
+#: Rule name → pass module (each exposes ``RULE`` and
+#: ``collect(cache) -> (findings, scanned_relpaths)``).
+REGISTRY = _registry
+
+
+def run(
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run the requested passes (default: all) over the tree at ``root``
+    (default: this repo), apply suppressions, flag bad/stale
+    suppressions, and return the surviving findings sorted by location.
+    """
+    root = root or repo_root()
+    registry = REGISTRY()
+    selected = list(registry) if rules is None else list(rules)
+    unknown = [r for r in selected if r not in registry]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown}; known: {sorted(registry)}"
+        )
+    cache = _Cache(root)
+
+    findings: List[Finding] = []
+    scanned_by_rule: Dict[str, Set[str]] = {}
+    for rule in selected:
+        pass_findings, scanned = registry[rule].collect(cache)
+        findings.extend(pass_findings)
+        scanned_by_rule[rule] = set(scanned)
+
+    # Suppression processing over every file any pass scanned.
+    all_scanned = sorted(set().union(*scanned_by_rule.values(), set()))
+    suppressions: List[Suppression] = []
+    for rel in all_scanned:
+        src = cache.get(rel)
+        if src is not None:
+            suppressions.extend(find_suppressions(src))
+
+    known_rules = set(registry)
+    scopes = [
+        _suppression_scope(sup, cache.get(sup.path))
+        for sup in suppressions
+    ]
+    used: Set[Tuple[int, str]] = set()  # (index into suppressions, rule)
+    kept: List[Finding] = []
+    for f in findings:
+        suppressed = False
+        for idx, sup in enumerate(suppressions):
+            if (
+                sup.path == f.path
+                and f.rule in sup.rules
+                and sup.reason
+                and f.line in scopes[idx]
+            ):
+                used.add((idx, f.rule))
+                suppressed = True
+        if not suppressed:
+            kept.append(f)
+
+    # Bad / stale suppressions are findings of the (unsuppressible)
+    # meta-rule ``suppression``.
+    for idx, sup in enumerate(suppressions):
+        if not sup.reason:
+            kept.append(Finding(
+                "suppression", sup.path, sup.line,
+                "suppression without a justification — write "
+                "`# graftcheck: ignore[rule] -- reason`",
+            ))
+            continue
+        for rule in sup.rules:
+            if rule not in known_rules:
+                kept.append(Finding(
+                    "suppression", sup.path, sup.line,
+                    f"suppression names unknown rule {rule!r} "
+                    f"(known: {sorted(known_rules)})",
+                ))
+            elif (
+                rule in scanned_by_rule
+                and sup.path in scanned_by_rule[rule]
+                and (idx, rule) not in used
+            ):
+                kept.append(Finding(
+                    "suppression", sup.path, sup.line,
+                    f"stale suppression: no [{rule}] finding in its "
+                    "scope (this line, the line below, or the span of "
+                    "the simple statement it attaches to) — the "
+                    "violation it excused is gone; delete the comment",
+                ))
+
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: exit 1 on findings.  ``--rules a,b`` filters passes;
+    ``--root`` points at another tree (tests use this)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="repo-wide static analysis: backend knob parity, "
+        "replay determinism, thread-guard discipline, host-sync lint",
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule subset (default: all)",
+    )
+    parser.add_argument("--root", help="tree to analyze (default: repo)")
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+    registry = REGISTRY()
+    if args.list_rules:
+        for rule, mod in registry.items():
+            doc = (mod.__doc__ or "").strip().splitlines()
+            print(f"{rule}: {doc[0] if doc else ''}")
+        return 0
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    try:
+        findings = run(root=args.root, rules=rules)
+    except ValueError as exc:
+        print(f"graftcheck: {exc}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f, file=sys.stderr)
+    if findings:
+        print(
+            f"graftcheck: {len(findings)} finding(s)", file=sys.stderr
+        )
+        return 1
+    n = len(rules) if rules else len(registry)
+    print(f"graftcheck: clean ({n} pass(es))")
+    return 0

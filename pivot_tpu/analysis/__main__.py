@@ -1,0 +1,8 @@
+"""``python -m pivot_tpu.analysis`` — the graftcheck CLI."""
+
+import sys
+
+from pivot_tpu.analysis import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

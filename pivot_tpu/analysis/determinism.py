@@ -1,0 +1,303 @@
+"""Determinism lint: the replay=bit-identical contract, statically.
+
+``chaos_replay``/``market_replay`` referee the whole evaluation
+methodology on one promise: replaying a seeded schedule on a seeded
+world reproduces the fault log, the meter, and every placement bit for
+bit.  That promise dies the moment sim/replay-critical code reads a
+wall clock, consumes process-global RNG state, or iterates a
+hash-ordered container.  This pass bans those constructs in the
+replay-critical modules (:data:`SCOPE` — the DES core, the fault/market
+engines, the scheduling layer, and the device kernels; the *serve*
+layer is deliberately out of scope, wall-clock pacing and stall
+watchdogs are its job):
+
+  * **wall-clock reads** — ``time.time()``/``monotonic()``/
+    ``perf_counter()``/… and ``datetime.now()``-family calls.  (Pure
+    *measurement* uses — meter bookkeeping, the adaptive router's
+    latency EMA, whose routing choice is placement-neutral by the
+    twin-parity contract — carry explicit ``ignore[determinism]``
+    suppressions with that justification.)
+  * **global / unseeded RNG** — any ``random.*`` call (module state;
+    ``random.Random(seed)`` construction is allowed) and ``np.random.*``
+    module-state calls (``np.random.rand`` etc.); the seeded
+    constructors (``default_rng``, ``RandomState``, ``Philox``,
+    ``Generator``, …) are the sanctioned idiom and stay allowed.
+  * **hash-ordered iteration** — ``for x in {…}`` / ``set(…)`` /
+    comprehensions over set expressions, list/tuple/iter/enumerate/
+    reversed of a set expression, and ``os.environ`` iteration.  Set
+    *membership* and ``sorted(set(…))`` stay fine — only order leaks
+    break replay.  (Dict iteration is insertion-ordered in Python 3.7+
+    and therefore deterministic; it is not flagged.)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Tuple
+
+from pivot_tpu.analysis import Finding, SourceFile
+
+RULE = "determinism"
+
+#: Replay-critical modules (repo-relative files or directories).
+SCOPE = (
+    "pivot_tpu/des",
+    "pivot_tpu/infra/faults.py",
+    "pivot_tpu/infra/market.py",
+    "pivot_tpu/sched",
+    "pivot_tpu/ops",
+)
+
+_WALL_FNS = {
+    "time", "time_ns", "monotonic", "monotonic_ns",
+    "perf_counter", "perf_counter_ns", "process_time",
+    "process_time_ns",
+}
+_DATETIME_FNS = {"now", "utcnow", "today"}
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+#: Seeded-generator constructors: the sanctioned numpy RNG idiom.
+_SEEDED_OK = {
+    "default_rng", "Generator", "RandomState", "SeedSequence",
+    "Philox", "PCG64", "PCG64DXSM", "MT19937", "SFC64", "BitGenerator",
+}
+#: Consuming one of these around a set expression leaks hash order.
+_ORDER_SENSITIVE_CALLS = {"list", "tuple", "iter", "enumerate", "reversed"}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in {"set", "frozenset"}
+    )
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    if (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    ):
+        return True
+    # os.environ.keys()/values()/items()
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in {"keys", "values", "items"}
+        and _is_os_environ(node.func.value)
+    )
+
+
+def _check_call(node: ast.Call, path: str) -> List[Finding]:
+    out: List[Finding] = []
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base, attr = f.value.id, f.attr
+        if base == "time" and attr in _WALL_FNS:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                f"wall-clock read time.{attr}() in a replay-critical "
+                "module — replay must be a pure function of "
+                "(seed, schedule)",
+            ))
+        elif base == "datetime" and attr in _DATETIME_FNS:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                f"wall-clock read datetime.{attr}() in a "
+                "replay-critical module",
+            ))
+        elif base == "random" and attr != "Random":
+            out.append(Finding(
+                RULE, path, node.lineno,
+                f"global-state RNG random.{attr}() — use a seeded "
+                "np.random generator (or random.Random(seed))",
+            ))
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Attribute)
+        and isinstance(f.value.value, ast.Name)
+    ):
+        # np.random.<fn>(...) / datetime.datetime.now(...)
+        root, mid, attr = f.value.value.id, f.value.attr, f.attr
+        if (
+            root in _NUMPY_ALIASES
+            and mid == "random"
+            and attr not in _SEEDED_OK
+        ):
+            out.append(Finding(
+                RULE, path, node.lineno,
+                f"module-state RNG {root}.random.{attr}() — seed a "
+                f"generator ({root}.random.default_rng(seed)) instead",
+            ))
+        elif root == "datetime" and mid in {
+            "datetime", "date"
+        } and attr in _DATETIME_FNS:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                f"wall-clock read datetime.{mid}.{attr}() in a "
+                "replay-critical module",
+            ))
+    return out
+
+
+def _check_import(node: ast.AST, path: str) -> List[Finding]:
+    """The call checks above key on literal base names (``time.X``,
+    ``random.X``, ``np.random.X``); an aliased or from-import would
+    bypass them silently, so the import statements themselves are
+    banned in scope — import the module unaliased and call through it
+    (review finding, round 12)."""
+    out: List[Finding] = []
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            if alias.name in {"time", "random"} and alias.asname:
+                out.append(Finding(
+                    RULE, path, node.lineno,
+                    f"aliased import `import {alias.name} as "
+                    f"{alias.asname}` defeats the determinism lint — "
+                    "import it unaliased so the call checks see it",
+                ))
+            elif alias.name == "numpy.random" or (
+                alias.name == "numpy"
+                and alias.asname not in (None, *_NUMPY_ALIASES)
+            ):
+                shown = alias.name + (
+                    f" as {alias.asname}" if alias.asname else ""
+                )
+                out.append(Finding(
+                    RULE, path, node.lineno,
+                    f"`import {shown}` defeats the determinism lint — "
+                    "use `import numpy as np` and the np.random.* "
+                    "seeded constructors",
+                ))
+    elif isinstance(node, ast.ImportFrom):
+        names = {alias.name for alias in node.names}
+        if node.module == "time" and names & _WALL_FNS:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                f"`from time import {', '.join(sorted(names & _WALL_FNS))}`"
+                " defeats the determinism lint — import the module and "
+                "call through it (so bans and suppressions attach to "
+                "the call sites)",
+            ))
+        elif node.module == "random" and names - {"Random"}:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                "`from random import ...` pulls module-state RNG into "
+                "scope — use a seeded generator",
+            ))
+        elif node.module == "numpy.random" and names - _SEEDED_OK:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                "`from numpy.random import "
+                f"{', '.join(sorted(names - _SEEDED_OK))}` pulls "
+                "module-state RNG into scope — seed a generator instead",
+            ))
+        elif node.module == "numpy" and "random" in names:
+            out.append(Finding(
+                RULE, path, node.lineno,
+                "`from numpy import random` defeats the determinism "
+                "lint — use `import numpy as np`",
+            ))
+        elif node.module == "datetime" and names & {"datetime", "date"}:
+            # ``datetime.now()`` on the from-imported class matches the
+            # two-level attribute check, so only note the import when
+            # it renames.
+            for alias in node.names:
+                if alias.name in {"datetime", "date"} and alias.asname:
+                    out.append(Finding(
+                        RULE, path, node.lineno,
+                        f"aliased `from datetime import {alias.name} as "
+                        f"{alias.asname}` defeats the determinism lint",
+                    ))
+    return out
+
+
+def _iter_message(path: str, lineno: int, what: str) -> Finding:
+    return Finding(
+        RULE, path, lineno,
+        f"iteration over {what} is hash-ordered (env-dependent) — "
+        "sort it (sorted(...)) or use an order-preserving container",
+    )
+
+
+def scan_source(src: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            out.extend(_check_import(node, src.path))
+        elif isinstance(node, ast.Call):
+            out.extend(_check_call(node, src.path))
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _ORDER_SENSITIVE_CALLS
+                and node.args
+                and _is_set_expr(node.args[0])
+            ):
+                out.append(_iter_message(
+                    src.path, node.lineno,
+                    f"a set expression via {node.func.id}(...)",
+                ))
+        elif isinstance(node, ast.For):
+            if _is_set_expr(node.iter):
+                out.append(_iter_message(
+                    src.path, node.lineno, "a set expression"
+                ))
+            elif _is_os_environ(node.iter):
+                out.append(_iter_message(
+                    src.path, node.lineno, "os.environ"
+                ))
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                if _is_set_expr(gen.iter):
+                    out.append(_iter_message(
+                        src.path, node.lineno,
+                        "a set expression (comprehension)",
+                    ))
+                elif _is_os_environ(gen.iter):
+                    out.append(_iter_message(
+                        src.path, node.lineno, "os.environ"
+                    ))
+    return out
+
+
+def _scope_files(root: str) -> List[str]:
+    rels: List[str] = []
+    for entry in SCOPE:
+        abspath = os.path.join(root, entry)
+        if os.path.isfile(abspath):
+            rels.append(entry)
+        elif os.path.isdir(abspath):
+            for dirpath, _dirs, files in sorted(os.walk(abspath)):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        rels.append(os.path.relpath(
+                            os.path.join(dirpath, name), root
+                        ))
+    return rels
+
+
+def collect(cache) -> Tuple[List[Finding], List[str]]:
+    import os as _os
+
+    out: List[Finding] = []
+    scanned: List[str] = []
+    for entry in SCOPE:
+        if not _os.path.exists(_os.path.join(cache.root, entry)):
+            out.append(Finding(
+                RULE, entry, 0,
+                f"replay-critical scope entry {entry} is missing — "
+                "renamed/deleted? update determinism SCOPE (it lost "
+                "all lint coverage)",
+            ))
+    for rel in _scope_files(cache.root):
+        src = cache.get(rel)
+        if src is None:
+            continue
+        scanned.append(rel)
+        out.extend(scan_source(src))
+    return out, scanned

@@ -1,0 +1,383 @@
+"""Backend feature-parity matrix: every knob reaches every kernel form.
+
+Each placement policy is one *family* served by several backend forms —
+the reference scan oracle (``*_kernel_ref``), the two-phase form
+(``*_impl``), the host-sharded twin (``*_kernel_sharded``), and for
+cost-aware the Pallas kernels — plus the span-driver family
+(``fused_tick_run`` / ``reference_tick_run`` / ``sharded_fused_tick_run``)
+and the ``sched/tpu.py`` routing layer that forwards the knobs.  A
+scheduling knob that reaches some forms but not others is a silent
+parity break: the affected form keeps compiling and keeps passing every
+test that doesn't exercise that knob on that form.  PR 9 threaded
+``risk``/``cost_stack`` through seven forms by hand; this pass turns
+the eighth such exercise into a static failure.
+
+Three checks:
+
+1. **Signature matrix** — per family, the knob set (parameter names
+   intersected with :data:`KNOBS` / :data:`SPAN_KNOBS`) must be equal
+   across forms, modulo each form's *declared* exemptions in
+   :data:`MANIFEST` (e.g. the Pallas kernel has no ``totals``/``phase2``
+   — it has no speculation to steer — and the scan oracles ARE the scan
+   mode, so ``phase2`` would be dead weight).  An exemption is a
+   documented decision; an undeclared gap is a finding.
+2. **Auto-discovery** — form names are *discovered* from naming
+   conventions (``<stem>_kernel_ref`` / ``<stem>_impl`` /
+   ``<stem>_kernel_sharded`` / ``<stem>_pallas[_batched]`` /
+   ``*tick_run``) in the declared files, so a NEW backend form shows up
+   as "unregistered form: add it to the manifest" instead of silently
+   escaping the matrix.  A manifest entry whose function vanished is
+   flagged too (renames cannot drop coverage).
+3. **Routing** — ``sched/tpu.py``'s ``_device_place`` methods must
+   forward every routing-layer knob (:data:`ROUTING_KNOBS` ∩ the
+   family's knob union) of the kernels they reference: explicit keyword
+   arguments and dict-key staging (``kw["live"] = …`` then ``**kw``)
+   both count.  The span route (``place_span`` + the ``_span_kw`` /
+   ``_span_market_kw`` builders) must stage :data:`SPAN_ROUTING_KNOBS`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from pivot_tpu.analysis import Finding, SourceFile
+
+RULE = "backend-parity"
+
+#: Knobs tracked for the per-tick kernel families (parameter names).
+KNOBS = frozenset({
+    "live", "risk", "totals", "phase2", "strict", "uniforms",
+    "bin_pack", "sort_hosts", "host_decay", "rt_bw_rows", "rt_bw_idx",
+})
+
+#: Knobs tracked for the span-driver family.
+SPAN_KNOBS = frozenset({
+    "uniforms", "sort_norm", "anchor_zone", "bucket_id", "totals",
+    "live", "risk_rows", "cost_stack", "cost_seg", "strict",
+    "decreasing", "bin_pack", "sort_tasks", "sort_hosts", "host_decay",
+    "phase2",
+})
+
+_KERNELS = "pivot_tpu/ops/kernels.py"
+_PALLAS = "pivot_tpu/ops/pallas_kernels.py"
+_SHARD = "pivot_tpu/ops/shard.py"
+_TICKLOOP = "pivot_tpu/ops/tickloop.py"
+_ROUTING_FILE = "pivot_tpu/sched/tpu.py"
+
+#: The scan oracles have no two-phase machinery: ``phase2``/``totals``
+#: would be dead parameters on the reference form.
+_REF_EXEMPT = frozenset({"phase2", "totals"})
+#: The Pallas kernels keep the whole tick in VMEM — no speculation
+#: (``totals``/``phase2``) and no live-bandwidth rows (per-tick host
+#: state a persistent kernel cannot hold).
+_PALLAS_EXEMPT = frozenset({"phase2", "totals", "rt_bw_rows", "rt_bw_idx"})
+
+#: family stem → {form name: (repo-relative file, exempt knobs)}.
+#: Registering a form here is a statement that its knob set matches the
+#: family union minus the listed, justified exemptions.
+MANIFEST: Dict[str, Dict[str, Tuple[str, FrozenSet[str]]]] = {
+    "opportunistic": {
+        "opportunistic_kernel_ref": (_KERNELS, _REF_EXEMPT),
+        "opportunistic_impl": (_KERNELS, frozenset()),
+        "opportunistic_kernel_sharded": (_SHARD, frozenset()),
+    },
+    "first_fit": {
+        "first_fit_kernel_ref": (_KERNELS, _REF_EXEMPT),
+        "first_fit_impl": (_KERNELS, frozenset()),
+        "first_fit_kernel_sharded": (_SHARD, frozenset()),
+    },
+    "best_fit": {
+        "best_fit_kernel_ref": (_KERNELS, _REF_EXEMPT),
+        "best_fit_impl": (_KERNELS, frozenset()),
+        "best_fit_kernel_sharded": (_SHARD, frozenset()),
+    },
+    "cost_aware": {
+        "cost_aware_kernel_ref": (_KERNELS, _REF_EXEMPT),
+        "cost_aware_impl": (_KERNELS, frozenset()),
+        "cost_aware_kernel_sharded": (_SHARD, frozenset()),
+        "cost_aware_pallas": (_PALLAS, _PALLAS_EXEMPT),
+        "cost_aware_pallas_batched": (_PALLAS, _PALLAS_EXEMPT),
+    },
+}
+
+#: Span-driver family: one knob contract across the fused driver, the
+#: sequential referee, and the host-sharded twin.
+SPAN_MANIFEST: Dict[str, Tuple[str, FrozenSet[str]]] = {
+    "fused_tick_run": (_TICKLOOP, frozenset()),
+    "reference_tick_run": (_TICKLOOP, frozenset()),
+    "sharded_fused_tick_run": (_SHARD, frozenset()),
+}
+
+#: Knobs the routing layer must forward per family (∩ the family's
+#: actual knob union — a family without ``totals`` isn't required to
+#: route it).
+ROUTING_KNOBS = frozenset({"live", "risk", "totals", "phase2"})
+#: Market/quarantine operands ``place_span``/``_span_kw``/
+#: ``_span_market_kw`` must stage for the span drivers.
+SPAN_ROUTING_KNOBS = frozenset({"live", "risk_rows", "cost_stack", "cost_seg"})
+_SPAN_ROUTING_FUNCS = ("place_span", "_span_kw", "_span_market_kw")
+
+#: Jitted wrappers the routing layer references for each family.
+_FORM_ALIASES: Dict[str, str] = {
+    "opportunistic_kernel": "opportunistic",
+    "first_fit_kernel": "first_fit",
+    "best_fit_kernel": "best_fit",
+    "cost_aware_kernel": "cost_aware",
+}
+
+#: Discovery patterns: (regex with a ``stem`` group, form label).  Any
+#: public top-level function matching one of these in a manifest file
+#: is a backend form and must be registered.
+_DISCOVER = (
+    (re.compile(r"^(?P<stem>[a-z]\w*)_kernel_ref$"), "kernel_ref"),
+    (re.compile(r"^(?P<stem>[a-z]\w*)_impl$"), "impl"),
+    (re.compile(r"^(?P<stem>[a-z]\w*)_kernel_sharded$"), "kernel_sharded"),
+    (re.compile(r"^(?P<stem>[a-z]\w*)_pallas(_batched)?$"), "pallas"),
+)
+_DISCOVER_SPAN = re.compile(r"^[a-z]\w*tick_run$")
+
+
+def _param_names(fn: ast.FunctionDef) -> Set[str]:
+    a = fn.args
+    return {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+
+
+def _top_level_functions(src: SourceFile) -> Dict[str, ast.FunctionDef]:
+    return {
+        node.name: node
+        for node in src.tree.body
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _matrix_findings(
+    family: str,
+    forms: Dict[str, Tuple[str, FrozenSet[str]]],
+    knob_universe: FrozenSet[str],
+    funcs_by_file: Dict[str, Dict[str, ast.FunctionDef]],
+) -> Tuple[List[Finding], Dict[str, Set[str]]]:
+    """Signature-matrix check for one family.  Returns findings plus
+    each found form's knob set (the routing check reuses the union)."""
+    out: List[Finding] = []
+    knob_sets: Dict[str, Set[str]] = {}
+    lines: Dict[str, Tuple[str, int]] = {}
+    for name, (rel, _exempt) in forms.items():
+        funcs = funcs_by_file.get(rel)
+        if funcs is None:
+            continue  # file absent from this tree: nothing to check
+        fn = funcs.get(name)
+        if fn is None:
+            out.append(Finding(
+                RULE, rel, 1,
+                f"registered backend form {name}() of family "
+                f"{family!r} not found — update the parity manifest "
+                "after renames",
+            ))
+            continue
+        knob_sets[name] = _param_names(fn) & knob_universe
+        lines[name] = (rel, fn.lineno)
+    if not knob_sets:
+        return out, knob_sets
+    union: Set[str] = set().union(*knob_sets.values())
+    for name, knobs in knob_sets.items():
+        rel, lineno = lines[name]
+        missing = union - knobs - forms[name][1]
+        if missing:
+            out.append(Finding(
+                RULE, rel, lineno,
+                f"{name}() is missing family {family!r} knob(s) "
+                f"{sorted(missing)} — every backend form must accept "
+                "every family knob (or declare an exemption in the "
+                "manifest with a justification)",
+            ))
+    return out, knob_sets
+
+
+def _discovery_findings(
+    funcs_by_file: Dict[str, Dict[str, ast.FunctionDef]],
+) -> List[Finding]:
+    registered = {
+        name for forms in MANIFEST.values() for name in forms
+    } | set(SPAN_MANIFEST)
+    out: List[Finding] = []
+    for rel, funcs in funcs_by_file.items():
+        for name, fn in funcs.items():
+            if name.startswith("_") or name in registered:
+                continue
+            hit = any(pat.match(name) for pat, _ in _DISCOVER)
+            if not hit:
+                hit = bool(_DISCOVER_SPAN.match(name))
+            if hit:
+                out.append(Finding(
+                    RULE, rel, fn.lineno,
+                    f"unregistered backend form {name}() — a new kernel/"
+                    "span form must join the parity manifest "
+                    "(pivot_tpu/analysis/parity.py) so the knob matrix "
+                    "covers it",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Routing-layer check
+# ---------------------------------------------------------------------------
+
+def _forwarded_names(fn: ast.AST) -> Set[str]:
+    """Every keyword-ish name a function can forward to a kernel call:
+    explicit call keywords, dict-literal string keys, ``dict(...)``
+    keywords, and ``kw["name"] = ...`` subscript staging."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    out.add(kw.arg)
+        elif isinstance(node, ast.Dict):
+            for key in node.keys:
+                if isinstance(key, ast.Constant) and isinstance(
+                    key.value, str
+                ):
+                    out.add(key.value)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)
+                ):
+                    out.add(tgt.slice.value)
+    return out
+
+
+def _referenced_families(fn: ast.AST) -> Set[str]:
+    members = dict(_FORM_ALIASES)
+    for family, forms in MANIFEST.items():
+        for name in forms:
+            members[name] = family
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in members:
+            out.add(members[node.id])
+    return out
+
+
+def _routing_findings(
+    src: SourceFile, family_unions: Dict[str, Set[str]]
+) -> List[Finding]:
+    out: List[Finding] = []
+    span_vocab: Set[str] = set()
+    span_seen = False
+    references_span = False
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if (
+                    isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and item.name == "_device_place"
+                ):
+                    vocab = _forwarded_names(item)
+                    for family in sorted(_referenced_families(item)):
+                        required = ROUTING_KNOBS & family_unions.get(
+                            family, set()
+                        )
+                        missing = required - vocab
+                        if missing:
+                            out.append(Finding(
+                                RULE, src.path, item.lineno,
+                                f"{node.name}._device_place does not "
+                                f"forward knob(s) {sorted(missing)} to "
+                                f"the {family!r} kernels — the routing "
+                                "layer must thread every routing knob",
+                            ))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in _SPAN_ROUTING_FUNCS:
+                span_seen = True
+                span_vocab |= _forwarded_names(node)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id in SPAN_MANIFEST:
+                        references_span = True
+    if span_seen and references_span:
+        missing = SPAN_ROUTING_KNOBS - span_vocab
+        if missing:
+            out.append(Finding(
+                RULE, src.path, 1,
+                f"the span route ({'/'.join(_SPAN_ROUTING_FUNCS)}) never "
+                f"stages span knob(s) {sorted(missing)} for the fused "
+                "tick drivers",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pass entry point
+# ---------------------------------------------------------------------------
+
+#: Directory swept for backend forms living in files the manifest does
+#: not know yet — every recent backend PR introduced its forms in a NEW
+#: file (tickloop.py, pallas_kernels.py, shard.py), so discovery must
+#: not be limited to already-registered files.
+_OPS_DIR = "pivot_tpu/ops"
+
+
+def _ops_files(root: str) -> List[str]:
+    import os
+
+    abspath = os.path.join(root, _OPS_DIR)
+    if not os.path.isdir(abspath):
+        return []
+    return [
+        f"{_OPS_DIR}/{name}"
+        for name in sorted(os.listdir(abspath))
+        if name.endswith(".py")
+    ]
+
+
+def collect(cache) -> Tuple[List[Finding], List[str]]:
+    registered = sorted(
+        {rel for forms in MANIFEST.values() for rel, _ in forms.values()}
+        | {rel for rel, _ in SPAN_MANIFEST.values()}
+        | {_ROUTING_FILE}
+    )
+    files = sorted(set(registered) | set(_ops_files(cache.root)))
+    funcs_by_file: Dict[str, Dict[str, ast.FunctionDef]] = {}
+    scanned: List[str] = []
+    missing: List[Finding] = []
+    for rel in files:
+        src = cache.get(rel)
+        if src is None:
+            # A registered file that vanished takes ALL of its forms'
+            # coverage with it — loud failure, not a silent skip (the
+            # old lint raised FileNotFoundError here; review finding,
+            # round 12).
+            missing.append(Finding(
+                RULE, rel, 0,
+                f"registered file {rel} is missing — renamed/deleted? "
+                "update the parity manifest (its forms lost all static "
+                "coverage)",
+            ))
+            continue
+        scanned.append(rel)
+        if rel != _ROUTING_FILE:
+            funcs_by_file[rel] = _top_level_functions(src)
+
+    out: List[Finding] = list(missing)
+    family_unions: Dict[str, Set[str]] = {}
+    for family, forms in MANIFEST.items():
+        findings, knob_sets = _matrix_findings(
+            family, forms, KNOBS, funcs_by_file
+        )
+        out.extend(findings)
+        if knob_sets:
+            family_unions[family] = set().union(*knob_sets.values())
+    span_findings, _span_sets = _matrix_findings(
+        "span", SPAN_MANIFEST, SPAN_KNOBS, funcs_by_file
+    )
+    out.extend(span_findings)
+    out.extend(_discovery_findings(funcs_by_file))
+
+    routing = cache.get(_ROUTING_FILE)
+    if routing is not None:
+        out.extend(_routing_findings(routing, family_unions))
+    return out, scanned

@@ -507,17 +507,23 @@ class DispatchBatcher:
                 groups.setdefault(req.key, []).append(req)
             for reqs in groups.values():
                 reqs.sort(key=lambda r: r.slot)
-                self.stats["dispatches"] += len(reqs)
-                self.stats["device_calls"] += 1
-                self.stats["max_group"] = max(
-                    self.stats["max_group"], len(reqs)
-                )
-                if len(reqs) > 1:
-                    self.stats["coalesced"] += len(reqs)
-                if _replica_mesh_for(
-                    self._mesh, group_bucket(len(reqs))
-                ) is not None:
-                    self.stats["mesh_dispatches"] += 1
+                # Under the cond: the single-live-slot fast path bumps
+                # these same counters on the owning run's thread (found
+                # by graftcheck's thread-guard pass — unlocked "+=" here
+                # could lose an increment against a concurrent solo
+                # dispatch after a respawn reopens the pool).
+                with self._cond:
+                    self.stats["dispatches"] += len(reqs)
+                    self.stats["device_calls"] += 1
+                    self.stats["max_group"] = max(
+                        self.stats["max_group"], len(reqs)
+                    )
+                    if len(reqs) > 1:
+                        self.stats["coalesced"] += len(reqs)
+                    if _replica_mesh_for(
+                        self._mesh, group_bucket(len(reqs))
+                    ) is not None:
+                        self.stats["mesh_dispatches"] += 1
                 try:
                     outs = batch_execute(
                         reqs[0].kernel,

@@ -134,9 +134,9 @@ def _probe_device_floor() -> float:
     np.asarray(f(x))  # compile outside the timed reps
     best = float("inf")
     for _ in range(3):
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # graftcheck: ignore[determinism] -- latency probe seeding the adaptive cost model; route choice is placement-neutral (twin-parity contract, tests/test_tpu_validate.py)
         np.asarray(f(x))
-        best = min(best, time.perf_counter() - t0)
+        best = min(best, time.perf_counter() - t0)  # graftcheck: ignore[determinism] -- same probe window
     return best
 
 
@@ -626,9 +626,9 @@ class _DevicePolicyBase(Policy):
                 == self._EXPLORE_EVERY - 1
             )
             if (twin_predicted and not explore_device) or explore_twin:
-                t0 = time.perf_counter()
+                t0 = time.perf_counter()  # graftcheck: ignore[determinism] -- adaptive-routing EMA sample; which side serves a tick is timing-dependent BY DESIGN, and placements are route-invariant (twin bit-parity on the CPU backend)
                 out = self._cpu_twin.place(ctx)
-                dt = time.perf_counter() - t0
+                dt = time.perf_counter() - t0  # graftcheck: ignore[determinism] -- same EMA sample window
                 if big:
                     self._cpu_cell_cost = 0.5 * (self._cpu_cell_cost + dt / cells)
                 if explore_twin:
@@ -636,7 +636,7 @@ class _DevicePolicyBase(Policy):
                 else:
                     self._twin_routed += 1
                 return out
-            t0 = time.perf_counter()
+            t0 = time.perf_counter()  # graftcheck: ignore[determinism] -- adaptive-routing EMA sample (device side); see the twin-side justification above
             if self.degrade_after is not None:
                 try:
                     out = self._device_place(ctx)
@@ -648,7 +648,7 @@ class _DevicePolicyBase(Policy):
                 self._consecutive_failures = 0
             else:
                 out = self._device_place(ctx)
-            dt = time.perf_counter() - t0
+            dt = time.perf_counter() - t0  # graftcheck: ignore[determinism] -- same EMA sample window (device side)
             # Attribute time beyond the probed floor to per-padded-cell
             # work — but never from a bucket's first call, which includes
             # XLA compile.  (The floor itself stays probe-only for the

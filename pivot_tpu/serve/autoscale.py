@@ -137,6 +137,7 @@ class SloAutoscaler(LogMixin):
         breach = calm = 0
         last_event = -float("inf")
         while not self._stop_evt.wait(cfg.check_interval_s):
+            # graftcheck: ignore[thread-guard] -- monotonic stop flag; a stale read costs one control-loop tick, and every pool mutation below re-validates under the driver's cv
             if driver._stop:
                 return
             # Finalize any retiring session whose drain completed —

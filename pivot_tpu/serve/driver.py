@@ -281,7 +281,20 @@ class ServeDriver(LogMixin):
     def on_session_error(self, session: ServeSession, exc) -> None:
         if session.abandoned:
             return  # already replaced by the supervisor; nothing to do
-        if session.retiring and not self._stop:
+        # Snapshot the routing decision's inputs under the cv
+        # (graftcheck thread-guard: unlocked reads of _stop/_restarts
+        # here raced the producer's stop and concurrent crash handlers).
+        # The snapshot is advisory — _restart_session re-validates the
+        # stop flag AND the restart budget under the cv and reports a
+        # lost race by returning False, in which case we fall through
+        # to the fail-stop path below.
+        with self._cv:
+            stopped = self._stop
+            can_restart = (
+                self._session_factory is not None
+                and self._restarts < self._max_restarts
+            )
+        if session.retiring and not stopped:
             # A crash DURING a scale-down drain: the retire was already
             # decided — settle it (requeue the in-flight jobs onto the
             # surviving pool, retire the slot exactly once) instead of
@@ -292,22 +305,21 @@ class ServeDriver(LogMixin):
             )
             self._retire_crashed(session, close_client=False)
             return
-        if (
-            self._session_factory is not None
-            and self._restarts < self._max_restarts
-            and not self._stop
-        ):
+        if can_restart and not stopped:
             self.logger.error(
                 "session %s crashed (%s) — supervisor restarting",
                 session.label, exc,
             )
-            self._restart_session(session, close_client=False)
-            return
+            if self._restart_session(session, close_client=False):
+                return
+            if session.abandoned:
+                return  # a concurrent handler replaced it first
         with self._cv:
             self._errors.append(exc)
             self._stop = True
+            survivors = list(self.sessions) + list(self._abandoned)
             self._cv.notify_all()
-        for s in self.sessions + self._abandoned:
+        for s in survivors:
             s.shutdown()
 
     # -- the session supervisor --------------------------------------------
@@ -383,13 +395,18 @@ class ServeDriver(LogMixin):
             )
 
     def _restart_session(self, dead: ServeSession,
-                         close_client: bool) -> None:
+                         close_client: bool) -> bool:
         """Replace a crashed/stalled session: requeue its in-flight jobs
         into a factory-fresh session on a fresh batcher slot.  Called
         from the dying session's own thread (crash path — its client
         closes itself in the loop's ``finally``) or from the watchdog
         (stall path — ``close_client=True``, the stalled thread may never
-        reach its finally).
+        reach its finally).  Returns False without acting when the
+        restart lost a race — service stopped, session already replaced,
+        or the recovery budget consumed by a CONCURRENT crash between
+        the caller's check and this cv acquisition (the budget is
+        re-validated here, under the cv, authoritatively); the caller
+        then falls back to its no-restart path.
 
         Stall-path caveat (best effort by design): the wedged thread may
         still be mid-``env.step`` while this reads ``dead._live`` and
@@ -398,8 +415,11 @@ class ServeDriver(LogMixin):
         common case) has no such window: the dying thread is parked in
         its own except handler while it runs this."""
         with self._cv:
-            if self._stop or dead.abandoned:
-                return
+            if (
+                self._stop or dead.abandoned
+                or self._restarts >= self._max_restarts
+            ):
+                return False
             dead.abandoned = True
             self._restarts += 1
             self._abandoned.append(dead)
@@ -424,6 +444,7 @@ class ServeDriver(LogMixin):
         dead.shutdown()
         if close_client and getattr(dead, "_client", None) is not None:
             dead._client.close()
+        return True
 
     def _wire_and_start(self, new: ServeSession) -> None:
         """Attach a factory session to the service and start its thread
@@ -454,9 +475,11 @@ class ServeDriver(LogMixin):
         cannot be killed — and ignored when it eventually wakes)."""
         poll = self.stall_timeout / 4.0
         while not self._watch_stop.wait(poll):
+            # graftcheck: ignore[thread-guard] -- monotonic stop flag; a stale read costs one extra poll, and the replace paths re-check under the cv
             if self._stop:
                 return
             now = time.perf_counter()
+            # graftcheck: ignore[thread-guard] -- snapshot iteration: list() copies under the GIL; pool surgery happens under the cv, so the worst case is judging a just-replaced session one poll late
             for s in list(self.sessions):
                 if s.abandoned or s.error is not None or not s._live:
                     continue
@@ -472,6 +495,7 @@ class ServeDriver(LogMixin):
                     continue
                 if (
                     self._session_factory is None
+                    # graftcheck: ignore[thread-guard] -- advisory budget read; _restarts only grows, so a stale value can at worst defer fail-stop by one poll (on_session_error re-reads it under the cv)
                     or self._restarts >= self._max_restarts
                 ):
                     self.on_session_error(
@@ -810,6 +834,7 @@ class ServeDriver(LogMixin):
         wall0 = time.perf_counter()
         try:
             for arr in arrivals:
+                # graftcheck: ignore[thread-guard] -- monotonic stop flag polled between admissions; _admit re-checks under the cv before blocking
                 if self._stop:
                     return
                 if pace:
@@ -837,7 +862,10 @@ class ServeDriver(LogMixin):
             with self._cv:
                 self._release_to(float("inf"))
                 self._draining = True
-            for s in self.sessions:
+                # Snapshot under the cv: grow_pool refuses once
+                # _draining is set, so this list is the final pool.
+                pool = list(self.sessions)
+            for s in pool:
                 s.shutdown()
 
     # -- lifecycle ---------------------------------------------------------
@@ -851,35 +879,39 @@ class ServeDriver(LogMixin):
         caller's thread runs the flush coordinator.  Otherwise sessions
         run free (numpy/naive policies have no dispatch to coalesce).
         """
-        clients = [None] * len(self.sessions)
-        if all(s.batchable for s in self.sessions):
-            # Initialize the backend once, here, before any session
-            # thread dispatches — concurrent first-touch PJRT client
-            # creation is not safe (same guard as run_grid_lockstep).
-            import jax
+        # Setup under the cv: no session/producer/watchdog thread
+        # exists yet, so the lock is uncontended — holding it keeps the
+        # thread-guard discipline checkable instead of exempting run()
+        # wholesale (which would also hide the join loop below, where
+        # the pass caught a real _threads iteration race).
+        started: List[threading.Thread] = []
+        with self._cv:
+            clients = [None] * len(self.sessions)
+            if all(s.batchable for s in self.sessions):
+                # Initialize the backend once, here, before any session
+                # thread dispatches — concurrent first-touch PJRT client
+                # creation is not safe (same guard as run_grid_lockstep).
+                import jax
 
-            jax.default_backend()
-            from pivot_tpu.sched.batch import DispatchBatcher
+                jax.default_backend()
+                from pivot_tpu.sched.batch import DispatchBatcher
 
-            self.batcher = DispatchBatcher(
-                len(self.sessions), flush_after=self.flush_after
-            )
-            clients = [self.batcher.client() for _ in self.sessions]
-            for s, c in zip(self.sessions, clients):
-                s.policy.enable_batching(c)
-            self.slo.attach_dispatch_stats(self.batcher.stats)
-        for s, c in zip(self.sessions, clients):
-            s._client = c
-            self._threads.append(
-                (
-                    s,
-                    threading.Thread(
-                        target=s.loop, args=(c,),
-                        name=f"serve-{s.label}", daemon=True,
-                    ),
+                self.batcher = DispatchBatcher(
+                    len(self.sessions), flush_after=self.flush_after
                 )
-            )
-        for _s, t in list(self._threads):
+                clients = [self.batcher.client() for _ in self.sessions]
+                for s, c in zip(self.sessions, clients):
+                    s.policy.enable_batching(c)
+                self.slo.attach_dispatch_stats(self.batcher.stats)
+            for s, c in zip(self.sessions, clients):
+                s._client = c
+                thread = threading.Thread(
+                    target=s.loop, args=(c,),
+                    name=f"serve-{s.label}", daemon=True,
+                )
+                self._threads.append((s, thread))
+                started.append(thread)
+        for t in started:
             t.start()
         watchdog = None
         if self.stall_timeout is not None:
@@ -905,10 +937,13 @@ class ServeDriver(LogMixin):
         # waiting on it would hang the service shutdown the restart just
         # saved.
         while True:
-            pending = [
-                t for s, t in self._threads
-                if t.is_alive() and not s.abandoned
-            ]
+            # Snapshot under the cv: supervisor restarts and autoscaler
+            # growth append to _threads concurrently with this loop.
+            with self._cv:
+                pending = [
+                    t for s, t in self._threads
+                    if t.is_alive() and not s.abandoned
+                ]
             if not pending:
                 break
             for t in pending:
@@ -919,11 +954,12 @@ class ServeDriver(LogMixin):
             watchdog.join()
         if self._autoscaler is not None:
             self._autoscaler.stop()
-        errors = self._errors + [
-            s.error
-            for s in self.sessions + self._retired
-            if s.error is not None
-        ]
+        with self._cv:
+            errors = self._errors + [
+                s.error
+                for s in self.sessions + self._retired
+                if s.error is not None
+            ]
         if errors:
             raise errors[0]
         return self.report()
@@ -1016,6 +1052,7 @@ def closed_loop_source(
     def gen():
         yielded = 0
         while yielded < n_jobs:
+            # graftcheck: ignore[thread-guard] -- monotonic stop flag polled by the feed loop; the producer thread consuming this generator re-checks under the cv
             if driver._stop:
                 return
             try:

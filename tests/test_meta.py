@@ -26,6 +26,19 @@ TIER1_BUDGET_S = 25.0
 MIN_TESTS_FOR_ENFORCEMENT = 50
 
 
+def test_graftcheck_clean():
+    """Tier-1 wiring of the graftcheck static-analysis suite
+    (``pivot_tpu/analysis``): the backend knob-parity matrix, the
+    determinism lint over the replay-critical modules, the thread-guard
+    discipline maps, and the host-sync lint must all be clean on the
+    tree — every real finding either fixed or suppressed with a written
+    justification (and stale suppressions are themselves findings)."""
+    from pivot_tpu.analysis import run
+
+    findings = run()
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
 def test_hotpath_lint_clean():
     """Tier-1 wiring of the fused-hot-path host-sync lint
     (``tools/hotpath_lint.py``): no host synchronization — fetches,

@@ -407,6 +407,32 @@ def test_supervisor_restart_on_fresh_batcher_slot():
     assert report["batcher"]["runs"] == 3  # 2 original slots + 1 respawned
 
 
+def test_restart_session_revalidates_budget_under_cv():
+    """graftcheck round-12 race fix: ``_restart_session`` re-validates
+    the restart budget under the cv and reports a lost race by
+    returning False — two sessions crashing concurrently can no longer
+    overshoot ``max_restarts`` (each handler's pre-check snapshot can
+    be stale; the authoritative check is inside the lock)."""
+    reset_ids()
+    sessions = _sessions(2, _numpy_policy)
+
+    def factory(label):  # pragma: no cover - must not be reached
+        raise AssertionError("budget exhausted: factory must not run")
+
+    driver = ServeDriver(
+        sessions, queue_depth=8, backpressure="shed",
+        session_factory=factory, max_restarts=1,
+    )
+    # Simulate the race: the budget was consumed by a concurrent crash
+    # between a handler's advisory snapshot and its restart call.
+    driver._restarts = 1
+    assert driver._restart_session(sessions[0], close_client=False) is False
+    assert not sessions[0].abandoned  # nothing was mutated
+    assert driver._restarts == 1
+    # Below budget the same call restarts for real is covered by
+    # test_supervisor_restarts_crashed_session above.
+
+
 def test_supervisor_exhausted_budget_fails_stop():
     """Past max_restarts the supervisor falls back to fail-stop: the
     crash surfaces to the caller exactly as before supervision."""

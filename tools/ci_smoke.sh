@@ -4,8 +4,12 @@
 #
 #   1. tier-1 quick chaos soak + replay determinism (the seeded
 #      acceptance twins in tests/test_chaos.py);
-#   2. hot-path host-sync lint (tools/hotpath_lint.py — bans blocking
-#      device fetches in the tick driver / kernel cores / rollout body);
+#   2. graftcheck static analysis (tools/graftcheck.py, round 12):
+#      backend knob-parity matrix across every kernel/span form +
+#      routing layer, determinism lint over the replay-critical
+#      modules, thread-guard discipline in the serve/batch layer, and
+#      the host-sync lint (auto-discovered hot bodies); plus the
+#      legacy hotpath CLI contract (tools/hotpath_lint.py shim);
 #   3. chaos replay determinism against the COMMITTED seed schedule
 #      (data/chaos/ci_seed.json): regenerating the schedule from its
 #      seed must reproduce it bit-for-bit, and two replays of it must
@@ -36,7 +40,8 @@ echo "== [1/5] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/5] hot-path host-sync lint =="
+echo "== [2/5] graftcheck static analysis + hot-path lint CLI =="
+python tools/graftcheck.py
 python tools/hotpath_lint.py
 
 echo "== [3/5] chaos replay determinism on the committed seed =="

@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""graftcheck CLI — the repo-wide static-analysis suite.
+
+Thin launcher for :mod:`pivot_tpu.analysis` (also runnable as
+``python -m pivot_tpu.analysis``).  Four passes: backend feature-parity
+matrix, determinism lint, thread-guard discipline, host-sync lint.
+Exit 1 on findings.  See ``docs/ARCHITECTURE.md`` "Static analysis".
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from pivot_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

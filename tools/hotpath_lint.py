@@ -1,172 +1,64 @@
 #!/usr/bin/env python3
-"""Static host-sync lint for the fused device hot paths.
+"""Static host-sync lint for the fused device hot paths — thin shim.
 
-The dispatch floor this repo spent three perf rounds killing (cross-run
-batching, two-phase kernels, the fused tick loop) creeps back in through
-ONE line of code: a host synchronization inside a device loop body.  A
-``np.asarray`` on a tracer, an ``.item()``, a ``float(...)`` coercion, a
-stray ``block_until_ready`` — each forces a device→host round trip per
-loop iteration and silently turns an O(1)-dispatch program back into an
-O(K)-dispatch one (worse: under ``jax.jit`` most of these simply fail at
-trace time only when the path is exercised, which a cached-compile test
-run may never do).
+The lint itself lives in :mod:`pivot_tpu.analysis.hostsync` since the
+graftcheck migration (round 12): the hand-maintained target dict is
+replaced by naming-convention auto-discovery there, and this module
+keeps the original CLI contract (exit 1 on violation) and the
+``lint_paths``/``lint_file``/``DEFAULT_TARGETS``/``Violation`` API that
+``tests/test_meta.py`` and ``tools/ci_smoke.sh`` consume.
 
-This lint walks the AST of the registered hot-path function bodies — the
-fused tick driver (``ops/tickloop.py``), every two-phase kernel core
-(``ops/kernels.py``), and the ensemble rollout tick body
-(``parallel/ensemble/tick.py``) — and fails on any call that can force a
-host sync:
+``DEFAULT_TARGETS`` is now *computed* from the auto-discovery at import
+time — it reflects what the framework actually covers, so asserting a
+body's membership in it (the round-10 coverage pins) checks the real
+coverage, not a parallel hand-list that could drift.
 
-  * ``<x>.block_until_ready(...)``, ``<x>.item(...)``, ``<x>.tolist(...)``
-  * ``np.asarray(...)`` / ``np.array(...)`` (any of the usual numpy
-    aliases) — host materialization of a device value
-  * ``jax.device_get(...)``
-  * ``float(...)`` / ``int(...)`` / ``bool(...)`` on a non-literal —
-    scalar coercion of a tracer blocks on the value
-  * ``print(...)`` — stringification fetches
-
-Nested helper functions defined inside a registered body are scanned
-too (the loop bodies are closures).  Run as a CLI (exit 1 on violation)
-or through :func:`lint_paths` — ``tests/test_meta.py`` wires the clean
-check into tier 1, with a seeded-violation regression proving the lint
-actually bites.
+What the lint bans (see the framework module for the full story): any
+call that can force a device→host round trip inside a registered hot
+body — ``.block_until_ready()``/``.item()``/``.tolist()``, numpy host
+materialization, ``jax.device_get``, scalar coercion of non-literals,
+``print``.
 """
 
 from __future__ import annotations
 
-import ast
+import os
 import sys
-from typing import Dict, List, NamedTuple, Sequence
+from typing import Dict, List, Sequence
 
-#: Registered hot paths: repo-relative file → function names whose whole
-#: bodies must stay host-sync-free.
-DEFAULT_TARGETS: Dict[str, Sequence[str]] = {
-    "pivot_tpu/ops/tickloop.py": [
-        "_fused_tick_run_impl",
-        # Span slot-axis algebra shared with the sharded driver (round
-        # 10 factoring) — still loop-body code, still host-sync-banned.
-        "_span_ready_batch",
-        "_span_stream_order",
-        "_span_group_entries",
-        "_span_requeue",
-    ],
-    "pivot_tpu/ops/kernels.py": [
-        "opportunistic_impl",
-        "first_fit_impl",
-        "best_fit_impl",
-        "cost_aware_impl",
-        "_opportunistic_scan",
-        "_first_fit_scan",
-        "_best_fit_scan",
-        "_cost_aware_scan",
-        "_slim_drive",
-        "_chunk_drive",
-        "_speculate_commit",
-        # Shared cost-aware phase-1/score helpers (used by the sharded
-        # kernels too).
-        "_ca_phase1",
-        "_ca_group_score",
-        "_ca_best_fit_score",
-    ],
-    # Round 10: the host-sharded kernel bodies and the shard_map
-    # two-stage reduce — a host sync here would serialize every
-    # sequential step across the whole mesh, the worst possible place
-    # for the floor to creep back in.
-    "pivot_tpu/ops/shard.py": [
-        "_two_stage_argmin",
-        "_two_stage_argmin_rows",
-        "_first_index_of",
-        "_first_index_of_rows",
-        "_opportunistic_pick",
-        "_opportunistic_pick_rows",
-        "_place_local",
-        "_bump_local",
-        "_carry_free_sharded_pass",
-        "_opportunistic_sharded_pass",
-        "_first_fit_sharded_pass",
-        "_best_fit_sharded_pass",
-        "_cost_aware_sharded_pass",
-        "_sharded_chunk_drive",
-        "_opportunistic_sharded_chunk",
-        "_first_fit_sharded_chunk",
-        "_best_fit_sharded_chunk",
-        "_cost_aware_sharded_chunk_pass",
-        "_sharded_span_body",
-    ],
-    "pivot_tpu/parallel/ensemble/tick.py": ["_rollout_segment"],
-}
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
-_NUMPY_ALIASES = {"np", "numpy", "onp"}
-_NUMPY_HOST_FNS = {"asarray", "array", "copyto", "savetxt"}
-_COERCIONS = {"float", "int", "bool"}
+from pivot_tpu.analysis import _Cache  # noqa: E402
+from pivot_tpu.analysis import hostsync as _hostsync  # noqa: E402
+from pivot_tpu.analysis.hostsync import Violation  # noqa: E402,F401
 
 
-class Violation(NamedTuple):
-    path: str
-    func: str
-    line: int
-    message: str
-
-    def __str__(self) -> str:
-        return f"{self.path}:{self.line}: in {self.func}(): {self.message}"
-
-
-def _is_literal(node: ast.AST) -> bool:
-    """Constant-ish argument — coercing it cannot touch a device value.
-    Covers signed numeric literals (``-1`` parses as UnaryOp(USub,
-    Constant))."""
-    if isinstance(node, ast.UnaryOp) and isinstance(
-        node.op, (ast.USub, ast.UAdd)
-    ):
-        return _is_literal(node.operand)
-    return isinstance(node, (ast.Constant, ast.Num, ast.Str))
-
-
-def _check_call(node: ast.Call, path: str, func: str) -> List[Violation]:
-    out: List[Violation] = []
-    f = node.func
-    if isinstance(f, ast.Attribute):
-        if f.attr in _SYNC_ATTRS:
-            out.append(Violation(
-                path, func, node.lineno,
-                f"host-sync call .{f.attr}() inside a fused hot path",
-            ))
-        elif (
-            isinstance(f.value, ast.Name)
-            and f.value.id in _NUMPY_ALIASES
-            and f.attr in _NUMPY_HOST_FNS
-        ):
-            out.append(Violation(
-                path, func, node.lineno,
-                f"host materialization {f.value.id}.{f.attr}(...) inside "
-                "a fused hot path",
-            ))
-        elif (
-            isinstance(f.value, ast.Name)
-            and f.value.id == "jax"
-            and f.attr == "device_get"
-        ):
-            out.append(Violation(
-                path, func, node.lineno,
-                "jax.device_get(...) inside a fused hot path",
-            ))
-    elif isinstance(f, ast.Name):
-        if f.id in _COERCIONS and node.args and not all(
-            _is_literal(a) for a in node.args
-        ):
-            out.append(Violation(
-                path, func, node.lineno,
-                f"scalar coercion {f.id}(...) on a non-literal inside a "
-                "fused hot path (blocks on the traced value)",
-            ))
-        elif f.id == "print":
-            out.append(Violation(
-                path, func, node.lineno,
-                "print(...) inside a fused hot path (stringification "
-                "fetches)",
-            ))
+def _discovered_targets(
+    root: str = None, strict: bool = False
+) -> Dict[str, List[str]]:
+    cache = _Cache(root or _ROOT)
+    out: Dict[str, List[str]] = {}
+    for rel, patterns in _hostsync.DISCOVER.items():
+        src = cache.get(rel)
+        if src is None:
+            if strict:
+                # Match the pre-shim behavior: a registered hot-path
+                # file that vanished fails the lint loudly instead of
+                # silently dropping its bodies from coverage.
+                raise FileNotFoundError(
+                    f"registered hot-path file missing: {rel}"
+                )
+            continue
+        out[rel] = _hostsync.discover_targets(src, patterns)
     return out
+
+
+#: Auto-discovered hot paths: repo-relative file → function names whose
+#: whole bodies must stay host-sync-free (was a hand-maintained dict
+#: before round 12).
+DEFAULT_TARGETS: Dict[str, Sequence[str]] = _discovered_targets()
 
 
 def lint_file(path: str, func_names: Sequence[str]) -> List[Violation]:
@@ -176,39 +68,52 @@ def lint_file(path: str, func_names: Sequence[str]) -> List[Violation]:
     violation — a silently renamed hot path would otherwise drop out of
     coverage without anyone noticing.
     """
-    with open(path) as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    found: set = set()
-    out: List[Violation] = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and node.name in func_names
-        ):
-            found.add(node.name)
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Call):
-                    out.extend(_check_call(sub, path, node.name))
-    for missing in sorted(set(func_names) - found):
-        out.append(Violation(
-            path, missing, 0,
-            "registered hot-path function not found — update "
-            "tools/hotpath_lint.py DEFAULT_TARGETS after renames",
-        ))
-    return out
+    return _hostsync.lint_functions(path, func_names)
+
+
+def _drop_suppressed(
+    violations: List[Violation], path: str
+) -> List[Violation]:
+    """Apply the framework's ``# graftcheck: ignore[host-sync] -- …``
+    suppressions, so this shim and ``tools/graftcheck.py`` can never
+    disagree about the same tree (``ci_smoke.sh`` runs both back to
+    back).  Line-0 violations (missing registrations) are never
+    suppressible."""
+    from pivot_tpu.analysis import (
+        SourceFile, _suppression_scope, find_suppressions,
+    )
+
+    try:
+        src = SourceFile(path, path)
+    except OSError:
+        return violations
+    sups = [
+        s for s in find_suppressions(src)
+        if "host-sync" in s.rules and s.reason
+    ]
+    if not sups:
+        return violations
+    return [
+        v for v in violations
+        if v.line == 0
+        or not any(v.line in _suppression_scope(s, src) for s in sups)
+    ]
 
 
 def lint_paths(
     targets: Dict[str, Sequence[str]] = None, root: str = None
 ) -> List[Violation]:
-    """Lint every registered hot path; returns all violations."""
-    import os
-
-    targets = targets if targets is not None else DEFAULT_TARGETS
-    root = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    """Lint every registered hot path; returns all violations (minus
+    framework-suppressed ones — see :func:`_drop_suppressed`)."""
+    root = root or _ROOT
+    targets = (
+        targets if targets is not None
+        else _discovered_targets(root, strict=True)
+    )
     out: List[Violation] = []
     for rel, funcs in targets.items():
-        out.extend(lint_file(os.path.join(root, rel), funcs))
+        path = os.path.join(root, rel)
+        out.extend(_drop_suppressed(lint_file(path, funcs), path))
     return out
 
 
