@@ -1,22 +1,30 @@
 # Developer/CI entry points.  Everything runs on the CPU backend
 # (JAX_PLATFORMS=cpu) — the TPU chip is bench.py's business only.
 
+SHELL := /bin/bash
+
 .PHONY: smoke tier1 bench lint
 
 # The per-PR resilience gate: quick chaos soak, the graftcheck static-
 # analysis suite (backend knob parity, determinism, thread-guard,
-# host-sync), chaos replay determinism against the committed seed
-# (data/chaos/ci_seed.json), sharded-placement parity on a forced
+# host-sync, plus the jitcheck passes: retrace, donation, dtype,
+# pallas-budget), the compile-counter harness (zero recompiles after
+# warmup, quick mode), chaos replay determinism against the committed
+# seed (data/chaos/ci_seed.json), sharded-placement parity on a forced
 # 8-device CPU mesh, and the spot-market survival soak + market replay
 # determinism against data/market/ci_seed.json.  ~3 minutes; see
 # tools/ci_smoke.sh.
 smoke:
 	tools/ci_smoke.sh
 
-# Standalone static analysis (no JAX import, sub-second): the four
-# graftcheck passes + the legacy hotpath CLI contract.
+# Standalone static analysis (no JAX import, sub-second): the eight
+# graftcheck passes with machine-readable findings annotated per
+# file:line (tools/lint_annotate.py emits GitHub ::error lines under
+# Actions), plus the legacy hotpath CLI contract.  pipefail keeps the
+# pipe failing when graftcheck itself exits nonzero.
 lint:
-	python tools/graftcheck.py
+	set -o pipefail; \
+	python tools/graftcheck.py --json | python tools/lint_annotate.py
 	python tools/hotpath_lint.py
 
 # The full quick test tier (ROADMAP.md "Tier-1 verify").

@@ -27,6 +27,31 @@ net has holes exactly where a PR threads a new knob or a new thread:
     migrated into the framework with naming-convention auto-discovery
     replacing the hand-maintained target dict.
 
+jitcheck (round 13) extends the suite to the layer where TPU
+performance is won or lost — JAX/XLA compilation semantics — with four
+more passes behind the same walker/registry/suppression framework:
+
+  * **retrace** (``rules/retrace``) — recompilation hazards in the
+    ~30 jitted entry points (traced-value branching, host coercions,
+    stale static declarations, closure-captured numpy constants);
+  * **donation** (``rules/donation``) — the declared-carry manifest,
+    enforced both ways: declared-donated carries must keep their
+    ``donate_argnums``, declared-undonated carries (host-numpy-staged
+    operands: CPU zero-copy hazard) must stay undonated, no donated
+    buffer may be read after the call, and an unrecorded returned
+    carry is a finding;
+  * **dtype** (``rules/dtype``) — no float64 typing arrays that cross
+    the device boundary, no weak-type scalar forks in the kernel cores;
+  * **pallas-budget** (``rules/pallas-budget``) — every Pallas kernel's
+    VMEM footprint recomputed from its BlockSpec shapes and checked
+    against the v5e budget constants in ``infra/roofline.py``.
+
+Every retrace rule corresponds to a runtime observable: the
+compile-counter harness (``pivot_tpu/utils/compile_counter.py``,
+``--compile-check``, and the tier-1 ``tests/test_jitcheck.py``) asserts
+the steady-state hypothesis — zero recompiles after warmup — on the
+fused-span and serve dispatch paths.
+
 Framework pieces shared by every pass: :class:`Finding`, the rule
 registry (:data:`REGISTRY`), ``# graftcheck: ignore[rule] -- reason``
 suppressions (reason REQUIRED; a suppression that matches no finding is
@@ -80,7 +105,9 @@ class Finding(NamedTuple):
 
 
 class SourceFile:
-    """A parsed source file: text, lines, AST — parsed once per run."""
+    """A parsed source file: text, lines, AST — parsed once per run and
+    shared by every pass through the run cache (one parse per file, not
+    one per pass — the round-13 wall-clock budget depends on it)."""
 
     def __init__(self, abspath: str, relpath: str):
         self.abspath = abspath
@@ -89,6 +116,21 @@ class SourceFile:
             self.text = fh.read()
         self.lines = self.text.splitlines()
         self.tree = ast.parse(self.text, filename=abspath)
+        self._stmt_spans: Optional[List[Tuple[int, int]]] = None
+
+    @property
+    def stmt_spans(self) -> List[Tuple[int, int]]:
+        """(lineno, end_lineno) of every SIMPLE statement — computed
+        once per file and shared by all suppression-scope lookups
+        (previously one full AST walk per suppression comment)."""
+        if self._stmt_spans is None:
+            self._stmt_spans = [
+                (node.lineno, node.end_lineno or node.lineno)
+                for node in ast.walk(self.tree)
+                if isinstance(node, ast.stmt)
+                and not isinstance(node, _COMPOUND_STMTS)
+            ]
+        return self._stmt_spans
 
 
 class _Cache:
@@ -184,18 +226,13 @@ def _suppression_scope(
     cover = {sup.line, sup.line + 1}
     if src is not None:
         best = None  # innermost simple statement containing sup.line
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.stmt) or isinstance(
-                node, _COMPOUND_STMTS
-            ):
-                continue
-            end = node.end_lineno or node.lineno
-            if node.lineno <= sup.line <= end:
-                if best is None or node.lineno > best[0]:
-                    best = (node.lineno, end)
-            elif node.lineno == sup.line + 1:
+        for lineno, end in src.stmt_spans:
+            if lineno <= sup.line <= end:
+                if best is None or lineno > best[0]:
+                    best = (lineno, end)
+            elif lineno == sup.line + 1:
                 # Comment-above form: cover the whole statement below.
-                cover.update(range(node.lineno, end + 1))
+                cover.update(range(lineno, end + 1))
         if best is not None:
             cover.update(range(best[0], best[1] + 1))
     return cover
@@ -208,13 +245,27 @@ def _suppression_scope(
 def _registry():
     # Imported lazily so ``import pivot_tpu.analysis`` stays cheap and
     # the pass modules can import framework types from here.
-    from pivot_tpu.analysis import determinism, hostsync, parity, threadguard
+    from pivot_tpu.analysis import (
+        determinism,
+        donation,
+        dtype,
+        hostsync,
+        pallas_budget,
+        parity,
+        retrace,
+        threadguard,
+    )
 
     return {
         parity.RULE: parity,
         determinism.RULE: determinism,
         threadguard.RULE: threadguard,
         hostsync.RULE: hostsync,
+        # jitcheck (round 13): the compile-semantics passes.
+        retrace.RULE: retrace,
+        donation.RULE: donation,
+        dtype.RULE: dtype,
+        pallas_budget.RULE: pallas_budget,
     }
 
 
@@ -311,31 +362,103 @@ def run(
     return kept
 
 
+def _compile_check(quick: bool) -> int:
+    """The falsifying runtime twin of the ``retrace`` pass: run the
+    fused span driver cold (warmup), then steady-state, and fail if the
+    steady phase compiled ANYTHING — the "zero recompiles after warmup"
+    hypothesis, observed instead of assumed.  ``quick`` keeps shapes
+    tiny (CI smoke lane); the tier-1 suite covers the serve path too
+    (``tests/test_jitcheck.py``)."""
+    import numpy as np  # deferred: the static passes must not need jax
+
+    import jax.numpy as jnp
+
+    from pivot_tpu.ops.tickloop import fused_tick_run, span_bucket
+    from pivot_tpu.utils.compile_counter import count_compiles
+
+    H, B, K = (8, 8, 4) if quick else (64, 32, 8)
+    rng = np.random.default_rng(0)
+    avail = rng.uniform(1, 6, (H, 4))
+    dem = rng.uniform(0.3, 2.0, (B, 4))
+    arrive = np.zeros(B, np.int32)
+
+    def span(k_dyn, seed):
+        r = np.random.default_rng(seed)
+        return fused_tick_run(
+            jnp.asarray(avail * r.uniform(0.9, 1.1, avail.shape)),
+            jnp.asarray(dem), jnp.asarray(arrive),
+            jnp.asarray(k_dyn, jnp.int32),
+            policy="first-fit", n_ticks=span_bucket(K),
+        )
+
+    np.asarray(span(K, 0).placements)  # warmup: compile the program
+    with count_compiles() as counter:
+        for seed in range(3):
+            np.asarray(span(K - 1 - seed % 2, seed).placements)
+    if counter.compiles or counter.traces:
+        print(
+            f"compile-check: FAILED — {counter.compiles} backend "
+            f"compile(s), {counter.traces} retrace(s) after warmup on "
+            "the fused-span path (steady state must be zero)",
+            file=sys.stderr,
+        )
+        return 1
+    print("compile-check: zero recompiles after warmup (fused-span path)")
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    """CLI: exit 1 on findings.  ``--rules a,b`` filters passes;
-    ``--root`` points at another tree (tests use this)."""
+    """CLI: exit 1 on findings.  ``--rules a,b`` filters passes (unknown
+    names exit 2 listing the valid set); ``--json`` prints the findings
+    machine-readably; ``--root`` points at another tree (tests use
+    this); ``--compile-check`` runs the runtime recompile harness."""
     import argparse
 
     parser = argparse.ArgumentParser(
         prog="graftcheck",
         description="repo-wide static analysis: backend knob parity, "
-        "replay determinism, thread-guard discipline, host-sync lint",
+        "replay determinism, thread-guard discipline, host-sync lint, "
+        "and the jitcheck compile-hazard passes (retrace, donation, "
+        "dtype, pallas-budget)",
     )
     parser.add_argument(
         "--rules",
-        help="comma-separated rule subset (default: all)",
+        help="comma-separated rule subset (default: all); unknown "
+        "names error listing the valid rule set",
     )
     parser.add_argument("--root", help="tree to analyze (default: repo)")
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings on stdout: {rule, path, line, "
+        "message} per finding (CI annotates per file:line from this)",
+    )
+    parser.add_argument(
+        "--compile-check", nargs="?", const="quick", default=None,
+        choices=("quick", "full"),
+        help="run the runtime compile-counter harness (imports jax): "
+        "warm the fused span driver, then assert ZERO recompiles in "
+        "steady state",
+    )
     args = parser.parse_args(argv)
     registry = REGISTRY()
     if args.list_rules:
+        if args.json:
+            import json
+
+            print(json.dumps({
+                rule: (mod.__doc__ or "").strip().splitlines()[0]
+                for rule, mod in registry.items()
+            }, indent=2))
+            return 0
         for rule, mod in registry.items():
             doc = (mod.__doc__ or "").strip().splitlines()
             print(f"{rule}: {doc[0] if doc else ''}")
         return 0
+    if args.compile_check is not None:
+        return _compile_check(quick=args.compile_check == "quick")
     rules = (
         [r.strip() for r in args.rules.split(",") if r.strip()]
         if args.rules else None
@@ -345,6 +468,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as exc:
         print(f"graftcheck: {exc}", file=sys.stderr)
         return 2
+    if args.json:
+        import json
+
+        print(json.dumps(
+            {
+                "clean": not findings,
+                "rules": rules or sorted(registry),
+                "findings": [f._asdict() for f in findings],
+            },
+            indent=2,
+        ))
+        return 1 if findings else 0
     for f in findings:
         print(f, file=sys.stderr)
     if findings:

@@ -1,0 +1,340 @@
+"""Donation lint: the declared-carry manifest, checked two ways.
+
+The repo's device-resident carries — the tickloop span availability,
+its host-sharded twin, and the ensemble segment states — are the
+dominant live allocations of their dispatch paths.  ``donate_argnums``
+is what lets XLA alias a carry's input buffer with its output instead
+of holding two copies per call; it is also a contract the *caller*
+must honor (a donated buffer is deleted — reading it after the call is
+a runtime error the CPU tests may never hit if the code path is
+device-only).  Both sides rot silently, so both are checked:
+
+  1. **Manifest coverage** — every carry in :data:`MANIFEST` is a
+     recorded decision, positive or negative.  ``donated=True``
+     entries (the ensemble segment/sweep carries, whose inputs are
+     always previous jit OUTPUTS — device-owned buffers) must be
+     wrapped by a jit that donates the declared position; a wrapper
+     that vanished or dropped its ``donate_argnums`` is a finding.
+     ``donated=False`` entries (the span availability carries) must
+     stay UNdonated: their operands are staged from host numpy at the
+     call boundary, and on the CPU backend ``jnp.asarray(host_array)``
+     is **zero-copy for large aligned arrays** — donating such a
+     buffer lets XLA reuse memory the caller still owns (measured in
+     round 13: silent, allocation-order-dependent corruption of the
+     DES availability snapshot).  Flipping either direction without
+     flipping the manifest is a finding.
+  2. **Use-after-donate** — a call passing a plain variable at a
+     donated position kills that variable: any later read of it in the
+     same function (without an intervening rebind — the
+     ``state, pending = step(state, ...)`` loop idiom rebinds at the
+     call itself) is a finding.  Precision limit: only direct ``Name``
+     arguments are tracked (a ``*args`` spread or a fresh
+     ``jnp.asarray(...)`` at the call site has no name to misuse).
+  3. **Missed donations** — discovery: a jitted entry point whose
+     wrapped function *returns* a carry-named parameter
+     (:data:`_CARRY_HINTS` — the structurally-unchanged-shape carry
+     signature) without donating it is flagged, unless the carry is
+     covered by a manifest entry or the gap is a declared, justified
+     exemption in :data:`EXEMPT`.  An exemption is a documented
+     decision; an undeclared gap is a finding — the same discipline
+     as the parity matrix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from pivot_tpu.analysis import Finding
+from pivot_tpu.analysis import jitmap
+
+RULE = "donation"
+
+
+class Carry(NamedTuple):
+    """One declared carry decision."""
+
+    file: str       # repo-relative file of the jit site
+    site: str       # jit-site name (wrapper/factory, jitmap naming)
+    arg: int        # positional index of the carry in the WRAPPED fn
+    param: str      # its parameter name
+    donated: bool   # the declared decision, enforced both ways
+    why: str        # rationale (negative entries especially)
+
+
+#: carry label → declared decision.
+MANIFEST: Dict[str, Carry] = {
+    "span-avail-carry": Carry(
+        "pivot_tpu/ops/tickloop.py", "_fused_tick_run", 0, "avail",
+        donated=False,
+        why="span operands are staged from host numpy per call; "
+            "CPU-backend jnp.asarray is zero-copy for large aligned "
+            "arrays, so donation would scribble on caller-owned memory",
+    ),
+    "sharded-span-avail-carry": Carry(
+        "pivot_tpu/ops/shard.py", "_sharded_span_fn", 0, "avail",
+        donated=False,
+        why="sharded twin of span-avail-carry — same zero-copy hazard",
+    ),
+    "ensemble-segment-carry": Carry(
+        "pivot_tpu/parallel/ensemble/checkpoint.py",
+        "_segment_step_carry", 0, "state", donated=True,
+        why="the carry is always a previous segment's OUTPUT (device-"
+            "owned; the executor defensively copies the first state)",
+    ),
+    "sweep-row-carry": Carry(
+        "pivot_tpu/parallel/ensemble/sweeps.py",
+        "_row_segment_step_carry", 0, "states", donated=True,
+        why="same output-fed contract as ensemble-segment-carry",
+    ),
+}
+
+#: Donating callables tracked for use-after-donate, by PUBLIC call name
+#: → donated positional index at that call site (only the POSITIVE
+#: manifest entries — an undonated carry cannot be used-after-donate).
+DONATING_CALLS: Dict[str, int] = {
+    "_segment_step_carry": 0,
+    "_row_segment_step_carry": 0,
+}
+
+#: Parameter names that mark a carry-shaped argument in the
+#: missed-donation discovery.
+_CARRY_HINTS = frozenset({"avail", "avail_r", "state", "states", "carry"})
+
+#: (file, site name, param) → justification.  Declared decisions NOT to
+#: donate a returned carry-shaped argument.
+EXEMPT: Dict[Tuple[str, str, str], str] = {
+    ("pivot_tpu/ops/kernels.py", "opportunistic_kernel", "avail"):
+        "per-tick twin: parity suites re-dispatch one staged snapshot "
+        "to several forms; the [H, 4] buffer is not a cross-call carry",
+    ("pivot_tpu/ops/kernels.py", "first_fit_kernel", "avail"):
+        "per-tick twin — same snapshot-sharing contract as above",
+    ("pivot_tpu/ops/kernels.py", "best_fit_kernel", "avail"):
+        "per-tick twin — same snapshot-sharing contract as above",
+    ("pivot_tpu/ops/kernels.py", "cost_aware_kernel", "avail"):
+        "per-tick twin — same snapshot-sharing contract as above",
+    ("pivot_tpu/ops/pallas_kernels.py", "cost_aware_pallas_batched",
+     "avail_r"):
+        "bench and placement_sensitivity re-score the same [R, H, 4] "
+        "replica ensemble across repeats; VMEM, not HBM aliasing, is "
+        "the binding constraint for the Pallas form",
+    ("pivot_tpu/parallel/ensemble/checkpoint.py", "_segment_step",
+     "state"):
+        "the deliberately NON-donating twin behind the segmented "
+        "executor's defensive first copy (see _segment_step_carry)",
+    ("pivot_tpu/parallel/ensemble/sweeps.py", "_row_segment_step",
+     "states"):
+        "non-donating twin of _row_segment_step_carry, by design",
+    ("pivot_tpu/parallel/ensemble/bill.py", "_finalize_batch", "states"):
+        "finalize derives metrics from every state leaf; callers "
+        "legitimately inspect final states after finalizing, and the "
+        "int32 stage/qpos leaves share no shape with any output",
+}
+
+
+def _manifest_findings(
+    sites: Dict[str, List[jitmap.JitSite]]
+) -> List[Finding]:
+    out: List[Finding] = []
+    for label, carry in sorted(MANIFEST.items()):
+        if carry.file not in sites:
+            continue  # registry finding already emitted by retrace
+        match = [s for s in sites[carry.file] if s.name == carry.site]
+        if not match:
+            out.append(Finding(
+                RULE, carry.file, 0,
+                f"manifest carry {label!r}: jit site {carry.site} not "
+                "found — renamed? update pivot_tpu/analysis/donation.py "
+                "MANIFEST (the carry lost its declared-decision check)",
+            ))
+            continue
+        for site in match:
+            donated = (
+                carry.arg in site.donate_nums
+                or carry.param in site.donate_params
+            )
+            if carry.donated and not donated:
+                out.append(Finding(
+                    RULE, carry.file, site.lineno,
+                    f"manifest carry {label!r}: {carry.site} does not "
+                    f"donate argument {carry.arg} ({carry.param!r}) — "
+                    "the carry holds two live copies per dispatch; add "
+                    f"donate_argnums=({carry.arg},)",
+                ))
+            elif not carry.donated and donated:
+                out.append(Finding(
+                    RULE, carry.file, site.lineno,
+                    f"manifest carry {label!r}: {carry.site} DONATES "
+                    f"argument {carry.arg} ({carry.param!r}) against "
+                    f"the declared decision ({carry.why}) — remove "
+                    "donate_argnums or flip the manifest entry with a "
+                    "new safety argument",
+                ))
+    return out
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (
+            stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        )
+        for tgt in targets:
+            for node in ast.walk(tgt):
+                if isinstance(node, ast.Name):
+                    out.add(node.id)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        for node in ast.walk(stmt.target):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+def _own_nodes(fn: ast.AST) -> List[ast.AST]:
+    """Every AST node belonging to ``fn`` itself — nested ``def``
+    bodies are excluded, so a donation in one function can never be
+    conflated with a read of a same-named variable in another scope
+    (lambdas stay included: they close over the enclosing frame)."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _use_after_donate(src, fn: ast.AST) -> List[Finding]:
+    """Flag reads of a variable after it was passed at a donated
+    position, with no rebind in between (line-ordered approximation
+    over ONE function scope; a rebind at the donating call's own
+    statement counts)."""
+    out: List[Finding] = []
+    # (var, call lineno, call end lineno) — the call's own span is
+    # excluded from the read scan (the donated argument itself may sit
+    # on a later physical line of a multi-line call).
+    donations: List[Tuple[str, int, int]] = []
+    rebinds: List[Tuple[str, int]] = []
+    nodes = _own_nodes(fn)
+
+    for node in nodes:
+        if isinstance(node, ast.stmt):
+            for name in _assigned_names(node):
+                rebinds.append((name, node.lineno))
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        callee = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if callee not in DONATING_CALLS:
+            continue
+        idx = DONATING_CALLS[callee]
+        if idx < len(node.args) and isinstance(node.args[idx], ast.Name):
+            donations.append((
+                node.args[idx].id, node.lineno,
+                node.end_lineno or node.lineno,
+            ))
+
+    if not donations:
+        return out
+    for var, call_line, call_end in donations:
+        for node in nodes:
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id == var
+                and node.lineno > call_end
+            ):
+                rebound = any(
+                    name == var and call_line <= line <= node.lineno
+                    for name, line in rebinds
+                )
+                if not rebound:
+                    out.append(Finding(
+                        RULE, src.path, node.lineno,
+                        f"use-after-donate: {var!r} was donated at line "
+                        f"{call_line} (its buffer is deleted by the "
+                        "call) and is read here without a rebind — "
+                        "re-stage the operand or restructure",
+                    ))
+    return out
+
+
+def _returned_names(fn: ast.AST) -> Set[str]:
+    """Names appearing in any return expression (lambda body included)."""
+    out: Set[str] = set()
+    if isinstance(fn, ast.Lambda):
+        for node in ast.walk(fn.body):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def _missed_donations(
+    sites: Dict[str, List[jitmap.JitSite]]
+) -> List[Finding]:
+    out: List[Finding] = []
+    covered = {
+        (c.file, c.site, c.param) for c in MANIFEST.values()
+    }
+    for rel in sorted(sites):
+        for site in sites[rel]:
+            if site.fn is None:
+                continue
+            returned = _returned_names(site.fn)
+            for param in jitmap.positional_params(site.fn):
+                if param not in _CARRY_HINTS or param not in returned:
+                    continue
+                if param in site.donate_params:
+                    continue
+                key = (rel, site.name, param)
+                if key in covered or key in EXEMPT:
+                    continue
+                out.append(Finding(
+                    RULE, rel, site.lineno,
+                    f"missed donation: jitted {site.name} returns its "
+                    f"carry-shaped argument {param!r} without donating "
+                    "it — two live copies per call; record the decision "
+                    "in the MANIFEST (donated or justified-undonated) "
+                    "or declare an exemption in "
+                    "pivot_tpu/analysis/donation.py",
+                ))
+    return out
+
+
+def collect(cache) -> Tuple[List[Finding], List[str]]:
+    sites, _registry_findings, scanned = jitmap.collect_sites(cache)
+    out: List[Finding] = []
+    # A manifest carry whose registered file vanished must fail THIS
+    # pass loudly, not rely on retrace also running — `--rules
+    # donation` alone would otherwise print clean while the carry's
+    # declared-decision check silently disappears.
+    for label, carry in sorted(MANIFEST.items()):
+        if carry.file not in sites:
+            out.append(Finding(
+                RULE, carry.file, 0,
+                f"manifest carry {label!r}: registered file "
+                f"{carry.file} is missing — renamed/deleted? update "
+                "pivot_tpu/analysis/donation.py MANIFEST (and "
+                "jitmap.JIT_FILES); the carry lost its donation check",
+            ))
+    out.extend(_manifest_findings(sites))
+    out.extend(_missed_donations(sites))
+    for rel in sorted(sites):
+        src = cache.get(rel)
+        for node in ast.walk(src.tree):
+            # Per innermost function: _own_nodes keeps each scope's
+            # donations and reads from leaking into sibling scopes.
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_use_after_donate(src, node))
+    return out, scanned

@@ -1,0 +1,183 @@
+"""Dtype lint: no float64 across the device boundary, no weak-type forks.
+
+The device layer is a **float32 world** (``_DevicePolicyBase.dtype``;
+the f64 parity runs opt in explicitly by overriding the policy dtype).
+Under ``jax_enable_x64`` — the tests' configuration, and any user's one
+config flag away — an implicitly-typed staging buffer silently becomes
+float64 on the device: memory doubles, and the compile cache forks into
+per-dtype program families (the retrace pass's problem wearing a dtype
+mask).  PR 11's fix moved every such buffer to **cast-at-source** (built
+in the policy dtype, f64 math rounding once on assignment — bit-identical
+to the old cast-at-staging); this pass keeps it that way:
+
+  * **float64 on the boundary** — any ``np.float64`` / ``jnp.float64``
+    reference, ``"float64"`` dtype string, or ``.astype(np.float64)``
+    in the device-boundary modules (:data:`SCOPE`) is a finding.  Host
+    f64 math is fine everywhere else (the DES and the numpy twins ARE
+    f64 by contract); what is banned is f64 *typing the arrays that get
+    staged*.  A justified exception carries a
+    ``# graftcheck: ignore[dtype] -- reason`` suppression.
+  * **weak-type mixing in kernel cores** — inside the hot-path bodies
+    (the host-sync pass's DISCOVER map, shared so the two passes cover
+    the same cores), a ``jnp.asarray``/``jnp.array``/``jnp.full`` whose
+    payload is a float literal and that omits an explicit dtype creates
+    a weak-typed scalar whose concrete dtype follows the x64 flag —
+    one innocuous constant forks the kernel's compile cache per config.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from pivot_tpu.analysis import Finding, SourceFile
+from pivot_tpu.analysis import hostsync
+
+RULE = "dtype"
+
+#: Device-boundary modules: files whose numpy arrays get staged onto
+#: the accelerator.  The CPU twins (``sched/policies.py``), the DES,
+#: and the converters stay out of scope — f64 is their contract.
+SCOPE = (
+    "pivot_tpu/ops",
+    "pivot_tpu/sched/tpu.py",
+    "pivot_tpu/sched/batch.py",
+    "pivot_tpu/parallel",
+)
+
+_ARRAY_MODS = {"np", "numpy", "onp", "jnp"}
+
+
+def _is_float64_ref(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "float64"
+        and isinstance(node.value, ast.Name)
+        and node.value.id in _ARRAY_MODS
+    )
+
+
+def _is_float_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_float_literal(node.operand)
+    return isinstance(node, ast.Constant) and isinstance(node.value, float)
+
+
+def scan_boundary(src: SourceFile) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        if _is_float64_ref(node):
+            out.append(Finding(
+                RULE, src.path, node.lineno,
+                "float64 on a device-boundary path — under x64 this "
+                "stages a double-width buffer and forks the compile "
+                "cache per dtype; build in the policy dtype at source "
+                "(np.dtype(self.dtype)) so f64 math rounds once on "
+                "assignment",
+            ))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == "float64"
+        ):
+            out.append(Finding(
+                RULE, src.path, node.lineno,
+                'astype("float64") on a device-boundary path — see the '
+                "cast-at-source rule",
+            ))
+        elif isinstance(node, ast.keyword) and node.arg == "dtype" and (
+            isinstance(node.value, ast.Constant)
+            and node.value.value == "float64"
+        ):
+            out.append(Finding(
+                RULE, src.path, node.lineno,
+                'dtype="float64" on a device-boundary path — see the '
+                "cast-at-source rule",
+            ))
+    return out
+
+
+def _weak_ctor_findings(src: SourceFile, fn_names) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(src.tree):
+        if not (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in fn_names
+        ):
+            continue
+        for sub in ast.walk(node):
+            if not (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == "jnp"
+                and sub.func.attr in {"asarray", "array", "full"}
+            ):
+                continue
+            payload_idx = 1 if sub.func.attr == "full" else 0
+            if len(sub.args) <= payload_idx or not _is_float_literal(
+                sub.args[payload_idx]
+            ):
+                continue
+            has_dtype = len(sub.args) > payload_idx + 1 or any(
+                kw.arg == "dtype" for kw in sub.keywords
+            )
+            if not has_dtype:
+                out.append(Finding(
+                    RULE, src.path, sub.lineno,
+                    f"weak-typed jnp.{sub.func.attr}(<float literal>) "
+                    f"without an explicit dtype inside hot body "
+                    f"{node.name}() — its concrete dtype follows the "
+                    "x64 flag and forks the kernel's compile cache; "
+                    "pass the carry dtype explicitly",
+                ))
+    return out
+
+
+def _scope_files(root: str) -> List[str]:
+    import os
+
+    rels: List[str] = []
+    for entry in SCOPE:
+        abspath = os.path.join(root, entry)
+        if os.path.isfile(abspath):
+            rels.append(entry)
+        elif os.path.isdir(abspath):
+            for dirpath, _dirs, files in sorted(os.walk(abspath)):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        rels.append(os.path.relpath(
+                            os.path.join(dirpath, name), root
+                        ))
+    return rels
+
+
+def collect(cache) -> Tuple[List[Finding], List[str]]:
+    import os
+
+    out: List[Finding] = []
+    scanned: List[str] = []
+    for entry in SCOPE:
+        if not os.path.exists(os.path.join(cache.root, entry)):
+            out.append(Finding(
+                RULE, entry, 0,
+                f"device-boundary scope entry {entry} is missing — "
+                "renamed/deleted? update dtype SCOPE (it lost all lint "
+                "coverage)",
+            ))
+    for rel in _scope_files(cache.root):
+        src = cache.get(rel)
+        if src is None:
+            continue
+        scanned.append(rel)
+        out.extend(scan_boundary(src))
+        targets = hostsync.DISCOVER.get(rel)
+        if targets:
+            names = hostsync.discover_targets(src, targets)
+            out.extend(_weak_ctor_findings(src, set(names)))
+    return out, scanned

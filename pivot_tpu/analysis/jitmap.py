@@ -1,0 +1,341 @@
+"""Shared jit-site discovery for the jitcheck passes.
+
+The ``retrace`` and ``donation`` passes both reason about *jitted entry
+points*: the ~30 places a Python function crosses into XLA (``jax.jit``
+as a decorator, a module-level ``name = jax.jit(impl, ...)`` wrapper, or
+a ``return jax.jit(...)`` inside a cached factory).  This module is the
+one resolver both passes share, so "what counts as a jitted entry
+point" — and which file hosts one — can never drift between them.
+
+Recognized wrapping shapes (everything the repo actually uses):
+
+  * ``@jax.jit`` / ``@functools.partial(jax.jit, static_argnames=...,
+    donate_argnums=...)`` on a ``def``;
+  * ``name = jax.jit(impl, static_argnames=..., donate_argnums=...)``
+    at module level, with ``impl`` a module-level ``def`` or ``lambda``;
+  * ``jax.jit(X, ...)`` inside a factory function (the lru-cached
+    shard_map wrappers), where ``X`` unwraps through ``jax.vmap(f)``,
+    ``_shard_map(f, ...)``, or ``functools.partial(f, **bound)`` to a
+    local or module-level ``def``.  ``functools.partial`` keyword names
+    count as *static* (they are bound at trace time, exactly like
+    ``static_argnames``).
+
+:data:`JIT_FILES` is the registry of files allowed to contain jitted
+entry points.  Discovery sweeps the whole package for ``jax.jit``
+occurrences, so a NEW file acquiring a jit wrapper is a finding ("add
+it to the registry") instead of a silent coverage gap — the same
+register-or-flag discipline as the parity manifest and the hostsync
+DISCOVER map.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from pivot_tpu.analysis import Finding, SourceFile
+
+#: Files registered to contain jitted entry points.  Adding a jit
+#: wrapper to any other pivot_tpu file fails the sweep below until the
+#: file is registered here (and thereby scanned by retrace/donation).
+JIT_FILES: Tuple[str, ...] = (
+    "pivot_tpu/ops/kernels.py",
+    "pivot_tpu/ops/tickloop.py",
+    "pivot_tpu/ops/shard.py",
+    "pivot_tpu/ops/pallas_kernels.py",
+    "pivot_tpu/sched/tpu.py",
+    "pivot_tpu/sched/batch.py",
+    "pivot_tpu/parallel/ensemble/__init__.py",
+    "pivot_tpu/parallel/ensemble/checkpoint.py",
+    "pivot_tpu/parallel/ensemble/sweeps.py",
+    "pivot_tpu/parallel/ensemble/bill.py",
+)
+
+#: Package subtree swept for unregistered ``jax.jit`` usage.
+_SWEEP_ROOT = "pivot_tpu"
+
+
+class JitSite(NamedTuple):
+    """One jitted entry point, resolved as far as the AST allows."""
+
+    path: str                      # repo-relative file
+    name: str                      # public handle (wrapper/factory name)
+    lineno: int                    # line of the jax.jit call
+    fn: Optional[ast.AST]          # wrapped FunctionDef/Lambda (or None)
+    static_names: Tuple[str, ...]  # trace-time-constant parameter names
+    donate_params: Tuple[str, ...]  # donated parameter names (resolved)
+    donate_nums: Tuple[int, ...]   # raw donate_argnums
+    stale_statics: Tuple[str, ...]  # static names matching no parameter
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+def _is_partial(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr == "partial" and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "functools"
+    return isinstance(node, ast.Name) and node.id == "partial"
+
+
+def _const_strings(node: Optional[ast.AST]) -> Tuple[str, ...]:
+    """String constants of a name-tuple keyword (``static_argnames``)."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _const_ints(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def positional_params(fn: ast.AST) -> List[str]:
+    """Positional parameter names of a ``def``/``lambda`` (no varargs)."""
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def all_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)]
+
+
+class _Resolver:
+    """Name → function-def resolution: module level plus the locals of
+    the factory function enclosing the jit call."""
+
+    def __init__(self, tree: ast.Module):
+        self.module: Dict[str, ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module[node.name] = node
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Lambda
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module[tgt.id] = node.value
+
+    def resolve(self, name: str, scope: Optional[ast.AST]) -> Optional[ast.AST]:
+        if scope is not None:
+            for node in ast.walk(scope):
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == name
+                ):
+                    return node
+        return self.module.get(name)
+
+
+def _unwrap(node: ast.AST, resolver: _Resolver, scope) -> Tuple[
+    Optional[ast.AST], Tuple[str, ...]
+]:
+    """Resolve a jit operand to the wrapped function, collecting the
+    static names ``functools.partial`` binds along the way."""
+    bound: Tuple[str, ...] = ()
+    if isinstance(node, ast.Lambda):
+        return node, bound
+    if isinstance(node, ast.Name):
+        return resolver.resolve(node.id, scope), bound
+    if isinstance(node, ast.Call):
+        f = node.func
+        if _is_partial(f):
+            bound = tuple(
+                kw.arg for kw in node.keywords if kw.arg is not None
+            )
+            if node.args:
+                inner, more = _unwrap(node.args[0], resolver, scope)
+                return inner, bound + more
+            return None, bound
+        # jax.vmap(f) / _shard_map(f, ...) / any wrapper(f, ...): the
+        # first positional argument is the wrapped callable.
+        if node.args:
+            return _unwrap(node.args[0], resolver, scope)
+    return None, bound
+
+
+def _site_name(call: ast.Call, parents: Dict[int, ast.AST]) -> Tuple[str, int]:
+    """Public handle for a jit call: the assignment target, the
+    decorated def, or the enclosing factory function."""
+    node: ast.AST = call
+    while id(node) in parents:
+        parent = parents[id(node)]
+        if isinstance(parent, ast.Assign):
+            for tgt in parent.targets:
+                if isinstance(tgt, ast.Name):
+                    return tgt.id, call.lineno
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return parent.name, call.lineno
+        node = parent
+    return "<module>", call.lineno
+
+
+def _build_site(
+    path: str,
+    name: str,
+    lineno: int,
+    fn: Optional[ast.AST],
+    keywords: List[ast.keyword],
+    partial_bound: Tuple[str, ...],
+) -> JitSite:
+    kw = {k.arg: k.value for k in keywords if k.arg is not None}
+    static = _const_strings(kw.get("static_argnames")) + partial_bound
+    static_nums = _const_ints(kw.get("static_argnums"))
+    donate_nums = _const_ints(kw.get("donate_argnums"))
+    donate_names = _const_strings(kw.get("donate_argnames"))
+    stale: Tuple[str, ...] = ()
+    donate_params = donate_names
+    if fn is not None:
+        pos = positional_params(fn)
+        names = set(all_params(fn))
+        stale = tuple(
+            s for s in _const_strings(kw.get("static_argnames"))
+            if s not in names
+        ) + tuple(
+            # An out-of-range static_argnums index is the same rot as a
+            # stale static name: the knob it used to pin is gone and
+            # something else is silently traced.
+            f"static_argnums[{i}]" for i in static_nums
+            if not 0 <= i < len(pos)
+        )
+        static = static + tuple(
+            pos[i] for i in static_nums if 0 <= i < len(pos)
+        )
+        donate_params = donate_params + tuple(
+            pos[i] for i in donate_nums if 0 <= i < len(pos)
+        )
+    return JitSite(
+        path, name, lineno, fn, tuple(dict.fromkeys(static)),
+        tuple(dict.fromkeys(donate_params)), donate_nums, stale,
+    )
+
+
+def sites_in(src: SourceFile) -> List[JitSite]:
+    """Every jitted entry point of one parsed file."""
+    resolver = _Resolver(src.tree)
+    parents: Dict[int, ast.AST] = {}
+    enclosing: Dict[int, Optional[ast.AST]] = {}
+
+    def index(node: ast.AST, scope: Optional[ast.AST]):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+            enclosing[id(child)] = scope
+            index(
+                child,
+                child if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) else scope,
+            )
+
+    index(src.tree, None)
+    out: List[JitSite] = []
+    seen_calls: set = set()
+
+    # Decorated defs first: the decorator list owns the jit call there.
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if _is_jax_jit(dec):
+                out.append(_build_site(
+                    src.path, node.name, node.lineno, node, [], ()
+                ))
+            elif isinstance(dec, ast.Call) and _is_partial(dec.func):
+                if dec.args and _is_jax_jit(dec.args[0]):
+                    seen_calls.add(id(dec))
+                    out.append(_build_site(
+                        src.path, node.name, node.lineno, node,
+                        dec.keywords, (),
+                    ))
+
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call) and _is_jax_jit(node.func)):
+            continue
+        if id(node) in seen_calls or not node.args:
+            continue
+        scope = enclosing.get(id(node))
+        fn, partial_bound = _unwrap(node.args[0], resolver, scope)
+        name, lineno = _site_name(node, parents)
+        out.append(_build_site(
+            src.path, name, lineno, fn, node.keywords, partial_bound
+        ))
+    return out
+
+
+def _sweep_unregistered(cache) -> Tuple[List[Finding], List[str]]:
+    """Package files with ``jax.jit`` usage outside :data:`JIT_FILES`."""
+    out: List[Finding] = []
+    swept: List[str] = []
+    root = os.path.join(cache.root, _SWEEP_ROOT)
+    if not os.path.isdir(root):
+        return out, swept
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fname), cache.root)
+            if rel in JIT_FILES or rel.startswith("pivot_tpu/analysis"):
+                continue
+            src = cache.get(rel)
+            if src is None or "jax.jit" not in src.text:
+                continue
+            swept.append(rel)
+            if sites_in(src):
+                out.append(Finding(
+                    "retrace", rel, 1,
+                    "jitted entry point in a file the jitcheck passes do "
+                    f"not cover — add {rel} to pivot_tpu/analysis/"
+                    "jitmap.py JIT_FILES so retrace/donation scan it",
+                ))
+    return out, swept
+
+
+def collect_sites(cache) -> Tuple[
+    Dict[str, List[JitSite]], List[Finding], List[str]
+]:
+    """All jit sites per registered file, plus registry findings
+    (missing registered file, unregistered file hosting a jit site) and
+    the scanned-file list for suppression processing."""
+    findings: List[Finding] = []
+    scanned: List[str] = []
+    sites: Dict[str, List[JitSite]] = {}
+    for rel in JIT_FILES:
+        src = cache.get(rel)
+        if src is None:
+            findings.append(Finding(
+                "retrace", rel, 0,
+                f"registered jit file {rel} is missing — renamed/deleted? "
+                "update pivot_tpu/analysis/jitmap.py JIT_FILES (its entry "
+                "points lost all jitcheck coverage)",
+            ))
+            continue
+        scanned.append(rel)
+        sites[rel] = sites_in(src)
+    sweep_findings, swept = _sweep_unregistered(cache)
+    findings.extend(sweep_findings)
+    scanned.extend(swept)
+    return sites, findings, scanned

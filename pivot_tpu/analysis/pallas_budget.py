@@ -1,0 +1,565 @@
+"""Pallas VMEM-budget pass: recompute every kernel's footprint statically.
+
+The replica-batched greedy kernel (``ops/pallas_kernels.py``) sizes its
+blocks against byte formulas (``rb_bytes``/``tile_bytes``) that were
+derived BY HAND from the BlockSpec tile set and validated on hardware
+(RB=512 at Hp=512 compiles; RB=1024 fails Mosaic).  Those formulas are
+load-bearing — the auto-sizer trusts them — and nothing stopped a tile
+edit from silently de-syncing them until a real chip OOMed.  This pass
+closes the loop without a chip:
+
+  1. **Recompute** the footprint from the ``pl.pallas_call`` spec set
+     itself: every VMEM ``BlockSpec``/scratch shape is symbolically
+     evaluated over the size variables (``RB``, ``Hp``, ``chunk``),
+     with the accounting convention the hardware validated — blocks
+     whose index_map varies along the **innermost grid axis** are
+     double-buffered by the Mosaic pipeline (×2); grid-outer and
+     invariant blocks are single (×1); SMEM streams are not VMEM.
+  2. **Drift check**: the spec-derived replica-scaled and streamed-tile
+     byte functions must equal the in-source ``rb_bytes``/``tile_bytes``
+     formulas at every probe point.  Editing the specs without the
+     formulas (or vice versa) fails here, at lint time.
+  3. **Budget check**: against the v5e constants in
+     ``infra/roofline.py`` (``PALLAS_VMEM_BUDGET_BYTES`` <
+     ``V5E_SCOPED_VMEM_BYTES``), inside the hardware-proven host-lane
+     envelope (``PALLAS_PROVEN_HP``): the auto-sizer's block must fit
+     the budget, and even the minimum (one-sublane) block must fit the
+     scoped limit — if it cannot, no fallback exists and the kernel is
+     a guaranteed Mosaic compile failure at that shape.
+  4. **Constant hygiene**: the kernel file must import the budget
+     constants from roofline (a re-hardcoded literal is drift waiting
+     to happen), and no Pallas operand may be 8-byte-typed (the dtype
+     pass's rule, enforced where it doubles VMEM).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from pivot_tpu.analysis import Finding, SourceFile
+
+RULE = "pallas-budget"
+
+_PALLAS_FILE = "pivot_tpu/ops/pallas_kernels.py"
+_ROOFLINE_FILE = "pivot_tpu/infra/roofline.py"
+_BUDGET_CONSTS = (
+    "V5E_SCOPED_VMEM_BYTES", "PALLAS_VMEM_BUDGET_BYTES", "PALLAS_PROVEN_HP",
+)
+
+#: dtype name (as written in source) → bytes per element.
+_DTYPE_BYTES = {
+    "f32": 4, "float32": 4, "int32": 4, "i32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int8": 1, "uint8": 1, "bool_": 1,
+    "float64": 8, "int64": 8,
+}
+
+#: Probe points for the drift check: (Hp, chunk) pairs inside the
+#: proven envelope plus RB values spanning the block range.
+_RB_PROBES = (8, 64, 512)
+
+
+class _Block(NamedTuple):
+    shape: Tuple[ast.AST, ...]   # element expressions (unevaluated)
+    dtype_bytes: int
+    inner_varying: bool          # index_map reads the innermost grid axis
+    memory_space: str            # "vmem" | "smem" | "?"
+    lineno: int
+
+
+def _safe_eval(node: ast.AST, env: Dict[str, float]):
+    """Tiny arithmetic evaluator: constants, env names, + - * / // **."""
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise KeyError(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        v = _safe_eval(node.operand, env)
+        return -v if isinstance(node.op, ast.USub) else v
+    if isinstance(node, ast.BinOp):
+        left = _safe_eval(node.left, env)
+        right = _safe_eval(node.right, env)
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.Div):
+            return left / right
+        if isinstance(node.op, ast.FloorDiv):
+            return left // right
+        if isinstance(node.op, ast.Pow):
+            return left ** right
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in {"int", "max", "min"}:
+            vals = [_safe_eval(a, env) for a in node.args]
+            if node.func.id == "int":
+                return int(vals[0])
+            return max(vals) if node.func.id == "max" else min(vals)
+    raise ValueError(
+        f"unevaluable expression at line {getattr(node, 'lineno', '?')}"
+    )
+
+
+def _dtype_bytes_of(node: Optional[ast.AST], aliases: Dict[str, str]) -> int:
+    """Bytes/element of a dtype expression (Name alias or jnp.attr)."""
+    name = None
+    if isinstance(node, ast.Name):
+        name = aliases.get(node.id, node.id)
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    if name in _DTYPE_BYTES:
+        return _DTYPE_BYTES[name]
+    return -1  # unknown
+
+
+def _lambda_inner_varying(lam: ast.AST) -> bool:
+    """Does a BlockSpec index_map read its LAST (innermost-grid) param?"""
+    if not isinstance(lam, ast.Lambda) or not lam.args.args:
+        return False
+    inner = lam.args.args[-1].arg
+    return any(
+        isinstance(n, ast.Name) and n.id == inner
+        for n in ast.walk(lam.body)
+    )
+
+
+def _collect_spec_exprs(node: ast.AST) -> List[ast.Call]:
+    """Spec-instance Call nodes of an in/out_specs expression: lists and
+    tuples contribute their elements, ``+`` both sides, ternaries BOTH
+    branches (worst case — the optional risk row counts)."""
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out: List[ast.Call] = []
+        for e in node.elts:
+            out.extend(_collect_spec_exprs(e))
+        return out
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _collect_spec_exprs(node.left) + _collect_spec_exprs(
+            node.right
+        )
+    if isinstance(node, ast.IfExp):
+        return _collect_spec_exprs(node.body) + _collect_spec_exprs(
+            node.orelse
+        )
+    if isinstance(node, ast.Call):
+        return [node]
+    return []
+
+
+class _KernelModel:
+    """The statically-extracted model of one pallas_call's tile set."""
+
+    def __init__(self):
+        self.blocks: List[_Block] = []
+        self.problems: List[Tuple[int, str]] = []  # (lineno, message)
+
+
+def _resolve_helper(call: ast.Call, helpers: Dict[str, ast.Lambda]):
+    """Expand ``smem_chunk(4)`` / ``whole((1, Hp))`` through its local
+    lambda to the underlying BlockSpec call plus a substitution env."""
+    name = call.func.id if isinstance(call.func, ast.Name) else None
+    lam = helpers.get(name)
+    if lam is None:
+        return None, None
+    subst: Dict[str, ast.AST] = {}
+    for param, arg in zip(lam.args.args, call.args):
+        subst[param.arg] = arg
+    body = lam.body
+    if isinstance(body, ast.Call):
+        return body, subst
+    return None, None
+
+
+def _shape_elts(node: ast.AST, subst: Dict[str, ast.AST]) -> Optional[
+    Tuple[ast.AST, ...]
+]:
+    if isinstance(node, ast.Name) and node.id in subst:
+        node = subst[node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            subst.get(e.id, e) if isinstance(e, ast.Name) else e
+            for e in node.elts
+        )
+    return None
+
+
+def _classify_blockspec(
+    call: ast.Call, subst: Dict[str, ast.AST], model: _KernelModel
+) -> None:
+    shape = _shape_elts(call.args[0], subst) if call.args else None
+    index_map = call.args[1] if len(call.args) > 1 else None
+    space = "vmem"
+    for kw in call.keywords:
+        if kw.arg == "index_map":
+            index_map = kw.value
+        elif kw.arg == "memory_space":
+            if isinstance(kw.value, ast.Attribute):
+                space = kw.value.attr.lower()
+    if shape is None:
+        model.problems.append((
+            call.lineno,
+            "BlockSpec with an unresolvable block shape — the budget "
+            "pass cannot account for it; use a literal shape tuple",
+        ))
+        return
+    model.blocks.append(_Block(
+        shape, 4, _lambda_inner_varying(index_map), space, call.lineno
+    ))
+
+
+def extract_models(src: SourceFile) -> Tuple[
+    List[Tuple[ast.FunctionDef, _KernelModel]], Dict[str, float]
+]:
+    """(function, tile model) per pallas_call, plus module constants."""
+    consts: Dict[str, float] = {}
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.targets[0], ast.Name
+        ):
+            try:
+                consts[node.targets[0].id] = _safe_eval(node.value, {})
+            except (ValueError, KeyError):
+                pass
+    models: List[Tuple[ast.FunctionDef, _KernelModel]] = []
+    for fn in src.tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        helpers: Dict[str, ast.Lambda] = {}
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.targets[0], ast.Name
+            ):
+                if isinstance(node.value, ast.Lambda):
+                    helpers[node.targets[0].id] = node.value
+                elif isinstance(node.value, ast.Attribute):
+                    aliases[node.targets[0].id] = node.value.attr
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "pallas_call"
+            ):
+                continue
+            model = _KernelModel()
+            for kw in node.keywords:
+                if kw.arg in ("in_specs", "out_specs"):
+                    for spec in _collect_spec_exprs(kw.value):
+                        f = spec.func
+                        if isinstance(f, ast.Attribute) and (
+                            f.attr == "BlockSpec"
+                        ):
+                            _classify_blockspec(spec, {}, model)
+                        elif isinstance(f, ast.Name):
+                            body, subst = _resolve_helper(spec, helpers)
+                            if body is not None:
+                                _classify_blockspec(body, subst, model)
+                            else:
+                                model.problems.append((
+                                    spec.lineno,
+                                    f"unresolvable spec helper "
+                                    f"{f.id}(...) — the budget pass "
+                                    "cannot account for this block",
+                                ))
+                elif kw.arg == "scratch_shapes":
+                    for spec in _collect_spec_exprs(kw.value):
+                        f = spec.func
+                        if isinstance(f, ast.Attribute) and f.attr in (
+                            "VMEM", "SMEM"
+                        ):
+                            shape = _shape_elts(spec.args[0], {})
+                            nbytes = _dtype_bytes_of(
+                                spec.args[1] if len(spec.args) > 1
+                                else None,
+                                aliases,
+                            )
+                            if shape is None or nbytes < 0:
+                                model.problems.append((
+                                    spec.lineno,
+                                    "scratch shape/dtype the budget "
+                                    "pass cannot evaluate",
+                                ))
+                            else:
+                                model.blocks.append(_Block(
+                                    shape, nbytes, False,
+                                    f.attr.lower(), spec.lineno,
+                                ))
+            models.append((fn, model))
+    return models, consts
+
+
+def _footprint(
+    model: _KernelModel, env: Dict[str, float]
+) -> Tuple[float, float, float, List[str]]:
+    """(replica-scaled bytes per replica, streamed fixed bytes,
+    invariant fixed bytes, unevaluable-shape problems) under the
+    validated accounting convention.  A shape the evaluator cannot
+    price (a renamed size variable, a new free name) is reported as a
+    problem string, never a crash — the pass must degrade to findings."""
+    rb = env["RB"]
+    per_replica = 0.0
+    streamed = 0.0
+    invariant = 0.0
+    problems: List[str] = []
+    for blk in model.blocks:
+        if blk.memory_space != "vmem":
+            continue
+        n = blk.dtype_bytes
+        uses_rb = False
+        try:
+            for e in blk.shape:
+                names = {
+                    x.id for x in ast.walk(e) if isinstance(x, ast.Name)
+                }
+                if "RB" in names:
+                    uses_rb = True
+                n *= _safe_eval(e, env)
+        except (ValueError, KeyError) as exc:
+            problems.append(
+                f"line {blk.lineno}: block shape is not evaluable over "
+                f"the size variables {sorted(env)} ({exc!r}) — rename "
+                "back to the RB/Hp/chunk convention or teach "
+                "pivot_tpu/analysis/pallas_budget.py the new variable"
+            )
+            continue
+        mult = 2.0 if blk.inner_varying else 1.0
+        if uses_rb:
+            per_replica += mult * n / rb
+        elif blk.inner_varying:
+            streamed += mult * n
+        else:
+            invariant += n
+    return per_replica, streamed, invariant, problems
+
+
+def _source_formula(fn: ast.FunctionDef, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name
+            for t in node.targets
+        ):
+            return node.value
+    return None
+
+
+def _chunk_cap(fn: ast.FunctionDef) -> Optional[int]:
+    """The literal cap of ``chunk = min(<cap>, ...)``."""
+    expr = _source_formula(fn, "chunk")
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and expr.func.id == "min"
+        and expr.args
+        and isinstance(expr.args[0], ast.Constant)
+    ):
+        return int(expr.args[0].value)
+    return None
+
+
+def _roofline_consts(cache) -> Tuple[Dict[str, int], List[Finding]]:
+    out: Dict[str, int] = {}
+    findings: List[Finding] = []
+    src = cache.get(_ROOFLINE_FILE)
+    if src is None:
+        findings.append(Finding(
+            RULE, _ROOFLINE_FILE, 0,
+            "infra/roofline.py is missing — the v5e VMEM budget "
+            "constants have no home; the pallas-budget pass cannot run",
+        ))
+        return out, findings
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+            node.targets[0], ast.Name
+        ) and node.targets[0].id in _BUDGET_CONSTS:
+            try:
+                out[node.targets[0].id] = int(
+                    _safe_eval(node.value, {})
+                )
+            except (ValueError, KeyError):
+                findings.append(Finding(
+                    RULE, _ROOFLINE_FILE, node.lineno,
+                    f"budget constant {node.targets[0].id} is not a "
+                    "literal integer expression — the static pass "
+                    "cannot evaluate it",
+                ))
+    for name in _BUDGET_CONSTS:
+        if name not in out and not findings:
+            findings.append(Finding(
+                RULE, _ROOFLINE_FILE, 0,
+                f"v5e budget constant {name} not found in "
+                "infra/roofline.py — the pallas-budget pass has no "
+                "reference to check against",
+            ))
+    return out, findings
+
+
+def collect(cache) -> Tuple[List[Finding], List[str]]:
+    out: List[Finding] = []
+    scanned: List[str] = []
+    consts, const_findings = _roofline_consts(cache)
+    out.extend(const_findings)
+    if cache.get(_ROOFLINE_FILE) is not None:
+        scanned.append(_ROOFLINE_FILE)
+    src = cache.get(_PALLAS_FILE)
+    if src is None:
+        out.append(Finding(
+            RULE, _PALLAS_FILE, 0,
+            "ops/pallas_kernels.py is missing — renamed? update "
+            "pivot_tpu/analysis/pallas_budget.py",
+        ))
+        return out, scanned
+    scanned.append(_PALLAS_FILE)
+
+    # Constant hygiene: budget literals must come from roofline.
+    imports_budget = any(
+        isinstance(node, ast.ImportFrom)
+        and node.module == "pivot_tpu.infra.roofline"
+        and {a.name for a in node.names} & set(_BUDGET_CONSTS)
+        for node in src.tree.body
+    )
+    if not imports_budget:
+        out.append(Finding(
+            RULE, _PALLAS_FILE, 1,
+            "pallas kernels do not import the v5e budget constants from "
+            "infra/roofline.py — a re-hardcoded byte budget drifts from "
+            "the checked one",
+        ))
+
+    if not all(c in consts for c in _BUDGET_CONSTS):
+        return out, scanned
+    scoped = consts["V5E_SCOPED_VMEM_BYTES"]
+    budget = consts["PALLAS_VMEM_BUDGET_BYTES"]
+    proven_hp = consts["PALLAS_PROVEN_HP"]
+    if budget >= scoped:
+        out.append(Finding(
+            RULE, _ROOFLINE_FILE, 0,
+            f"PALLAS_VMEM_BUDGET_BYTES ({budget}) must leave headroom "
+            f"under V5E_SCOPED_VMEM_BYTES ({scoped}) for Mosaic's own "
+            "buffers",
+        ))
+
+    models, module_consts = extract_models(src)
+    rb_cap = int(module_consts.get("_MAX_BLOCK_REPLICAS", 512))
+    checked_any = False
+    for fn, model in models:
+        if not model.blocks:
+            continue
+        checked_any = True
+        for lineno, message in model.problems:
+            out.append(Finding(RULE, _PALLAS_FILE, lineno, message))
+        chunk_cap = _chunk_cap(fn) or 256
+        rb_expr = _source_formula(fn, "rb_bytes")
+        tile_expr = _source_formula(fn, "tile_bytes")
+        if rb_expr is None or tile_expr is None:
+            out.append(Finding(
+                RULE, _PALLAS_FILE, fn.lineno,
+                f"{fn.name}: rb_bytes/tile_bytes byte formulas not "
+                "found — the auto-sizer has nothing to size against "
+                "and the drift check nothing to check",
+            ))
+            continue
+        hp_probes = sorted({128, 256, proven_hp})
+        for hp in hp_probes:
+            for rb in _RB_PROBES:
+                env = {"Hp": float(hp), "chunk": float(chunk_cap),
+                       "RB": float(rb), **module_consts}
+                per_replica, streamed, invariant, shape_problems = (
+                    _footprint(model, env)
+                )
+                if shape_problems:
+                    for msg in shape_problems:
+                        out.append(Finding(
+                            RULE, _PALLAS_FILE, fn.lineno,
+                            f"{fn.name}: {msg}",
+                        ))
+                    break
+                try:
+                    src_rb = _safe_eval(rb_expr, env)
+                    src_tile = _safe_eval(tile_expr, env)
+                except (ValueError, KeyError) as exc:
+                    out.append(Finding(
+                        RULE, _PALLAS_FILE, rb_expr.lineno,
+                        f"{fn.name}: byte formula is not statically "
+                        f"evaluable ({exc}) — keep it arithmetic over "
+                        "the size variables",
+                    ))
+                    break
+                if abs(src_rb - per_replica) > 0.5:
+                    out.append(Finding(
+                        RULE, _PALLAS_FILE, rb_expr.lineno,
+                        f"{fn.name}: rb_bytes drifted from the BlockSpec "
+                        f"tile set at (Hp={hp}, chunk={chunk_cap}, "
+                        f"RB={rb}): formula says {src_rb:.0f} B/replica, "
+                        f"the specs say {per_replica:.0f} — update the "
+                        "formula (or the specs) so the auto-sizer sizes "
+                        "against reality",
+                    ))
+                    break
+                if abs(src_tile - streamed) > 0.5:
+                    out.append(Finding(
+                        RULE, _PALLAS_FILE, tile_expr.lineno,
+                        f"{fn.name}: tile_bytes drifted from the "
+                        f"streamed-tile specs at (Hp={hp}, "
+                        f"chunk={chunk_cap}): formula {src_tile:.0f} B "
+                        f"vs specs {streamed:.0f} B",
+                    ))
+                    break
+            else:
+                continue
+            break
+        # Budget checks at the proven envelope (worst in-envelope shape).
+        env = {"Hp": float(proven_hp), "chunk": float(chunk_cap),
+               "RB": 8.0, **module_consts}
+        per_replica, streamed, invariant, shape_problems = _footprint(
+            model, env
+        )
+        if shape_problems or per_replica <= 0:
+            # Unevaluable (already reported above) or no replica-scaled
+            # blocks at all — the auto-sizer math below has no meaning.
+            if per_replica <= 0 and not shape_problems:
+                out.append(Finding(
+                    RULE, _PALLAS_FILE, fn.lineno,
+                    f"{fn.name}: no replica-scaled (RB-shaped) VMEM "
+                    "block found — the replica auto-sizer has nothing "
+                    "to size; update the budget pass's convention if "
+                    "the block layout changed",
+                ))
+            continue
+        floor_total = 8 * per_replica + streamed + invariant
+        if floor_total > scoped:
+            out.append(Finding(
+                RULE, _PALLAS_FILE, fn.lineno,
+                f"{fn.name}: even the minimum one-sublane block needs "
+                f"{floor_total / 1e6:.1f} MB of scoped VMEM at "
+                f"Hp={proven_hp} (limit {scoped / 1e6:.1f} MB) — a "
+                "guaranteed Mosaic compile failure with no fallback",
+            ))
+        auto_rb = max(
+            8,
+            min(rb_cap,
+                int(max(budget - streamed, per_replica * 8)
+                    // per_replica) // 8 * 8),
+        )
+        auto_total = auto_rb * per_replica + streamed + invariant
+        if auto_total > scoped:
+            out.append(Finding(
+                RULE, _PALLAS_FILE, fn.lineno,
+                f"{fn.name}: the auto-sized block (RB={auto_rb}) needs "
+                f"{auto_total / 1e6:.1f} MB at Hp={proven_hp} — over "
+                f"the {scoped / 1e6:.1f} MB scoped-VMEM limit; shrink "
+                "the budget constant or the tile set",
+            ))
+    if not checked_any:
+        out.append(Finding(
+            RULE, _PALLAS_FILE, 1,
+            "no pallas_call tile set found — the Pallas kernels moved? "
+            "update pivot_tpu/analysis/pallas_budget.py",
+        ))
+    return out, scanned

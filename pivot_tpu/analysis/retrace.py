@@ -1,0 +1,245 @@
+"""Retrace lint: recompilation hazards in the jitted entry points.
+
+A jitted program is compiled once per (shapes, dtypes, static values)
+key; everything that silently widens that key — or blocks the trace on
+a device value — passes every CPU test and only surfaces as wall-clock
+collapse on hardware (ROADMAP item 1's recapture is exactly where this
+bites).  The steady-state hypothesis this pass protects is the one the
+tier-1 compile-counter harness (``tests/test_jitcheck.py``) asserts at
+runtime: **zero recompiles after warmup** on the fused-span and serve
+dispatch paths.  Every rule below is the static shadow of a way that
+hypothesis dies:
+
+  * **traced-value branching** — an ``if``/``while``/ternary testing a
+    non-static parameter of a jitted function raises a tracer-bool
+    error at best and, when the value happens to be concrete (weak
+    scalars, shapes smuggled as values), forks one compile cache entry
+    per value at worst.  ``x is None`` / ``x is not None`` tests are the
+    sanctioned trace-structure dispatch (an operand that is absent vs
+    present IS a static program distinction) and stay allowed.
+  * **host coercion of traced values** — ``float()``/``int()``/
+    ``bool()`` over an expression containing a traced parameter,
+    ``.item()``/``.tolist()`` on one, ``np.asarray``/``np.array`` of
+    one, and ``jax.device_get`` force a device→host sync per call
+    (the host-sync pass covers the hot *bodies*; this covers every
+    jitted entry point, including the ensemble and batcher wrappers).
+  * **stale static declarations** — a ``static_argnames`` entry naming
+    no parameter of the wrapped function: after a parameter rename the
+    knob silently becomes *traced*, and every distinct value retraces
+    the program.  ``static_argnums`` out of positional range is the
+    same rot.
+  * **unhashable static defaults** — a static parameter defaulting to a
+    list/dict/set literal fails hashing at the first call that relies
+    on the default.
+  * **closure-captured numpy constants** — a module-level
+    ``np.array(...)``-family constant referenced inside a jitted body
+    constant-folds into the HLO: the array is baked into the program
+    (bloating it and re-baking on every content change) instead of
+    riding the argument path as a device operand.
+  * **Python loops over traced extents** — ``for ... in range(x)`` with
+    ``x`` traced unrolls (or errors); bounded device loops belong in
+    ``lax.fori_loop``/``lax.while_loop``.
+
+Scope: the jitted entry points discovered by
+:mod:`pivot_tpu.analysis.jitmap` (plus its registry findings — a new
+file growing a jit wrapper must register there).  Only the wrapped
+function's own body (nested defs/lambdas included) is scanned; helpers
+it calls are covered when they are themselves registered hot bodies
+(host-sync pass) or entry points.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from pivot_tpu.analysis import Finding, SourceFile
+from pivot_tpu.analysis import jitmap
+
+RULE = "retrace"
+
+_NUMPY_ALIASES = {"np", "numpy", "onp"}
+_NUMPY_CTORS = {
+    "array", "asarray", "zeros", "ones", "full", "arange", "linspace",
+    "eye", "empty",
+}
+_COERCIONS = {"float", "int", "bool"}
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _is_none_guard(test: ast.AST, traced: Set[str]) -> bool:
+    """True when every traced-parameter reference in ``test`` sits
+    inside an ``is None`` / ``is not None`` comparison (possibly under
+    boolean operators) — the sanctioned operand-presence dispatch."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_none_guard(v, traced) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_none_guard(test.operand, traced)
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return True
+    return not (_names_in(test) & traced)
+
+
+def _module_np_constants(src: SourceFile) -> Dict[str, int]:
+    """Module-level names bound to numpy-constructor calls."""
+    out: Dict[str, int] = {}
+    for node in src.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Attribute)
+            and isinstance(v.func.value, ast.Name)
+            and v.func.value.id in _NUMPY_ALIASES
+            and v.func.attr in _NUMPY_CTORS
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.lineno
+    return out
+
+
+def check_site(
+    site: jitmap.JitSite,
+    np_constants: Dict[str, int],
+) -> List[Finding]:
+    out: List[Finding] = []
+    for stale in site.stale_statics:
+        out.append(Finding(
+            RULE, site.path, site.lineno,
+            f"static declaration {stale!r} of {site.name} matches no "
+            "parameter of the wrapped function — after a rename the knob "
+            "silently becomes TRACED and every distinct value recompiles "
+            "the program; update the static declaration",
+        ))
+    fn = site.fn
+    if fn is None:
+        return out
+    pos = jitmap.positional_params(fn)
+    statics = set(site.static_names)
+    traced = {p for p in jitmap.all_params(fn) if p not in statics}
+
+    # Unhashable static defaults.
+    args = fn.args
+    named = (*args.posonlyargs, *args.args)
+    defaults = args.defaults
+    for param, default in zip(named[len(named) - len(defaults):], defaults):
+        if param.arg in statics and isinstance(
+            default, (ast.List, ast.Dict, ast.Set)
+        ):
+            out.append(Finding(
+                RULE, site.path, default.lineno,
+                f"static parameter {param.arg!r} of {site.name} defaults "
+                "to an unhashable literal — the first call relying on the "
+                "default fails the static-argument hash",
+            ))
+
+    body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+    # Names the function shadows — its own parameters (nested defs'
+    # included) and everything it assigns: a module-level numpy
+    # constant hidden behind a same-named local never constant-folds.
+    shadowed: Set[str] = set(jitmap.all_params(fn))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            shadowed.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            shadowed.update(jitmap.all_params(node))
+    for stmt in body:
+        for node in ast.walk(stmt):
+            test = None
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                test = node.test
+            if test is not None:
+                hits = _names_in(test) & traced
+                if hits and not _is_none_guard(test, traced):
+                    out.append(Finding(
+                        RULE, site.path, node.lineno,
+                        "Python-level branch on traced parameter(s) "
+                        f"{sorted(hits)} inside jitted {site.name} — "
+                        "declare the knob static, dispatch on `is None`, "
+                        "or move the branch into lax.cond/lax.select",
+                    ))
+            if isinstance(node, ast.For):
+                if (
+                    isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and _names_in(node.iter) & traced
+                ):
+                    out.append(Finding(
+                        RULE, site.path, node.lineno,
+                        "Python for-loop over a traced extent inside "
+                        f"jitted {site.name} — unrolls per value; use "
+                        "lax.fori_loop / lax.while_loop",
+                    ))
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in _COERCIONS:
+                    hits = set().union(
+                        *(_names_in(a) for a in node.args), set()
+                    ) & traced
+                    if hits:
+                        out.append(Finding(
+                            RULE, site.path, node.lineno,
+                            f"host coercion {f.id}(...) of traced "
+                            f"parameter(s) {sorted(hits)} inside jitted "
+                            f"{site.name} — blocks on the device value "
+                            "every call",
+                        ))
+                elif isinstance(f, ast.Attribute):
+                    if f.attr in _SYNC_ATTRS and _names_in(f.value) & traced:
+                        out.append(Finding(
+                            RULE, site.path, node.lineno,
+                            f"host sync .{f.attr}() on a traced parameter "
+                            f"inside jitted {site.name}",
+                        ))
+                    elif (
+                        isinstance(f.value, ast.Name)
+                        and (
+                            (f.value.id in _NUMPY_ALIASES
+                             and f.attr in {"asarray", "array"})
+                            or (f.value.id == "jax"
+                                and f.attr == "device_get")
+                        )
+                        and node.args
+                        and _names_in(node.args[0]) & traced
+                    ):
+                        out.append(Finding(
+                            RULE, site.path, node.lineno,
+                            f"host materialization {f.value.id}.{f.attr}"
+                            f"(...) of a traced parameter inside jitted "
+                            f"{site.name}",
+                        ))
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Load
+            ) and node.id in np_constants and node.id not in shadowed:
+                out.append(Finding(
+                    RULE, site.path, node.lineno,
+                    f"jitted {site.name} closes over module-level numpy "
+                    f"constant {node.id!r} (defined line "
+                    f"{np_constants[node.id]}) — it constant-folds into "
+                    "the HLO; pass it as an operand instead",
+                ))
+    return out
+
+
+def collect(cache) -> Tuple[List[Finding], List[str]]:
+    sites, findings, scanned = jitmap.collect_sites(cache)
+    out = list(findings)
+    for rel in sorted(sites):
+        src = cache.get(rel)
+        np_constants = _module_np_constants(src)
+        for site in sites[rel]:
+            out.extend(check_site(site, np_constants))
+    return out, scanned

@@ -37,7 +37,10 @@ from typing import Dict, Optional
 import numpy as np
 
 __all__ = [
+    "PALLAS_PROVEN_HP",
+    "PALLAS_VMEM_BUDGET_BYTES",
     "TPU_PEAKS",
+    "V5E_SCOPED_VMEM_BYTES",
     "annotate",
     "backend_peaks",
     "cpu_peaks",
@@ -45,6 +48,33 @@ __all__ = [
     "placement_cost",
     "serial_model",
 ]
+
+# -- v5e VMEM budget constants (single source of truth) ----------------------
+#
+# The Pallas kernels (``ops/pallas_kernels.py``) size their replica
+# blocks against these, and the ``pallas-budget`` static pass
+# (``pivot_tpu/analysis/pallas_budget.py``) recomputes every kernel's
+# VMEM footprint from its BlockSpec shapes and fails the build when a
+# tile change outgrows them — so the numbers live HERE, once, not in a
+# kernel comment that can drift.
+
+#: Scoped-VMEM capacity one Pallas program may allocate on a v5e core
+#: (Mosaic's scoped-allocation limit; exceeding it is a hardware-proven
+#: compile failure — RB=1024 at Hp=512, RESULTS.md round 3).
+V5E_SCOPED_VMEM_BYTES = int(16e6)
+
+#: Working-set budget the replica-block auto-sizer targets — deliberate
+#: headroom under :data:`V5E_SCOPED_VMEM_BYTES` for Mosaic's own
+#: pipeline buffers and the semaphore/metadata overhead the block
+#: accounting cannot see.
+PALLAS_VMEM_BUDGET_BYTES = int(12e6)
+
+#: Hardware-proven host-lane envelope of the replica-batched greedy
+#: kernel (every RB sweep in RESULTS.md ran at Hp ≤ 512).  The static
+#: budget pass verifies the footprint inside this envelope; larger host
+#: counts rely on the runtime auto-sizer shrinking RB and are outside
+#: the verified envelope.
+PALLAS_PROVEN_HP = 512
 
 #: Known-chip peak table.  v5e figures from the public spec: 197 TFLOP/s
 #: bf16 on the MXUs and 819 GB/s of HBM bandwidth per chip.  The f32

@@ -53,6 +53,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from pivot_tpu.infra.roofline import (
+    PALLAS_VMEM_BUDGET_BYTES,
+    V5E_SCOPED_VMEM_BYTES,
+)
+
 __all__ = ["cost_aware_pallas", "cost_aware_pallas_batched"]
 
 _BIG = 1e30
@@ -318,14 +323,19 @@ def cost_aware_pallas_batched(
     chunk = min(256, _round_up(T, 8))
     # Per-replica VMEM bytes of the block's working set: two [4·RB, Hp]
     # avail blocks + two [RB, Hp] scratches (40·Hp) and the [RB, chunk]
-    # placement block (8·chunk, both copies); budget ~12 MB of the 16 MB
-    # scoped-VMEM limit.  The phase-1 score tiles are replica-independent
-    # fixed overhead: two [chunk, Hp] streamed inputs, double-buffered by
-    # the pipeline (16·chunk·Hp bytes), subtracted from the budget before
-    # the replica split.
+    # placement block (8·chunk, both copies); budgeted against
+    # ``infra.roofline.PALLAS_VMEM_BUDGET_BYTES`` (deliberate headroom
+    # under the ``V5E_SCOPED_VMEM_BYTES`` Mosaic limit).  The phase-1
+    # score tiles are replica-independent fixed overhead: two
+    # [chunk, Hp] streamed inputs, double-buffered by the pipeline
+    # (16·chunk·Hp bytes), subtracted from the budget before the
+    # replica split.  The byte formulas here are recomputed from the
+    # BlockSpec shapes by the ``pallas-budget`` static pass — editing
+    # the specs without these formulas fails ``make lint``.
     rb_bytes = 40 * Hp + 8 * chunk
     tile_bytes = 16 * chunk * Hp
-    vmem_budget = max(int(12e6 - tile_bytes), rb_bytes * 8)
+    assert PALLAS_VMEM_BUDGET_BYTES < V5E_SCOPED_VMEM_BYTES
+    vmem_budget = max(PALLAS_VMEM_BUDGET_BYTES - tile_bytes, rb_bytes * 8)
     if block_replicas is None:
         # VMEM budget first: cap RB so the working set stays within
         # budget at ANY host count (the fixed 512 cap is only proven at
@@ -358,10 +368,10 @@ def cost_aware_pallas_batched(
             raise ValueError(
                 f"block_replicas={block_replicas} needs "
                 f"~{block_replicas * rb_bytes / 1e6:.1f} MB of scoped VMEM at "
-                f"Hp={Hp} (budget {vmem_budget / 1e6:.1f} MB of the 16 MB "
-                "limit after the phase-1 score tiles) and would fail Mosaic "
-                "compilation; pass block_replicas=None for the largest "
-                "known-good block"
+                f"Hp={Hp} (budget {vmem_budget / 1e6:.1f} MB of the "
+                f"{V5E_SCOPED_VMEM_BYTES / 1e6:.0f} MB limit after the "
+                "phase-1 score tiles) and would fail Mosaic compilation; "
+                "pass block_replicas=None for the largest known-good block"
             )
     RB = block_replicas
     Tp = _round_up(T, chunk)

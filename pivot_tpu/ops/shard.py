@@ -1196,6 +1196,13 @@ def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
             avail=_HOST_MAT,
         ),
         check_rep=False,
+        # DELIBERATELY NOT donated — the sharded twin of the tickloop
+        # span carry's negative manifest entry (pivot_tpu/analysis/
+        # donation.py): span operands are staged from host numpy at the
+        # call boundary, and CPU-backend ``jnp.asarray`` is zero-copy
+        # for large aligned arrays, so a donated carry would scribble
+        # on caller-owned memory.  The donation pass enforces the
+        # decision both ways.
     ))
 
 

@@ -381,6 +381,16 @@ _fused_tick_run = jax.jit(
         "host_decay",
         "phase2",
     ),
+    # DELIBERATELY NOT donated (a negative entry in the analysis
+    # donation manifest, ``pivot_tpu/analysis/donation.py``): the span
+    # operands are staged straight from host numpy at the call boundary
+    # (``place_span``/tests/bench), and on the CPU backend
+    # ``jnp.asarray(host_array)`` is ZERO-COPY for large aligned arrays
+    # — a donated carry would let XLA reuse memory the caller still
+    # owns (measured: silent corruption of the DES availability
+    # snapshot the sequential referee reads).  The donation pass
+    # enforces this decision in BOTH directions: adding donate_argnums
+    # here is a finding until the manifest entry flips.
 )
 
 

@@ -432,7 +432,12 @@ class _DevicePolicyBase(Policy):
         if self.risk_weight:
             hz = ctx.host_zones
             w = self.risk_weight * self.rework_cost
-            rows = np.zeros((K, len(hz)), dtype=np.float64)
+            # Built in the POLICY dtype at source (dtype pass,
+            # pivot_tpu/analysis/dtype.py): the f64 hazard products round
+            # once on assignment — bit-identical to the old
+            # cast-at-staging — and an x64 run can no longer stage a
+            # double-width [K, H] buffer / fork the compile cache.
+            rows = np.zeros((K, len(hz)), dtype=np.dtype(self.dtype))
             # One vectorized [k_dyn] segment lookup + [k_dyn, H] zone
             # gather — the same per-span time-index pattern as cost_seg —
             # instead of k_dyn Python-level hazard_vector calls.
@@ -563,11 +568,14 @@ class _DevicePolicyBase(Policy):
         return _SpanOutcome(np.asarray(res.placements))
 
     def _span_norms(self, dem_host: np.ndarray, B: int):
-        """Host-side f64 demand norms padded to the slot bucket — the
-        exact ``_sort_decreasing`` keys, staged for the driver's
-        device-side ordering so a device-recomputed norm can never round
-        a tie differently than the CPU twin's sort."""
-        norms = np.zeros(B, dtype=np.float64)
+        """Host-computed demand norms padded to the slot bucket — the
+        ``_sort_decreasing`` keys computed in f64 and rounded ONCE into
+        the policy dtype at source (dtype pass: an implicit f64 staging
+        buffer would fork the compile cache under x64).  Staging the
+        host-computed keys — rather than recomputing norms device-side —
+        is what keeps a device sqrt from rounding a tie differently than
+        the CPU twin's sort."""
+        norms = np.zeros(B, dtype=np.dtype(self.dtype))
         norms[: dem_host.shape[0]] = np.sqrt(
             np.sum(dem_host * dem_host, axis=1)
         )
@@ -741,7 +749,9 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
         # first T_k of the same counter stream), so span service leaves
         # the stream aligned for any fallback tick.
         seed = ctx.scheduler.seed or 0
-        u = np.zeros((K, B), dtype=np.float64)
+        # Policy dtype at source (dtype pass): the f64 Philox draws round
+        # once on assignment, exactly like the old cast-at-staging.
+        u = np.zeros((K, B), dtype=np.dtype(self.dtype))
         for k in range(plan.n_ticks):
             u[k] = tick_uniforms(seed, ctx.tick_seq + k, B)
         return dict(policy="opportunistic", uniforms=self._stage(u, self.dtype),
@@ -750,7 +760,7 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
     def _device_place(self, ctx: TickContext) -> np.ndarray:
         T = ctx.n_tasks
         avail, dem, valid = self._padded(ctx)
-        u = np.zeros(valid.shape[0], dtype=np.float64)
+        u = np.zeros(valid.shape[0], dtype=np.dtype(self.dtype))
         u[:T] = tick_uniforms(ctx.scheduler.seed or 0, ctx.tick_seq, T)
         placements, _ = self._kernel_for(
             opportunistic_kernel, opportunistic_kernel_sharded
@@ -1182,7 +1192,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             # small bucket so XLA compiles one program per (G-bucket, B,
             # H) shape, not per group count.
             G = pad_bucket(max(len(group_rows), 1))
-            rows = np.ones((G, ctx.n_hosts), dtype=np.float64)
+            rows = np.ones((G, ctx.n_hosts), dtype=np.dtype(self.dtype))
             if group_rows:
                 rows[: len(group_rows)] = np.stack(group_rows)
             idx = np.zeros(az_arr.shape[0], dtype=np.int32)

@@ -1,0 +1,85 @@
+"""Compile-counter hook: observe recompiles instead of assuming none.
+
+The retrace pass (``pivot_tpu/analysis/retrace.py``) bans the *static*
+shapes of recompilation hazards; this module supplies the falsifying
+runtime observable — chaos-engineering style, the steady-state
+hypothesis "zero recompiles after warmup" is *measured*, not assumed.
+
+Implementation: ``jax.monitoring`` duration events.  Every XLA backend
+compile fires ``/jax/core/compile/backend_compile_duration`` and every
+fresh trace fires ``/jax/core/compile/jaxpr_trace_duration``; a cache
+hit (the steady state) fires neither.  JAX offers listener registration
+but no deregistration, so ONE process-wide listener is installed
+lazily and fans out to the currently-active counters.
+
+Usage::
+
+    with count_compiles() as counter:
+        serve_many_ticks()
+    assert counter.compiles == 0 and counter.traces == 0
+
+Tracking both numbers matters: a persistent-compilation-cache hit
+skips the backend compile but still pays the trace — and per-call
+tracing is exactly the dispatch-floor regression the fused paths
+exist to avoid.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, List
+
+__all__ = ["CompileCounter", "count_compiles"]
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+_lock = threading.Lock()
+_active: List["CompileCounter"] = []
+_installed = False
+
+
+class CompileCounter:
+    """Counts of XLA backend compiles and jaxpr traces in a window."""
+
+    def __init__(self) -> None:
+        self.compiles = 0
+        self.traces = 0
+
+    def _record(self, event: str) -> None:
+        if event == _COMPILE_EVENT:
+            self.compiles += 1
+        elif event == _TRACE_EVENT:
+            self.traces += 1
+
+
+def _install_listener() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax
+
+        def _on_event(event: str, duration_secs: float, **kw) -> None:
+            with _lock:
+                for counter in _active:
+                    counter._record(event)
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _installed = True
+
+
+@contextlib.contextmanager
+def count_compiles() -> Iterator[CompileCounter]:
+    """Count XLA compiles/traces while the block runs.  Nestable; each
+    context gets its own counter."""
+    _install_listener()
+    counter = CompileCounter()
+    with _lock:
+        _active.append(counter)
+    try:
+        yield counter
+    finally:
+        with _lock:
+            _active.remove(counter)

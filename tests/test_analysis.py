@@ -11,13 +11,16 @@ The clean-tree gate itself (all four passes green on HEAD) is tier-1
 wired in ``tests/test_meta.py::test_graftcheck_clean``.
 """
 
+import json
 import os
 import re
 import shutil
 import textwrap
 
-from pivot_tpu.analysis import SourceFile, repo_root, run
-from pivot_tpu.analysis import parity, threadguard
+import pytest
+
+from pivot_tpu.analysis import SourceFile, main, repo_root, run
+from pivot_tpu.analysis import jitmap, parity, threadguard
 
 PARITY_FILES = (
     "pivot_tpu/ops/kernels.py",
@@ -25,6 +28,14 @@ PARITY_FILES = (
     "pivot_tpu/ops/shard.py",
     "pivot_tpu/ops/tickloop.py",
     "pivot_tpu/sched/tpu.py",
+)
+
+#: The jitcheck passes scan every registered jit file plus the roofline
+#: constants — a seeded tree carries them all so registry findings
+#: (missing-file protection, separately tested) don't mask the seeded
+#: violation.
+JITCHECK_FILES = tuple(jitmap.JIT_FILES) + (
+    "pivot_tpu/infra/roofline.py",
 )
 
 
@@ -551,3 +562,261 @@ def test_hotpath_shim_honors_framework_suppressions(tmp_path):
     )
     assert len(filtered) == 1, filtered
     assert "item" in filtered[0].message
+
+
+# ---------------------------------------------------------------------------
+# jitcheck (round 13): one minimal seeded violation per pass.  The
+# parametrized scheme mirrors the acceptance criterion — each new rule
+# must BITE on its violation and stay silent when the rule is the only
+# one disabled (a check that stops matching keeps printing "clean").
+# ---------------------------------------------------------------------------
+
+
+def _seed_traced_branch(root):
+    """retrace: a Python `if` on a traced parameter of a jitted impl."""
+    p = root / "pivot_tpu/ops/kernels.py"
+    text = p.read_text()
+    needle = (
+        'def best_fit_impl(avail, demands, valid, totals=None, '
+        'phase2="auto",\n                  live=None, risk=None):'
+    )
+    assert needle in text
+    p.write_text(text.replace(
+        needle, needle + "\n    if valid:\n        pass"
+    ))
+
+
+def _seed_use_after_donate(root):
+    """donation: read a variable after passing it at a donated slot."""
+    p = root / "pivot_tpu/parallel/ensemble/checkpoint.py"
+    p.write_text(p.read_text() + textwrap.dedent("""\n
+        def _bad_segment_caller(state, rt, arr, ra, workload, topo):
+            out = _segment_step_carry(
+                state, rt, arr, ra, workload, topo, tick=5.0,
+                segment_ticks=8,
+            )
+            return out, state.stage
+    """))
+
+
+def _seed_float64_stage(root):
+    """dtype: a float64-typed staging buffer on the device boundary."""
+    p = root / "pivot_tpu/sched/tpu.py"
+    text = p.read_text()
+    needle = "norms = np.zeros(B, dtype=np.dtype(self.dtype))"
+    assert needle in text
+    p.write_text(text.replace(
+        needle, "norms = np.zeros(B, dtype=np.float64)"
+    ))
+
+
+def _seed_oversized_tile(root):
+    """pallas-budget: grow a scratch tile without touching the byte
+    formulas — the drift check must notice the specs moved."""
+    p = root / "pivot_tpu/ops/pallas_kernels.py"
+    text = p.read_text()
+    needle = "pltpu.VMEM((RB, Hp), f32),  # frozen group scores"
+    assert needle in text
+    p.write_text(text.replace(
+        needle, "pltpu.VMEM((RB, 64 * Hp), f32),  # frozen group scores"
+    ))
+
+
+_JITCHECK_SEEDS = {
+    "retrace": (_seed_traced_branch, "branch on traced parameter"),
+    "donation": (_seed_use_after_donate, "use-after-donate"),
+    "dtype": (_seed_float64_stage, "float64 on a device-boundary"),
+    "pallas-budget": (_seed_oversized_tile, "drifted from the BlockSpec"),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_JITCHECK_SEEDS))
+def test_jitcheck_seeded_violation_bites(tmp_path, rule):
+    seed, fragment = _JITCHECK_SEEDS[rule]
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    seed(tmp_path)
+    findings = run(root=root, rules=[rule])
+    assert any(fragment in f.message for f in findings), (
+        "\n".join(str(f) for f in findings) or "no findings"
+    )
+    # Loud-failure criterion: with the rule disabled (every OTHER pass
+    # enabled), the seeded tree reads clean — the finding belongs to
+    # this rule alone.
+    others = [r for r in _JITCHECK_SEEDS if r != rule]
+    assert not any(
+        fragment in f.message
+        for f in run(root=root, rules=others)
+    )
+    # And the unmutated tree is clean under the rule.
+    clean = _copy_tree(tmp_path / "clean", JITCHECK_FILES)
+    assert run(root=clean, rules=[rule]) == [], rule
+
+
+def test_jitcheck_clean_tree_all_rules(tmp_path):
+    """The four jitcheck passes together on an unmutated copy: clean."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    findings = run(root=root, rules=sorted(_JITCHECK_SEEDS))
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_donation_catches_dropped_donate_argnums(tmp_path):
+    """The positive manifest direction: stripping donate_argnums from
+    the ensemble segment carry's jit wrapper is flagged BY NAME
+    (manifest coverage, not discovery)."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/parallel/ensemble/checkpoint.py"
+    text = p.read_text()
+    mutated = text.replace("    donate_argnums=(0,),\n", "", 1)
+    assert mutated != text
+    p.write_text(mutated)
+    findings = run(root=root, rules=["donation"])
+    assert any(
+        "ensemble-segment-carry" in f.message
+        and "does not donate" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
+def test_donation_catches_forbidden_donation(tmp_path):
+    """The NEGATIVE manifest direction: donating the span availability
+    carry — whose operands are zero-copy-staged from host numpy on the
+    CPU backend — is flagged until the manifest entry flips with a new
+    safety argument."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/ops/tickloop.py"
+    text = p.read_text()
+    needle = '        "phase2",\n    ),'
+    assert needle in text
+    p.write_text(text.replace(
+        needle, needle + "\n    donate_argnums=(0,),", 1
+    ))
+    findings = run(root=root, rules=["donation"])
+    assert any(
+        "span-avail-carry" in f.message
+        and "against the declared decision" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
+def test_retrace_flags_unregistered_jit_file(tmp_path):
+    """jitmap discovery: a NEW file growing a jax.jit wrapper must join
+    JIT_FILES or the sweep flags it (register-or-flag, like parity)."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    (tmp_path / "pivot_tpu/ops/newjit.py").write_text(
+        "import jax\n\n\ndef f(x):\n    return x\n\n\ng = jax.jit(f)\n"
+    )
+    findings = run(root=root, rules=["retrace"])
+    assert any(
+        "newjit.py" in f.message and "JIT_FILES" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
+def test_retrace_flags_stale_static_argnames(tmp_path):
+    """Renaming a parameter out from under static_argnames silently
+    turns the knob traced — flagged at the jit site."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/ops/kernels.py"
+    text = p.read_text()
+    mutated = text.replace(
+        "def best_fit_impl(avail, demands, valid, totals=None, "
+        'phase2="auto",',
+        "def best_fit_impl(avail, demands, valid, totals=None, "
+        'phase2_mode="auto",',
+    )
+    assert mutated != text
+    p.write_text(mutated)
+    findings = run(root=root, rules=["retrace"])
+    assert any(
+        "phase2" in f.message and "matches no parameter" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
+def test_pallas_budget_catches_inverted_headroom(tmp_path):
+    """Raising the working-set budget past the scoped-VMEM limit is a
+    finding — the headroom is the contract, not a suggestion."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/infra/roofline.py"
+    text = p.read_text()
+    mutated = text.replace(
+        "PALLAS_VMEM_BUDGET_BYTES = int(12e6)",
+        "PALLAS_VMEM_BUDGET_BYTES = int(32e6)",
+    )
+    assert mutated != text
+    p.write_text(mutated)
+    findings = run(root=root, rules=["pallas-budget"])
+    assert any("headroom" in f.message for f in findings), (
+        "\n".join(str(f) for f in findings)
+    )
+
+
+def test_new_rule_suppression_round_trip(tmp_path):
+    """Suppression grammar over a jitcheck rule name: a justified
+    ``ignore[dtype]`` silences the seeded f64 finding; a stale one is
+    itself a finding (same contract as the round-12 rules)."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/sched/tpu.py"
+    text = p.read_text()
+    needle = "norms = np.zeros(B, dtype=np.dtype(self.dtype))"
+    p.write_text(text.replace(
+        needle,
+        "norms = np.zeros(B, dtype=np.float64)  "
+        "# graftcheck: ignore[dtype] -- seeded round-trip justification",
+    ))
+    assert run(root=root, rules=["dtype"]) == []
+
+    # Stale: the suppression outlives the violation.
+    p.write_text(text.replace(
+        needle,
+        needle + "  "
+        "# graftcheck: ignore[dtype] -- excuses nothing anymore",
+    ))
+    findings = run(root=root, rules=["dtype"])
+    assert len(findings) == 1 and findings[0].rule == "suppression"
+    assert "stale" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (satellite: --json, --list-rules, unknown-rule errors)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_list_rules_names_all_eight(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in (
+        "backend-parity", "determinism", "thread-guard", "host-sync",
+        "retrace", "donation", "dtype", "pallas-budget",
+    ):
+        assert rule in out, f"{rule} missing from --list-rules"
+
+
+def test_cli_unknown_rule_errors_listing_valid_set(capsys):
+    """Unknown names passed to --rules must ERROR naming the valid rule
+    set — never silently select nothing and print clean."""
+    assert main(["--rules", "no-such-pass"]) == 2
+    err = capsys.readouterr().err
+    assert "unknown rule" in err and "backend-parity" in err
+
+
+def test_cli_json_findings_schema(tmp_path, capsys):
+    """--json emits machine-readable {rule, path, line, message} rows —
+    what the CI lane annotates per file:line."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    _seed_float64_stage(tmp_path)
+    assert main(["--root", root, "--rules", "dtype", "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["clean"] is False
+    assert payload["rules"] == ["dtype"]
+    row = payload["findings"][0]
+    assert row["rule"] == "dtype"
+    assert row["path"] == "pivot_tpu/sched/tpu.py"
+    assert isinstance(row["line"], int) and row["line"] > 0
+    assert "float64" in row["message"]
+
+    # Clean tree: exit 0, clean=true, empty findings.
+    clean = _copy_tree(tmp_path / "clean", JITCHECK_FILES)
+    assert main(["--root", clean, "--rules", "dtype", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["clean"] is True and payload["findings"] == []

@@ -1,0 +1,197 @@
+"""jitcheck runtime observables (round 13).
+
+The retrace pass bans the static shapes of recompilation hazards;
+these tests supply the falsifying runtime twin — the steady-state
+hypothesis **zero recompiles after warmup** (Basiri et al.'s chaos
+framing: state the hypothesis, then measure it) on the two dispatch
+paths where a silent retrace costs the most:
+
+  * the **fused-span path** (``ops/tickloop.py``) — one retrace per
+    span re-adds the per-dispatch floor K times over;
+  * the **serve dispatch path** (``pivot_tpu/serve``) — a retrace per
+    tick on the hot serving loop is the PR-6 dispatch-floor regression
+    in compile-cache clothing.
+
+Plus the satellite-2 parity pins: the dtype pass's cast-at-source fix
+(``sched/tpu.py`` staging buffers built in the policy dtype) must not
+move a single placement bit.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from pivot_tpu.ops.tickloop import fused_tick_run, span_bucket
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.utils.compile_counter import count_compiles
+
+H, B, K = 24, 16, 8
+
+
+def _span_operands(seed):
+    rng = np.random.default_rng(seed)
+    avail = rng.uniform(1, 6, (H, 4))
+    dem = rng.uniform(0.3, 2.0, (B, 4))
+    arrive = np.zeros(B, np.int32)
+    arrive[B - 4:] = 2
+    norms = np.sqrt((dem * dem).sum(1))
+    return avail, dem, arrive, norms
+
+
+def _run_span(seed, k_dyn, *, decreasing=False, sort_norm=None):
+    avail, dem, arrive, norms = _span_operands(seed)
+    kw = {}
+    if decreasing:
+        kw = dict(
+            decreasing=True,
+            sort_norm=jnp.asarray(
+                norms if sort_norm is None else sort_norm
+            ),
+        )
+    res = fused_tick_run(
+        jnp.asarray(avail), jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(k_dyn, jnp.int32),
+        policy="first-fit", n_ticks=span_bucket(K), **kw,
+    )
+    return np.asarray(res.placements)
+
+
+# ---------------------------------------------------------------------------
+# zero recompiles after warmup — fused-span path
+# ---------------------------------------------------------------------------
+
+
+def test_fused_span_zero_recompiles_after_warmup():
+    """Warm the span program once, then serve spans with different
+    data AND different dynamic horizons (same buckets — the contract
+    the bucketing exists to honor): the steady state must compile and
+    trace NOTHING.  This is the observable behind every retrace rule."""
+    _run_span(0, K)  # warmup: compiles the (K-bucket, B, H, config) program
+    with count_compiles() as counter:
+        for seed in range(1, 5):
+            _run_span(seed, K - (seed % 3))  # vary horizon within bucket
+    assert counter.compiles == 0 and counter.traces == 0, (
+        f"fused-span steady state recompiled: {counter.compiles} "
+        f"compile(s), {counter.traces} trace(s) — a retrace hazard "
+        "slipped past the static pass"
+    )
+
+
+def test_fused_span_distinct_config_does_compile():
+    """Counter sanity (the harness must be able to FAIL): a config the
+    warmup never saw (the decreasing arm) is a new static key and must
+    register at least one fresh trace+compile."""
+    _run_span(0, K)
+    with count_compiles() as counter:
+        _run_span(0, K, decreasing=True)
+    assert counter.traces > 0, "counter observed no trace for a new config"
+
+
+# ---------------------------------------------------------------------------
+# zero recompiles after warmup — serve dispatch path
+# ---------------------------------------------------------------------------
+
+
+def _serve_once(seed):
+    from pivot_tpu.serve import ServeDriver, ServeSession, poisson_arrivals
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    reset_ids()
+    session = ServeSession(
+        "s0",
+        build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        make_policy(PolicyConfig(
+            name="cost-aware", device="tpu", bin_pack="first-fit",
+            sort_tasks=True, sort_hosts=True, adaptive=False,
+        )),
+        seed=seed,
+    )
+    driver = ServeDriver([session], queue_depth=32, backpressure="shed")
+    report = driver.run(poisson_arrivals(rate=0.1, n_jobs=6, seed=3))
+    assert report["slo"]["counters"]["completed"] == 6
+    return report
+
+
+def test_serve_dispatch_zero_recompiles_after_warmup():
+    """Serve an identical seeded stream twice: the first run owns every
+    compile; the replay — same shapes, same buckets, same static
+    config — must hit the jit caches on every tick dispatch.  A single
+    session keeps batch membership deterministic (cross-session
+    coalescing groups are wall-clock-timed)."""
+    _serve_once(seed=0)  # warmup run: compiles the dispatch programs
+    with count_compiles() as counter:
+        _serve_once(seed=0)
+    assert counter.compiles == 0 and counter.traces == 0, (
+        f"serve steady state recompiled: {counter.compiles} compile(s), "
+        f"{counter.traces} trace(s) after an identical warmup run"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the CLI harness (quick mode — what the CI smoke lane runs)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_check_cli_quick_mode():
+    from pivot_tpu.analysis import main
+
+    assert main(["--compile-check"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: cast-at-source dtype fixes pin bit-identical decisions
+# ---------------------------------------------------------------------------
+
+
+def test_span_norm_staging_dtype_and_parity():
+    """``_span_norms`` builds in the POLICY dtype at source.  The pinned
+    regression: staging the f64-computed sort keys rounded to f32 moves
+    no placement bit against staging them at full f64 width (the
+    pre-fix x64 behavior) on a decreasing span."""
+    from pivot_tpu.sched.tpu import TpuFirstFitPolicy
+
+    pol = TpuFirstFitPolicy(decreasing=True)
+    _avail, dem, _arrive, norms64 = _span_operands(7)
+    staged = pol._span_norms(dem, B)
+    assert staged.dtype == jnp.dtype(pol.dtype)
+    np.testing.assert_array_equal(
+        np.asarray(staged)[: dem.shape[0]],
+        norms64.astype(np.dtype(pol.dtype)),
+    )
+    p_f32 = _run_span(7, K, decreasing=True,
+                      sort_norm=np.asarray(staged))
+    p_f64 = _run_span(7, K, decreasing=True, sort_norm=norms64)
+    np.testing.assert_array_equal(p_f32, p_f64)
+
+
+def test_uniform_staging_rounds_once_bitexact():
+    """The opportunistic span uniforms: assigning f64 Philox draws into
+    a policy-dtype buffer (cast-at-source) is bit-identical to the old
+    build-f64-then-cast-at-staging — one rounding either way."""
+    from pivot_tpu.sched.rand import tick_uniforms
+
+    dtype = np.float32
+    draws = [tick_uniforms(123, 40 + k, B) for k in range(4)]
+    at_source = np.zeros((4, B), dtype=dtype)
+    for k, row in enumerate(draws):
+        at_source[k] = row
+    at_staging = np.stack(draws).astype(dtype)
+    np.testing.assert_array_equal(at_source, at_staging)
+
+
+def test_risk_row_staging_rounds_once_bitexact():
+    """Same single-rounding pin for the span risk rows (w × hazard
+    products assigned into a policy-dtype buffer)."""
+    rng = np.random.default_rng(5)
+    hazard = rng.uniform(0.0, 0.2, (4, H))
+    w = 1.0 * 50.0
+    at_source = np.zeros((4, H), dtype=np.float32)
+    at_source[:] = w * hazard
+    np.testing.assert_array_equal(
+        at_source, (w * hazard).astype(np.float32)
+    )

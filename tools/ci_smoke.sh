@@ -4,12 +4,22 @@
 #
 #   1. tier-1 quick chaos soak + replay determinism (the seeded
 #      acceptance twins in tests/test_chaos.py);
-#   2. graftcheck static analysis (tools/graftcheck.py, round 12):
-#      backend knob-parity matrix across every kernel/span form +
-#      routing layer, determinism lint over the replay-critical
-#      modules, thread-guard discipline in the serve/batch layer, and
-#      the host-sync lint (auto-discovered hot bodies); plus the
-#      legacy hotpath CLI contract (tools/hotpath_lint.py shim);
+#   2. graftcheck static analysis (tools/graftcheck.py, round 12; the
+#      jitcheck passes, round 13): backend knob-parity matrix across
+#      every kernel/span form + routing layer, determinism lint over
+#      the replay-critical modules, thread-guard discipline in the
+#      serve/batch layer, the host-sync lint (auto-discovered hot
+#      bodies), and the compile-semantics passes — retrace hazards,
+#      the carry-donation manifest, device-boundary dtype hygiene,
+#      and the Pallas VMEM-budget recomputation.  Findings are emitted
+#      as --json and annotated per file:line (lint_annotate.py); the
+#      whole suite must finish inside a 10 s wall-clock budget (it
+#      shares one parsed AST per file across passes — a pass that
+#      re-parses shows up here as a timeout).  The compile-counter
+#      harness then proves the retrace rules' runtime observable:
+#      zero recompiles after warmup on the fused-span path (quick
+#      mode; tier-1 covers the serve path).  Plus the legacy hotpath
+#      CLI contract (tools/hotpath_lint.py shim);
 #   3. chaos replay determinism against the COMMITTED seed schedule
 #      (data/chaos/ci_seed.json): regenerating the schedule from its
 #      seed must reproduce it bit-for-bit, and two replays of it must
@@ -40,9 +50,27 @@ echo "== [1/5] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/5] graftcheck static analysis + hot-path lint CLI =="
-python tools/graftcheck.py
+echo "== [2/5] graftcheck static analysis (8 passes) + compile check =="
+# Machine-readable findings, annotated per file:line; the 10 s timeout
+# IS the wall-clock budget check for the full static suite.  The
+# capture must not abort under `set -e` before lint_annotate has
+# rendered the findings — annotate carries the pass/fail exit itself.
+gc_rc=0
+timeout 10 python tools/graftcheck.py --json > "$TMP/graftcheck.json" \
+    || gc_rc=$?
+if [ "$gc_rc" -ge 124 ]; then
+    echo "graftcheck exceeded its 10 s wall-clock budget" >&2
+    exit 1
+elif [ "$gc_rc" -gt 1 ]; then
+    echo "graftcheck crashed (exit $gc_rc):" >&2
+    cat "$TMP/graftcheck.json" >&2
+    exit "$gc_rc"
+fi
+python tools/lint_annotate.py < "$TMP/graftcheck.json"
 python tools/hotpath_lint.py
+# Runtime twin of the retrace pass: warm the fused span driver, then
+# assert ZERO recompiles in steady state (quick mode).
+python -m pivot_tpu.analysis --compile-check quick
 
 echo "== [3/5] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):

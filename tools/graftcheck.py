@@ -2,9 +2,14 @@
 """graftcheck CLI — the repo-wide static-analysis suite.
 
 Thin launcher for :mod:`pivot_tpu.analysis` (also runnable as
-``python -m pivot_tpu.analysis``).  Four passes: backend feature-parity
-matrix, determinism lint, thread-guard discipline, host-sync lint.
-Exit 1 on findings.  See ``docs/ARCHITECTURE.md`` "Static analysis".
+``python -m pivot_tpu.analysis``).  Eight passes: backend
+feature-parity matrix, determinism lint, thread-guard discipline,
+host-sync lint, and the jitcheck compile-hazard passes (retrace,
+donation, dtype, pallas-budget).  Exit 1 on findings; ``--json`` for
+machine-readable output (pipe into ``tools/lint_annotate.py`` for CI
+per-line annotations); ``--compile-check`` for the runtime
+zero-recompiles harness.  See ``docs/ARCHITECTURE.md`` "Static
+analysis".
 """
 
 import os

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Render ``graftcheck --json`` findings for CI.
+
+Reads the machine-readable findings payload on stdin and prints one
+line per finding: the human ``file:line: [rule] message`` form always,
+plus a GitHub Actions ``::error file=...,line=...::...`` annotation
+when running under Actions (``GITHUB_ACTIONS=true``), so findings
+surface inline on the PR diff.  Exit 1 when findings exist, 0 clean —
+the pipe ``graftcheck --json | lint_annotate`` preserves the lint's
+pass/fail contract (both ends of the pipe fail on findings; with
+``pipefail`` either is enough).
+
+Usage::
+
+    python tools/graftcheck.py --json | python tools/lint_annotate.py
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    payload = json.load(sys.stdin)
+    findings = payload.get("findings", [])
+    annotate = os.environ.get("GITHUB_ACTIONS") == "true"
+    for f in findings:
+        print(
+            f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}",
+            file=sys.stderr,
+        )
+        if annotate:
+            message = f["message"].replace("\n", " ")
+            print(
+                f"::error file={f['path']},line={f['line']},"
+                f"title=graftcheck[{f['rule']}]::{message}"
+            )
+    if findings:
+        print(
+            f"graftcheck: {len(findings)} finding(s)", file=sys.stderr
+        )
+        return 1
+    print(f"graftcheck: clean ({len(payload.get('rules', []))} pass(es))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
