@@ -17,14 +17,16 @@ SHELL := /bin/bash
 smoke:
 	tools/ci_smoke.sh
 
-# Standalone static analysis (no JAX import, sub-second): the eight
+# Standalone static analysis (no JAX import, sub-second): the nine
 # graftcheck passes with machine-readable findings annotated per
 # file:line (tools/lint_annotate.py emits GitHub ::error lines under
-# Actions), plus the legacy hotpath CLI contract.  pipefail keeps the
-# pipe failing when graftcheck itself exits nonzero.
+# Actions; --require pins the obs-boundary pass so a filtered run
+# cannot silently skip it), plus the legacy hotpath CLI contract.
+# pipefail keeps the pipe failing when graftcheck itself exits nonzero.
 lint:
 	set -o pipefail; \
-	python tools/graftcheck.py --json | python tools/lint_annotate.py
+	python tools/graftcheck.py --json | \
+	    python tools/lint_annotate.py --require obs-boundary
 	python tools/hotpath_lint.py
 
 # The full quick test tier (ROADMAP.md "Tier-1 verify").

@@ -499,6 +499,139 @@ def _bench_fused_tick(
     }
 
 
+def _bench_obs_overhead(n_apps: int = 16, repeats: int = 9) -> dict:
+    """Round-14 acceptance row: the observability plane's hot-path cost.
+
+    Three measurements over the IDENTICAL seeded DES run on the
+    fused-tick path (``fuse_spans=True``, the default — fast-forward +
+    fused-span machinery engaged, which is where per-tick tracer hooks
+    would hurt most):
+
+      * ``off`` — a disabled ``Tracer`` (the shipped default: every
+        recording call short-circuits on ``enabled`` before touching a
+        clock or lock);
+      * ``off_again`` — the same arm re-measured, so the row carries
+        its own noise floor (``off_noise_pct``) — "tracer-off at noise
+        level" is then a statement against a measured noise, not a
+        hand-wave;
+      * ``on`` — full tracing (tick spans, task instants, causal
+        stages).
+
+    Gates: ``meets_3pct`` (tracer-on overhead < 3% of the untraced
+    wall) and ``parity`` (the traced run's meter summary — wall clock
+    excluded — and avg_runtime are bit-identical to the untraced run:
+    observation must not perturb the system).  Walls are best-of-N:
+    these runs are hundreds of ms, where the min rejects scheduler/GC
+    jitter a mean would soak up.
+    """
+    from pivot_tpu.experiments.runner import ExperimentRun
+    from pivot_tpu.infra.gen import RandomClusterGenerator
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.sched.policies import CostAwarePolicy
+
+    from pivot_tpu.des import Environment
+
+    trace_file = "data/jobs/jobs-5000-200-86400-172800.npz"
+
+    def build():
+        meta = ResourceMetadata(seed=0)
+        gen = RandomClusterGenerator(
+            Environment(), (16, 16), (128 * 1024,) * 2, (100, 100),
+            (1, 1), meta=meta, seed=0,
+        )
+        return gen.generate(24)
+
+    cluster = build()
+
+    def once(trace_events: bool):
+        import gc
+
+        run = ExperimentRun(
+            "obs", cluster, CostAwarePolicy(mode="numpy"),
+            trace_file, n_apps=n_apps, seed=3, fuse_spans=True,
+            trace_events=trace_events,
+        )
+        # GC pauses landing mid-run are 10-40% of the wall at this
+        # scale (measured) — collect up front and pause the collector
+        # so the row measures the tracer, not the allocator.
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            summary = run.run()
+            wall = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        return wall, summary, len(run.tracer.events)
+
+    # Bracketed-pair median.  On a shared, noisy CPU the wall of one
+    # run wobbles far more than the tracer costs, so neither absolute
+    # floors nor single pairs resolve a 3% gate; what does (measured):
+    # pin the GC (done in ``once`` — its pauses alone are 10-40% of
+    # the wall), BRACKET each traced run between two untraced runs in
+    # the same round (machine state maximally shared), score the round
+    # as on / min(off, off2), and take the MEDIAN across rounds — the
+    # median rejects the rounds a scheduler hiccup poisoned, and the
+    # off/off gap inside each round is the row's own noise estimate,
+    # so "tracer-off at noise level" is a measured statement.
+    once(False)  # unmeasured warmup: trace-file load, numpy caches
+    on_ratios: list = []
+    noise_ratios: list = []
+    summaries = {}
+    walls = {"off": float("inf"), "on": float("inf")}
+    n_events = 0
+    for r in range(repeats):
+        order = ("off", "on", "off2") if r % 2 else ("off2", "on", "off")
+        round_walls = {}
+        for key in order:
+            wall, summary, events = once(key == "on")
+            round_walls[key] = wall
+            summaries[key] = summary
+            if key == "on":
+                n_events = events
+        base_r = min(round_walls["off"], round_walls["off2"])
+        walls["off"] = min(walls["off"], base_r)
+        walls["on"] = min(walls["on"], round_walls["on"])
+        on_ratios.append(round_walls["on"] / base_r)
+        noise_ratios.append(
+            abs(round_walls["off"] - round_walls["off2"]) / base_r
+        )
+
+    def median(vals):
+        s = sorted(vals)
+        mid = len(s) // 2
+        return s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+    s_off, s_off2, s_on = (
+        summaries["off"], summaries["off2"], summaries["on"]
+    )
+
+    def sim_view(s: dict) -> dict:
+        return {
+            k: v for k, v in s.items() if k not in ("wall_clock",)
+        }
+
+    parity = sim_view(s_on) == sim_view(s_off) == sim_view(s_off2)
+    base = walls["off"]
+    overhead_pct = (median(on_ratios) - 1.0) * 100.0
+    off_noise_pct = median(noise_ratios) * 100.0
+    return {
+        **({} if parity else {
+            "error": "traced run diverged from untraced (meter/runtime)"
+        }),
+        "n_apps": n_apps,
+        "rounds": repeats,
+        "fused_tick_path": True,
+        "wall_off_s": round(base, 6),
+        "wall_on_s": round(walls["on"], 6),
+        "trace_events": n_events,
+        "tracer_on_overhead_pct": round(overhead_pct, 3),
+        "tracer_off_noise_pct": round(off_noise_pct, 3),
+        "parity": parity,
+        "meets_3pct": bool(parity and overhead_pct < 3.0),
+    }
+
+
 def _bench_device(ctx, n_replicas: int, repeats: int = 5):
     """Decisions/sec of the vmapped fused kernel over a perturbed ensemble."""
     import numpy as np
@@ -1702,6 +1835,14 @@ def main() -> None:
         spot_survival = _bench_spot_survival()
     except Exception as exc:  # noqa: BLE001 — row-level isolation
         spot_survival = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    # Round-14 acceptance row: the observability plane must be free
+    # when off and <3% when on, on the fused-tick DES path, without
+    # perturbing a single meter bit.  Pure DES (numpy policy) — same
+    # measurement on every backend.
+    try:
+        obs_overhead = _bench_obs_overhead()
+    except Exception as exc:  # noqa: BLE001 — row-level isolation
+        obs_overhead = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     if backend != "tpu":
         # The Pallas variants cannot run on the fallback backend, so the
         # official record would otherwise exercise one kernel (VERDICT
@@ -1784,6 +1925,7 @@ def main() -> None:
         "serve_tiers": serve_tiers,
         "shard_place": shard_place,
         "spot_survival": spot_survival,
+        "obs_overhead": obs_overhead,
         **(
             {"ensemble_saturated": ens_saturated} if ens_saturated else {}
         ),

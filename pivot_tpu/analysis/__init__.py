@@ -52,6 +52,16 @@ compile-counter harness (``pivot_tpu/utils/compile_counter.py``,
 the steady-state hypothesis — zero recompiles after warmup — on the
 fused-span and serve dispatch paths.
 
+The observability plane (round 14) adds a ninth pass:
+
+  * **obs-boundary** (``rules/obs-boundary``) — the structural pins of
+    ``pivot_tpu/obs``: the device layer (``pivot_tpu/ops/``) never
+    imports the obs package, the hostsync-discovered hot bodies never
+    call a tracer recording method (events belong at dispatch
+    boundaries), and the determinism-scoped modules never own an
+    ``ObsClock`` (hooks pass sim-time payloads; the wall side is
+    stamped inside ``obs/``).
+
 Framework pieces shared by every pass: :class:`Finding`, the rule
 registry (:data:`REGISTRY`), ``# graftcheck: ignore[rule] -- reason``
 suppressions (reason REQUIRED; a suppression that matches no finding is
@@ -250,6 +260,7 @@ def _registry():
         donation,
         dtype,
         hostsync,
+        obsbound,
         pallas_budget,
         parity,
         retrace,
@@ -266,6 +277,10 @@ def _registry():
         donation.RULE: donation,
         dtype.RULE: dtype,
         pallas_budget.RULE: pallas_budget,
+        # The observability plane's boundary pins (round 14): no
+        # instrumentation inside the device layer / hot bodies, no obs
+        # wall clock inside the determinism scope.
+        obsbound.RULE: obsbound,
     }
 
 
@@ -418,8 +433,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="graftcheck",
         description="repo-wide static analysis: backend knob parity, "
         "replay determinism, thread-guard discipline, host-sync lint, "
-        "and the jitcheck compile-hazard passes (retrace, donation, "
-        "dtype, pallas-budget)",
+        "the jitcheck compile-hazard passes (retrace, donation, "
+        "dtype, pallas-budget), and the observability boundary pins "
+        "(obs-boundary)",
     )
     parser.add_argument(
         "--rules",

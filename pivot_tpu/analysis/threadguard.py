@@ -76,12 +76,13 @@ GUARDS: Dict[str, Dict[str, dict]] = {
                 "_register_inflight", "_route", "_preempt_for",
             ),
             # Single-threaded lifecycle phases: __init__ precedes every
-            # thread; report/audit run on the drained service.  run()
-            # is NOT exempt — its setup section is pre-thread (per-line
-            # suppressions say so), but its join loop runs concurrently
-            # with supervisor restarts and stays checked (that is where
-            # this pass caught the _threads iteration race).
-            "exempt": ("__init__", "report", "audit"),
+            # thread; report/audit/publish_metrics run on the drained
+            # service.  run() is NOT exempt — its setup section is
+            # pre-thread (per-line suppressions say so), but its join
+            # loop runs concurrently with supervisor restarts and stays
+            # checked (that is where this pass caught the _threads
+            # iteration race).
+            "exempt": ("__init__", "report", "audit", "publish_metrics"),
         },
     },
     "pivot_tpu/serve/autoscale.py": {

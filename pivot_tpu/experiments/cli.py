@@ -560,6 +560,18 @@ def parse_args(argv=None):
     srv.add_argument("--slo-p99-ms", type=float, default=50.0,
                      help="tier-0 p99 decision-latency target (ms) the "
                           "autoscaler sizes the pool against")
+    srv.add_argument("--trace-out", default="", metavar="PATH",
+                     help="write the service's causal trace timeline "
+                          "(every job's arrival→completion chain, "
+                          "dispatch spans, autoscaler actions) as "
+                          "Perfetto/Chrome trace_event JSON to PATH "
+                          "(plus PATH.jsonl raw events); render with "
+                          "tools/obs_report.py or load in ui.perfetto.dev")
+    srv.add_argument("--metrics-out", default="", metavar="PATH",
+                     help="export the unified metrics registry "
+                          "(SLO counters, latency summaries, dispatch "
+                          "mix, autoscaler actions) as Prometheus text "
+                          "exposition to PATH (plus PATH.json)")
     sub.add_parser(
         "worker",
         help="resident what-if worker: serve repeated CLI requests from "
@@ -1490,6 +1502,13 @@ def run_serve_stream(args) -> dict:
         autoscale = AutoscaleConfig(
             g_min=g_min, g_max=g_max, slo_p99_s=args.slo_p99_ms / 1e3,
         )
+    # Observability plane (round 14): --trace-out turns on causal task
+    # tracing (zero-cost otherwise), --metrics-out attaches the unified
+    # registry; the report then carries the metrics snapshot inline.
+    from pivot_tpu.obs import MetricsRegistry, Tracer
+
+    tracer = Tracer() if args.trace_out else None
+    registry = MetricsRegistry() if args.metrics_out else None
     driver = ServeDriver(
         sessions,
         queue_depth=args.queue_depth,
@@ -1501,6 +1520,8 @@ def run_serve_stream(args) -> dict:
         preempt=args.preempt,
         session_factory=make_session if autoscale else None,
         autoscale=autoscale,
+        tracer=tracer,
+        registry=registry,
     )
     if args.closed_loop:
         arrivals = closed_loop_source(
@@ -1534,6 +1555,15 @@ def run_serve_stream(args) -> dict:
     )
     out_dir = os.path.join(args.output_dir, "serve", str(int(time.time())))
     os.makedirs(out_dir, exist_ok=True)
+    if tracer is not None:
+        tracer.save_perfetto(args.trace_out)
+        tracer.save_jsonl(args.trace_out + ".jsonl")
+        report["trace_out"] = args.trace_out
+        report["trace_events"] = len(tracer.events)
+    if registry is not None:
+        registry.save_prometheus(args.metrics_out)
+        registry.save_json(args.metrics_out + ".json")
+        report["metrics_out"] = args.metrics_out
     with open(os.path.join(out_dir, "report.json"), "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report))

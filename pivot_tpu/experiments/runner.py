@@ -273,9 +273,15 @@ class ExperimentRun(LogMixin):
 
     def run(self) -> dict:
         env = Environment()
-        meter = Meter(env, self.cluster.meta)
-        cluster = self.cluster.clone(env, meter)
         self.tracer = Tracer(enabled=self.trace_events)
+        # One injected obs clock per run: the meter's wall snapshot and
+        # the tracer's wall timestamps share an epoch (round 14).
+        meter = Meter(env, self.cluster.meta, clock=self.tracer.clock)
+        cluster = self.cluster.clone(env, meter)
+        if self.market is not None:
+            # Price-regime changes land on the same timeline as ticks
+            # and task events (no-op when tracing is disabled).
+            self.market.emit_timeline(self.tracer)
         scheduler = GlobalScheduler(
             env,
             cluster,
@@ -337,6 +343,9 @@ class ExperimentRun(LogMixin):
             if self.trace_events:
                 self.tracer.save_jsonl(os.path.join(out, "events.jsonl"))
                 self.tracer.save_chrome(os.path.join(out, "events.chrome.json"))
+                self.tracer.save_perfetto(
+                    os.path.join(out, "events.perfetto.json")
+                )
             # Completion sentinel — written LAST and atomically (a truncated
             # sentinel after a mid-write kill must read as "incomplete", not
             # crash the resumed sweep), carrying the run identity so grid

@@ -149,6 +149,24 @@ class MarketSchedule:
             self.n_segments - 1,
         ).astype(np.int32)
 
+    def emit_timeline(self, tracer) -> None:
+        """Stamp every price-segment boundary onto a trace timeline
+        (round 14, ``pivot_tpu.obs``): each segment start becomes a
+        ``market``/``price_segment`` instant carrying the segment's
+        mean price multiplier and mean hazard, so cost/risk regime
+        changes read in context with placements and chaos events.
+        Deterministic — pure sim-time payloads; the tracer stamps the
+        wall side inside ``obs/``."""
+        if not getattr(tracer, "enabled", False):
+            return
+        for p in range(self.n_segments):
+            tracer.emit(
+                "market", "price_segment", float(self.times[p]),
+                segment=p,
+                mean_price=float(np.mean(self.price[p])),
+                mean_hazard=float(np.mean(self.hazard[p])),
+            )
+
     def price_row(self, t: float) -> np.ndarray:
         """[NZ] per-zone price multiplier at ``t``."""
         return self.price[self.segment(t)]

@@ -22,21 +22,26 @@ import json
 import math
 import os
 import threading
-import time
 from collections import defaultdict
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from pivot_tpu.obs.clock import ObsClock
 from pivot_tpu.utils import LogMixin, ceil_bucket, floor_bucket
 
 __all__ = ["Meter", "SloMeter", "StreamingHistogram"]
 
 
 class Meter(LogMixin):
-    def __init__(self, env, meta):
+    def __init__(self, env, meta, clock: Optional[ObsClock] = None):
         self.env = env
         self.meta = meta
+        #: The injected obs wall clock (round 14): a run that hands the
+        #: SAME clock to its Meter and SloMeter gets snapshots that
+        #: agree exactly on elapsed wall time — before, each kept a
+        #: private perf_counter start and disagreed by construction.
+        self.clock = clock or ObsClock()
         # host -> list of [start] / [start, end] busy intervals
         self._host_intervals: Dict[object, List[list]] = defaultdict(list)
         # route -> transfer key -> list of [start, end, chunk_mb] service
@@ -57,7 +62,6 @@ class Meter(LogMixin):
         # Native network engines whose per-route stats replace per-slot
         # logs (``NativeNetworkEngine.metered_route_stats``).
         self._native_sources: List[object] = []
-        self._wall_start = time.perf_counter()
 
     def add_native_source(self, engine) -> None:
         self._native_sources.append(engine)
@@ -73,7 +77,7 @@ class Meter(LogMixin):
 
     @property
     def wall_clock(self) -> float:
-        return time.perf_counter() - self._wall_start
+        return self.clock.elapsed()
 
     @property
     def total_scheduling_ops(self) -> int:
@@ -279,6 +283,40 @@ class Meter(LogMixin):
             return 0.0
         return float(np.mean(self._sched_turnovers))
 
+    def publish_metrics(self, registry, run: str = "default") -> None:
+        """Publish this run's derived metrics into the unified registry
+        (``pivot_tpu.obs.MetricsRegistry``), labeled by run — the batch
+        half of the one-snapshot-shape contract (``SloMeter
+        .publish_metrics`` is the serving half)."""
+        g = [
+            ("pivot_run_egress_cost_dollars",
+             "total network egress cost over metered routes",
+             self.total_network_traffic_cost),
+            ("pivot_run_instance_hours",
+             "cumulative billed instance hours",
+             self.cumulative_instance_hours),
+            ("pivot_run_rework_seconds",
+             "sim-seconds of aborted-execution rework",
+             self._rework_s),
+            ("pivot_run_sim_seconds", "simulated time", self.runtime),
+            ("pivot_run_wall_seconds",
+             "wall seconds on the injected obs clock", self.wall_clock),
+            ("pivot_run_avg_scheduling_turnover_seconds",
+             "mean submit-to-placement latency (sim-seconds)",
+             self.average_scheduling_turnover),
+        ]
+        for name, help_text, value in g:
+            registry.gauge(name, help_text, labelnames=("run",))
+            registry.set(name, value, run=run)
+        registry.counter(
+            "pivot_run_scheduling_ops_total",
+            "placement decisions considered by the tick loop",
+            labelnames=("run",),
+        )
+        registry.set(
+            "pivot_run_scheduling_ops_total", self._n_sched_ops, run=run
+        )
+
     def save(self, data_dir: str) -> None:
         """Write the reference-compatible four-file JSON layout."""
         os.makedirs(data_dir, exist_ok=True)
@@ -459,9 +497,13 @@ class SloMeter(LogMixin):
         "spilled", "preempted", "decisions",
     )
 
-    def __init__(self):
+    def __init__(self, clock: Optional[ObsClock] = None):
         self._lock = threading.Lock()
-        self._wall_start = time.perf_counter()
+        #: Injected obs wall clock (round 14) — share one instance with
+        #: the run's :class:`Meter` and the two snapshots agree exactly
+        #: on elapsed wall time (they used to keep duplicate private
+        #: ``perf_counter`` starts).
+        self.clock = clock or ObsClock()
         self.counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
         self.shed_reasons: Dict[str, int] = {}
         # Wall seconds per placement call (decision latency SLO).
@@ -575,7 +617,7 @@ class SloMeter(LogMixin):
 
     @property
     def wall_clock(self) -> float:
-        return time.perf_counter() - self._wall_start
+        return self.clock.elapsed()
 
     def snapshot(self) -> dict:
         """JSON-ready view of the service's SLO state at this instant."""
@@ -611,3 +653,92 @@ class SloMeter(LogMixin):
         with open(tmp, "w") as f:
             json.dump(self.snapshot(), f, indent=2)
         os.replace(tmp, path)
+
+    @staticmethod
+    def _publish_hist(registry, name: str, help_text: str,
+                      hist: StreamingHistogram, **labels) -> None:
+        registry.summary(name, help_text,
+                         labelnames=tuple(sorted(labels)))
+        registry.observe_summary(
+            name,
+            count=hist.count,
+            total=hist._sum,
+            quantiles={
+                0.5: hist.percentile(50),
+                0.95: hist.percentile(95),
+                0.99: hist.percentile(99),
+            },
+            **labels,
+        )
+
+    def publish_metrics(self, registry) -> None:
+        """Publish the service's SLO state into the unified registry
+        (``pivot_tpu.obs.MetricsRegistry``) — counters, shed reasons,
+        per-tier counters, the three latency/depth distributions as
+        summaries, and the dispatch-path mix.  Idempotent (set-style):
+        republishing a later snapshot overwrites, never double-counts.
+        One snapshot shape for every consumer instead of five."""
+        with self._lock:
+            counters = dict(self.counters)
+            shed = dict(self.shed_reasons)
+            tiers = {
+                tier: dict(t["counters"])
+                for tier, t in sorted(self._tiers.items())
+            }
+            stats = dict(self._dispatch_stats or {})
+        registry.counter(
+            "pivot_serve_events_total",
+            "admission/serve lifecycle counters "
+            "(SloMeter.COUNTERS key set)",
+            labelnames=("event",),
+        )
+        for key, value in counters.items():
+            registry.set("pivot_serve_events_total", value, event=key)
+        registry.counter(
+            "pivot_serve_shed_total",
+            "jobs shed, by recorded reason",
+            labelnames=("reason",),
+        )
+        for reason, value in shed.items():
+            registry.set("pivot_serve_shed_total", value, reason=reason)
+        registry.counter(
+            "pivot_serve_tier_events_total",
+            "per-tier lifecycle counters (SloMeter.TIER_COUNTERS)",
+            labelnames=("event", "tier"),
+        )
+        for tier, tc in tiers.items():
+            for key, value in tc.items():
+                registry.set(
+                    "pivot_serve_tier_events_total", value,
+                    event=key, tier=tier,
+                )
+        registry.counter(
+            "pivot_serve_dispatch_total",
+            "dispatch-path mix (DispatchBatcher documented stats keys)",
+            labelnames=("key",),
+        )
+        for key in self.DISPATCH_KEYS:
+            registry.set(
+                "pivot_serve_dispatch_total", int(stats.get(key, 0)),
+                key=key,
+            )
+        self._publish_hist(
+            registry, "pivot_serve_decision_latency_seconds",
+            "wall latency of each placement call (batcher wait "
+            "included)", self.decision_latency,
+        )
+        self._publish_hist(
+            registry, "pivot_serve_queue_depth",
+            "admitted-but-incomplete jobs at each arrival",
+            self.queue_depth,
+        )
+        self._publish_hist(
+            registry, "pivot_serve_sojourn_sim_seconds",
+            "admission-to-completion sim-time sojourn per job",
+            self.sojourn_sim,
+        )
+        registry.gauge(
+            "pivot_serve_wall_seconds",
+            "service wall clock on the injected obs clock",
+        )
+        registry.set("pivot_serve_wall_seconds", self.wall_clock)

@@ -1,0 +1,94 @@
+"""pivot_tpu.obs — the first-class observability plane (round 14).
+
+Three pillars (ISSUE 12):
+
+  * **causal task tracing** (:mod:`pivot_tpu.obs.tracer`) — every serve
+    job carries a trace id from arrival through admission/queue/spill →
+    routing → batcher slot/device dispatch → placement/retry/preemption
+    /dead-letter → completion, as parent-linked stages on dual clocks
+    (sim + wall); DES ticks, batcher flushes, autoscaler actions, and
+    chaos/market events land on the same timeline; exported as
+    Perfetto/Chrome ``trace_event`` JSON and JSONL, rendered by
+    ``tools/obs_report.py``;
+  * **unified metrics registry** (:mod:`pivot_tpu.obs.registry`) — one
+    thread-safe, label-aware counter/gauge/summary store that
+    ``Meter``, ``SloMeter``, the dispatch batcher, the autoscaler, and
+    the compile counter publish into, exported as Prometheus text
+    exposition and JSON;
+  * **hot-path safety** — zero-cost when disabled, bounded when
+    enabled, wall capture confined to this package (the graftcheck
+    ``obs-boundary`` pass pins the determinism boundary; the
+    ``obs_overhead`` bench row gates the enabled cost).
+
+See docs/ARCHITECTURE.md "The observability plane".
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from pivot_tpu.obs.clock import ObsClock
+from pivot_tpu.obs.registry import MetricsRegistry
+from pivot_tpu.obs.tracer import (
+    NULL_TRACER,
+    TERMINAL_STAGES,
+    Tracer,
+    device_profile,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "NULL_TRACER",
+    "ObsClock",
+    "TERMINAL_STAGES",
+    "Tracer",
+    "attach_compile_observer",
+    "device_profile",
+]
+
+
+def attach_compile_observer(
+    registry: Optional[MetricsRegistry] = None,
+    tracer: Optional[Tracer] = None,
+    sim_time: Optional[Callable[[], float]] = None,
+) -> Callable[[], None]:
+    """Make JAX recompiles *visible*: publish every backend compile /
+    jaxpr trace into the registry
+    (``pivot_jax_compile_events_total{kind=...}``) and stamp an instant
+    event on the trace timeline — a recompile after warmup becomes a
+    mark a human sees in Perfetto, not just a test assertion
+    (``tests/test_jitcheck.py``).
+
+    ``sim_time`` (optional, e.g. ``lambda: env.now``) anchors the
+    instant on the sim timeline as well; without it the event is
+    wall-only.  Returns a detach callable — call it when the observed
+    window ends (the underlying ``jax.monitoring`` listener is
+    process-permanent, but the observer fan-out list is not).
+    """
+    from pivot_tpu.utils import compile_counter
+
+    if registry is not None:
+        registry.counter(
+            "pivot_jax_compile_events_total",
+            "XLA backend compiles and jaxpr traces observed by the "
+            "compile counter (zero after warmup is the steady-state "
+            "hypothesis)",
+            labelnames=("kind",),
+        )
+
+    def _observe(kind: str) -> None:
+        if registry is not None:
+            registry.inc("pivot_jax_compile_events_total", kind=kind)
+        if tracer is not None and tracer.enabled:
+            sim = sim_time() if sim_time is not None else None
+            if sim is not None:
+                tracer.emit("compile", kind, sim)
+            else:
+                tracer.mark("compile", kind)
+
+    compile_counter.add_observer(_observe)
+
+    def detach() -> None:
+        compile_counter.remove_observer(_observe)
+
+    return detach

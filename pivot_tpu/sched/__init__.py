@@ -447,6 +447,19 @@ class GlobalScheduler(LogMixin):
         }
         policy.bind(self)
 
+    def _stage_task(self, task: Task, name: str, **args) -> None:
+        """Causal-trace hook (round 14): link a task-level event into
+        its serve job's parent-linked chain when the app carries a
+        trace id (stamped by the serve driver at admission).  Call
+        sites gate on ``self.tracer.enabled`` so the disabled path
+        costs nothing; the payload is sim-time only — the wall side is
+        stamped inside ``pivot_tpu/obs`` (the determinism boundary)."""
+        trace = getattr(task.application, "_obs_trace", None)
+        if trace is not None:
+            self.tracer.stage(
+                trace, name, sim=self.env.now, task=task.id, **args
+            )
+
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
         self.env.process(self._dispatch_loop())
@@ -706,6 +719,8 @@ class GlobalScheduler(LogMixin):
                 task.placement = host.id
                 cluster.dispatch_q.put(task)
                 task.set_submitted()
+                if self.tracer.enabled:
+                    self._stage_task(task, "placed", host=host.id)
                 if self.meter:
                     self.meter.add_scheduling_turnover(
                         env.now - self._pending_since.pop(task, env.now)
@@ -1076,6 +1091,8 @@ class GlobalScheduler(LogMixin):
             self.tracer.emit(
                 "task", "finished", env.now, id=task.id, host=task.placement
             )
+            if self.tracer.enabled:
+                self._stage_task(task, "task_finished")
             local.notify(task)
         else:
             failed_host = task.placement
@@ -1098,6 +1115,8 @@ class GlobalScheduler(LogMixin):
                     self._dead_letter(task, failed_host, attempts)
                     return
                 self.tracer.emit("task", "retry", env.now, id=task.id)
+                if self.tracer.enabled:
+                    self._stage_task(task, "retry", attempt=attempts)
                 delay = self.retry.backoff(attempts, task.id)
                 if delay > 0.0:
                     # Backed-off resubmission: the task re-enters the
@@ -1111,6 +1130,8 @@ class GlobalScheduler(LogMixin):
                     self.submit_q.put(task)
             else:
                 self.tracer.emit("task", "retry", env.now, id=task.id)
+                if self.tracer.enabled:
+                    self._stage_task(task, "retry")
                 self.submit_q.put(task)
         if app.is_finished:
             app.end_time = env.now
@@ -1155,6 +1176,10 @@ class GlobalScheduler(LogMixin):
             "task", "dead_letter", self.env.now, id=task.id, reason=reason,
             attempts=attempts, host=host_id,
         )
+        if self.tracer.enabled:
+            self._stage_task(
+                task, "dead_letter", reason=reason, attempts=attempts
+            )
         self.logger.warning(
             "[%.3f] task %s dead-lettered after %d attempts (%s)",
             self.env.now, task.id, attempts, reason,

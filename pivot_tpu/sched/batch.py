@@ -373,11 +373,21 @@ class DispatchBatcher:
     """
 
     def __init__(self, n_slots: int, flush_after: Optional[float] = None,
-                 mesh: Optional[object] = None):
+                 mesh: Optional[object] = None, tracer=None):
         if n_slots < 1:
             raise ValueError("DispatchBatcher needs at least one slot")
         if flush_after is not None and flush_after <= 0:
             raise ValueError("flush_after must be positive (or None)")
+        #: Observability hook (round 14): each flush lands on the trace
+        #: timeline as a wall-duration ``dispatch``/``flush`` span with
+        #: its group size — the wall capture happens inside the tracer
+        #: (``pivot_tpu/obs``), never here (sched/ is determinism-
+        #: scoped).  ``None`` = the zero-cost NULL tracer.
+        if tracer is None:
+            from pivot_tpu.obs.tracer import NULL_TRACER
+
+            tracer = NULL_TRACER
+        self.tracer = tracer
         self._cond = threading.Condition()
         self._n_slots = n_slots
         self._open = n_slots
@@ -525,12 +535,16 @@ class DispatchBatcher:
                     ) is not None:
                         self.stats["mesh_dispatches"] += 1
                 try:
-                    outs = batch_execute(
-                        reqs[0].kernel,
-                        [(r.args, r.arr_kw) for r in reqs],
-                        reqs[0].static_kw,
-                        mesh=self._mesh,
-                    )
+                    with self.tracer.wall_span(
+                        "dispatch", "flush", group=len(reqs),
+                        slots=[r.slot for r in reqs],
+                    ):
+                        outs = batch_execute(
+                            reqs[0].kernel,
+                            [(r.args, r.arr_kw) for r in reqs],
+                            reqs[0].static_kw,
+                            mesh=self._mesh,
+                        )
                 except BaseException as exc:  # noqa: BLE001 — deliver, don't hang
                     for r in reqs:
                         r.error = exc

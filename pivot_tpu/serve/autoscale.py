@@ -129,6 +129,13 @@ class SloAutoscaler(LogMixin):
                 "detail": detail,
             }
         )
+        # Observability (round 14): every scaling action is a wall-
+        # domain instant on the shared trace timeline — pool moves read
+        # in context with the dispatch spans that triggered them.
+        self.driver.tracer.mark(
+            "autoscale", action, p99_s=round(p99, 6), pool=pool,
+            detail=detail,
+        )
 
     def _loop(self) -> None:
         cfg = self.config

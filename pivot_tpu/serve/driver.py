@@ -55,6 +55,7 @@ from typing import Callable, Dict, Iterable, List, Optional
 import time
 
 from pivot_tpu.infra.meter import SloMeter
+from pivot_tpu.obs import NULL_TRACER, ObsClock
 from pivot_tpu.utils import LogMixin
 
 from pivot_tpu.serve.admission import ADMITTED, BLOCKED, AdmissionQueue
@@ -133,6 +134,9 @@ class ServeDriver(LogMixin):
         preempt: bool = False,
         preempt_timeout: float = 5.0,
         autoscale: Optional[AutoscaleConfig] = None,
+        tracer=None,
+        registry=None,
+        clock: Optional[ObsClock] = None,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
@@ -156,7 +160,19 @@ class ServeDriver(LogMixin):
                     f"{autoscale.g_min}"
                 )
         self.sessions = list(sessions)
-        self.slo = slo or SloMeter()
+        #: Observability plane (round 14).  ``tracer`` records the
+        #: causal chain of every admitted job (arrival → admission →
+        #: routing → injection → placement → completion) plus batcher
+        #: flushes and autoscaler actions on the same timeline —
+        #: ``None`` is the zero-cost NULL tracer, under which the
+        #: service is bit-identical to the untraced driver (pinned by
+        #: tests/test_obs.py replay parity).  ``registry`` receives the
+        #: unified metrics snapshot at :meth:`report`.  ``clock`` is
+        #: the one injected wall source the SLO meter reports through.
+        self.tracer = tracer or NULL_TRACER
+        self.registry = registry
+        self.clock = clock or ObsClock()
+        self.slo = slo or SloMeter(clock=self.clock)
         self.queue = AdmissionQueue(
             queue_depth, backpressure, self.slo,
             tier_reserve=tier_reserve, tier_policies=tier_policies,
@@ -209,6 +225,14 @@ class ServeDriver(LogMixin):
             s.slot = slot
             s.slo = self.slo  # one service-wide SLO meter
             s.scheduler.slo = self.slo  # dead-letter sheds land here too
+            s.tracer = self.tracer  # one service-wide trace timeline
+            s.scheduler.tracer = self.tracer
+            # ONE wall epoch service-wide: the sessions' run meters
+            # report through the driver's clock, so their wall
+            # snapshots agree with the SLO meter's (the round-14
+            # clock-unification contract).
+            s.clock = self.clock
+            s.meter.clock = self.clock
 
     # -- gate + coordination ----------------------------------------------
     def wait_released(self, session: ServeSession, t: float,
@@ -273,6 +297,9 @@ class ServeDriver(LogMixin):
             key = "failed_jobs" if failed else "completed"
             self.slo.count(key)
             self.slo.count_tier(tier, key)
+            if self.tracer.enabled:
+                self._stage(app, "failed" if failed else "completed",
+                            sim=sim_now, session=session.label)
             self._reoffer_spilled(after_sim=sim_now)
             self._cv.notify_all()
         for fn in self._completion_hooks:
@@ -362,9 +389,24 @@ class ServeDriver(LogMixin):
                 key = "completed" if app.is_finished else "failed_jobs"
                 self.slo.count(key)
                 self.slo.count_tier(tier, key)
+                if self.tracer.enabled:
+                    # Anchored at the dead session's sim clock: a
+                    # sim-less terminal would export on the wall
+                    # fallback BEFORE its sim-anchored parent and fail
+                    # the obs_report --check parent-ordering gate.
+                    self._stage(
+                        app, "completed" if app.is_finished else "failed",
+                        sim=dead.env.now, session=dead.label,
+                        late_reap=True,
+                    )
                 continue
             ts = getattr(app, "_serve_admit_ts", 0.0)
             clone = app.clone()
+            trace = self._trace_of(app)
+            if trace is not None:
+                # The clone continues the SAME causal chain — its
+                # restart stages parent-link onto the dead session's.
+                clone._obs_trace = trace
             if rec is not None:
                 rec.app = clone
                 rec.requested = False
@@ -390,6 +432,8 @@ class ServeDriver(LogMixin):
                 else max(arr.ts, self._next_tick(floor_t))
             )
             self.slo.count("requeued")
+            if self.tracer.enabled:
+                self._stage(arr.app, "requeued", sim=ts)
             self._route(
                 JobArrival(ts, arr.app, tier=arr.tier, tenant=arr.tenant)
             )
@@ -455,6 +499,10 @@ class ServeDriver(LogMixin):
         new._driver = self
         new.slo = self.slo
         new.scheduler.slo = self.slo
+        new.tracer = self.tracer
+        new.scheduler.tracer = self.tracer
+        new.clock = self.clock  # one wall epoch service-wide
+        new.meter.clock = self.clock
         client = None
         if self.batcher is not None:
             client = self.batcher.respawn_client()
@@ -666,6 +714,9 @@ class ServeDriver(LogMixin):
             self.queue.release()
             self.slo.count("preempted")
             self.slo.count_tier(rec.tier, "preempted")
+            if self.tracer.enabled:
+                self._stage(app, "preempted", sim=sim_now,
+                            tier=rec.tier)
             # Requeue-to-spill with the ORIGINAL arrival timestamp; the
             # re-offer path floors it to the next grid tick when it
             # finally readmits.  The app object is reused as-is — it
@@ -720,6 +771,9 @@ class ServeDriver(LogMixin):
                     tier=arr.tier, tenant=arr.tenant,
                 )
             self.queue.readmit(arr)
+            if self.tracer.enabled:
+                self._stage(arr.app, "readmitted", sim=arr.ts,
+                            tier=arr.tier)
             self._register_inflight(arr)
             self._route(arr)
 
@@ -754,12 +808,45 @@ class ServeDriver(LogMixin):
         rec = self._inflight.get(arrival.app.id)
         if rec is not None:
             rec.session = target
+        if self.tracer.enabled:
+            # Emitted BEFORE the inbox put: the session's "injected"
+            # stage happens-after this append, so the chain order is
+            # routed → injected on every interleaving.  Anchored at the
+            # arrival's sim instant — the routing decision is part of
+            # the admission instant on the sim timeline.
+            self._stage(arrival.app, "routed", sim=arrival.ts,
+                        session=target.label, slot=target.slot)
         target.offer(arrival)
         self._cv.notify_all()
 
     # -- admission ---------------------------------------------------------
+    def _trace_of(self, app) -> Optional[int]:
+        return getattr(app, "_obs_trace", None)
+
+    def _stage(self, app, name: str, sim: Optional[float] = None,
+               **args) -> None:
+        """Causal-trace hook: one parent-linked stage of ``app``'s job
+        chain (no-op when tracing is off or the app carries no trace —
+        e.g. jobs admitted before a tracer was attached)."""
+        trace = getattr(app, "_obs_trace", None)
+        if trace is not None:
+            self.tracer.stage(trace, name, sim=sim, **args)
+
     def _admit(self, arrival: JobArrival) -> None:
         tier = int(getattr(arrival, "tier", 0))
+        if self.tracer.enabled:
+            # Trace ids are allocated in admission order (the producer
+            # thread is the only allocator), so replaying a seeded
+            # stream yields the same ids.  The id rides on the app —
+            # every later layer (router, session, scheduler) links its
+            # stages through it.
+            trace = self.tracer.new_trace()
+            arrival.app._obs_trace = trace
+            self.tracer.stage(
+                trace, "arrived", sim=arrival.ts, tier=tier,
+                tenant=getattr(arrival, "tenant", "default"),
+                app=arrival.app.id,
+            )
         with self._cv:
             # An arrival at ts proves the stream silent before ts: time
             # may flow to it even while admission deliberates.
@@ -772,6 +859,8 @@ class ServeDriver(LogMixin):
             ):
                 self._preempt_for(tier)
             status = self.queue.offer(arrival)
+            if self.tracer.enabled:
+                self._stage(arrival.app, status, sim=arrival.ts)
             try:
                 self._waiting_tier = tier
                 while (
@@ -795,6 +884,9 @@ class ServeDriver(LogMixin):
                     if self.queue.has_room(tier):
                         self.queue.readmit(arrival)
                         status = ADMITTED
+                        if self.tracer.enabled:
+                            self._stage(arrival.app, "admitted",
+                                        sim=arrival.ts, after="blocked")
             finally:
                 self._waiting_tier = None
             if status == ADMITTED:
@@ -897,7 +989,8 @@ class ServeDriver(LogMixin):
                 from pivot_tpu.sched.batch import DispatchBatcher
 
                 self.batcher = DispatchBatcher(
-                    len(self.sessions), flush_after=self.flush_after
+                    len(self.sessions), flush_after=self.flush_after,
+                    tracer=self.tracer,
                 )
                 clients = [self.batcher.client() for _ in self.sessions]
                 for s, c in zip(self.sessions, clients):
@@ -964,6 +1057,34 @@ class ServeDriver(LogMixin):
             raise errors[0]
         return self.report()
 
+    def publish_metrics(self, registry=None) -> Optional[dict]:
+        """Publish the service's full metrics state into the unified
+        registry (``pivot_tpu.obs.MetricsRegistry``) — the SLO meter
+        (counters, tiers, distributions, dispatch mix), the autoscaler
+        action log, and per-session run meters — and return the JSON
+        snapshot.  Uses the driver's attached registry when none is
+        passed; None when neither exists."""
+        registry = registry or self.registry
+        if registry is None:
+            return None
+        self.slo.publish_metrics(registry)
+        if self._autoscaler is not None:
+            registry.counter(
+                "pivot_autoscale_actions_total",
+                "autoscaler actions (grow/shrink/preempt)",
+                labelnames=("action",),
+            )
+            actions: Dict[str, int] = {}
+            for evt in self._autoscaler.events:
+                actions[evt["action"]] = actions.get(evt["action"], 0) + 1
+            for action, n in actions.items():
+                registry.set(
+                    "pivot_autoscale_actions_total", n, action=action
+                )
+        for s in self.sessions + self._retired:
+            s.meter.publish_metrics(registry, run=s.label)
+        return registry.to_json()
+
     def report(self) -> dict:
         return {
             "sessions": len(self.sessions),
@@ -1001,6 +1122,12 @@ class ServeDriver(LogMixin):
             "per_session": [
                 s.summary() for s in self.sessions + self._retired
             ],
+            # The unified registry snapshot (round 14): present exactly
+            # when the driver was built with a MetricsRegistry.
+            **(
+                {"metrics": self.publish_metrics()}
+                if self.registry is not None else {}
+            ),
         }
 
     def audit(self, context: str = "serve drain") -> None:

@@ -39,6 +39,7 @@ import numpy as np
 
 from pivot_tpu.des import Environment
 from pivot_tpu.infra.meter import Meter, SloMeter
+from pivot_tpu.obs import NULL_TRACER, ObsClock
 from pivot_tpu.sched import GlobalScheduler
 from pivot_tpu.utils import LogMixin
 
@@ -88,12 +89,20 @@ class ServeSession(LogMixin):
         slo: Optional[SloMeter] = None,
         retry=None,
         breaker=None,
+        clock: Optional[ObsClock] = None,
     ):
         self.label = label
         self.policy = policy
         self.seed = seed
         self.interval = interval
-        self.slo = slo or SloMeter()
+        #: One injected obs wall clock for everything this session
+        #: meters (round 14): the run Meter and the fallback SLO meter
+        #: share it, so their wall snapshots agree exactly.
+        self.clock = clock or ObsClock()
+        self.slo = slo or SloMeter(clock=self.clock)
+        #: Causal trace timeline — swapped for the service-wide tracer
+        #: by the driver (like ``slo``); NULL = zero-cost.
+        self.tracer = NULL_TRACER
         self.error: Optional[BaseException] = None
         self.completed: List = []
         self.failed: List = []  # dead-lettered (retry-governed) apps
@@ -125,7 +134,7 @@ class ServeSession(LogMixin):
         # Mirror ExperimentRun.run()'s construction exactly — the parity
         # contract depends on the two modes building identical worlds.
         self.env = Environment()
-        self.meter = Meter(self.env, cluster.meta)
+        self.meter = Meter(self.env, cluster.meta, clock=self.clock)
         self.cluster = cluster.clone(self.env, self.meter)
         self.scheduler = GlobalScheduler(
             self.env,
@@ -171,6 +180,15 @@ class ServeSession(LogMixin):
             # service-wide SLO meter after construction.
             self.slo.record_decision(dt, int(arr.shape[0]),
                                      int((arr >= 0).sum()))
+            if self.tracer.enabled:
+                # The dispatch lane of the service timeline: one span
+                # per placement call (batcher wait included) — what
+                # obs_report's top-N slow dispatches ranks.
+                self.tracer.record_span(
+                    "dispatch", "place", dt, sim=ctx.env_now,
+                    session=self.label, n_tasks=int(arr.shape[0]),
+                    n_placed=int((arr >= 0).sum()),
+                )
             # Per-tier attribution: the batch's latency counts toward
             # every tier with work in it (mixed-tier ticks are the
             # norm — a tier's histogram must see the latency its jobs
@@ -297,6 +315,14 @@ class ServeSession(LogMixin):
         app._serve_admit_ts = arrival.ts
         app._serve_tier = int(getattr(arrival, "tier", 0))
         app._serve_tenant = getattr(arrival, "tenant", "default")
+        if self.tracer.enabled:
+            trace = getattr(app, "_obs_trace", None)
+            if trace is not None:
+                self.tracer.stage(
+                    trace, "injected",
+                    sim=max(arrival.ts, env.now),
+                    session=self.label, late=arrival.ts < env.now,
+                )
         if arrival.ts >= env.now:
             # The callback handle rides on the app so an in-queue
             # preemption arriving before it fires can cancel the

@@ -28,15 +28,33 @@ from __future__ import annotations
 
 import contextlib
 import threading
-from typing import Iterator, List
+from typing import Callable, Iterator, List
 
-__all__ = ["CompileCounter", "count_compiles"]
+__all__ = [
+    "CompileCounter",
+    "add_observer",
+    "count_compiles",
+    "remove_observer",
+]
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 
+_KIND_OF = {
+    _COMPILE_EVENT: "backend_compile",
+    _TRACE_EVENT: "jaxpr_trace",
+}
+
 _lock = threading.Lock()
 _active: List["CompileCounter"] = []
+#: Observer fan-out (round 14, ``pivot_tpu.obs``): callables invoked
+#: with the event *kind* ("backend_compile" / "jaxpr_trace") on every
+#: compile event — how a recompile becomes a registry counter bump and
+#: a visible instant on the trace timeline instead of only a test
+#: assertion.  The JAX listener is process-permanent; this list is not
+#: (``remove_observer``).  Observers run under the module lock — keep
+#: them O(1) and non-reentrant (no jax calls).
+_observers: List[Callable[[str], None]] = []
 _installed = False
 
 
@@ -62,12 +80,33 @@ def _install_listener() -> None:
         import jax
 
         def _on_event(event: str, duration_secs: float, **kw) -> None:
+            kind = _KIND_OF.get(event)
             with _lock:
                 for counter in _active:
                     counter._record(event)
+                if kind is not None:
+                    for fn in _observers:
+                        fn(kind)
 
         jax.monitoring.register_event_duration_secs_listener(_on_event)
         _installed = True
+
+
+def add_observer(fn: Callable[[str], None]) -> None:
+    """Register a compile-event observer (called with the event kind,
+    under the module lock).  Installs the process-wide JAX listener on
+    first use; pair with :func:`remove_observer`."""
+    _install_listener()
+    with _lock:
+        _observers.append(fn)
+
+
+def remove_observer(fn: Callable[[str], None]) -> None:
+    with _lock:
+        try:
+            _observers.remove(fn)
+        except ValueError:
+            pass
 
 
 @contextlib.contextmanager

@@ -1,156 +1,20 @@
-"""Structured event tracing + device profiling.
+"""Compatibility shim over :mod:`pivot_tpu.obs.tracer`.
 
-The reference's only observability is per-class debug logging with sim
-timestamps (``util.py:5-16``) and the meter's end-of-run JSON dumps
-(``resources/meter.py:108-133``).  This module adds what SURVEY.md §5
-prescribes for the rebuild: a structured, chronological event trace of the
-simulation (scheduler ticks, policy latency, task lifecycle) that can be
-written as JSONL or as a Chrome ``chrome://tracing`` / Perfetto file, plus
-a ``jax.profiler`` context for capturing device (TPU) traces around the
-kernel hot path.
-
-Events carry BOTH clocks: ``sim`` (discrete-event virtual seconds) and
-``wall`` (host seconds since tracer creation) — the sim timeline shows
-*what the simulated system did*; the wall timeline shows *what the
-framework paid to compute it* (policy/kernel latency per tick).
-
-Tracing is opt-in and zero-cost when disabled: the module-level
-``NULL_TRACER`` short-circuits ``emit``/``span`` before touching any
-clock.
+The round-1 seed tracer lived here; round 14 grew it into the
+observability plane (``pivot_tpu/obs/`` — causal task tracing, the
+unified metrics registry, Perfetto export).  Every existing import
+(``from pivot_tpu.utils.trace import Tracer, NULL_TRACER,
+device_profile``) keeps working through this module; new code should
+import from :mod:`pivot_tpu.obs` directly.
 """
 
 from __future__ import annotations
 
-import contextlib
-import json
-import time
-from typing import Any, Dict, List, Optional
+from pivot_tpu.obs.tracer import (  # noqa: F401 — re-exports
+    NULL_TRACER,
+    TERMINAL_STAGES,
+    Tracer,
+    device_profile,
+)
 
-__all__ = ["Tracer", "NULL_TRACER", "device_profile"]
-
-
-class Tracer:
-    """Append-only structured event log with sim + wall timestamps."""
-
-    __slots__ = ("enabled", "events", "_wall0")
-
-    def __init__(self, enabled: bool = True):
-        self.enabled = enabled
-        self.events: List[Dict[str, Any]] = []
-        self._wall0 = time.perf_counter()
-
-    # -- recording -------------------------------------------------------
-    def emit(self, cat: str, name: str, sim: float, **args: Any) -> None:
-        """Record an instant event at sim time ``sim``."""
-        if not self.enabled:
-            return
-        evt: Dict[str, Any] = {
-            "cat": cat,
-            "name": name,
-            "sim": sim,
-            "wall": time.perf_counter() - self._wall0,
-        }
-        if args:
-            evt["args"] = args
-        self.events.append(evt)
-
-    @contextlib.contextmanager
-    def span(self, cat: str, name: str, sim: float, **args: Any):
-        """Record a wall-clock duration span (e.g. one policy invocation).
-
-        The span's ``dur`` is *wall* seconds — sim time does not advance
-        inside a synchronous block.  Mutations to ``args`` made inside the
-        block (e.g. recording the number of placed tasks once known) are
-        captured because the dict is attached at exit.
-        """
-        if not self.enabled:
-            yield args
-            return
-        t0 = time.perf_counter()
-        try:
-            yield args
-        finally:
-            evt = {
-                "cat": cat,
-                "name": name,
-                "sim": sim,
-                "wall": t0 - self._wall0,
-                "dur": time.perf_counter() - t0,
-            }
-            if args:
-                evt["args"] = args
-            self.events.append(evt)
-
-    # -- serialization ---------------------------------------------------
-    def save_jsonl(self, path: str) -> None:
-        with open(path, "w") as f:
-            for evt in self.events:
-                f.write(json.dumps(evt) + "\n")
-
-    def save_chrome(self, path: str, timeline: str = "sim") -> None:
-        """Write a Chrome/Perfetto trace (``chrome://tracing`` loadable).
-
-        ``timeline='sim'`` places events at their simulated time (µs = sim
-        seconds × 1e6, so 1 simulated second reads as 1 s in the viewer);
-        ``timeline='wall'`` places them at host time — use this to inspect
-        where the framework itself spends wall clock (policy spans carry
-        real durations on either timeline).
-        """
-        assert timeline in ("sim", "wall")
-        out = []
-        for evt in self.events:
-            ts = evt[timeline] * 1e6
-            rec: Dict[str, Any] = {
-                "name": evt["name"],
-                "cat": evt["cat"],
-                "pid": 0,
-                "tid": evt["cat"],
-                "ts": ts,
-            }
-            if "dur" in evt:
-                rec["ph"] = "X"
-                rec["dur"] = max(evt["dur"] * 1e6, 1.0)
-            else:
-                rec["ph"] = "i"
-                rec["s"] = "t"
-            if "args" in evt:
-                rec["args"] = evt["args"]
-            out.append(rec)
-        with open(path, "w") as f:
-            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
-
-    # -- analysis helpers ------------------------------------------------
-    def by_category(self, cat: str) -> List[Dict[str, Any]]:
-        return [e for e in self.events if e["cat"] == cat]
-
-    def total_dur(self, cat: str, name: Optional[str] = None) -> float:
-        """Σ wall-clock duration of matching spans (e.g. total policy time)."""
-        return sum(
-            e.get("dur", 0.0)
-            for e in self.events
-            if e["cat"] == cat and (name is None or e["name"] == name)
-        )
-
-
-NULL_TRACER = Tracer(enabled=False)
-
-
-@contextlib.contextmanager
-def device_profile(logdir: Optional[str]):
-    """Capture a ``jax.profiler`` device trace around the enclosed block.
-
-    The resulting TensorBoard-loadable trace shows XLA/Pallas kernel
-    timings on the accelerator — the microscope for the decision-kernel
-    hot path.  No-op when ``logdir`` is falsy (so call sites can thread an
-    optional CLI flag straight through).
-    """
-    if not logdir:
-        yield
-        return
-    import jax
-
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = ["Tracer", "NULL_TRACER", "TERMINAL_STAGES", "device_profile"]

@@ -782,12 +782,12 @@ def test_new_rule_suppression_round_trip(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_cli_list_rules_names_all_eight(capsys):
+def test_cli_list_rules_names_all_nine(capsys):
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in (
         "backend-parity", "determinism", "thread-guard", "host-sync",
-        "retrace", "donation", "dtype", "pallas-budget",
+        "retrace", "donation", "dtype", "pallas-budget", "obs-boundary",
     ):
         assert rule in out, f"{rule} missing from --list-rules"
 

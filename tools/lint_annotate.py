@@ -10,37 +10,82 @@ the pipe ``graftcheck --json | lint_annotate`` preserves the lint's
 pass/fail contract (both ends of the pipe fail on findings; with
 ``pipefail`` either is enough).
 
+Hardening (round 14): the payload is schema-validated (a truncated or
+crashed upstream can no longer read as "clean"), findings missing
+location fields are rendered with placeholders instead of crashing the
+annotator, and ``--require rule[,rule...]`` asserts the named passes
+actually RAN in the upstream invocation — CI pins the obs-boundary
+rule (and can pin any future pass) so a filtered ``--rules`` run can
+never silently skip a gate.
+
 Usage::
 
     python tools/graftcheck.py --json | python tools/lint_annotate.py
+    python tools/graftcheck.py --json | \
+        python tools/lint_annotate.py --require obs-boundary
 """
 
+import argparse
 import json
 import os
 import sys
 
 
-def main() -> int:
-    payload = json.load(sys.stdin)
-    findings = payload.get("findings", [])
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="lint_annotate")
+    parser.add_argument(
+        "--require", default="",
+        help="comma-separated rules that must appear in the payload's "
+        "executed-rule list; exit 2 when any is missing (guards "
+        "against a filtered run silently skipping a CI gate)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        payload = json.load(sys.stdin)
+    except json.JSONDecodeError as exc:
+        print(f"lint_annotate: stdin is not JSON ({exc}) — did "
+              "graftcheck crash upstream?", file=sys.stderr)
+        return 2
+    if not isinstance(payload, dict) or not isinstance(
+        payload.get("findings", None), list
+    ):
+        print("lint_annotate: payload missing a findings list — "
+              "not a graftcheck --json document", file=sys.stderr)
+        return 2
+    ran = payload.get("rules", [])
+    required = [r.strip() for r in args.require.split(",") if r.strip()]
+    missing = [r for r in required if r not in ran]
+    if missing:
+        print(
+            f"lint_annotate: required rule(s) {missing} did not run "
+            f"(executed: {ran}) — a filtered graftcheck invocation is "
+            "skipping a pinned CI gate",
+            file=sys.stderr,
+        )
+        return 2
+    findings = payload["findings"]
     annotate = os.environ.get("GITHUB_ACTIONS") == "true"
     for f in findings:
+        path = f.get("path", "<unknown>")
+        line = f.get("line", 0)
+        rule = f.get("rule", "?")
+        message = f.get("message", "")
         print(
-            f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}",
+            f"{path}:{line}: [{rule}] {message}",
             file=sys.stderr,
         )
         if annotate:
-            message = f["message"].replace("\n", " ")
+            message = str(message).replace("\n", " ")
             print(
-                f"::error file={f['path']},line={f['line']},"
-                f"title=graftcheck[{f['rule']}]::{message}"
+                f"::error file={path},line={line},"
+                f"title=graftcheck[{rule}]::{message}"
             )
     if findings:
         print(
             f"graftcheck: {len(findings)} finding(s)", file=sys.stderr
         )
         return 1
-    print(f"graftcheck: clean ({len(payload.get('rules', []))} pass(es))")
+    print(f"graftcheck: clean ({len(ran)} pass(es))")
     return 0
 
 

@@ -1,0 +1,452 @@
+#!/usr/bin/env python3
+"""Render or validate an observability-plane trace (round 14).
+
+Input: a Perfetto/Chrome ``trace_event`` JSON written by
+``Tracer.save_perfetto`` (the ``serve --trace-out`` / ``ExperimentRun``
+artifact) or a raw ``.jsonl`` event log from ``Tracer.save_jsonl``.
+
+Two modes:
+
+  * **report** (default) — the human view of a run:
+      - causal-chain summary: jobs traced, chains complete vs broken,
+        terminal-stage mix (completed / failed / shed / dead_letter);
+      - per-stage latency breakdown: sim-time spent between consecutive
+        chain stages (arrived→admitted→routed→injected→placed→…),
+        aggregated p50/p95/max per transition;
+      - per-tier SLO attribution: arrival→terminal sim sojourn
+        percentiles per tier;
+      - top-N slow dispatches: the longest wall-duration ``dispatch``
+        spans (placement calls / batcher flushes);
+      - in-flight depth timeline: admissions minus terminations over
+        sim time (bucketed sparkline);
+      - event-category census (ticks, chaos, market, autoscale,
+        compile instants).
+
+  * **--check** — the CI gate (exit 1 on violation): the file is
+    loadable ``trace_event`` JSON; every event carries name/ph/ts/pid/
+    tid with a numeric non-negative ts; ``X`` events carry a
+    non-negative dur; ``b``/``e`` async pairs match per id; ts is
+    monotone non-decreasing in file order (the exporter sorts; a
+    violation means a clock went backwards); every ``parent`` link
+    resolves to an earlier event of the SAME trace; and every trace
+    that recorded an ``arrived`` stage terminates in exactly one
+    terminal stage (completed/failed/shed/dead_letter).
+
+Usage::
+
+    python tools/obs_report.py run.perfetto.json
+    python tools/obs_report.py --check run.perfetto.json
+    python tools/obs_report.py --top 5 --json run.perfetto.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+# Stdlib-only by design: CI runs this gate without importing jax.
+TERMINAL_STAGES = {"completed", "failed", "shed", "dead_letter"}
+
+_ALLOWED_PH = {"X", "i", "I", "b", "e", "n", "M"}
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    """Normalize either artifact into one event-dict list.
+
+    Normalized keys: name, cat, ph, ts (µs, export timeline), dur (µs,
+    optional), sim (s, optional), trace / parent / id (optional).
+    """
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        payload = json.loads(text)
+    except json.JSONDecodeError:
+        payload = None  # more than one JSON document: the JSONL form
+    if payload is not None:
+        events = (
+            payload.get("traceEvents")
+            if isinstance(payload, dict) else None
+        )
+        if not isinstance(events, list):
+            raise ValueError(
+                f"{path}: no traceEvents list (not a trace_event file)"
+            )
+        out = []
+        for e in events:
+            rec = dict(e)
+            args = e.get("args") or {}
+            for key in ("trace", "parent", "id", "sim"):
+                if key in args and key not in rec:
+                    rec[key] = args[key]
+            out.append(rec)
+        return out
+    # JSONL raw events: synthesize the export view (sim-µs ts).
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        e = json.loads(line)
+        rec = dict(e)
+        rec.setdefault("ph", "X" if "dur" in e else "i")
+        base = e.get("sim", e.get("wall", 0.0))
+        rec["ts"] = base * 1e6
+        if "dur" in e:
+            rec["dur"] = e["dur"] * 1e6
+        rec.setdefault("pid", 0)
+        rec.setdefault("tid", e.get("cat", "events"))
+        out.append(rec)
+    # Same contract as the Perfetto exporter: a sorted timeline.
+    out.sort(key=lambda r: r["ts"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# --check
+# ---------------------------------------------------------------------------
+
+def check_events(
+    events: List[Dict[str, Any]],
+    chains: Optional[Dict[int, List[Dict[str, Any]]]] = None,
+) -> List[str]:
+    """Structural + causal validation.  ``chains`` (optional) reuses a
+    chain map the caller already built — main's --check path builds it
+    once and shares it instead of walking every parent link twice."""
+    errors: List[str] = []
+    last_ts: Optional[float] = None
+    by_id: Dict[int, Dict[str, Any]] = {}
+    async_open: Dict[str, int] = {}
+    for i, e in enumerate(events):
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            if field not in e:
+                errors.append(f"event {i}: missing field {field!r}")
+        ph = e.get("ph")
+        if ph not in _ALLOWED_PH:
+            errors.append(f"event {i}: unknown ph {ph!r}")
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            errors.append(f"event {i}: bad ts {ts!r}")
+            continue
+        if last_ts is not None and ts < last_ts:
+            errors.append(
+                f"event {i}: ts {ts} < previous {last_ts} — the "
+                "exporter emits sorted timelines; a decrease means a "
+                "clock went backwards"
+            )
+        last_ts = ts
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(f"event {i}: X span with bad dur {dur!r}")
+        elif ph == "b":
+            async_open[str(e.get("id"))] = (
+                async_open.get(str(e.get("id")), 0) + 1
+            )
+        elif ph == "e":
+            key = str(e.get("id"))
+            if async_open.get(key, 0) <= 0:
+                errors.append(f"event {i}: async end id={key} before begin")
+            else:
+                async_open[key] -= 1
+        if "id" in e and isinstance(e.get("id"), int):
+            by_id[e["id"]] = e
+    for key, n in sorted(async_open.items()):
+        if n != 0:
+            errors.append(f"async span id={key}: {n} unmatched begin(s)")
+    # Parent links: resolve, same trace, non-decreasing ts.
+    for i, e in enumerate(events):
+        parent = e.get("parent")
+        if parent is None:
+            continue
+        p = by_id.get(parent)
+        if p is None:
+            errors.append(
+                f"event {i} ({e.get('name')}): parent {parent} not in file"
+            )
+            continue
+        if p.get("trace") != e.get("trace"):
+            errors.append(
+                f"event {i}: parent {parent} belongs to trace "
+                f"{p.get('trace')} != {e.get('trace')}"
+            )
+        if p.get("ts", 0) > e.get("ts", 0):
+            errors.append(
+                f"event {i}: parent {parent} is later on the timeline"
+            )
+    # Causal completeness: every arrived trace must terminate once.
+    if chains is None:
+        chains = build_chains(events)
+    for trace, chain in sorted(chains.items()):
+        names = [c.get("name") for c in chain]
+        if "arrived" not in names:
+            continue
+        terminals = [n for n in names if n in TERMINAL_STAGES]
+        if len(terminals) == 0:
+            errors.append(
+                f"trace {trace}: arrived but never reached a terminal "
+                f"stage (chain: {' -> '.join(map(str, names))})"
+            )
+        elif len(terminals) > 1:
+            errors.append(
+                f"trace {trace}: {len(terminals)} terminal stages "
+                f"({terminals}) — a job must terminate exactly once"
+            )
+    return errors
+
+
+def build_chains(
+    events: List[Dict[str, Any]]
+) -> Dict[int, List[Dict[str, Any]]]:
+    """trace id -> its stage events, reconstructed by WALKING PARENT
+    LINKS back from each chain tail (not by grouping): a broken link
+    surfaces as a truncated chain, which --check flags."""
+    staged = [e for e in events if e.get("trace") is not None]
+    by_id = {e["id"]: e for e in staged if isinstance(e.get("id"), int)}
+    # Chain tails: events no other event claims as parent.
+    claimed = {
+        e["parent"] for e in staged if e.get("parent") is not None
+    }
+    chains: Dict[int, List[Dict[str, Any]]] = {}
+    for e in staged:
+        if e.get("id") in claimed:
+            continue
+        chain = []
+        cur: Optional[Dict[str, Any]] = e
+        seen = set()
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            chain.append(cur)
+            parent = cur.get("parent")
+            cur = by_id.get(parent) if parent is not None else None
+        chain.reverse()
+        trace = e["trace"]
+        # Keep the longest chain per trace (a broken link creates a
+        # second, shorter tail — check_events reports the breakage).
+        if trace not in chains or len(chain) > len(chains[trace]):
+            chains[trace] = chain
+    return chains
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def _pct(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(int(q / 100.0 * len(s)), len(s) - 1)
+    return s[idx]
+
+
+def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
+    chains = build_chains(events)
+    terminal_mix: Dict[str, int] = {}
+    complete = 0
+    transitions: Dict[str, List[float]] = {}
+    tier_sojourn: Dict[str, List[float]] = {}
+    for trace, chain in chains.items():
+        names = [c.get("name") for c in chain]
+        term = next((n for n in reversed(names) if n in TERMINAL_STAGES),
+                    None)
+        if term is not None:
+            terminal_mix[term] = terminal_mix.get(term, 0) + 1
+            if "arrived" in names:
+                complete += 1
+        # Stage-to-stage sim latency along the chain.
+        for a, b in zip(chain, chain[1:]):
+            if "sim" in a and "sim" in b:
+                key = f"{a['name']}->{b['name']}"
+                transitions.setdefault(key, []).append(
+                    b["sim"] - a["sim"]
+                )
+        arrived = next((c for c in chain if c.get("name") == "arrived"),
+                       None)
+        if arrived is not None and term in ("completed", "failed"):
+            tail = chain[-1]
+            if "sim" in arrived and "sim" in tail:
+                tier = str(
+                    (arrived.get("args") or {}).get(
+                        "tier", arrived.get("tier", 0)
+                    )
+                )
+                tier_sojourn.setdefault(tier, []).append(
+                    tail["sim"] - arrived["sim"]
+                )
+    dispatches = sorted(
+        (
+            e for e in events
+            if e.get("ph") == "X" and e.get("cat") == "dispatch"
+        ),
+        key=lambda e: -e.get("dur", 0.0),
+    )
+    # In-flight depth over sim time (admissions − terminations).  A
+    # terminal only decrements when its trace actually admitted —
+    # shed-at-the-door jobs never held capacity, and counting their
+    # terminals would push the curve negative on exactly the overload
+    # runs where depth matters.
+    deltas: List[tuple] = []
+    for chain in chains.values():
+        holding = 0
+        for c in chain:
+            if "sim" not in c:
+                continue
+            if c["name"] in ("admitted", "readmitted"):
+                holding += 1
+                deltas.append((c["sim"], +1))
+            elif c["name"] in TERMINAL_STAGES or c["name"] == "preempted":
+                if holding > 0:
+                    holding -= 1
+                    deltas.append((c["sim"], -1))
+    deltas.sort()
+    depth, peak = 0, 0
+    depth_curve = []
+    for t, d in deltas:
+        depth += d
+        peak = max(peak, depth)
+        depth_curve.append([round(t, 3), depth])
+    cats: Dict[str, int] = {}
+    for e in events:
+        cats[str(e.get("cat"))] = cats.get(str(e.get("cat")), 0) + 1
+    return {
+        "events": len(events),
+        "jobs_traced": len(chains),
+        "chains_complete": complete,
+        "terminal_mix": dict(sorted(terminal_mix.items())),
+        "stage_latency_sim_s": {
+            key: {
+                "n": len(vals),
+                "p50": round(_pct(vals, 50), 6),
+                "p95": round(_pct(vals, 95), 6),
+                "max": round(max(vals), 6),
+            }
+            for key, vals in sorted(transitions.items())
+        },
+        "tier_sojourn_sim_s": {
+            tier: {
+                "n": len(vals),
+                "p50": round(_pct(vals, 50), 6),
+                "p99": round(_pct(vals, 99), 6),
+            }
+            for tier, vals in sorted(tier_sojourn.items())
+        },
+        "top_slow_dispatches": [
+            {
+                "name": e.get("name"),
+                "dur_ms": round(e.get("dur", 0.0) / 1e3, 4),
+                "ts_ms": round(e.get("ts", 0.0) / 1e3, 4),
+                **{
+                    k: v
+                    for k, v in (e.get("args") or {}).items()
+                    if k in ("session", "group", "n_tasks", "n_placed")
+                },
+            }
+            for e in dispatches[:top]
+        ],
+        "inflight_depth": {
+            "peak": peak,
+            "final": depth,
+            "curve_tail": depth_curve[-10:],
+        },
+        "event_categories": dict(sorted(cats.items())),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="obs_report",
+        description="render or validate an observability-plane trace "
+        "(Perfetto JSON from serve --trace-out / ExperimentRun, or "
+        "raw Tracer JSONL)",
+    )
+    parser.add_argument("trace", help="trace file (.json or .jsonl)")
+    parser.add_argument(
+        "--check", action="store_true",
+        help="validate structure + causal completeness; exit 1 on any "
+        "violation (the CI smoke gate)",
+    )
+    parser.add_argument(
+        "--top", type=int, default=10,
+        help="slow-dispatch rows in the report (default 10)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable report on stdout",
+    )
+    args = parser.parse_args(argv)
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"obs_report: cannot load {args.trace}: {exc}",
+              file=sys.stderr)
+        return 1
+    if args.check:
+        chains = build_chains(events)
+        errors = check_events(events, chains)
+        if errors:
+            for err in errors:
+                print(f"obs_report: {err}", file=sys.stderr)
+            print(
+                f"obs_report: {len(errors)} violation(s) in {args.trace}",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"obs_report: {args.trace} OK — {len(events)} events, "
+            f"{len(chains)} causal chain(s) verified"
+        )
+        return 0
+    report = build_report(events, top=args.top)
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    print(f"== obs report: {args.trace} ==")
+    print(
+        f"events: {report['events']}  jobs traced: "
+        f"{report['jobs_traced']}  complete chains: "
+        f"{report['chains_complete']}"
+    )
+    print(f"terminal mix: {report['terminal_mix']}")
+    print("-- per-stage sim latency (s) --")
+    for key, row in report["stage_latency_sim_s"].items():
+        print(
+            f"  {key:34s} n={row['n']:<5d} p50={row['p50']:<10g} "
+            f"p95={row['p95']:<10g} max={row['max']:g}"
+        )
+    if report["tier_sojourn_sim_s"]:
+        print("-- per-tier sojourn (sim s) --")
+        for tier, row in report["tier_sojourn_sim_s"].items():
+            print(
+                f"  tier {tier}: n={row['n']} p50={row['p50']:g} "
+                f"p99={row['p99']:g}"
+            )
+    if report["top_slow_dispatches"]:
+        print(f"-- top {args.top} slow dispatches (wall ms) --")
+        for row in report["top_slow_dispatches"]:
+            extra = {
+                k: v for k, v in row.items()
+                if k not in ("name", "dur_ms", "ts_ms")
+            }
+            print(f"  {row['dur_ms']:>10.3f} ms  {row['name']}  {extra}")
+    print(
+        f"in-flight depth: peak={report['inflight_depth']['peak']} "
+        f"final={report['inflight_depth']['final']}"
+    )
+    print(f"categories: {report['event_categories']}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # Downstream pager/head closed the pipe — normal CLI usage.
+        os_devnull = open("/dev/null", "w")
+        sys.stdout = os_devnull
+        sys.exit(0)
