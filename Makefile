@@ -8,25 +8,29 @@ SHELL := /bin/bash
 # The per-PR resilience gate: quick chaos soak, the graftcheck static-
 # analysis suite (backend knob parity, determinism, thread-guard,
 # host-sync, plus the jitcheck passes: retrace, donation, dtype,
-# pallas-budget), the compile-counter harness (zero recompiles after
-# warmup, quick mode), chaos replay determinism against the committed
-# seed (data/chaos/ci_seed.json), sharded-placement parity on a forced
-# 8-device CPU mesh, and the spot-market survival soak + market replay
-# determinism against data/market/ci_seed.json.  ~3 minutes; see
-# tools/ci_smoke.sh.
+# pallas-budget, and the obs/profiler boundary pins), the
+# compile-counter harness (zero recompiles after warmup, quick mode),
+# chaos replay determinism against the committed seed
+# (data/chaos/ci_seed.json), sharded-placement parity on a forced
+# 8-device CPU mesh, the spot-market survival soak + market replay
+# determinism against data/market/ci_seed.json, the traced+profiled
+# serve soak, and the continuous-bench regression gate against
+# data/bench/ci_baseline.jsonl.  ~3 minutes; see tools/ci_smoke.sh.
 smoke:
 	tools/ci_smoke.sh
 
-# Standalone static analysis (no JAX import, sub-second): the nine
+# Standalone static analysis (no JAX import, sub-second): the ten
 # graftcheck passes with machine-readable findings annotated per
 # file:line (tools/lint_annotate.py emits GitHub ::error lines under
-# Actions; --require pins the obs-boundary pass so a filtered run
-# cannot silently skip it), plus the legacy hotpath CLI contract.
+# Actions; --require pins the obs-boundary and profiler-boundary
+# passes so a filtered run cannot silently skip them), plus the legacy
+# hotpath CLI contract.
 # pipefail keeps the pipe failing when graftcheck itself exits nonzero.
 lint:
 	set -o pipefail; \
 	python tools/graftcheck.py --json | \
-	    python tools/lint_annotate.py --require obs-boundary
+	    python tools/lint_annotate.py \
+	        --require obs-boundary,profiler-boundary
 	python tools/hotpath_lint.py
 
 # The full quick test tier (ROADMAP.md "Tier-1 verify").

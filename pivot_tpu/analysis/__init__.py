@@ -62,6 +62,15 @@ The observability plane (round 14) adds a ninth pass:
     ``ObsClock`` (hooks pass sim-time payloads; the wall side is
     stamped inside ``obs/``).
 
+The performance-observability layer (round 15) adds a tenth:
+
+  * **profiler-boundary** (``rules/profiler-boundary``) — the sampled
+    dispatch profiler's structural pins: ``profiler.profile(...)``
+    may be invoked only inside the registered boundary bodies
+    (``sched/tpu._call_kernel``, ``sched/batch._execute``), those
+    bodies must keep existing (rename protection), and the device
+    layer never imports the profiler.
+
 Framework pieces shared by every pass: :class:`Finding`, the rule
 registry (:data:`REGISTRY`), ``# graftcheck: ignore[rule] -- reason``
 suppressions (reason REQUIRED; a suppression that matches no finding is
@@ -263,6 +272,7 @@ def _registry():
         obsbound,
         pallas_budget,
         parity,
+        profbound,
         retrace,
         threadguard,
     )
@@ -281,6 +291,9 @@ def _registry():
         # instrumentation inside the device layer / hot bodies, no obs
         # wall clock inside the determinism scope.
         obsbound.RULE: obsbound,
+        # The dispatch profiler's boundary pins (round 15): profiler
+        # recording calls only at the registered dispatch boundaries.
+        profbound.RULE: profbound,
     }
 
 

@@ -46,6 +46,7 @@ JIT_FILES: Tuple[str, ...] = (
     "pivot_tpu/ops/pallas_kernels.py",
     "pivot_tpu/sched/tpu.py",
     "pivot_tpu/sched/batch.py",
+    "pivot_tpu/obs/profiler.py",
     "pivot_tpu/parallel/ensemble/__init__.py",
     "pivot_tpu/parallel/ensemble/checkpoint.py",
     "pivot_tpu/parallel/ensemble/sweeps.py",

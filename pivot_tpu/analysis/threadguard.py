@@ -76,13 +76,16 @@ GUARDS: Dict[str, Dict[str, dict]] = {
                 "_register_inflight", "_route", "_preempt_for",
             ),
             # Single-threaded lifecycle phases: __init__ precedes every
-            # thread; report/audit/publish_metrics run on the drained
-            # service.  run() is NOT exempt — its setup section is
-            # pre-thread (per-line suppressions say so), but its join
-            # loop runs concurrently with supervisor restarts and stays
-            # checked (that is where this pass caught the _threads
-            # iteration race).
-            "exempt": ("__init__", "report", "audit", "publish_metrics"),
+            # thread; report/audit run on the drained service.
+            # publish_metrics is NOT exempt since round 15 — the
+            # --metrics-port scrape endpoint calls it mid-run, so its
+            # pool-state reads must (and do) snapshot under the cv.
+            # run() is NOT exempt — its setup section is pre-thread
+            # (per-line suppressions say so), but its join loop runs
+            # concurrently with supervisor restarts and stays checked
+            # (that is where this pass caught the _threads iteration
+            # race).
+            "exempt": ("__init__", "report", "audit"),
         },
     },
     "pivot_tpu/serve/autoscale.py": {

@@ -572,6 +572,21 @@ def parse_args(argv=None):
                           "(SLO counters, latency summaries, dispatch "
                           "mix, autoscaler actions) as Prometheus text "
                           "exposition to PATH (plus PATH.json)")
+    srv.add_argument("--metrics-port", type=int, default=0, metavar="N",
+                     help="serve the registry's Prometheus text "
+                          "exposition LIVE at http://127.0.0.1:N"
+                          "/metrics (stdlib HTTP thread, thread-guarded "
+                          "snapshot; /metrics.json for the JSON form). "
+                          "0 = off")
+    srv.add_argument("--profile-dispatch", type=int, default=0,
+                     metavar="N",
+                     help="sampled device-dispatch profiler: time every "
+                          "Nth kernel dispatch to completion "
+                          "(block_until_ready) at the dispatch "
+                          "boundaries, publishing per-family latency "
+                          "summaries into the registry and 'device' "
+                          "lane spans into --trace-out.  Placements "
+                          "are bit-identical either way.  0 = off")
     sub.add_parser(
         "worker",
         help="resident what-if worker: serve repeated CLI requests from "
@@ -606,6 +621,18 @@ def parse_args(argv=None):
         # make batch membership (and, on f32 backends, placements)
         # nondeterministic.
         args.adaptive = False
+    if (
+        args.command == "serve"
+        and getattr(args, "profile_dispatch", 0)
+        and args.device != "tpu"
+    ):
+        # The profiler brackets DEVICE dispatches; a numpy/naive policy
+        # has none, so the run would silently produce an empty census —
+        # same fail-loud precedent as --batch-runs' device requirement.
+        parser.error(
+            "--profile-dispatch requires --device tpu (the numpy/naive "
+            "policies dispatch no kernels for the profiler to bracket)"
+        )
     if args.batch_runs > 1:
         if args.device != "tpu":
             parser.error(
@@ -1505,10 +1532,22 @@ def run_serve_stream(args) -> dict:
     # Observability plane (round 14): --trace-out turns on causal task
     # tracing (zero-cost otherwise), --metrics-out attaches the unified
     # registry; the report then carries the metrics snapshot inline.
-    from pivot_tpu.obs import MetricsRegistry, Tracer
+    # Round 15: --profile-dispatch N samples device dispatches; a live
+    # --metrics-port endpoint serves the registry mid-soak.
+    from pivot_tpu.obs import DispatchProfiler, MetricsRegistry, Tracer
 
     tracer = Tracer() if args.trace_out else None
-    registry = MetricsRegistry() if args.metrics_out else None
+    registry = (
+        MetricsRegistry()
+        if args.metrics_out or args.metrics_port else None
+    )
+    profiler = (
+        DispatchProfiler(
+            sample_every=args.profile_dispatch, seed=args.seed or 0,
+            registry=registry,
+        )
+        if args.profile_dispatch else None
+    )
     driver = ServeDriver(
         sessions,
         queue_depth=args.queue_depth,
@@ -1522,7 +1561,26 @@ def run_serve_stream(args) -> dict:
         autoscale=autoscale,
         tracer=tracer,
         registry=registry,
+        profiler=profiler,
     )
+    metrics_server = None
+    if args.metrics_port:
+        # Live scrape endpoint: every GET re-publishes the service's
+        # current state into the registry (cv-snapshotted) and renders
+        # the text exposition under the registry lock.
+        from pivot_tpu.obs import MetricsHTTPServer
+
+        def _render_text() -> str:
+            driver.publish_metrics(registry)
+            return registry.to_prometheus()
+
+        def _render_json() -> dict:
+            return driver.publish_metrics(registry) or {}
+
+        metrics_server = MetricsHTTPServer(
+            _render_text, _render_json, port=args.metrics_port
+        )
+        metrics_server.start()
     if args.closed_loop:
         arrivals = closed_loop_source(
             driver, synthetic_app_factory(seed=args.seed),
@@ -1547,7 +1605,11 @@ def run_serve_stream(args) -> dict:
             args.arrival_rate, args.jobs, seed=args.seed
         )
     wall0 = time.perf_counter()
-    report = driver.run(arrivals, pace=args.pace or None)
+    try:
+        report = driver.run(arrivals, pace=args.pace or None)
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
     wall = time.perf_counter() - wall0
     report["wall_s"] = round(wall, 3)
     report["decisions_per_sec"] = round(
@@ -1560,7 +1622,10 @@ def run_serve_stream(args) -> dict:
         tracer.save_jsonl(args.trace_out + ".jsonl")
         report["trace_out"] = args.trace_out
         report["trace_events"] = len(tracer.events)
-    if registry is not None:
+    if metrics_server is not None:
+        report["metrics_port"] = metrics_server.port
+    if registry is not None and args.metrics_out:
+        driver.publish_metrics(registry)
         registry.save_prometheus(args.metrics_out)
         registry.save_json(args.metrics_out + ".json")
         report["metrics_out"] = args.metrics_out

@@ -20,7 +20,27 @@ Three pillars (ISSUE 12):
     ``obs-boundary`` pass pins the determinism boundary; the
     ``obs_overhead`` bench row gates the enabled cost).
 
-See docs/ARCHITECTURE.md "The observability plane".
+Round 15 adds the *performance* observability layer on top:
+
+  * **sampled dispatch profiling** (:mod:`pivot_tpu.obs.profiler`) —
+    :class:`DispatchProfiler` times a deterministic 1-in-N sample of
+    kernel dispatches to completion at the ``_call_kernel`` /
+    ``place_span`` / batcher-flush boundaries, publishing per-family
+    latency summaries into the registry and ``device``-lane Perfetto
+    spans carrying shape + analytic roofline predictions (the
+    ``profiler-boundary`` graftcheck pass pins the call sites; the
+    ``profiler_overhead`` bench row gates the enabled cost);
+  * **XLA cost attribution** (:mod:`pivot_tpu.obs.costattr`) — every
+    jitmap-registered entry point gets FLOPs/bytes from
+    ``lowered.compile().cost_analysis()`` or an explicit flag
+    (register-or-flag, the jitcheck convention), joined against the
+    analytic ``infra/roofline.py`` model;
+  * **live scrape** (:mod:`pivot_tpu.obs.metrics_http`) — the
+    registry's Prometheus exposition served over a stdlib HTTP
+    endpoint (``serve --metrics-port``).
+
+See docs/ARCHITECTURE.md "The observability plane" and "Performance
+observability".
 """
 
 from __future__ import annotations
@@ -28,6 +48,8 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from pivot_tpu.obs.clock import ObsClock
+from pivot_tpu.obs.metrics_http import MetricsHTTPServer
+from pivot_tpu.obs.profiler import DispatchProfiler
 from pivot_tpu.obs.registry import MetricsRegistry
 from pivot_tpu.obs.tracer import (
     NULL_TRACER,
@@ -37,6 +59,8 @@ from pivot_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "DispatchProfiler",
+    "MetricsHTTPServer",
     "MetricsRegistry",
     "NULL_TRACER",
     "ObsClock",

@@ -1,0 +1,397 @@
+"""XLA cost attribution over the jitmap entry-point registry (pillar 2).
+
+``analysis/jitmap.py`` already knows every place a Python function
+crosses into XLA.  This module closes the measurement loop: for each
+registered entry point it either *measures* the compiled program —
+``jitfn.lower(args).compile().cost_analysis()`` FLOPs / bytes-accessed
+at a small canonical shape — or carries an explicit *flag* explaining
+why that site has no standalone attribution (a sharded twin of a
+measured kernel, a TPU-only Mosaic program, a latency probe, an
+ensemble rollout attributed by its own bench row).
+
+**Register-or-flag** (the jitcheck convention): :func:`coverage_problems`
+diffs the live jitmap discovery against :data:`ENTRY_POINTS` — a NEW
+jit site anywhere in the package fails the bench ``cost_attribution``
+gate (and ``tests/test_profiler.py``) until it gets a manifest entry,
+and a manifest entry whose site vanished is equally a finding.  No jit
+program can silently have *no* cost story.
+
+Measured rows are joined against the analytic ``infra/roofline.py``
+work model at the same shape: ``flops_vs_model`` / ``bytes_vs_model``
+are the measured/analytic ratios.  They are recorded, not gated — the
+analytic model counts compares/selects as vector-issue-slot work while
+XLA's cost analysis counts arithmetic only, so a constant-factor gap is
+expected; what the ratio buys is *trend* comparability across forms of
+the same kernel (and a drift alarm when a rewrite silently changes a
+program's work class).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ENTRY_POINTS",
+    "coverage_problems",
+    "cost_attribution",
+]
+
+#: Canonical measurement shape: small enough that the whole manifest
+#: compiles in seconds on CPU, large enough that the [T, H] decision
+#: space dominates the program.
+_T, _H = 32, 16
+
+
+def _operands(T: int = _T, H: int = _H):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    avail = jnp.asarray(
+        rng.uniform(2.0, 8.0, (H, 4)).astype(np.float32)
+    )
+    dem = jnp.asarray(rng.uniform(0.1, 1.0, (T, 4)).astype(np.float32))
+    valid = jnp.ones(T, dtype=bool)
+    u = jnp.asarray(rng.uniform(size=T).astype(np.float32))
+    ng = jnp.asarray((np.arange(T) % 4 == 0))
+    az = jnp.zeros(T, dtype=jnp.int32)
+    cost = jnp.ones((2, 2), dtype=jnp.float32)
+    bw = jnp.ones((2, 2), dtype=jnp.float32)
+    hz = jnp.zeros(H, dtype=jnp.int32)
+    counts = jnp.zeros(H, dtype=jnp.int32)
+    totals = jnp.asarray(np.asarray(avail).sum(axis=0))
+    return dict(
+        avail=avail, dem=dem, valid=valid, u=u, ng=ng, az=az,
+        cost=cost, bw=bw, hz=hz, counts=counts, totals=totals,
+    )
+
+
+def _b_opportunistic_ref(o):
+    from pivot_tpu.ops.kernels import opportunistic_kernel_ref
+
+    return opportunistic_kernel_ref, (
+        o["avail"], o["dem"], o["valid"], o["u"],
+    ), {}, "scan"
+
+
+def _b_first_fit_ref(o):
+    from pivot_tpu.ops.kernels import first_fit_kernel_ref
+
+    return first_fit_kernel_ref, (
+        o["avail"], o["dem"], o["valid"],
+    ), dict(strict=False), "scan"
+
+
+def _b_best_fit_ref(o):
+    from pivot_tpu.ops.kernels import best_fit_kernel_ref
+
+    return best_fit_kernel_ref, (
+        o["avail"], o["dem"], o["valid"],
+    ), {}, "scan"
+
+
+def _b_cost_aware_ref(o):
+    from pivot_tpu.ops.kernels import cost_aware_kernel_ref
+
+    return cost_aware_kernel_ref, (
+        o["avail"], o["dem"], o["valid"], o["ng"], o["az"],
+        o["cost"], o["bw"], o["hz"], o["counts"],
+    ), dict(bin_pack="first-fit", sort_hosts=True, host_decay=False), "scan"
+
+
+def _two_phase_kind(backend: str) -> str:
+    return "slim" if backend == "cpu" else "scan"
+
+
+def _b_opportunistic(o):
+    from pivot_tpu.ops.kernels import opportunistic_kernel
+
+    return opportunistic_kernel, (
+        o["avail"], o["dem"], o["valid"], o["u"],
+    ), dict(phase2="auto"), None
+
+
+def _b_first_fit(o):
+    from pivot_tpu.ops.kernels import first_fit_kernel
+
+    return first_fit_kernel, (
+        o["avail"], o["dem"], o["valid"],
+    ), dict(strict=False, totals=o["totals"], phase2="auto"), None
+
+
+def _b_best_fit(o):
+    from pivot_tpu.ops.kernels import best_fit_kernel
+
+    return best_fit_kernel, (
+        o["avail"], o["dem"], o["valid"],
+    ), dict(totals=o["totals"], phase2="auto"), None
+
+
+def _b_cost_aware(o):
+    from pivot_tpu.ops.kernels import cost_aware_kernel
+
+    return cost_aware_kernel, (
+        o["avail"], o["dem"], o["valid"], o["ng"], o["az"],
+        o["cost"], o["bw"], o["hz"], o["counts"],
+    ), dict(
+        bin_pack="first-fit", sort_hosts=True, host_decay=False,
+        totals=o["totals"], phase2="auto",
+    ), None
+
+
+def _b_fused_tick_run(o):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pivot_tpu.ops.tickloop import _fused_tick_run
+
+    K = 4
+    arrive = jnp.asarray(
+        (np.arange(o["dem"].shape[0]) % K).astype(np.int32)
+    )
+    args = (
+        o["avail"], o["dem"], arrive, jnp.int32(K),
+        None, None, None, None, None, None, None, None, None,
+        None, None, None, None,
+    )
+    return _fused_tick_run, args, dict(
+        policy="first-fit", n_ticks=K, strict=False, decreasing=False,
+        bin_pack="first-fit", sort_tasks=False, sort_hosts=True,
+        host_decay=False, phase2="auto",
+    ), "scan"
+
+
+#: Builder registry: key → callable(operands) returning ``(jit entry
+#: point, positional args, static kwargs, analytic kind-or-None)``
+#: (``None`` = resolve the two-phase kind per backend).
+_BUILDERS: Dict[str, Callable] = {
+    "opportunistic_ref": _b_opportunistic_ref,
+    "first_fit_ref": _b_first_fit_ref,
+    "best_fit_ref": _b_best_fit_ref,
+    "cost_aware_ref": _b_cost_aware_ref,
+    "opportunistic": _b_opportunistic,
+    "first_fit": _b_first_fit,
+    "best_fit": _b_best_fit,
+    "cost_aware": _b_cost_aware,
+    "fused_tick_run": _b_fused_tick_run,
+}
+
+
+def measure(key: str) -> Tuple[str, str]:
+    assert key in _BUILDERS, key
+    return ("measure", key)
+
+
+def flag(reason: str) -> Tuple[str, str]:
+    return ("flag", reason)
+
+
+#: The manifest: every jitmap-discovered (path, entry-point name) must
+#: appear here — measured, or flagged with the reason it has no
+#: standalone program to attribute.  ``coverage_problems`` enforces
+#: both directions.
+ENTRY_POINTS: Dict[Tuple[str, str], Tuple[str, str]] = {
+    # -- the placement-kernel families: measured directly ----------------
+    ("pivot_tpu/ops/kernels.py", "opportunistic_kernel_ref"):
+        measure("opportunistic_ref"),
+    ("pivot_tpu/ops/kernels.py", "first_fit_kernel_ref"):
+        measure("first_fit_ref"),
+    ("pivot_tpu/ops/kernels.py", "best_fit_kernel_ref"):
+        measure("best_fit_ref"),
+    ("pivot_tpu/ops/kernels.py", "cost_aware_kernel_ref"):
+        measure("cost_aware_ref"),
+    ("pivot_tpu/ops/kernels.py", "opportunistic_kernel"):
+        measure("opportunistic"),
+    ("pivot_tpu/ops/kernels.py", "first_fit_kernel"):
+        measure("first_fit"),
+    ("pivot_tpu/ops/kernels.py", "best_fit_kernel"):
+        measure("best_fit"),
+    ("pivot_tpu/ops/kernels.py", "cost_aware_kernel"):
+        measure("cost_aware"),
+    ("pivot_tpu/ops/tickloop.py", "_fused_tick_run"):
+        measure("fused_tick_run"),
+    # -- sharded twins: same program family, host-sharded over a mesh ----
+    ("pivot_tpu/ops/shard.py", "_opportunistic_sharded_fn"): flag(
+        "host-sharded twin of opportunistic_kernel (bit-identical by "
+        "tests/test_shard.py); per-shard work attributed by the "
+        "single-device row, collectives by the shard_place bench row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_first_fit_sharded_fn"): flag(
+        "host-sharded twin of first_fit_kernel — see shard_place row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_best_fit_sharded_fn"): flag(
+        "host-sharded twin of best_fit_kernel — see shard_place row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_cost_aware_sharded_fn"): flag(
+        "host-sharded twin of cost_aware_kernel — see shard_place row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_sharded_span_fn"): flag(
+        "host-sharded twin of _fused_tick_run — see shard_place row"
+    ),
+    # -- Pallas: Mosaic programs, only meaningful on the TPU backend -----
+    ("pivot_tpu/ops/pallas_kernels.py", "cost_aware_pallas"): flag(
+        "TPU-only Mosaic kernel; XLA cost_analysis does not see inside "
+        "a pallas_call — VMEM work is accounted by the static "
+        "pallas-budget pass and the hardware bench rows"
+    ),
+    ("pivot_tpu/ops/pallas_kernels.py", "cost_aware_pallas_batched"):
+        flag(
+            "TPU-only replica-batched Mosaic kernel — same accounting "
+            "as cost_aware_pallas (pallas-budget pass + BENCH_TPU rows)"
+        ),
+    # -- routing / batching plumbing -------------------------------------
+    ("pivot_tpu/sched/tpu.py", "f"): flag(
+        "trivial x+1 latency probe (_probe_device_floor) — its cost IS "
+        "the dispatch floor the profiler's model uses as intercept"
+    ),
+    ("pivot_tpu/obs/profiler.py", "f"): flag(
+        "the profiler's own x+1 floor probe (DispatchProfiler."
+        "_lazy_floor) — same trivial program as the sched.tpu probe"
+    ),
+    ("pivot_tpu/sched/batch.py", "_batched_fn"): flag(
+        "factory: vmap of the wrapped placement kernel over the [G] "
+        "run axis — work is G x the wrapped kernel's measured row "
+        "(grid_batched bench row carries the measured amortization)"
+    ),
+    # -- ensemble rollout programs: attributed by their own bench rows ---
+    ("pivot_tpu/parallel/ensemble/__init__.py", "_rollout_states"): flag(
+        "full Monte-Carlo rollout program — attributed by bench.py's "
+        "ensemble_roofline / ensemble_saturated rows at the real shape"
+    ),
+    ("pivot_tpu/parallel/ensemble/__init__.py", "_sharded_rollout_fn"):
+        flag("host-sharded rollout twin — see ensemble rows"),
+    ("pivot_tpu/parallel/ensemble/__init__.py", "shard_sweep"): flag(
+        "sharded sweep driver over the rollout program — see ensemble "
+        "rows"
+    ),
+    ("pivot_tpu/parallel/ensemble/checkpoint.py", "_segment_step"): flag(
+        "segment-granular slice of the rollout program (double-buffer "
+        "pipeline) — same program family as _rollout_states"
+    ),
+    ("pivot_tpu/parallel/ensemble/checkpoint.py", "_segment_step_carry"):
+        flag("device-resident-carry variant of _segment_step"),
+    ("pivot_tpu/parallel/ensemble/sweeps.py", "_row_segment_step"): flag(
+        "per-row sweep variant of _segment_step (vmapped arm axis)"
+    ),
+    ("pivot_tpu/parallel/ensemble/sweeps.py", "_row_segment_step_carry"):
+        flag("device-resident-carry variant of _row_segment_step"),
+    ("pivot_tpu/parallel/ensemble/bill.py", "_finalize_batch"): flag(
+        "O(R) billing reduction over rollout outputs — negligible next "
+        "to the rollout program it post-processes"
+    ),
+}
+
+
+def coverage_problems() -> List[str]:
+    """Register-or-flag diff of the live jitmap discovery against
+    :data:`ENTRY_POINTS` (empty = every entry point has a cost story).
+    Pure AST work — no jax import."""
+    from pivot_tpu.analysis import _Cache, repo_root
+    from pivot_tpu.analysis.jitmap import collect_sites
+
+    cache = _Cache(repo_root())
+    sites, findings, _scanned = collect_sites(cache)
+    problems = [str(f) for f in findings]
+    discovered = {
+        (path, s.name) for path, ss in sites.items() for s in ss
+    }
+    for key in sorted(discovered - set(ENTRY_POINTS)):
+        problems.append(
+            f"jit entry point {key[1]} ({key[0]}) has no cost-"
+            "attribution entry — add it to pivot_tpu/obs/costattr.py "
+            "ENTRY_POINTS (measure or flag with a reason)"
+        )
+    for key in sorted(set(ENTRY_POINTS) - discovered):
+        problems.append(
+            f"stale cost-attribution entry {key[1]} ({key[0]}): no such "
+            "jit site — renamed/deleted? update ENTRY_POINTS"
+        )
+    return problems
+
+
+def _extract(cost) -> Dict[str, float]:
+    """Normalize ``cost_analysis()`` output (dict, or list of dicts on
+    this jax) to {flops, bytes}."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    cost = cost or {}
+    return {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes": float(cost.get("bytes accessed", 0.0)),
+    }
+
+
+def cost_attribution(
+    T: int = _T, H: int = _H, include_flags: bool = True
+) -> dict:
+    """Measure every manifest "measure" entry at the canonical shape and
+    join against the analytic roofline model.
+
+    Returns ``{"t", "h", "backend", "complete", "coverage_problems",
+    "rows": {name: row}}`` where a measured row carries
+    ``{path, flops, bytes, analytic_flops, analytic_bytes,
+    flops_vs_model, bytes_vs_model}`` and a flagged row
+    ``{path, flagged: reason}``.  ``complete`` is the bench gate:
+    every jitmap entry point has a row and no coverage problem exists.
+    """
+    import jax
+
+    from pivot_tpu.infra import roofline
+
+    backend = jax.default_backend()
+    problems = coverage_problems()
+    operands = _operands(T, H)
+    rows: Dict[str, dict] = {}
+    for (path, name), (kind, payload) in sorted(ENTRY_POINTS.items()):
+        if kind == "flag":
+            if include_flags:
+                rows[name] = {"path": path, "flagged": payload}
+            continue
+        builder = _BUILDERS[payload]
+        try:
+            jitfn, args, static_kw, model_kind = builder(operands)
+            lowered = jitfn.lower(*args, **static_kw)
+            measured = _extract(lowered.compile().cost_analysis())
+        except Exception as exc:  # noqa: BLE001 — row-level isolation
+            rows[name] = {
+                "path": path,
+                "error": f"{type(exc).__name__}: {exc}"[:200],
+            }
+            problems.append(f"cost_analysis failed for {name}: {exc}")
+            continue
+        model_kind = model_kind or (
+            "slim" if backend == "cpu" else "scan"
+        )
+        k = 4 if payload == "fused_tick_run" else 1
+        analytic = roofline.placement_cost(
+            model_kind, T * k, H, dtype_bytes=4
+        )
+        row = {
+            "path": path,
+            "kind": model_kind,
+            "flops": measured["flops"],
+            "bytes": measured["bytes"],
+            "analytic_flops": analytic["flops"],
+            "analytic_bytes": analytic["bytes"],
+        }
+        if measured["flops"] and analytic["flops"]:
+            row["flops_vs_model"] = round(
+                measured["flops"] / analytic["flops"], 4
+            )
+        if measured["bytes"] and analytic["bytes"]:
+            row["bytes_vs_model"] = round(
+                measured["bytes"] / analytic["bytes"], 4
+            )
+        rows[name] = row
+    return {
+        "t": T,
+        "h": H,
+        "backend": backend,
+        "entries": len(ENTRY_POINTS),
+        "measured": sum(1 for r in rows.values() if "flops" in r),
+        "flagged": sum(1 for r in rows.values() if "flagged" in r),
+        "coverage_problems": problems,
+        "complete": not problems,
+        "rows": rows,
+    }

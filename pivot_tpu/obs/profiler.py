@@ -1,0 +1,452 @@
+"""Sampled device-dispatch profiler (round 15, ISSUE 13 pillar 1).
+
+PR 12 gave the system a causal timeline and a metrics registry; this
+module answers the question neither could: *how long do the device
+dispatches actually take, and is that what the analytic device model
+predicts?*  :class:`DispatchProfiler` brackets kernel dispatches at the
+three boundaries where host control crosses into XLA —
+``sched/tpu.py`` ``_call_kernel`` (per-tick kernels), ``place_span``
+(fused spans, through the same ``_call_kernel`` rung), and
+``DispatchBatcher._flush`` (coalesced serve/grid dispatches) — and
+times a deterministic 1-in-N sample of them to completion with
+``jax.block_until_ready``.
+
+Design pins (the ``profiler-boundary`` graftcheck pass enforces the
+structural ones):
+
+  * **wall capture lives HERE** — the boundary hooks hand the profiler
+    a thunk; the profiler owns every ``time.perf_counter`` read, so the
+    determinism-scoped modules (``sched/``, ``ops/``) stay clock-free
+    exactly as the ``obs-boundary``/``determinism`` passes require;
+  * **outside the jitted bodies** — the profiler wraps the *dispatch*,
+    never instruments inside a jitted/Pallas body (a hook there would
+    trace once and lie); the hostsync-discovered hot bodies may not
+    call it;
+  * **zero-cost off, bounded on, placements bit-identical either way**
+    — ``profile()`` short-circuits on ``enabled`` before touching a
+    clock or lock; sampling only *times* the thunk (forcing completion
+    of a result the caller was about to fetch anyway) and never touches
+    operands, so the ``profiler_overhead`` bench row can hold the
+    traced run to the same bit-parity bar as ``obs_overhead``;
+  * **deterministic cadence** — whether call #k of a family is sampled
+    is a pure function of (seed, family, k): a per-family phase derived
+    from ``crc32(seed:family)`` offsets a call counter, so two profiled
+    replays of a seeded run sample the identical dispatches
+    (``tests/test_profiler.py`` pins replayability).
+
+Each sampled dispatch publishes into three sinks:
+
+  * per-family streaming stats (count/sum/min/max + a bounded duration
+    ring for quantiles), exported to the unified
+    :class:`~pivot_tpu.obs.registry.MetricsRegistry` via
+    :meth:`publish_metrics` (``pivot_dispatch_*`` families);
+  * a ``device``-lane Perfetto span on the attached tracer whose args
+    carry the dispatch shape (tasks/hosts/span-K/group), the backend,
+    and the analytic prediction — ``tools/obs_report.py``'s perf
+    section joins these without importing jax;
+  * a measured-vs-predicted roofline ratio against the analytic
+    ``infra/roofline.py`` model (dispatch floor + max(flops/peak,
+    bytes/bw)) — the per-family median ratio is the "device model is
+    lying" drift signal that stalled the ROADMAP-1 hardware recapture.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from statistics import median as _median
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["DispatchProfiler", "family_of", "predicted_seconds"]
+
+#: Kernel-family → analytic work-model kind (``roofline.placement_cost``).
+#: ``auto``-phase2 two-phase kernels resolve to the slim early-exit pass
+#: on the CPU backend and the scan form elsewhere (``ops/kernels.py``),
+#: which is exactly how ``bench.py`` annotates its rows.
+_TWO_PHASE = {
+    "opportunistic", "first_fit", "best_fit", "cost_aware",
+}
+_SCAN_ONLY = {
+    "opportunistic_ref", "first_fit_ref", "best_fit_ref",
+    "cost_aware_ref", "fused_tick_run",
+}
+_PALLAS = {"cost_aware_pallas", "cost_aware_pallas_batched"}
+
+
+def family_of(kernel: Any) -> str:
+    """Stable family name for a dispatched kernel callable: the wrapped
+    implementation's ``__name__`` with the ``_impl``/``_kernel``
+    plumbing suffixes stripped (``first_fit_impl`` → ``first_fit``,
+    ``cost_aware_kernel_ref`` → ``cost_aware_ref``)."""
+    name = getattr(kernel, "__name__", None) or type(kernel).__name__
+    for suffix, repl in (
+        ("_kernel_ref", "_ref"), ("_impl", ""), ("_kernel", ""),
+    ):
+        if name.endswith(suffix):
+            return name[: -len(suffix)] + repl
+    return name
+
+
+def _model_kind(family: str, backend: str) -> Optional[str]:
+    if family in _PALLAS:
+        return "pallas_rb"
+    if family in _SCAN_ONLY:
+        return "scan"
+    if family in _TWO_PHASE:
+        return "slim" if backend == "cpu" else "scan"
+    return None
+
+
+def predicted_seconds(
+    family: str,
+    shape: Dict[str, int],
+    backend: str,
+    floor_s: float,
+    peaks: Optional[Dict[str, float]] = None,
+) -> Optional[float]:
+    """Analytic wall prediction for one dispatch: the probed per-call
+    dispatch floor plus the roofline time bound of the estimated work
+    (``max(flops/peak_flops, bytes/peak_bw)``).  A trend-level model —
+    its job is the ×-level drift verdict, not microsecond accuracy.
+    None when the family has no work model (the ratio is then omitted
+    rather than fabricated)."""
+    kind = _model_kind(family, backend)
+    h = int(shape.get("h", 0))
+    t = int(shape.get("t", shape.get("b", 0)))
+    if kind is None or h <= 0 or t <= 0:
+        return None
+    from pivot_tpu.infra import roofline
+
+    k = int(shape.get("k", 1)) or 1
+    r = int(shape.get("g", 1)) or 1
+    peaks = peaks or roofline.backend_peaks(backend)
+    cost = roofline.placement_cost(kind, t * k, h, R=r, dtype_bytes=4)
+    work_s = max(
+        cost["flops"] / (peaks["flops_peak_gflops"] * 1e9),
+        cost["bytes"] / (peaks["bw_gbps"] * 1e9),
+    )
+    return floor_s + work_s
+
+
+class _FamilyStats:
+    """Streaming per-family latency stats + a bounded duration ring."""
+
+    __slots__ = ("calls", "sampled", "total_s", "min_s", "max_s",
+                 "durs", "ratios")
+
+    _RING = 1024  # bounded memory for quantiles on long soaks
+
+    def __init__(self):
+        self.calls = 0
+        self.sampled = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+        self.durs: List[float] = []
+        self.ratios: List[float] = []
+
+    def record(self, dur: float, ratio: Optional[float]) -> None:
+        self.sampled += 1
+        self.total_s += dur
+        self.min_s = min(self.min_s, dur)
+        self.max_s = max(self.max_s, dur)
+        if len(self.durs) < self._RING:
+            self.durs.append(dur)
+        else:
+            self.durs[self.sampled % self._RING] = dur
+        if ratio is not None:
+            if len(self.ratios) < self._RING:
+                self.ratios.append(ratio)
+            else:
+                self.ratios[self.sampled % self._RING] = ratio
+
+
+#: Per-process dispatch-floor cache, keyed by backend name.  The floor
+#: is a property of the process's backend link, not of any one profiler
+#: instance — and re-probing per instance would pay a fresh XLA compile
+#: for the probe lambda each time (a new function object defeats jax's
+#: jit cache), which alone would blow the profiler_overhead gate.
+_FLOOR_CACHE: Dict[str, float] = {}
+_FLOOR_LOCK = threading.Lock()
+
+
+def _probe_floor(backend: str) -> float:
+    """Fixed per-call dispatch latency: trivial jit round trip, best of
+    3 (the ``sched.tpu._probe_device_floor`` protocol), probed once per
+    (process, backend)."""
+    with _FLOOR_LOCK:
+        cached = _FLOOR_CACHE.get(backend)
+        if cached is not None:
+            return cached
+        import jax
+        import numpy as np
+
+        f = jax.jit(lambda x: x + 1.0)
+        x = np.zeros((8,), np.float32)
+        np.asarray(f(x))  # compile outside the timed reps
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f(x))
+            best = min(best, time.perf_counter() - t0)
+        _FLOOR_CACHE[backend] = best
+        return best
+
+
+def _quantile(vals: List[float], q: float) -> float:
+    s = sorted(vals)
+    return s[min(int(q * len(s)), len(s) - 1)]
+
+
+class DispatchProfiler:
+    """Deterministically sampled, completion-forced dispatch timer.
+
+    ``sample_every`` is the cadence N (1 = every dispatch; the default
+    16 keeps the enabled cost inside the ``profiler_overhead`` bench
+    gate); ``seed`` fixes the per-family sampling phase; ``tracer``
+    (optional) receives one ``device``-lane span per sampled dispatch;
+    ``registry`` (optional) is the default :meth:`publish_metrics`
+    sink.  Thread-safe: serve sessions and the batcher coordinator
+    share one profiler (counter advance + stats append run under one
+    lock; the timed thunk itself does not).
+    """
+
+    def __init__(
+        self,
+        sample_every: int = 16,
+        seed: int = 0,
+        tracer=None,
+        registry=None,
+        enabled: bool = True,
+    ):
+        if sample_every < 1:
+            raise ValueError("sample_every must be >= 1")
+        self.enabled = enabled
+        self.sample_every = int(sample_every)
+        self.seed = int(seed)
+        self.tracer = tracer
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._stats: Dict[str, _FamilyStats] = {}
+        self._phases: Dict[str, int] = {}
+        self._backend: Optional[str] = None
+        self._floor_s: Optional[float] = None
+        self._peaks: Optional[Dict[str, float]] = None
+
+    # -- deterministic cadence -------------------------------------------
+    def _phase(self, family: str) -> int:
+        phase = self._phases.get(family)
+        if phase is None:
+            phase = zlib.crc32(
+                f"{self.seed}:{family}".encode()
+            ) % self.sample_every
+            self._phases[family] = phase
+        return phase
+
+    def _tick(self, family: str) -> bool:
+        """Advance ``family``'s call counter; True iff this call is the
+        deterministic 1-in-N sample (call under the lock)."""
+        st = self._stats.get(family)
+        if st is None:
+            st = self._stats[family] = _FamilyStats()
+        n = st.calls
+        st.calls += 1
+        return (n + self._phase(family)) % self.sample_every == 0
+
+    def sampled_indices(self, family: str, n_calls: int) -> List[int]:
+        """Which of ``n_calls`` consecutive calls WOULD be sampled — the
+        pure cadence function, exposed so tests can pin replayability
+        without driving real dispatches."""
+        phase = zlib.crc32(
+            f"{self.seed}:{family}".encode()
+        ) % self.sample_every
+        return [
+            i for i in range(n_calls)
+            if (i + phase) % self.sample_every == 0
+        ]
+
+    # -- the boundary hook ------------------------------------------------
+    def _lazy_backend(self) -> str:
+        if self._backend is None:
+            import jax
+
+            self._backend = jax.default_backend()
+        return self._backend
+
+    def _lazy_floor(self) -> float:
+        """The fixed per-call dispatch latency — the intercept of the
+        analytic prediction.  Lazy (building a profiler never touches
+        the backend) and process-cached (:func:`_probe_floor`)."""
+        if self._floor_s is None:
+            self._floor_s = _probe_floor(self._lazy_backend())
+        return self._floor_s
+
+    def profile(
+        self,
+        family: str,
+        fn: Callable[[], Any],
+        shape: Optional[Dict[str, int]] = None,
+        flush: bool = False,
+    ):
+        """Run one dispatch thunk, timing it to completion when this
+        call lands on the family's sampling cadence.
+
+        Unsampled calls still advance the counter (the cadence is over
+        *calls*, so it is replayable) but pay only a dict lookup and an
+        increment.  Sampled calls force completion with
+        ``jax.block_until_ready`` — legal at every registered boundary
+        because the caller is about to fetch (or hand off) the result
+        anyway — and record a ``device`` span whose args carry the
+        shape, backend, and analytic prediction.  ``flush=True`` marks
+        spans recorded inside a batcher flush (``in_flush``), which
+        ``obs_report --check`` requires to nest inside their
+        ``dispatch/flush`` parent span.
+        """
+        if not self.enabled:
+            return fn()
+        with self._lock:
+            sampled = self._tick(family)
+        if not sampled:
+            return fn()
+        import jax
+
+        backend = self._lazy_backend()
+        floor_s = self._lazy_floor()
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        dur = time.perf_counter() - t0
+        shape = shape or {}
+        pred = predicted_seconds(
+            family, shape, backend, floor_s, self._peaks
+        )
+        ratio = dur / pred if pred and pred > 0 else None
+        with self._lock:
+            st = self._stats[family]
+            # The family's FIRST sample almost always carries XLA
+            # compile time (the same poisoning the adaptive router's
+            # warm-bucket guard exists for) — keep its duration in the
+            # census but exclude it from the model-ratio stats, or the
+            # drift verdict would fire on every fresh process.
+            cold = st.sampled == 0
+            st.record(dur, None if cold else ratio)
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            args: Dict[str, Any] = {"backend": backend}
+            args.update({k: int(v) for k, v in shape.items()})
+            if pred is not None:
+                args["pred_us"] = round(pred * 1e6, 3)
+                if not cold:
+                    args["model_ratio"] = round(ratio, 3)
+            if cold:
+                args["cold"] = True  # first sample: includes compile
+            if flush:
+                args["in_flush"] = True
+            tracer.record_span("device", family, dur, **args)
+        return out
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> dict:
+        """Per-family latency census + model-ratio medians (the
+        machine-readable view ``bench.py``'s ``profiler_overhead`` row
+        and the serve report embed)."""
+        with self._lock:
+            fams = {}
+            for family in sorted(self._stats):
+                st = self._stats[family]
+                row = {
+                    "calls": st.calls,
+                    "sampled": st.sampled,
+                }
+                if st.sampled:
+                    row.update(
+                        total_ms=round(st.total_s * 1e3, 3),
+                        min_us=round(st.min_s * 1e6, 3),
+                        max_us=round(st.max_s * 1e6, 3),
+                        p50_us=round(_quantile(st.durs, 0.5) * 1e6, 3),
+                        p95_us=round(_quantile(st.durs, 0.95) * 1e6, 3),
+                    )
+                if st.ratios:
+                    row["model_ratio_p50"] = round(
+                        _median(st.ratios), 3
+                    )
+                fams[family] = row
+            return {
+                "sample_every": self.sample_every,
+                "seed": self.seed,
+                "backend": self._backend,
+                "dispatch_floor_us": (
+                    round(self._floor_s * 1e6, 3)
+                    if self._floor_s is not None else None
+                ),
+                "families": fams,
+            }
+
+    def publish_metrics(self, registry=None) -> None:
+        """Publish the per-family census into the unified registry
+        (publish-style: idempotent on republish).  Families:
+        ``pivot_dispatch_calls_total``/``..._sampled_total`` counters,
+        ``pivot_dispatch_latency_seconds`` summaries (p50/p95), and the
+        ``pivot_dispatch_model_ratio`` gauge — the scrapeable form of
+        the drift signal."""
+        registry = registry or self.registry
+        if registry is None:
+            return
+        backend = self._backend or "unknown"
+        registry.counter(
+            "pivot_dispatch_calls_total",
+            "kernel dispatches crossing a profiled boundary",
+            labelnames=("family", "backend"),
+        )
+        registry.counter(
+            "pivot_dispatch_sampled_total",
+            "dispatches timed to completion by the sampler",
+            labelnames=("family", "backend"),
+        )
+        registry.summary(
+            "pivot_dispatch_latency_seconds",
+            "sampled dispatch wall latency (block_until_ready-forced)",
+            labelnames=("family", "backend"),
+        )
+        registry.gauge(
+            "pivot_dispatch_model_ratio",
+            "median measured/predicted dispatch wall ratio vs the "
+            "analytic roofline model (>2 or <0.5 = the device model "
+            "is lying)",
+            labelnames=("family", "backend"),
+        )
+        with self._lock:
+            # Full snapshot under the lock: a --metrics-port scrape runs
+            # concurrently with recording threads, and reading the
+            # mutable stats fields (or sorting a ring being overwritten)
+            # outside it would export torn count/total/quantile pairs.
+            items = [
+                (
+                    family, st.calls, st.sampled, st.total_s,
+                    list(st.durs), list(st.ratios),
+                )
+                for family, st in sorted(self._stats.items())
+            ]
+        for family, calls, sampled, total_s, durs, ratios in items:
+            labels = dict(family=family, backend=backend)
+            registry.set("pivot_dispatch_calls_total", calls, **labels)
+            registry.set(
+                "pivot_dispatch_sampled_total", sampled, **labels
+            )
+            if sampled:
+                registry.observe_summary(
+                    "pivot_dispatch_latency_seconds",
+                    count=sampled,
+                    total=total_s,
+                    quantiles={
+                        0.5: _quantile(durs, 0.5),
+                        0.95: _quantile(durs, 0.95),
+                    },
+                    **labels,
+                )
+            if ratios:
+                registry.set(
+                    "pivot_dispatch_model_ratio",
+                    round(_median(ratios), 6), **labels,
+                )

@@ -373,7 +373,8 @@ class DispatchBatcher:
     """
 
     def __init__(self, n_slots: int, flush_after: Optional[float] = None,
-                 mesh: Optional[object] = None, tracer=None):
+                 mesh: Optional[object] = None, tracer=None,
+                 profiler=None):
         if n_slots < 1:
             raise ValueError("DispatchBatcher needs at least one slot")
         if flush_after is not None and flush_after <= 0:
@@ -388,6 +389,15 @@ class DispatchBatcher:
 
             tracer = NULL_TRACER
         self.tracer = tracer
+        #: Sampled dispatch profiler (round 15, ``obs/profiler.py``):
+        #: the flush boundary is where batched kernel calls actually
+        #: hit the device, so the profiler brackets ``batch_execute``
+        #: HERE — the per-policy ``_call_kernel`` hook deliberately
+        #: stands down when a batch client is attached (it would time
+        #: slot park time, not the dispatch).  The wall capture and the
+        #: sampling decision both live inside the profiler (this module
+        #: is determinism-scoped).  ``None`` = zero cost.
+        self.profiler = profiler
         self._cond = threading.Condition()
         self._n_slots = n_slots
         self._open = n_slots
@@ -507,6 +517,34 @@ class DispatchBatcher:
                 batch, self._pending = self._pending, []
             self._flush(batch)
 
+    def _execute(self, reqs: List["_Request"]):
+        """One coalesced device call for a same-key request group —
+        through the sampled profiler when one is attached (its span
+        carries ``in_flush`` so ``obs_report --check`` can assert the
+        device span nests inside the surrounding flush span)."""
+        call = lambda: batch_execute(  # noqa: E731 — thunk for the profiler
+            reqs[0].kernel,
+            [(r.args, r.arr_kw) for r in reqs],
+            reqs[0].static_kw,
+            mesh=self._mesh,
+        )
+        prof = self.profiler
+        if prof is None or not prof.enabled:
+            return call()
+        from pivot_tpu.obs.profiler import family_of
+
+        shape = {"g": len(reqs)}
+        args0 = reqs[0].args
+        if args0 and hasattr(args0[0], "shape") and len(
+            args0[0].shape
+        ) == 2:
+            shape["h"] = int(args0[0].shape[0])
+        if len(args0) > 1 and hasattr(args0[1], "shape"):
+            shape["b"] = int(args0[1].shape[0])
+        return prof.profile(
+            family_of(reqs[0].kernel), call, shape=shape, flush=True
+        )
+
     def _flush(self, batch: List[_Request]) -> None:
         # Deterministic composition given a fixed co-pending set: groups
         # in first-key-seen order, rows in slot order.  (Results are
@@ -539,12 +577,7 @@ class DispatchBatcher:
                         "dispatch", "flush", group=len(reqs),
                         slots=[r.slot for r in reqs],
                     ):
-                        outs = batch_execute(
-                            reqs[0].kernel,
-                            [(r.args, r.arr_kw) for r in reqs],
-                            reqs[0].static_kw,
-                            mesh=self._mesh,
-                        )
+                        outs = self._execute(reqs)
                 except BaseException as exc:  # noqa: BLE001 — deliver, don't hang
                     for r in reqs:
                         r.error = exc

@@ -123,6 +123,23 @@ class _SpanOutcome:
         self.placements = placements
 
 
+def _dispatch_shape(args, kw) -> dict:
+    """Shape labels of one kernel dispatch for the profiler's device
+    spans and analytic prediction: H from the [H, 4] availability
+    operand, B from the padded batch, K from a fused span's static
+    tick count.  Sim-free and clock-free — the profiler owns the wall
+    side (obs-boundary contract)."""
+    shape = {}
+    if args and hasattr(args[0], "shape") and len(args[0].shape) == 2:
+        shape["h"] = int(args[0].shape[0])
+    if len(args) > 1 and hasattr(args[1], "shape") and args[1].shape:
+        shape["b"] = int(args[1].shape[0])
+    n_ticks = kw.get("n_ticks")
+    if isinstance(n_ticks, int):
+        shape["k"] = n_ticks
+    return shape
+
+
 def _probe_device_floor() -> float:
     """Measure the fixed per-call device latency: dispatch + execution of a
     trivial kernel + result fetch (the fetch is what actually waits on the
@@ -227,6 +244,12 @@ class _DevicePolicyBase(Policy):
         # every placement dispatch — per-tick kernels AND fused spans —
         # runs host-sharded over the mesh's ``host`` axis.
         self._mesh = None
+        # Sampled dispatch profiler (``pivot_tpu/obs/profiler.py``):
+        # attached via enable_profiler, consulted only on the DIRECT
+        # dispatch path in _call_kernel (batched dispatches are timed
+        # at the batcher's flush boundary instead — timing here would
+        # measure slot park time, not the device).  None = zero cost.
+        self._profiler = None
         self._topology_host: Optional[DeviceTopology] = None
         self._cpu_twin: Optional[Policy] = None  # set by subclasses
         self._cpu_cell_cost = self._CELL_COST_SEED
@@ -341,12 +364,37 @@ class _DevicePolicyBase(Policy):
             return functools.partial(self._call_kernel, kernel)
         return functools.partial(sharded_kernel, self._mesh)
 
+    # -- sampled dispatch profiling (round 15, ``obs/profiler.py``) --------
+    def enable_profiler(self, profiler) -> None:
+        """Attach a :class:`pivot_tpu.obs.DispatchProfiler`: a
+        deterministic 1-in-N sample of this policy's direct device
+        dispatches (per-tick kernels through :meth:`_call_kernel`,
+        fused spans through :meth:`place_span`'s use of the same rung)
+        is timed to completion and published as per-family latency
+        summaries + ``device``-lane trace spans.  Placements are
+        untouched — the profiler only times; ``None`` detaches.  When
+        cross-run batching is enabled the batcher's flush boundary owns
+        the timing instead (``DispatchBatcher(profiler=...)``)."""
+        self._profiler = profiler
+
     def _call_kernel(self, kernel, *args, **kw):
         """Kernel-call indirection: direct when unbatched, through the
         cross-run batcher when a client is attached.  Array-valued
         keyword arguments (the realtime-bw rows) batch along with the
         positional arrays; plain keywords stay static."""
         if self._batch_client is None:
+            prof = self._profiler
+            if prof is not None and prof.enabled:
+                # The profiler owns the wall capture (obs-boundary:
+                # this module stays clock-free) and the sampling
+                # decision (deterministic per-family cadence).
+                from pivot_tpu.obs.profiler import family_of
+
+                return prof.profile(
+                    family_of(kernel),
+                    lambda: kernel(*args, **kw),
+                    shape=_dispatch_shape(args, kw),
+                )
             return kernel(*args, **kw)
         arr_kw = {k: v for k, v in kw.items() if hasattr(v, "shape")}
         static_kw = {k: v for k, v in kw.items() if k not in arr_kw}

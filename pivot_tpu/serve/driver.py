@@ -137,6 +137,7 @@ class ServeDriver(LogMixin):
         tracer=None,
         registry=None,
         clock: Optional[ObsClock] = None,
+        profiler=None,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
@@ -172,6 +173,16 @@ class ServeDriver(LogMixin):
         self.tracer = tracer or NULL_TRACER
         self.registry = registry
         self.clock = clock or ObsClock()
+        #: Sampled dispatch profiler (round 15, ``obs/profiler.py``):
+        #: attached to every device-backed session policy (direct
+        #: dispatches) AND the shared batcher (coalesced flushes); its
+        #: census lands in :meth:`publish_metrics` and its ``device``
+        #: spans on the service trace timeline.  ``None`` = zero cost.
+        self.profiler = profiler
+        if profiler is not None and profiler.tracer is None:
+            # Device spans land on the service-wide timeline unless the
+            # caller attached a dedicated tracer explicitly.
+            profiler.tracer = self.tracer
         self.slo = slo or SloMeter(clock=self.clock)
         self.queue = AdmissionQueue(
             queue_depth, backpressure, self.slo,
@@ -233,6 +244,10 @@ class ServeDriver(LogMixin):
             # clock-unification contract).
             s.clock = self.clock
             s.meter.clock = self.clock
+            if self.profiler is not None and hasattr(
+                s.policy, "enable_profiler"
+            ):
+                s.policy.enable_profiler(self.profiler)
 
     # -- gate + coordination ----------------------------------------------
     def wait_released(self, session: ServeSession, t: float,
@@ -503,6 +518,10 @@ class ServeDriver(LogMixin):
         new.scheduler.tracer = self.tracer
         new.clock = self.clock  # one wall epoch service-wide
         new.meter.clock = self.clock
+        if self.profiler is not None and hasattr(
+            new.policy, "enable_profiler"
+        ):
+            new.policy.enable_profiler(self.profiler)
         client = None
         if self.batcher is not None:
             client = self.batcher.respawn_client()
@@ -990,7 +1009,7 @@ class ServeDriver(LogMixin):
 
                 self.batcher = DispatchBatcher(
                     len(self.sessions), flush_after=self.flush_after,
-                    tracer=self.tracer,
+                    tracer=self.tracer, profiler=self.profiler,
                 )
                 clients = [self.batcher.client() for _ in self.sessions]
                 for s, c in zip(self.sessions, clients):
@@ -1061,13 +1080,22 @@ class ServeDriver(LogMixin):
         """Publish the service's full metrics state into the unified
         registry (``pivot_tpu.obs.MetricsRegistry``) — the SLO meter
         (counters, tiers, distributions, dispatch mix), the autoscaler
-        action log, and per-session run meters — and return the JSON
-        snapshot.  Uses the driver's attached registry when none is
-        passed; None when neither exists."""
+        action log, per-session run meters, and the dispatch-profiler
+        census — and return the JSON snapshot.  Uses the driver's
+        attached registry when none is passed; None when neither
+        exists.
+
+        Scrape-safe (round 15, ``serve --metrics-port``): callable
+        mid-run from the HTTP endpoint's worker thread — the mutable
+        pool state is snapshotted under the cv, the SLO meter and
+        registry lock internally, and publish-style ``set`` makes
+        republishing idempotent."""
         registry = registry or self.registry
         if registry is None:
             return None
         self.slo.publish_metrics(registry)
+        with self._cv:
+            sessions = list(self.sessions) + list(self._retired)
         if self._autoscaler is not None:
             registry.counter(
                 "pivot_autoscale_actions_total",
@@ -1075,14 +1103,16 @@ class ServeDriver(LogMixin):
                 labelnames=("action",),
             )
             actions: Dict[str, int] = {}
-            for evt in self._autoscaler.events:
+            for evt in list(self._autoscaler.events):
                 actions[evt["action"]] = actions.get(evt["action"], 0) + 1
             for action, n in actions.items():
                 registry.set(
                     "pivot_autoscale_actions_total", n, action=action
                 )
-        for s in self.sessions + self._retired:
+        for s in sessions:
             s.meter.publish_metrics(registry, run=s.label)
+        if self.profiler is not None:
+            self.profiler.publish_metrics(registry)
         return registry.to_json()
 
     def report(self) -> dict:
@@ -1119,6 +1149,12 @@ class ServeDriver(LogMixin):
             ),
             "slo": self.slo.snapshot(),
             "batcher": dict(self.batcher.stats) if self.batcher else None,
+            # Dispatch-profiler census (round 15): per-family sampled
+            # latency + model-ratio medians; present when profiling.
+            **(
+                {"profiler": self.profiler.summary()}
+                if self.profiler is not None else {}
+            ),
             "per_session": [
                 s.summary() for s in self.sessions + self._retired
             ],

@@ -1,0 +1,698 @@
+"""Performance observability (round 15, ISSUE 13).
+
+Acceptance bars:
+
+  * the sampled :class:`DispatchProfiler` is zero-cost when disabled,
+    deterministic in WHICH dispatches it samples (replayable under a
+    fixed seed), and a profiled serve soak is bit-identical to an
+    unprofiled one — placements, meter snapshots, SLO counters (the
+    honest <3% wall figure is ``bench.py``'s ``profiler_overhead``
+    row; the bits are pinned here);
+  * profiler ``device`` spans land on the service timeline with
+    shape + analytic-prediction args, nest inside their batcher flush
+    spans (``obs_report --check``), and feed the report's perf
+    section (per-family census, top-N with attribution, drift);
+  * every jitmap-registered XLA entry point has a cost-attribution
+    row or an explicit flag (register-or-flag,
+    ``pivot_tpu/obs/costattr.py``);
+  * ``tools/bench_history.py`` gates tracked bench rows against the
+    rolling best with bracketed-pair noise floors: clean on the
+    committed baseline, non-zero on a seeded synthetic regression;
+  * ``serve --metrics-port`` serves the live registry exposition
+    (scrape-during-soak);
+  * the ``profiler-boundary`` graftcheck pass pins the profiler's
+    call sites (seeded-violation tests).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pivot_tpu.analysis import repo_root, run as graftcheck_run
+from pivot_tpu.obs import (
+    DispatchProfiler,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    Tracer,
+)
+from pivot_tpu.serve import ServeDriver, ServeSession, poisson_arrivals
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.utils.config import (
+    ClusterConfig,
+    PolicyConfig,
+    build_cluster,
+    make_policy,
+)
+
+
+def _load_tool(name: str):
+    path = os.path.join(repo_root(), "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Sampling cadence: deterministic, seed-replayable, zero-cost off
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_cadence_is_deterministic_and_seed_replayable():
+    a = DispatchProfiler(sample_every=8, seed=13)
+    b = DispatchProfiler(sample_every=8, seed=13)
+    # The pure cadence function agrees across instances with one seed.
+    assert a.sampled_indices("cost_aware", 100) == b.sampled_indices(
+        "cost_aware", 100
+    )
+    # ...and matches what profile() actually samples, call by call.
+    sampled = []
+    for i in range(64):
+        before = a._stats.get("cost_aware")
+        before_n = before.sampled if before else 0
+        a.profile("cost_aware", lambda: 1)
+        now = a._stats["cost_aware"].sampled
+        if now > before_n:
+            sampled.append(i)
+    assert sampled == a.sampled_indices("cost_aware", 64)
+    assert len(sampled) == 8  # 64 calls at 1-in-8
+    # A different seed phases differently for at least some family.
+    c = DispatchProfiler(sample_every=8, seed=14)
+    assert any(
+        c.sampled_indices(fam, 64) != a.sampled_indices(fam, 64)
+        for fam in ("cost_aware", "first_fit", "fused_tick_run")
+    )
+    # Families are phase-independent: the cadence is per family.
+    counts = a.summary()["families"]["cost_aware"]
+    assert counts["calls"] == 64 and counts["sampled"] == 8
+
+
+def test_disabled_profiler_is_passthrough():
+    prof = DispatchProfiler(sample_every=1, enabled=False)
+    calls = []
+    out = prof.profile("x", lambda: calls.append(1) or "result")
+    assert out == "result" and calls == [1]
+    assert prof.summary()["families"] == {}
+    # publish into a registry is a no-op shape (no families).
+    reg = MetricsRegistry()
+    prof.publish_metrics(reg)
+    assert "pivot_dispatch_calls_total" in reg.families()
+
+
+def test_sample_every_validation():
+    with pytest.raises(ValueError):
+        DispatchProfiler(sample_every=0)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance soak: profiler-on bit-identical, spans nest
+# ---------------------------------------------------------------------------
+
+
+def _device_policy():
+    return make_policy(
+        PolicyConfig(
+            name="cost-aware", device="tpu", bin_pack="first-fit",
+            sort_tasks=True, sort_hosts=True, adaptive=False,
+        )
+    )
+
+
+def _profiled_soak(profiler, tracer=None):
+    reset_ids()
+    sessions = [
+        ServeSession(
+            f"s{g}",
+            build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            _device_policy(),
+            seed=0,
+        )
+        for g in range(2)
+    ]
+    driver = ServeDriver(
+        sessions, queue_depth=32, backpressure="shed",
+        tracer=tracer, profiler=profiler,
+    )
+    report = driver.run(poisson_arrivals(0.5, 10, seed=3))
+    placements = [
+        (
+            s.label,
+            [
+                (a.id, round(a.start_time, 9), round(a.end_time, 9))
+                for a in s.completed
+            ],
+        )
+        for s in driver.sessions
+    ]
+    meters = []
+    for s in driver.sessions:
+        summary = s.meter.summary()
+        summary.pop("wall_clock")
+        meters.append((s.label, summary))
+    return report, placements, meters
+
+
+def test_profiled_soak_bit_identical_and_device_spans_nest(tmp_path):
+    """Satellite 4's spine: profiler-on serve soak bit-identical to
+    profiler-off (placements, meter, SLO counters), device spans carry
+    shape+prediction args and nest inside their flush spans."""
+    obs_report = _load_tool("obs_report")
+    report_off, placements_off, meters_off = _profiled_soak(None)
+    tracer = Tracer()
+    prof = DispatchProfiler(sample_every=2, seed=0)
+    report_on, placements_on, meters_on = _profiled_soak(
+        prof, tracer=tracer
+    )
+
+    # -- observation must not perturb the system --
+    assert placements_on == placements_off
+    assert meters_on == meters_off
+    assert (
+        report_on["slo"]["counters"] == report_off["slo"]["counters"]
+    )
+
+    # -- the profiler actually profiled, and reported --
+    fams = prof.summary()["families"]
+    assert sum(f["sampled"] for f in fams.values()) > 0
+    assert report_on["profiler"]["families"] == fams
+
+    # -- device spans: shape args + flush nesting, checked end to end --
+    dev = [e for e in tracer.events if e["cat"] == "device"]
+    assert dev, "sampled dispatches must land on the device lane"
+    for e in dev:
+        args = e["args"]
+        assert "backend" in args and "h" in args
+        assert args.get("in_flush") or "b" in args
+    path = str(tmp_path / "profiled.perfetto.json")
+    tracer.save_perfetto(path)
+    events = obs_report.load_events(path)
+    assert obs_report.check_events(events) == []
+    # The perf section sees the same spans.
+    report = obs_report.build_report(events)
+    dd = report["device_dispatch"]
+    assert dd["sampled_spans"] == len(dev)
+    assert dd["families"] and dd["top_slow"]
+    # The registry export carries the census.
+    reg = MetricsRegistry()
+    prof.publish_metrics(reg)
+    text = reg.to_prometheus()
+    assert "pivot_dispatch_latency_seconds" in text
+    assert "pivot_dispatch_calls_total" in text
+
+
+def test_obs_report_flags_unnested_flush_span(tmp_path):
+    """--check regression: an in_flush device span outside every flush
+    interval is a violation (the profiler timed something that is not
+    the flushed device call)."""
+    obs_report = _load_tool("obs_report")
+    doc = {
+        "traceEvents": [
+            {"name": "flush", "cat": "dispatch", "ph": "X", "pid": 0,
+             "tid": "dispatch", "ts": 100.0, "dur": 50.0},
+            {"name": "cost_aware", "cat": "device", "ph": "X", "pid": 0,
+             "tid": "device", "ts": 110.0, "dur": 30.0,
+             "args": {"in_flush": True}},
+            {"name": "cost_aware", "cat": "device", "ph": "X", "pid": 0,
+             "tid": "device", "ts": 400.0, "dur": 30.0,
+             "args": {"in_flush": True}},
+        ]
+    }
+    path = str(tmp_path / "nest.json")
+    json.dump(doc, open(path, "w"))
+    errors = obs_report.check_events(obs_report.load_events(path))
+    assert len(errors) == 1 and "nests inside no" in errors[0]
+
+
+def test_obs_report_perf_census_and_drift(tmp_path):
+    """The perf section: per-family census aggregates the device lane,
+    and a family whose median measured/model ratio leaves [0.5, 2]
+    raises a loud drift finding."""
+    obs_report = _load_tool("obs_report")
+    tr = Tracer()
+    for i in range(6):
+        tr.record_span(
+            "device", "cost_aware", 0.004,
+            backend="cpu", b=32, h=64, pred_us=1000.0,
+            model_ratio=4.0,
+        )
+        tr.record_span(
+            "device", "first_fit", 0.001,
+            backend="cpu", b=32, h=64, pred_us=900.0,
+            model_ratio=1.1,
+        )
+    path = str(tmp_path / "perf.jsonl")
+    tr.save_jsonl(path)
+    report = obs_report.build_report(obs_report.load_events(path))
+    dd = report["device_dispatch"]
+    assert dd["families"]["cost_aware"]["n"] == 6
+    assert dd["families"]["cost_aware"]["model_ratio_p50"] == 4.0
+    assert dd["families"]["first_fit"]["model_ratio_p50"] == 1.1
+    assert len(dd["drift"]) == 1 and "cost_aware" in dd["drift"][0]
+    assert all("first_fit" not in d for d in dd["drift"])
+
+
+# ---------------------------------------------------------------------------
+# XLA cost attribution: register-or-flag coverage
+# ---------------------------------------------------------------------------
+
+
+def test_cost_attribution_covers_every_jitmap_entry_point():
+    from pivot_tpu.obs.costattr import coverage_problems
+
+    assert coverage_problems() == []
+
+
+def test_cost_attribution_rows_measure_real_programs():
+    from pivot_tpu.obs.costattr import cost_attribution
+
+    ca = cost_attribution(T=16, H=8)
+    assert ca["complete"], ca["coverage_problems"]
+    measured = {
+        name: row for name, row in ca["rows"].items() if "flops" in row
+    }
+    # Every placement-kernel family + the fused driver measure.
+    for name in (
+        "opportunistic_kernel", "first_fit_kernel", "best_fit_kernel",
+        "cost_aware_kernel", "cost_aware_kernel_ref", "_fused_tick_run",
+    ):
+        assert name in measured, name
+        assert measured[name]["flops"] > 0
+        assert measured[name]["bytes"] > 0
+        assert measured[name]["analytic_flops"] > 0
+    # Flag rows carry their reasons.
+    flagged = {
+        name: row for name, row in ca["rows"].items()
+        if "flagged" in row
+    }
+    assert "cost_aware_pallas" in flagged
+    assert ca["measured"] == len(measured)
+    assert ca["flagged"] == len(flagged)
+
+
+def test_cost_attribution_flags_unregistered_site(monkeypatch):
+    """Register-or-flag: a jit site missing from the manifest is a
+    coverage problem (simulated by shrinking the manifest)."""
+    from pivot_tpu.obs import costattr
+
+    trimmed = dict(costattr.ENTRY_POINTS)
+    removed = ("pivot_tpu/ops/kernels.py", "cost_aware_kernel")
+    del trimmed[removed]
+    monkeypatch.setattr(costattr, "ENTRY_POINTS", trimmed)
+    problems = costattr.coverage_problems()
+    assert any("cost_aware_kernel" in p for p in problems)
+    # ...and a stale manifest entry equally.
+    stale = dict(costattr.ENTRY_POINTS)
+    stale[("pivot_tpu/ops/kernels.py", "no_such_kernel")] = (
+        "flag", "gone"
+    )
+    monkeypatch.setattr(costattr, "ENTRY_POINTS", stale)
+    problems = costattr.coverage_problems()
+    assert any("no_such_kernel" in p and "stale" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# bench_history: the continuous-bench regression gate
+# ---------------------------------------------------------------------------
+
+
+def _history_record(bh, metrics, noise=None, rev="abc1234"):
+    return {
+        "recorded_at": "2026-08-04T00:00:00+00:00",
+        "git_rev": rev,
+        "backend": "cpu",
+        "fingerprint": bh.fingerprint(),
+        "metrics": dict(metrics),
+        "noise": dict(noise or {}),
+    }
+
+
+_BASE_METRICS = {
+    "fused_tick_k16_per_tick_us": 364.0,
+    "two_phase_dps": 97000.0,
+    "obs_overhead_pct": 1.2,
+    "profiler_overhead_pct": 1.5,
+    "serve_tiers_dps": 72.0,
+}
+_BASE_NOISE = {"obs_overhead_pct": 1.0, "profiler_overhead_pct": 1.0}
+
+
+def test_bench_history_clean_within_floor_and_fails_on_regression():
+    bh = _load_tool("bench_history")
+    ref = [
+        _history_record(bh, _BASE_METRICS, _BASE_NOISE),
+        _history_record(bh, {
+            **_BASE_METRICS,
+            "fused_tick_k16_per_tick_us": 371.0,  # bracketed pair
+            "two_phase_dps": 95500.0,
+        }, _BASE_NOISE),
+    ]
+    # Within-noise candidate: clean.
+    cand = _history_record(bh, {
+        **_BASE_METRICS,
+        "fused_tick_k16_per_tick_us": 380.0,
+        "two_phase_dps": 93000.0,
+    }, _BASE_NOISE)
+    regressions, _notes = bh.check_candidate(cand, ref)
+    assert regressions == []
+    # A 2x fused-tick slowdown regresses loudly.
+    slow = _history_record(bh, {
+        **_BASE_METRICS, "fused_tick_k16_per_tick_us": 364.0 * 2,
+    }, _BASE_NOISE)
+    regressions, _ = bh.check_candidate(slow, ref)
+    assert len(regressions) == 1
+    assert "fused_tick_k16_per_tick_us" in regressions[0]
+    # A throughput collapse on a higher-better metric too.
+    slow2 = _history_record(bh, {
+        **_BASE_METRICS, "two_phase_dps": 97000.0 / 2,
+    }, _BASE_NOISE)
+    regressions, _ = bh.check_candidate(slow2, ref)
+    assert len(regressions) == 1 and "two_phase_dps" in regressions[0]
+    # Improvements never regress.
+    fast = _history_record(bh, {
+        **_BASE_METRICS,
+        "fused_tick_k16_per_tick_us": 200.0,
+        "two_phase_dps": 150000.0,
+    }, _BASE_NOISE)
+    assert bh.check_candidate(fast, ref)[0] == []
+
+
+def test_bench_history_missing_tracked_row_fails_unless_waived():
+    bh = _load_tool("bench_history")
+    ref = [_history_record(bh, _BASE_METRICS, _BASE_NOISE)]
+    dropped = {
+        k: v for k, v in _BASE_METRICS.items() if k != "two_phase_dps"
+    }
+    cand = _history_record(bh, dropped, _BASE_NOISE)
+    regressions, _ = bh.check_candidate(cand, ref)
+    assert any("missing" in r for r in regressions)
+    regressions, _ = bh.check_candidate(cand, ref, allow_missing=True)
+    assert regressions == []
+
+
+def test_bench_history_ignores_foreign_fingerprints():
+    bh = _load_tool("bench_history")
+    foreign = _history_record(bh, {
+        **_BASE_METRICS, "two_phase_dps": 10_000_000.0,  # another box
+    })
+    foreign["fingerprint"] = dict(
+        foreign["fingerprint"], machine="tpu-superpod"
+    )
+    ref = [foreign, _history_record(bh, _BASE_METRICS, _BASE_NOISE)]
+    cand = _history_record(bh, _BASE_METRICS, _BASE_NOISE)
+    regressions, notes = bh.check_candidate(cand, ref)
+    # The 10M-dps foreign record must NOT become the rolling best.
+    assert regressions == []
+    assert any("different machine" in n for n in notes)
+
+
+def test_bench_history_cli_gate_on_committed_baseline(tmp_path):
+    """THE acceptance pair: exit 0 on the committed baseline, non-zero
+    on a seeded synthetic regression injected into it."""
+    root = repo_root()
+    baseline = os.path.join(root, "data", "bench", "ci_baseline.jsonl")
+    assert os.path.exists(baseline), (
+        "committed bench baseline missing — regenerate with bench.py "
+        "--rows ... --json + bench_history.py append"
+    )
+    clean = subprocess.run(
+        [sys.executable, "tools/bench_history.py", "check",
+         "--history", baseline],
+        cwd=root, capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stderr + clean.stdout
+    injected = subprocess.run(
+        [sys.executable, "tools/bench_history.py", "check",
+         "--history", baseline,
+         "--inject-regression", "two_phase_dps:2.0", "--seed", "7"],
+        cwd=root, capture_output=True, text=True, timeout=120,
+    )
+    assert injected.returncode == 1, injected.stdout + injected.stderr
+    assert "REGRESSION" in injected.stderr
+    # The injection is seeded: two runs report the identical verdict.
+    injected2 = subprocess.run(
+        [sys.executable, "tools/bench_history.py", "check",
+         "--history", baseline,
+         "--inject-regression", "two_phase_dps:2.0", "--seed", "7"],
+        cwd=root, capture_output=True, text=True, timeout=120,
+    )
+    assert injected2.stderr == injected.stderr
+    # pct-kind metrics fire too: the injection scales with the SAME
+    # noise-derived allowance the gate applies (review round 15 — a
+    # fixed-points bump under a wide measured floor read as "gate
+    # works" while the gate could never fire).
+    pct = subprocess.run(
+        [sys.executable, "tools/bench_history.py", "check",
+         "--history", baseline,
+         "--inject-regression", "profiler_overhead_pct:2.0",
+         "--seed", "7"],
+        cwd=root, capture_output=True, text=True, timeout=120,
+    )
+    assert pct.returncode == 1, pct.stdout + pct.stderr
+    assert "profiler_overhead_pct" in pct.stderr
+
+
+def test_bench_history_append_roundtrip(tmp_path):
+    bh = _load_tool("bench_history")
+    row = tmp_path / "row.json"
+    line = {
+        "backend": "cpu",
+        "fused_tick": {"per_k": {"16": {"per_tick_fused_s": 3.6e-4}}},
+        "two_phase": {"two_phase_dps": 90000.0},
+        "obs_overhead": {
+            "tracer_on_overhead_pct": 1.0,
+            "tracer_off_noise_pct": 0.8,
+        },
+        "profiler_overhead": {
+            "profiler_on_overhead_pct": 1.2,
+            "profiler_off_noise_pct": 0.9,
+        },
+        "serve_tiers": {"fixed_pool": {"decisions_per_sec": 70.0}},
+    }
+    row.write_text(json.dumps(line) + "\n")
+    hist = tmp_path / "hist.jsonl"
+    rc = bh.main([
+        "append", "--row", str(row), "--history", str(hist),
+    ])
+    assert rc == 0
+    records = bh.load_history(str(hist))
+    assert len(records) == 1
+    assert records[0]["metrics"]["fused_tick_k16_per_tick_us"] == 360.0
+    assert records[0]["noise"]["obs_overhead_pct"] == 0.8
+    # Single record: vacuously clean, says so, exits 0.
+    assert bh.main(["check", "--history", str(hist)]) == 0
+    # Append a second and gate a fresh identical row file: still clean.
+    assert bh.main([
+        "append", "--row", str(row), "--history", str(hist),
+    ]) == 0
+    assert bh.main([
+        "check", "--history", str(hist), "--row", str(row),
+    ]) == 0
+
+
+# ---------------------------------------------------------------------------
+# serve --metrics-port: scrape during soak
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_http_scrape_during_soak():
+    reset_ids()
+    sessions = [
+        ServeSession(
+            f"m{g}",
+            build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            make_policy(PolicyConfig(
+                name="cost-aware", device="numpy",
+                sort_tasks=True, sort_hosts=True,
+            )),
+            seed=0,
+        )
+        for g in range(2)
+    ]
+    registry = MetricsRegistry()
+    driver = ServeDriver(
+        sessions, queue_depth=32, backpressure="shed",
+        registry=registry,
+    )
+
+    def render() -> str:
+        driver.publish_metrics(registry)
+        return registry.to_prometheus()
+
+    server = MetricsHTTPServer(
+        render, lambda: driver.publish_metrics(registry) or {},
+    )
+    port = server.start()
+    scrapes = {"n": 0, "errors": []}
+    done = threading.Event()
+
+    def scraper():
+        while not done.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ) as resp:
+                    body = resp.read().decode()
+                    assert resp.status == 200
+                    scrapes["n"] += 1
+                    scrapes["last"] = body
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                scrapes["errors"].append(repr(exc))
+                return
+            time.sleep(0.01)  # scrape cadence, not a busy loop
+
+    thread = threading.Thread(target=scraper, daemon=True)
+    try:
+        thread.start()
+        report = driver.run(poisson_arrivals(0.4, 12, seed=5))
+    finally:
+        done.set()
+        thread.join(timeout=10)
+        server.stop()
+    assert scrapes["errors"] == [], scrapes["errors"]
+    assert scrapes["n"] > 0, "no successful scrape during the soak"
+    assert report["slo"]["counters"]["completed"] == 12
+    # The final exposition carries the serve counter families.
+    final = render()
+    assert "pivot_serve_events_total" in final
+    assert 'event="completed"' in final
+
+
+def test_metrics_http_routes_and_errors():
+    reg = MetricsRegistry()
+    reg.counter("x_total")
+    reg.inc("x_total")
+    server = MetricsHTTPServer(
+        reg.to_prometheus, reg.to_json,
+    )
+    port = server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert "x_total 1" in resp.read().decode()
+            assert "0.0.4" in resp.headers["Content-Type"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read())
+            assert doc["metrics"]["x_total"]["kind"] == "counter"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5
+            )
+        assert exc.value.code == 404
+    finally:
+        server.stop()
+    # A failing render answers 500, not a dead worker: restart with a
+    # poisoned render and scrape twice.
+    def boom() -> str:
+        raise RuntimeError("poisoned")
+
+    server2 = MetricsHTTPServer(boom)
+    port2 = server2.start()
+    try:
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port2}/metrics", timeout=5
+                )
+            assert exc.value.code == 500
+    finally:
+        server2.stop()
+
+
+# ---------------------------------------------------------------------------
+# The profiler-boundary graftcheck pass
+# ---------------------------------------------------------------------------
+
+
+def _prof_skeleton(tmp_path):
+    """Minimal tree satisfying the pass's boundary registry."""
+    files = {
+        "pivot_tpu/sched/tpu.py": """\
+            def _call_kernel(self, kernel):
+                return self._profiler.profile("k", lambda: kernel())
+        """,
+        "pivot_tpu/sched/batch.py": """\
+            def _execute(self, reqs):
+                return self.profiler.profile("k", lambda: reqs)
+        """,
+        "pivot_tpu/ops/__init__.py": "",
+    }
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+    return str(tmp_path)
+
+
+def test_profiler_boundary_clean_on_skeleton(tmp_path):
+    _prof_skeleton(tmp_path)
+    assert graftcheck_run(
+        root=str(tmp_path), rules=["profiler-boundary"]
+    ) == []
+
+
+def test_profiler_boundary_flags_unregistered_call_site(tmp_path):
+    _prof_skeleton(tmp_path)
+    bad = tmp_path / "pivot_tpu" / "serve" / "rogue.py"
+    bad.parent.mkdir(parents=True, exist_ok=True)
+    bad.write_text(textwrap.dedent("""\
+        def route(self, arrival):
+            self.profiler.profile("route", lambda: arrival)
+    """))
+    findings = graftcheck_run(
+        root=str(tmp_path), rules=["profiler-boundary"]
+    )
+    assert len(findings) == 1
+    assert "not a registered dispatch boundary" in findings[0].message
+    assert "route" in findings[0].message
+
+
+def test_profiler_boundary_rename_protection(tmp_path):
+    _prof_skeleton(tmp_path)
+    # Rename the batch boundary away: its registry entry must flag.
+    (tmp_path / "pivot_tpu" / "sched" / "batch.py").write_text(
+        "def _execute_renamed(self):\n    return 1\n"
+    )
+    findings = graftcheck_run(
+        root=str(tmp_path), rules=["profiler-boundary"]
+    )
+    assert any(
+        "_execute" in f.message and "no longer exists" in f.message
+        for f in findings
+    )
+
+
+def test_profiler_boundary_flags_device_layer_import(tmp_path):
+    _prof_skeleton(tmp_path)
+    bad = tmp_path / "pivot_tpu" / "ops" / "instrumented.py"
+    bad.write_text(textwrap.dedent("""\
+        from pivot_tpu.obs.profiler import DispatchProfiler
+        from pivot_tpu.obs import DispatchProfiler as DP
+    """))
+    findings = graftcheck_run(
+        root=str(tmp_path), rules=["profiler-boundary"]
+    )
+    assert len(findings) == 2
+    assert all("device-layer" in f.message for f in findings)
+
+
+def test_profiler_boundary_clean_on_this_repo():
+    assert graftcheck_run(rules=["profiler-boundary"]) == []
+
+
+def test_graftcheck_registry_carries_profiler_boundary():
+    from pivot_tpu.analysis import REGISTRY
+
+    assert "profiler-boundary" in REGISTRY()

@@ -1,0 +1,559 @@
+#!/usr/bin/env python3
+"""Continuous-bench history: append bench rows, gate on regressions.
+
+The bench artifact answers "how fast is it *now*"; nothing compared
+against *last week* — a 2× slowdown on the fused-tick path would pass
+every test and every bench row (ISSUE 13 motivation; Bronson et al.'s
+metastable-failures argument that sustained-degradation detection must
+be automatic).  This tool is that comparison:
+
+  * **append** — parse a ``bench.py`` artifact (the final JSON line;
+    ``bench.py --json PATH`` writes it directly), extract the tracked
+    metrics, stamp a machine fingerprint + git revision, and append one
+    JSONL record to the history file;
+  * **check** — the regression gate (exit 1 on regression, 0 clean,
+    2 on usage/schema errors): the candidate record (the history's
+    last, or ``--row`` for a fresh artifact) is compared per tracked
+    metric against the **rolling best** of all fingerprint-compatible
+    earlier records, with a **noise floor** derived from bracketed
+    pairs — consecutive same-fingerprint records (the committed
+    baseline is appended twice back-to-back for exactly this reason)
+    plus the row's own off/off noise estimate where the bench measures
+    one (obs_overhead / profiler_overhead).  A metric regresses when it
+    is worse than the rolling best by more than ``--margin`` × floor.
+
+Tracked rows (the ISSUE-13 set): ``fused_tick`` (K=16 fused per-tick
+wall), ``two_phase`` (single-dispatch decisions/s), ``obs_overhead``
+and ``profiler_overhead`` (enabled-cost percentages), ``serve_tiers``
+(fixed-pool sustained decisions/s).
+
+Noise model: throughput-like metrics ("rate") use a *relative* floor —
+max(default 10%, median relative gap of bracketed pairs); percentage
+metrics ("pct", already small numbers near zero) use an *absolute*
+floor in percentage points — max(1.0, the row's own measured off/off
+noise, bracketed-pair gaps).  Records from a different machine
+fingerprint (cpu count / arch / backend) are excluded from the
+reference set: cross-box walls are not comparable.
+
+Seeded synthetic regression (CI self-test): ``--inject-regression
+metric:factor --seed N`` degrades the candidate's named metric by
+``factor`` (with a small seeded jitter) and runs the same gate — the
+smoke lane asserts this exits non-zero, so the gate can never rot into
+a rubber stamp.
+
+Stdlib-only: the smoke-lane quick gate must not import jax.
+
+Usage::
+
+    python bench.py --json /tmp/row.json
+    python tools/bench_history.py append --row /tmp/row.json \
+        --history data/bench/history.jsonl
+    python tools/bench_history.py check --history data/bench/history.jsonl
+    python tools/bench_history.py check \
+        --history data/bench/ci_baseline.jsonl \
+        --inject-regression two_phase_dps:2.0 --seed 7   # must exit 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import platform
+import random
+import subprocess
+import sys
+from statistics import median as _median
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+DEFAULT_HISTORY = "data/bench/history.jsonl"
+
+#: Relative noise floor (percent) for throughput metrics when the
+#: history carries no bracketed pairs to measure one from.
+DEFAULT_REL_FLOOR_PCT = 10.0
+#: Absolute floor (percentage points) for pct-kind metrics.
+DEFAULT_PCT_FLOOR = 1.0
+
+
+class Metric(NamedTuple):
+    """One tracked bench metric.  ``rel_floor`` is the metric's
+    minimum relative noise floor in percent ("rate" kind) — raised for
+    rows whose wall is service-throughput-shaped and therefore rides
+    the box's load (measured run-to-run spread on the CI box), never
+    lowered below the bracketed-pair estimate."""
+
+    name: str
+    path: Tuple[str, ...]          # into the bench JSON line
+    lower_better: bool
+    kind: str                      # "rate" (relative) | "pct" (absolute)
+    scale: float = 1.0
+    noise_path: Optional[Tuple[str, ...]] = None  # row-local noise, pct
+    rel_floor: float = DEFAULT_REL_FLOOR_PCT
+
+
+TRACKED: Tuple[Metric, ...] = (
+    Metric(
+        "fused_tick_k16_per_tick_us",
+        ("fused_tick", "per_k", "16", "per_tick_fused_s"),
+        lower_better=True, kind="rate", scale=1e6,
+    ),
+    Metric(
+        "two_phase_dps",
+        ("two_phase", "two_phase_dps"),
+        lower_better=False, kind="rate",
+    ),
+    Metric(
+        "obs_overhead_pct",
+        ("obs_overhead", "tracer_on_overhead_pct"),
+        lower_better=True, kind="pct",
+        noise_path=("obs_overhead", "tracer_off_noise_pct"),
+    ),
+    Metric(
+        "profiler_overhead_pct",
+        ("profiler_overhead", "profiler_on_overhead_pct"),
+        lower_better=True, kind="pct",
+        noise_path=("profiler_overhead", "profiler_off_noise_pct"),
+    ),
+    Metric(
+        "serve_tiers_dps",
+        ("serve_tiers", "fixed_pool", "decisions_per_sec"),
+        lower_better=False, kind="rate",
+        # Sustained service throughput over a threaded soak: the most
+        # load-sensitive tracked row (±25% run-to-run on the CI box);
+        # 30% floor x 1.5 margin still fires on a 2x collapse.
+        rel_floor=30.0,
+    ),
+)
+
+
+def _dig(doc: Any, path: Tuple[str, ...]) -> Optional[float]:
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return float(cur) if isinstance(cur, (int, float)) else None
+
+
+def fingerprint() -> Dict[str, Any]:
+    """What makes two records wall-clock comparable: the box and the
+    backend-visible resources (NOT hostname — fleet twins of one image
+    are comparable; an address is not a capability)."""
+    return {
+        "machine": platform.machine(),
+        "system": platform.system(),
+        "cpu_count": os.cpu_count(),
+        "python": ".".join(map(str, sys.version_info[:2])),
+    }
+
+
+def _fp_key(rec: dict) -> tuple:
+    fp = rec.get("fingerprint", {})
+    return (
+        fp.get("machine"), fp.get("system"), fp.get("cpu_count"),
+        rec.get("backend"),
+    )
+
+
+def _git_rev() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        return subprocess.run(
+            ["git", "-C", here, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001 — the record matters more
+        return "unknown"
+
+
+def load_bench_line(path: str) -> dict:
+    """The authoritative final JSON line of a bench artifact (a --json
+    file holds exactly one; a captured stdout stream may hold a
+    superseded line first)."""
+    with open(path) as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+    for ln in reversed(lines):
+        try:
+            doc = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    raise ValueError(f"{path}: no JSON object line found")
+
+
+def record_from_line(line: dict, note: str = "") -> dict:
+    metrics: Dict[str, float] = {}
+    noise: Dict[str, float] = {}
+    for m in TRACKED:
+        val = _dig(line, m.path)
+        if val is not None:
+            metrics[m.name] = round(val * m.scale, 6)
+        if m.noise_path is not None:
+            nv = _dig(line, m.noise_path)
+            if nv is not None:
+                noise[m.name] = round(nv, 6)
+    rec = {
+        "recorded_at": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "git_rev": _git_rev(),
+        "backend": line.get("backend"),
+        "fingerprint": fingerprint(),
+        "metrics": metrics,
+        "noise": noise,
+    }
+    if note:
+        rec["note"] = note
+    return rec
+
+
+def load_history(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out: List[dict] = []
+    with open(path) as fh:
+        for i, ln in enumerate(fh, 1):
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError as exc:
+                raise ValueError(f"{path}:{i}: not JSON ({exc})")
+            if not isinstance(rec, dict) or "metrics" not in rec:
+                raise ValueError(
+                    f"{path}:{i}: not a bench-history record "
+                    "(missing 'metrics')"
+                )
+            out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Noise floors + the gate
+# ---------------------------------------------------------------------------
+
+
+
+
+def bracketed_floor(
+    refs: List[dict], metric: Metric
+) -> Optional[float]:
+    """Noise estimate from bracketed pairs: consecutive records with
+    the same fingerprint measuring the same code revision twice are
+    repeat measurements — their gap IS the floor.  Relative percent for
+    "rate" metrics, absolute points for "pct".  None without pairs."""
+    gaps: List[float] = []
+    for a, b in zip(refs, refs[1:]):
+        if _fp_key(a) != _fp_key(b):
+            continue
+        if a.get("git_rev") != b.get("git_rev"):
+            continue
+        va = a["metrics"].get(metric.name)
+        vb = b["metrics"].get(metric.name)
+        if va is None or vb is None:
+            continue
+        if metric.kind == "rate":
+            lo = min(abs(va), abs(vb))
+            if lo > 0:
+                gaps.append(abs(va - vb) / lo * 100.0)
+        else:
+            gaps.append(abs(va - vb))
+    return _median(gaps) if gaps else None
+
+
+def metric_allowance(
+    m: Metric,
+    candidate: dict,
+    refs: List[dict],
+    best: float,
+    margin: float,
+) -> Tuple[float, Optional[float]]:
+    """(allowed degradation past the rolling best, relative floor %
+    when rate-kind).  ONE implementation shared by the gate and the
+    synthetic-regression injector — an injection that does not scale
+    with the same floor the gate applies silently under-shoots it and
+    the CI self-test reads as "gate works" without the gate ever
+    being able to fire (review round 15)."""
+    pair_floor = bracketed_floor(refs, m)
+    if m.kind == "rate":
+        floor_pct = max(
+            m.rel_floor,
+            pair_floor if pair_floor is not None else 0.0,
+        )
+        return abs(best) * margin * floor_pct / 100.0, floor_pct
+    own_noise = candidate.get("noise", {}).get(m.name, 0.0)
+    ref_noise = [r.get("noise", {}).get(m.name) for r in refs]
+    ref_noise = [n for n in ref_noise if n is not None]
+    floor_pts = max(
+        DEFAULT_PCT_FLOOR,
+        own_noise,
+        _median(ref_noise) if ref_noise else 0.0,
+        pair_floor if pair_floor is not None else 0.0,
+    )
+    return margin * floor_pts, None
+
+
+def check_candidate(
+    candidate: dict,
+    reference: List[dict],
+    margin: float = 1.5,
+    allow_missing: bool = False,
+) -> Tuple[List[str], List[str]]:
+    """(regressions, notes) for one candidate record against the
+    fingerprint-compatible reference set."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    cand_key = _fp_key(candidate)
+    refs = [r for r in reference if _fp_key(r) == cand_key]
+    skipped = len(reference) - len(refs)
+    if skipped:
+        notes.append(
+            f"{skipped} reference record(s) from a different machine "
+            "fingerprint/backend excluded (walls not comparable)"
+        )
+    for m in TRACKED:
+        value = candidate["metrics"].get(m.name)
+        ref_vals = [
+            r["metrics"][m.name] for r in refs
+            if m.name in r.get("metrics", {})
+        ]
+        if value is None:
+            if ref_vals and not allow_missing:
+                regressions.append(
+                    f"{m.name}: tracked row missing from the candidate "
+                    "but present in the history — a silently dropped "
+                    "row hides exactly the regressions this gate "
+                    "exists for (--allow-missing to waive)"
+                )
+            else:
+                notes.append(f"{m.name}: absent (no comparison)")
+            continue
+        if not ref_vals:
+            notes.append(
+                f"{m.name}: no comparable history — recorded, not gated"
+            )
+            continue
+        best = min(ref_vals) if m.lower_better else max(ref_vals)
+        allowance, floor_pct = metric_allowance(
+            m, candidate, refs, best, margin
+        )
+        worse = (
+            value - best if m.lower_better else best - value
+        )
+        if worse > allowance:
+            regressions.append(
+                f"{m.name}: {value:g} regresses past the rolling "
+                f"best {best:g} by {worse:g} (allowed: {allowance:g} = "
+                f"{margin:g} x noise floor"
+                + (
+                    f" {floor_pct:g}%" if floor_pct is not None
+                    else f" {allowance / margin:g} pts"
+                )
+                + f", {len(ref_vals)} reference record(s))"
+            )
+        else:
+            notes.append(
+                f"{m.name}: {value:g} vs best {best:g} — within floor"
+            )
+    return regressions, notes
+
+
+def inject_regression(
+    candidate: dict, spec: str, seed: int,
+    reference: List[dict], margin: float,
+) -> dict:
+    """Seeded synthetic regression: degrade ``metric:factor`` on a copy
+    of the candidate (the CI self-test of the gate).
+
+    Rate metrics degrade multiplicatively (× / ÷ ``factor`` — the
+    "2x collapse" shape the gate is calibrated for).  Pct metrics
+    degrade by ``factor`` × the SAME allowance the gate will apply
+    (:func:`metric_allowance` over the same references) — an absolute
+    bump that ignored the noise-derived floor could land inside a wide
+    allowance and read as "gate works" while the gate never fired."""
+    try:
+        name, factor_s = spec.split(":")
+        factor = float(factor_s)
+    except ValueError:
+        raise SystemExit(
+            f"--inject-regression wants metric:factor, got {spec!r}"
+        )
+    metric = next((m for m in TRACKED if m.name == name), None)
+    if metric is None:
+        raise SystemExit(
+            f"unknown tracked metric {name!r} "
+            f"(tracked: {[m.name for m in TRACKED]})"
+        )
+    if factor <= 1.0:
+        raise SystemExit("--inject-regression factor must be > 1")
+    rng = random.Random(seed)
+    jitter = 1.0 + rng.uniform(-0.01, 0.01)
+    degraded = dict(candidate)
+    degraded["metrics"] = dict(candidate["metrics"])
+    value = degraded["metrics"].get(name)
+    if value is None:
+        raise SystemExit(
+            f"candidate record has no {name} value to degrade"
+        )
+    if metric.kind == "pct":
+        refs = [
+            r for r in reference if _fp_key(r) == _fp_key(candidate)
+        ]
+        allowance, _ = metric_allowance(
+            metric, candidate, refs, value, margin
+        )
+        degraded["metrics"][name] = round(
+            value + factor * jitter * max(allowance, DEFAULT_PCT_FLOOR),
+            6,
+        )
+    elif metric.lower_better:
+        degraded["metrics"][name] = round(value * factor * jitter, 6)
+    else:
+        degraded["metrics"][name] = round(value / factor * jitter, 6)
+    degraded["note"] = f"synthetic regression {spec} seed={seed}"
+    return degraded
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_history",
+        description="append bench rows to a JSONL history and gate on "
+        "regressions vs the rolling best (noise floors from bracketed "
+        "pairs; exit 1 on regression)",
+    )
+    sub = parser.add_subparsers(dest="command")
+    ap = sub.add_parser(
+        "append", help="append one bench artifact to the history"
+    )
+    ap.add_argument(
+        "--row", required=True,
+        help="bench artifact (bench.py --json file, or captured stdout)",
+    )
+    ap.add_argument("--history", default=DEFAULT_HISTORY)
+    ap.add_argument("--note", default="", help="free-form record note")
+    ck = sub.add_parser(
+        "check",
+        help="gate the newest record (or --row) against the rolling "
+        "best of the earlier history",
+    )
+    ck.add_argument("--history", default=DEFAULT_HISTORY)
+    ck.add_argument(
+        "--row", default="",
+        help="fresh bench artifact to gate against the FULL history "
+        "(default: the history's last record against the earlier ones)",
+    )
+    ck.add_argument(
+        "--margin", type=float, default=1.5,
+        help="regression threshold in noise-floor multiples "
+        "(default 1.5 — with the 30%% serve-tiers floor this still "
+        "fires on a 2x collapse of every tracked row)",
+    )
+    ck.add_argument(
+        "--allow-missing", action="store_true",
+        help="a tracked row absent from the candidate is a note, not "
+        "a failure",
+    )
+    ck.add_argument(
+        "--inject-regression", default="", metavar="METRIC:FACTOR",
+        help="degrade the candidate's metric by FACTOR first (seeded "
+        "synthetic regression — the gate's CI self-test must exit 1)",
+    )
+    ck.add_argument(
+        "--seed", type=int, default=0,
+        help="jitter seed for --inject-regression",
+    )
+    args = parser.parse_args(argv)
+    if args.command is None:
+        parser.print_help()
+        return 2
+
+    if args.command == "append":
+        try:
+            line = load_bench_line(args.row)
+        except (OSError, ValueError) as exc:
+            print(f"bench_history: {exc}", file=sys.stderr)
+            return 2
+        rec = record_from_line(line, note=args.note)
+        if not rec["metrics"]:
+            print(
+                "bench_history: artifact carries none of the tracked "
+                f"rows ({[m.name for m in TRACKED]}) — refusing to "
+                "append an empty record",
+                file=sys.stderr,
+            )
+            return 2
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.history)), exist_ok=True
+        )
+        with open(args.history, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        print(
+            f"bench_history: appended {sorted(rec['metrics'])} "
+            f"to {args.history}"
+        )
+        return 0
+
+    # check
+    try:
+        history = load_history(args.history)
+    except (OSError, ValueError) as exc:
+        print(f"bench_history: {exc}", file=sys.stderr)
+        return 2
+    if args.row:
+        try:
+            candidate = record_from_line(load_bench_line(args.row))
+        except (OSError, ValueError) as exc:
+            print(f"bench_history: {exc}", file=sys.stderr)
+            return 2
+        reference = history
+    else:
+        if not history:
+            print(
+                f"bench_history: {args.history} is empty — nothing to "
+                "check", file=sys.stderr,
+            )
+            return 2
+        candidate, reference = history[-1], history[:-1]
+        if not reference:
+            # A single-record history gates against itself: vacuously
+            # clean, but say so instead of implying a comparison ran.
+            print(
+                "bench_history: single record, no earlier history — "
+                "clean by construction"
+            )
+            return 0
+    if args.inject_regression:
+        candidate = inject_regression(
+            candidate, args.inject_regression, args.seed,
+            reference, args.margin,
+        )
+    regressions, notes = check_candidate(
+        candidate, reference, margin=args.margin,
+        allow_missing=args.allow_missing,
+    )
+    for note in notes:
+        print(f"bench_history: {note}")
+    if regressions:
+        for r in regressions:
+            print(f"bench_history: REGRESSION {r}", file=sys.stderr)
+        print(
+            f"bench_history: {len(regressions)} regression(s) vs "
+            f"{args.history}", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"bench_history: clean ({len(reference)} reference record(s), "
+        f"margin {args.margin:g} x floor)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,14 +35,22 @@
 #      MarketSchedule replay determinism against the COMMITTED seed
 #      market (data/market/ci_seed.json) — regeneration reproduces it
 #      bit-for-bit and two survival runs report identically.
-#   6. observability plane (round 14): a tiny traced serve soak
-#      through the CLI (--trace-out), the emitted Perfetto timeline
-#      validated by tools/obs_report.py --check (trace_event fields,
-#      monotone timestamps, every admitted job's parent-linked
-#      arrival→completion chain terminating exactly once) and
-#      rendered, plus the quick tracing-parity/overhead guard from
+#   6. observability plane (round 14) + performance observability
+#      (round 15): a tiny traced serve soak through the CLI
+#      (--trace-out, with the sampled dispatch profiler engaged via
+#      --profile-dispatch on the device policy), the emitted Perfetto
+#      timeline validated by tools/obs_report.py --check (trace_event
+#      fields, monotone timestamps, every admitted job's parent-linked
+#      arrival→completion chain terminating exactly once, profiler
+#      device spans nesting inside their flush spans) and rendered,
+#      plus the quick tracing-parity/overhead guard from
 #      tests/test_obs.py (tracing on must not perturb a single meter
 #      bit and must stay bounded).
+#   7. continuous-bench regression gate (round 15): the committed
+#      baseline history (data/bench/ci_baseline.jsonl) passes
+#      tools/bench_history.py check, and a SEEDED SYNTHETIC REGRESSION
+#      injected into it is flagged non-zero — the gate is proven live
+#      on every run, so it can never rot into a rubber stamp.
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -54,11 +62,11 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/6] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/7] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/6] graftcheck static analysis (9 passes) + compile check =="
+echo "== [2/7] graftcheck static analysis (10 passes) + compile check =="
 # Machine-readable findings, annotated per file:line; the 10 s timeout
 # IS the wall-clock budget check for the full static suite.  The
 # capture must not abort under `set -e` before lint_annotate has
@@ -74,15 +82,16 @@ elif [ "$gc_rc" -gt 1 ]; then
     cat "$TMP/graftcheck.json" >&2
     exit "$gc_rc"
 fi
-# --require pins the obs-boundary pass: a filtered --rules run can
-# never silently skip the round-14 gate.
-python tools/lint_annotate.py --require obs-boundary < "$TMP/graftcheck.json"
+# --require pins the obs-boundary and profiler-boundary passes: a
+# filtered --rules run can never silently skip the round-14/15 gates.
+python tools/lint_annotate.py --require obs-boundary,profiler-boundary \
+    < "$TMP/graftcheck.json"
 python tools/hotpath_lint.py
 # Runtime twin of the retrace pass: warm the fused span driver, then
 # assert ZERO recompiles in steady state (quick mode).
 python -m pivot_tpu.analysis --compile-check quick
 
-echo "== [3/6] chaos replay determinism on the committed seed =="
+echo "== [3/7] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -97,7 +106,7 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
-echo "== [4/6] sharded-placement parity on a forced 8-device CPU mesh =="
+echo "== [4/7] sharded-placement parity on a forced 8-device CPU mesh =="
 # Small-H quick twins + the H=1024 acceptance + the sharded span driver:
 # bit-parity with the single-device oracles, exercised on every run
 # without a TPU.  (conftest pins the same mesh; the explicit flag keeps
@@ -106,7 +115,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_shard.py tests/test_mesh.py -q -m 'not slow' \
     -k 'parity or span or mesh' -p no:cacheprovider
 
-echo "== [5/6] spot soak + market replay determinism on the committed seed =="
+echo "== [5/7] spot soak + market replay determinism on the committed seed =="
 MARKET_SEED_FILE=data/market/ci_seed.json
 # The quick acceptance soak (tier-1 twin in tests/test_market.py).
 python -m pytest tests/test_market.py -q -m 'not slow' \
@@ -126,17 +135,41 @@ python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
     --out "$TMP/spot_b.json"
 python tools/market_replay.py diff "$TMP/spot_a.json" "$TMP/spot_b.json"
 
-echo "== [6/6] observability plane: traced serve soak + trace check + guard =="
-# A tiny traced serve soak through the CLI; the Perfetto artifact must
-# pass the structural + causal-completeness check and render.
-python -m pivot_tpu.experiments.cli serve --jobs 8 --sessions 2 \
-    --arrival-rate 0.5 --trace-out "$TMP/soak.perfetto.json" \
+echo "== [6/7] observability plane: traced+profiled soak + trace check =="
+# A tiny traced serve soak through the CLI — device policy so the
+# sampled dispatch profiler (--profile-dispatch) has dispatches to
+# bracket; the Perfetto artifact must pass the structural + causal +
+# profiler-nesting checks and render (perf section included).
+python -m pivot_tpu.experiments.cli --device tpu serve --jobs 8 \
+    --sessions 2 --arrival-rate 0.5 --profile-dispatch 4 \
+    --trace-out "$TMP/soak.perfetto.json" \
     --metrics-out "$TMP/soak.prom" > /dev/null
 python tools/obs_report.py --check "$TMP/soak.perfetto.json"
 python tools/obs_report.py "$TMP/soak.perfetto.json" > /dev/null
+# The exported exposition carries the profiler's census families.
+grep -q "pivot_dispatch_latency_seconds" "$TMP/soak.prom"
 # Quick tracing-parity + overhead guard (tier-1 twins): tracing on is
 # bit-identical to tracing off, and the causal chains verify.
 python -m pytest tests/test_obs.py -q -m 'not slow' \
     -k 'parity or chain or overhead' -p no:cacheprovider
+
+echo "== [7/7] continuous-bench regression gate (committed baseline) =="
+BASELINE=data/bench/ci_baseline.jsonl
+# The committed baseline history must gate clean against itself...
+python tools/bench_history.py check --history "$BASELINE"
+# ...and the gate must FIRE on a seeded synthetic regression — proven
+# live on every run so it can never rot into a rubber stamp.  Exit
+# code 1 SPECIFICALLY: a usage/schema failure (2) or a missing tracked
+# row would also be non-zero, which is exactly the rot this self-test
+# exists to catch, so it must not read as "gate fired".
+inj_rc=0
+inj_out=$(python tools/bench_history.py check --history "$BASELINE" \
+    --inject-regression two_phase_dps:2.0 --seed 7 2>&1) || inj_rc=$?
+if [ "$inj_rc" -ne 1 ]; then
+    echo "bench_history self-test: expected exit 1 on the seeded" \
+         "synthetic regression, got $inj_rc:" >&2
+    echo "$inj_out" >&2
+    exit 1
+fi
 
 echo "smoke lane: all green"

@@ -17,6 +17,14 @@ Two modes:
         percentiles per tier;
       - top-N slow dispatches: the longest wall-duration ``dispatch``
         spans (placement calls / batcher flushes);
+      - **perf section** (round 15): the ``device`` lane the sampled
+        :class:`DispatchProfiler` emits — per-kernel-family latency
+        census (n/p50/p95/max), the top-N slow device dispatches
+        joined with their analytic roofline predictions
+        (``pred_us``/``model_ratio`` span args), and a LOUD drift
+        finding whenever a family's median measured/model ratio
+        leaves [0.5, 2] — the device model is lying, which is what
+        stalled the hardware recapture;
       - in-flight depth timeline: admissions minus terminations over
         sim time (bucketed sparkline);
       - event-category census (ticks, chaos, market, autoscale,
@@ -28,9 +36,13 @@ Two modes:
     non-negative dur; ``b``/``e`` async pairs match per id; ts is
     monotone non-decreasing in file order (the exporter sorts; a
     violation means a clock went backwards); every ``parent`` link
-    resolves to an earlier event of the SAME trace; and every trace
+    resolves to an earlier event of the SAME trace; every trace
     that recorded an ``arrived`` stage terminates in exactly one
-    terminal stage (completed/failed/shed/dead_letter).
+    terminal stage (completed/failed/shed/dead_letter); and every
+    profiler ``device`` span recorded inside a batcher flush
+    (``in_flush`` arg) nests inside a ``dispatch``/``flush`` span's
+    interval (a profiled device call escaping its flush means the
+    profiler is timing something that is not the dispatch).
 
 Usage::
 
@@ -179,6 +191,33 @@ def check_events(
             errors.append(
                 f"event {i}: parent {parent} is later on the timeline"
             )
+    # Profiler nesting (round 15): a device span recorded inside a
+    # batcher flush must sit inside SOME flush span's interval — the
+    # profiler brackets the device call the flush issued, so a span
+    # escaping every flush means it timed something else.  ε covers the
+    # exporter's 1 µs minimum-duration clamp.
+    flushes = [
+        (e["ts"], e["ts"] + e.get("dur", 0.0))
+        for e in events
+        if e.get("ph") == "X" and e.get("cat") == "dispatch"
+        and e.get("name") == "flush"
+    ]
+    eps = 2.0  # µs
+    for i, e in enumerate(events):
+        if e.get("ph") != "X" or e.get("cat") != "device":
+            continue
+        if not (e.get("args") or {}).get("in_flush"):
+            continue
+        t0, t1 = e.get("ts", 0.0), e.get("ts", 0.0) + e.get("dur", 0.0)
+        if not any(
+            f0 - eps <= t0 and t1 <= f1 + eps for f0, f1 in flushes
+        ):
+            errors.append(
+                f"event {i} ({e.get('name')}): in_flush device span "
+                f"[{t0:.1f}, {t1:.1f}]µs nests inside no "
+                "dispatch/flush span — the profiler timed something "
+                "that is not the flushed device call"
+            )
     # Causal completeness: every arrived trace must terminate once.
     if chains is None:
         chains = build_chains(events)
@@ -286,6 +325,58 @@ def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
         ),
         key=lambda e: -e.get("dur", 0.0),
     )
+    # Perf section (round 15): the profiler's ``device`` lane — a
+    # per-family latency census, the top-N slow device dispatches with
+    # their analytic predictions, and the drift verdict.
+    device_spans = [
+        e for e in events
+        if e.get("ph") == "X" and e.get("cat") == "device"
+    ]
+    fam_durs: Dict[str, List[float]] = {}
+    fam_ratios: Dict[str, List[float]] = {}
+    for e in device_spans:
+        fam = str(e.get("name"))
+        fam_durs.setdefault(fam, []).append(e.get("dur", 0.0))
+        ratio = (e.get("args") or {}).get("model_ratio")
+        if isinstance(ratio, (int, float)):
+            fam_ratios.setdefault(fam, []).append(float(ratio))
+    fam_census = {}
+    drift: List[str] = []
+    for fam, durs in sorted(fam_durs.items()):
+        row = {
+            "n": len(durs),
+            "p50_us": round(_pct(durs, 50), 3),
+            "p95_us": round(_pct(durs, 95), 3),
+            "max_us": round(max(durs), 3),
+        }
+        ratios = fam_ratios.get(fam, [])
+        if ratios:
+            med = _pct(ratios, 50)
+            row["model_ratio_p50"] = round(med, 3)
+            if med > 2.0 or med < 0.5:
+                drift.append(
+                    f"DRIFT {fam}: median measured/model ratio "
+                    f"{med:.2f} over {len(ratios)} sampled "
+                    "dispatch(es) — outside [0.5, 2]; the analytic "
+                    "device model (infra/roofline.py) no longer "
+                    "explains this family's dispatches"
+                )
+        fam_census[fam] = row
+    slow_device = [
+        {
+            "family": e.get("name"),
+            "dur_us": round(e.get("dur", 0.0), 3),
+            **{
+                k: v
+                for k, v in (e.get("args") or {}).items()
+                if k in ("backend", "t", "b", "h", "k", "g",
+                         "pred_us", "model_ratio", "in_flush")
+            },
+        }
+        for e in sorted(
+            device_spans, key=lambda e: -e.get("dur", 0.0)
+        )[:top]
+    ]
     # In-flight depth over sim time (admissions − terminations).  A
     # terminal only decrements when its trace actually admitted —
     # shed-at-the-door jobs never held capacity, and counting their
@@ -349,6 +440,12 @@ def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
             }
             for e in dispatches[:top]
         ],
+        "device_dispatch": {
+            "sampled_spans": len(device_spans),
+            "families": fam_census,
+            "top_slow": slow_device,
+            "drift": drift,
+        },
         "inflight_depth": {
             "peak": peak,
             "final": depth,
@@ -434,6 +531,30 @@ def main(argv=None) -> int:
                 if k not in ("name", "dur_ms", "ts_ms")
             }
             print(f"  {row['dur_ms']:>10.3f} ms  {row['name']}  {extra}")
+    dd = report["device_dispatch"]
+    if dd["sampled_spans"]:
+        print(
+            f"-- device dispatches (profiler lane, "
+            f"{dd['sampled_spans']} sampled) --"
+        )
+        for fam, row in dd["families"].items():
+            ratio = row.get("model_ratio_p50")
+            print(
+                f"  {fam:24s} n={row['n']:<5d} "
+                f"p50={row['p50_us']:<10g} p95={row['p95_us']:<10g} "
+                f"max={row['max_us']:<10g} us"
+                + (f"  x model={ratio:g}" if ratio is not None else "")
+            )
+        for row in dd["top_slow"]:
+            extra = {
+                k: v for k, v in row.items()
+                if k not in ("family", "dur_us")
+            }
+            print(f"  {row['dur_us']:>10.3f} us  {row['family']}  {extra}")
+    for finding in dd["drift"]:
+        # Loud on purpose: a lying device model is the round-15 signal
+        # this whole layer exists to surface.
+        print(f"!! {finding}")
     print(
         f"in-flight depth: peak={report['inflight_depth']['peak']} "
         f"final={report['inflight_depth']['final']}"
