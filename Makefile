@@ -14,8 +14,10 @@ SHELL := /bin/bash
 # (data/chaos/ci_seed.json), sharded-placement parity on a forced
 # 8-device CPU mesh, the spot-market survival soak + market replay
 # determinism against data/market/ci_seed.json, the traced+profiled
-# serve soak, and the continuous-bench regression gate against
-# data/bench/ci_baseline.jsonl.  ~3 minutes; see tools/ci_smoke.sh.
+# serve soak, the continuous-bench regression gate against
+# data/bench/ci_baseline.jsonl, and the policy-search gate (tiny CEM
+# beats a bad init + replays bit-identically on the committed
+# data/search/ci_seed.json config).  ~3 minutes; see tools/ci_smoke.sh.
 smoke:
 	tools/ci_smoke.sh
 

@@ -589,6 +589,55 @@ def _bracketed_overhead(once, repeats: int) -> dict:
     }
 
 
+def _bench_policy_search(
+    n_hosts: int = 12,
+    seed: int = 5,
+    n_apps: int = 6,
+    popsize: int = 8,
+    n_replicas: int = 16,
+    generations: int = 2,
+) -> dict:
+    """Policy-search row (round 16, ``pivot_tpu/search/``): search
+    throughput at population scale — a CEM run over the seeded
+    spot-market fitness environment where every generation's candidate
+    population (``popsize × replicas`` rows) is one fused vmapped-
+    rollout dispatch.  Columns: generations/s and rollouts/s over the
+    timed generations (a warm-up search compiles the draw + population
+    programs first, so the row measures steady state), plus the search
+    outcome sanity (``improved``: the best evaluated vector is never
+    worse than the incumbent's generation-0 score).  Pure estimator
+    row — runs on any backend; ``rollouts_per_sec`` is tracked by
+    ``tools/bench_history.py``.
+    """
+    from pivot_tpu.search.cem import cem_search
+    from pivot_tpu.search.fitness import make_search_env
+
+    env = make_search_env(
+        n_hosts=n_hosts, seed=seed, n_apps=n_apps, horizon=400.0,
+        n_replicas=n_replicas,
+    )
+    # Warm-up: compiles the draw program and the population program.
+    cem_search(env, generations=1, popsize=popsize, seed=seed)
+    t0 = time.perf_counter()
+    res = cem_search(env, generations=generations, popsize=popsize, seed=seed)
+    wall = time.perf_counter() - t0
+    rollouts = generations * popsize * n_replicas
+    return {
+        "popsize": popsize,
+        "replicas": n_replicas,
+        "generations": generations,
+        "rows_per_generation": popsize * n_replicas,
+        "n_tasks": env.n_tasks,
+        "n_preemptions": env.n_preemptions,
+        "wall_s": round(wall, 3),
+        "generations_per_sec": round(generations / wall, 4),
+        "rollouts_per_sec": round(rollouts / wall, 2),
+        "best_score": res.best_score,
+        "init_score": res.init_score,
+        "improved": bool(res.best_score <= res.init_score),
+    }
+
+
 def _bench_obs_overhead(n_apps: int = 16, repeats: int = 9) -> dict:
     """Round-14 acceptance row: the observability plane's hot-path cost.
 
@@ -1868,8 +1917,8 @@ def main() -> None:
         known_rows = {
             "headline", "two_phase", "grid_batched", "fused_tick",
             "serve_stream", "serve_tiers", "shard_place",
-            "spot_survival", "obs_overhead", "profiler_overhead",
-            "cost_attribution", "saturated",
+            "spot_survival", "policy_search", "obs_overhead",
+            "profiler_overhead", "cost_attribution", "saturated",
         }
         _ROWS = {r.strip() for r in args.rows.split(",") if r.strip()}
         unknown_rows = _ROWS - known_rows
@@ -2075,6 +2124,10 @@ def main() -> None:
     # (CPU policies, no device dispatch), so it measures the same thing
     # on every backend.
     spot_survival = _row("spot_survival", _bench_spot_survival)
+    # Round-16 acceptance row: policy-search throughput — candidate
+    # populations scored as one fused ensemble dispatch per generation
+    # (pivot_tpu/search/).  Pure estimator row, any backend.
+    policy_search = _row("policy_search", _bench_policy_search)
     # Round-14 acceptance row: the observability plane must be free
     # when off and <3% when on, on the fused-tick DES path, without
     # perturbing a single meter bit.  Pure DES (numpy policy) — same
@@ -2177,6 +2230,7 @@ def main() -> None:
         "serve_tiers": serve_tiers,
         "shard_place": shard_place,
         "spot_survival": spot_survival,
+        "policy_search": policy_search,
         "obs_overhead": obs_overhead,
         "profiler_overhead": profiler_overhead,
         "cost_attribution": cost_attribution,

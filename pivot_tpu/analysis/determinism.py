@@ -47,6 +47,11 @@ SCOPE = (
     "pivot_tpu/infra/market.py",
     "pivot_tpu/sched",
     "pivot_tpu/ops",
+    # The policy-search subsystem (round 16): search runs must replay —
+    # same seed + same env ⇒ identical winning vector and fitness trace
+    # — so its optimizers and fitness plumbing live under the same lint
+    # as the DES core (seeded generators only, no wall-clock reads).
+    "pivot_tpu/search",
 )
 
 _WALL_FNS = {

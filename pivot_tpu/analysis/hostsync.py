@@ -52,6 +52,7 @@ DISCOVER: Dict[str, Tuple[str, ...]] = {
         "_risk_restrict_sharded*",
     ),
     "pivot_tpu/parallel/ensemble/tick.py": ("_rollout_segment",),
+    "pivot_tpu/search/fitness.py": ("_fitness_rows_impl", "_draw_rows_impl"),
 }
 
 #: Anchor bodies that MUST be discovered per file — a rename that
@@ -64,6 +65,7 @@ REQUIRED: Dict[str, Tuple[str, ...]] = {
     "pivot_tpu/ops/tickloop.py": ("_fused_tick_run_impl",),
     "pivot_tpu/ops/shard.py": ("_sharded_span_body", "_two_stage_argmin"),
     "pivot_tpu/parallel/ensemble/tick.py": ("_rollout_segment",),
+    "pivot_tpu/search/fitness.py": ("_fitness_rows_impl",),
 }
 
 _SYNC_ATTRS = {"block_until_ready", "item", "tolist"}

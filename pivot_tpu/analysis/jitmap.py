@@ -51,6 +51,7 @@ JIT_FILES: Tuple[str, ...] = (
     "pivot_tpu/parallel/ensemble/checkpoint.py",
     "pivot_tpu/parallel/ensemble/sweeps.py",
     "pivot_tpu/parallel/ensemble/bill.py",
+    "pivot_tpu/search/fitness.py",
 )
 
 #: Package subtree swept for unregistered ``jax.jit`` usage.

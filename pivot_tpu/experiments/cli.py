@@ -481,6 +481,50 @@ def parse_args(argv=None):
                            "price-scaled cost tensor — the round-11 "
                            "environment axis for the gate's "
                            "sign-stability")
+    sch = sub.add_parser(
+        "search",
+        help="policy search at ensemble scale (pivot_tpu/search/): learn "
+             "scoring weights (fit/egress/bw exponents + the risk pair) "
+             "with CEM/ES — every generation's candidate population is "
+             "one fused vmapped-rollout dispatch under the seeded "
+             "market + preemption environment — then score learned vs "
+             "hand-tuned on held-out seeds and report regret against "
+             "the exact branch-and-bound oracle; prints the report JSON",
+    )
+    sch.add_argument("--method", default="cem", choices=["cem", "es"])
+    sch.add_argument("--generations", type=int, default=6)
+    sch.add_argument("--popsize", type=int, default=12,
+                     help="candidate weight vectors per generation")
+    sch.add_argument("--replicas", type=int, default=8,
+                     help="Monte-Carlo rollouts per candidate (the "
+                          "population dispatch is popsize x replicas "
+                          "rows)")
+    sch.add_argument("--hosts", type=int, default=12)
+    sch.add_argument("--num-apps", type=int, dest="num_apps", default=8)
+    sch.add_argument("--horizon", type=float, default=600.0)
+    sch.add_argument("--seed", type=int, default=5)
+    sch.add_argument("--holdout", type=int, default=2,
+                     help="held-out environment seeds for the "
+                          "learned-vs-hand-tuned comparison")
+    sch.add_argument("--backend", default="rollout",
+                     choices=["rollout", "sharded_rollout"],
+                     help="fitness backend: single-device rows, or rows "
+                          "host-sharded over the replica mesh "
+                          "(bit-identical scores; the 10k+-row shape)")
+    sch.add_argument("--bad-init", action="store_true",
+                     help="start from the deliberately-bad vector (the "
+                          "smoke gate's shape) instead of the hand-tuned "
+                          "default")
+    sch.add_argument("--no-oracle", action="store_true",
+                     help="skip the small-instance regret section")
+    sch.add_argument("--des-validate", action="store_true",
+                     help="also play learned vs hand-tuned through the "
+                          "exact DES under the first held-out market")
+    sch.add_argument("--config", default=None, metavar="FILE",
+                     help="JSON config overriding the flags above (the "
+                          "smoke lane replays data/search/ci_seed.json)")
+    sch.add_argument("--out", default=None, metavar="FILE",
+                     help="write the report JSON here as well")
     srv = sub.add_parser(
         "serve",
         help="online serving layer: stream Poisson/trace job arrivals "
@@ -1715,6 +1759,49 @@ def run_worker() -> None:
         _serving = False
 
 
+def run_search_cli(args) -> None:
+    """The ``search`` subcommand: run the learn → hold out → regret
+    pipeline (``pivot_tpu/experiments/search.py``) and print/emit the
+    report JSON."""
+    import json
+
+    from pivot_tpu.experiments.search import (
+        load_config,
+        run_search_experiment,
+    )
+
+    kw = dict(
+        method=args.method,
+        generations=args.generations,
+        popsize=args.popsize,
+        seed=args.seed,
+        n_hosts=args.hosts,
+        n_apps=args.num_apps,
+        horizon=args.horizon,
+        n_replicas=args.replicas,
+        holdout=args.holdout,
+        backend=args.backend,
+        bad_init=args.bad_init,
+        oracle=not args.no_oracle,
+        des_validate=args.des_validate,
+    )
+    if args.config:
+        kw.update(load_config(args.config))
+    mesh = None
+    if kw["backend"] == "sharded_rollout":
+        import jax
+
+        from pivot_tpu.parallel.mesh import replica_mesh
+
+        mesh = replica_mesh(len(jax.devices()))
+    report = run_search_experiment(mesh=mesh, **kw)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+
+
 def main(argv=None) -> None:
     # Respect an explicit JAX_PLATFORMS pin at the config level too: the
     # accelerator site package force-updates jax_platforms at interpreter
@@ -1731,6 +1818,9 @@ def main(argv=None) -> None:
         return
     if args.command == "serve":
         run_serve_stream(args)
+        return
+    if args.command == "search":
+        run_search_cli(args)
         return
     from pivot_tpu.experiments import plots
 

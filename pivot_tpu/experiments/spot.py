@@ -118,6 +118,7 @@ def run_spot_arm(
     rate_per_hour: float = 1.0,
     fault_seed: Optional[int] = None,
     arrival_spacing: float = 40.0,
+    weights=None,
 ) -> dict:
     """Run ONE arm of the spot-survival game to completion and report.
 
@@ -159,9 +160,20 @@ def run_spot_arm(
     # already-constructed hosts — the instance-cost integral would read
     # an empty ledger).
     cluster = proto.clone(env, meter)
-    policy = CostAwarePolicy(
-        risk_weight=risk_weight, rework_cost=rework_cost
-    )
+    # ``weights`` (a search-learned PolicyWeights vector) supersedes the
+    # legacy risk-knob pair — the round-16 DES validation path: play a
+    # learned vector through the exact simulator under the same market.
+    if weights is not None:
+        policy = CostAwarePolicy(weights=weights)
+        # The resolved vector (resolve_weights coerces array-likes), not
+        # the raw argument — the report builder reads its _fields.
+        weights = policy.weights
+        risk_weight = policy.risk_weight
+        rework_cost = policy.rework_cost
+    else:
+        policy = CostAwarePolicy(
+            risk_weight=risk_weight, rework_cost=rework_cost
+        )
     scheduler = GlobalScheduler(
         cluster.env,
         cluster,
@@ -229,6 +241,11 @@ def run_spot_arm(
         "arm": {
             "risk_weight": risk_weight,
             "rework_cost": rework_cost,
+            **(
+                {"weights": {k: float(v) for k, v in
+                             zip(type(weights)._fields, weights)}}
+                if weights is not None else {}
+            ),
             "proactive": proactive,
             "n_hosts": n_hosts,
             "seed": seed,

@@ -280,6 +280,23 @@ ENTRY_POINTS: Dict[Tuple[str, str], Tuple[str, str]] = {
         "O(R) billing reduction over rollout outputs — negligible next "
         "to the rollout program it post-processes"
     ),
+    # -- policy-search fitness programs (round 16) -----------------------
+    ("pivot_tpu/search/fitness.py", "_draw_rows"): flag(
+        "tiny per-generation Monte-Carlo draw program ([B x R, T] "
+        "tiled uniforms) — negligible next to the population rollout "
+        "it feeds; kept unsharded by design (threefry lowering)"
+    ),
+    ("pivot_tpu/search/fitness.py", "_fitness_rows"): flag(
+        "population fitness program: B x R rows of the rollout-segment "
+        "family — same program family as _rollout_states/"
+        "_row_segment_step; attributed at scale by bench.py's "
+        "policy_search row (generations/s, rollouts/s)"
+    ),
+    ("pivot_tpu/search/fitness.py", "_sharded_fitness_fn"): flag(
+        "row-sharded twin of _fitness_rows (NamedSharding over the "
+        "replica mesh; bit-identical scores by tests/test_search.py) — "
+        "see the policy_search bench row"
+    ),
 }
 
 

@@ -112,16 +112,50 @@ from pivot_tpu.parallel.mesh import host_axis_size
 
 __all__ = [
     "HOST_AXIS",
+    "REPLICA_AXIS",
     "best_fit_kernel_sharded",
+    "check_row_divisibility",
     "cost_aware_kernel_sharded",
     "first_fit_kernel_sharded",
     "opportunistic_kernel_sharded",
+    "row_sharding",
     "sharded_fused_tick_run",
 ]
 
 #: Mesh axis the host dimension shards over (``parallel.mesh.build_mesh``
 #: axis_names convention).
 HOST_AXIS = "host"
+
+#: Mesh axis row/replica batches shard over (``parallel.mesh.replica_mesh``
+#: convention — ``sharded_rollout``, the sweep shardings, and the policy-
+#: search fitness rows all partition their leading batch axis here).
+REPLICA_AXIS = "replica"
+
+
+def row_sharding(mesh):
+    """``NamedSharding`` partitioning a leading row/batch axis over the
+    mesh's :data:`REPLICA_AXIS` — the one definition shared by the
+    ensemble row consumers (``search/fitness.py``'s candidate rows; the
+    same spec `sharded_rollout` and ``sweep_out_shardings`` spell out
+    longhand), so "how rows shard" cannot drift between them."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, P(REPLICA_AXIS))
+
+
+def check_row_divisibility(mesh, n_rows: int) -> None:
+    """Raise unless ``n_rows`` splits into equal contiguous blocks over
+    the mesh's replica axis (``NamedSharding`` partitions the leading
+    axis that way; a ragged split fails deep inside XLA otherwise)."""
+    n = int(mesh.shape[REPLICA_AXIS])
+    if n < 1:
+        raise ValueError("mesh has an empty replica axis")
+    if n_rows % n:
+        raise ValueError(
+            f"{n_rows} rows do not divide over the mesh's {n} replica "
+            f"shards — round the population/replica product up to a "
+            f"multiple of {n}"
+        )
 
 #: Integer sentinel above any host index — the "no candidate" rung of the
 #: pmin reduces (1 << 30 like the kernels' fill-capacity clip).

@@ -214,7 +214,7 @@ def _rollout_states(
     Z = topo.cost.shape[0]
 
     def one(r, a, ra, *ex):
-        f, u, _tot, _sp, _act = _unpack_extras(spec, ex)
+        f, u, _tot, _sp, _act, _rc = _unpack_extras(spec, ex)
         state = _init_state(avail0, workload.n_tasks, Z,
                             congestion=congestion)
         return _rollout_segment(

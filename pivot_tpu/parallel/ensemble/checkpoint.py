@@ -73,7 +73,7 @@ def _vmapped_segment(
     spec, extras = _pack_extras(faults, task_u)
 
     def seg(s, r, a, ra, *ex):
-        f, u, _tot, _sp, _act = _unpack_extras(spec, ex)
+        f, u, _tot, _sp, _act, _rc = _unpack_extras(spec, ex)
         return _rollout_segment(
             s, r, a, ra, workload, topo, tick, segment_ticks,
             faults=f, totals=totals, policy=policy, task_u=u,

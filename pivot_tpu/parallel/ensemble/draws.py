@@ -50,7 +50,7 @@ def _make_fault_schedule(
 
 
 def _pack_extras(faults=None, task_u=None, totals=None, score_params=None,
-                 active=None):
+                 active=None, risk_coeff=None):
     """Flatten the optional per-replica/per-row axes for a vmap body.
 
     Returns ``(spec, extras_list)``; ``spec`` is the static presence
@@ -59,26 +59,34 @@ def _pack_extras(faults=None, task_u=None, totals=None, score_params=None,
     :func:`_segment_step`, and the row-based sweep runner so the
     execution paths cannot drift.  ``spec`` is hashable, so it can cross
     a jit boundary as a static argument.
+
+    ``risk_coeff`` (round 16, the policy-search fitness environment) is
+    the per-row scalar ``risk_weight × rework_cost`` — the eviction-risk
+    term's weight; the [P, H] hazard rows it scales are replica-SHARED
+    (one market per environment) and ride the tick body's closed-over
+    ``hazard`` operand instead of this per-row channel.
     """
     spec = (
         faults is not None, task_u is not None, totals is not None,
         score_params is not None, active is not None,
+        risk_coeff is not None,
     )
     extras = []
     if faults is not None:
         extras.extend(faults)
-    for x in (task_u, totals, score_params, active):
+    for x in (task_u, totals, score_params, active, risk_coeff):
         if x is not None:
             extras.append(x)
     return spec, extras
 
 
 def _unpack_extras(spec, ex):
-    """Rebuild ``(faults, task_u, totals, score_params, active)`` from a
-    flat extras tuple, per the presence ``spec`` from :func:`_pack_extras`."""
-    has_f, has_u, has_tot, has_sp, has_act = spec
+    """Rebuild ``(faults, task_u, totals, score_params, active,
+    risk_coeff)`` from a flat extras tuple, per the presence ``spec``
+    from :func:`_pack_extras`."""
+    has_f, has_u, has_tot, has_sp, has_act, has_rc = spec
     i = 0
-    f = u = tot = sp = act = None
+    f = u = tot = sp = act = rc = None
     if has_f:
         f = (ex[0], ex[1], ex[2])
         i = 3
@@ -94,7 +102,10 @@ def _unpack_extras(spec, ex):
     if has_act:
         act = ex[i]
         i += 1
-    return f, u, tot, sp, act
+    if has_rc:
+        rc = ex[i]
+        i += 1
+    return f, u, tot, sp, act, rc
 
 
 def _opportunistic_uniforms(key, n_replicas, n_tasks, dtype):

@@ -56,30 +56,34 @@ def _row_segment_step(
     realtime_scoring: bool = False,
     forms: str = "vector",
     tick_order: str = "fifo",
+    hazard=None,  # optional replica-SHARED ([P], [P, H]) market trace
 ):
     """Advance every row by at most ``segment_ticks`` scheduler ticks."""
     return _vmapped_row_segment(
         states, rt, arr, ra, workload, topo, tick, segment_ticks, spec,
         extras, policy, congestion, realtime_scoring, forms, tick_order,
+        hazard,
     )
 
 
 def _vmapped_row_segment(
     states, rt, arr, ra, workload, topo, tick, segment_ticks, spec, extras,
-    policy, congestion, realtime_scoring, forms, tick_order,
+    policy, congestion, realtime_scoring, forms, tick_order, hazard=None,
 ):
     """The one vmapped row-segment body behind :func:`_row_segment_step`
     and :func:`_row_segment_step_carry` — the twins differ only in jit
-    decoration (donation) and the carry's pending-flag reduction."""
+    decoration (donation) and the carry's pending-flag reduction.
+    ``hazard`` is closed over (replica-shared market trace), unlike the
+    per-row extras the vmap maps."""
 
     def seg(s, r, a, ra_, *ex):
-        f, u, tot, sp, act = _unpack_extras(spec, ex)
+        f, u, tot, sp, act, rc = _unpack_extras(spec, ex)
         return _rollout_segment(
             s, r, a, ra_, workload, topo, tick, segment_ticks,
             faults=f, totals=tot, score_params=sp, policy=policy,
             task_u=u, congestion=congestion,
             realtime_scoring=realtime_scoring, active=act, forms=forms,
-            tick_order=tick_order,
+            tick_order=tick_order, risk_coeff=rc, hazard=hazard,
         )
 
     return jax.vmap(seg)(states, rt, arr, ra, *extras)
@@ -109,6 +113,7 @@ def _row_segment_step_carry(
     realtime_scoring: bool = False,
     forms: str = "vector",
     tick_order: str = "fifo",
+    hazard=None,
 ):
     """:func:`_row_segment_step` with a donated carry and an on-device
     early-exit flag — the sweeps' analog of
@@ -119,9 +124,10 @@ def _row_segment_step_carry(
     out = _vmapped_row_segment(
         states, rt, arr, ra, workload, topo, tick, segment_ticks, spec,
         extras, policy, congestion, realtime_scoring, forms, tick_order,
+        hazard,
     )
     pending = out.stage != _DONE
-    _f, _u, _tot, _sp, act = _unpack_extras(spec, extras)
+    _f, _u, _tot, _sp, act, _rc = _unpack_extras(spec, extras)
     if act is not None:
         pending = pending & act
     return out, jnp.any(pending)
@@ -139,6 +145,8 @@ def _run_rows(
     active=None,  # optional [B, T] bool
     forms: Optional[str] = None,
     tick_order: str = "fifo",
+    risk_coeff=None,  # optional [B] risk_weight × rework_cost per row
+    hazard=None,  # optional replica-SHARED ([P], [P, H]) market trace
 ) -> RolloutResult:
     """Run B rows to the horizon and finalize through the shared program.
 
@@ -157,7 +165,9 @@ def _run_rows(
             "mode — use congestion=True here"
         )
     Z = topo.cost.shape[0]
-    spec, extras = _pack_extras(faults, task_u, totals, score_params, active)
+    spec, extras = _pack_extras(
+        faults, task_u, totals, score_params, active, risk_coeff
+    )
     forms = _resolve_forms(forms)
 
     states = jax.vmap(lambda av: _init_state(av, workload.n_tasks, Z))(
@@ -169,7 +179,7 @@ def _run_rows(
             jnp.asarray(max_ticks, jnp.int32), spec, *extras,
             policy=policy, congestion=congestion,
             realtime_scoring=realtime_scoring, forms=forms,
-            tick_order=tick_order,
+            tick_order=tick_order, hazard=hazard,
         )
     else:
         # Host-side segmented loop (the remote-transport-friendly mode):
@@ -188,7 +198,7 @@ def _run_rows(
                 s, rt, arr, ra, workload, topo, tick, seg, spec, *extras,
                 policy=policy, congestion=congestion,
                 realtime_scoring=realtime_scoring, forms=forms,
-                tick_order=tick_order,
+                tick_order=tick_order, hazard=hazard,
             )
 
         states = _run_segments_pipelined(

@@ -51,6 +51,8 @@ def _rollout_segment(
     active=None,  # optional [T] bool: early-exit ignores inactive tasks
     forms: str = "vector",  # | "indexed" — tick-body op forms, see below
     tick_order: str = "fifo",  # | "lifo" — within-tick batch order, see below
+    risk_coeff=None,  # optional scalar: risk_weight × rework_cost
+    hazard=None,  # optional ([P] segment starts, [P, H] per-host hazards)
 ) -> RolloutState:
     """Advance one replica's rollout by at most ``n_ticks`` scheduler ticks
     (stops early once every task is done).
@@ -98,6 +100,21 @@ def _rollout_segment(
     DES ``realtime_bw`` arm (``Route.realtime_bw``, ref
     ``resources/network.py:70-73``): placement actively steers AROUND
     congested links instead of merely paying for them.
+
+    With ``risk_coeff`` + ``hazard`` (round 16, the policy-search
+    fitness environment), placement prices eviction risk exactly like
+    the DES backends price ``policies.resolve_risk``'s vector: each
+    tick's per-host penalty is ``risk_coeff × hazard_row(t)``, where
+    ``hazard = (times [P], rows [P, H])`` is the market's
+    piecewise-constant per-host hazard trace (replica-shared — one
+    market per environment; ``risk_coeff = risk_weight × rework_cost``
+    is per-row, so a candidate population sweeps it).  The shared
+    cross-backend consumption rules apply unchanged: score-based
+    selections (cost-aware, best-fit) add the penalty, first-fit's
+    index order becomes the lexicographic (risk, index) order, and the
+    opportunistic draw restricts to the minimum-risk tier of fitting
+    hosts (same uniform, narrower support).  Both args None (the
+    default) keeps today's compiled program untouched.
     """
     if congestion not in (False, True, "pairs"):
         raise ValueError(
@@ -126,6 +143,12 @@ def _rollout_segment(
                          "exponents are mutually exclusive")
     if forms not in ("vector", "indexed"):
         raise ValueError(f"forms must be 'vector' or 'indexed', got {forms!r}")
+    if (hazard is None) != (risk_coeff is None):
+        raise ValueError(
+            "the risk term needs BOTH hazard (the [P]/[P, H] market "
+            "trace) and risk_coeff (risk_weight × rework_cost) — pass "
+            "neither to keep the risk-free program"
+        )
     if tick_order not in ("fifo", "lifo"):
         raise ValueError(
             f"tick_order must be 'fifo' or 'lifo', got {tick_order!r}"
@@ -631,6 +654,31 @@ def _rollout_segment(
         else:
             score_bw_rt = bw_rt
 
+        # 4b. Eviction-risk penalty row for this tick: the market's
+        #     piecewise-constant per-host hazard at t, scaled by the
+        #     row's risk coefficient — hoisted out of the placement loop
+        #     (the segment cannot change within a tick).  Vector form
+        #     selects the segment row as a [P, H] one-hot reduce (P is
+        #     the handful of price segments; the gather's per-replica
+        #     index would land in scalar memory under vmap), indexed
+        #     form keeps the exact row gather.
+        if hazard is not None:
+            h_times, h_rows = hazard
+            Pn = h_rows.shape[0]
+            seg = jnp.clip(
+                jnp.searchsorted(h_times, t, side="right") - 1, 0, Pn - 1
+            )
+            if vector:
+                seg_oh = (jnp.arange(Pn) == seg)[:, None]  # [P, 1]
+                hz_row = jnp.sum(
+                    jnp.where(seg_oh, h_rows, jnp.zeros((), dtype)), axis=0
+                )  # [H]
+            else:
+                hz_row = h_rows[seg]  # [H] row gather (exact selection)
+            risk_row = risk_coeff * hz_row
+        else:
+            risk_row = None
+
         # 5a. Transfer-delay table — BEFORE the placement loop (it only
         #     reads zc, which predates placement): max over predecessor
         #     instances of size / bw(src zone → dst zone).  All instances
@@ -739,14 +787,30 @@ def _rollout_segment(
                     score = cost_row / (norm_snap * bw_row)
                 else:
                     score = cost_row / (norm_snap ** w_norm * bw_row)
+                if risk_row is not None:
+                    score = score + risk_row  # the shared score += risk rule
                 h = jnp.argmin(jnp.where(fit, score, inf))
             elif policy == "first-fit":
-                h = jnp.argmax(fit)  # lowest-index fit (ref vbp.py:6-29)
+                if risk_row is not None:
+                    # Risk-aware first fit: the index order becomes the
+                    # lexicographic (risk, index) order — argmin ties to
+                    # the lowest index (resolve_risk's shared rule).
+                    h = jnp.argmin(jnp.where(fit, risk_row, inf))
+                else:
+                    h = jnp.argmax(fit)  # lowest-index fit (ref vbp.py:6-29)
             elif policy == "best-fit":
                 resid = avail - demand[None, :]
                 score = jnp.sqrt(jnp.sum(resid * resid, axis=1))
+                if risk_row is not None:
+                    score = score + risk_row  # the shared score += risk rule
                 h = jnp.argmin(jnp.where(fit, score, inf))
             else:  # opportunistic: uniform among fits (ref opportunistic.py)
+                if risk_row is not None:
+                    # Minimum-risk tier restriction (same draw, narrower
+                    # support); no fits ⇒ rmin = inf and finite risk rows
+                    # match nothing, so `ok` below stays False.
+                    rmin = jnp.min(jnp.where(fit, risk_row, inf))
+                    fit = fit & (risk_row == rmin)
                 # Per-tick redraw via a Weyl rotation of the task's base
                 # uniform (the DES redraws per tick, policies.py:105; a
                 # retrying task must not deterministically re-target the

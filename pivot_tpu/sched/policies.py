@@ -32,6 +32,7 @@ import numpy as np
 
 from pivot_tpu.sched import Policy, TickContext
 from pivot_tpu.sched.rand import keyed_storage_index, tick_uniforms
+from pivot_tpu.search.weights import PolicyWeights
 
 
 def resolve_root_anchor(ctx: TickContext, app, n_storage: int) -> int:
@@ -52,7 +53,40 @@ __all__ = [
     "CostAwarePolicy",
     "fold_quarantine",
     "resolve_risk",
+    "resolve_weights",
 ]
+
+
+def resolve_weights(
+    weights: Optional[PolicyWeights],
+    risk_weight: float = 0.0,
+    rework_cost: float = 1.0,
+) -> PolicyWeights:
+    """Fold a policy constructor's scoring knobs into the ONE typed
+    vector (round 16, ``pivot_tpu/search/weights.py``).
+
+    Every backend now carries ``self.weights`` as the source of truth;
+    the legacy ``risk_weight`` / ``rework_cost`` constructor knobs stay
+    accepted (they populate the vector's risk dims) but may not be
+    combined with an explicit ``weights=`` — two sources for one knob
+    is exactly the scatter this refactor removes.  The score exponents
+    (``w_cost``/``w_bw``/``w_norm``) parameterize the cost-aware score
+    terms; policies whose selections have no such terms (first-fit's
+    index order, best-fit's residual norm, the opportunistic draw) are
+    exponent-invariant by construction and consume only the risk dims.
+    """
+    if weights is None:
+        return PolicyWeights(
+            risk_weight=risk_weight, rework_cost=rework_cost
+        ).validate()
+    if not isinstance(weights, PolicyWeights):
+        weights = PolicyWeights.from_array(np.asarray(weights, dtype=float))
+    if (risk_weight, rework_cost) != (0.0, 1.0):
+        raise ValueError(
+            "pass weights= OR the legacy risk_weight/rework_cost knobs, "
+            "not both — the typed vector is the one source of truth"
+        )
+    return weights.validate()
 
 
 def resolve_risk(ctx: TickContext, risk_weight: float,
@@ -152,11 +186,13 @@ class OpportunisticPolicy(Policy):
     name = "opportunistic"
 
     def __init__(self, mode: str = "numpy", risk_weight: float = 0.0,
-                 rework_cost: float = 1.0):
+                 rework_cost: float = 1.0,
+                 weights: Optional[PolicyWeights] = None):
         assert mode in ("naive", "numpy")
         self.mode = mode
-        self.risk_weight = risk_weight
-        self.rework_cost = rework_cost
+        self.weights = resolve_weights(weights, risk_weight, rework_cost)
+        self.risk_weight = self.weights.risk_weight
+        self.rework_cost = self.weights.rework_cost
 
     def place(self, ctx: TickContext) -> np.ndarray:
         fold_quarantine(ctx)
@@ -214,12 +250,14 @@ class FirstFitPolicy(Policy):
     name = "first_fit"
 
     def __init__(self, decreasing: bool = False, mode: str = "numpy",
-                 risk_weight: float = 0.0, rework_cost: float = 1.0):
+                 risk_weight: float = 0.0, rework_cost: float = 1.0,
+                 weights: Optional[PolicyWeights] = None):
         assert mode in ("naive", "numpy")
         self.decreasing = decreasing
         self.mode = mode
-        self.risk_weight = risk_weight
-        self.rework_cost = rework_cost
+        self.weights = resolve_weights(weights, risk_weight, rework_cost)
+        self.risk_weight = self.weights.risk_weight
+        self.rework_cost = self.weights.rework_cost
 
     def place(self, ctx: TickContext) -> np.ndarray:
         fold_quarantine(ctx)
@@ -285,12 +323,14 @@ class BestFitPolicy(Policy):
     name = "best_fit"
 
     def __init__(self, decreasing: bool = False, mode: str = "numpy",
-                 risk_weight: float = 0.0, rework_cost: float = 1.0):
+                 risk_weight: float = 0.0, rework_cost: float = 1.0,
+                 weights: Optional[PolicyWeights] = None):
         assert mode in ("naive", "numpy")
         self.decreasing = decreasing
         self.mode = mode
-        self.risk_weight = risk_weight
-        self.rework_cost = rework_cost
+        self.weights = resolve_weights(weights, risk_weight, rework_cost)
+        self.risk_weight = self.weights.risk_weight
+        self.rework_cost = self.weights.rework_cost
 
     def place(self, ctx: TickContext) -> np.ndarray:
         fold_quarantine(ctx)
@@ -368,6 +408,7 @@ class CostAwarePolicy(Policy):
         mode: str = "numpy",
         risk_weight: float = 0.0,
         rework_cost: float = 1.0,
+        weights: Optional[PolicyWeights] = None,
     ):
         assert bin_pack in ("first-fit", "best-fit")
         assert mode in ("naive", "numpy")
@@ -377,8 +418,14 @@ class CostAwarePolicy(Policy):
         self.realtime_bw = realtime_bw
         self.host_decay = host_decay
         self.mode = mode
-        self.risk_weight = risk_weight
-        self.rework_cost = rework_cost
+        self.weights = resolve_weights(weights, risk_weight, rework_cost)
+        self.risk_weight = self.weights.risk_weight
+        self.rework_cost = self.weights.rework_cost
+        #: (w_cost, w_bw, w_norm) when any score exponent departs from
+        #: the reference shape, else None — the None branch keeps the
+        #: exact unparameterized score expressions below (no ``pow``),
+        #: which is the default vector's bit-parity contract.
+        self._score_exp = self.weights.score_exponents()
 
     # -- grouping --------------------------------------------------------
     def group_tasks(
@@ -493,11 +540,21 @@ class CostAwarePolicy(Policy):
         """
         if self.sort_hosts:
             with np.errstate(divide="ignore"):
-                score = (
-                    cost_rt
-                    * self._decay(ctx, _NO_EXTRA)
-                    / (_norms(avail) * bw_rt)
-                )
+                if self._score_exp is None:
+                    score = (
+                        cost_rt
+                        * self._decay(ctx, _NO_EXTRA)
+                        / (_norms(avail) * bw_rt)
+                    )
+                else:
+                    # Searchable exponents (PolicyWeights): pow form,
+                    # engaged only off the default vector.
+                    wc, wb, wn = self._score_exp
+                    score = (
+                        cost_rt ** wc
+                        * self._decay(ctx, _NO_EXTRA)
+                        / (_norms(avail) ** wn * bw_rt ** wb)
+                    )
             if risk is not None:
                 score = score + risk  # the shared score += risk rule
             order = np.argsort(score, kind="stable")
@@ -570,7 +627,14 @@ class CostAwarePolicy(Policy):
                         if self.host_decay
                         else 1.0
                     )
-                    score = cost_rt[h] * r * decay / bw_rt[h]
+                    if self._score_exp is None:
+                        score = cost_rt[h] * r * decay / bw_rt[h]
+                    else:
+                        wc, wb, wn = self._score_exp
+                        score = (
+                            cost_rt[h] ** wc * r ** wn * decay
+                            / bw_rt[h] ** wb
+                        )
                     if risk is not None:
                         score = score + risk[h]
                     if score < best_score:
@@ -586,7 +650,17 @@ class CostAwarePolicy(Policy):
                     continue
                 residual = _norms(avail - demands[i])
                 with np.errstate(divide="ignore", invalid="ignore"):
-                    score = cost_rt * residual * self._decay(ctx, extra_tasks) / bw_rt
+                    if self._score_exp is None:
+                        score = (
+                            cost_rt * residual
+                            * self._decay(ctx, extra_tasks) / bw_rt
+                        )
+                    else:
+                        wc, wb, wn = self._score_exp
+                        score = (
+                            cost_rt ** wc * residual ** wn
+                            * self._decay(ctx, extra_tasks) / bw_rt ** wb
+                        )
                 if risk is not None:
                     score = score + risk  # the shared score += risk rule
                 score[~mask] = np.inf

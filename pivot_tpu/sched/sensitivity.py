@@ -28,7 +28,38 @@ import numpy as np
 
 from pivot_tpu.sched import Policy, TickContext
 
-__all__ = ["SensitivityGatedCostAware"]
+__all__ = ["SensitivityGatedCostAware", "evaluate_candidates"]
+
+
+def evaluate_candidates(weights, env, **kw) -> np.ndarray:
+    """Score a ``[B]`` population of scoring-weight vectors under a
+    seeded market environment: ``scores[b]`` is candidate b's mean
+    cost-per-completed-task over the environment's R paired Monte-Carlo
+    rollouts (lower is better).
+
+    This is the round-16 refactor of this module's batched-arm market
+    evaluator into a reusable library function: the machinery that
+    batches arms under a live market used to be private to the
+    gated-policy class below (``placement_sensitivity`` replica batches
+    scored through the policy's own kernel, replica 0 the production
+    decision) — now the batched evaluation over *candidate weight
+    vectors* is a first-class call the search loop
+    (``pivot_tpu.search.es`` / ``pivot_tpu.search.cem``) drives
+    directly.  ``weights`` is a ``[B, 5]`` matrix
+    (:meth:`pivot_tpu.search.PolicyWeights.stack`) or a sequence of
+    :class:`~pivot_tpu.search.PolicyWeights`; ``env`` a
+    :class:`~pivot_tpu.search.fitness.SearchEnv`.  Keyword arguments
+    (``key``, ``backend``, ``mesh``, ``tick_order``) pass through to
+    :func:`pivot_tpu.search.fitness.evaluate_rows` — the population is
+    one fused device dispatch, host-shardable over a replica mesh.
+
+    Imported lazily so this module (a ``sched`` citizen the CLI loads
+    eagerly) never drags the search/ensemble stack in by itself.
+    """
+    from pivot_tpu.search.fitness import evaluate_rows
+
+    scores, _details = evaluate_rows(weights, env, **kw)
+    return scores
 
 
 class SensitivityGatedCostAware(Policy):

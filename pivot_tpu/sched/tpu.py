@@ -86,6 +86,7 @@ from pivot_tpu.sched.policies import (
     _sort_decreasing,
     resolve_risk,
     resolve_root_anchor,
+    resolve_weights,
 )
 from pivot_tpu.sched.rand import tick_uniforms
 from pivot_tpu.utils import enable_compilation_cache as _enable_compilation_cache
@@ -199,18 +200,25 @@ class _DevicePolicyBase(Policy):
 
     def __init__(self, adaptive: bool = False, phase2="auto",
                  degrade_after: Optional[int] = None,
-                 risk_weight: float = 0.0, rework_cost: float = 1.0):
+                 risk_weight: float = 0.0, rework_cost: float = 1.0,
+                 weights=None):
         self.topology: Optional[DeviceTopology] = None
         self._scheduler = None
         self.adaptive = adaptive
-        #: Risk-aware placement (``infra/market.py``): weight and scalar
-        #: rework price of the eviction-risk scoring term.  The per-tick
-        #: [H] vector is resolved host-side by the SAME
-        #: ``policies.resolve_risk`` the CPU policies use (0.0 weight, no
-        #: market, or an all-calm tick ⇒ None ⇒ the risk-free compiled
-        #: program — and today's outputs — bit for bit).
-        self.risk_weight = risk_weight
-        self.rework_cost = rework_cost
+        #: The typed scoring-weight vector (round 16,
+        #: ``pivot_tpu/search/weights.py``) — the one source of truth
+        #: the legacy ``risk_weight``/``rework_cost`` knobs fold into
+        #: (``policies.resolve_weights``).  Risk-aware placement
+        #: (``infra/market.py``): the per-tick [H] vector is resolved
+        #: host-side by the SAME ``policies.resolve_risk`` the CPU
+        #: policies use (0.0 weight, no market, or an all-calm tick ⇒
+        #: None ⇒ the risk-free compiled program — and today's outputs
+        #: — bit for bit).  Score exponents off the default vector are
+        #: rejected by the cost-aware subclass (the scan kernels score
+        #: with the reference exponent shape — see its __init__).
+        self.weights = resolve_weights(weights, risk_weight, rework_cost)
+        self.risk_weight = self.weights.risk_weight
+        self.rework_cost = self.weights.rework_cost
         # Device-staged market state, reset at bind: per-segment [Z, Z]
         # cost slices (per-tick dispatches) and the [P, Z, Z] stack
         # (fused spans) — staged once per price segment / market, not
@@ -783,11 +791,11 @@ class TpuOpportunisticPolicy(_DevicePolicyBase):
 
     def __init__(self, adaptive: bool = False, phase2="auto",
                  degrade_after=None, risk_weight: float = 0.0,
-                 rework_cost: float = 1.0):
+                 rework_cost: float = 1.0, weights=None):
         super().__init__(adaptive, phase2, degrade_after,
-                         risk_weight, rework_cost)
+                         risk_weight, rework_cost, weights)
         self._cpu_twin = OpportunisticPolicy(
-            mode="numpy", risk_weight=risk_weight, rework_cost=rework_cost
+            mode="numpy", weights=self.weights
         )
 
     def _span_kw(self, ctx, plan, dem_host, B, K):
@@ -825,13 +833,13 @@ class TpuFirstFitPolicy(_DevicePolicyBase):
 
     def __init__(self, decreasing: bool = False, adaptive: bool = False,
                  phase2="auto", degrade_after=None,
-                 risk_weight: float = 0.0, rework_cost: float = 1.0):
+                 risk_weight: float = 0.0, rework_cost: float = 1.0,
+                 weights=None):
         super().__init__(adaptive, phase2, degrade_after,
-                         risk_weight, rework_cost)
+                         risk_weight, rework_cost, weights)
         self.decreasing = decreasing
         self._cpu_twin = FirstFitPolicy(
-            decreasing=decreasing, mode="numpy",
-            risk_weight=risk_weight, rework_cost=rework_cost,
+            decreasing=decreasing, mode="numpy", weights=self.weights,
         )
 
     def _span_kw(self, ctx, plan, dem_host, B, K):
@@ -891,13 +899,13 @@ class TpuBestFitPolicy(_DevicePolicyBase):
 
     def __init__(self, decreasing: bool = False, adaptive: bool = False,
                  phase2="auto", degrade_after=None,
-                 risk_weight: float = 0.0, rework_cost: float = 1.0):
+                 risk_weight: float = 0.0, rework_cost: float = 1.0,
+                 weights=None):
         super().__init__(adaptive, phase2, degrade_after,
-                         risk_weight, rework_cost)
+                         risk_weight, rework_cost, weights)
         self.decreasing = decreasing
         self._cpu_twin = BestFitPolicy(
-            decreasing=decreasing, mode="numpy",
-            risk_weight=risk_weight, rework_cost=rework_cost,
+            decreasing=decreasing, mode="numpy", weights=self.weights,
         )
 
     def _span_kw(self, ctx, plan, dem_host, B, K):
@@ -972,10 +980,19 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         degrade_after: Optional[int] = None,
         risk_weight: float = 0.0,
         rework_cost: float = 1.0,
+        weights=None,
     ):
         super().__init__(adaptive, phase2, degrade_after,
-                         risk_weight, rework_cost)
+                         risk_weight, rework_cost, weights)
         assert bin_pack in ("first-fit", "best-fit")
+        if self.weights.score_exponents() is not None:
+            raise ValueError(
+                "the device scan kernels score with the reference "
+                "exponent shape — non-default w_cost/w_bw/w_norm are "
+                "served by the CPU policy (CostAwarePolicy(weights=...)) "
+                "or the ensemble estimator's score_params path; the "
+                "device arms consume the vector's risk dims only"
+            )
         if realtime_bw and use_pallas:
             raise ValueError(
                 "realtime_bw is served by the scan kernel only — the "
@@ -1008,8 +1025,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             sort_hosts=sort_hosts,
             host_decay=host_decay,
             realtime_bw=realtime_bw,
-            risk_weight=risk_weight,
-            rework_cost=rework_cost,
+            weights=self.weights,
         )
         self._cpu_twin = self._grouper
 

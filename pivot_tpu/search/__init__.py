@@ -1,0 +1,82 @@
+"""Policy search at ensemble scale: learned schedulers with a regret oracle.
+
+The subsystem that closes ROADMAP item 2 — it turns the vmapped rollout
+ensemble from an evaluation engine into an *optimization* engine:
+
+  * :mod:`pivot_tpu.search.weights` — :class:`PolicyWeights`, the typed
+    scoring-weight vector every backend accepts (exponents on the
+    fit/egress/bandwidth score terms + the PR-9 risk pair), with the
+    hand-tuned defaults reproducing today's decisions bit-identically.
+  * :mod:`pivot_tpu.search.fitness` — :class:`SearchEnv`, a seeded
+    spot-market evaluation environment (``MarketSchedule`` hazards +
+    the hazard-drawn ``ChaosSchedule`` preemption plan rendered into
+    ensemble fault triples), and the jitted population evaluator behind
+    ``pivot_tpu.sched.sensitivity.evaluate_candidates``: a [B]
+    candidate population × R seeded Monte-Carlo rollouts as ONE device
+    dispatch per generation, host-shardable over the mesh's replica
+    axis so populations reach 10k+ rows.
+  * :mod:`pivot_tpu.search.es` / :mod:`pivot_tpu.search.cem` —
+    evolution-strategies and cross-entropy-method optimizers over that
+    evaluator; seed-replayable end to end (same seed + same env ⇒ the
+    identical winning vector and generation-by-generation fitness
+    trace, across both fitness backends).
+  * :mod:`pivot_tpu.search.oracle` — an exact small-instance
+    branch-and-bound solver over the same fit + egress + risk
+    objective, so "learned beats hand-tuned" is reported as *regret
+    against an optimum* instead of a delta between heuristics.
+
+The package ``__init__`` stays import-light on purpose: ``sched``
+imports :class:`PolicyWeights` from here (the one place the layering
+inverts), so the optimizer/fitness stack loads lazily via PEP 562 —
+importing ``pivot_tpu.search`` must never drag JAX in.
+"""
+
+from __future__ import annotations
+
+from pivot_tpu.search.weights import (  # noqa: F401
+    DEFAULT_WEIGHTS,
+    PolicyWeights,
+    SearchSpace,
+)
+
+__all__ = [
+    "DEFAULT_WEIGHTS",
+    "PolicyWeights",
+    "SearchSpace",
+    "SearchEnv",
+    "make_search_env",
+    "evaluate_candidates",
+    "cem_search",
+    "es_search",
+    "OracleInstance",
+    "solve_instance",
+    "placement_objective",
+    "greedy_placement",
+    "regret",
+]
+
+#: Lazily-resolved public names → defining submodule.  ``evaluate_candidates``
+#: resolves through ``sched.sensitivity`` — the library exposure of the
+#: batched-arm market evaluator (see that module) — so the two surfaces
+#: are one function.
+_LAZY = {
+    "SearchEnv": "pivot_tpu.search.fitness",
+    "make_search_env": "pivot_tpu.search.fitness",
+    "evaluate_candidates": "pivot_tpu.sched.sensitivity",
+    "cem_search": "pivot_tpu.search.cem",
+    "es_search": "pivot_tpu.search.es",
+    "OracleInstance": "pivot_tpu.search.oracle",
+    "solve_instance": "pivot_tpu.search.oracle",
+    "placement_objective": "pivot_tpu.search.oracle",
+    "greedy_placement": "pivot_tpu.search.oracle",
+    "regret": "pivot_tpu.search.oracle",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

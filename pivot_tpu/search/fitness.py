@@ -1,0 +1,488 @@
+"""The search loop's fitness environment and population evaluator.
+
+A :class:`SearchEnv` is one seeded spot-market evaluation world, fully
+rendered into device operands:
+
+  * a synthetic cluster + two-stage DAG workload (the spot-survival
+    shape, ``experiments/spot.py``), flattened to an
+    :class:`~pivot_tpu.parallel.ensemble.EnsembleWorkload`;
+  * the seeded :class:`~pivot_tpu.infra.market.MarketSchedule` rendered
+    twice — its per-host piecewise hazard trace ``([P], [P, H])`` feeds
+    the tick body's risk term, and its hazard-drawn
+    :class:`~pivot_tpu.infra.faults.ChaosSchedule` preemption plan
+    (``spot_schedule``) is converted to the ensemble's fault triple, so
+    every candidate lives through the *identical* eviction game
+    (common random numbers: between-candidate variance excludes the
+    fault scenario);
+  * billing constants for the cost-per-completed-task score.
+
+:func:`evaluate_rows` scores a ``[B]`` candidate population of
+:class:`~pivot_tpu.search.weights.PolicyWeights` vectors under R seeded
+Monte-Carlo rollouts each — ``B × R`` rows through the ensemble's
+row-based runner as **one jitted device dispatch per generation**
+(``_fitness_rows``; the inner segment/finalize programs inline).  Two
+backends, held bit-identical by ``tests/test_search.py``:
+
+  * ``"rollout"`` — the plain single-device program;
+  * ``"sharded_rollout"`` — the same program with its ``[B × R]`` row
+    axis sharded over a replica mesh (``NamedSharding`` outputs, the
+    ``sharded_rollout`` idiom), which is what lets candidate
+    populations reach 10k+ rows on a pod: per-row rollouts are
+    embarrassingly parallel, so XLA partitions the vmapped while_loop
+    with zero cross-row traffic.  Per-candidate reductions happen
+    host-side in one fixed order for both backends — the
+    generation-by-generation fitness trace is backend-invariant bit
+    for bit.
+
+The public library surface is
+:func:`pivot_tpu.sched.sensitivity.evaluate_candidates` — the
+batched-arm market evaluator refactored out of the gated-policy class
+(see that module's docstring); the optimizers (``search/es.py``,
+``search/cem.py``) call it, and it delegates here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pivot_tpu.ops.kernels import DeviceTopology
+from pivot_tpu.ops.shard import check_row_divisibility, row_sharding
+from pivot_tpu.parallel.ensemble.draws import _perturbations
+from pivot_tpu.parallel.ensemble.sweeps import _run_rows, _tile_rows
+from pivot_tpu.search.weights import PolicyWeights
+
+__all__ = [
+    "SearchEnv",
+    "chaos_to_faults",
+    "evaluate_rows",
+    "make_search_env",
+]
+
+#: Fitness backends (tests sweep both for bit-identity).
+BACKENDS = ("rollout", "sharded_rollout")
+
+
+class SearchEnv(NamedTuple):
+    """One seeded fitness world, device-operand-ready.  Built by
+    :func:`make_search_env`; consumed by :func:`evaluate_rows` and the
+    optimizers.  All array members are committed device/host arrays —
+    the environment itself is immutable across generations, so staging
+    happens once."""
+
+    workload: object          # EnsembleWorkload
+    topo: DeviceTopology
+    avail0: jax.Array         # [H, 4]
+    storage_zones: jax.Array  # [S] i32
+    hazard: Optional[Tuple[jax.Array, jax.Array]]  # ([P], [P, H]) or None
+    faults: Optional[Tuple[jax.Array, jax.Array, jax.Array]]  # [F] triple
+    tick: float
+    max_ticks: int
+    n_replicas: int
+    perturb: float
+    rate_per_hour: float
+    price_scale: float        # time-mean market price multiplier
+    incomplete_penalty: float  # $ per task still pending at the horizon
+    seed: int
+    n_preemptions: int        # diagnostics: events in the fault plan
+
+    @property
+    def n_tasks(self) -> int:
+        return self.workload.n_tasks
+
+
+def chaos_to_faults(schedule, cluster):
+    """Render a :class:`ChaosSchedule` into the ensemble's fault triple
+    ``([F] host index, [F] fail_at, [F] recover_at)``.
+
+    Preemptions abort at ``at + lead`` (the warning window is the DES's
+    proactive-drain affordance; the estimator has no drain machinery,
+    so the abort instant is the fault) and recover after ``duration``
+    (None ⇒ never); plain host outages abort at ``at``.  Stragglers and
+    partitions have no tick-resolution analog in the estimator and are
+    skipped — the fitness environment's plans are preemption-only
+    (``MarketSchedule.spot_schedule``), so nothing is silently dropped
+    there.  Events sort by abort time for a stable layout.  Returns
+    None for an event-free plan.
+    """
+    index = {h.id: i for i, h in enumerate(cluster.hosts)}
+    rows = []
+    for ev in schedule.events:
+        if ev.kind == "preemption":
+            fail = ev.at + ev.lead
+        elif ev.kind == "host_outage":
+            fail = ev.at
+        else:
+            continue
+        rec = fail + ev.duration if ev.duration is not None else np.inf
+        rows.append((fail, index[ev.target], rec))
+    if not rows:
+        return None
+    rows.sort()
+    host = np.asarray([r[1] for r in rows], dtype=np.int32)
+    fail = np.asarray([r[0] for r in rows], dtype=np.float64)
+    rec = np.asarray([r[2] for r in rows], dtype=np.float64)
+    return host, fail, rec
+
+
+def make_search_env(
+    n_hosts: int = 12,
+    seed: int = 3,
+    n_apps: int = 8,
+    horizon: float = 600.0,
+    *,
+    tick: float = 5.0,
+    max_ticks: Optional[int] = None,
+    n_replicas: int = 8,
+    perturb: float = 0.1,
+    rate_per_hour: float = 1.0,
+    incomplete_penalty: float = 1.0,
+    arrival_spacing: float = 40.0,
+    lead: float = 15.0,
+    outage: float = 100.0,
+    fault_seed: Optional[int] = None,
+    dtype=jnp.float32,
+    # MarketSchedule.generate knobs — the spot-survival defaults
+    # (experiments/spot.py): a large discounted-and-hazardous pool next
+    # to calm on-demand zones, so the risk dimension has signal.
+    n_segments: int = 6,
+    hot_fraction: float = 0.4,
+    hot_hazard: float = 2e-2,
+    hot_discount: float = 0.65,
+    base_hazard: float = 5e-4,
+    price_vol: float = 0.15,
+) -> SearchEnv:
+    """Build one seeded fitness world.  A pure function of its
+    arguments: the cluster, workload, market, and preemption plan are
+    all derived from ``seed`` (``fault_seed`` defaults to it), so two
+    calls yield operand-identical environments — the replay anchor the
+    determinism suite holds the search to.  Held-out evaluation is just
+    this function at different seeds.
+    """
+    from pivot_tpu.experiments.spot import synthetic_spot_apps
+    from pivot_tpu.infra.market import MarketSchedule
+    from pivot_tpu.parallel.ensemble import EnsembleWorkload
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    reset_ids()  # deterministic host-N ids per (n_hosts, seed)
+    cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed))
+    market = MarketSchedule.generate(
+        cluster.meta, seed=seed, horizon=horizon, n_segments=n_segments,
+        hot_fraction=hot_fraction, hot_hazard=hot_hazard,
+        hot_discount=hot_discount, base_hazard=base_hazard,
+        price_vol=price_vol,
+    )
+    apps = synthetic_spot_apps(n_apps, seed)
+    arrivals = [
+        (i * arrival_spacing if arrival_spacing > 0 else 0.0)
+        for i in range(len(apps))
+    ]
+    workload = EnsembleWorkload.from_applications(
+        apps, arrivals=arrivals, dtype=dtype
+    )
+    topo = DeviceTopology.from_cluster(cluster, dtype)
+    avail0 = jnp.asarray(cluster.availability_matrix(), dtype=dtype)
+    storage_zones = jnp.asarray(cluster.storage_zone_vector())
+
+    host_zones = np.asarray(topo.host_zone)
+    hz_rows = market.hazard[:, host_zones]  # [P, H]
+    hazard = None
+    if hz_rows.any():
+        hazard = (
+            jnp.asarray(market.times, dtype=dtype),
+            jnp.asarray(hz_rows, dtype=dtype),
+        )
+
+    plan = market.spot_schedule(
+        cluster, seed=seed if fault_seed is None else fault_seed,
+        lead=lead, outage=outage, horizon=horizon,
+    )
+    triple = chaos_to_faults(plan, cluster)
+    faults = None
+    n_preempt = 0
+    if triple is not None:
+        host, fail, rec = triple
+        n_preempt = int(host.shape[0])
+        faults = (
+            jnp.asarray(host),
+            jnp.asarray(fail, dtype=dtype),
+            jnp.asarray(rec, dtype=dtype),
+        )
+
+    # Time-mean price multiplier: the estimator's busy integral is one
+    # scalar per rollout (no per-zone attribution), so instance dollars
+    # bill at the market's duration-weighted mean multiplier.  The DES
+    # harness (experiments/search.py) re-validates winners under the
+    # exact piecewise-price integral (billed_instance_cost).
+    bounds = np.append(market.times, horizon)
+    durs = np.maximum(np.diff(bounds), 0.0)
+    total = float(durs.sum())
+    price_scale = (
+        float((durs * market.price.mean(axis=1)).sum() / total)
+        if total > 0 else 1.0
+    )
+
+    if max_ticks is None:
+        # Horizon plus slack for preemption rework; the while_loop
+        # early-exits once every task is done, so slack is free.
+        max_ticks = int(np.ceil(horizon / tick)) * 2
+
+    return SearchEnv(
+        workload=workload,
+        topo=topo,
+        avail0=avail0,
+        storage_zones=storage_zones,
+        hazard=hazard,
+        faults=faults,
+        tick=float(tick),
+        max_ticks=int(max_ticks),
+        n_replicas=int(n_replicas),
+        perturb=float(perturb),
+        rate_per_hour=float(rate_per_hour),
+        price_scale=price_scale,
+        incomplete_penalty=float(incomplete_penalty),
+        seed=int(seed),
+        n_preemptions=n_preempt,
+    )
+
+
+# -- the jitted population programs ------------------------------------------
+#
+# Two programs per generation, split on purpose: the Monte-Carlo draws
+# are a tiny ALWAYS-UNSHARDED program shared verbatim by both fitness
+# backends, because ``jax.random`` lowers its counters differently when
+# the consuming computation is partitioned (``jax_threefry_partitionable``
+# is off repo-wide to keep every existing result bit-stable) — drawing
+# inside the sharded program would silently change the scenarios under
+# the mesh.  The population rollout itself — the heavy part — is ONE
+# device dispatch per generation in either backend.
+
+
+def _draw_rows_impl(
+    key,
+    workload,
+    avail0,
+    storage_zones,
+    n_candidates: int,
+    n_replicas: int,
+    perturb: float,
+):
+    """[B × R] candidate-major draw rows (runtimes, arrivals, anchors):
+    the R Monte-Carlo scenarios drawn ONCE and tiled across candidates
+    (paired comparisons — common random numbers)."""
+    rt, arr, ra = _perturbations(
+        key, workload, storage_zones, n_replicas, perturb, avail0.dtype
+    )
+    B = n_candidates
+    return _tile_rows(rt, B), _tile_rows(arr, B), _tile_rows(ra, B)
+
+
+_draw_rows = jax.jit(
+    _draw_rows_impl,
+    static_argnames=("n_candidates", "n_replicas", "perturb"),
+)
+
+
+def _fitness_rows_impl(
+    rt_rows,         # [B·R, T] tiled perturbed runtimes (_draw_rows)
+    arr_rows,        # [B·R, T] tiled perturbed arrivals
+    ra_rows,         # [B·R, T] i32 tiled root anchors
+    warr,            # [B, 5] candidate PolicyWeights matrix
+    avail0,          # [H, 4]
+    workload,
+    topo: DeviceTopology,
+    hazard,          # ([P], [P, H]) or None — replica-shared market trace
+    faults,          # ([F], [F], [F]) or None — the shared preemption plan
+    tick: float,
+    max_ticks: int,
+    forms: str,
+    tick_order: str,
+):
+    """[B × R] candidate rows to slim per-row metrics, as ONE program.
+
+    Row layout is candidate-major (row b = candidate ``b // R``, replica
+    ``b % R``).  Every candidate's exponents ride the ``score_params``
+    pow path and its risk product the ``risk_coeff`` channel —
+    including the hand-tuned anchors, so population scoring is one
+    compiled program and candidate deltas can never come from path
+    divergence.  Returns ``(egress, instance_hours, n_unfinished,
+    makespan)``, each ``[B × R]`` — the full finish/placement tensors
+    stay on device.
+    """
+    B = warr.shape[0]
+    n_rows = rt_rows.shape[0]
+    R = n_rows // B
+    warr = jnp.asarray(warr, avail0.dtype)
+    avail_rows = jnp.broadcast_to(avail0, (B * R,) + avail0.shape)
+    sp = jnp.repeat(warr[:, :3], R, axis=0)          # [B·R, 3] exponents
+    # The risk channel rides only when the environment has a hazard
+    # trace — without one the term is disengaged for every candidate
+    # (``resolve_risk`` semantics: no market ⇒ no risk ops traced).
+    rc = (
+        jnp.repeat(warr[:, 3] * warr[:, 4], R)       # [B·R] risk coeff
+        if hazard is not None else None
+    )
+    fault_rows = None
+    totals = None
+    if faults is not None:
+        fh, ff, fr = faults
+        F = fh.shape[0]
+        fault_rows = (
+            jnp.broadcast_to(fh, (B * R, F)),
+            jnp.broadcast_to(ff, (B * R, F)),
+            jnp.broadcast_to(fr, (B * R, F)),
+        )
+        totals = avail_rows
+    res = _run_rows(
+        avail_rows, rt_rows, arr_rows, ra_rows,
+        workload, topo, tick, max_ticks, None,
+        "cost-aware", False, False,
+        faults=fault_rows,
+        totals=totals,
+        score_params=sp,
+        risk_coeff=rc,
+        hazard=hazard,
+        forms=forms,
+        tick_order=tick_order,
+    )
+    return (
+        res.egress_cost, res.instance_hours, res.n_unfinished, res.makespan
+    )
+
+
+#: The single-device fitness program: one dispatch per generation.
+_fitness_rows = jax.jit(
+    _fitness_rows_impl,
+    static_argnames=("tick", "max_ticks", "forms", "tick_order"),
+)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_fitness_fn(mesh, tick, max_ticks, forms, tick_order):
+    """Cached jitted fitness per (mesh, static config): the identical
+    row program with its ``[B × R]`` row axis sharded over the mesh's
+    ``replica`` axis — the ``sharded_rollout`` idiom (replicated
+    inputs, ``NamedSharding`` outputs; per-row rollouts partition with
+    zero cross-row traffic)."""
+    out = row_sharding(mesh)
+    return jax.jit(
+        functools.partial(
+            _fitness_rows_impl,
+            tick=tick, max_ticks=max_ticks, forms=forms,
+            tick_order=tick_order,
+        ),
+        out_shardings=(out, out, out, out),
+    )
+
+
+def evaluate_rows(
+    weights,
+    env: SearchEnv,
+    *,
+    key=None,
+    backend: str = "rollout",
+    mesh=None,
+    forms: Optional[str] = None,
+    tick_order: str = "fifo",
+) -> Tuple[np.ndarray, dict]:
+    """Score a candidate population under ``env``.
+
+    ``weights`` is a ``[B, 5]`` matrix (``PolicyWeights.stack``) or a
+    sequence of :class:`PolicyWeights`.  Returns ``(scores [B],
+    details)`` where ``scores[b]`` is candidate b's mean
+    cost-per-completed-task over the R paired rollouts (lower is
+    better; incomplete rollouts pay ``env.incomplete_penalty`` per
+    pending task) and ``details`` carries the per-candidate metric
+    breakdown.  ``key`` defaults to ``PRNGKey(env.seed)``; optimizers
+    fold the generation index in so draws refresh while staying
+    seed-replayable.
+
+    ``backend="sharded_rollout"`` requires ``mesh`` (a replica mesh,
+    ``parallel.mesh.replica_mesh``) and ``B × R`` divisible over its
+    replica axis; per-row values — and therefore scores — are
+    bit-identical to the ``"rollout"`` backend.
+    """
+    from pivot_tpu.parallel.ensemble.state import _resolve_forms
+
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"unknown fitness backend {backend!r} — one of {BACKENDS}"
+        )
+    warr = (
+        np.asarray(weights, dtype=np.float64)
+        if isinstance(weights, np.ndarray)
+        else PolicyWeights.stack(list(weights))
+    )
+    if warr.ndim != 2 or warr.shape[1] != PolicyWeights.DIM:
+        raise ValueError(
+            f"weights must be [B, {PolicyWeights.DIM}], got {warr.shape}"
+        )
+    if not np.all(np.isfinite(warr)):
+        raise ValueError("candidate weights must be finite")
+    B, R = warr.shape[0], env.n_replicas
+    if key is None:
+        key = jax.random.PRNGKey(env.seed)
+    forms = _resolve_forms(forms)
+    # Draws come from the shared UNSHARDED program in both backends —
+    # see the draw/rollout split note above (threefry lowering).
+    rt_rows, arr_rows, ra_rows = _draw_rows(
+        key, env.workload, env.avail0, env.storage_zones,
+        n_candidates=B, n_replicas=R, perturb=env.perturb,
+    )
+    args = (
+        rt_rows, arr_rows, ra_rows, jnp.asarray(warr), env.avail0,
+        env.workload, env.topo, env.hazard, env.faults,
+    )
+    statics = dict(
+        tick=env.tick, max_ticks=env.max_ticks, forms=forms,
+        tick_order=tick_order,
+    )
+    if backend == "sharded_rollout":
+        if mesh is None:
+            raise ValueError(
+                "backend='sharded_rollout' needs a replica mesh "
+                "(parallel.mesh.replica_mesh)"
+            )
+        check_row_divisibility(mesh, B * R)
+        fn = _sharded_fitness_fn(mesh, **statics)
+        egress, ihours, unfin, makespan = fn(*args)
+    else:
+        egress, ihours, unfin, makespan = _fitness_rows(*args, **statics)
+
+    # Host-side per-candidate reduction, ONE fixed order for both
+    # backends (the device programs return per-row scalars; a device
+    # cross-row mean could re-associate differently under sharding).
+    egress = np.asarray(egress, np.float64).reshape(B, R)
+    ihours = np.asarray(ihours, np.float64).reshape(B, R)
+    unfin = np.asarray(unfin, np.float64).reshape(B, R)
+    makespan = np.asarray(makespan, np.float64).reshape(B, R)
+    T = env.n_tasks
+    completed = T - unfin
+    cost = (
+        ihours * env.rate_per_hour * env.price_scale
+        + egress
+        + env.incomplete_penalty * unfin
+    )
+    per_row = np.where(completed > 0, cost / np.maximum(completed, 1.0),
+                       np.inf)
+    scores = per_row.mean(axis=1)
+    details = {
+        "scores": scores,
+        "egress": egress.mean(axis=1),
+        "instance_cost": (
+            ihours * env.rate_per_hour * env.price_scale
+        ).mean(axis=1),
+        "unfinished": unfin.mean(axis=1),
+        "makespan": makespan.mean(axis=1),
+        "completed": completed.mean(axis=1),
+        "n_rows": B * R,
+        "backend": backend,
+    }
+    return scores, details

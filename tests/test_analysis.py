@@ -58,6 +58,7 @@ def _scope_skeleton(tmp_path):
         "pivot_tpu/infra/market.py",
         "pivot_tpu/sched/__init__.py",
         "pivot_tpu/ops/__init__.py",
+        "pivot_tpu/search/__init__.py",
     ):
         p = tmp_path / rel
         p.parent.mkdir(parents=True, exist_ok=True)
@@ -708,6 +709,25 @@ def test_retrace_flags_unregistered_jit_file(tmp_path):
     findings = run(root=root, rules=["retrace"])
     assert any(
         "newjit.py" in f.message and "JIT_FILES" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
+def test_retrace_flags_unregistered_jit_in_search(tmp_path):
+    """Round-16 satellite: the policy-search package rides the same
+    register-or-flag discipline — a NEW ``search/`` file growing a
+    ``jax.jit`` entry point must join JIT_FILES or ``make lint``
+    (retrace) fails."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/search/newopt.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(
+        "import jax\n\n\ndef fitness(x):\n    return x\n\n\n"
+        "fast_fitness = jax.jit(fitness)\n"
+    )
+    findings = run(root=root, rules=["retrace"])
+    assert any(
+        "newopt.py" in f.message and "JIT_FILES" in f.message
         for f in findings
     ), "\n".join(str(f) for f in findings)
 

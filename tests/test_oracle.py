@@ -1,0 +1,202 @@
+"""Regret-oracle tests (round 16, ``pivot_tpu/search/oracle.py``).
+
+Two satellites pinned here: (1) on instances small enough to
+enumerate, branch-and-bound matches brute force exactly; (2) the
+oracle's objective matches the simulator's metered cost for the same
+placement — the egress dollars of a consumer wave computed by
+:func:`placement_objective` equal the ensemble estimator's own
+``_finalize`` bill (no objective drift between what the oracle
+optimizes and what the meter charges).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pivot_tpu.search.oracle import (
+    OracleInstance,
+    brute_force,
+    greedy_placement,
+    instance_from_wave,
+    placement_objective,
+    regret,
+    solve_instance,
+)
+from pivot_tpu.search.weights import DEFAULT_WEIGHTS, PolicyWeights
+
+
+def _random_instance(seed, T=5, H=4, Z=3, risk_coeff=10.0, penalty=2.0,
+                     cap_lo=2.0, cap_hi=6.0):
+    rng = np.random.default_rng(seed)
+    return OracleInstance(
+        avail=rng.uniform(cap_lo, cap_hi, (H, 4)),
+        demands=rng.uniform(0.5, 2.5, (T, 4)),
+        host_zone=(np.arange(H) % Z).astype(np.int32),
+        egress_tz=rng.uniform(0.0, 1.0, (T, Z)),
+        hazard=rng.uniform(0.0, 0.02, H),
+        risk_coeff=risk_coeff,
+        unplaced_penalty=penalty,
+        anchor_zone=rng.integers(0, Z, T).astype(np.int32),
+        cost_zz=rng.uniform(0.1, 1.0, (Z, Z)),
+        bw_zz=rng.uniform(50.0, 150.0, (Z, Z)),
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_bnb_matches_brute_force(seed):
+    inst = _random_instance(seed)
+    pb, ob = brute_force(inst)
+    ps, os_, stats = solve_instance(inst)
+    assert abs(ob - os_) < 1e-12, (ob, os_)
+    # Both vectors must be feasible and achieve the optimum (ties may
+    # pick different argmins; the objective is the contract).
+    assert abs(placement_objective(inst, ps) - ob) < 1e-12
+    assert stats["nodes"] >= 1
+
+
+def test_bnb_matches_brute_force_tight_capacity():
+    """Capacity so tight some tasks MUST go unplaced: the penalty arm
+    participates in the optimum and the solver must still match."""
+    inst = _random_instance(7, T=5, H=3, cap_lo=1.0, cap_hi=2.2,
+                            penalty=0.4)
+    pb, ob = brute_force(inst)
+    ps, os_, _ = solve_instance(inst)
+    assert abs(ob - os_) < 1e-12
+    assert np.any(np.asarray(ps) < 0) or np.any(np.asarray(pb) < 0)
+
+
+def test_objective_infeasible_placement_raises():
+    inst = _random_instance(3, T=4, H=2, cap_lo=1.0, cap_hi=1.5)
+    overload = np.zeros(4, dtype=np.int64)  # everything onto host 0
+    with pytest.raises(ValueError, match="infeasible"):
+        placement_objective(inst, overload)
+
+
+def test_regret_nonnegative_and_zero_at_optimum():
+    inst = _random_instance(11)
+    p_opt, opt, _ = solve_instance(inst)
+    assert regret(inst, p_opt, opt) == 0.0
+    g = greedy_placement(inst, DEFAULT_WEIGHTS)
+    assert regret(inst, g, opt) >= -1e-12
+    # Learned-style vectors route through the same greedy arm.
+    g2 = greedy_placement(inst, PolicyWeights(w_cost=2.0, risk_weight=3.0))
+    assert regret(inst, g2, opt) >= -1e-12
+
+
+def test_oracle_objective_matches_simulator_egress():
+    """No-objective-drift satellite: the oracle's egress for a consumer
+    wave equals the ensemble simulator's metered bill (``_finalize``)
+    for the SAME placement, on an f64 workload."""
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.ops.kernels import DeviceTopology
+    from pivot_tpu.parallel.ensemble import EnsembleWorkload
+    from pivot_tpu.parallel.ensemble.bill import _finalize
+    from pivot_tpu.parallel.ensemble.state import RolloutState, _DONE
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+    from pivot_tpu.workload import Application, TaskGroup
+
+    reset_ids()
+    cluster = build_cluster(ClusterConfig(n_hosts=6, seed=2))
+    topo = DeviceTopology.from_cluster(cluster, jnp.float64)
+    apps = [
+        Application(
+            f"a{i}",
+            [
+                TaskGroup("src", cpus=2, mem=256, runtime=50,
+                          output_size=200.0, instances=3),
+                TaskGroup("dst", cpus=2, mem=256, runtime=30,
+                          dependencies=["src"], instances=2),
+            ],
+        )
+        for i in range(2)
+    ]
+    wl = EnsembleWorkload.from_applications(apps, dtype=jnp.float64)
+    T = wl.n_tasks
+    group_of = np.asarray(wl.group_of)
+    is_root = np.asarray(wl.pred_group).sum(axis=1)[group_of] == 0
+    H = len(cluster.hosts)
+
+    # Producers round-robin; consumers by a fixed test vector.
+    pp = np.full(T, -1, dtype=np.int64)
+    prod_idx = np.nonzero(is_root)[0]
+    pp[prod_idx] = np.arange(len(prod_idx)) % H
+    cons_idx = np.nonzero(~is_root)[0]
+    cons_place = (np.arange(len(cons_idx)) * 2 + 1) % H
+
+    avail = np.asarray(cluster.availability_matrix(), dtype=np.float64)
+    inst = instance_from_wave(
+        wl, topo, avail, pp, ~is_root, weights=DEFAULT_WEIGHTS,
+        unplaced_penalty=0.0,
+    )
+    # Oracle side: risk disengaged, penalty 0 ⇒ objective == egress $.
+    assert inst.risk_coeff == 0.0
+    oracle_egress = placement_objective(inst, cons_place)
+
+    # Simulator side: every task DONE at its placement; _finalize's
+    # sampled-pull bill is the metered egress.
+    full_place = pp.copy()
+    full_place[cons_idx] = cons_place
+    state = RolloutState(
+        t=jnp.asarray(100.0, jnp.float64),
+        stage=jnp.full((T,), _DONE, dtype=jnp.int32),
+        finish=jnp.full((T,), 90.0, dtype=jnp.float64),
+        place=jnp.asarray(full_place, dtype=jnp.int32),
+        avail=jnp.asarray(avail),
+        busy=jnp.asarray(0.0, jnp.float64),
+        q=jnp.zeros((topo.cost.shape[0], H), dtype=jnp.float64),
+        qpos=jnp.full((T,), -1, dtype=jnp.int32),
+    )
+    res = _finalize(state, wl, topo)
+    sim_egress = float(res.egress_cost)
+    assert sim_egress > 0.0  # the wave actually bills something
+    np.testing.assert_allclose(oracle_egress, sim_egress, rtol=1e-9)
+
+
+def test_instance_from_experiment_harness_is_solvable():
+    from pivot_tpu.experiments.search import (
+        HAND_TUNED_ARMS,
+        small_oracle_instance,
+    )
+
+    inst, _env = small_oracle_instance(107)
+    p, opt, stats = solve_instance(inst)
+    assert np.isfinite(opt)
+    for name, w in HAND_TUNED_ARMS.items():
+        g = greedy_placement(inst, w)
+        assert regret(inst, g, opt) >= -1e-12, name
+
+
+def test_greedy_bin_pack_modes_mirror_policy_semantics():
+    """The two greedy modes carry their policy twins' semantics: the
+    best-fit arm's NON-strict fit takes an exactly-fitting host
+    (residual 0), the first-fit arm's strict fit must reject it."""
+    inst = OracleInstance(
+        avail=np.array([[1.0, 1, 1, 1], [5.0, 5, 5, 5]]),
+        demands=np.array([[1.0, 1, 1, 1]]),
+        host_zone=np.array([0, 1], np.int32),
+        egress_tz=np.array([[0.1, 0.9]]),
+        hazard=np.zeros(2),
+        risk_coeff=0.0,
+        unplaced_penalty=5.0,
+        anchor_zone=np.array([0], np.int32),
+        cost_zz=np.array([[0.1, 1.0], [1.0, 0.1]]),
+        bw_zz=np.full((2, 2), 100.0),
+    )
+    bf = greedy_placement(inst, bin_pack="best-fit")
+    ff = greedy_placement(inst, bin_pack="first-fit")
+    assert bf[0] == 0  # exact fit allowed non-strictly, residual 0
+    assert ff[0] == 1  # strict fit rejects the exactly-full host
+
+
+def test_brute_force_refuses_large_instances():
+    inst = _random_instance(0, T=12, H=6)
+    with pytest.raises(ValueError, match="shrink the instance"):
+        brute_force(inst)
+
+
+def test_bnb_node_budget_is_loud():
+    inst = _random_instance(1, T=6, H=5)
+    with pytest.raises(RuntimeError, match="node budget"):
+        solve_instance(inst, max_nodes=1)

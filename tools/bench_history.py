@@ -116,6 +116,16 @@ TRACKED: Tuple[Metric, ...] = (
         noise_path=("profiler_overhead", "profiler_off_noise_pct"),
     ),
     Metric(
+        "policy_search_rps",
+        ("policy_search", "rollouts_per_sec"),
+        lower_better=False, kind="rate",
+        # Generation wall includes the host-side optimizer update and
+        # per-candidate reductions, which ride box load like the serve
+        # rows do; phase-in: absent from pre-round-16 histories, so the
+        # gate engages once the first record carries it.
+        rel_floor=25.0,
+    ),
+    Metric(
         "serve_tiers_dps",
         ("serve_tiers", "fixed_pool", "decisions_per_sec"),
         lower_better=False, kind="rate",

@@ -51,6 +51,12 @@
 #      tools/bench_history.py check, and a SEEDED SYNTHETIC REGRESSION
 #      injected into it is flagged non-zero — the gate is proven live
 #      on every run, so it can never rot into a rubber stamp.
+#   8. policy search (round 16, pivot_tpu/search/): a tiny CEM search
+#      (2 generations, popsize 4, small cluster) over the committed
+#      seeded config (data/search/ci_seed.json) strictly beats the
+#      deliberately-bad initial weight vector, and two runs of the
+#      identical config emit bit-identical reports — the search's
+#      seed-replayability proven on every PR.
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -62,11 +68,11 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/7] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/8] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/7] graftcheck static analysis (10 passes) + compile check =="
+echo "== [2/8] graftcheck static analysis (10 passes) + compile check =="
 # Machine-readable findings, annotated per file:line; the 10 s timeout
 # IS the wall-clock budget check for the full static suite.  The
 # capture must not abort under `set -e` before lint_annotate has
@@ -91,7 +97,7 @@ python tools/hotpath_lint.py
 # assert ZERO recompiles in steady state (quick mode).
 python -m pivot_tpu.analysis --compile-check quick
 
-echo "== [3/7] chaos replay determinism on the committed seed =="
+echo "== [3/8] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -106,7 +112,7 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
-echo "== [4/7] sharded-placement parity on a forced 8-device CPU mesh =="
+echo "== [4/8] sharded-placement parity on a forced 8-device CPU mesh =="
 # Small-H quick twins + the H=1024 acceptance + the sharded span driver:
 # bit-parity with the single-device oracles, exercised on every run
 # without a TPU.  (conftest pins the same mesh; the explicit flag keeps
@@ -115,7 +121,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_shard.py tests/test_mesh.py -q -m 'not slow' \
     -k 'parity or span or mesh' -p no:cacheprovider
 
-echo "== [5/7] spot soak + market replay determinism on the committed seed =="
+echo "== [5/8] spot soak + market replay determinism on the committed seed =="
 MARKET_SEED_FILE=data/market/ci_seed.json
 # The quick acceptance soak (tier-1 twin in tests/test_market.py).
 python -m pytest tests/test_market.py -q -m 'not slow' \
@@ -135,7 +141,7 @@ python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
     --out "$TMP/spot_b.json"
 python tools/market_replay.py diff "$TMP/spot_a.json" "$TMP/spot_b.json"
 
-echo "== [6/7] observability plane: traced+profiled soak + trace check =="
+echo "== [6/8] observability plane: traced+profiled soak + trace check =="
 # A tiny traced serve soak through the CLI — device policy so the
 # sampled dispatch profiler (--profile-dispatch) has dispatches to
 # bracket; the Perfetto artifact must pass the structural + causal +
@@ -153,7 +159,7 @@ grep -q "pivot_dispatch_latency_seconds" "$TMP/soak.prom"
 python -m pytest tests/test_obs.py -q -m 'not slow' \
     -k 'parity or chain or overhead' -p no:cacheprovider
 
-echo "== [7/7] continuous-bench regression gate (committed baseline) =="
+echo "== [7/8] continuous-bench regression gate (committed baseline) =="
 BASELINE=data/bench/ci_baseline.jsonl
 # The committed baseline history must gate clean against itself...
 python tools/bench_history.py check --history "$BASELINE"
@@ -171,5 +177,41 @@ if [ "$inj_rc" -ne 1 ]; then
     echo "$inj_out" >&2
     exit 1
 fi
+
+echo "== [8/8] policy search: tiny CEM beats bad init + replays =="
+# The round-16 learned-scheduler gate: a tiny CEM search (2
+# generations, popsize 4, small cluster) over the COMMITTED seeded
+# config (data/search/ci_seed.json) must strictly beat the
+# deliberately-bad initial weight vector it starts from, and two runs
+# of the identical config must emit bit-identical reports (the search
+# is seed-replayable end to end: population sampling, scenario draws,
+# fitness, oracle regret).
+SEARCH_SEED_FILE=data/search/ci_seed.json
+python -m pivot_tpu.experiments.cli search --config "$SEARCH_SEED_FILE" \
+    --out "$TMP/search_a.json" > /dev/null
+python -m pivot_tpu.experiments.cli search --config "$SEARCH_SEED_FILE" \
+    --out "$TMP/search_b.json" > /dev/null
+cmp "$TMP/search_a.json" "$TMP/search_b.json" || {
+    echo "policy-search replay drifted between two runs of the" \
+         "committed config" >&2
+    exit 1
+}
+python - "$TMP/search_a.json" <<'PYEOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["beats_bad_init"], (
+    "the tiny CEM search failed to beat the deliberately-bad initial "
+    f"weight vector: best {r['search']['best_score']} vs init "
+    f"{r['search']['init_score']}"
+)
+assert r["search"]["best_score"] < r["search"]["init_score"]
+print(
+    "policy search gate: best %.6g beats bad init %.6g; regret vs "
+    "oracle: %s" % (
+        r["search"]["best_score"], r["search"]["init_score"],
+        r["oracle"]["regret"],
+    )
+)
+PYEOF
 
 echo "smoke lane: all green"
