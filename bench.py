@@ -1515,6 +1515,158 @@ def _bench_serve_tiers_in_child(timeout_s: int = 420) -> dict:
     return _run_row_in_child("PIVOT_BENCH_SERVE_TIERS_CHILD", timeout_s)
 
 
+def _bench_serve_sharded(
+    n_jobs: int = 40,
+    rate: float = 25.0,
+    n_hosts: int = 16,
+    queue_depth: int = 12,
+    seed: int = 0,
+    n_sessions: int = 3,
+) -> dict:
+    """2-D mesh serving row (round 17): the SAME mixed-tier stream at
+    100× the PR-2 ``serve_stream`` rate served by three stacks —
+
+      * ``batch_1d``  — cross-run batching only (the pre-round-17
+        serving stack: vmapped coalesced flushes, single device);
+      * ``shard_1d``  — host sharding only (sessions run free, each
+        dispatch host-sharded over the 8-device mesh, no coalescing);
+      * ``mesh_2d``   — batching × sharding composed on the
+        ``replica × host`` mesh + ``fuse_spans="slo"`` (the 100×
+        stack: coalesced 2-D flushes, fused spans between SLO
+        checkpoints).
+
+    Per arm: sustained decisions/s, per-tier p99 decision latency, the
+    dispatch mix, and span stats.  Runs on the forced-8-device CPU mesh
+    (the child pins the flag); same warm-start caveat as serve_tiers —
+    the FIRST arm pays jit compiles, so compare tiers within an arm and
+    dispatch mixes across arms.  Tracked as ``serve_sharded_dps``
+    (the ``mesh_2d`` arm) in ``tools/bench_history.py``, phase-in:
+    note-not-gate until the committed baseline carries rows."""
+    from pivot_tpu.parallel.mesh import build_hybrid_mesh
+    from pivot_tpu.serve import (
+        ServeDriver,
+        ServeSession,
+        mixed_tier_arrivals,
+        synthetic_app_factory,
+    )
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    mesh2d = build_hybrid_mesh(host_parallel=2)
+    pcfg = PolicyConfig(
+        name="cost-aware", device="tpu", bin_pack="first-fit",
+        sort_tasks=True, sort_hosts=True, adaptive=False,
+    )
+
+    def one_arm(label, sharded, fuse, mesh):
+        reset_ids()
+
+        def make_session(slabel):
+            policy = make_policy(pcfg)
+            if sharded:
+                policy.enable_sharding(mesh2d)
+            return ServeSession(
+                slabel,
+                build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed)),
+                policy,
+                seed=seed,
+                fuse_spans=fuse,
+            )
+
+        sessions = [
+            make_session(f"{label}-{g}") for g in range(n_sessions)
+        ]
+        driver = ServeDriver(
+            sessions,
+            queue_depth=queue_depth,
+            backpressure="shed",
+            flush_after=0.02,
+            mesh=mesh,
+            tier_reserve=(0, 2, 4),
+            tier_policies=("spill", "shed", "shed"),
+        )
+        stream = mixed_tier_arrivals(
+            rate, n_jobs, weights=(0.25, 0.35, 0.40), seed=seed,
+            make_app=synthetic_app_factory(seed=seed),
+        )
+        t0 = time.perf_counter()
+        report = driver.run(stream)
+        wall = time.perf_counter() - t0
+        driver.audit(context=f"serve_sharded bench ({label})")
+        snap = report["slo"]
+        tiers = {
+            tier: {
+                "p99_ms": round(
+                    tsnap["decision_latency_s"].get("p99", 0.0) * 1e3, 3
+                ),
+                "completed": tsnap["counters"]["completed"],
+                "shed": tsnap["counters"]["shed"],
+            }
+            for tier, tsnap in snap["tiers"].items()
+        }
+        span_stats = {
+            k: sum(
+                s.summary()["span_stats"][k]
+                for s in driver.sessions + driver._retired
+            )
+            for k in ("fused_spans", "fused_ticks", "ff_ticks",
+                      "span_aborts")
+        }
+        return {
+            "wall_s": round(wall, 3),
+            "decisions": snap["counters"]["decisions"],
+            "decisions_per_sec": round(
+                snap["counters"]["decisions"] / max(wall, 1e-9), 1
+            ),
+            "completed": snap["counters"]["completed"],
+            "shed": snap["counters"]["shed"],
+            "span_dispatches": snap["counters"]["span_dispatches"],
+            "dispatch": snap["dispatch"],
+            "span_stats": span_stats,
+            "tiers": tiers,
+            "mesh": report["mesh"],
+        }
+
+    return {
+        "jobs": n_jobs,
+        "arrival_rate": rate,
+        "rate_vs_pr2": round(rate / 0.25, 1),
+        "h": n_hosts,
+        "sessions": n_sessions,
+        "tier_mix": [0.25, 0.35, 0.40],
+        "batch_1d": one_arm("b1", sharded=False, fuse=False, mesh=None),
+        "shard_1d": one_arm("s1", sharded=True, fuse=False, mesh=None),
+        "mesh_2d": one_arm("m2", sharded=True, fuse="slo", mesh=mesh2d),
+    }
+
+
+def _serve_sharded_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SERVE_SHARDED_CHILD=1``): pin the
+    forced-8-device CPU mesh BEFORE the first jax import (XLA reads the
+    flag once per process — the shard_place arms' pattern), run the
+    serve_sharded row, print ONE JSON line."""
+    os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    jax = _child_backend_setup()
+    row = _bench_serve_sharded()
+    row["backend"] = jax.default_backend()
+    row["n_devices"] = len(jax.devices())
+    print(json.dumps(row), flush=True)
+
+
+def _bench_serve_sharded_in_child(timeout_s: int = 420) -> dict:
+    """Parent side of the serve_sharded row — see ``_run_row_in_child``."""
+    return _run_row_in_child("PIVOT_BENCH_SERVE_SHARDED_CHILD", timeout_s)
+
+
 # -- shard_place row: pod-scale host-sharded placement (ops/shard.py) -------
 #
 # Weak-scaling protocol: per-shard host count H0 held fixed while the
@@ -1916,7 +2068,8 @@ def main() -> None:
     if args.rows:
         known_rows = {
             "headline", "two_phase", "grid_batched", "fused_tick",
-            "serve_stream", "serve_tiers", "shard_place",
+            "serve_stream", "serve_tiers", "serve_sharded",
+            "shard_place",
             "spot_survival", "policy_search", "obs_overhead",
             "profiler_overhead", "cost_attribution", "saturated",
         }
@@ -1940,6 +2093,9 @@ def main() -> None:
         return
     if os.environ.get("PIVOT_BENCH_SERVE_TIERS_CHILD"):
         _serve_tiers_child()
+        return
+    if os.environ.get("PIVOT_BENCH_SERVE_SHARDED_CHILD"):
+        _serve_sharded_child()
         return
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
@@ -2045,6 +2201,10 @@ def main() -> None:
     )
     serve_tiers = (
         _bench_serve_tiers_in_child() if _row_on("serve_tiers")
+        else skipped
+    )
+    serve_sharded = (
+        _bench_serve_sharded_in_child() if _row_on("serve_sharded")
         else skipped
     )
     # Pod-scale sharded placement, also all-children (each arm pins its
@@ -2228,6 +2388,7 @@ def main() -> None:
         "fused_tick": fused_tick,
         "serve_stream": serve_stream,
         "serve_tiers": serve_tiers,
+        "serve_sharded": serve_sharded,
         "shard_place": shard_place,
         "spot_survival": spot_survival,
         "policy_search": policy_search,

@@ -50,6 +50,10 @@ DISCOVER: Dict[str, Tuple[str, ...]] = {
         "_sharded_span_body", "_two_stage_argmin*", "_first_index_of*",
         "_opportunistic_pick*", "_place_local", "_bump_local",
         "_risk_restrict_sharded*",
+        # Round-17 shared per-shard body factories: the closures the 1-D
+        # AND [G]-batched 2-D jit factories both wrap (a host sync here
+        # would poison every sharded program at once).
+        "*_sharded_body", "_span_fn_body",
     ),
     "pivot_tpu/parallel/ensemble/tick.py": ("_rollout_segment",),
     "pivot_tpu/search/fitness.py": ("_fitness_rows_impl", "_draw_rows_impl"),
@@ -63,7 +67,10 @@ REQUIRED: Dict[str, Tuple[str, ...]] = {
         "cost_aware_impl", "_speculate_commit",
     ),
     "pivot_tpu/ops/tickloop.py": ("_fused_tick_run_impl",),
-    "pivot_tpu/ops/shard.py": ("_sharded_span_body", "_two_stage_argmin"),
+    "pivot_tpu/ops/shard.py": (
+        "_sharded_span_body", "_two_stage_argmin",
+        "_cost_aware_sharded_body", "_span_fn_body",
+    ),
     "pivot_tpu/parallel/ensemble/tick.py": ("_rollout_segment",),
     "pivot_tpu/search/fitness.py": ("_fitness_rows_impl",),
 }

@@ -82,32 +82,38 @@ MANIFEST: Dict[str, Dict[str, Tuple[str, FrozenSet[str]]]] = {
         "opportunistic_kernel_ref": (_KERNELS, _REF_EXEMPT),
         "opportunistic_impl": (_KERNELS, frozenset()),
         "opportunistic_kernel_sharded": (_SHARD, frozenset()),
+        "opportunistic_kernel_sharded_batched": (_SHARD, frozenset()),
     },
     "first_fit": {
         "first_fit_kernel_ref": (_KERNELS, _REF_EXEMPT),
         "first_fit_impl": (_KERNELS, frozenset()),
         "first_fit_kernel_sharded": (_SHARD, frozenset()),
+        "first_fit_kernel_sharded_batched": (_SHARD, frozenset()),
     },
     "best_fit": {
         "best_fit_kernel_ref": (_KERNELS, _REF_EXEMPT),
         "best_fit_impl": (_KERNELS, frozenset()),
         "best_fit_kernel_sharded": (_SHARD, frozenset()),
+        "best_fit_kernel_sharded_batched": (_SHARD, frozenset()),
     },
     "cost_aware": {
         "cost_aware_kernel_ref": (_KERNELS, _REF_EXEMPT),
         "cost_aware_impl": (_KERNELS, frozenset()),
         "cost_aware_kernel_sharded": (_SHARD, frozenset()),
+        "cost_aware_kernel_sharded_batched": (_SHARD, frozenset()),
         "cost_aware_pallas": (_PALLAS, _PALLAS_EXEMPT),
         "cost_aware_pallas_batched": (_PALLAS, _PALLAS_EXEMPT),
     },
 }
 
 #: Span-driver family: one knob contract across the fused driver, the
-#: sequential referee, and the host-sharded twin.
+#: sequential referee, the host-sharded twin, and the round-17
+#: [G]-batched 2-D form.
 SPAN_MANIFEST: Dict[str, Tuple[str, FrozenSet[str]]] = {
     "fused_tick_run": (_TICKLOOP, frozenset()),
     "reference_tick_run": (_TICKLOOP, frozenset()),
     "sharded_fused_tick_run": (_SHARD, frozenset()),
+    "sharded_batched_tick_run": (_SHARD, frozenset()),
 }
 
 #: Knobs the routing layer must forward per family (∩ the family's
@@ -134,6 +140,8 @@ _DISCOVER = (
     (re.compile(r"^(?P<stem>[a-z]\w*)_kernel_ref$"), "kernel_ref"),
     (re.compile(r"^(?P<stem>[a-z]\w*)_impl$"), "impl"),
     (re.compile(r"^(?P<stem>[a-z]\w*)_kernel_sharded$"), "kernel_sharded"),
+    (re.compile(r"^(?P<stem>[a-z]\w*)_kernel_sharded_batched$"),
+     "kernel_sharded_batched"),
     (re.compile(r"^(?P<stem>[a-z]\w*)_pallas(_batched)?$"), "pallas"),
 )
 _DISCOVER_SPAN = re.compile(r"^[a-z]\w*tick_run$")

@@ -74,6 +74,7 @@ GUARDS: Dict[str, Dict[str, dict]] = {
                 "_release_to", "_recover_inflight", "_requeue",
                 "_wire_and_start", "_try_preempt", "_reoffer_spilled",
                 "_register_inflight", "_route", "_preempt_for",
+                "_release_one", "_batching_compatible",
             ),
             # Single-threaded lifecycle phases: __init__ precedes every
             # thread; report/audit run on the drained service.

@@ -572,6 +572,30 @@ def parse_args(argv=None):
                      choices=["cost-aware", "first-fit", "best-fit",
                               "opportunistic"],
                      help="placement arm every session runs")
+    srv.add_argument("--shard-hosts", type=int, default=0, metavar="S",
+                     help="2-D mesh serving (round 17): shard every "
+                          "session's host axis over S mesh shards AND "
+                          "coalesce co-pending dispatches over the "
+                          "remaining devices' replica axis — one "
+                          "shard_map(vmap) program per flush "
+                          "(build_hybrid_mesh(host_parallel=S); needs "
+                          "a device-backed policy and n_hosts "
+                          "divisible by S).  0 = off")
+    srv.add_argument("--fuse-spans", choices=["off", "slo"],
+                     default="off",
+                     help="serve-span mode: 'off' keeps per-tick "
+                          "dispatch (the bit-parity default); 'slo' "
+                          "fuses multi-tick spans between SLO "
+                          "checkpoints — spans bounded by the "
+                          "admission window, ONE decision latency "
+                          "per span with span lengths in the snapshot")
+    srv.add_argument("--tenant-quota", type=float, default=0.0,
+                     help="DRF tenant fairness within a tier: cap each "
+                          "tenant's dominant-resource occupancy at "
+                          "this share (0 < q <= 1) of its tier's "
+                          "total, shedding/spilling over-quota "
+                          "arrivals with reason 'tenant_quota'.  "
+                          "0 = off")
     srv.add_argument("--tier-mix", default="",
                      help="multi-tenant arrival mix: comma-separated "
                           "tier weights, index = priority tier (0 = "
@@ -1548,12 +1572,32 @@ def run_serve_stream(args) -> dict:
         arm.update(decreasing=True)  # the reference's VBP arm
     pcfg = PolicyConfig(**arm)
 
+    # 2-D mesh serving (round 17): --shard-hosts S builds the hybrid
+    # replica × host mesh once, shards every session policy's host axis
+    # over it, and hands it to the driver so coalesced flushes run the
+    # composed shard_map(vmap(...)) program.
+    mesh = None
+    if args.shard_hosts:
+        if args.device != "tpu":
+            raise SystemExit(
+                "--shard-hosts needs a device-backed policy "
+                "(--device tpu); numpy policies have no sharded form"
+            )
+        from pivot_tpu.parallel.mesh import build_hybrid_mesh
+
+        mesh = build_hybrid_mesh(host_parallel=args.shard_hosts)
+    fuse = "slo" if args.fuse_spans == "slo" else False
+
     def make_session(label):
+        policy = make_policy(pcfg)
+        if mesh is not None:
+            policy.enable_sharding(mesh)
         return ServeSession(
             label,
             build_cluster(_cluster_config(args)),
-            make_policy(pcfg),
+            policy,
             seed=args.seed,
+            fuse_spans=fuse,
         )
 
     sessions = [make_session(f"session-{g}") for g in range(args.sessions)]
@@ -1606,6 +1650,8 @@ def run_serve_stream(args) -> dict:
         tracer=tracer,
         registry=registry,
         profiler=profiler,
+        mesh=mesh,
+        tenant_quota=args.tenant_quota or None,
     )
     metrics_server = None
     if args.metrics_port:

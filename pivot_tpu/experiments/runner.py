@@ -117,7 +117,13 @@ def run_grid_lockstep(runs, stats_out: Optional[dict] = None,
     flush's stacked [G] axis over the mesh's ``replica`` axis, so
     co-pending runs execute on distinct devices — bit-identical results
     (``sched/batch.py``); ``stats_out['mesh_dispatches']`` counts the
-    flushes that actually sharded.
+    flushes that actually sharded and ``stats_out['mesh_fallbacks']``
+    the coalesced flushes that DROPPED the mesh because their padded
+    bucket did not divide the replica axis (single-device fallbacks —
+    bit-identical, but a mesh deployment should watch the count; the
+    first is also logged).  A 2-D ``replica × host`` mesh
+    (``build_hybrid_mesh``) additionally host-shards each row through
+    the registered ``*_kernel_sharded_batched`` programs (round 17).
     """
     import threading
 

@@ -331,6 +331,20 @@ def audit_serve(driver) -> List[str]:
         violations.append(
             f"{len(driver._inflight)} stale admission ledger entries"
         )
+    # DRF tenant fairness (round 17): with a tenant quota on, every
+    # admission charged its tenant's dominant-share occupancy and every
+    # settlement must have given exactly that share back — a drained
+    # service's per-(tier, tenant) ledger is zero.  A positive residue
+    # is a leaked release (that tenant is permanently over-charged and
+    # will be quota-shed forever); a negative one is a double release.
+    if getattr(q, "tenant_quota", None) is not None:
+        for (tier, tenant), occ in sorted(q.tenant_occupancy.items()):
+            if abs(occ) > 1e-6:
+                violations.append(
+                    f"tenant {tenant!r} tier {tier}: dominant-share "
+                    f"occupancy residue {occ:.6g} after drain "
+                    "(leaked or double-released quota charge)"
+                )
 
     def _check(counters, scope: str) -> None:
         admitted = counters.get("admitted", 0)

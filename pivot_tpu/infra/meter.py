@@ -478,6 +478,11 @@ class SloMeter(LogMixin):
         "kernel_failures", "degraded_decisions",
         "preempted", "preempt_requeued", "preempt_requests",
         "preempt_misses", "scale_up_events", "scale_down_events",
+        # Round-17 fused serve spans (``fuse_spans="slo"``): whole-span
+        # dispatches and the simulator ticks they covered — one
+        # decision-latency sample per span (the SLO-checkpoint
+        # contract), span lengths in the ``span_length`` histogram.
+        "span_dispatches", "span_ticks",
     )
 
     #: The dispatch-path mix section of the snapshot mirrors the
@@ -488,7 +493,7 @@ class SloMeter(LogMixin):
     DISPATCH_KEYS = (
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
         "deadline_flushes", "single_fast_path", "mesh_dispatches",
-        "respawns", "retired_slots",
+        "mesh_fallbacks", "respawns", "retired_slots",
     )
 
     #: Per-tier counter keys (each tier's section of the snapshot).
@@ -510,6 +515,9 @@ class SloMeter(LogMixin):
         self.decision_latency = StreamingHistogram(1e-6, 1e4)
         # Admitted-but-incomplete jobs at each arrival instant.
         self.queue_depth = StreamingHistogram(1.0, 1e7, bins_per_decade=32)
+        # Ticks per fused serve span (``fuse_spans="slo"``): how much
+        # simulator time each one-latency-sample dispatch covered.
+        self.span_length = StreamingHistogram(1.0, 1e4, bins_per_decade=32)
         # Sim-time job sojourn: admission timestamp -> app completion.
         self.sojourn_sim = StreamingHistogram(1e-3, 1e9, bins_per_decade=32)
         #: Per-tier telemetry, lazily created on first record for a tier
@@ -575,6 +583,22 @@ class SloMeter(LogMixin):
             self.counters["decisions"] += n_tasks
             self.counters["placed"] += n_placed
 
+    def record_span_decision(self, wall_s: float, n_ticks: int,
+                             n_tasks: int, n_placed: int) -> None:
+        """One fused serve span (``fuse_spans="slo"``): the whole span
+        is ONE decision-latency sample — the latency an admitted job
+        actually experienced at the dispatch boundary — with the span
+        length recorded separately so a reader can tell a 1-tick
+        dispatch from a 32-tick one (the snapshot's ``span_length``
+        section).  ``n_tasks`` counts the span's unique slots."""
+        with self._lock:
+            self.decision_latency.record(wall_s)
+            self.span_length.record(max(n_ticks, 1))
+            self.counters["span_dispatches"] += 1
+            self.counters["span_ticks"] += n_ticks
+            self.counters["decisions"] += n_tasks
+            self.counters["placed"] += n_placed
+
     def record_decision_tier(self, tier: int, wall_s: float,
                              n_tasks: int = 0) -> None:
         """Attribute one placement call's wall latency to ``tier`` —
@@ -629,6 +653,7 @@ class SloMeter(LogMixin):
                 "shed_reasons": dict(self.shed_reasons),
                 "decision_latency_s": self.decision_latency.snapshot(),
                 "queue_depth": self.queue_depth.snapshot(),
+                "span_length": self.span_length.snapshot(),
                 "sojourn_sim_s": self.sojourn_sim.snapshot(),
                 # The documented DispatchBatcher stats key set, zeros
                 # when the service never engaged a batcher — fixed

@@ -229,6 +229,31 @@ ENTRY_POINTS: Dict[Tuple[str, str], Tuple[str, str]] = {
     ("pivot_tpu/ops/shard.py", "_sharded_span_fn"): flag(
         "host-sharded twin of _fused_tick_run — see shard_place row"
     ),
+    # -- round-17 [G]-batched 2-D forms (batching × sharding composed):
+    # each row is the 1-D sharded program under vmap (bit-identical by
+    # tests/test_shard.py's 2-D suite), so per-row work is attributed
+    # by the single-device rows and the composed throughput by the
+    # serve_sharded bench row's mesh_2d arm.
+    ("pivot_tpu/ops/shard.py", "_opportunistic_sharded_batched_fn"): flag(
+        "[G]-batched 2-D form of _opportunistic_sharded_fn — see the "
+        "serve_sharded bench row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_first_fit_sharded_batched_fn"): flag(
+        "[G]-batched 2-D form of _first_fit_sharded_fn — see "
+        "serve_sharded row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_best_fit_sharded_batched_fn"): flag(
+        "[G]-batched 2-D form of _best_fit_sharded_fn — see "
+        "serve_sharded row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_cost_aware_sharded_batched_fn"): flag(
+        "[G]-batched 2-D form of _cost_aware_sharded_fn — see "
+        "serve_sharded row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_sharded_span_batched_fn"): flag(
+        "[G]-batched 2-D form of _sharded_span_fn — see serve_sharded "
+        "row"
+    ),
     # -- Pallas: Mosaic programs, only meaningful on the TPU backend -----
     ("pivot_tpu/ops/pallas_kernels.py", "cost_aware_pallas"): flag(
         "TPU-only Mosaic kernel; XLA cost_analysis does not see inside "

@@ -61,6 +61,24 @@ wait-stack rebuild — is imported from ``ops.tickloop`` verbatim and
 computed redundantly on every shard (it is O(B), replicated state), so
 the two drivers cannot drift.
 
+**2-D mesh: batching × sharding composed (round 17).**  Every sharded
+form also has a ``[G]``-batched twin (``*_kernel_sharded_batched``,
+:func:`sharded_batched_tick_run`) serving G coalesced dispatches on a
+``replica × host`` mesh: stacked operands shard their leading [G] run
+axis over ``replica`` and their host axis over ``host`` (e.g. stacked
+availability ``[G, H, 4]`` is ``P("replica", "host", None)``; stacked
+span risk rows ``[G, K, H]`` are ``P("replica", None, "host")``), and
+the program is ``shard_map(vmap(per-shard body))`` — the SAME per-shard
+bodies the 1-D twins run, vmapped over the local [G/R] rows.  Rows
+never communicate over ``replica`` (each is an independent run), and
+the ``host``-axis collectives batch per row, so the existing two-stage
+tie-break and chunk-commit proofs compose under vmap unchanged: each
+row's op sequence is the 1-D sharded program's, which is the flat
+program's.  ``DispatchBatcher`` (``sched/batch.py``) builds these
+through :func:`batched_sharded_call` whenever its mesh carries a
+non-trivial host axis — this is what lifts the old batching/sharding
+mutual exclusion in ``sched/tpu.py``.
+
 Layout contract: ``H`` must divide evenly by the mesh's host-axis size
 (pad the cluster with DOWN-sentinel hosts otherwise — a ``-1``
 availability row can never be selected).  All kernels are cached per
@@ -101,25 +119,40 @@ from pivot_tpu.ops.kernels import (
     _risk_key,
     _risk_score,
 )
+from pivot_tpu.ops.kernels import (
+    best_fit_kernel,
+    cost_aware_kernel,
+    first_fit_kernel,
+    opportunistic_kernel,
+)
 from pivot_tpu.ops.tickloop import (
     SpanResult,
     _span_group_entries,
     _span_ready_batch,
     _span_requeue,
     _span_stream_order,
+    fused_tick_run,
 )
 from pivot_tpu.parallel.mesh import host_axis_size
 
 __all__ = [
     "HOST_AXIS",
     "REPLICA_AXIS",
+    "batched_sharded_call",
     "best_fit_kernel_sharded",
+    "best_fit_kernel_sharded_batched",
     "check_row_divisibility",
     "cost_aware_kernel_sharded",
+    "cost_aware_kernel_sharded_batched",
     "first_fit_kernel_sharded",
+    "first_fit_kernel_sharded_batched",
+    "mesh_is_2d",
     "opportunistic_kernel_sharded",
+    "opportunistic_kernel_sharded_batched",
     "row_sharding",
+    "sharded_batched_tick_run",
     "sharded_fused_tick_run",
+    "sharded_twin_of",
 ]
 
 #: Mesh axis the host dimension shards over (``parallel.mesh.build_mesh``
@@ -171,6 +204,35 @@ def _check_host_axis(H: int, mesh) -> int:
             f"availability row is never selected) to a multiple of {n}"
         )
     return n
+
+
+def mesh_is_2d(mesh) -> bool:
+    """True when ``mesh`` composes both program axes: a non-trivial
+    ``host`` axis next to the ``replica`` axis (the ``build_hybrid_mesh``
+    / ``build_mesh(host_parallel=…)`` layout).  The batcher consults
+    this to decide between the plain ``vmap`` program (replica-only
+    mesh) and the 2-D ``shard_map(vmap(...))`` program."""
+    return (
+        HOST_AXIS in mesh.shape and REPLICA_AXIS in mesh.shape
+        and int(mesh.shape[HOST_AXIS]) > 1
+    )
+
+
+def _check_g_axis(mesh, G: int) -> None:
+    n = int(mesh.shape[REPLICA_AXIS])
+    if G % n:
+        raise ValueError(
+            f"batch axis G={G} does not divide over the mesh's {n} "
+            f"replica shards — the batcher's group bucket must be a "
+            f"multiple of the replica axis (sched.batch.group_bucket)"
+        )
+
+
+def _g_spec(spec: P) -> P:
+    """Prepend the replica axis to an operand spec: the stacked [G]
+    leading axis shards over ``replica``, everything after keeps the
+    1-D form's layout."""
+    return P(REPLICA_AXIS, *tuple(spec))
 
 
 def _sharded_mode(phase2):
@@ -824,9 +886,10 @@ def _opt_unpack(rest, has_live, has_risk):
     return live, risk
 
 
-@functools.lru_cache(maxsize=None)
-def _opportunistic_sharded_fn(mesh, mode, has_live, has_risk):
-    n = host_axis_size(mesh)
+def _opportunistic_sharded_body(mode, n_shards, has_live, has_risk):
+    """Per-shard opportunistic body — shared by the 1-D jit factory and
+    the [G]-batched 2-D factory (``shard_map(vmap(body))``), so the two
+    programs cannot drift."""
 
     def fn(avail, demands, valid, uniforms, *rest):
         live, risk = _opt_unpack(rest, has_live, has_risk)
@@ -834,20 +897,44 @@ def _opportunistic_sharded_fn(mesh, mode, has_live, has_risk):
         n_eff = _effective_len(valid)
         if mode == "step":
             p, a = _opportunistic_sharded_pass(
-                avail, demands, valid, uniforms, n_eff, n, risk
+                avail, demands, valid, uniforms, n_eff, n_shards, risk
             )
         else:
             p, a = _opportunistic_sharded_chunk(
                 avail, demands, valid, uniforms, n_eff,
-                min(mode, demands.shape[0]), n, risk,
+                min(mode, demands.shape[0]), n_shards, risk,
             )
         return p, restore(a)
 
+    return fn
+
+
+_OPP_SPECS = (_HOST_MAT, P(None, None), _REP, _REP)
+
+
+@functools.lru_cache(maxsize=None)
+def _opportunistic_sharded_fn(mesh, mode, has_live, has_risk):
+    fn = _opportunistic_sharded_body(
+        mode, host_axis_size(mesh), has_live, has_risk
+    )
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(_HOST_MAT, P(None, None), _REP, _REP)
-        + _opt_specs(has_live, has_risk),
+        in_specs=_OPP_SPECS + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _opportunistic_sharded_batched_fn(mesh, mode, has_live, has_risk):
+    fn = _opportunistic_sharded_body(
+        mode, host_axis_size(mesh), has_live, has_risk
+    )
+    specs = _OPP_SPECS + _opt_specs(has_live, has_risk)
+    return jax.jit(_shard_map(
+        jax.vmap(fn), mesh=mesh,
+        in_specs=tuple(_g_spec(s) for s in specs),
+        out_specs=(_g_spec(_REP), _g_spec(_HOST_MAT)),
         check_rep=False,
     ))
 
@@ -869,8 +956,28 @@ def opportunistic_kernel_sharded(mesh, avail, demands, valid, uniforms,
     )(*args)
 
 
-@functools.lru_cache(maxsize=None)
-def _first_fit_sharded_fn(mesh, mode, strict, has_live, has_risk):
+def opportunistic_kernel_sharded_batched(mesh, avail, demands, valid,
+                                         uniforms, phase2="auto",
+                                         live=None, risk=None):
+    """[G]-batched :func:`opportunistic_kernel_sharded`: every operand
+    carries a leading run axis sharded over the mesh's ``replica`` axis
+    while the host axis stays sharded over ``host`` — G coalesced
+    dispatches as ONE 2-D program, each row bit-identical to the 1-D
+    twin (the same per-shard body under vmap)."""
+    mode = _sharded_mode(phase2)
+    _check_host_axis(avail.shape[1], mesh)
+    _check_g_axis(mesh, avail.shape[0])
+    if demands.shape[1] == 0:
+        return jnp.zeros(demands.shape[:2], jnp.int32), avail
+    args = (avail, demands, valid, uniforms) + _opt_args(live, risk)
+    return _opportunistic_sharded_batched_fn(
+        mesh, mode, live is not None, risk is not None
+    )(*args)
+
+
+def _first_fit_sharded_body(mode, strict, has_live, has_risk):
+    """Per-shard first-fit body shared by the 1-D and batched factories."""
+
     def fn(avail, demands, valid, *rest):
         live, risk = _opt_unpack(rest, has_live, has_risk)
         avail, restore = _apply_live(avail, live)
@@ -886,11 +993,31 @@ def _first_fit_sharded_fn(mesh, mode, strict, has_live, has_risk):
             )
         return p, restore(a)
 
+    return fn
+
+
+_FF_SPECS = (_HOST_MAT, P(None, None), _REP)
+
+
+@functools.lru_cache(maxsize=None)
+def _first_fit_sharded_fn(mesh, mode, strict, has_live, has_risk):
+    fn = _first_fit_sharded_body(mode, strict, has_live, has_risk)
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(_HOST_MAT, P(None, None), _REP)
-        + _opt_specs(has_live, has_risk),
+        in_specs=_FF_SPECS + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _first_fit_sharded_batched_fn(mesh, mode, strict, has_live, has_risk):
+    fn = _first_fit_sharded_body(mode, strict, has_live, has_risk)
+    specs = _FF_SPECS + _opt_specs(has_live, has_risk)
+    return jax.jit(_shard_map(
+        jax.vmap(fn), mesh=mesh,
+        in_specs=tuple(_g_spec(s) for s in specs),
+        out_specs=(_g_spec(_REP), _g_spec(_HOST_MAT)),
         check_rep=False,
     ))
 
@@ -913,8 +1040,25 @@ def first_fit_kernel_sharded(mesh, avail, demands, valid, strict=False,
     )(*args)
 
 
-@functools.lru_cache(maxsize=None)
-def _best_fit_sharded_fn(mesh, mode, has_live, has_risk):
+def first_fit_kernel_sharded_batched(mesh, avail, demands, valid,
+                                     strict=False, totals=None,
+                                     phase2="auto", live=None, risk=None):
+    """[G]-batched :func:`first_fit_kernel_sharded` (2-D replica × host
+    program; ``totals`` accepted and ignored like the 1-D twin)."""
+    mode = _sharded_mode(phase2)
+    _check_host_axis(avail.shape[1], mesh)
+    _check_g_axis(mesh, avail.shape[0])
+    if demands.shape[1] == 0:
+        return jnp.zeros(demands.shape[:2], jnp.int32), avail
+    args = (avail, demands, valid) + _opt_args(live, risk)
+    return _first_fit_sharded_batched_fn(
+        mesh, mode, bool(strict), live is not None, risk is not None
+    )(*args)
+
+
+def _best_fit_sharded_body(mode, has_live, has_risk):
+    """Per-shard best-fit body shared by the 1-D and batched factories."""
+
     def fn(avail, demands, valid, *rest):
         live, risk = _opt_unpack(rest, has_live, has_risk)
         avail, restore = _apply_live(avail, live)
@@ -930,11 +1074,28 @@ def _best_fit_sharded_fn(mesh, mode, has_live, has_risk):
             )
         return p, restore(a)
 
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _best_fit_sharded_fn(mesh, mode, has_live, has_risk):
+    fn = _best_fit_sharded_body(mode, has_live, has_risk)
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(_HOST_MAT, P(None, None), _REP)
-        + _opt_specs(has_live, has_risk),
+        in_specs=_FF_SPECS + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _best_fit_sharded_batched_fn(mesh, mode, has_live, has_risk):
+    fn = _best_fit_sharded_body(mode, has_live, has_risk)
+    specs = _FF_SPECS + _opt_specs(has_live, has_risk)
+    return jax.jit(_shard_map(
+        jax.vmap(fn), mesh=mesh,
+        in_specs=tuple(_g_spec(s) for s in specs),
+        out_specs=(_g_spec(_REP), _g_spec(_HOST_MAT)),
         check_rep=False,
     ))
 
@@ -954,9 +1115,26 @@ def best_fit_kernel_sharded(mesh, avail, demands, valid, totals=None,
     )(*args)
 
 
-@functools.lru_cache(maxsize=None)
-def _cost_aware_sharded_fn(mesh, mode, bin_pack, sort_hosts, host_decay,
-                           has_live, has_risk):
+def best_fit_kernel_sharded_batched(mesh, avail, demands, valid,
+                                    totals=None, phase2="auto", live=None,
+                                    risk=None):
+    """[G]-batched :func:`best_fit_kernel_sharded` (2-D replica × host
+    program; ``totals`` accepted and ignored like the 1-D twin)."""
+    mode = _sharded_mode(phase2)
+    _check_host_axis(avail.shape[1], mesh)
+    _check_g_axis(mesh, avail.shape[0])
+    if demands.shape[1] == 0:
+        return jnp.zeros(demands.shape[:2], jnp.int32), avail
+    args = (avail, demands, valid) + _opt_args(live, risk)
+    return _best_fit_sharded_batched_fn(
+        mesh, mode, live is not None, risk is not None
+    )(*args)
+
+
+def _cost_aware_sharded_body(mode, bin_pack, sort_hosts, host_decay,
+                             has_live, has_risk):
+    """Per-shard cost-aware body shared by the 1-D and batched factories."""
+
     def fn(avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
            host_zone, base_task_counts, *rest):
         live, risk = _opt_unpack(rest, has_live, has_risk)
@@ -977,13 +1155,40 @@ def _cost_aware_sharded_fn(mesh, mode, bin_pack, sort_hosts, host_decay,
             )
         return p, restore(a)
 
+    return fn
+
+
+_CA_SPECS = (
+    _HOST_MAT, P(None, None), _REP, _REP, _REP,
+    P(None, None), P(None, None), _HOST_VEC, _HOST_VEC,
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _cost_aware_sharded_fn(mesh, mode, bin_pack, sort_hosts, host_decay,
+                           has_live, has_risk):
+    fn = _cost_aware_sharded_body(
+        mode, bin_pack, sort_hosts, host_decay, has_live, has_risk
+    )
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(
-            _HOST_MAT, P(None, None), _REP, _REP, _REP,
-            P(None, None), P(None, None), _HOST_VEC, _HOST_VEC,
-        ) + _opt_specs(has_live, has_risk),
+        in_specs=_CA_SPECS + _opt_specs(has_live, has_risk),
         out_specs=(_REP, _HOST_MAT),
+        check_rep=False,
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _cost_aware_sharded_batched_fn(mesh, mode, bin_pack, sort_hosts,
+                                   host_decay, has_live, has_risk):
+    fn = _cost_aware_sharded_body(
+        mode, bin_pack, sort_hosts, host_decay, has_live, has_risk
+    )
+    specs = _CA_SPECS + _opt_specs(has_live, has_risk)
+    return jax.jit(_shard_map(
+        jax.vmap(fn), mesh=mesh,
+        in_specs=tuple(_g_spec(s) for s in specs),
+        out_specs=(_g_spec(_REP), _g_spec(_HOST_MAT)),
         check_rep=False,
     ))
 
@@ -1029,6 +1234,48 @@ def cost_aware_kernel_sharded(
     args = (avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
             host_zone, base_task_counts) + _opt_args(live, risk)
     return _cost_aware_sharded_fn(
+        mesh, mode, bin_pack, bool(sort_hosts), bool(host_decay),
+        live is not None, risk is not None,
+    )(*args)
+
+
+def cost_aware_kernel_sharded_batched(
+    mesh,
+    avail,
+    demands,
+    valid,
+    new_group,
+    anchor_zone,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    base_task_counts,
+    bin_pack: str = "first-fit",
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    rt_bw_rows=None,
+    rt_bw_idx=None,
+    totals=None,
+    phase2="auto",
+    live=None,
+    risk=None,
+):
+    """[G]-batched :func:`cost_aware_kernel_sharded` (2-D replica × host
+    program; same realtime-bw exclusion, same ignored ``totals``)."""
+    mode = _sharded_mode(phase2)
+    if rt_bw_rows is not None or rt_bw_idx is not None:
+        raise ValueError(
+            "realtime_bw has no sharded form — the per-tick sampled "
+            "[G, H] rows would reshard every dispatch; use the "
+            "single-device kernel for realtime scoring"
+        )
+    _check_host_axis(avail.shape[1], mesh)
+    _check_g_axis(mesh, avail.shape[0])
+    if demands.shape[1] == 0:
+        return jnp.zeros(demands.shape[:2], jnp.int32), avail
+    args = (avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
+            host_zone, base_task_counts) + _opt_args(live, risk)
+    return _cost_aware_sharded_batched_fn(
         mesh, mode, bin_pack, bool(sort_hosts), bool(host_decay),
         live is not None, risk is not None,
     )(*args)
@@ -1182,9 +1429,38 @@ def _sharded_span_body(
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
-                     sort_tasks, sort_hosts, host_decay):
+_SPAN_IN_SPECS = (
+    _HOST_MAT,        # avail
+    P(None, None),    # demands
+    _REP,             # arrive
+    P(),              # n_ticks_dyn
+    P(None, None),    # uniforms (or None)
+    _REP,             # sort_norm (or None)
+    _REP,             # anchor_zone (or None)
+    _REP,             # bucket_id (or None)
+    P(None, None),    # cost_zz (or None)
+    P(None, None),    # bw_zz (or None)
+    _HOST_VEC,        # host_zone (or None)
+    _HOST_VEC,        # base_task_counts (or None)
+    _HOST_VEC,        # live (or None)
+    P(None, HOST_AXIS),   # risk_rows [K, H] (or None)
+    P(None, None, None),  # cost_stack [P, Z, Z] (or None)
+    _REP,                 # cost_seg [K] (or None)
+)
+
+_SPAN_OUT_SPECS = SpanResult(
+    placements=P(None, None),
+    n_ready=_REP,
+    n_placed=_REP,
+    ticks_run=P(),
+    n_stack_final=P(),
+    stackpos=_REP,
+    avail=_HOST_MAT,
+)
+
+
+def _span_fn_body(mesh, policy, n_ticks, strict, decreasing, bin_pack,
+                  sort_tasks, sort_hosts, host_decay):
     n = host_axis_size(mesh)
 
     def fn(avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
@@ -1200,35 +1476,18 @@ def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
             host_decay=host_decay,
         )
 
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
+                     sort_tasks, sort_hosts, host_decay):
+    fn = _span_fn_body(mesh, policy, n_ticks, strict, decreasing,
+                       bin_pack, sort_tasks, sort_hosts, host_decay)
     return jax.jit(_shard_map(
         fn, mesh=mesh,
-        in_specs=(
-            _HOST_MAT,        # avail
-            P(None, None),    # demands
-            _REP,             # arrive
-            P(),              # n_ticks_dyn
-            P(None, None),    # uniforms (or None)
-            _REP,             # sort_norm (or None)
-            _REP,             # anchor_zone (or None)
-            _REP,             # bucket_id (or None)
-            P(None, None),    # cost_zz (or None)
-            P(None, None),    # bw_zz (or None)
-            _HOST_VEC,        # host_zone (or None)
-            _HOST_VEC,        # base_task_counts (or None)
-            _HOST_VEC,        # live (or None)
-            P(None, HOST_AXIS),   # risk_rows [K, H] (or None)
-            P(None, None, None),  # cost_stack [P, Z, Z] (or None)
-            _REP,                 # cost_seg [K] (or None)
-        ),
-        out_specs=SpanResult(
-            placements=P(None, None),
-            n_ready=_REP,
-            n_placed=_REP,
-            ticks_run=P(),
-            n_stack_final=P(),
-            stackpos=_REP,
-            avail=_HOST_MAT,
-        ),
+        in_specs=_SPAN_IN_SPECS,
+        out_specs=_SPAN_OUT_SPECS,
         check_rep=False,
         # DELIBERATELY NOT donated — the sharded twin of the tickloop
         # span carry's negative manifest entry (pivot_tpu/analysis/
@@ -1237,6 +1496,28 @@ def _sharded_span_fn(mesh, policy, n_ticks, strict, decreasing, bin_pack,
         # for large aligned arrays, so a donated carry would scribble
         # on caller-owned memory.  The donation pass enforces the
         # decision both ways.
+    ))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_span_batched_fn(mesh, policy, n_ticks, strict, decreasing,
+                             bin_pack, sort_tasks, sort_hosts, host_decay):
+    fn = _span_fn_body(mesh, policy, n_ticks, strict, decreasing,
+                       bin_pack, sort_tasks, sort_hosts, host_decay)
+    return jax.jit(_shard_map(
+        # The same per-shard span body under vmap: each [G] row is one
+        # run's whole span, rows go inert independently (the body's
+        # ``alive`` gating — the same property the plain vmapped driver
+        # relies on), and the host-axis collectives batch per row.
+        jax.vmap(fn), mesh=mesh,
+        in_specs=tuple(
+            _g_spec(s) for s in _SPAN_IN_SPECS
+        ),
+        out_specs=SpanResult(
+            *(_g_spec(s) for s in _SPAN_OUT_SPECS)
+        ),
+        check_rep=False,
+        # NOT donated — same zero-copy hazard as the 1-D twin above.
     ))
 
 
@@ -1289,3 +1570,124 @@ def sharded_fused_tick_run(
         anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
         base_task_counts, live, risk_rows, cost_stack, cost_seg,
     )
+
+
+def sharded_batched_tick_run(
+    mesh,
+    avail,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    *,
+    policy: str,
+    n_ticks: int,
+    uniforms=None,
+    sort_norm=None,
+    anchor_zone=None,
+    bucket_id=None,
+    cost_zz=None,
+    bw_zz=None,
+    host_zone=None,
+    base_task_counts=None,
+    totals=None,
+    live=None,
+    risk_rows=None,
+    cost_stack=None,
+    cost_seg=None,
+    strict: bool = False,
+    decreasing: bool = False,
+    bin_pack: str = "first-fit",
+    sort_tasks: bool = False,
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    phase2="auto",
+) -> SpanResult:
+    """[G]-batched :func:`sharded_fused_tick_run`: G coalesced fused
+    spans on the 2-D ``replica × host`` mesh — G×K simulator ticks as
+    ONE device program, the [G, H/S, 4] availability carries
+    shard-resident between ticks.  Every operand carries a leading [G]
+    run axis sharded over ``replica``; the host axis keeps the 1-D
+    twin's layout (stacked ``risk_rows`` [G, K, H] shard as
+    ``P("replica", None, "host")``).  Each row is bit-identical to the
+    1-D sharded driver — the same per-shard body under vmap, with the
+    same per-row inertness the plain vmapped driver relies on."""
+    _resolve_phase2(phase2)
+    _check_host_axis(avail.shape[1], mesh)
+    _check_g_axis(mesh, avail.shape[0])
+    return _sharded_span_batched_fn(
+        mesh, policy, n_ticks, bool(strict), bool(decreasing), bin_pack,
+        bool(sort_tasks), bool(sort_hosts), bool(host_decay),
+    )(
+        avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
+        anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
+        base_task_counts, live, risk_rows, cost_stack, cost_seg,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The batcher's 2-D entry points (``sched/batch.py``)
+# ---------------------------------------------------------------------------
+
+#: Single-device public kernel → its 1-D host-sharded twin.  The batcher
+#: serves an uncoalesced (G=1) flush on a 2-D mesh through the twin so a
+#: lone dispatch still runs host-sharded.
+_SHARDED_TWINS = {
+    opportunistic_kernel: opportunistic_kernel_sharded,
+    first_fit_kernel: first_fit_kernel_sharded,
+    best_fit_kernel: best_fit_kernel_sharded,
+    cost_aware_kernel: cost_aware_kernel_sharded,
+    fused_tick_run: sharded_fused_tick_run,
+}
+
+#: Single-device public kernel → its [G]-batched 2-D form.  What the
+#: batcher's coalesced flushes resolve to when its mesh is 2-D.
+_BATCHED_TWINS = {
+    opportunistic_kernel: opportunistic_kernel_sharded_batched,
+    first_fit_kernel: first_fit_kernel_sharded_batched,
+    best_fit_kernel: best_fit_kernel_sharded_batched,
+    cost_aware_kernel: cost_aware_kernel_sharded_batched,
+    fused_tick_run: sharded_batched_tick_run,
+}
+
+
+#: Array-kwarg names that disqualify a dispatch from the sharded forms:
+#: the realtime-bandwidth rows are per-tick host state the mesh cannot
+#: hold (both sharded cost-aware forms raise on them), so a request
+#: carrying them must stay on the single-device program.
+UNSHARDABLE_KW = frozenset({"rt_bw_rows", "rt_bw_idx"})
+
+
+def sharded_twin_of(kernel, arr_kw_keys=()):
+    """The 1-D host-sharded twin of a single-device public kernel, or
+    None when the family has no sharded form (e.g. the Pallas pair) or
+    the request carries operands the sharded forms reject
+    (:data:`UNSHARDABLE_KW` — the realtime-bw rows)."""
+    if UNSHARDABLE_KW & set(arr_kw_keys):
+        return None
+    return _SHARDED_TWINS.get(kernel)
+
+
+def batched_sharded_call(mesh, kernel, static_kw, n_args, kw_keys):
+    """Resolve a coalesced batch of ``kernel`` dispatches to its 2-D
+    ``replica × host`` program, or None when ``kernel`` has no batched
+    sharded form (the batcher then falls back to the plain vmap
+    program, bit-identically).
+
+    The returned callable takes the batcher's flat positional leaves —
+    stacked positional args first, stacked array-kwargs in ``kw_keys``
+    order after — exactly like the ``jit(vmap(...))`` program it
+    replaces, so ``batch_execute`` needs no 2-D special-casing at the
+    call site."""
+    batched = _BATCHED_TWINS.get(kernel)
+    if batched is None:
+        return None
+
+    def call(*cols):
+        return batched(
+            mesh,
+            *cols[:n_args],
+            **dict(zip(kw_keys, cols[n_args:])),
+            **static_kw,
+        )
+
+    return call

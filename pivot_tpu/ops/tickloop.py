@@ -49,6 +49,14 @@ skipped ticks' meters exactly.
 Host-sync discipline: no ``block_until_ready`` / host fetch / ``.item()``
 may appear inside the loop body — enforced statically by
 ``tools/hotpath_lint.py`` (tier-1 wired).
+
+Backend forms (the parity manifest's span family): this driver, the
+sequential :func:`reference_tick_run` referee, the host-sharded twin
+(``ops/shard.py::sharded_fused_tick_run``), and — round 17 — the
+``[G]``-batched 2-D form (``sharded_batched_tick_run``), which serves G
+coalesced spans on a ``replica × host`` mesh; the cross-run batcher
+resolves :func:`fused_tick_run` requests to it when its mesh carries a
+host axis (``sched/batch.py``).
 """
 
 from __future__ import annotations

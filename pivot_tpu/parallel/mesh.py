@@ -105,6 +105,14 @@ def build_hybrid_mesh(
 
     The reference's multi-machine story is "run more OS processes"
     (``alibaba/sim.py:187-195``); this is its collective-aware equivalent.
+
+    Round 17 made this the canonical 2-D *serving* mesh: with
+    ``host_parallel=S`` on one process it is the ``replica × host``
+    layout the composed batching × sharding programs partition
+    (``ops/shard.py`` ``*_kernel_sharded_batched`` /
+    ``sharded_batched_tick_run``) — handed to
+    ``DispatchBatcher(mesh=...)`` / ``ServeDriver(mesh=...)`` and to
+    ``policy.enable_sharding`` (the serve CLI's ``--shard-hosts``).
     """
     from jax.experimental import mesh_utils
 

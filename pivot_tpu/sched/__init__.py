@@ -428,6 +428,15 @@ class GlobalScheduler(LogMixin):
         #: commits precomputed ticks only while this stays unchanged; any
         #: bump aborts the remaining span (the committed prefix is exact).
         self._span_epoch = 0
+        #: Serving's SLO-checkpoint span bound (round 17,
+        #: ``fuse_spans="slo"``): an optional zero-arg callable returning
+        #: a sim-time horizon spans must not cross.  The serve driver
+        #: points it at its release frontier, so a fused span never
+        #: speculates past the last revealed arrival — each span ends at
+        #: an admission checkpoint where the SLO meter records exactly
+        #: one decision latency (``serve/session.py``).  ``None`` (the
+        #: batch default) leaves span extraction unchanged.
+        self.span_horizon = None
         self._ff_evt = None  # pending fast-forward wake (early-wakeable)
         self._ff_cb: Optional[Callback] = None
         self._ff_anchor = 0.0  # tick-grid anchor of the pending wake
@@ -793,6 +802,10 @@ class GlobalScheduler(LogMixin):
         if not allowed:
             return None
         t_bound = min(t_foreign, self._quarantine_bound(now))
+        if self.span_horizon is not None:
+            # Serving's admission-window bound (``fuse_spans="slo"``):
+            # never speculate past the stream's revealed frontier.
+            t_bound = min(t_bound, self.span_horizon())
         cap = int(getattr(policy, "span_cap", 32))
         # Exact grid: iterated float adds, the same op sequence the
         # sequential timeout chain performs — anchor + k*interval can

@@ -116,7 +116,7 @@ def group_bucket(g: int) -> int:
 
 @functools.lru_cache(maxsize=256)
 def _batched_fn(kernel, static_items: tuple, n_args: int, kw_keys: tuple,
-                mesh=None):
+                mesh=None, host_ok: bool = False):
     """jit(vmap(kernel)) closed over the static config — cached per
     (kernel, static kwargs, array-kwarg names, mesh); jit's own cache
     keys the shapes, so this is one entry per kernel configuration, one
@@ -126,14 +126,32 @@ def _batched_fn(kernel, static_items: tuple, n_args: int, kw_keys: tuple,
     more per dispatch, and per-dispatch overhead is this module's whole
     subject.
 
-    With ``mesh``, every stacked operand's leading [G] axis is sharded
-    over the mesh's ``replica`` axis (``in_shardings``), so XLA
-    partitions the vmapped program row-wise: co-pending runs execute on
-    DISTINCT devices instead of queueing on one.  Rows never
+    With a replica-only ``mesh``, every stacked operand's leading [G]
+    axis is sharded over the mesh's ``replica`` axis (``in_shardings``),
+    so XLA partitions the vmapped program row-wise: co-pending runs
+    execute on DISTINCT devices instead of queueing on one.  Rows never
     communicate (the kernels are per-row pure), so partitioning cannot
     change a row's op sequence — bit-identical outputs, asserted by
-    ``tests/test_shard.py``."""
+    ``tests/test_shard.py``.
+
+    With a 2-D ``replica × host`` mesh (round 17 — batching × sharding
+    composed), kernels with a registered sharded family resolve to the
+    ``shard_map(vmap(per-shard body))`` program instead
+    (``ops.shard.batched_sharded_call``): the [G] run axis shards over
+    ``replica`` AND each row's host axis shards over ``host`` — one
+    dispatch, G runs × S host shards.  Unregistered kernels keep the
+    plain vmap program (bit-identical either way)."""
     static_kw = dict(static_items)
+    if mesh is not None and host_ok:
+        # ``host_ok`` is the caller's shape check: the kernel has a
+        # registered sharded family AND the stacked host axis divides
+        # the mesh's host shards (batch_execute computes it — shapes
+        # aren't visible here).
+        from pivot_tpu.ops.shard import batched_sharded_call
+
+        fn = batched_sharded_call(mesh, kernel, static_kw, n_args, kw_keys)
+        if fn is not None:
+            return fn
 
     def call(*cols):
         return kernel(
@@ -153,10 +171,50 @@ def _batched_fn(kernel, static_items: tuple, n_args: int, kw_keys: tuple,
 def _replica_mesh_for(mesh, gb: int):
     """The mesh to shard a ``gb``-row batch over, or None: the replica
     axis must divide the padded group bucket (contiguous row blocks per
-    device), and a 1-row batch has nothing to spread."""
+    device), and a 1-row batch has nothing to spread.  A None return on
+    a real mesh is a *fallback to the single-device program* — silent
+    here (bit-identical by contract), but metered by the batcher
+    (``mesh_fallbacks``) so a 2-D deployment can't quietly degrade to
+    single-device dispatches.  (On a 2-D mesh, :func:`_plan_mesh` pads
+    shardable groups up to the replica axis FIRST, so this fallback is
+    the replica-only mesh's and unshardable kernels' path.)"""
     if mesh is None or gb <= 1:
         return None
     return mesh if gb % int(mesh.shape["replica"]) == 0 else None
+
+
+def _plan_mesh(mesh, kernel, g: int, args0: tuple, arr_kw_keys=()):
+    """One coalesced group's (padded bucket, mesh, 2-D eligibility) —
+    the ONE routing decision ``batch_execute`` executes and the
+    batcher's stats mirror, so the meter can never disagree with the
+    program.
+
+    On a 2-D ``replica × host`` mesh, a group of a kernel with a
+    registered sharded family whose host axis divides the host shards
+    gets its ``[G]`` bucket rounded UP to a multiple of the replica
+    axis: padding a 2-row group to 4 costs redundant pad rows (their
+    outputs are discarded) but keeps the flush on the mesh — without
+    it, every small coalesced group (the common serving case) would
+    silently run single-device, which is exactly what the
+    ``mesh_fallbacks`` meter exists to catch."""
+    gb = group_bucket(g)
+    host_ok = False
+    if mesh is not None and g > 1:
+        from pivot_tpu.ops.shard import mesh_is_2d, sharded_twin_of
+        from pivot_tpu.parallel.mesh import host_axis_size
+
+        if (
+            mesh_is_2d(mesh)
+            and sharded_twin_of(kernel, arr_kw_keys) is not None
+            and args0 and hasattr(args0[0], "shape")
+            and args0[0].shape[0] % host_axis_size(mesh) == 0
+        ):
+            r = int(mesh.shape["replica"])
+            gb = ((gb + r - 1) // r) * r
+            host_ok = True
+    fn_mesh = _replica_mesh_for(mesh, gb)
+    host_ok = host_ok and fn_mesh is not None
+    return gb, fn_mesh, host_ok
 
 
 def _to_host(tree):
@@ -204,8 +262,30 @@ def batch_execute(
         return []
     if g == 1:
         args, arr_kw = requests[0]
+        if mesh is not None:
+            from pivot_tpu.ops.shard import mesh_is_2d, sharded_twin_of
+            from pivot_tpu.parallel.mesh import host_axis_size
+
+            twin = (
+                sharded_twin_of(kernel, arr_kw) if mesh_is_2d(mesh)
+                else None
+            )
+            if (
+                twin is not None
+                and args and hasattr(args[0], "shape")
+                and args[0].shape[0] % host_axis_size(mesh) == 0
+            ):
+                # A lone dispatch on a 2-D mesh still runs HOST-sharded
+                # through the family's 1-D twin (replica columns compute
+                # replicas of the same program) — on a pod-scale cluster
+                # the unsharded single-device program is exactly what
+                # sharding exists to avoid.  Bit-identical by the twin
+                # parity contract.
+                return [_to_host(twin(mesh, *args, **arr_kw, **static_kw))]
         return [_to_host(kernel(*args, **arr_kw, **static_kw))]
-    gb = group_bucket(g)
+    gb, fn_mesh, host_ok = _plan_mesh(
+        mesh, kernel, g, requests[0][0], requests[0][1]
+    )
 
     def stack(col):
         arrs = [np.asarray(a) for a in col]
@@ -224,7 +304,7 @@ def batch_execute(
     kw_cols = tuple(stack([r[1][k] for r in requests]) for k in kw_keys)
     fn = _batched_fn(
         kernel, tuple(sorted(static_kw.items())), len(args_cols), kw_keys,
-        _replica_mesh_for(mesh, gb),
+        fn_mesh, host_ok,
     )
     out = _to_host(fn(*args_cols, *kw_cols))
     return [
@@ -271,6 +351,13 @@ class BatchClient:
         self._closed = False
         self._idle = False
 
+    @property
+    def mesh(self):
+        """The owning batcher's mesh (None, replica-only, or 2-D) —
+        what ``sched.tpu`` validates host-sharding compatibility
+        against when composing batching with sharding."""
+        return self._batcher._mesh
+
     def dispatch(self, kernel, args, arr_kw=None, static_kw=None):
         if self._closed:
             # An abandoned (stall-supervised) session thread waking up
@@ -295,9 +382,13 @@ class BatchClient:
                 batcher.stats["device_calls"] += 1
                 batcher.stats["single_fast_path"] += 1
         if solo:
+            # The batcher's mesh rides along so a lone slot on a 2-D
+            # mesh still dispatches host-sharded (batch_execute's g=1
+            # twin path); on a replica-only mesh g=1 has nothing to
+            # spread and runs the plain program as before.
             return batch_execute(
                 kernel, [(tuple(args), dict(arr_kw or {}))],
-                dict(static_kw or {}),
+                dict(static_kw or {}), mesh=batcher._mesh,
             )[0]
         req = _Request(
             self.slot, kernel, tuple(args), dict(arr_kw or {}),
@@ -365,7 +456,15 @@ class DispatchBatcher:
     thread because theirs was the only live slot — no queue hand-off,
     no coordinator hop), ``mesh_dispatches`` (device calls whose [G]
     axis sharded over the replica mesh — multi-chip coalesced
-    flushes), and the pool-resize pair ``respawns`` (slots
+    flushes), ``mesh_fallbacks`` (coalesced flushes that DROPPED the
+    mesh because the padded group bucket does not divide the replica
+    axis — served by the single-device vmap program instead,
+    bit-identically, but a deployment seeing this climb is quietly
+    degrading; the first occurrence is also logged.  On a 2-D mesh,
+    shardable groups have their bucket padded UP to the replica axis
+    (``_plan_mesh``), so this counts only replica-only meshes and
+    kernels without a sharded family), and the
+    pool-resize pair ``respawns`` (slots
     opened beyond the construction-time count: supervisor restarts and
     autoscaler growth) / ``retired_slots`` (slots closed for good:
     finished runs, drained-and-retired or crashed sessions).  At any
@@ -421,7 +520,12 @@ class DispatchBatcher:
             #: Device calls whose [G] axis actually sharded over the
             #: replica mesh (mesh set AND the bucket divided the axis).
             "mesh_dispatches": 0,
+            #: Coalesced flushes that dropped the mesh (bucket did not
+            #: divide the replica axis) — single-device fallbacks a 2-D
+            #: deployment must watch (docstring above; logged once).
+            "mesh_fallbacks": 0,
         }
+        self._mesh_fallback_logged = False
         #: Pool-resize accounting (serving autoscaler + supervisor):
         #: slots opened beyond the construction-time count and slots
         #: retired (closed for good — drained sessions, crashed runs).
@@ -560,6 +664,13 @@ class DispatchBatcher:
                 # by graftcheck's thread-guard pass — unlocked "+=" here
                 # could lose an increment against a concurrent solo
                 # dispatch after a respawn reopens the pool).
+                log_fallback = False
+                # The SAME routing decision batch_execute will make for
+                # this group — stats and program cannot disagree.
+                _gb, grp_mesh, _ok = _plan_mesh(
+                    self._mesh, reqs[0].kernel, len(reqs), reqs[0].args,
+                    reqs[0].arr_kw,
+                )
                 with self._cond:
                     self.stats["dispatches"] += len(reqs)
                     self.stats["device_calls"] += 1
@@ -568,10 +679,29 @@ class DispatchBatcher:
                     )
                     if len(reqs) > 1:
                         self.stats["coalesced"] += len(reqs)
-                    if _replica_mesh_for(
-                        self._mesh, group_bucket(len(reqs))
-                    ) is not None:
+                    if grp_mesh is not None:
                         self.stats["mesh_dispatches"] += 1
+                    elif self._mesh is not None and len(reqs) > 1:
+                        # The coalesced group LOST its mesh: the padded
+                        # bucket does not divide the replica axis, so
+                        # this flush runs the single-device program.
+                        # Metered + logged once so a 2-D deployment
+                        # can't quietly degrade (ISSUE-17 satellite).
+                        self.stats["mesh_fallbacks"] += 1
+                        if not self._mesh_fallback_logged:
+                            self._mesh_fallback_logged = True
+                            log_fallback = True
+                if log_fallback:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "DispatchBatcher: %d-request flush (bucket %d) "
+                        "does not divide the mesh's replica axis (%d) — "
+                        "serving on a single device; further fallbacks "
+                        "counted in stats['mesh_fallbacks']",
+                        len(reqs), _gb,
+                        int(self._mesh.shape["replica"]),
+                    )
                 try:
                     with self.tracer.wall_span(
                         "dispatch", "flush", group=len(reqs),

@@ -65,6 +65,8 @@ from pivot_tpu.ops.kernels import (
     opportunistic_kernel,
 )
 from pivot_tpu.ops.shard import (
+    HOST_AXIS,
+    REPLICA_AXIS,
     best_fit_kernel_sharded,
     cost_aware_kernel_sharded,
     first_fit_kernel_sharded,
@@ -293,6 +295,13 @@ class _DevicePolicyBase(Policy):
         the other grid runs' co-pending ticks into one vmapped dispatch
         (bit-identical placements — see ``sched/batch.py``).
 
+        Composes with host sharding (round 17): when this policy also
+        has :meth:`enable_sharding` on, the batcher must carry a 2-D
+        ``replica × host`` mesh whose host axis matches this policy's —
+        coalesced flushes then run the ``shard_map(vmap(...))`` 2-D
+        program (``ops/shard.py``), G runs × S host shards in one
+        dispatch.
+
         Requires deterministic routing: the adaptive twin routes on
         measured latencies, which would make batch membership — and on
         the f32 TPU backend, placements — timing-dependent.
@@ -303,15 +312,33 @@ class _DevicePolicyBase(Policy):
                 "construct the policy with adaptive=False"
             )
         if self._mesh is not None:
-            raise ValueError(
-                "cross-run batching and host sharding are mutually "
-                "exclusive on one policy: the batcher's program is "
-                "vmap(kernel) over the run axis, which would need a "
-                "replica x host 2-D partitioning of every dispatch — "
-                "shard the batcher's [G] axis over the mesh's replica "
-                "axis instead (DispatchBatcher(mesh=...), sched/batch.py)"
-            )
+            self._check_batch_mesh(client)
         self._batch_client = client
+
+    def _check_batch_mesh(self, client) -> None:
+        """Composing batching × sharding needs the batcher's 2-D mesh to
+        agree with this policy's host mesh: same host-axis size, so the
+        coalesced 2-D program and the direct 1-D sharded dispatches
+        partition the SAME [H] layout (contiguous blocks per shard)."""
+        bmesh = getattr(client, "mesh", None)
+        n = host_axis_size(self._mesh)
+        if (
+            bmesh is None
+            or HOST_AXIS not in bmesh.shape
+            # No replica axis ⇒ nothing to stack the [G] run axis over:
+            # the coalesced 2-D program (and _replica_mesh_for) key on
+            # it, so a host-only batcher mesh would fail at flush time.
+            or REPLICA_AXIS not in bmesh.shape
+            or host_axis_size(bmesh) != n
+        ):
+            raise ValueError(
+                "composing host sharding with cross-run batching needs "
+                "a DispatchBatcher built on a 2-D replica x host mesh "
+                f"whose host axis matches enable_sharding's ({n} "
+                "shards) — build one with parallel.mesh."
+                "build_hybrid_mesh(host_parallel=...) and pass it as "
+                "DispatchBatcher(mesh=...)"
+            )
 
     # -- pod-scale host sharding (round 10, ``ops/shard.py``) --------------
     def enable_sharding(self, mesh) -> None:
@@ -323,20 +350,18 @@ class _DevicePolicyBase(Policy):
         (``tests/test_shard.py``).  Fused spans ride the sharded span
         driver with the carry staying shard-resident between ticks.
 
+        Composes with cross-run batching (round 17) when the attached
+        batcher carries a matching 2-D ``replica × host`` mesh — see
+        :meth:`enable_batching`.
+
         Requires deterministic routing (no adaptive twin — its latency
         model prices a single-device program) and the scan-family
-        kernels (no Pallas, no realtime-bw rows); mutually exclusive
-        with cross-run batching (see :meth:`enable_batching`).
+        kernels (no Pallas, no realtime-bw rows).
         """
         if self.adaptive:
             raise ValueError(
                 "host sharding needs deterministic dispatch — construct "
                 "the policy with adaptive=False"
-            )
-        if self._batch_client is not None:
-            raise ValueError(
-                "host sharding and cross-run batching are mutually "
-                "exclusive — see enable_batching"
             )
         if getattr(self, "use_pallas", False):
             raise ValueError(
@@ -350,6 +375,13 @@ class _DevicePolicyBase(Policy):
             )
         if host_axis_size(mesh) < 1:
             raise ValueError("mesh has an empty host axis")
+        if self._batch_client is not None:
+            prev, self._mesh = self._mesh, mesh
+            try:
+                self._check_batch_mesh(self._batch_client)
+            except ValueError:
+                self._mesh = prev
+                raise
         if self.topology is not None:
             self._check_mesh_hosts(mesh)
         self._mesh = mesh
@@ -366,11 +398,17 @@ class _DevicePolicyBase(Policy):
 
     def _kernel_for(self, kernel, sharded_kernel):
         """The dispatch rung for one placement call: the single-device
-        kernel (through the cross-run batcher when attached), or its
-        host-sharded twin when a mesh is enabled."""
-        if self._mesh is None:
+        kernel (through the cross-run batcher when attached), its
+        host-sharded twin when only a mesh is enabled, or — batching ×
+        sharding composed — the single-device kernel identity routed
+        through the batcher, whose 2-D mesh resolves coalesced flushes
+        to the ``shard_map(vmap(...))`` program and lone flushes to the
+        1-D sharded twin (``sched/batch.py``/``ops/shard.py``)."""
+        if self._batch_client is not None:
             return functools.partial(self._call_kernel, kernel)
-        return functools.partial(sharded_kernel, self._mesh)
+        if self._mesh is not None:
+            return functools.partial(sharded_kernel, self._mesh)
+        return functools.partial(self._call_kernel, kernel)
 
     # -- sampled dispatch profiling (round 15, ``obs/profiler.py``) --------
     def enable_profiler(self, profiler) -> None:
@@ -606,16 +644,18 @@ class _DevicePolicyBase(Policy):
             self._stage(arrive),
             np.int32(k_dyn),
         )
-        if self._mesh is not None:
+        if self._mesh is not None and self._batch_client is None:
             # Host-sharded span driver: the [H/S, 4] carry stays
             # shard-resident between ticks; bit-identical by the span
-            # parity suite.  Not routed through the batcher — sharding
-            # and cross-run batching are mutually exclusive (see
-            # enable_sharding).
+            # parity suite.
             res = sharded_fused_tick_run(
                 self._mesh, *span_args, n_ticks=K, **kw
             )
         else:
+            # Through the batcher when one is attached (co-pending
+            # spans of G runs coalesce) — on a 2-D mesh the batcher
+            # resolves the group to ``sharded_batched_tick_run`` and a
+            # lone span to the 1-D sharded driver (``sched/batch.py``).
             res = self._call_kernel(
                 fused_tick_run, *span_args, n_ticks=K, **kw
             )

@@ -68,14 +68,25 @@ __all__ = ["ServeDriver", "closed_loop_source"]
 _ROUTINGS = ("rr", "least_loaded")
 
 
+def _cluster_capacity(cluster) -> List[float]:
+    """Total (cpus, mem, disk, gpus) of a cluster — the DRF dominant-
+    share normalizer for tenant quotas (``serve/admission.py``)."""
+    caps = [0.0, 0.0, 0.0, 0.0]
+    for host in cluster.hosts:
+        r = host.resource
+        for i, dim in enumerate(("t_cpus", "t_mem", "t_disk", "t_gpus")):
+            caps[i] += float(getattr(r, dim, 0.0) or 0.0)
+    return caps
+
+
 class _Inflight:
     """Ledger entry for one admitted job — what preemption victims are
     chosen from and what completions settle against."""
 
     __slots__ = ("app", "ts", "tier", "tenant", "seq", "session",
-                 "requested", "preemptible")
+                 "requested", "preemptible", "dom")
 
-    def __init__(self, app, ts, tier, tenant, seq):
+    def __init__(self, app, ts, tier, tenant, seq, dom=1.0):
         self.app = app
         self.ts = ts
         self.tier = tier
@@ -84,6 +95,10 @@ class _Inflight:
         self.session: Optional[ServeSession] = None
         self.requested = False  # a preempt request is in flight
         self.preemptible = True  # False after a miss (it placed/ran)
+        #: Dominant share this admission charged against its tenant's
+        #: DRF occupancy (1.0 when the quota is off) — what release
+        #: gives back, surviving supervisor clones (the rec re-keys).
+        self.dom = dom
 
 
 class ServeDriver(LogMixin):
@@ -138,6 +153,8 @@ class ServeDriver(LogMixin):
         registry=None,
         clock: Optional[ObsClock] = None,
         profiler=None,
+        mesh=None,
+        tenant_quota: Optional[float] = None,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
@@ -184,11 +201,25 @@ class ServeDriver(LogMixin):
             # caller attached a dedicated tracer explicitly.
             profiler.tracer = self.tracer
         self.slo = slo or SloMeter(clock=self.clock)
+        #: DRF tenant fairness (round 17, ``serve/admission.py``): the
+        #: dominant-share reference capacity is the first session's
+        #: cluster totals — every session clones the same topology, and
+        #: fairness only needs a consistent normalizer.
+        capacity = None
+        if tenant_quota is not None:
+            capacity = _cluster_capacity(sessions[0].cluster)
         self.queue = AdmissionQueue(
             queue_depth, backpressure, self.slo,
             tier_reserve=tier_reserve, tier_policies=tier_policies,
+            tenant_quota=tenant_quota, capacity=capacity,
         )
         self.flush_after = flush_after
+        #: 2-D serving mesh (round 17): handed to the DispatchBatcher so
+        #: coalesced flushes shard [G] over ``replica`` — and, when the
+        #: session policies also have ``enable_sharding`` on, the host
+        #: axis over ``host`` (the composed 2-D program).  ``None``
+        #: keeps today's single-device vmap dispatch.
+        self.mesh = mesh
         self.routing = routing
         self.preempt = preempt
         self.preempt_timeout = preempt_timeout
@@ -244,12 +275,25 @@ class ServeDriver(LogMixin):
             # clock-unification contract).
             s.clock = self.clock
             s.meter.clock = self.clock
+            if getattr(s, "fuse_spans", False) == "slo":
+                # The SLO-checkpoint span bound: spans end at the
+                # stream's revealed frontier (serve/session.py).
+                s.scheduler.span_horizon = self.release_frontier
             if self.profiler is not None and hasattr(
                 s.policy, "enable_profiler"
             ):
                 s.policy.enable_profiler(self.profiler)
 
     # -- gate + coordination ----------------------------------------------
+    def release_frontier(self) -> float:
+        """The admission window's edge: the largest sim instant the
+        arrival stream has revealed (∞ once it drains).  What
+        ``fuse_spans="slo"`` sessions bound their fused spans at
+        (``GlobalScheduler.span_horizon``) — read under the cv so the
+        thread-guard discipline holds."""
+        with self._cv:
+            return self._released
+
     def wait_released(self, session: ServeSession, t: float,
                       client=None) -> bool:
         """Block ``session`` until the release frontier reaches sim time
@@ -308,7 +352,7 @@ class ServeDriver(LogMixin):
                 rec.tier if rec is not None
                 else int(getattr(app, "_serve_tier", 0))
             )
-            self.queue.release()
+            self._release_one(rec, app, tier)
             key = "failed_jobs" if failed else "completed"
             self.slo.count(key)
             self.slo.count_tier(tier, key)
@@ -319,6 +363,20 @@ class ServeDriver(LogMixin):
             self._cv.notify_all()
         for fn in self._completion_hooks:
             fn(session, app, sim_now)
+
+    def _release_one(self, rec: Optional[_Inflight], app, tier: int) -> None:
+        """Free one settled admission's capacity AND its tenant's DRF
+        occupancy (cv held).  The (tenant, dominant share) pair comes
+        from the ledger record when one survives, else from the app's
+        cached share — either way the exact values the admission
+        charged, so the occupancy ledger drains to zero
+        (``audit_serve``)."""
+        if rec is not None:
+            tenant, share = rec.tenant, rec.dom
+        else:
+            tenant = getattr(app, "_serve_tenant", "default")
+            share = getattr(app, "_serve_dom_share", None)
+        self.queue.release(tier=tier, tenant=tenant, share=share)
 
     def on_session_error(self, session: ServeSession, exc) -> None:
         if session.abandoned:
@@ -400,7 +458,7 @@ class ServeDriver(LogMixin):
                 else int(getattr(app, "_serve_tier", 0))
             )
             if app.is_finished or getattr(app, "failed", False):
-                self.queue.release()
+                self._release_one(rec, app, tier)
                 key = "completed" if app.is_finished else "failed_jobs"
                 self.slo.count(key)
                 self.slo.count_tier(tier, key)
@@ -518,6 +576,8 @@ class ServeDriver(LogMixin):
         new.scheduler.tracer = self.tracer
         new.clock = self.clock  # one wall epoch service-wide
         new.meter.clock = self.clock
+        if getattr(new, "fuse_spans", False) == "slo":
+            new.scheduler.span_horizon = self.release_frontier
         if self.profiler is not None and hasattr(
             new.policy, "enable_profiler"
         ):
@@ -730,7 +790,7 @@ class ServeDriver(LogMixin):
                 self._cv.notify_all()
                 return
             del self._inflight[app.id]
-            self.queue.release()
+            self._release_one(rec, app, rec.tier)
             self.slo.count("preempted")
             self.slo.count_tier(rec.tier, "preempted")
             if self.tracer.enabled:
@@ -772,15 +832,32 @@ class ServeDriver(LogMixin):
         important than it stay spilled — the head check suffices because
         the buffer is tier-ordered."""
         while self.queue.spilled:
-            arr = self.queue.peek_spill()
-            if (
-                self._waiting_tier is not None
-                and arr.tier > self._waiting_tier
-            ):
+            # Pick the first admissible entry in (tier, arrival) order.
+            # Room and the waiting-tier gate stop the scan (both are
+            # monotone in buffer order); a QUOTA-blocked entry is
+            # skipped instead — its tenant's occupancy blocking other
+            # tenants' admissible jobs behind it would waste idle
+            # capacity on fairness (the work-conserving contract;
+            # review finding, round 17).  Quota off ⇒ the head is
+            # always picked ⇒ bit-identical to the pre-quota loop.
+            picked = None
+            for i, arr in enumerate(self.queue.spilled):
+                if (
+                    self._waiting_tier is not None
+                    and arr.tier > self._waiting_tier
+                ):
+                    break
+                if not self.queue.has_room(arr.tier):
+                    # Capacity frees on completions, and every
+                    # completion re-runs this loop.
+                    break
+                if self.queue.over_quota(arr):
+                    continue
+                picked = i
                 break
-            if not self.queue.has_room(arr.tier):
+            if picked is None:
                 break
-            self.queue.pop_spill()
+            arr = self.queue.pop_spill(picked)
             floor_t = after_sim
             if floor_t is None and self._released != float("inf"):
                 floor_t = self._released
@@ -802,6 +879,7 @@ class ServeDriver(LogMixin):
         self._inflight[arrival.app.id] = _Inflight(
             arrival.app, arrival.ts, arrival.tier, arrival.tenant,
             self._admit_seq,
+            dom=getattr(arrival.app, "_serve_dom_share", 1.0),
         )
 
     def _route(self, arrival: JobArrival) -> None:
@@ -900,8 +978,10 @@ class ServeDriver(LogMixin):
                         # the low tiers rather than waiting them out.
                         if self._preempt_outstanding == 0:
                             self._try_preempt(tier)
-                    if self.queue.has_room(tier):
-                        self.queue.readmit(arrival)
+                    if self.queue.readmit(arrival):
+                        # readmit re-checks room AND the tenant quota
+                        # (a blocked over-quota arrival waits for its
+                        # tenant's occupancy to drain, not just depth).
                         status = ADMITTED
                         if self.tracer.enabled:
                             self._stage(arrival.app, "admitted",
@@ -979,6 +1059,40 @@ class ServeDriver(LogMixin):
             for s in pool:
                 s.shutdown()
 
+    def _batching_compatible(self) -> bool:
+        """(cv held) Whether the pool can share a DispatchBatcher: every policy
+        batchable (device-backed, deterministic routing), and — when
+        sharding is in play — the driver's mesh host axis agreeing with
+        every sharded policy's (the composed 2-D program partitions one
+        [H] layout).  A sharded pool WITHOUT a compatible driver mesh
+        runs free: 1-D host-sharded per-session dispatches, no
+        coalescing — the ``serve_sharded`` bench's 1-D-sharding arm."""
+        if not all(s.batchable for s in self.sessions):
+            return False
+        if self.mesh is not None:
+            # The batcher's flush machinery keys on both axes: a mesh
+            # missing either would crash the first coalesced flush.
+            from pivot_tpu.ops.shard import HOST_AXIS, REPLICA_AXIS
+
+            if (
+                HOST_AXIS not in self.mesh.shape
+                or REPLICA_AXIS not in self.mesh.shape
+            ):
+                return False
+        for s in self.sessions:
+            pmesh = getattr(s.policy, "_mesh", None)
+            if pmesh is None:
+                continue
+            if self.mesh is None:
+                return False
+            # Import inside the sharded branch only: pure-numpy serving
+            # must never import jax (parallel.mesh does at module scope).
+            from pivot_tpu.parallel.mesh import host_axis_size
+
+            if host_axis_size(self.mesh) != host_axis_size(pmesh):
+                return False
+        return True
+
     # -- lifecycle ---------------------------------------------------------
     def run(self, arrivals: Iterable[JobArrival],
             pace: Optional[float] = None) -> dict:
@@ -998,7 +1112,7 @@ class ServeDriver(LogMixin):
         started: List[threading.Thread] = []
         with self._cv:
             clients = [None] * len(self.sessions)
-            if all(s.batchable for s in self.sessions):
+            if self._batching_compatible():
                 # Initialize the backend once, here, before any session
                 # thread dispatches — concurrent first-touch PJRT client
                 # creation is not safe (same guard as run_grid_lockstep).
@@ -1009,6 +1123,7 @@ class ServeDriver(LogMixin):
 
                 self.batcher = DispatchBatcher(
                     len(self.sessions), flush_after=self.flush_after,
+                    mesh=self.mesh,
                     tracer=self.tracer, profiler=self.profiler,
                 )
                 clients = [self.batcher.client() for _ in self.sessions]
@@ -1123,6 +1238,13 @@ class ServeDriver(LogMixin):
             "flush_after_s": self.flush_after,
             "routing": self.routing,
             "preempt": self.preempt,
+            "tenant_quota": self.queue.tenant_quota,
+            # 2-D serving mesh (round 17): axis sizes when one is
+            # attached — how coalesced dispatches partitioned.
+            "mesh": (
+                {str(k): int(v) for k, v in self.mesh.shape.items()}
+                if self.mesh is not None else None
+            ),
             "tier_reserve": (
                 list(self.queue.tier_reserve)
                 if self.queue.tier_reserve else None

@@ -90,11 +90,30 @@ class ServeSession(LogMixin):
         retry=None,
         breaker=None,
         clock: Optional[ObsClock] = None,
+        fuse_spans=False,
     ):
+        if fuse_spans not in (False, "slo"):
+            raise ValueError(
+                'ServeSession fuse_spans must be False (per-tick '
+                'dispatch, the bit-parity default) or "slo" (fused '
+                "spans bounded by the driver's admission window, one "
+                "SLO latency sample per span) — unbounded True is a "
+                "batch-mode knob: an online scheduler may not "
+                "speculate past the stream's revealed frontier "
+                "without an SLO checkpoint"
+            )
         self.label = label
         self.policy = policy
         self.seed = seed
         self.interval = interval
+        #: Serve-span mode (round 17): ``False`` keeps per-tick
+        #: dispatch; ``"slo"`` lets the scheduler fuse spans between
+        #: SLO checkpoints — spans bounded by the serve driver's
+        #: release frontier (``GlobalScheduler.span_horizon``, wired by
+        #: the driver), the SLO meter recording ONE decision latency
+        #: per span with the span length in the snapshot.  Placements
+        #: are bit-identical either way (the span parity contract).
+        self.fuse_spans = fuse_spans
         #: One injected obs wall clock for everything this session
         #: meters (round 14): the run Meter and the fallback SLO meter
         #: share it, so their wall snapshots agree exactly.
@@ -146,19 +165,24 @@ class ServeSession(LogMixin):
             retry=retry,
             breaker=breaker,
             slo=self.slo,
-            # Serving keeps per-tick dispatch: the SLO meter counts one
-            # decision latency per dispatch and the ServeDriver's whole
-            # amortization story is coalescing co-pending per-tick calls
-            # ACROSS sessions (a fused span would collapse several ticks
-            # into one dispatch and skew both).  Span outputs are
-            # bit-identical either way — asserted by the serve-vs-batch
-            # parity test, whose batch arm runs with fusion on.
-            fuse_spans=False,
+            # Per-tick dispatch (fuse_spans=False, the default): the SLO
+            # meter counts one decision latency per dispatch and the
+            # driver's amortization is coalescing per-tick calls ACROSS
+            # sessions.  fuse_spans="slo" (round 17) turns span fusion
+            # ON — the driver bounds each span at its release frontier
+            # (scheduler.span_horizon) so serving never speculates past
+            # revealed arrivals, and the span tap below records one SLO
+            # latency per span.  Span outputs are bit-identical either
+            # way — the serve-vs-batch parity test and the round-17
+            # per-tick-referee test both pin it.
+            fuse_spans=bool(fuse_spans),
         )
         self.cluster.start()
         self.scheduler.start()
         self._last_unfinished = 0
         self._install_decision_tap()
+        if fuse_spans == "slo":
+            self._install_span_tap()
 
     @property
     def batchable(self) -> bool:
@@ -217,6 +241,53 @@ class ServeSession(LogMixin):
             return out
 
         self.policy.place = timed_place
+
+    def _install_span_tap(self) -> None:
+        """Wrap ``policy.place_span`` with the SLO span recorder
+        (``fuse_spans="slo"`` only).  A served span is ONE dispatch —
+        the latency its jobs actually experienced — so it lands as one
+        decision-latency sample plus the span length
+        (``SloMeter.record_span_decision``); a DECLINED span (None)
+        records nothing (the per-tick path then serves the tick through
+        the ordinary decision tap).  Ticks a replay aborts are
+        re-served per-tick and meter there — same accounting rule as
+        the per-tick path: every dispatch counts the batch it decided.
+        No-op for policies without a span tier (numpy arms)."""
+        orig = getattr(self.policy, "place_span", None)
+        if orig is None:
+            return
+
+        def timed_place_span(ctx, plan):
+            t0 = time.perf_counter()
+            out = orig(ctx, plan)
+            dt = time.perf_counter() - t0
+            if out is None:
+                return None
+            k_dyn = plan.n_ticks
+            placements = out.placements[:k_dyn]
+            n_placed = int((placements >= 0).sum())
+            n_tasks = len(plan.slots)
+            self.slo.record_span_decision(dt, k_dyn, n_tasks, n_placed)
+            if self.tracer.enabled:
+                self.tracer.record_span(
+                    "dispatch", "place_span", dt, sim=ctx.env_now,
+                    session=self.label, n_ticks=k_dyn,
+                    n_tasks=n_tasks, n_placed=n_placed,
+                )
+            # Per-tier attribution mirrors the per-tick tap: the span's
+            # latency counts toward every tier with slots in it.
+            tier_tasks = {}
+            for t in plan.slots:
+                tier = int(getattr(t.application, "_serve_tier", 0))
+                tier_tasks[tier] = tier_tasks.get(tier, 0) + 1
+            for tier, n in tier_tasks.items():
+                self.slo.record_decision_tier(tier, dt, n_tasks=n)
+            self.recent_decision_s = (
+                0.8 * self.recent_decision_s + 0.2 * dt
+            )
+            return out
+
+        self.policy.place_span = timed_place_span
 
     # -- driver-facing ----------------------------------------------------
     def offer(self, arrival: JobArrival) -> None:
@@ -413,6 +484,10 @@ class ServeSession(LogMixin):
         )
         s["n_failed"] = len(self.failed)
         s["degraded"] = bool(getattr(self.policy, "degraded", False))
+        # Span-fusion observability (fuse_spans="slo"): fused spans
+        # served, ticks they covered, replay aborts, fast-forwarded
+        # no-op ticks — all zero under per-tick dispatch.
+        s["span_stats"] = dict(self.scheduler.span_stats)
         s["kernel_failures"] = int(
             getattr(self.policy, "kernel_failures", 0)
         )

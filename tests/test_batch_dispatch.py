@@ -118,6 +118,7 @@ def test_lockstep_grid_smoke_and_stats_keys():
     assert set(stats) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
         "deadline_flushes", "single_fast_path", "mesh_dispatches",
+        "mesh_fallbacks",
         "respawns",
         "retired_slots",
     }

@@ -271,6 +271,7 @@ def test_slo_snapshot_schema_has_dispatch_mix():
     assert set(SloMeter.DISPATCH_KEYS) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
         "deadline_flushes", "single_fast_path", "mesh_dispatches",
+        "mesh_fallbacks",
         "respawns",
         "retired_slots",
     }

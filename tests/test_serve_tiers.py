@@ -496,7 +496,8 @@ def test_bench_serve_tiers_smoke():
         assert set(arm["dispatch"]) == {
             "runs", "dispatches", "device_calls", "coalesced",
             "max_group", "deadline_flushes", "single_fast_path",
-            "mesh_dispatches", "respawns", "retired_slots",
+            "mesh_dispatches", "mesh_fallbacks", "respawns",
+            "retired_slots",
         }
     assert "scale_events" in row["autoscaled"]
 

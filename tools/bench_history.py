@@ -134,6 +134,17 @@ TRACKED: Tuple[Metric, ...] = (
         # 30% floor x 1.5 margin still fires on a 2x collapse.
         rel_floor=30.0,
     ),
+    Metric(
+        "serve_sharded_dps",
+        ("serve_sharded", "mesh_2d", "decisions_per_sec"),
+        lower_better=False, kind="rate",
+        # The round-17 2-D serving arm (batching × sharding + slo
+        # spans) at 100× the PR-2 rate — same threaded-soak load
+        # sensitivity as serve_tiers; phase-in: absent from
+        # pre-round-17 histories, so the gate notes (not fires) until
+        # data/bench/ci_baseline.jsonl carries rows with it.
+        rel_floor=30.0,
+    ),
 )
 
 

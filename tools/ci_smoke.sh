@@ -113,13 +113,23 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
 echo "== [4/8] sharded-placement parity on a forced 8-device CPU mesh =="
-# Small-H quick twins + the H=1024 acceptance + the sharded span driver:
-# bit-parity with the single-device oracles, exercised on every run
-# without a TPU.  (conftest pins the same mesh; the explicit flag keeps
-# this lane standalone.)
+# Small-H quick twins + the H=1024 acceptance + the sharded span driver
+# + the round-17 2-D suite: the [G]-batched replica × host programs
+# (shard_map(vmap(...)) via batch_execute(mesh=...)) vs the sequential
+# oracle AND both 1-D paths, plus the mesh_fallbacks meter — bit-parity
+# with the single-device oracles, exercised on every run without a TPU.
+# (conftest pins the same mesh; the explicit flag keeps this lane
+# standalone.)
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_shard.py tests/test_mesh.py -q -m 'not slow' \
     -k 'parity or span or mesh' -p no:cacheprovider
+# 2-D mesh serving (round 17): the tiny fuse_spans="slo" soak whose
+# placements and meters are diffed against the unsharded per-tick twin,
+# the span-accounting SLO meter contract, the DRF tenant-quota audit,
+# and the zero-recompile assertion on the 2-D serve dispatch path.
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+python -m pytest tests/test_serve_2d.py -q -m 'not slow' \
+    -k 'not 100x' -p no:cacheprovider
 
 echo "== [5/8] spot soak + market replay determinism on the committed seed =="
 MARKET_SEED_FILE=data/market/ci_seed.json
