@@ -1667,6 +1667,240 @@ def _bench_serve_sharded_in_child(timeout_s: int = 420) -> dict:
     return _run_row_in_child("PIVOT_BENCH_SERVE_SHARDED_CHILD", timeout_s)
 
 
+def _bench_serve_ragged(
+    n_jobs: int = 40,
+    rate: float = 25.0,
+    n_hosts: int = 16,
+    queue_depth: int = 12,
+    seed: int = 0,
+    n_sessions: int = 3,
+    dense_jobs: int = 160,
+    dense_sessions: int = 4,
+) -> dict:
+    """Ragged continuous batching row (round 18): the serve_sharded
+    mixed-tier stream (100× the PR-2 rate) on the full ``mesh_2d``
+    stack, with the dispatch batcher's ragged mode ON vs OFF —
+
+      * ``same_shape`` — the PR-15 path (``ragged=False``): co-pending
+        spans coalesce only on exact shape match, so mixed-horizon
+        groups fragment into serial flushes and mesh fallbacks;
+      * ``ragged``     — mixed-horizon spans padded to a shared
+        (K-bucket, B-bucket) and served as ONE device program, trimmed
+        per request (bit-identical by the repack parity suite).
+
+    Two blocks, because the acceptance properties live at different
+    densities.  The SPARSE block (``n_jobs`` jobs) is deterministic end
+    to end — admission and routing settle identically run to run — so
+    it carries the exact assertions: bit-identical final placements
+    across ragged / same-shape / per-tick-referee arms (``parity_ok``)
+    and zero recompiles on the measured ragged pass after a warmup pass
+    of the same stream (``count_compiles``).  The DENSE block
+    (``dense_jobs`` jobs, ``dense_sessions`` sessions) actually
+    produces co-pending mixed-horizon spans — that is where
+    ``throughput_ratio`` (ragged vs same-shape decisions/s) and
+    ``fallbacks_lower`` (ragged kills the mixed-shape mesh fallbacks)
+    are measured; its placements are covered by the repack parity
+    suite, not re-asserted here, because wall-clock routing at 100×
+    density is legitimately racy across arms.  Tracked as
+    ``serve_ragged`` in ``tools/bench_history.py``, phase-in:
+    note-not-gate until the committed baseline carries rows."""
+    from pivot_tpu.parallel.mesh import build_hybrid_mesh
+    from pivot_tpu.serve import (
+        ServeDriver,
+        ServeSession,
+        mixed_tier_arrivals,
+        synthetic_app_factory,
+    )
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.compile_counter import count_compiles
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    mesh2d = build_hybrid_mesh(host_parallel=2)
+    pcfg = PolicyConfig(
+        name="cost-aware", device="tpu", bin_pack="first-fit",
+        sort_tasks=True, sort_hosts=True, adaptive=False,
+    )
+
+    def final_placements(sessions):
+        out = []
+        for s in sessions:
+            for app in s._injected:
+                for group in app.groups:
+                    for task in group.tasks:
+                        out.append((app.id, task.id, task.placement))
+        return sorted(out)
+
+    def one_arm(label, sharded, fuse, mesh, ragged,
+                jobs=n_jobs, pool_n=n_sessions):
+        reset_ids()
+
+        def make_session(slabel):
+            policy = make_policy(pcfg)
+            if sharded:
+                policy.enable_sharding(mesh2d)
+            return ServeSession(
+                slabel,
+                build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed)),
+                policy,
+                seed=seed,
+                fuse_spans=fuse,
+            )
+
+        sessions = [
+            make_session(f"{label}-{g}") for g in range(pool_n)
+        ]
+        driver = ServeDriver(
+            sessions,
+            queue_depth=queue_depth,
+            backpressure="shed",
+            flush_after=0.02,
+            mesh=mesh,
+            tier_reserve=(0, 2, 4),
+            tier_policies=("spill", "shed", "shed"),
+            ragged=ragged,
+        )
+        stream = mixed_tier_arrivals(
+            rate, jobs, weights=(0.25, 0.35, 0.40), seed=seed,
+            make_app=synthetic_app_factory(seed=seed),
+        )
+        t0 = time.perf_counter()
+        report = driver.run(stream)
+        wall = time.perf_counter() - t0
+        driver.audit(context=f"serve_ragged bench ({label})")
+        snap = report["slo"]
+        batcher = report["batcher"] or {}
+        pool = driver.sessions + driver._retired
+        span_stats = {
+            k: sum(s.summary()["span_stats"][k] for s in pool)
+            for k in ("fused_spans", "fused_ticks", "ff_ticks",
+                      "span_aborts", "span_ticks_max")
+        }
+        coalesced = max(int(batcher.get("dispatches", 0)), 1)
+        return {
+            "wall_s": round(wall, 3),
+            "decisions": snap["counters"]["decisions"],
+            "decisions_per_sec": round(
+                snap["counters"]["decisions"] / max(wall, 1e-9), 1
+            ),
+            "completed": snap["counters"]["completed"],
+            "shed": snap["counters"]["shed"],
+            "device_calls": int(batcher.get("device_calls", 0)),
+            "mesh_dispatches": int(batcher.get("mesh_dispatches", 0)),
+            "mesh_fallbacks": int(batcher.get("mesh_fallbacks", 0)),
+            "fallback_causes": {
+                k: int(batcher.get(f"mesh_fallback_{k}", 0))
+                for k in ("unshardable", "mixed_shapes", "indivisible")
+            },
+            "ragged_merges": int(batcher.get("ragged_merges", 0)),
+            "ragged_rows": int(batcher.get("ragged_rows", 0)),
+            "ragged_pad_cells": int(batcher.get("ragged_pad_cells", 0)),
+            "ragged_frac": round(
+                int(batcher.get("ragged_rows", 0)) / coalesced, 3
+            ),
+            "span_stats": span_stats,
+        }, final_placements(pool)
+
+    # -- sparse block: exact assertions on a deterministic stream -----
+    # Warmup pass: both mesh arms serve the full stream once so every
+    # (policy, shape) program is compiled before measurement.
+    one_arm("w0", sharded=True, fuse="slo", mesh=mesh2d, ragged=False)
+    one_arm("w1", sharded=True, fuse="slo", mesh=mesh2d, ragged=True)
+
+    sp_same, p_same = one_arm(
+        "ss", sharded=True, fuse="slo", mesh=mesh2d, ragged=False
+    )
+    with count_compiles() as counter:
+        sp_ragged, p_ragged = one_arm(
+            "rg", sharded=True, fuse="slo", mesh=mesh2d, ragged=True
+        )
+    sp_referee, p_ref = one_arm(
+        "pt", sharded=False, fuse=False, mesh=None, ragged=False
+    )
+
+    # -- dense block: co-pending mixed horizons, throughput + fallbacks
+    # Best-of-3 measured passes per arm: span shapes at this density
+    # are timing-dependent, so a pass can hit a shape the warmup never
+    # saw — one compile on a ~0.2 s wall would swamp the ratio, but it
+    # can only poison the pass that first meets the shape.
+    one_arm("dw0", sharded=True, fuse="slo", mesh=mesh2d, ragged=False,
+            jobs=dense_jobs, pool_n=dense_sessions)
+    one_arm("dw1", sharded=True, fuse="slo", mesh=mesh2d, ragged=True,
+            jobs=dense_jobs, pool_n=dense_sessions)
+
+    def dense_arm(label, ragged):
+        passes = [
+            one_arm(f"{label}{i}", sharded=True, fuse="slo",
+                    mesh=mesh2d, ragged=ragged,
+                    jobs=dense_jobs, pool_n=dense_sessions)[0]
+            for i in range(3)
+        ]
+        best = max(passes, key=lambda a: a["decisions_per_sec"])
+        best["pass_walls_s"] = [a["wall_s"] for a in passes]
+        return best
+
+    dn_same = dense_arm("dss", ragged=False)
+    with count_compiles() as dense_counter:
+        dn_ragged = dense_arm("drg", ragged=True)
+    return {
+        "jobs": n_jobs,
+        "dense_jobs": dense_jobs,
+        "arrival_rate": rate,
+        "rate_vs_pr2": round(rate / 0.25, 1),
+        "h": n_hosts,
+        "sessions": n_sessions,
+        "dense_sessions": dense_sessions,
+        "tier_mix": [0.25, 0.35, 0.40],
+        "sparse": {
+            "same_shape": sp_same,
+            "ragged": sp_ragged,
+            "referee": sp_referee,
+        },
+        "same_shape": dn_same,
+        "ragged": dn_ragged,
+        "throughput_ratio": round(
+            dn_ragged["decisions_per_sec"]
+            / max(dn_same["decisions_per_sec"], 1e-9), 3
+        ),
+        "fallbacks_lower": (
+            dn_ragged["mesh_fallbacks"] < dn_same["mesh_fallbacks"]
+        ),
+        "recompiles_after_warmup": int(counter.compiles),
+        "retraces_after_warmup": int(counter.traces),
+        # Informational at dense density: timing-dependent span shapes
+        # can straddle the warmup pass (the assertion lives in the
+        # deterministic sparse block above).
+        "dense_recompiles": int(dense_counter.compiles),
+        "parity_ok": bool(p_ragged == p_same == p_ref),
+    }
+
+
+def _serve_ragged_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SERVE_RAGGED_CHILD=1``): pin the
+    forced-8-device CPU mesh BEFORE the first jax import (XLA reads the
+    flag once per process), run the serve_ragged row, print ONE JSON
+    line."""
+    os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    jax = _child_backend_setup()
+    row = _bench_serve_ragged()
+    row["backend"] = jax.default_backend()
+    row["n_devices"] = len(jax.devices())
+    print(json.dumps(row), flush=True)
+
+
+def _bench_serve_ragged_in_child(timeout_s: int = 540) -> dict:
+    """Parent side of the serve_ragged row — see ``_run_row_in_child``."""
+    return _run_row_in_child("PIVOT_BENCH_SERVE_RAGGED_CHILD", timeout_s)
+
+
 # -- shard_place row: pod-scale host-sharded placement (ops/shard.py) -------
 #
 # Weak-scaling protocol: per-shard host count H0 held fixed while the
@@ -2069,7 +2303,7 @@ def main() -> None:
         known_rows = {
             "headline", "two_phase", "grid_batched", "fused_tick",
             "serve_stream", "serve_tiers", "serve_sharded",
-            "shard_place",
+            "serve_ragged", "shard_place",
             "spot_survival", "policy_search", "obs_overhead",
             "profiler_overhead", "cost_attribution", "saturated",
         }
@@ -2096,6 +2330,9 @@ def main() -> None:
         return
     if os.environ.get("PIVOT_BENCH_SERVE_SHARDED_CHILD"):
         _serve_sharded_child()
+        return
+    if os.environ.get("PIVOT_BENCH_SERVE_RAGGED_CHILD"):
+        _serve_ragged_child()
         return
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
@@ -2205,6 +2442,10 @@ def main() -> None:
     )
     serve_sharded = (
         _bench_serve_sharded_in_child() if _row_on("serve_sharded")
+        else skipped
+    )
+    serve_ragged = (
+        _bench_serve_ragged_in_child() if _row_on("serve_ragged")
         else skipped
     )
     # Pod-scale sharded placement, also all-children (each arm pins its
@@ -2389,6 +2630,7 @@ def main() -> None:
         "serve_stream": serve_stream,
         "serve_tiers": serve_tiers,
         "serve_sharded": serve_sharded,
+        "serve_ragged": serve_ragged,
         "shard_place": shard_place,
         "spot_survival": spot_survival,
         "policy_search": policy_search,

@@ -12,7 +12,7 @@ test that doesn't exercise that knob on that form.  PR 9 threaded
 ``risk``/``cost_stack`` through seven forms by hand; this pass turns
 the eighth such exercise into a static failure.
 
-Three checks:
+Four checks:
 
 1. **Signature matrix** — per family, the knob set (parameter names
    intersected with :data:`KNOBS` / :data:`SPAN_KNOBS`) must be equal
@@ -34,6 +34,14 @@ Three checks:
    arguments and dict-key staging (``kw["live"] = …`` then ``**kw``)
    both count.  The span route (``place_span`` + the ``_span_kw`` /
    ``_span_market_kw`` builders) must stage :data:`SPAN_ROUTING_KNOBS`.
+4. **Ragged axis coverage** (round 18) — the ragged repack's axis
+   tables (``tickloop.RAGGED_AXES`` ∪ ``RAGGED_INVARIANT``) must
+   partition *exactly* the span family's array knobs (the keyword-only
+   ``fused_tick_run`` parameters defaulting to None).  An array knob
+   added to the span driver but absent from both tables would be
+   silently dropped from the coalescing key AND left unpadded by
+   ``ragged_span_pad`` — a shape error at best, a wrong-merge at
+   worst; an overlap would pad an operand twice.
 """
 
 from __future__ import annotations
@@ -329,6 +337,95 @@ def _routing_findings(
 _OPS_DIR = "pivot_tpu/ops"
 
 
+def _set_literal_names(node: ast.AST) -> Optional[Set[str]]:
+    """String elements of a ``{...}`` / ``frozenset({...})`` literal, or
+    None when the node is not one (the check then reports it rather than
+    guessing)."""
+    if isinstance(node, ast.Call) and len(node.args) == 1:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "frozenset":
+            node = node.args[0]
+    if isinstance(node, ast.Set):
+        elts = node.elts
+    elif isinstance(node, ast.Dict):
+        elts = [k for k in node.keys if k is not None]
+    else:
+        return None
+    out: Set[str] = set()
+    for e in elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.add(e.value)
+    return out
+
+
+def _ragged_findings(
+    funcs_by_file: Dict[str, Dict[str, ast.FunctionDef]],
+    tickloop_src: Optional[SourceFile],
+) -> List[Finding]:
+    """Check 4: RAGGED_AXES ∪ RAGGED_INVARIANT partitions the span
+    family's array knobs (kwonly ``fused_tick_run`` params defaulting
+    to None) — every operand the ragged repack may see is classified
+    exactly once as padded-per-axis or shape-invariant."""
+    if tickloop_src is None:
+        return []  # the missing-file finding already fired
+    fn = funcs_by_file.get(_TICKLOOP, {}).get("fused_tick_run")
+    if fn is None:
+        return []  # span-manifest check already reports the vanish
+    array_knobs = {
+        p.arg
+        for p, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+        if isinstance(d, ast.Constant) and d.value is None
+    }
+    tables: Dict[str, Optional[Set[str]]] = {
+        "RAGGED_AXES": None, "RAGGED_INVARIANT": None,
+    }
+    lines: Dict[str, int] = {}
+    for node in tickloop_src.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name) and tgt.id in tables:
+            tables[tgt.id] = _set_literal_names(node.value)
+            lines[tgt.id] = node.lineno
+    out: List[Finding] = []
+    for name, names in tables.items():
+        if names is None:
+            out.append(Finding(
+                RULE, _TICKLOOP, lines.get(name, 0),
+                f"{name} is missing or not a string-keyed literal — the "
+                "ragged axis-coverage check cannot read it statically",
+            ))
+    axes, invariant = tables["RAGGED_AXES"], tables["RAGGED_INVARIANT"]
+    if axes is None or invariant is None:
+        return out
+    overlap = axes & invariant
+    if overlap:
+        out.append(Finding(
+            RULE, _TICKLOOP, lines["RAGGED_AXES"],
+            "ragged tables overlap (operand classified twice): "
+            f"{sorted(overlap)}",
+        ))
+    uncovered = array_knobs - axes - invariant
+    if uncovered:
+        out.append(Finding(
+            RULE, _TICKLOOP, lines["RAGGED_AXES"],
+            "span array knob(s) missing from both ragged tables — the "
+            "repack would drop them from the coalescing key and leave "
+            f"them unpadded: {sorted(uncovered)} (add to RAGGED_AXES "
+            "with (K, B) axis positions, or to RAGGED_INVARIANT if the "
+            "operand has neither axis)",
+        ))
+    stale = (axes | invariant) - array_knobs
+    if stale:
+        out.append(Finding(
+            RULE, _TICKLOOP, lines["RAGGED_AXES"],
+            "ragged table entries with no matching fused_tick_run "
+            f"array knob (renamed/removed?): {sorted(stale)}",
+        ))
+    return out
+
+
 def _ops_files(root: str) -> List[str]:
     import os
 
@@ -383,6 +480,7 @@ def collect(cache) -> Tuple[List[Finding], List[str]]:
         "span", SPAN_MANIFEST, SPAN_KNOBS, funcs_by_file
     )
     out.extend(span_findings)
+    out.extend(_ragged_findings(funcs_by_file, cache.get(_TICKLOOP)))
     out.extend(_discovery_findings(funcs_by_file))
 
     routing = cache.get(_ROUTING_FILE)

@@ -589,6 +589,14 @@ def parse_args(argv=None):
                           "checkpoints — spans bounded by the "
                           "admission window, ONE decision latency "
                           "per span with span lengths in the snapshot")
+    srv.add_argument("--no-ragged", action="store_true",
+                     help="disable ragged continuous batching (round "
+                          "18): by default co-pending mixed-horizon "
+                          "spans are padded into a shared power-of-two "
+                          "K-bucket and served as ONE device program "
+                          "(trimmed per request, bit-identical); this "
+                          "flag pins the round-17 same-shape-only "
+                          "coalescing for A/B runs")
     srv.add_argument("--tenant-quota", type=float, default=0.0,
                      help="DRF tenant fairness within a tier: cap each "
                           "tenant's dominant-resource occupancy at "
@@ -1652,6 +1660,7 @@ def run_serve_stream(args) -> dict:
         profiler=profiler,
         mesh=mesh,
         tenant_quota=args.tenant_quota or None,
+        ragged=not args.no_ragged,
     )
     metrics_server = None
     if args.metrics_port:

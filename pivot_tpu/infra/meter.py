@@ -493,7 +493,10 @@ class SloMeter(LogMixin):
     DISPATCH_KEYS = (
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
         "deadline_flushes", "single_fast_path", "mesh_dispatches",
-        "mesh_fallbacks", "respawns", "retired_slots",
+        "mesh_fallbacks", "mesh_fallback_unshardable",
+        "mesh_fallback_mixed_shapes", "mesh_fallback_indivisible",
+        "ragged_merges", "ragged_rows", "ragged_pad_cells",
+        "respawns", "retired_slots",
     )
 
     #: Per-tier counter keys (each tier's section of the snapshot).

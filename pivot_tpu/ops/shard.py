@@ -1610,7 +1610,18 @@ def sharded_batched_tick_run(
     twin's layout (stacked ``risk_rows`` [G, K, H] shard as
     ``P("replica", None, "host")``).  Each row is bit-identical to the
     1-D sharded driver — the same per-shard body under vmap, with the
-    same per-row inertness the plain vmapped driver relies on."""
+    same per-row inertness the plain vmapped driver relies on.
+
+    Ragged contract (round 18): rows need NOT share a true horizon —
+    ``n_ticks_dyn`` is a [G] operand and each row's while-loop carry
+    freezes (select-masked by vmap) once that row exits, so a short
+    row's ``ticks_run``/meters stay exact while longer rows keep
+    stepping.  The batcher exploits this by padding mixed-horizon
+    ``fused_tick_run`` requests to a shared (K-bucket, B-bucket) before
+    stacking the [G] axis (``ops.tickloop.ragged_span_pad``); the
+    static ``n_ticks`` here is the shared K-bucket, and padded K/B
+    extents are inert by the zero-fill-safety of every span operand
+    (see ``ragged_span_signature`` for which axes pad where)."""
     _resolve_phase2(phase2)
     _check_host_axis(avail.shape[1], mesh)
     _check_g_axis(mesh, avail.shape[0])

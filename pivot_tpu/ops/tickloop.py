@@ -82,8 +82,13 @@ from pivot_tpu.ops.kernels import (
 )
 
 __all__ = [
+    "RAGGED_AXES",
+    "RAGGED_INVARIANT",
     "SpanResult",
     "fused_tick_run",
+    "ragged_span_pad",
+    "ragged_span_signature",
+    "ragged_span_trim",
     "reference_tick_run",
     "span_bucket",
 ]
@@ -643,3 +648,139 @@ def reference_tick_run(
         np.add.at(cum, placed_hosts, 1)
         n_placed[k] = len(placed_hosts)
     return placements, n_ready, n_placed, np.asarray(avail)
+
+
+# ---------------------------------------------------------------------------
+# Ragged span repack — the continuous-batching contract
+# ---------------------------------------------------------------------------
+# Mixed-horizon spans (different K tick buckets and/or B slot buckets)
+# can ride ONE coalesced device program because the padded tails are
+# provably inert:
+#
+#   * K tail (ticks in [n_ticks_dyn, K′)): the while-loop condition is
+#     ``(k < n_ticks_dyn) & ~done`` and the batched while rule
+#     select-masks each row's carry, so a finished row's state (its
+#     ``k`` included) freezes at its own exit value — ``ticks_run``
+#     stays per-row exact and rows ≥ ``ticks_run`` of ``placements``/
+#     ``n_ready``/``n_placed`` keep their −1/0 init.  The per-tick
+#     gathers (``uniforms[k]``, ``risk_rows[k]``, ``cost_seg[k]``)
+#     never index past the row's own live range, so zero-padding those
+#     tails cannot reach any live tick.
+#   * B tail (slots in [B, B′)): a pad slot arrives at K′ ≥ n_ticks_dyn,
+#     so it never joins a ready batch (``_span_ready_batch``), sorts
+#     after every active slot (the ``inactive`` sort key), contributes
+#     the ``big`` sentinel to the cost-aware ``segment_min``, and the
+#     kernels return −1 for its invalid position — no live slot's
+#     stream position, score, or placement moves.
+#
+# The batcher (``sched/batch.py``) uses these three helpers to merge
+# co-pending ``fused_tick_run`` requests whose shapes differ only in
+# (K, B) into one (K′, B′) = (max K, max B) bucket, then slices each
+# result back — bit-identical to the request's own solo dispatch, the
+# ragged-parity suite's contract (``tests/test_ragged.py``).  They are
+# HOST-side staging utilities (numpy in, numpy out, never jitted), so
+# they deliberately do NOT match the ``_span_*`` hostsync-discovery
+# patterns that lint device bodies.
+
+#: Array-kwarg name → (K axis, B axis) — which axes of each span operand
+#: the ragged repack must pad (None = operand lacks that axis).  The
+#: parity pass (``analysis/parity.py``) asserts this table plus
+#: :data:`RAGGED_INVARIANT` covers every array knob of the span family.
+RAGGED_AXES = {
+    "uniforms": (0, 1),
+    "risk_rows": (0, None),
+    "cost_seg": (0, None),
+    "sort_norm": (None, 0),
+    "anchor_zone": (None, 0),
+    "bucket_id": (None, 0),
+}
+
+#: Span operands with no K or B axis: stacked per-row by the batcher
+#: like everything else, untouched by the repack.
+RAGGED_INVARIANT = frozenset({
+    "cost_zz", "bw_zz", "host_zone", "base_task_counts", "totals",
+    "live", "cost_stack",
+})
+
+
+def ragged_span_signature(args, arr_kw, static_kw):
+    """Coalescing key for mixed-horizon span requests: the request key
+    with the span-length bucket K (the ``n_ticks`` static) and the
+    slot-bucket width B normalized OUT, so requests that differ only in
+    their (K, B) pads may merge into one device program.  Returns a
+    hashable tuple, or None when the operands do not match the span
+    family's layout (defensive — the batcher then leaves the request on
+    the exact-key path)."""
+    if len(args) != 4:
+        return None
+    avail, demands, arrive, _n_dyn = args
+    if (
+        getattr(avail, "ndim", None) != 2
+        or getattr(demands, "ndim", None) != 2
+        or getattr(arrive, "ndim", None) != 1
+        or "n_ticks" not in static_kw
+    ):
+        return None
+    for name in arr_kw:
+        if name not in RAGGED_AXES and name not in RAGGED_INVARIANT:
+            return None
+    statics = tuple(sorted(
+        (k, v) for k, v in static_kw.items() if k != "n_ticks"
+    ))
+    names = tuple(sorted(arr_kw))
+    dtypes = tuple(str(arr_kw[n].dtype) for n in names)
+    invariant_shapes = tuple(
+        tuple(arr_kw[n].shape) for n in names if n in RAGGED_INVARIANT
+    )
+    return (
+        tuple(avail.shape), str(avail.dtype), str(demands.dtype),
+        str(arrive.dtype), names, dtypes, invariant_shapes, statics,
+    )
+
+
+def _ragged_pad_to(arr, shape):
+    out = np.zeros(shape, arr.dtype)
+    out[tuple(slice(0, s) for s in arr.shape)] = arr
+    return out
+
+
+def ragged_span_pad(args, arr_kw, k2: int, b2: int):
+    """Pad one staged span request from its own (K, B) buckets up to the
+    merged (K′, B′) = ``(k2, b2)`` — new pad slots arrive at ``k2`` (so
+    they can never join a batch) and every K/B tail is zero-filled (the
+    inert-tail contract above).  Returns ``(args, arr_kw)`` rebuilt;
+    operands already at the target shape pass through untouched."""
+    avail, demands, arrive, n_ticks_dyn = args
+    b = demands.shape[0]
+    if b != b2:
+        demands = _ragged_pad_to(demands, (b2,) + demands.shape[1:])
+        arr2 = np.full((b2,), k2, arrive.dtype)
+        arr2[:b] = arrive
+        arrive = arr2
+    out_kw = {}
+    for name, v in arr_kw.items():
+        k_ax, b_ax = RAGGED_AXES.get(name, (None, None))
+        shape = list(v.shape)
+        if k_ax is not None:
+            shape[k_ax] = k2
+        if b_ax is not None:
+            shape[b_ax] = b2
+        shape = tuple(shape)
+        out_kw[name] = v if shape == v.shape else _ragged_pad_to(v, shape)
+    return (avail, demands, arrive, n_ticks_dyn), out_kw
+
+
+def ragged_span_trim(res: SpanResult, k: int, b: int) -> SpanResult:
+    """Slice a merged-bucket :class:`SpanResult` back to the request's
+    own (K, B) buckets — the demux half of the ragged contract.  The
+    scalar fields (``ticks_run``, ``n_stack_final``) and the [H, 4]
+    carry are per-row exact already (inert-tail contract)."""
+    return SpanResult(
+        placements=res.placements[:k, :b],
+        n_ready=res.n_ready[:k],
+        n_placed=res.n_placed[:k],
+        ticks_run=res.ticks_run,
+        n_stack_final=res.n_stack_final,
+        stackpos=res.stackpos[:b],
+        avail=res.avail,
+    )

@@ -453,6 +453,14 @@ class GlobalScheduler(LogMixin):
             "fused_ticks": 0,
             "span_aborts": 0,
             "spans_declined": 0,
+            # Span-length observability (round 18): the longest span
+            # extracted and the sum of extracted lengths — fragmentation
+            # diagnostics for the ragged batcher (extracted length is
+            # what the K-bucket ladder quantises; committed ticks are
+            # ``fused_ticks``).  Always present, zero under per-tick
+            # dispatch, so summary key sets match across serve arms.
+            "span_ticks_max": 0,
+            "span_ticks_sum": 0,
         }
         policy.bind(self)
 
@@ -802,10 +810,20 @@ class GlobalScheduler(LogMixin):
         if not allowed:
             return None
         t_bound = min(t_foreign, self._quarantine_bound(now))
-        if self.span_horizon is not None:
-            # Serving's admission-window bound (``fuse_spans="slo"``):
-            # never speculate past the stream's revealed frontier.
-            t_bound = min(t_bound, self.span_horizon())
+        # Serving's admission-window bound (``fuse_spans="slo"``): never
+        # speculate past the stream's revealed frontier.  INCLUSIVE,
+        # unlike the foreign/quarantine bounds: a tick landing exactly on
+        # the frontier is safe — arrivals at that instant are already
+        # revealed (``wait_released`` admits at ``released >= t`` for the
+        # same reason), and anything revealed later bumps ``_span_epoch``
+        # and aborts the replay before the affected tick.  Exclusive
+        # truncation here is what used to clip mixed-horizon groups to
+        # their minimum frontier and fragment the ragged batcher's
+        # K-buckets.
+        t_horizon = (
+            self.span_horizon() if self.span_horizon is not None
+            else float("inf")
+        )
         cap = int(getattr(policy, "span_cap", 32))
         # Exact grid: iterated float adds, the same op sequence the
         # sequential timeout chain performs — anchor + k*interval can
@@ -814,7 +832,7 @@ class GlobalScheduler(LogMixin):
         t = now
         for _ in range(cap - 1):
             t = t + self.interval
-            if t >= t_bound:
+            if t >= t_bound or t > t_horizon:
                 break
             grid.append(t)
         if len(grid) < 2:
@@ -868,6 +886,9 @@ class GlobalScheduler(LogMixin):
             dem = np.stack([t.demand for t in slots])
             norms = np.sqrt(np.sum(dem * dem, axis=1))
         self.span_stats["fused_spans"] += 1
+        self.span_stats["span_ticks_sum"] += plan.n_ticks
+        if plan.n_ticks > self.span_stats["span_ticks_max"]:
+            self.span_stats["span_ticks_max"] = plan.n_ticks
         ready_k = list(ctx.tasks)
         for k in range(plan.n_ticks):
             if k > 0:

@@ -191,12 +191,16 @@ def _plan_mesh(mesh, kernel, g: int, args0: tuple, arr_kw_keys=()):
 
     On a 2-D ``replica × host`` mesh, a group of a kernel with a
     registered sharded family whose host axis divides the host shards
-    gets its ``[G]`` bucket rounded UP to a multiple of the replica
-    axis: padding a 2-row group to 4 costs redundant pad rows (their
-    outputs are discarded) but keeps the flush on the mesh — without
-    it, every small coalesced group (the common serving case) would
-    silently run single-device, which is exactly what the
-    ``mesh_fallbacks`` meter exists to catch."""
+    gets its ``[G]`` bucket set to the SMALLEST multiple of the replica
+    axis ≥ the group size: padding a 2-row group to 4 costs redundant
+    pad rows (their outputs are discarded) but keeps the flush on the
+    mesh — without it, every small coalesced group (the common serving
+    case) would silently run single-device, which is exactly what the
+    ``mesh_fallbacks`` meter exists to catch.  The smallest dividing
+    bucket (not the power-of-two ladder rounded up) cuts the wasted
+    rows — a 9-row group on a replica-4 axis pads to 12, not 16 — and
+    the compile cache stays bounded: distinct [G] sizes are multiples
+    of the replica axis capped by the pool size."""
     gb = group_bucket(g)
     host_ok = False
     if mesh is not None and g > 1:
@@ -210,7 +214,7 @@ def _plan_mesh(mesh, kernel, g: int, args0: tuple, arr_kw_keys=()):
             and args0[0].shape[0] % host_axis_size(mesh) == 0
         ):
             r = int(mesh.shape["replica"])
-            gb = ((gb + r - 1) // r) * r
+            gb = ((g + r - 1) // r) * r
             host_ok = True
     fn_mesh = _replica_mesh_for(mesh, gb)
     host_ok = host_ok and fn_mesh is not None
@@ -327,7 +331,7 @@ def _request_key(kernel, args, arr_kw, static_kw) -> tuple:
 
 class _Request:
     __slots__ = ("slot", "kernel", "args", "arr_kw", "static_kw", "key",
-                 "done", "result", "error")
+                 "done", "result", "error", "trim")
 
     def __init__(self, slot, kernel, args, arr_kw, static_kw):
         self.slot = slot
@@ -339,6 +343,10 @@ class _Request:
         self.done = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        #: (K, B) buckets this span request was staged at, set by the
+        #: ragged repack when the request rides a merged (K′, B′)
+        #: dispatch — the demux slices the result back to these.
+        self.trim: Optional[Tuple[int, int]] = None
 
 
 class BatchClient:
@@ -456,24 +464,45 @@ class DispatchBatcher:
     thread because theirs was the only live slot — no queue hand-off,
     no coordinator hop), ``mesh_dispatches`` (device calls whose [G]
     axis sharded over the replica mesh — multi-chip coalesced
-    flushes), ``mesh_fallbacks`` (coalesced flushes that DROPPED the
-    mesh because the padded group bucket does not divide the replica
-    axis — served by the single-device vmap program instead,
-    bit-identically, but a deployment seeing this climb is quietly
-    degrading; the first occurrence is also logged.  On a 2-D mesh,
-    shardable groups have their bucket padded UP to the replica axis
-    (``_plan_mesh``), so this counts only replica-only meshes and
-    kernels without a sharded family), and the
+    flushes), ``mesh_fallbacks`` (dispatches on a mesh that ran the
+    single-device program when a mesh program was on the table: a
+    coalesced flush whose padded group bucket does not divide the
+    replica axis, or a fragment of a flush whose kernel appeared under
+    multiple shape keys — bit-identical either way, but a deployment
+    seeing this climb is quietly degrading; the first occurrence is
+    also logged), its root-cause split ``mesh_fallback_unshardable``
+    (the kernel has no sharded family or carries operands the sharded
+    forms reject), ``mesh_fallback_mixed_shapes`` (the flush held the
+    same kernel under ≥ 2 shape keys — the fragmentation the ragged
+    repack exists to remove), ``mesh_fallback_indivisible`` (the
+    bucket does not divide the replica axis; the causes partition
+    ``mesh_fallbacks`` exactly), the ragged-repack trio
+    ``ragged_merges`` (mixed-horizon span groups merged into one
+    (K′, B′) bucket), ``ragged_rows`` (requests that rode a merged
+    dispatch), ``ragged_pad_cells`` (K×B device cells executed beyond
+    the members' own buckets — the padding waste the profiler
+    attributes ragged losses to), and the
     pool-resize pair ``respawns`` (slots
     opened beyond the construction-time count: supervisor restarts and
     autoscaler growth) / ``retired_slots`` (slots closed for good:
     finished runs, drained-and-retired or crashed sessions).  At any
     instant ``live_slots == runs − retired_slots``.
+
+    ``ragged=True`` (the default) turns on continuous span batching:
+    co-pending ``fused_tick_run`` requests that differ ONLY in their
+    span-length bucket K and slot-bucket width B are repacked to one
+    merged (K′, B′) bucket and ride one device program, each result
+    sliced back to its own buckets on demux (``ops/tickloop.py``
+    ragged helpers; bit-identical by the inert-tail contract).  Rows
+    join and leave the device batch at span boundaries — a tier-0
+    2-tick span and a tier-2 16-tick span share one dispatch instead
+    of fragmenting the flush.  ``ragged=False`` keeps the PR-15
+    exact-shape coalescing (the bench A/B arm).
     """
 
     def __init__(self, n_slots: int, flush_after: Optional[float] = None,
                  mesh: Optional[object] = None, tracer=None,
-                 profiler=None):
+                 profiler=None, ragged: bool = True):
         if n_slots < 1:
             raise ValueError("DispatchBatcher needs at least one slot")
         if flush_after is not None and flush_after <= 0:
@@ -520,11 +549,24 @@ class DispatchBatcher:
             #: Device calls whose [G] axis actually sharded over the
             #: replica mesh (mesh set AND the bucket divided the axis).
             "mesh_dispatches": 0,
-            #: Coalesced flushes that dropped the mesh (bucket did not
-            #: divide the replica axis) — single-device fallbacks a 2-D
-            #: deployment must watch (docstring above; logged once).
+            #: Mesh-eligible dispatches that ran the single-device
+            #: program instead — fallbacks a 2-D deployment must watch
+            #: (docstring above; logged once).  The three cause
+            #: counters below partition this total exactly.
             "mesh_fallbacks": 0,
+            "mesh_fallback_unshardable": 0,
+            "mesh_fallback_mixed_shapes": 0,
+            "mesh_fallback_indivisible": 0,
+            #: Ragged continuous batching (docstring above): merged
+            #: mixed-horizon span groups / requests riding them / K×B
+            #: pad cells executed beyond the members' own buckets.
+            "ragged_merges": 0,
+            "ragged_rows": 0,
+            "ragged_pad_cells": 0,
         }
+        #: Continuous span batching (mixed-horizon ``fused_tick_run``
+        #: repack) — see the class docstring.
+        self._ragged = bool(ragged)
         self._mesh_fallback_logged = False
         #: Pool-resize accounting (serving autoscaler + supervisor):
         #: slots opened beyond the construction-time count and slots
@@ -645,18 +687,100 @@ class DispatchBatcher:
             shape["h"] = int(args0[0].shape[0])
         if len(args0) > 1 and hasattr(args0[1], "shape"):
             shape["b"] = int(args0[1].shape[0])
+        n_ticks = reqs[0].static_kw.get("n_ticks")
+        if n_ticks is not None:
+            shape["k"] = int(n_ticks)
+        # Ragged attribution: the K×B cells this merged dispatch
+        # executes beyond its members' own buckets — where the ragged
+        # path loses against the same-shape ideal (pure padding waste;
+        # zero on exact-shape groups).
+        pad = sum(
+            int(n_ticks) * shape.get("b", 0) - t[0] * t[1]
+            for t in (r.trim for r in reqs) if t is not None
+        )
+        if pad:
+            shape["ragged_pad_cells"] = pad
         return prof.profile(
             family_of(reqs[0].kernel), call, shape=shape, flush=True
         )
+
+    def _fallback_cause(self, req: "_Request", fragmented: bool) -> str:
+        """Root cause of one mesh fallback — the three causes partition
+        ``mesh_fallbacks`` exactly: ``unshardable`` (no sharded family
+        or operands the sharded forms reject), ``mixed_shapes`` (the
+        flush held this kernel under ≥ 2 shape keys — fragmentation),
+        ``indivisible`` (the padded bucket does not divide the replica
+        axis)."""
+        from pivot_tpu.ops.shard import mesh_is_2d, sharded_twin_of
+
+        if mesh_is_2d(self._mesh) and sharded_twin_of(
+            req.kernel, req.arr_kw
+        ) is None:
+            return "unshardable"
+        if fragmented:
+            return "mixed_shapes"
+        return "indivisible"
+
+    def _ragged_regroup(self, batch: List[_Request]) -> None:
+        """Continuous span batching: merge co-pending ``fused_tick_run``
+        requests that differ only in their (K, B) buckets into one
+        (K′, B′) = (max K, max B) bucket so they share one device
+        program (keys rewritten in place — the exact-key grouping below
+        then coalesces them naturally).  Bit-identical per request by
+        the inert-tail contract (``ops/tickloop.py``); the demux slices
+        each result back via ``req.trim``."""
+        from pivot_tpu.ops.tickloop import (
+            fused_tick_run,
+            ragged_span_pad,
+            ragged_span_signature,
+        )
+
+        cand: Dict[tuple, List[_Request]] = {}
+        for req in batch:
+            if req.kernel is not fused_tick_run:
+                continue
+            sig = ragged_span_signature(
+                req.args, req.arr_kw, req.static_kw
+            )
+            if sig is not None:
+                cand.setdefault(sig, []).append(req)
+        for reqs in cand.values():
+            if len(reqs) < 2 or len({r.key for r in reqs}) < 2:
+                continue  # solo or already same-shape — nothing to merge
+            k2 = max(int(r.static_kw["n_ticks"]) for r in reqs)
+            b2 = max(int(r.args[1].shape[0]) for r in reqs)
+            pad_cells = 0
+            for r in reqs:
+                k, b = int(r.static_kw["n_ticks"]), int(r.args[1].shape[0])
+                r.args, r.arr_kw = ragged_span_pad(r.args, r.arr_kw, k2, b2)
+                r.static_kw = dict(r.static_kw, n_ticks=k2)
+                r.trim = (k, b)
+                r.key = _request_key(
+                    r.kernel, r.args, r.arr_kw, r.static_kw
+                )
+                pad_cells += k2 * b2 - k * b
+            with self._cond:
+                self.stats["ragged_merges"] += 1
+                self.stats["ragged_rows"] += len(reqs)
+                self.stats["ragged_pad_cells"] += pad_cells
 
     def _flush(self, batch: List[_Request]) -> None:
         # Deterministic composition given a fixed co-pending set: groups
         # in first-key-seen order, rows in slot order.  (Results are
         # composition-independent anyway — the vmap-parity contract.)
         try:
+            if self._ragged:
+                self._ragged_regroup(batch)
             groups: Dict[tuple, List[_Request]] = {}
             for req in batch:
                 groups.setdefault(req.key, []).append(req)
+            # Per-kernel shape-key multiplicity across THIS flush: a
+            # group that lost the mesh while its kernel rode other keys
+            # fragmented — the mixed-shapes fallback cause the ragged
+            # repack exists to remove.
+            kernel_keys: Dict[object, set] = {}
+            for key in groups:
+                kernel_keys.setdefault(key[0], set()).add(key)
             for reqs in groups.values():
                 reqs.sort(key=lambda r: r.slot)
                 # Under the cond: the single-live-slot fast path bumps
@@ -665,6 +789,7 @@ class DispatchBatcher:
                 # could lose an increment against a concurrent solo
                 # dispatch after a respawn reopens the pool).
                 log_fallback = False
+                fragmented = len(kernel_keys[reqs[0].kernel]) > 1
                 # The SAME routing decision batch_execute will make for
                 # this group — stats and program cannot disagree.
                 _gb, grp_mesh, _ok = _plan_mesh(
@@ -681,13 +806,22 @@ class DispatchBatcher:
                         self.stats["coalesced"] += len(reqs)
                     if grp_mesh is not None:
                         self.stats["mesh_dispatches"] += 1
-                    elif self._mesh is not None and len(reqs) > 1:
-                        # The coalesced group LOST its mesh: the padded
-                        # bucket does not divide the replica axis, so
-                        # this flush runs the single-device program.
-                        # Metered + logged once so a 2-D deployment
-                        # can't quietly degrade (ISSUE-17 satellite).
+                    elif self._mesh is not None and (
+                        len(reqs) > 1 or fragmented
+                    ):
+                        # The group LOST its mesh (coalesced but the
+                        # bucket does not divide the replica axis, the
+                        # kernel has no sharded form, or the flush
+                        # fragmented into shape-keyed slivers) — this
+                        # dispatch runs the single-device program.
+                        # Metered by cause + logged once so a 2-D
+                        # deployment can't quietly degrade.
                         self.stats["mesh_fallbacks"] += 1
+                        self.stats[
+                            "mesh_fallback_" + self._fallback_cause(
+                                reqs[0], fragmented
+                            )
+                        ] += 1
                         if not self._mesh_fallback_logged:
                             self._mesh_fallback_logged = True
                             log_fallback = True
@@ -696,11 +830,12 @@ class DispatchBatcher:
 
                     logging.getLogger(__name__).warning(
                         "DispatchBatcher: %d-request flush (bucket %d) "
-                        "does not divide the mesh's replica axis (%d) — "
+                        "cannot ride the mesh (%s) — "
                         "serving on a single device; further fallbacks "
-                        "counted in stats['mesh_fallbacks']",
+                        "counted in stats['mesh_fallbacks'] and the "
+                        "per-cause mesh_fallback_* counters",
                         len(reqs), _gb,
-                        int(self._mesh.shape["replica"]),
+                        self._fallback_cause(reqs[0], fragmented),
                     )
                 try:
                     with self.tracer.wall_span(
@@ -713,8 +848,13 @@ class DispatchBatcher:
                         r.error = exc
                         r.done.set()
                     continue
+                from pivot_tpu.ops.tickloop import ragged_span_trim
+
                 for r, out in zip(reqs, outs):
-                    r.result = out
+                    r.result = (
+                        ragged_span_trim(out, *r.trim)
+                        if r.trim is not None else out
+                    )
                     r.done.set()
         except BaseException as exc:  # noqa: BLE001 — coordinator crash-safety
             # A failure OUTSIDE the per-group kernel call (malformed

@@ -620,6 +620,19 @@ class _DevicePolicyBase(Policy):
         whose ``placements[k, s]`` is slot ``s``'s host index at span
         tick ``k`` (−1 unplaced).  Returns None to decline (the
         scheduler then serves the tick per-tick, bit-identically).
+
+        Ragged coalescing contract (round 18): the operands built here
+        are zero-fill-safe past their true extents — ``arrive`` pads at
+        the K-bucket (≥ ``k_dyn``, so pad slots never join a ready
+        batch), K-axis streams past ``k_dyn`` are never read (the span
+        loop exits at ``k == k_dyn``), and ``cost_seg`` pads index row 0
+        of ``cost_stack`` harmlessly.  That is what lets the dispatch
+        batcher pad co-pending mixed-horizon spans up to a shared
+        (K′, B′) and run them as one device program
+        (``DispatchBatcher`` ragged mode) with per-request trims bit-
+        identical to the solo dispatch.  The static ``n_ticks`` passed
+        down is the K-bucket; the true horizon rides as the dynamic
+        ``k_dyn`` operand, so a merged bucket never changes results.
         """
         slots = plan.slots
         S = len(slots)

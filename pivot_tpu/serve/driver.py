@@ -155,6 +155,7 @@ class ServeDriver(LogMixin):
         profiler=None,
         mesh=None,
         tenant_quota: Optional[float] = None,
+        ragged: bool = True,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
@@ -220,6 +221,13 @@ class ServeDriver(LogMixin):
         #: axis over ``host`` (the composed 2-D program).  ``None``
         #: keeps today's single-device vmap dispatch.
         self.mesh = mesh
+        #: Ragged continuous batching (round 18): the batcher repacks
+        #: co-pending mixed-horizon ``place_span`` dispatches into one
+        #: (K′, B′) bucket so a tier-0 2-tick span and a tier-2 16-tick
+        #: span ride ONE device program (``sched/batch.py``; bit-
+        #: identical by the inert-tail contract).  ``False`` keeps the
+        #: PR-15 exact-shape coalescing — the bench A/B arm.
+        self.ragged = bool(ragged)
         self.routing = routing
         self.preempt = preempt
         self.preempt_timeout = preempt_timeout
@@ -1125,6 +1133,7 @@ class ServeDriver(LogMixin):
                     len(self.sessions), flush_after=self.flush_after,
                     mesh=self.mesh,
                     tracer=self.tracer, profiler=self.profiler,
+                    ragged=self.ragged,
                 )
                 clients = [self.batcher.client() for _ in self.sessions]
                 for s, c in zip(self.sessions, clients):
@@ -1236,6 +1245,7 @@ class ServeDriver(LogMixin):
             "backpressure": self.queue.policy,
             "queue_depth": self.queue.depth,
             "flush_after_s": self.flush_after,
+            "ragged": self.ragged,
             "routing": self.routing,
             "preempt": self.preempt,
             "tenant_quota": self.queue.tenant_quota,

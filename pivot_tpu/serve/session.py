@@ -111,8 +111,13 @@ class ServeSession(LogMixin):
         #: SLO checkpoints — spans bounded by the serve driver's
         #: release frontier (``GlobalScheduler.span_horizon``, wired by
         #: the driver), the SLO meter recording ONE decision latency
-        #: per span with the span length in the snapshot.  Placements
-        #: are bit-identical either way (the span parity contract).
+        #: per span with the span length in the snapshot.  The frontier
+        #: bound is INCLUSIVE (round 18): a tick landing exactly on the
+        #: revealed frontier joins the span — same instant
+        #: ``wait_released`` admits at — so mixed-horizon sessions no
+        #: longer truncate spans to one below their gate and fragment
+        #: the ragged batcher's K-buckets.  Placements are
+        #: bit-identical either way (the span parity contract).
         self.fuse_spans = fuse_spans
         #: One injected obs wall clock for everything this session
         #: meters (round 14): the run Meter and the fallback SLO meter

@@ -124,6 +124,43 @@ def test_parity_catches_dropped_span_knob(tmp_path):
     ), "\n".join(str(f) for f in findings)
 
 
+def test_parity_catches_uncovered_ragged_operand(tmp_path):
+    """Ragged axis coverage (round 18): dropping ``sort_norm`` from
+    RAGGED_AXES leaves a span array knob classified by neither table —
+    the repack would silently drop it from the coalescing key — and the
+    check must name the operand."""
+    root = _copy_tree(tmp_path)
+    path = tmp_path / "pivot_tpu/ops/tickloop.py"
+    text = path.read_text()
+    mutated = text.replace('    "sort_norm": (None, 0),\n', "")
+    assert mutated != text, "RAGGED_AXES sort_norm entry not found"
+    path.write_text(mutated)
+    findings = run(root=root, rules=["backend-parity"])
+    assert any(
+        "sort_norm" in f.message and "RAGGED" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
+def test_parity_catches_ragged_table_overlap(tmp_path):
+    """An operand in BOTH ragged tables would be padded and also
+    asserted shape-invariant — flagged as a double classification."""
+    root = _copy_tree(tmp_path)
+    path = tmp_path / "pivot_tpu/ops/tickloop.py"
+    text = path.read_text()
+    mutated = text.replace(
+        'RAGGED_INVARIANT = frozenset({\n    "cost_zz",',
+        'RAGGED_INVARIANT = frozenset({\n    "sort_norm", "cost_zz",',
+    )
+    assert mutated != text, "RAGGED_INVARIANT literal not found"
+    path.write_text(mutated)
+    findings = run(root=root, rules=["backend-parity"])
+    assert any(
+        "overlap" in f.message and "sort_norm" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
 def test_parity_flags_unregistered_new_form(tmp_path):
     """Auto-discovery: a NEW function matching the backend naming
     conventions is flagged until it joins the manifest — new forms are

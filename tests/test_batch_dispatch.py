@@ -118,7 +118,9 @@ def test_lockstep_grid_smoke_and_stats_keys():
     assert set(stats) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
         "deadline_flushes", "single_fast_path", "mesh_dispatches",
-        "mesh_fallbacks",
+        "mesh_fallbacks", "mesh_fallback_unshardable",
+        "mesh_fallback_mixed_shapes", "mesh_fallback_indivisible",
+        "ragged_merges", "ragged_rows", "ragged_pad_cells",
         "respawns",
         "retired_slots",
     }

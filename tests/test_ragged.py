@@ -1,0 +1,419 @@
+"""Ragged continuous span batching (round 18, ``sched/batch.py`` +
+``ops/tickloop.py`` ragged helpers).
+
+The contract under test: mixed-horizon ``fused_tick_run`` requests —
+spans whose K tick buckets and/or B slot buckets differ — merge into one
+(K′, B′) = (max K, max B) device program and each demuxed result is
+**bit-identical** to the request's own solo dispatch (and so to the
+sequential per-tick referee).  Plus the fragmentation regression pair:
+the PR-15 exact-shape path splits a mixed-horizon flush into per-shape
+slivers (metered as ``mesh_fallback_mixed_shapes`` on a mesh), the
+ragged path rides one dispatch.
+
+Quick tier-1 smalls here; the full policy × phase-2 × live × K-mix
+sweep is slow-marked.  The serve-level mixed-horizon soak at the bottom
+diffs the ragged service bit-identically against the unbatched per-tick
+referee — the CI smoke-lane entry.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from pivot_tpu.ops.tickloop import (
+    RAGGED_AXES,
+    RAGGED_INVARIANT,
+    fused_tick_run,
+    ragged_span_pad,
+    ragged_span_signature,
+    ragged_span_trim,
+    reference_tick_run,
+    span_bucket,
+)
+from pivot_tpu.parallel.mesh import build_hybrid_mesh
+from pivot_tpu.sched.batch import DispatchBatcher
+
+_H, _Z = 16, 3
+
+_CONFIGS = {
+    "opportunistic": dict(policy="opportunistic"),
+    "first_fit": dict(policy="first-fit", strict=False),
+    "first_fit_decreasing": dict(
+        policy="first-fit", strict=False, decreasing=True
+    ),
+    "best_fit": dict(policy="best-fit"),
+    "cost_aware_ff": dict(policy="cost-aware", bin_pack="first-fit",
+                          sort_tasks=True),
+    "cost_aware_bf_decay": dict(policy="cost-aware", bin_pack="best-fit",
+                                host_decay=True),
+}
+
+_QUICK_CONFIGS = ("opportunistic", "first_fit_decreasing", "cost_aware_ff")
+
+
+def _staged_span(config_kw, n_ticks, B, H=_H, live=None, seed=0,
+                 avail=None):
+    """One ``place_span``-shaped request staged host-side: ``(args,
+    arr_kw, static_kw)`` split exactly like ``_call_kernel`` does (arrays
+    vs statics), buckets at (span_bucket(n_ticks), B)."""
+    K = span_bucket(n_ticks)
+    rng = np.random.default_rng(seed)
+    if avail is None:
+        avail = rng.uniform(1, 6, (_H, 4))[:H]
+    dem = rng.uniform(0.3, 2.5, (B, 4))
+    arrive = np.zeros(B, np.int32)
+    arrive[B - 6:B - 3] = min(2, max(n_ticks - 1, 0))
+    arrive[B - 3:] = min(5, max(n_ticks - 1, 0))
+    kw = dict(config_kw)
+    if kw["policy"] == "opportunistic":
+        kw["uniforms"] = rng.random((K, B))
+    if kw.get("decreasing") or kw.get("sort_tasks"):
+        kw["sort_norm"] = np.sqrt((dem * dem).sum(1))
+    if kw["policy"] == "cost-aware":
+        kw.update(
+            cost_zz=rng.uniform(0.01, 0.2, (_Z, _Z)),
+            bw_zz=rng.uniform(50, 500, (_Z, _Z)),
+            host_zone=rng.integers(0, _Z, H).astype(np.int32),
+            base_task_counts=rng.integers(0, 3, H).astype(np.int32),
+            anchor_zone=rng.integers(0, _Z, B).astype(np.int32),
+            bucket_id=rng.integers(0, 5, B).astype(np.int32),
+        )
+    if live is not None:
+        kw["live"] = live
+    args = (avail, dem, arrive, np.int32(n_ticks))
+    arr_kw = {k: v for k, v in kw.items() if hasattr(v, "shape")}
+    static_kw = {k: v for k, v in kw.items() if not hasattr(v, "shape")}
+    static_kw["n_ticks"] = K
+    return args, arr_kw, static_kw
+
+
+def _run_span(args, arr_kw, static_kw):
+    return fused_tick_run(*args, **arr_kw, **static_kw)
+
+
+def _assert_span_equal(a, b, label=""):
+    np.testing.assert_array_equal(
+        np.asarray(a.placements), np.asarray(b.placements), label
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.n_ready), np.asarray(b.n_ready), label
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.n_placed), np.asarray(b.n_placed), label
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.stackpos), np.asarray(b.stackpos), label
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.avail), np.asarray(b.avail), label
+    )
+    assert int(a.ticks_run) == int(b.ticks_run), label
+    assert int(a.n_stack_final) == int(b.n_stack_final), label
+
+
+def _assert_pad_parity(config_kw, n_ticks, B, k2, b2, live=None, seed=0,
+                       check_reference=True, phase2="auto"):
+    """Solo (K, B) dispatch == padded (K′, B′) dispatch trimmed back —
+    the inert-tail contract, plus the sequential referee."""
+    kw = dict(config_kw, phase2=phase2)
+    args, arr_kw, static_kw = _staged_span(kw, n_ticks, B, live=live,
+                                           seed=seed)
+    native = _run_span(args, arr_kw, static_kw)
+    K0, B0 = static_kw["n_ticks"], B
+    pargs, parr_kw = ragged_span_pad(args, arr_kw, k2, b2)
+    padded = _run_span(pargs, parr_kw, dict(static_kw, n_ticks=k2))
+    trimmed = ragged_span_trim(padded, K0, B0)
+    _assert_span_equal(trimmed, native, f"{config_kw} K{K0}->{k2} "
+                                        f"B{B0}->{b2}")
+    if check_reference:
+        # The referee simulates exactly the TRUE horizon (fused rows
+        # past it are −1 no-ops by the SpanResult tail contract).
+        ref_p, _nr, _np_, ref_avail = reference_tick_run(
+            args[0], args[1], args[2], n_ticks,
+            **{k: v for k, v in {**arr_kw, **static_kw}.items()
+               if k != "n_ticks"},
+        )
+        np.testing.assert_array_equal(
+            np.asarray(trimmed.placements)[:n_ticks], ref_p
+        )
+        np.testing.assert_array_equal(np.asarray(trimmed.avail), ref_avail)
+
+
+# -- repack parity ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("config", _QUICK_CONFIGS)
+def test_ragged_pad_trim_parity_quick(config):
+    """Tier-1: padding a span up to a larger (K′, B′) bucket and slicing
+    the result back is bit-identical to the solo dispatch AND the
+    sequential per-tick referee."""
+    _assert_pad_parity(_CONFIGS[config], n_ticks=3, B=8, k2=16, b2=32)
+
+
+def test_ragged_pad_trim_live_mask_quick():
+    live = np.ones(_H, bool)
+    live[3] = live[10] = False
+    _assert_pad_parity(
+        _CONFIGS["cost_aware_ff"], n_ticks=6, B=8, k2=8, b2=8, live=live
+    )
+    _assert_pad_parity(
+        _CONFIGS["first_fit"], n_ticks=2, B=32, k2=4, b2=32, live=live
+    )
+
+
+def test_ragged_signature_merges_only_span_shapes():
+    """The coalescing key: same config at different (K, B) buckets →
+    same signature; different policy/static config or host axis →
+    different signature; a non-span layout → None."""
+    a1, k1, s1 = _staged_span(_CONFIGS["first_fit"], 3, 8)
+    a2, k2, s2 = _staged_span(_CONFIGS["first_fit"], 11, 32, seed=1)
+    assert ragged_span_signature(a1, k1, s1) == \
+        ragged_span_signature(a2, k2, s2)
+    a3, k3, s3 = _staged_span(_CONFIGS["best_fit"], 3, 8)
+    assert ragged_span_signature(a3, k3, s3) != \
+        ragged_span_signature(a1, k1, s1)
+    assert ragged_span_signature(a1[:2], k1, s1) is None
+    assert ragged_span_signature(a1, {"bogus_kw": a1[0]}, s1) is None
+
+
+def test_ragged_axis_tables_cover_span_operands():
+    """Every array operand of ``fused_tick_run`` is classified by the
+    ragged axis tables (K/B-padded or invariant) — a new span operand
+    that isn't classified would silently fall off the ragged path."""
+    import inspect
+
+    sig = inspect.signature(fused_tick_run)
+    array_knobs = {
+        n for n, p in sig.parameters.items()
+        if p.kind is p.KEYWORD_ONLY and p.default is None
+    }
+    covered = set(RAGGED_AXES) | set(RAGGED_INVARIANT)
+    assert array_knobs == covered
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("config", sorted(_CONFIGS))
+@pytest.mark.parametrize("kmix", [(1, 4), (2, 16), (3, 8), (7, 32)])
+@pytest.mark.parametrize("phase2", ["scan", "slim", 4])
+def test_ragged_pad_parity_sweep_full(config, kmix, phase2):
+    """Slow full sweep: every policy config × K mixes × phase-2 modes ×
+    live masks, each padded shape held to its solo dispatch and the
+    referee."""
+    n_ticks, k2 = kmix
+    live = np.ones(_H, bool)
+    live[5] = False
+    for lv in (None, live):
+        for b0, b2 in ((8, 32), (32, 32)):
+            _assert_pad_parity(
+                _CONFIGS[config], n_ticks=n_ticks, B=b0,
+                k2=k2, b2=b2, live=lv, seed=n_ticks, phase2=phase2,
+            )
+
+
+# -- batcher merge + fragmentation regression -------------------------------
+
+
+def _dispatch_pair(batcher, reqs):
+    """Run two span requests through the batcher from two slot threads;
+    returns their results in slot order."""
+    clients = [batcher.client() for _ in reqs]
+    out = [None] * len(reqs)
+    errs = []
+
+    def work(i):
+        try:
+            out[i] = clients[i].dispatch(fused_tick_run, *reqs[i])
+        except BaseException as exc:  # noqa: BLE001 — surface in test
+            errs.append(exc)
+        finally:
+            clients[i].close()
+
+    threads = [
+        threading.Thread(target=work, args=(i,), daemon=True)
+        for i in range(len(reqs))
+    ]
+    for t in threads:
+        t.start()
+    batcher.serve()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    return out
+
+
+def _mixed_requests():
+    r1 = _staged_span(_CONFIGS["cost_aware_ff"], 3, 8, seed=3)
+    r2 = _staged_span(_CONFIGS["cost_aware_ff"], 11, 32, seed=4)
+    return [r1, r2]
+
+
+def test_ragged_batcher_merges_mixed_horizons():
+    """Two co-pending spans at different (K, B) buckets ride ONE device
+    call when ragged is on, each result bit-identical to its solo
+    dispatch."""
+    reqs = _mixed_requests()
+    solo = [_run_span(*r) for r in reqs]
+    batcher = DispatchBatcher(2, ragged=True)
+    out = _dispatch_pair(batcher, reqs)
+    for o, s in zip(out, solo):
+        _assert_span_equal(o, s)
+    assert batcher.stats["ragged_merges"] == 1
+    assert batcher.stats["ragged_rows"] == 2
+    assert batcher.stats["ragged_pad_cells"] > 0
+    assert batcher.stats["device_calls"] == 1
+    assert batcher.stats["coalesced"] == 2
+
+
+def test_ragged_off_pins_fragmentation():
+    """The PR-15 regression pin: with ragged off the same mixed-horizon
+    flush fragments into one device call per shape (results still
+    bit-identical — fragmentation is a throughput bug, not a
+    correctness bug)."""
+    reqs = _mixed_requests()
+    solo = [_run_span(*r) for r in reqs]
+    batcher = DispatchBatcher(2, ragged=False)
+    out = _dispatch_pair(batcher, reqs)
+    for o, s in zip(out, solo):
+        _assert_span_equal(o, s)
+    assert batcher.stats["ragged_merges"] == 0
+    assert batcher.stats["device_calls"] == 2
+    assert batcher.stats["coalesced"] == 0
+
+
+def test_ragged_mesh_flush_rides_mesh_where_sameshape_falls_back():
+    """THE regression flip on the 2-D mesh: a mixed-horizon flush that
+    the exact-shape path degrades to per-shape single-device slivers
+    (metered ``mesh_fallback_mixed_shapes``) rides the mesh as one
+    merged dispatch under ragged — ``mesh_fallbacks`` strictly lower,
+    same bits."""
+    mesh = build_hybrid_mesh(host_parallel=2)
+    reqs = _mixed_requests()
+    solo = [_run_span(*r) for r in reqs]
+
+    frag = DispatchBatcher(2, mesh=mesh, ragged=False)
+    out = _dispatch_pair(frag, reqs)
+    for o, s in zip(out, solo):
+        _assert_span_equal(o, s)
+    assert frag.stats["mesh_fallbacks"] == 2
+    assert frag.stats["mesh_fallback_mixed_shapes"] == 2
+    assert frag.stats["mesh_dispatches"] == 0
+
+    merged = DispatchBatcher(2, mesh=mesh, ragged=True)
+    out = _dispatch_pair(merged, reqs)
+    for o, s in zip(out, solo):
+        _assert_span_equal(o, s)
+    assert merged.stats["mesh_fallbacks"] == 0
+    assert merged.stats["mesh_dispatches"] == 1
+    assert merged.stats["ragged_merges"] == 1
+    assert merged.stats["mesh_fallbacks"] < frag.stats["mesh_fallbacks"]
+
+
+def test_ragged_same_shape_flush_untouched():
+    """Same-shape co-pending spans take the exact-key path unchanged —
+    the repack is a no-op (no trim, no ragged counters)."""
+    reqs = [
+        _staged_span(_CONFIGS["first_fit"], 5, 8, seed=7),
+        _staged_span(_CONFIGS["first_fit"], 5, 8, seed=8),
+    ]
+    solo = [_run_span(*r) for r in reqs]
+    batcher = DispatchBatcher(2, ragged=True)
+    out = _dispatch_pair(batcher, reqs)
+    for o, s in zip(out, solo):
+        _assert_span_equal(o, s)
+    assert batcher.stats["ragged_merges"] == 0
+    assert batcher.stats["device_calls"] == 1
+
+
+def test_ragged_zero_recompiles_after_warmup():
+    """The K-bucket ladder bound: after one warm-up merge at (K′, B′),
+    a second mixed flush landing in the same merged bucket compiles
+    nothing — the compile-cache key is the bucket, never the true
+    horizon mix."""
+    from pivot_tpu.utils.compile_counter import count_compiles
+
+    warm = _mixed_requests()
+    batcher = DispatchBatcher(2, ragged=True)
+    _dispatch_pair(batcher, warm)
+
+    again = [
+        _staged_span(_CONFIGS["cost_aware_ff"], 2, 8, seed=9),
+        _staged_span(_CONFIGS["cost_aware_ff"], 9, 32, seed=10),
+    ]
+    batcher2 = DispatchBatcher(2, ragged=True)
+    with count_compiles() as counter:
+        out = _dispatch_pair(batcher2, again)
+    assert counter.compiles == 0 and counter.traces == 0, (
+        counter.compiles, counter.traces,
+    )
+    solo = [_run_span(*r) for r in again]
+    for o, s in zip(out, solo):
+        _assert_span_equal(o, s)
+
+
+# -- serve-level mixed-horizon soak vs the per-tick referee -----------------
+
+
+def _serve_arm(ragged, fuse, n_jobs=12, rate=2.0, sessions=3):
+    from pivot_tpu.serve import (
+        ServeDriver,
+        ServeSession,
+        poisson_arrivals,
+        synthetic_app_factory,
+    )
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+
+    reset_ids()
+    pool = [
+        ServeSession(
+            f"s{g}",
+            build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            make_policy(PolicyConfig(
+                name="cost-aware", device="tpu", bin_pack="first-fit",
+                sort_tasks=True, sort_hosts=True, adaptive=False,
+            )),
+            seed=0,
+            fuse_spans=fuse,
+        )
+        for g in range(sessions)
+    ]
+    driver = ServeDriver(
+        pool, queue_depth=64, backpressure="shed", flush_after=0.05,
+        ragged=ragged,
+    )
+    report = driver.run(poisson_arrivals(
+        rate=rate, n_jobs=n_jobs, seed=7,
+        make_app=synthetic_app_factory(seed=11),
+    ))
+    placements = []
+    for s in pool:
+        for app in s._injected:
+            for group in app.groups:
+                for task in group.tasks:
+                    placements.append((app.id, task.id, task.placement))
+    return sorted(placements), report, pool
+
+
+def test_ragged_serve_soak_bit_identical_to_referee():
+    """Tiny mixed-horizon soak (CI smoke-lane entry): the same seeded
+    stream served with ragged span batching vs the unbatched per-tick
+    referee yields bit-identical final placements, while the ragged arm
+    actually fused spans."""
+    p_ragged, rep_ragged, pool = _serve_arm(True, "slo")
+    p_ref, _rep_ref, _pool_ref = _serve_arm(False, False)
+    assert p_ragged == p_ref
+    span_activity = sum(
+        s.summary()["span_stats"]["fused_spans"]
+        + s.summary()["span_stats"]["ff_ticks"]
+        for s in pool
+    )
+    assert span_activity > 0
+    assert rep_ragged["batcher"]["dispatches"] > 0

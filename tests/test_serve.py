@@ -271,7 +271,9 @@ def test_slo_snapshot_schema_has_dispatch_mix():
     assert set(SloMeter.DISPATCH_KEYS) == {
         "runs", "dispatches", "device_calls", "coalesced", "max_group",
         "deadline_flushes", "single_fast_path", "mesh_dispatches",
-        "mesh_fallbacks",
+        "mesh_fallbacks", "mesh_fallback_unshardable",
+        "mesh_fallback_mixed_shapes", "mesh_fallback_indivisible",
+        "ragged_merges", "ragged_rows", "ragged_pad_cells",
         "respawns",
         "retired_slots",
     }

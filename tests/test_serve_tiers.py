@@ -496,7 +496,9 @@ def test_bench_serve_tiers_smoke():
         assert set(arm["dispatch"]) == {
             "runs", "dispatches", "device_calls", "coalesced",
             "max_group", "deadline_flushes", "single_fast_path",
-            "mesh_dispatches", "mesh_fallbacks", "respawns",
+            "mesh_dispatches", "mesh_fallbacks", "mesh_fallback_unshardable",
+        "mesh_fallback_mixed_shapes", "mesh_fallback_indivisible",
+        "ragged_merges", "ragged_rows", "ragged_pad_cells", "respawns",
             "retired_slots",
         }
     assert "scale_events" in row["autoscaled"]

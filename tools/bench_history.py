@@ -121,8 +121,10 @@ TRACKED: Tuple[Metric, ...] = (
         lower_better=False, kind="rate",
         # Generation wall includes the host-side optimizer update and
         # per-candidate reductions, which ride box load like the serve
-        # rows do; phase-in: absent from pre-round-16 histories, so the
-        # gate engages once the first record carries it.
+        # rows do.  Gated as of round 18: the committed
+        # ``data/bench/ci_baseline.jsonl`` carries records with this
+        # row, so the gate fires (not notes) on fingerprint-matched
+        # boxes.
         rel_floor=25.0,
     ),
     Metric(
@@ -140,9 +142,22 @@ TRACKED: Tuple[Metric, ...] = (
         lower_better=False, kind="rate",
         # The round-17 2-D serving arm (batching × sharding + slo
         # spans) at 100× the PR-2 rate — same threaded-soak load
-        # sensitivity as serve_tiers; phase-in: absent from
-        # pre-round-17 histories, so the gate notes (not fires) until
-        # data/bench/ci_baseline.jsonl carries rows with it.
+        # sensitivity as serve_tiers.  Gated as of round 18: the
+        # committed baseline carries records with this row, so the
+        # gate fires (not notes) on fingerprint-matched boxes.
+        rel_floor=30.0,
+    ),
+    Metric(
+        "serve_ragged_dps",
+        ("serve_ragged", "ragged", "decisions_per_sec"),
+        lower_better=False, kind="rate",
+        # Round-18 ragged continuous batching: the mesh_2d stack with
+        # mixed-horizon spans padded into shared K-buckets (best-of-3
+        # dense passes, so the value is compile-stall-free); same
+        # threaded-soak load sensitivity as the other serve rows.
+        # Phase-in: absent from pre-round-18 histories, so the gate
+        # notes (not fires) until the baseline carries rows with it on
+        # the gating box's fingerprint.
         rel_floor=30.0,
     ),
 )
