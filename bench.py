@@ -1901,6 +1901,235 @@ def _bench_serve_ragged_in_child(timeout_s: int = 540) -> dict:
     return _run_row_in_child("PIVOT_BENCH_SERVE_RAGGED_CHILD", timeout_s)
 
 
+def _bench_serve_mpc(
+    n_jobs: int = 120,
+    rate: float = 0.4,
+    n_hosts: int = 8,
+    queue_depth: int = 24,
+    seed: int = 7,
+    pace: float = 120.0,
+) -> dict:
+    """Model-predictive serving row (round 19): the same seeded
+    mixed-tier chaos+market stream through a reactive fixed-pool driver
+    and an MPC-supervised one (``pivot_tpu/mpc/``: forecaster →
+    shape-pinned shadow rollouts → five-slot planner → background CEM
+    tuner → staged weight rollout).
+
+    What the row records:
+
+      * ``mpc.decisions_per_sec`` — serving throughput WITH the
+        controller, forecaster tap, and tuner thread attached (the
+        overhead question: the tracked metric in
+        ``tools/bench_history.py``, phase-in note-not-gate until the
+        committed baseline carries the row);
+      * ``overhead_ratio`` — mpc vs reactive decisions/s on the
+        identical stream;
+      * ``tuned_vs_default`` — cost-per-task of the best regret-gated
+        tuner vector relative to ``DEFAULT_WEIGHTS``, re-scored on a
+        FRESH scenario key (< 1.0 means the live tuner found a cheaper
+        scoring vector than the reactive incumbent — the subsystem's
+        headline);
+      * ``recompiles_after_warmup`` — the planner AND tuner dispatches
+        are compile-counted across the whole MPC arm after one warmup
+        of each program: shape-pinned rendering means every window's
+        variation (forecast rates, tier masks, scenario keys) enters
+        as data, so the count must be zero;
+      * ``tier0_lossless`` / ``parity`` — tier 0 sheds nothing in
+        either arm, and the MPC arm's admission outcome stays within a
+        whisker of the reactive baseline on the identical stream.
+    """
+    import jax
+
+    from pivot_tpu.infra.market import MarketSchedule
+    from pivot_tpu.mpc import MpcConfig
+    from pivot_tpu.mpc.forecast import TierForecast, render_env
+    from pivot_tpu.mpc.planner import enumerate_actions, plan
+    from pivot_tpu.mpc.tuner import tune_once
+    from pivot_tpu.sched.policies import CostAwarePolicy
+    from pivot_tpu.search.fitness import evaluate_rows
+    from pivot_tpu.search.weights import DEFAULT_WEIGHTS, PolicyWeights
+    from pivot_tpu.serve import (
+        ServeDriver,
+        ServeSession,
+        mixed_tier_arrivals,
+        synthetic_app_factory,
+    )
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.compile_counter import count_compiles
+    from pivot_tpu.utils.config import ClusterConfig, build_cluster
+
+    reset_ids()
+    template = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=11))
+    market = MarketSchedule.generate(template.meta, seed=11, horizon=240.0)
+    cfg = MpcConfig(
+        check_interval_s=0.02, horizon=200.0, tick=5.0, n_replicas=2,
+        env_apps=4, seed=5, min_observations=3, cooldown_s=0.0,
+        latency_weight=0.05, referee_every=4, g_min=1, g_max=3,
+        n_tiers=3, bucket_s=10.0,
+        tune=True, tune_interval_s=0.05, tune_generations=1,
+        tune_popsize=4, cluster=template, market=market,
+    )
+
+    # Warm BOTH compiled programs outside the counter — the planner's
+    # fused 5-slot dispatch and the tuner's CEM population dispatch —
+    # on the same template and pinned shapes the controller renders
+    # every window.
+    mix = (0.4, 0.3, 0.3)
+    warm_fc = TierForecast(
+        rates=tuple(rate * m for m in mix), mix=mix,
+        n_observed=12, window=60.0,
+    )
+    env, _, task_tiers = render_env(
+        warm_fc, cluster=template, market=market, horizon=cfg.horizon,
+        seed=cfg.seed, n_replicas=cfg.n_replicas, tick=cfg.tick,
+        n_apps=cfg.env_apps, redraw_faults=cfg.redraw_faults,
+    )
+    warm_menu = enumerate_actions(
+        1, g_min=cfg.g_min, g_max=cfg.g_max, incumbent=DEFAULT_WEIGHTS,
+        shed_tier=2,
+    )
+    plan(warm_menu, env, task_tiers, 1,
+         latency_weight=cfg.latency_weight,
+         key=jax.random.fold_in(jax.random.PRNGKey(cfg.seed), 0))
+    tune_once(env, incumbent=DEFAULT_WEIGHTS, seed=cfg.seed,
+              generations=cfg.tune_generations, popsize=cfg.tune_popsize)
+
+    def arm(label, mpc):
+        reset_ids()
+        make_app = synthetic_app_factory(
+            seed=seed, runtime=(60.0, 120.0), n_nodes=(2, 3),
+        )
+
+        def make_session(slabel):
+            return ServeSession(
+                slabel,
+                build_cluster(ClusterConfig(n_hosts=n_hosts, seed=1)),
+                CostAwarePolicy(),
+                seed=1,
+            )
+
+        driver = ServeDriver(
+            [make_session(f"{label}-0")],
+            queue_depth=queue_depth,
+            backpressure="shed",
+            tier_policies=("spill", "shed", "shed"),
+            preempt=True,
+            session_factory=make_session if mpc is not None else None,
+            mpc=mpc,
+        )
+        stream = mixed_tier_arrivals(
+            rate, n_jobs, mix, seed=seed, make_app=make_app,
+        )
+        t0 = time.perf_counter()
+        report = driver.run(stream, pace=pace)
+        wall = time.perf_counter() - t0
+        driver.audit(context=f"serve_mpc bench ({label})")
+        snap = report["slo"]
+        row = {
+            "wall_s": round(wall, 3),
+            "decisions": snap["counters"]["decisions"],
+            "decisions_per_sec": round(
+                snap["counters"]["decisions"] / max(wall, 1e-9), 1
+            ),
+            "completed": snap["counters"]["completed"],
+            "shed": snap["counters"]["shed"],
+            "tier0_shed": snap["tiers"]["0"]["counters"]["shed"],
+            "pool_final": len(driver.sessions),
+        }
+        return driver, report, row
+
+    _, report_r, reactive = arm("re", None)
+    with count_compiles() as counter:
+        driver_m, report_m, mpc_row = arm("mp", cfg)
+
+    mpc = report_m["mpc"] or {}
+    mpc_row.update(
+        {
+            "rounds": int(mpc.get("rounds", 0)),
+            "plans": int(mpc.get("plans", 0)),
+            "disabled": bool(mpc.get("disabled", False)),
+            "n_observed": int(
+                (mpc.get("forecast") or {}).get("n_observed", 0)
+            ),
+            "actions": _count_mpc_actions(mpc.get("events") or []),
+            "tuner": mpc.get("tuner"),
+            "rollout": {
+                k: (mpc.get("rollout") or {}).get(k)
+                for k in ("promotions", "rollbacks", "stage")
+            },
+        }
+    )
+
+    # The headline: the soak's own tuner output vs the reactive
+    # incumbent, re-scored on a fresh scenario key neither the tuner
+    # nor the planner ever drew.
+    tuned_vs_default = None
+    results = list(driver_m._mpc.tuner.results) if driver_m._mpc else []
+    eligible = [r.weights for r in results if r.eligible]
+    if eligible:
+        W = PolicyWeights.stack(eligible + [DEFAULT_WEIGHTS])
+        scores, _ = evaluate_rows(
+            W, env, key=jax.random.PRNGKey(1234), backend="rollout",
+        )
+        scores = [float(s) for s in scores]
+        tuned_vs_default = round(
+            min(scores[:-1]) / max(scores[-1], 1e-9), 4
+        )
+
+    c_r = report_r["slo"]["counters"]
+    c_m = report_m["slo"]["counters"]
+    return {
+        "jobs": n_jobs,
+        "arrival_rate": rate,
+        "h": n_hosts,
+        "pace": pace,
+        "tier_mix": list(mix),
+        "reactive": reactive,
+        "mpc": mpc_row,
+        "overhead_ratio": round(
+            mpc_row["decisions_per_sec"]
+            / max(reactive["decisions_per_sec"], 1e-9), 3
+        ),
+        "tuned_vs_default": tuned_vs_default,
+        "tuned_beats_default": (
+            tuned_vs_default is not None and tuned_vs_default < 1.0
+        ),
+        "tier0_lossless": (
+            reactive["tier0_shed"] == 0 and mpc_row["tier0_shed"] == 0
+        ),
+        "parity": (
+            abs(c_m["completed"] - c_r["completed"]) <= 4
+            and c_m["shed"] <= c_r["shed"] + 4
+        ),
+        "recompiles_after_warmup": int(counter.compiles),
+        "retraces_after_warmup": int(counter.traces),
+    }
+
+
+def _count_mpc_actions(events) -> dict:
+    counts: dict = {}
+    for evt in events:
+        a = evt.get("action", "?")
+        counts[a] = counts.get(a, 0) + 1
+    return counts
+
+
+def _serve_mpc_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SERVE_MPC_CHILD=1``): run the
+    serve_mpc row and print ONE JSON line.  Child-isolated like every
+    serve row — the MPC arm starts controller and tuner threads that
+    must never share a PJRT client with the parent's headline pass."""
+    jax = _child_backend_setup()
+    row = _bench_serve_mpc()
+    row["backend"] = jax.default_backend()
+    print(json.dumps(row), flush=True)
+
+
+def _bench_serve_mpc_in_child(timeout_s: int = 540) -> dict:
+    """Parent side of the serve_mpc row — see ``_run_row_in_child``."""
+    return _run_row_in_child("PIVOT_BENCH_SERVE_MPC_CHILD", timeout_s)
+
+
 # -- shard_place row: pod-scale host-sharded placement (ops/shard.py) -------
 #
 # Weak-scaling protocol: per-shard host count H0 held fixed while the
@@ -2303,7 +2532,7 @@ def main() -> None:
         known_rows = {
             "headline", "two_phase", "grid_batched", "fused_tick",
             "serve_stream", "serve_tiers", "serve_sharded",
-            "serve_ragged", "shard_place",
+            "serve_ragged", "serve_mpc", "shard_place",
             "spot_survival", "policy_search", "obs_overhead",
             "profiler_overhead", "cost_attribution", "saturated",
         }
@@ -2333,6 +2562,9 @@ def main() -> None:
         return
     if os.environ.get("PIVOT_BENCH_SERVE_RAGGED_CHILD"):
         _serve_ragged_child()
+        return
+    if os.environ.get("PIVOT_BENCH_SERVE_MPC_CHILD"):
+        _serve_mpc_child()
         return
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
@@ -2446,6 +2678,10 @@ def main() -> None:
     )
     serve_ragged = (
         _bench_serve_ragged_in_child() if _row_on("serve_ragged")
+        else skipped
+    )
+    serve_mpc = (
+        _bench_serve_mpc_in_child() if _row_on("serve_mpc")
         else skipped
     )
     # Pod-scale sharded placement, also all-children (each arm pins its
@@ -2631,6 +2867,7 @@ def main() -> None:
         "serve_tiers": serve_tiers,
         "serve_sharded": serve_sharded,
         "serve_ragged": serve_ragged,
+        "serve_mpc": serve_mpc,
         "shard_place": shard_place,
         "spot_survival": spot_survival,
         "policy_search": policy_search,

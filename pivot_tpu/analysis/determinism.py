@@ -52,6 +52,13 @@ SCOPE = (
     # — so its optimizers and fitness plumbing live under the same lint
     # as the DES core (seeded generators only, no wall-clock reads).
     "pivot_tpu/search",
+    # Model-predictive serving (round 19): the SCORING half of the MPC
+    # loop — the forecaster's fit and the planner's fused action
+    # dispatch — must replay bit-for-bit (every actuation is auditable
+    # from its recorded inputs).  The controller/tuner/rollout threads
+    # do wall-clock pacing and stay outside, like serve/.
+    "pivot_tpu/mpc/forecast.py",
+    "pivot_tpu/mpc/planner.py",
 )
 
 _WALL_FNS = {

@@ -58,6 +58,7 @@ RULE = "backend-parity"
 KNOBS = frozenset({
     "live", "risk", "totals", "phase2", "strict", "uniforms",
     "bin_pack", "sort_hosts", "host_decay", "rt_bw_rows", "rt_bw_idx",
+    "score_exp",
 })
 
 #: Knobs tracked for the span-driver family.
@@ -65,7 +66,7 @@ SPAN_KNOBS = frozenset({
     "uniforms", "sort_norm", "anchor_zone", "bucket_id", "totals",
     "live", "risk_rows", "cost_stack", "cost_seg", "strict",
     "decreasing", "bin_pack", "sort_tasks", "sort_hosts", "host_decay",
-    "phase2",
+    "phase2", "score_exp",
 })
 
 _KERNELS = "pivot_tpu/ops/kernels.py"
@@ -79,8 +80,17 @@ _ROUTING_FILE = "pivot_tpu/sched/tpu.py"
 _REF_EXEMPT = frozenset({"phase2", "totals"})
 #: The Pallas kernels keep the whole tick in VMEM — no speculation
 #: (``totals``/``phase2``) and no live-bandwidth rows (per-tick host
-#: state a persistent kernel cannot hold).
-_PALLAS_EXEMPT = frozenset({"phase2", "totals", "rt_bw_rows", "rt_bw_idx"})
+#: state a persistent kernel cannot hold).  Learned score exponents are
+#: also out (the tile algebra hard-codes the reference shape);
+#: ``sched/tpu.py`` rejects ``use_pallas`` with non-default exponents.
+_PALLAS_EXEMPT = frozenset({
+    "phase2", "totals", "rt_bw_rows", "rt_bw_idx", "score_exp",
+})
+#: The sharded twins have not been threaded for learned exponents —
+#: ``enable_sharding`` rejects non-default ``score_exponents()`` at the
+#: policy layer (sched/tpu.py), so the gap is a declared decision, not
+#: a silent parity break.
+_SHARD_EXEMPT = frozenset({"score_exp"})
 
 #: family stem → {form name: (repo-relative file, exempt knobs)}.
 #: Registering a form here is a statement that its knob set matches the
@@ -107,8 +117,8 @@ MANIFEST: Dict[str, Dict[str, Tuple[str, FrozenSet[str]]]] = {
     "cost_aware": {
         "cost_aware_kernel_ref": (_KERNELS, _REF_EXEMPT),
         "cost_aware_impl": (_KERNELS, frozenset()),
-        "cost_aware_kernel_sharded": (_SHARD, frozenset()),
-        "cost_aware_kernel_sharded_batched": (_SHARD, frozenset()),
+        "cost_aware_kernel_sharded": (_SHARD, _SHARD_EXEMPT),
+        "cost_aware_kernel_sharded_batched": (_SHARD, _SHARD_EXEMPT),
         "cost_aware_pallas": (_PALLAS, _PALLAS_EXEMPT),
         "cost_aware_pallas_batched": (_PALLAS, _PALLAS_EXEMPT),
     },
@@ -120,17 +130,19 @@ MANIFEST: Dict[str, Dict[str, Tuple[str, FrozenSet[str]]]] = {
 SPAN_MANIFEST: Dict[str, Tuple[str, FrozenSet[str]]] = {
     "fused_tick_run": (_TICKLOOP, frozenset()),
     "reference_tick_run": (_TICKLOOP, frozenset()),
-    "sharded_fused_tick_run": (_SHARD, frozenset()),
-    "sharded_batched_tick_run": (_SHARD, frozenset()),
+    "sharded_fused_tick_run": (_SHARD, _SHARD_EXEMPT),
+    "sharded_batched_tick_run": (_SHARD, _SHARD_EXEMPT),
 }
 
 #: Knobs the routing layer must forward per family (∩ the family's
 #: actual knob union — a family without ``totals`` isn't required to
 #: route it).
-ROUTING_KNOBS = frozenset({"live", "risk", "totals", "phase2"})
+ROUTING_KNOBS = frozenset({"live", "risk", "totals", "phase2", "score_exp"})
 #: Market/quarantine operands ``place_span``/``_span_kw``/
 #: ``_span_market_kw`` must stage for the span drivers.
-SPAN_ROUTING_KNOBS = frozenset({"live", "risk_rows", "cost_stack", "cost_seg"})
+SPAN_ROUTING_KNOBS = frozenset({
+    "live", "risk_rows", "cost_stack", "cost_seg", "score_exp",
+})
 _SPAN_ROUTING_FUNCS = ("place_span", "_span_kw", "_span_market_kw")
 
 #: Jitted wrappers the routing layer references for each family.

@@ -89,6 +89,20 @@ GUARDS: Dict[str, Dict[str, dict]] = {
             "exempt": ("__init__", "report", "audit"),
         },
     },
+    "pivot_tpu/mpc/controller.py": {
+        # Same shape as the autoscaler below: the MPC controller owns
+        # no guarded state (its forecaster/tuner/rollout lock or
+        # thread-confine internally; every pool mutation goes through
+        # ServeDriver methods).  The entry puts the file in scope so
+        # foreign reads of driver fields (``driver._stop``) are
+        # checked and its suppressions staleness-tracked.
+        "MpcController": {
+            "lock": None,
+            "fields": (),
+            "held": (),
+            "exempt": ("__init__",),
+        },
+    },
     "pivot_tpu/serve/autoscale.py": {
         # The autoscaler owns no guarded state of its own: every pool
         # mutation goes through ServeDriver methods (which take the

@@ -636,6 +636,38 @@ def parse_args(argv=None):
     srv.add_argument("--slo-p99-ms", type=float, default=50.0,
                      help="tier-0 p99 decision-latency target (ms) the "
                           "autoscaler sizes the pool against")
+    srv.add_argument("--mpc", action="store_true",
+                     help="model-predictive serving (pivot_tpu/mpc): a "
+                          "control thread forecasts the arrival stream, "
+                          "scores hold/grow/drain/shed/weight actions "
+                          "with seeded shadow rollouts of the predicted "
+                          "horizon (ONE fused dispatch per window), "
+                          "executes the predicted best, and promotes "
+                          "tuned PolicyWeights through a canary→fleet "
+                          "rollout with automatic SLO rollback")
+    srv.add_argument("--mpc-pool", default="", metavar="GMIN:GMAX",
+                     help="pool bounds the MPC planner moves between "
+                          "(e.g. '1:8'); empty pins the pool at the "
+                          "launch size (plan actions limited to "
+                          "hold/shed/weights)")
+    srv.add_argument("--mpc-horizon", type=float, default=300.0,
+                     help="shadow-rollout horizon (sim seconds) each "
+                          "decision window predicts over")
+    srv.add_argument("--mpc-interval-ms", type=float, default=50.0,
+                     help="wall milliseconds between MPC decision "
+                          "windows")
+    srv.add_argument("--mpc-replicas", type=int, default=4,
+                     help="seeded shadow rollouts per candidate action")
+    srv.add_argument("--mpc-max-regret", type=float, default=1.0,
+                     help="oracle regret gate ($ from the proven "
+                          "optimum) a tuned weight vector must pass "
+                          "before canary eligibility")
+    srv.add_argument("--mpc-dry-run", action="store_true",
+                     help="score and record every MPC window but never "
+                          "actuate — the observe-only A/B arm")
+    srv.add_argument("--mpc-no-tune", action="store_true",
+                     help="disable the background CEM weight tuner "
+                          "(plan pool/shed actions only)")
     srv.add_argument("--trace-out", default="", metavar="PATH",
                      help="write the service's causal trace timeline "
                           "(every job's arrival→completion chain, "
@@ -691,6 +723,17 @@ def parse_args(argv=None):
             "--closed-loop (the trace/closed-loop jobs would be "
             "silently replaced)"
         )
+    if args.command == "serve":
+        if args.mpc and args.autoscale:
+            parser.error(
+                "--mpc and --autoscale are mutually exclusive: two "
+                "supervisors resizing the same pool would fight (the "
+                "MPC planner subsumes the autoscaler's grow/drain)"
+            )
+        if not args.mpc and (
+            args.mpc_pool or args.mpc_dry_run or args.mpc_no_tune
+        ):
+            parser.error("--mpc-* options require --mpc")
     if args.command == "serve" and args.device == "tpu":
         # Shared-dispatch serving needs deterministic routing, exactly
         # like --batch-runs: adaptive timing-based twin routing would
@@ -1625,6 +1668,34 @@ def run_serve_stream(args) -> dict:
         autoscale = AutoscaleConfig(
             g_min=g_min, g_max=g_max, slo_p99_s=args.slo_p99_ms / 1e3,
         )
+    mpc = None
+    if args.mpc:
+        from pivot_tpu.mpc import MpcConfig
+
+        if args.mpc_pool:
+            try:
+                mpc_min, mpc_max = (
+                    int(x) for x in args.mpc_pool.split(":")
+                )
+            except ValueError:
+                raise SystemExit(
+                    f"--mpc-pool wants GMIN:GMAX, got {args.mpc_pool!r}"
+                )
+        else:
+            mpc_min = mpc_max = args.sessions
+        tier_weights = _csv(args.tier_mix, float)
+        mpc = MpcConfig(
+            check_interval_s=args.mpc_interval_ms / 1e3,
+            horizon=args.mpc_horizon,
+            n_replicas=args.mpc_replicas,
+            seed=args.seed or 0,
+            g_min=mpc_min,
+            g_max=mpc_max,
+            n_tiers=max(len(tier_weights), 1) if tier_weights else 1,
+            max_regret=args.mpc_max_regret,
+            dry_run=args.mpc_dry_run,
+            tune=not args.mpc_no_tune,
+        )
     # Observability plane (round 14): --trace-out turns on causal task
     # tracing (zero-cost otherwise), --metrics-out attaches the unified
     # registry; the report then carries the metrics snapshot inline.
@@ -1653,8 +1724,11 @@ def run_serve_stream(args) -> dict:
         tier_policies=_csv(args.tier_policies, str),
         routing=args.routing.replace("-", "_"),
         preempt=args.preempt,
-        session_factory=make_session if autoscale else None,
+        session_factory=(
+            make_session if (autoscale or mpc) else None
+        ),
         autoscale=autoscale,
+        mpc=mpc,
         tracer=tracer,
         registry=registry,
         profiler=profiler,

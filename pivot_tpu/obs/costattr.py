@@ -153,7 +153,7 @@ def _b_fused_tick_run(o):
     args = (
         o["avail"], o["dem"], arrive, jnp.int32(K),
         None, None, None, None, None, None, None, None, None,
-        None, None, None, None,
+        None, None, None, None, None,
     )
     return _fused_tick_run, args, dict(
         policy="first-fit", n_ticks=K, strict=False, decreasing=False,

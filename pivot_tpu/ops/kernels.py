@@ -370,14 +370,23 @@ def _cost_aware_scan(
     rt_bw_rows,
     rt_bw_idx,
     risk=None,
+    score_exp=None,
 ):
+    if score_exp is not None and rt_bw_rows is not None:
+        raise ValueError(
+            "learned score exponents pow the static phase-1 bandwidth "
+            "table; realtime_bw rows bypass that table — the combination "
+            "is rejected at the policy layer (sched/tpu.py)"
+        )
     H = avail.shape[0]
     big = jnp.asarray(jnp.inf, avail.dtype)
     first_fit = bin_pack == "first-fit"
     base_counts = base_task_counts.astype(avail.dtype)
+    w_norm = None if score_exp is None else score_exp[2]
     # [Z, H] round-trip tables: anchor-zone z ↔ each host.
     cost_rt, bw_rt, _ = _ca_phase1(
-        cost_zz, bw_zz, host_zone, base_counts, prescale_decay=False
+        cost_zz, bw_zz, host_zone, base_counts, prescale_decay=False,
+        score_exp=score_exp,
     )
 
     def group_score(avail, cost_row, bw_row):
@@ -387,7 +396,10 @@ def _cost_aware_scan(
                 return risk
             return jnp.arange(H, dtype=avail.dtype)  # identity host order
         decay = jnp.maximum(base_counts, 1.0) if host_decay else 1.0
-        return _risk_score(cost_row * decay / (_norms(avail) * bw_row), risk)
+        norms = _norms(avail)
+        if w_norm is not None:
+            norms = norms ** w_norm
+        return _risk_score(cost_row * decay / (norms * bw_row), risk)
 
     def body(carry, x):
         avail, frozen_score, extra = carry
@@ -407,6 +419,8 @@ def _cost_aware_scan(
         else:
             score = frozen_score  # unused carry for best-fit
             residual = _norms(avail - demand)
+            if w_norm is not None:
+                residual = residual ** w_norm
             decay = (
                 jnp.maximum(base_counts + extra.astype(avail.dtype), 1.0)
                 if host_decay
@@ -456,6 +470,7 @@ def cost_aware_kernel_ref(
     rt_bw_idx=None,
     live=None,
     risk=None,
+    score_exp=None,
 ):
     """The PIVOT cost-aware placement (ref cost_aware.py:28-127), fused —
     the reference-shaped scan, retained as the parity oracle.
@@ -489,7 +504,7 @@ def cost_aware_kernel_ref(
     p, a = _cost_aware_scan(
         avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
         host_zone, base_task_counts, bin_pack, sort_hosts, host_decay,
-        rt_bw_rows, rt_bw_idx, risk,
+        rt_bw_rows, rt_bw_idx, risk, score_exp,
     )
     return p, restore(a)
 
@@ -504,16 +519,28 @@ def _scan_swap(body, avail, xs):
 # ---------------------------------------------------------------------------
 
 
-def _ca_phase1(cost_zz, bw_zz, host_zone, base_counts, prescale_decay):
+def _ca_phase1(cost_zz, bw_zz, host_zone, base_counts, prescale_decay,
+               score_exp=None):
     """Cost-aware phase-1 tables for a host block: the ``[Z, H]``
     round-trip topology tables and the (optional) exact host-decay
     prescale of the cost table.  ``host_zone``/``base_counts`` may be the
     full ``[H]`` vectors or one shard's contiguous block — every output
     element depends only on its own host column, so the sharded kernels
     (``ops/shard.py``) call this on their local block and get the exact
-    same elements the single-device kernels compute, bit for bit."""
+    same elements the single-device kernels compute, bit for bit.
+
+    ``score_exp`` is the optional traced [3] exponent vector
+    ``(w_cost, w_bw, w_norm)`` of :class:`~pivot_tpu.search.weights.
+    PolicyWeights` — the cost/bw tables are powed HERE, once per
+    dispatch, so the per-step score sites stay pow-free; ``w_norm``
+    applies at the score sites (the norm is availability-dependent).
+    ``None`` keeps the traced program unchanged bit for bit (the
+    reference (1, 1, 1) shape never pays a ``pow``)."""
     cost_rt = cost_zz[:, host_zone] + cost_zz[host_zone, :].T
     bw_rt = bw_zz[:, host_zone] + bw_zz[host_zone, :].T
+    if score_exp is not None:
+        cost_rt = cost_rt ** score_exp[0]
+        bw_rt = bw_rt ** score_exp[1]
     if prescale_decay:
         num_rt = cost_rt * jnp.maximum(base_counts, 1.0)[None, :]
     else:
@@ -521,17 +548,25 @@ def _ca_phase1(cost_zz, bw_zz, host_zone, base_counts, prescale_decay):
     return cost_rt, bw_rt, num_rt
 
 
-def _ca_group_score(num_row, avail, bw_row):
-    """The cost-aware first-fit group score row ``num / (‖avail‖·bw)``
+def _ca_group_score(num_row, avail, bw_row, w_norm=None):
+    """The cost-aware first-fit group score row ``num / (‖avail‖^wₙ·bw)``
     over a host block — shared verbatim by the slim phase-2 body and the
-    sharded kernels so the two can never round differently."""
-    return num_row / (_norms(avail) * bw_row)
+    sharded kernels so the two can never round differently.  ``w_norm``
+    None = the reference shape (no ``pow`` traced)."""
+    norms = _norms(avail)
+    if w_norm is not None:
+        norms = norms ** w_norm
+    return num_row / (norms * bw_row)
 
 
-def _ca_best_fit_score(cost_row, avail, demand, decay, bw_row):
-    """The cost-aware best-fit per-task score ``cost·‖avail−d‖·decay/bw``
-    over a host block — shared like :func:`_ca_group_score`."""
+def _ca_best_fit_score(cost_row, avail, demand, decay, bw_row,
+                       w_norm=None):
+    """The cost-aware best-fit per-task score
+    ``cost·‖avail−d‖^wₙ·decay/bw`` over a host block — shared like
+    :func:`_ca_group_score`."""
     residual = _norms(avail - demand)
+    if w_norm is not None:
+        residual = residual ** w_norm
     return cost_row * residual * decay / bw_row
 
 
@@ -957,6 +992,7 @@ def cost_aware_impl(
     phase2="auto",
     live=None,
     risk=None,
+    score_exp=None,
 ):
     """The PIVOT cost-aware placement (ref cost_aware.py:28-127), two-phase
     form — argument contract as :func:`cost_aware_kernel_ref`, plus the
@@ -966,6 +1002,17 @@ def cost_aware_impl(
     ``risk`` (``score += risk``; the ``sort_hosts=False`` index order
     becomes lexicographic (risk, index)).  Bit-identical to the oracle
     in every mode.
+
+    ``score_exp`` — optional traced [3] ``(w_cost, w_bw, w_norm)``
+    exponent vector (``PolicyWeights.score_exponents()``): cost/bw pow
+    at phase 1 (:func:`_ca_phase1`), the norm/residual pow at the score
+    sites, matching ``sched/policies.py::CostAwarePolicy``'s learned
+    shape ``cost^w_c·decay / (‖·‖^w_n·bw^w_b)`` (first-fit) and
+    ``cost^w_c·‖·‖^w_n·decay / bw^w_b`` (best-fit).  ``None`` (the
+    reference (1, 1, 1) shape) traces the exact pre-existing program —
+    the bit-parity default.  Traced, not static: tuner-promoted weights
+    change values with zero recompiles.  Rejected with ``rt_bw_rows``
+    (realtime rows bypass the powed table).
 
     Phase-1 hoists here: the ``[Z, H]`` round-trip tables (already
     pre-scan), the host-decay prescale of the cost table (exact: the same
@@ -979,12 +1026,18 @@ def cost_aware_impl(
     (``ops/pallas_kernels.py``).
     """
     mode = _resolve_phase2(phase2)
+    if score_exp is not None and rt_bw_rows is not None:
+        raise ValueError(
+            "learned score exponents pow the static phase-1 bandwidth "
+            "table; realtime_bw rows bypass that table — the combination "
+            "is rejected at the policy layer (sched/tpu.py)"
+        )
     avail, restore = _apply_live(avail, live)
     if mode == "scan":
         p, a = _cost_aware_scan(
             avail, demands, valid, new_group, anchor_zone, cost_zz, bw_zz,
             host_zone, base_task_counts, bin_pack, sort_hosts, host_decay,
-            rt_bw_rows, rt_bw_idx, risk,
+            rt_bw_rows, rt_bw_idx, risk, score_exp,
         )
         return p, restore(a)
     B, H = demands.shape[0], avail.shape[0]
@@ -995,6 +1048,7 @@ def cost_aware_impl(
     dtype = avail.dtype
     base_counts = base_task_counts.astype(dtype)
     track_extra = (not first_fit) and host_decay
+    w_norm = None if score_exp is None else score_exp[2]
 
     # ---- phase 1 ----
     # Exact hoist of the group score's (cost_row * decay) product:
@@ -1002,6 +1056,7 @@ def cost_aware_impl(
     cost_rt, bw_rt, num_rt = _ca_phase1(
         cost_zz, bw_zz, host_zone, base_counts,
         first_fit and sort_hosts and host_decay,
+        score_exp=score_exp,
     )
     iota_h = jnp.arange(H, dtype=dtype)
     n_eff = _effective_len(valid)
@@ -1026,7 +1081,7 @@ def cost_aware_impl(
                         new_group[j],
                         lambda a: _risk_score(_ca_group_score(
                             num_rt[anchor_zone[j]], a,
-                            bw_row_at(anchor_zone[j], ri[j]),
+                            bw_row_at(anchor_zone[j], ri[j]), w_norm,
                         ), risk),
                         lambda a: frozen,
                         avail,
@@ -1046,7 +1101,7 @@ def cost_aware_impl(
                 )
                 per_task = _risk_score(_ca_best_fit_score(
                     cost_rt[anchor_zone[j]], avail, demand, decay,
-                    bw_row_at(anchor_zone[j], ri[j]),
+                    bw_row_at(anchor_zone[j], ri[j]), w_norm,
                 ), risk)
                 fit = _fits(avail, demand, strict=False) & valid_j
                 h = jnp.argmin(jnp.where(fit, per_task, big))
@@ -1094,7 +1149,10 @@ def cost_aware_impl(
 
             if sort_hosts:
                 row_spec = _risk_score(
-                    num_rt[az_e1] / (_norms(avail) * bw_row_at(az_e1, ri_e1)),
+                    _ca_group_score(
+                        num_rt[az_e1], avail, bw_row_at(az_e1, ri_e1),
+                        w_norm,
+                    ),
                     risk,
                 )
             elif risk is not None:
@@ -1120,8 +1178,9 @@ def cost_aware_impl(
             def recheck(a_pre, _ex):
                 if sort_hosts:
                     row_check = _risk_score(
-                        num_rt[az_e1] / (
-                            _norms(a_pre[e1c]) * bw_row_at(az_e1, ri_e1)
+                        _ca_group_score(
+                            num_rt[az_e1], a_pre[e1c],
+                            bw_row_at(az_e1, ri_e1), w_norm,
                         ),
                         risk,
                     )
@@ -1141,6 +1200,8 @@ def cost_aware_impl(
             cost_rows = cost_rt[az_c]                       # [C, H]
             bw_rows = bw_rt[az_c] if rt_bw_rows is None else rt_bw_rows[ri_c]
             resid0 = _norms(avail - dem_c[0][None, :])
+            if w_norm is not None:
+                resid0 = resid0 ** w_norm
             dec0 = jnp.maximum(base_counts + extra.astype(dtype), 1.0) \
                 if host_decay else 1.0
             row_spec = _risk_score(
@@ -1157,6 +1218,8 @@ def cost_aware_impl(
                 fit = jnp.all(a_pre >= dem_c[:, None, :], axis=2)
                 fit = fit & valid_c[:, None]
                 residual = _norms(a_pre - dem_c[:, None, :])
+                if w_norm is not None:
+                    residual = residual ** w_norm
                 decay = (
                     jnp.maximum(base_counts[None] + ex_pre.astype(dtype), 1.0)
                     if host_decay else 1.0

@@ -237,6 +237,7 @@ def _fused_tick_run_impl(
     risk_rows,
     cost_stack,
     cost_seg,
+    score_exp,
     *,
     policy: str,
     n_ticks: int,
@@ -326,6 +327,7 @@ def _fused_tick_run_impl(
                 totals=totals,
                 phase2=phase2,
                 risk=risk_k,
+                score_exp=score_exp,
             )
         row = jnp.full((B,), -1, jnp.int32).at[order].set(
             p_ord.astype(jnp.int32)
@@ -428,6 +430,7 @@ def fused_tick_run(
     risk_rows=None,
     cost_stack=None,
     cost_seg=None,
+    score_exp=None,
     strict: bool = False,
     decreasing: bool = False,
     bin_pack: str = "first-fit",
@@ -466,6 +469,11 @@ def fused_tick_run(
       cost_seg         [K] i32 per-tick segment index into ``cost_stack``
                                (``MarketSchedule.segment_indices`` of the
                                span grid — the per-span time-index row)
+      score_exp        [3]     span-constant learned score exponents
+                               ``(w_cost, w_bw, w_norm)`` for cost-aware
+                               (``PolicyWeights.score_exponents()``; or
+                               None — the reference (1, 1, 1) shape,
+                               traced program unchanged bit for bit)
 
     Static config mirrors the per-tick kernels (``strict``/``decreasing``
     for the VBP arms, ``bin_pack``/``sort_tasks``/``sort_hosts``/
@@ -492,6 +500,7 @@ def fused_tick_run(
         risk_rows,
         cost_stack,
         cost_seg,
+        score_exp,
         policy=policy,
         n_ticks=n_ticks,
         strict=strict,
@@ -524,6 +533,7 @@ def reference_tick_run(
     risk_rows=None,
     cost_stack=None,
     cost_seg=None,
+    score_exp=None,
     strict: bool = False,
     decreasing: bool = False,
     bin_pack: str = "first-fit",
@@ -635,6 +645,7 @@ def reference_tick_run(
                 sort_hosts=sort_hosts,
                 host_decay=host_decay,
                 totals=totals,
+                score_exp=score_exp,
                 **kw,
             )
         p_host = np.asarray(p_ord)
@@ -699,7 +710,7 @@ RAGGED_AXES = {
 #: like everything else, untouched by the repack.
 RAGGED_INVARIANT = frozenset({
     "cost_zz", "bw_zz", "host_zone", "base_task_counts", "totals",
-    "live", "cost_stack",
+    "live", "cost_stack", "score_exp",
 })
 
 

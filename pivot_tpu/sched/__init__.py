@@ -210,6 +210,28 @@ class Policy(LogMixin):
     def bind(self, scheduler: "GlobalScheduler") -> None:
         """Called once when attached to a scheduler (override to warm up)."""
 
+    def apply_weights(self, weights) -> None:
+        """Hot-swap the scoring-weight vector on a LIVE policy.
+
+        The promotion surface of model-predictive serving
+        (``pivot_tpu/mpc``): every concrete policy resolves its risk
+        term per :meth:`place` call (``policies.resolve_risk``), so
+        swapping the attributes here takes effect on the next decision
+        without re-binding or recompiling anything.  Subclasses that
+        cache derived scoring state (``_score_exp``) or own a CPU twin
+        override and extend this.
+        """
+        from pivot_tpu.search.weights import PolicyWeights
+
+        w = (
+            weights
+            if isinstance(weights, PolicyWeights)
+            else PolicyWeights.from_array(weights)
+        ).validate()
+        self.weights = w
+        self.risk_weight = w.risk_weight
+        self.rework_cost = w.rework_cost
+
 
 class LocalScheduler(LogMixin):
     """Per-application scheduler: DAG readiness tracking + submission pump.

@@ -427,6 +427,10 @@ class CostAwarePolicy(Policy):
         #: which is the default vector's bit-parity contract.
         self._score_exp = self.weights.score_exponents()
 
+    def apply_weights(self, weights) -> None:
+        super().apply_weights(weights)
+        self._score_exp = self.weights.score_exponents()
+
     # -- grouping --------------------------------------------------------
     def group_tasks(
         self, ctx: TickContext

@@ -273,6 +273,14 @@ class _DevicePolicyBase(Policy):
         # the device path for the rest of the process).
         self._warm_buckets: set = set()
 
+    def apply_weights(self, weights) -> None:
+        """Live weight promotion, forwarded to the CPU twin so kernel
+        and twin keep scoring from the same vector (adaptive routing and
+        per-tick fallback must not change decisions mid-promotion)."""
+        super().apply_weights(weights)
+        if self._cpu_twin is not None:
+            self._cpu_twin.apply_weights(self.weights)
+
     def bind(self, scheduler) -> None:
         self._scheduler = scheduler
         _ensure_live_backend()
@@ -1038,13 +1046,26 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         super().__init__(adaptive, phase2, degrade_after,
                          risk_weight, rework_cost, weights)
         assert bin_pack in ("first-fit", "best-fit")
-        if self.weights.score_exponents() is not None:
+        #: Learned score exponents (w_cost, w_bw, w_norm) or None at the
+        #: reference (1, 1, 1) shape — None keeps every existing
+        #: compiled program serving bit-identically (the kernels trace
+        #: no ``pow``); non-None rides the scan/two-phase/fused-span
+        #: kernels as a traced [3] operand, so tuner-promoted weights
+        #: (pivot_tpu/mpc) change values with ZERO recompiles.
+        self._score_exp = self.weights.score_exponents()
+        if self._score_exp is not None and realtime_bw:
             raise ValueError(
-                "the device scan kernels score with the reference "
-                "exponent shape — non-default w_cost/w_bw/w_norm are "
-                "served by the CPU policy (CostAwarePolicy(weights=...)) "
-                "or the ensemble estimator's score_params path; the "
-                "device arms consume the vector's risk dims only"
+                "learned score exponents pow the static phase-1 "
+                "bandwidth table; realtime_bw rows bypass that table — "
+                "score with the static topology (realtime_bw=False) or "
+                "the reference exponents"
+            )
+        if self._score_exp is not None and use_pallas:
+            raise ValueError(
+                "the Pallas kernel's tile algebra hard-codes the "
+                "reference exponent shape — learned w_cost/w_bw/w_norm "
+                "are served by the scan/two-phase kernels; drop "
+                "use_pallas=True"
             )
         if realtime_bw and use_pallas:
             raise ValueError(
@@ -1082,6 +1103,51 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
         )
         self._cpu_twin = self._grouper
 
+    def apply_weights(self, weights) -> None:
+        """Live promotion with the same guards the constructor enforces:
+        a promoted vector whose exponents depart the reference shape is
+        rejected on configurations the exponent operand has not been
+        threaded through (Pallas / realtime-bw / sharded / 2-D batched)
+        — rejecting beats silently serving the old exponents.  At the
+        reference shape (``score_exponents() is None``) every
+        configuration accepts the promotion."""
+        from pivot_tpu.search.weights import PolicyWeights
+
+        w = (
+            weights
+            if isinstance(weights, PolicyWeights)
+            else PolicyWeights.from_array(weights)
+        ).validate()
+        exps = w.score_exponents()
+        if exps is not None:
+            if self.realtime_bw:
+                raise ValueError(
+                    "cannot promote learned score exponents onto a "
+                    "realtime_bw policy — the exponents pow the static "
+                    "phase-1 bandwidth table"
+                )
+            if self.use_pallas:
+                raise ValueError(
+                    "cannot promote learned score exponents onto a "
+                    "Pallas-kernel policy — its tile algebra hard-codes "
+                    "the reference exponent shape"
+                )
+            if self._mesh is not None:
+                raise ValueError(
+                    "cannot promote learned score exponents onto a "
+                    "host-sharded policy (ops/shard.py exemption)"
+                )
+            if (
+                self._batch_client is not None
+                and getattr(self._batch_client, "mesh", None) is not None
+            ):
+                raise ValueError(
+                    "cannot promote learned score exponents onto a "
+                    "2-D-mesh-batched policy (ops/shard.py exemption)"
+                )
+        super().apply_weights(w)
+        self._score_exp = exps
+
     def enable_batching(self, client) -> None:
         if self.use_pallas:
             raise ValueError(
@@ -1089,7 +1155,27 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 "kernel); the Pallas kernel batches replicas on its own "
                 "sublane axis — drop use_pallas=True"
             )
+        if (
+            self._score_exp is not None
+            and getattr(client, "mesh", None) is not None
+        ):
+            raise ValueError(
+                "the 2-D coalesced-flush twins (ops/shard.py) have not "
+                "been threaded for learned score exponents — batch "
+                "through a mesh-free DispatchBatcher, or keep the "
+                "reference exponents"
+            )
         super().enable_batching(client)
+
+    def enable_sharding(self, mesh) -> None:
+        if self._score_exp is not None:
+            raise ValueError(
+                "the host-sharded kernels (ops/shard.py) have not been "
+                "threaded for learned score exponents (a declared "
+                "exemption in analysis/parity.py) — serve learned "
+                "exponents single-device, or keep the reference shape"
+            )
+        super().enable_sharding(mesh)
 
     def _span_kw(self, ctx, plan, dem_host, B, K):
         if self.realtime_bw:
@@ -1134,6 +1220,13 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             totals=topo.totals,
             phase2=self.phase2,
         )
+        if self._score_exp is not None:
+            # Span-constant learned exponents: a [3] traced operand
+            # (RAGGED_INVARIANT), absent entirely at the reference shape
+            # so default-weight spans keep their compiled programs.
+            kw["score_exp"] = self._stage(
+                np.asarray(self._score_exp), self.dtype
+            )
         market = getattr(ctx.scheduler, "market", None)
         if market is not None:
             # Time-varying prices: the [P, Z, Z] stack (staged once per
@@ -1256,6 +1349,10 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
             if risk is not None:
                 kw["risk"] = jnp.asarray(risk, dtype=self.dtype)
+            if self._score_exp is not None:
+                kw["score_exp"] = jnp.asarray(
+                    self._score_exp, dtype=self.dtype
+                )
             # Kernel choice mirrors _device_place exactly: an explicit
             # use_pallas override wins, and the auto default requires the
             # TPU backend AND f32 (the Pallas kernel is f32-only — an f64
@@ -1265,6 +1362,7 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 use_pallas = (
                     jax.default_backend() == "tpu"
                     and self.dtype == jnp.float32
+                    and self._score_exp is None
                 )
             if use_pallas:
                 return cost_aware_pallas_batched(avail_r, *args, **kw)[0]
@@ -1291,6 +1389,9 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
                 # kernel serves that mode on every backend (explicit
                 # use_pallas=True + realtime_bw is rejected in __init__).
                 and not self.realtime_bw
+                # Nor a learned-exponent input (explicit use_pallas=True
+                # with non-default exponents likewise rejected).
+                and self._score_exp is None
             )
         if self._batch_client is not None or self._mesh is not None:
             # The batcher's program is vmap(scan kernel): the Pallas
@@ -1330,6 +1431,13 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             # Same pattern for the eviction-risk vector: omitted (None)
             # whenever the term is disengaged (resolve_risk).
             kw["risk"] = risk_arg
+        if self._score_exp is not None:
+            # Learned exponents as a traced [3] operand — same omit-when-
+            # disengaged pattern, so reference-shape policies keep their
+            # compiled programs bit for bit.
+            kw["score_exp"] = self._stage(
+                np.asarray(self._score_exp), self.dtype
+            )
         topo = self._staged_topology()
         if not use_pallas:
             # Phase-1 demand-vs-total pre-filter (two-phase kernels only —

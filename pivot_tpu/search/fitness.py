@@ -81,7 +81,10 @@ class SearchEnv(NamedTuple):
     avail0: jax.Array         # [H, 4]
     storage_zones: jax.Array  # [S] i32
     hazard: Optional[Tuple[jax.Array, jax.Array]]  # ([P], [P, H]) or None
-    faults: Optional[Tuple[jax.Array, jax.Array, jax.Array]]  # [F] triple
+    # Shared-plan mode: [F] triple (every replica lives the same
+    # eviction game).  Redraw mode (``redraw_faults=True``): [R, F]
+    # triple, one seeded plan per replica, padded with inert rows.
+    faults: Optional[Tuple[jax.Array, jax.Array, jax.Array]]
     tick: float
     max_ticks: int
     n_replicas: int
@@ -147,6 +150,9 @@ def make_search_env(
     lead: float = 15.0,
     outage: float = 100.0,
     fault_seed: Optional[int] = None,
+    redraw_faults: bool = False,
+    cluster=None,
+    market=None,
     dtype=jnp.float32,
     # MarketSchedule.generate knobs — the spot-survival defaults
     # (experiments/spot.py): a large discounted-and-hazardous pool next
@@ -164,6 +170,19 @@ def make_search_env(
     calls yield operand-identical environments — the replay anchor the
     determinism suite holds the search to.  Held-out evaluation is just
     this function at different seeds.
+
+    ``redraw_faults=True`` draws R *independent* seeded preemption
+    plans (seeds ``fault_seed + r``) instead of one shared plan:
+    candidate comparisons stay paired (candidate b's replica r faces
+    the same plan as candidate b′'s replica r), but fitness variance
+    now includes eviction-plan risk rather than conditioning every
+    score on a single draw.  Still a pure function of its arguments.
+
+    ``cluster``/``market`` inject a live world instead of generating a
+    synthetic one — the model-predictive controller's template-env
+    path (``pivot_tpu.mpc.forecast``).  Injecting a cluster skips the
+    global id reset: ``reset_ids()`` mid-serve would collide fresh ids
+    with the sessions' live apps.
     """
     from pivot_tpu.experiments.spot import synthetic_spot_apps
     from pivot_tpu.infra.market import MarketSchedule
@@ -171,14 +190,16 @@ def make_search_env(
     from pivot_tpu.utils import reset_ids
     from pivot_tpu.utils.config import ClusterConfig, build_cluster
 
-    reset_ids()  # deterministic host-N ids per (n_hosts, seed)
-    cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed))
-    market = MarketSchedule.generate(
-        cluster.meta, seed=seed, horizon=horizon, n_segments=n_segments,
-        hot_fraction=hot_fraction, hot_hazard=hot_hazard,
-        hot_discount=hot_discount, base_hazard=base_hazard,
-        price_vol=price_vol,
-    )
+    if cluster is None:
+        reset_ids()  # deterministic host-N ids per (n_hosts, seed)
+        cluster = build_cluster(ClusterConfig(n_hosts=n_hosts, seed=seed))
+    if market is None:
+        market = MarketSchedule.generate(
+            cluster.meta, seed=seed, horizon=horizon,
+            n_segments=n_segments, hot_fraction=hot_fraction,
+            hot_hazard=hot_hazard, hot_discount=hot_discount,
+            base_hazard=base_hazard, price_vol=price_vol,
+        )
     apps = synthetic_spot_apps(n_apps, seed)
     arrivals = [
         (i * arrival_spacing if arrival_spacing > 0 else 0.0)
@@ -200,21 +221,55 @@ def make_search_env(
             jnp.asarray(hz_rows, dtype=dtype),
         )
 
-    plan = market.spot_schedule(
-        cluster, seed=seed if fault_seed is None else fault_seed,
-        lead=lead, outage=outage, horizon=horizon,
-    )
-    triple = chaos_to_faults(plan, cluster)
+    fs = seed if fault_seed is None else fault_seed
     faults = None
     n_preempt = 0
-    if triple is not None:
-        host, fail, rec = triple
-        n_preempt = int(host.shape[0])
-        faults = (
-            jnp.asarray(host),
-            jnp.asarray(fail, dtype=dtype),
-            jnp.asarray(rec, dtype=dtype),
+    if redraw_faults:
+        # One seeded plan per replica, padded to a common event count
+        # with inert rows (``fail_at = inf`` never fires inside the
+        # horizon, so padding is shape-only).  Replica r's seed is
+        # ``fs + r`` — adjacent SeedSequence streams are independent,
+        # and the layout replays bit-for-bit from the same arguments.
+        triples = [
+            chaos_to_faults(
+                market.spot_schedule(
+                    cluster, seed=fs + r, lead=lead, outage=outage,
+                    horizon=horizon,
+                ),
+                cluster,
+            )
+            for r in range(n_replicas)
+        ]
+        sizes = [0 if t is None else int(t[0].shape[0]) for t in triples]
+        n_preempt = sum(sizes)
+        F = max(sizes)
+        if F > 0:
+            host = np.zeros((n_replicas, F), dtype=np.int32)
+            fail = np.full((n_replicas, F), np.inf, dtype=np.float64)
+            rec = np.full((n_replicas, F), np.inf, dtype=np.float64)
+            for r, t in enumerate(triples):
+                if t is None:
+                    continue
+                k = t[0].shape[0]
+                host[r, :k], fail[r, :k], rec[r, :k] = t
+            faults = (
+                jnp.asarray(host),
+                jnp.asarray(fail, dtype=dtype),
+                jnp.asarray(rec, dtype=dtype),
+            )
+    else:
+        plan = market.spot_schedule(
+            cluster, seed=fs, lead=lead, outage=outage, horizon=horizon,
         )
+        triple = chaos_to_faults(plan, cluster)
+        if triple is not None:
+            host, fail, rec = triple
+            n_preempt = int(host.shape[0])
+            faults = (
+                jnp.asarray(host),
+                jnp.asarray(fail, dtype=dtype),
+                jnp.asarray(rec, dtype=dtype),
+            )
 
     # Time-mean price multiplier: the estimator's busy integral is one
     # scalar per rollout (no per-zone attribution), so instance dollars
@@ -299,7 +354,9 @@ def _fitness_rows_impl(
     workload,
     topo: DeviceTopology,
     hazard,          # ([P], [P, H]) or None — replica-shared market trace
-    faults,          # ([F], [F], [F]) or None — the shared preemption plan
+    faults,          # ([F]×3) shared plan or ([R, F]×3) per-replica plans
+    cap_rows,        # [B] capacity scale per candidate, or None
+    active_rows,     # [B, T] bool admit mask per candidate, or None
     tick: float,
     max_ticks: int,
     forms: str,
@@ -312,15 +369,25 @@ def _fitness_rows_impl(
     pow path and its risk product the ``risk_coeff`` channel —
     including the hand-tuned anchors, so population scoring is one
     compiled program and candidate deltas can never come from path
-    divergence.  Returns ``(egress, instance_hours, n_unfinished,
-    makespan)``, each ``[B × R]`` — the full finish/placement tensors
-    stay on device.
+    divergence.  ``cap_rows``/``active_rows`` are the model-predictive
+    planner's action channels — per-candidate capacity scaling
+    (grow/drain) and task admission masks (admit/shed); ``None`` traces
+    the plain program.  Returns ``(egress, instance_hours,
+    n_unfinished, makespan)``, each ``[B × R]`` — the full
+    finish/placement tensors stay on device.
     """
     B = warr.shape[0]
     n_rows = rt_rows.shape[0]
     R = n_rows // B
     warr = jnp.asarray(warr, avail0.dtype)
     avail_rows = jnp.broadcast_to(avail0, (B * R,) + avail0.shape)
+    if cap_rows is not None:
+        scale = jnp.repeat(jnp.asarray(cap_rows, avail0.dtype), R)
+        avail_rows = avail_rows * scale[:, None, None]
+    active = (
+        jnp.repeat(jnp.asarray(active_rows, bool), R, axis=0)
+        if active_rows is not None else None
+    )
     sp = jnp.repeat(warr[:, :3], R, axis=0)          # [B·R, 3] exponents
     # The risk channel rides only when the environment has a hazard
     # trace — without one the term is disengaged for every candidate
@@ -333,12 +400,20 @@ def _fitness_rows_impl(
     totals = None
     if faults is not None:
         fh, ff, fr = faults
-        F = fh.shape[0]
-        fault_rows = (
-            jnp.broadcast_to(fh, (B * R, F)),
-            jnp.broadcast_to(ff, (B * R, F)),
-            jnp.broadcast_to(fr, (B * R, F)),
-        )
+        F = fh.shape[-1]
+        if fh.ndim == 2:
+            # Per-replica redrawn plans [R, F]: tile candidate-major to
+            # match the draw rows — row b·R + r gets replica r's plan
+            # for EVERY candidate b, so comparisons stay paired.
+            fault_rows = (
+                _tile_rows(fh, B), _tile_rows(ff, B), _tile_rows(fr, B)
+            )
+        else:
+            fault_rows = (
+                jnp.broadcast_to(fh, (B * R, F)),
+                jnp.broadcast_to(ff, (B * R, F)),
+                jnp.broadcast_to(fr, (B * R, F)),
+            )
         totals = avail_rows
     res = _run_rows(
         avail_rows, rt_rows, arr_rows, ra_rows,
@@ -348,6 +423,7 @@ def _fitness_rows_impl(
         totals=totals,
         score_params=sp,
         risk_coeff=rc,
+        active=active,
         hazard=hazard,
         forms=forms,
         tick_order=tick_order,
@@ -391,6 +467,8 @@ def evaluate_rows(
     mesh=None,
     forms: Optional[str] = None,
     tick_order: str = "fifo",
+    cap_rows=None,
+    active_rows=None,
 ) -> Tuple[np.ndarray, dict]:
     """Score a candidate population under ``env``.
 
@@ -408,6 +486,13 @@ def evaluate_rows(
     ``parallel.mesh.replica_mesh``) and ``B × R`` divisible over its
     replica axis; per-row values — and therefore scores — are
     bit-identical to the ``"rollout"`` backend.
+
+    ``cap_rows`` ([B], capacity scale) and ``active_rows`` ([B, T]
+    bool, admit masks) attach per-candidate *actions* to the rollout —
+    the model-predictive planner's channels.  Shed tasks (mask False)
+    never run and don't bill the incomplete penalty; scores divide by
+    each candidate's admitted-and-completed count, so shedding trades
+    throughput against cost inside the same score.
     """
     from pivot_tpu.parallel.ensemble.state import _resolve_forms
 
@@ -427,6 +512,21 @@ def evaluate_rows(
     if not np.all(np.isfinite(warr)):
         raise ValueError("candidate weights must be finite")
     B, R = warr.shape[0], env.n_replicas
+    if cap_rows is not None:
+        cap_rows = np.asarray(cap_rows, np.float64)
+        if cap_rows.shape != (B,):
+            raise ValueError(
+                f"cap_rows must be [B={B}], got {cap_rows.shape}"
+            )
+        if not np.all(np.isfinite(cap_rows)) or np.any(cap_rows < 0):
+            raise ValueError("cap_rows must be finite and non-negative")
+    if active_rows is not None:
+        active_rows = np.asarray(active_rows, dtype=bool)
+        if active_rows.shape != (B, env.n_tasks):
+            raise ValueError(
+                f"active_rows must be [B={B}, T={env.n_tasks}], "
+                f"got {active_rows.shape}"
+            )
     if key is None:
         key = jax.random.PRNGKey(env.seed)
     forms = _resolve_forms(forms)
@@ -439,6 +539,8 @@ def evaluate_rows(
     args = (
         rt_rows, arr_rows, ra_rows, jnp.asarray(warr), env.avail0,
         env.workload, env.topo, env.hazard, env.faults,
+        None if cap_rows is None else jnp.asarray(cap_rows),
+        None if active_rows is None else jnp.asarray(active_rows),
     )
     statics = dict(
         tick=env.tick, max_ticks=env.max_ticks, forms=forms,
@@ -464,7 +566,15 @@ def evaluate_rows(
     unfin = np.asarray(unfin, np.float64).reshape(B, R)
     makespan = np.asarray(makespan, np.float64).reshape(B, R)
     T = env.n_tasks
-    completed = T - unfin
+    # Shed (inactive) tasks never run: they are neither unfinished nor
+    # completed, so the divisor is each candidate's ADMITTED count.
+    admitted = (
+        np.broadcast_to(
+            active_rows.sum(axis=1).astype(np.float64)[:, None], (B, R)
+        )
+        if active_rows is not None else float(T)
+    )
+    completed = admitted - unfin
     cost = (
         ihours * env.rate_per_hour * env.price_scale
         + egress
@@ -482,6 +592,7 @@ def evaluate_rows(
         "unfinished": unfin.mean(axis=1),
         "makespan": makespan.mean(axis=1),
         "completed": completed.mean(axis=1),
+        "admitted": np.broadcast_to(admitted, (B, R)).mean(axis=1),
         "n_rows": B * R,
         "backend": backend,
     }

@@ -149,6 +149,7 @@ class ServeDriver(LogMixin):
         preempt: bool = False,
         preempt_timeout: float = 5.0,
         autoscale: Optional[AutoscaleConfig] = None,
+        mpc=None,
         tracer=None,
         registry=None,
         clock: Optional[ObsClock] = None,
@@ -177,6 +178,17 @@ class ServeDriver(LogMixin):
                 raise ValueError(
                     f"initial pool {len(sessions)} below autoscale.g_min "
                     f"{autoscale.g_min}"
+                )
+        if mpc is not None:
+            if session_factory is None and mpc.g_max > len(sessions):
+                raise ValueError(
+                    "mpc growth (g_max > initial pool) needs a "
+                    "session_factory"
+                )
+            if len(sessions) < mpc.g_min:
+                raise ValueError(
+                    f"initial pool {len(sessions)} below mpc.g_min "
+                    f"{mpc.g_min}"
                 )
         self.sessions = list(sessions)
         #: Observability plane (round 14).  ``tracer`` records the
@@ -269,6 +281,14 @@ class ServeDriver(LogMixin):
         self._abandoned: List[ServeSession] = []
         self._retired: List[ServeSession] = []
         self._autoscaler: Optional[SloAutoscaler] = None
+        #: Model-predictive serving (``pivot_tpu/mpc``): the config is
+        #: an ``MpcConfig`` or None.  ``None`` — the default — never
+        #: imports the package, starts no thread, and leaves the
+        #: reactive driver bit-identical (pinned by tests/test_mpc.py).
+        #: The controller is built in :meth:`run` before the producer
+        #: thread starts, so ``_mpc`` is set-once-then-read (no lock).
+        self.mpc = mpc
+        self._mpc = None
         self._watch_stop = threading.Event()
         for slot, s in enumerate(self.sessions):
             s._driver = self
@@ -655,6 +675,19 @@ class ServeDriver(LogMixin):
                 [s for s in self.sessions if not s.retiring]
             )
 
+    def policy_pool(self) -> List:
+        """``[(label, policy)]`` snapshot of the active pool (retiring
+        and abandoned excluded) — the MPC rollout's promotion surface.
+        The list is a snapshot; the policy objects are live (attribute
+        swaps via ``Policy.apply_weights`` take effect on the session's
+        next decision)."""
+        with self._cv:
+            return [
+                (s.label, s.policy)
+                for s in self.sessions
+                if not s.retiring and not s.abandoned
+            ]
+
     def grow_pool(self, reason: str = "") -> bool:
         """Add one factory session to the pool (autoscaler thread)."""
         with self._cv:
@@ -939,6 +972,10 @@ class ServeDriver(LogMixin):
 
     def _admit(self, arrival: JobArrival) -> None:
         tier = int(getattr(arrival, "tier", 0))
+        if self._mpc is not None:
+            # Forecast tap: sim timestamp + tier, before any admission
+            # verdict — shed/spilled arrivals are still demand.
+            self._mpc.forecaster.observe(arrival.ts, tier)
         if self.tracer.enabled:
             # Trace ids are allocated in admission order (the producer
             # thread is the only allocator), so replaying a seeded
@@ -1158,6 +1195,13 @@ class ServeDriver(LogMixin):
         if self.autoscale is not None:
             self._autoscaler = SloAutoscaler(self, self.autoscale)
             self._autoscaler.start()
+        if self.mpc is not None:
+            # Imported here, not at module scope: mpc=None serving must
+            # never pay for (or depend on) the search/planner stack.
+            from pivot_tpu.mpc.controller import MpcController
+
+            self._mpc = MpcController(self, self.mpc)
+            self._mpc.start()
         producer = threading.Thread(
             target=self._produce, args=(arrivals, pace),
             name="serve-producer", daemon=True,
@@ -1190,6 +1234,8 @@ class ServeDriver(LogMixin):
             watchdog.join()
         if self._autoscaler is not None:
             self._autoscaler.stop()
+        if self._mpc is not None:
+            self._mpc.stop()
         with self._cv:
             errors = self._errors + [
                 s.error
@@ -1233,6 +1279,24 @@ class ServeDriver(LogMixin):
                 registry.set(
                     "pivot_autoscale_actions_total", n, action=action
                 )
+        if self._mpc is not None:
+            registry.counter(
+                "pivot_mpc_actions_total",
+                "mpc planner actions (hold/grow/drain/shed/canary)",
+                labelnames=("action",),
+            )
+            for action, n in self._mpc.action_counts().items():
+                registry.set("pivot_mpc_actions_total", n, action=action)
+            registry.counter(
+                "pivot_mpc_stage_events_total",
+                "mpc rollout stage transitions",
+                labelnames=("stage",),
+            )
+            stages: Dict[str, int] = {}
+            for evt in list(self._mpc.rollout.events):
+                stages[evt["stage"]] = stages.get(evt["stage"], 0) + 1
+            for stage, n in stages.items():
+                registry.set("pivot_mpc_stage_events_total", n, stage=stage)
         for s in sessions:
             s.meter.publish_metrics(registry, run=s.label)
         if self.profiler is not None:
@@ -1278,6 +1342,9 @@ class ServeDriver(LogMixin):
                     "events": list(self._autoscaler.events),
                 }
                 if self._autoscaler is not None else None
+            ),
+            "mpc": (
+                self._mpc.summary() if self._mpc is not None else None
             ),
             "slo": self.slo.snapshot(),
             "batcher": dict(self.batcher.stats) if self.batcher else None,

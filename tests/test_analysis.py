@@ -59,6 +59,8 @@ def _scope_skeleton(tmp_path):
         "pivot_tpu/sched/__init__.py",
         "pivot_tpu/ops/__init__.py",
         "pivot_tpu/search/__init__.py",
+        "pivot_tpu/mpc/forecast.py",
+        "pivot_tpu/mpc/planner.py",
     ):
         p = tmp_path / rel
         p.parent.mkdir(parents=True, exist_ok=True)

@@ -146,6 +146,33 @@ def test_cost_aware_parity(meta, seed, kwargs):
     assert p_cpu.tolist() == p_dev.tolist()
 
 
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(sort_tasks=True, sort_hosts=True),
+        dict(sort_hosts=True, host_decay=True),
+        dict(bin_pack="best-fit", sort_tasks=True),
+        dict(bin_pack="best-fit", host_decay=True),
+    ],
+)
+@pytest.mark.parametrize("phase2", ["scan", "slim", 8])
+def test_cost_aware_learned_exponent_parity(meta, phase2, kwargs):
+    """Learned score exponents on the device fast path (PR-14 remainder):
+    a non-default ``(w_cost, w_bw, w_norm)`` vector must reproduce the
+    CPU policy's placements through every phase-2 mode."""
+    from pivot_tpu.search.weights import PolicyWeights
+
+    w = PolicyWeights(w_cost=1.7, w_bw=0.6, w_norm=1.4, risk_weight=0.5)
+    p_cpu, p_dev, *_ = pair_place(
+        meta,
+        CostAwarePolicy(mode="numpy", weights=w, **kwargs),
+        TpuCostAwarePolicy(weights=w, phase2=phase2, **kwargs),
+        random_groups(2),
+        seed=2,
+    )
+    assert p_cpu.tolist() == p_dev.tolist()
+
+
 @pytest.mark.parametrize("phase2", ["scan", "slim", 8])
 def test_cost_aware_parity_phase2_modes(meta, phase2):
     """The policy-level ``phase2`` plumbing (round 6): every phase-2 mode

@@ -147,14 +147,23 @@ def test_non_default_exponents_change_cost_aware_scores():
     assert exp._score_exp == (3.0, 1.0, 0.2)
 
 
-def test_device_policy_accepts_vector_rejects_exponents():
+def test_device_policy_accepts_vector_and_learned_exponents():
     from pivot_tpu.sched.tpu import TpuCostAwarePolicy, TpuFirstFitPolicy
 
     p = TpuCostAwarePolicy(weights=PolicyWeights(risk_weight=1.5))
     assert p.risk_weight == 1.5
     assert p._cpu_twin.risk_weight == 1.5
-    with pytest.raises(ValueError, match="reference exponent shape"):
-        TpuCostAwarePolicy(weights=PolicyWeights(w_cost=2.0))
+    # Learned exponents now ride the device scan kernels (the PR-14
+    # remainder — placement parity vs the CPU policy is pinned in
+    # tests/test_kernels.py::test_cost_aware_learned_exponent_parity).
+    w = PolicyWeights(w_cost=3.0, w_norm=0.2)
+    dev = TpuCostAwarePolicy(sort_hosts=True, weights=w)
+    assert dev._score_exp == (3.0, 1.0, 0.2)
+    # Combinations without a threaded exponent path stay rejected.
+    with pytest.raises(ValueError, match="realtime_bw"):
+        TpuCostAwarePolicy(realtime_bw=True, weights=w)
+    with pytest.raises(ValueError, match="Pallas"):
+        TpuCostAwarePolicy(use_pallas=True, weights=w)
     # Non-cost-aware device arms are exponent-invariant by construction
     # and accept any vector's risk dims.
     q = TpuFirstFitPolicy(weights=PolicyWeights(risk_weight=0.5))
@@ -227,6 +236,81 @@ def test_sensitivity_evaluate_candidates_is_the_library_surface(tiny_env):
     via_lib = evaluate_candidates(pop, tiny_env)
     direct, _ = evaluate_rows(PolicyWeights.stack(pop), tiny_env)
     np.testing.assert_array_equal(via_lib, direct)
+
+
+# -- per-replica fault redraws & planner action channels ---------------------
+
+
+def test_redraw_faults_deterministic_per_replica_plans():
+    """``redraw_faults=True`` replays bit-for-bit from the same
+    arguments, stacks one seeded plan per replica ([R, F] triple,
+    inert-padded), and actually varies the eviction game across
+    replicas."""
+    kw = dict(n_hosts=8, seed=3, n_apps=3, horizon=300.0, n_replicas=4,
+              redraw_faults=True)
+    a = make_search_env(**kw)
+    b = make_search_env(**kw)
+    assert a.faults is not None
+    for x, y in zip(a.faults, b.faults):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    host, fail, rec = (np.asarray(x) for x in a.faults)
+    assert host.shape[0] == 4 and host.shape == fail.shape == rec.shape
+    # Real events are finite, inert padding is inf; the diagnostic
+    # count tallies real events across every replica plan.
+    assert int(np.isfinite(fail).sum()) == a.n_preemptions > 0
+    assert any(not np.array_equal(fail[0], fail[r]) for r in range(1, 4))
+
+
+def test_redraw_faults_scores_replayable_and_diverge(tiny_env):
+    """Fitness under redrawn fault plans is seed-replayable and differs
+    from the shared-plan world (the variance now includes eviction-plan
+    risk)."""
+    env = make_search_env(
+        n_hosts=8, seed=3, n_apps=3, horizon=300.0, n_replicas=4,
+        redraw_faults=True,
+    )
+    pop = PolicyWeights.stack(
+        [DEFAULT_WEIGHTS, PolicyWeights(risk_weight=5.0)]
+    )
+    s1, _ = evaluate_rows(pop, env)
+    s2, _ = evaluate_rows(pop, env)
+    np.testing.assert_array_equal(s1, s2)
+    shared, _ = evaluate_rows(pop, tiny_env)
+    assert not np.array_equal(s1, shared)
+
+
+def test_planner_action_channels(tiny_env):
+    """``cap_rows``/``active_rows`` are the model-predictive planner's
+    action channels: inert values (scale 1, all-admitted) score
+    bit-identically to the plain path; real values move capacity and
+    admission accounting per candidate."""
+    pop = PolicyWeights.stack([DEFAULT_WEIGHTS, DEFAULT_WEIGHTS])
+    T = tiny_env.n_tasks
+    base, _ = evaluate_rows(pop, tiny_env)
+    inert, _ = evaluate_rows(
+        pop, tiny_env, cap_rows=np.ones(2),
+        active_rows=np.ones((2, T), dtype=bool),
+    )
+    np.testing.assert_array_equal(base, inert)
+    # Halving candidate 1's capacity moves only candidate 1's score.
+    capped, _ = evaluate_rows(
+        pop, tiny_env, cap_rows=np.array([1.0, 0.5])
+    )
+    assert capped[0] == base[0]
+    assert capped[1] != base[1]
+    # Shedding one task: the admitted divisor and billing both follow.
+    act = np.ones((2, T), dtype=bool)
+    act[1, -1] = False
+    shed, ds = evaluate_rows(pop, tiny_env, active_rows=act)
+    assert ds["admitted"][0] == T and ds["admitted"][1] == T - 1
+    assert shed[0] == base[0]
+    assert shed[1] != base[1]
+    with pytest.raises(ValueError, match="cap_rows"):
+        evaluate_rows(pop, tiny_env, cap_rows=np.ones(3))
+    with pytest.raises(ValueError, match="active_rows"):
+        evaluate_rows(
+            pop, tiny_env, active_rows=np.ones((2, T + 1), dtype=bool)
+        )
 
 
 # -- search determinism ------------------------------------------------------

@@ -160,6 +160,20 @@ TRACKED: Tuple[Metric, ...] = (
         # the gating box's fingerprint.
         rel_floor=30.0,
     ),
+    Metric(
+        "serve_mpc_dps",
+        ("serve_mpc", "mpc", "decisions_per_sec"),
+        lower_better=False, kind="rate",
+        # Round-19 model-predictive serving: throughput of the served
+        # stream WITH the controller, forecaster tap, and background
+        # tuner attached — a collapse here means the MPC threads are
+        # stealing the serving path's cycles.  Same threaded-soak load
+        # sensitivity as the other serve rows.  Phase-in: absent from
+        # pre-round-19 histories, so the gate notes (not fires) until
+        # the baseline carries rows with it on the gating box's
+        # fingerprint.
+        rel_floor=30.0,
+    ),
 )
 
 

@@ -64,6 +64,13 @@
 #      warmup assertion, and the tiny mixed-horizon serve soak diffed
 #      bit-identical against the per-tick referee (the full
 #      policy × phase2 × live-mask × K-mix sweep is slow-marked).
+#  10. model-predictive serving (round 19, pivot_tpu/mpc/): the
+#      forecast/render replay-determinism twins, the planner's
+#      clone-parity + bitwise-replay + referee contract, the
+#      zero-recompile-after-warmup assertion on the shadow-rollout
+#      dispatch, and the off-switch pin (mpc=None never engages the
+#      subsystem; dry_run observes without perturbing one outcome
+#      counter).  The full chaos+market acceptance soak stays tier-1.
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -75,11 +82,11 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/9] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/10] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/9] graftcheck static analysis (10 passes) + compile check =="
+echo "== [2/10] graftcheck static analysis (10 passes) + compile check =="
 # Machine-readable findings, annotated per file:line; the 10 s timeout
 # IS the wall-clock budget check for the full static suite.  The
 # capture must not abort under `set -e` before lint_annotate has
@@ -104,7 +111,7 @@ python tools/hotpath_lint.py
 # assert ZERO recompiles in steady state (quick mode).
 python -m pivot_tpu.analysis --compile-check quick
 
-echo "== [3/9] chaos replay determinism on the committed seed =="
+echo "== [3/10] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -119,7 +126,7 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
-echo "== [4/9] sharded-placement parity on a forced 8-device CPU mesh =="
+echo "== [4/10] sharded-placement parity on a forced 8-device CPU mesh =="
 # Small-H quick twins + the H=1024 acceptance + the sharded span driver
 # + the round-17 2-D suite: the [G]-batched replica × host programs
 # (shard_map(vmap(...)) via batch_execute(mesh=...)) vs the sequential
@@ -138,7 +145,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_serve_2d.py -q -m 'not slow' \
     -k 'not 100x' -p no:cacheprovider
 
-echo "== [5/9] spot soak + market replay determinism on the committed seed =="
+echo "== [5/10] spot soak + market replay determinism on the committed seed =="
 MARKET_SEED_FILE=data/market/ci_seed.json
 # The quick acceptance soak (tier-1 twin in tests/test_market.py).
 python -m pytest tests/test_market.py -q -m 'not slow' \
@@ -158,7 +165,7 @@ python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
     --out "$TMP/spot_b.json"
 python tools/market_replay.py diff "$TMP/spot_a.json" "$TMP/spot_b.json"
 
-echo "== [6/9] observability plane: traced+profiled soak + trace check =="
+echo "== [6/10] observability plane: traced+profiled soak + trace check =="
 # A tiny traced serve soak through the CLI — device policy so the
 # sampled dispatch profiler (--profile-dispatch) has dispatches to
 # bracket; the Perfetto artifact must pass the structural + causal +
@@ -176,7 +183,7 @@ grep -q "pivot_dispatch_latency_seconds" "$TMP/soak.prom"
 python -m pytest tests/test_obs.py -q -m 'not slow' \
     -k 'parity or chain or overhead' -p no:cacheprovider
 
-echo "== [7/9] continuous-bench regression gate (committed baseline) =="
+echo "== [7/10] continuous-bench regression gate (committed baseline) =="
 BASELINE=data/bench/ci_baseline.jsonl
 # The committed baseline history must gate clean against itself...
 python tools/bench_history.py check --history "$BASELINE"
@@ -195,7 +202,7 @@ if [ "$inj_rc" -ne 1 ]; then
     exit 1
 fi
 
-echo "== [8/9] policy search: tiny CEM beats bad init + replays =="
+echo "== [8/10] policy search: tiny CEM beats bad init + replays =="
 # The round-16 learned-scheduler gate: a tiny CEM search (2
 # generations, popsize 4, small cluster) over the COMMITTED seeded
 # config (data/search/ci_seed.json) must strictly beat the
@@ -231,7 +238,7 @@ print(
 )
 PYEOF
 
-echo "== [9/9] ragged continuous batching: repack parity + mixed-horizon soak =="
+echo "== [9/10] ragged continuous batching: repack parity + mixed-horizon soak =="
 # Round 18: mixed-horizon serve spans padded into a shared (K, B)
 # bucket and run as ONE device program.  Quick repack/batcher parity
 # smalls + the tiny mixed-horizon soak vs the per-tick referee, on the
@@ -239,5 +246,17 @@ echo "== [9/9] ragged continuous batching: repack parity + mixed-horizon soak ==
 # contract is exercised without a TPU.
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_ragged.py -q -m 'not slow' -p no:cacheprovider
+
+echo "== [10/10] model-predictive serving: replay + parity + off-switch =="
+# Round 19: the simulator's fitness estimator runs INSIDE the server.
+# Quick deterministic gates only — forecast/render bit-replay, the
+# five-slot planner's clone-parity/bitwise-replay/referee contract,
+# zero recompiles after warmup on the shape-pinned shadow-rollout
+# dispatch, and the mpc=None / dry_run off-switch pins.  The
+# chaos+market soak (MPC vs reactive on identical seeded streams) is
+# the tier-1 acceptance test in tests/test_mpc.py.
+python -m pytest tests/test_mpc.py -q -m 'not slow' \
+    -k 'determinism or parity or replay or recompiles or dry_run' \
+    -p no:cacheprovider
 
 echo "smoke lane: all green"
