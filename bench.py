@@ -2130,6 +2130,428 @@ def _bench_serve_mpc_in_child(timeout_s: int = 540) -> dict:
     return _run_row_in_child("PIVOT_BENCH_SERVE_MPC_CHILD", timeout_s)
 
 
+def _bench_serve_resident(
+    n_hosts: int = 4,
+    n_apps: int = 6,
+    micro_hosts: tuple = (4096, 32768, 100_000),
+    micro_b: int = 32,
+    micro_k: int = 8,
+    micro_spans: int = 30,
+    n_jobs: int = 80,
+    rate: float = 50.0,
+    seed: int = 0,
+) -> dict:
+    """Resident-carry serving row (round 20): device-persistent span
+    state with donated buffers vs the re-staged span path.
+
+    Three blocks:
+
+      * ``serve`` — a deterministic DES run (cost-aware policy, chain
+        apps) with the profiler attached: resident vs re-staged arms
+        must produce bit-identical placements AND meter totals, the
+        resident arm must take ZERO recompiles on a second identical
+        pass after warmup, and the profiler's census-grade per-family
+        transfer counters give honest h2d bytes/span for both arms at
+        serving scale.
+      * ``scaling`` — kernel-level micro arms at H up to 100k hosts
+        with every per-span input the real serve paths pay engaged
+        (live mask, resident task counts, market risk): the re-staged
+        arm renders + stages [K, H] risk rows, [H, 4] availability,
+        counts, and live every span; the resident arm mirror-diffs
+        against the carry and ships only the [B]-sized operands plus a
+        [K] segment row against the once-staged [P, H] table.
+        ``throughput_ratio`` (≥1.2x) and ``h2d_ratio`` (≥5x) are
+        measured at the largest H, with bit parity asserted per H.
+      * ``splice_soak`` — mid-span arrivals at staggered DES instants
+        joined into the RUNNING span, each run verified bit-identical
+        against the per-tick (``fuse_spans=False``) referee, plus a
+        streamed ServeDriver pass with ``resident=True`` and the
+        splice tier gate open (``driver`` — serve-level decisions/s;
+        its slo-bounded spans end at the admission window, so driver
+        streams report splices only when an in-DES submission lands
+        mid-span).
+
+    Tracked as ``serve_resident`` in ``tools/bench_history.py``
+    (phase-in: note-not-gate until the committed baseline carries
+    rows)."""
+    import gc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pivot_tpu.des import Environment
+    from pivot_tpu.infra import Cluster, Host, Storage
+    from pivot_tpu.infra.locality import ResourceMetadata
+    from pivot_tpu.infra.meter import Meter
+    from pivot_tpu.obs import DispatchProfiler
+    from pivot_tpu.ops.tickloop import (
+        fused_tick_run,
+        resident_carry_init,
+        resident_span_run,
+    )
+    from pivot_tpu.sched import GlobalScheduler
+    from pivot_tpu.sched.tpu import TpuCostAwarePolicy
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.compile_counter import count_compiles
+    from pivot_tpu.workload import Application, TaskGroup
+
+    # -- serve block: bit parity + meter parity + h2d census ----------
+    def build_cluster_des(env, meter):
+        meta = ResourceMetadata(seed=seed)
+        zones = meta.zones
+        hosts = [
+            Host(env, 4.0, 1024, 100, 1, locality=zones[i % 2],
+                 meter=meter, id=f"h{i}")
+            for i in range(n_hosts)
+        ]
+        storage = [
+            Storage(env, z)
+            for z in dict.fromkeys(h.locality for h in hosts)
+        ]
+        return Cluster(
+            env, hosts=hosts, storage=storage, meta=meta, meter=meter,
+            route_mode="meta", seed=seed, executor_backend="fast",
+        )
+
+    def chain_apps():
+        return [
+            Application(f"app{i}", [
+                TaskGroup("a", cpus=1, mem=64, runtime=17.0,
+                          output_size=400, instances=10),
+                TaskGroup("b", cpus=2, mem=64, runtime=9.0,
+                          dependencies=["a"], instances=6),
+                TaskGroup("c", cpus=1, mem=32, runtime=5.0,
+                          dependencies=["b"], instances=8),
+            ])
+            for i in range(n_apps)
+        ]
+
+    def serve_arm(resident):
+        reset_ids()
+        env = Environment()
+        meta = ResourceMetadata(seed=seed)
+        meter = Meter(env, meta)
+        cluster = build_cluster_des(env, meter)
+        policy = TpuCostAwarePolicy(
+            bin_pack="first-fit", sort_tasks=True, sort_hosts=True,
+            adaptive=False,
+        )
+        prof = DispatchProfiler(sample_every=4, seed=0)
+        policy.enable_profiler(prof)
+        if resident:
+            policy.enable_resident(splice=False)
+        sched = GlobalScheduler(
+            env, cluster, policy, seed=3, meter=meter, fuse_spans=True,
+        )
+        cluster.start()
+        sched.start()
+        apps = chain_apps()
+        for a in apps:
+            sched.submit(a)
+        sched.stop()
+        gc.collect()
+        t0 = time.perf_counter()
+        env.run()
+        wall = time.perf_counter() - t0
+        placements = sorted(
+            (t.id, t.placement)
+            for a in apps for g in a.groups for t in g.tasks
+        )
+        fam = prof.summary()["families"].get(
+            "resident_span_run" if resident else "fused_tick_run", {},
+        )
+        return {
+            "wall_s": round(wall, 3),
+            "spans": int(fam.get("calls", 0)),
+            "h2d_bytes_total": int(fam.get("h2d_bytes_total", 0)),
+            "h2d_bytes_per_span": round(
+                fam.get("h2d_bytes_per_call", 0.0), 1
+            ),
+            "meter_ops": meter.total_scheduling_ops,
+            "span_stats": dict(sched.span_stats),
+        }, placements
+
+    serve_re, p_re = serve_arm(resident=False)
+    serve_res, p_res = serve_arm(resident=True)
+    with count_compiles() as counter:
+        serve_res2, p_res2 = serve_arm(resident=True)
+    serve_parity = bool(
+        p_re == p_res == p_res2
+        and serve_re["meter_ops"] == serve_res["meter_ops"]
+    )
+
+    # -- scaling block: kernel-level arms at H up to 100k -------------
+    P = 24  # market segments in the synthetic risk table
+
+    def micro(H):
+        rng = np.random.default_rng(seed)
+        avail0 = rng.uniform(4.0, 8.0, (H, 4)).astype(np.float32)
+        counts0 = np.zeros(H, np.int32)
+        live0 = np.ones(H, bool)
+        dems = rng.uniform(
+            0.05, 0.3, (micro_spans, micro_b, 4)
+        ).astype(np.float32)
+        arrive = np.zeros(micro_b, np.int32)
+        hz = rng.integers(0, 4, H).astype(np.int32)
+        hazard = rng.uniform(0.0, 0.2, (P, 4))
+        w = 0.5
+        table = (w * hazard[:, hz]).astype(np.float32)  # [P, H]
+        segs = rng.integers(0, P, (micro_spans, micro_k)).astype(
+            np.int32
+        )
+        kw = dict(policy="first-fit", n_ticks=micro_k, strict=False)
+
+        def restaged():
+            host_avail = avail0.copy()
+            counts = counts0.copy()
+            pls = []
+            for i in range(micro_spans):
+                rows = (w * hazard[:, hz])[segs[i]].astype(np.float32)
+                res = fused_tick_run(
+                    jnp.asarray(host_avail), jnp.asarray(dems[i]),
+                    jnp.asarray(arrive), jnp.int32(micro_k),
+                    base_task_counts=jnp.asarray(counts),
+                    live=jnp.asarray(live0),
+                    risk_rows=jnp.asarray(rows), **kw,
+                )
+                host_avail = np.asarray(res.avail)
+                pl = np.asarray(res.placements)
+                np.add.at(counts, pl[pl >= 0], 1)
+                pls.append(pl)
+            return pls
+
+        def resident():
+            carry = resident_carry_init(
+                jnp.asarray(avail0), jnp.asarray(counts0),
+                jnp.asarray(live0),
+            )
+            tdev = jnp.asarray(table)
+            host_avail = avail0.copy()
+            counts = counts0.copy()
+            pls = []
+            for i in range(micro_spans):
+                # The mirror-diff the serve path pays every span (reads
+                # are D2H — free of the h2d budget this row gates on).
+                diff = (
+                    (np.asarray(carry.avail) != host_avail).any(axis=1)
+                    | (np.asarray(carry.counts) != counts)
+                    | (np.asarray(carry.live) != live0)
+                )
+                assert not diff.any()
+                res, carry = resident_span_run(
+                    carry, jnp.asarray(dems[i]), jnp.asarray(arrive),
+                    jnp.int32(micro_k), risk_table=tdev,
+                    risk_seg=jnp.asarray(segs[i]), **kw,
+                )
+                host_avail = np.asarray(res.avail)
+                pl = np.asarray(res.placements)
+                np.add.at(counts, pl[pl >= 0], 1)
+                pls.append(pl)
+            return pls
+
+        restaged(), resident()  # warmup: every program compiled
+        gc.collect()
+        t0 = time.perf_counter()
+        p0 = restaged()
+        t_re = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        p1 = resident()
+        t_res = time.perf_counter() - t0
+        parity = all(np.array_equal(a, b) for a, b in zip(p0, p1))
+        decisions = sum(int((p >= 0).sum()) for p in p0)
+        dem_b = int(dems[0].nbytes)
+        arr_b = int(arrive.nbytes)
+        h2d_re = (
+            int(avail0.nbytes) + int(counts0.nbytes) + int(live0.nbytes)
+            + micro_k * H * 4 + dem_b + arr_b
+        )
+        h2d_res = dem_b + arr_b + int(segs[0].nbytes)
+        return {
+            "h": H,
+            "restaged": {
+                "ms_per_span": round(t_re * 1e3 / micro_spans, 3),
+                "decisions_per_sec": round(decisions / t_re, 1),
+                "h2d_bytes_per_span": h2d_re,
+            },
+            "resident": {
+                "ms_per_span": round(t_res * 1e3 / micro_spans, 3),
+                "decisions_per_sec": round(decisions / t_res, 1),
+                "h2d_bytes_per_span": h2d_res,
+                "first_span_h2d_bytes": h2d_res + int(avail0.nbytes)
+                + int(counts0.nbytes) + int(live0.nbytes)
+                + int(table.nbytes),
+            },
+            "throughput_ratio": round(t_re / t_res, 3),
+            "h2d_ratio": round(h2d_re / h2d_res, 1),
+            "parity_ok": parity,
+        }
+
+    scaling = [micro(H) for H in micro_hosts]
+    top = scaling[-1]
+
+    # -- splice soak: mid-span arrivals vs the per-tick referee -------
+    def splice_arm(late_at, resident):
+        reset_ids()
+        env = Environment()
+        meta = ResourceMetadata(seed=seed)
+        meter = Meter(env, meta)
+        cluster = build_cluster_des(env, meter)
+        policy = TpuCostAwarePolicy(
+            bin_pack="first-fit", sort_tasks=True, sort_hosts=True,
+            adaptive=False,
+        )
+        if resident:
+            policy.enable_resident(splice=True)
+        sched = GlobalScheduler(
+            env, cluster, policy, seed=3, meter=meter,
+            fuse_spans=resident,
+        )
+        cluster.start()
+        sched.start()
+        apps = chain_apps()
+        for a in apps:
+            sched.submit(a)
+        env.run(until=late_at)
+        late = Application("late", [
+            TaskGroup("z", cpus=1, mem=32, runtime=4.0, instances=3),
+        ])
+        sched.submit(late)
+        apps.append(late)
+        sched.stop()
+        env.run()
+        placements = sorted(
+            (t.id, t.placement)
+            for a in apps for g in a.groups for t in g.tasks
+        )
+        return placements, dict(sched.span_stats)
+
+    def splice_soak():
+        splices = 0
+        parity = True
+        for t in (18.0, 22.0, 27.0, 33.0, 38.0):
+            ref, _ = splice_arm(t, resident=False)
+            res, stats = splice_arm(t, resident=True)
+            parity = parity and ref == res
+            splices += stats["span_splices"]
+        return {"splices": splices, "referee_parity_ok": bool(parity)}
+
+    # -- driver pass: the serve stack with the splice tier gate open --
+    def driver_soak():
+        from pivot_tpu.serve import (
+            ServeDriver,
+            ServeSession,
+            mixed_tier_arrivals,
+            synthetic_app_factory,
+        )
+        from pivot_tpu.utils.config import (
+            ClusterConfig,
+            PolicyConfig,
+            build_cluster,
+            make_policy,
+        )
+
+        reset_ids()
+        pcfg = PolicyConfig(
+            name="cost-aware", device="tpu", bin_pack="first-fit",
+            sort_tasks=True, sort_hosts=True, adaptive=False,
+        )
+        sessions = [
+            ServeSession(
+                f"res-{g}",
+                build_cluster(ClusterConfig(n_hosts=16, seed=seed)),
+                make_policy(pcfg),
+                seed=seed,
+                fuse_spans="slo",
+            )
+            for g in range(3)
+        ]
+        driver = ServeDriver(
+            sessions,
+            queue_depth=32,
+            backpressure="shed",
+            flush_after=0.02,
+            resident=True,
+            splice_tier=2,
+        )
+        stream = mixed_tier_arrivals(
+            rate, n_jobs, weights=(0.25, 0.35, 0.40), seed=seed,
+            make_app=synthetic_app_factory(seed=seed),
+        )
+        t0 = time.perf_counter()
+        report = driver.run(stream)
+        wall = time.perf_counter() - t0
+        driver.audit(context="serve_resident bench (splice soak)")
+        pool = driver.sessions + driver._retired
+        stats = {
+            k: sum(s.summary()["span_stats"].get(k, 0) for s in pool)
+            for k in ("fused_spans", "span_splices", "span_aborts")
+        }
+        snap = report["slo"]
+        return {
+            "wall_s": round(wall, 3),
+            "decisions": snap["counters"]["decisions"],
+            "decisions_per_sec": round(
+                snap["counters"]["decisions"] / max(wall, 1e-9), 1
+            ),
+            "completed": snap["counters"]["completed"],
+            **stats,
+        }
+
+    soak = splice_soak()
+    try:
+        soak["driver"] = driver_soak()
+    except Exception as exc:  # noqa: BLE001 — block-level isolation
+        soak["driver"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+
+    return {
+        "h_top": int(micro_hosts[-1]),
+        "b": micro_b,
+        "k": micro_k,
+        "spans": micro_spans,
+        "serve": {
+            "restaged": serve_re,
+            "resident": serve_res,
+            "h2d_ratio": round(
+                serve_re["h2d_bytes_per_span"]
+                / max(serve_res["h2d_bytes_per_span"], 1e-9), 1
+            ),
+        },
+        "scaling": scaling,
+        "restaged": top["restaged"],
+        "resident": top["resident"],
+        "throughput_ratio": top["throughput_ratio"],
+        "throughput_1p2x_ok": bool(top["throughput_ratio"] >= 1.2),
+        "h2d_ratio": top["h2d_ratio"],
+        "h2d_5x_ok": bool(top["h2d_ratio"] >= 5.0),
+        "splice_soak": soak,
+        "recompiles_after_warmup": int(counter.compiles),
+        "retraces_after_warmup": int(counter.traces),
+        "parity_ok": bool(
+            serve_parity
+            and all(s["parity_ok"] for s in scaling)
+            and soak["referee_parity_ok"]
+        ),
+    }
+
+
+def _serve_resident_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SERVE_RESIDENT_CHILD=1``): run
+    the serve_resident row and print ONE JSON line.  Child-isolated
+    like every serve row (single-tenant backend; a wedged RPC must
+    never hang the parent)."""
+    os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+    jax = _child_backend_setup()
+    row = _bench_serve_resident()
+    row["backend"] = jax.default_backend()
+    print(json.dumps(row), flush=True)
+
+
+def _bench_serve_resident_in_child(timeout_s: int = 540) -> dict:
+    """Parent side of the serve_resident row — see
+    ``_run_row_in_child``."""
+    return _run_row_in_child("PIVOT_BENCH_SERVE_RESIDENT_CHILD", timeout_s)
+
+
 # -- shard_place row: pod-scale host-sharded placement (ops/shard.py) -------
 #
 # Weak-scaling protocol: per-shard host count H0 held fixed while the
@@ -2532,7 +2954,7 @@ def main() -> None:
         known_rows = {
             "headline", "two_phase", "grid_batched", "fused_tick",
             "serve_stream", "serve_tiers", "serve_sharded",
-            "serve_ragged", "serve_mpc", "shard_place",
+            "serve_ragged", "serve_mpc", "serve_resident", "shard_place",
             "spot_survival", "policy_search", "obs_overhead",
             "profiler_overhead", "cost_attribution", "saturated",
         }
@@ -2565,6 +2987,9 @@ def main() -> None:
         return
     if os.environ.get("PIVOT_BENCH_SERVE_MPC_CHILD"):
         _serve_mpc_child()
+        return
+    if os.environ.get("PIVOT_BENCH_SERVE_RESIDENT_CHILD"):
+        _serve_resident_child()
         return
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
@@ -2682,6 +3107,10 @@ def main() -> None:
     )
     serve_mpc = (
         _bench_serve_mpc_in_child() if _row_on("serve_mpc")
+        else skipped
+    )
+    serve_resident = (
+        _bench_serve_resident_in_child() if _row_on("serve_resident")
         else skipped
     )
     # Pod-scale sharded placement, also all-children (each arm pins its
@@ -2868,6 +3297,7 @@ def main() -> None:
         "serve_sharded": serve_sharded,
         "serve_ragged": serve_ragged,
         "serve_mpc": serve_mpc,
+        "serve_resident": serve_resident,
         "shard_place": shard_place,
         "spot_survival": spot_survival,
         "policy_search": policy_search,

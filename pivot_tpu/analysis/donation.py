@@ -15,14 +15,28 @@ device-only).  Both sides rot silently, so both are checked:
      always previous jit OUTPUTS — device-owned buffers) must be
      wrapped by a jit that donates the declared position; a wrapper
      that vanished or dropped its ``donate_argnums`` is a finding.
-     ``donated=False`` entries (the span availability carries) must
-     stay UNdonated: their operands are staged from host numpy at the
-     call boundary, and on the CPU backend ``jnp.asarray(host_array)``
-     is **zero-copy for large aligned arrays** — donating such a
-     buffer lets XLA reuse memory the caller still owns (measured in
-     round 13: silent, allocation-order-dependent corruption of the
-     DES availability snapshot).  Flipping either direction without
-     flipping the manifest is a finding.
+     ``donated=False`` entries (the RE-STAGED span availability
+     carries) must stay UNdonated: their operands are staged from host
+     numpy at the call boundary, and on the CPU backend
+     ``jnp.asarray(host_array)`` is **zero-copy for large aligned
+     arrays** — donating such a buffer lets XLA reuse memory the
+     caller still owns (measured in round 13: silent, allocation-
+     order-dependent corruption of the DES availability snapshot).
+     Flipping either direction without flipping the manifest is a
+     finding.
+
+     Round 20 AMENDS that hazard writeup rather than repealing it: the
+     resident span tier (``resident-span-carry`` /
+     ``sharded-resident-span-carry``) donates the very state the
+     re-staged entries refuse to, and both decisions are correct —
+     what changed is buffer OWNERSHIP, not the rule.  The resident
+     carry is always a previous jit OUTPUT (``resident_carry_init``
+     materializes an explicit device copy before the first donation;
+     every later span's carry is the prior ``resident_span_run``
+     output), so caller-owned host memory can never sit behind the
+     donated position.  ``fused_tick_run``'s re-staged form keeps its
+     negative entry because ITS operands still arrive from host numpy
+     every call.
   2. **Use-after-donate** — a call passing a plain variable at a
      donated position kills that variable: any later read of it in the
      same function (without an intervening rebind — the
@@ -76,6 +90,22 @@ MANIFEST: Dict[str, Carry] = {
         donated=False,
         why="sharded twin of span-avail-carry — same zero-copy hazard",
     ),
+    "resident-span-carry": Carry(
+        "pivot_tpu/ops/tickloop.py", "_resident_span_run", 0, "carry",
+        donated=True,
+        why="the carry is always a previous jit OUTPUT — "
+            "resident_carry_init materializes an explicit device copy "
+            "before the first donation, so the round-13 zero-copy "
+            "hazard (caller-owned host memory behind a donated "
+            "position) is structurally unreachable",
+    ),
+    "sharded-resident-span-carry": Carry(
+        "pivot_tpu/ops/shard.py", "_sharded_resident_span_fn", 0,
+        "carry", donated=True,
+        why="sharded twin of resident-span-carry — same output-fed "
+            "ownership contract, the carry shard-resident between "
+            "spans",
+    ),
     "ensemble-segment-carry": Carry(
         "pivot_tpu/parallel/ensemble/checkpoint.py",
         "_segment_step_carry", 0, "state", donated=True,
@@ -95,6 +125,15 @@ MANIFEST: Dict[str, Carry] = {
 DONATING_CALLS: Dict[str, int] = {
     "_segment_step_carry": 0,
     "_row_segment_step_carry": 0,
+    # Resident span tier (round 20): the public wrappers, their jitted
+    # forms, and the policy-layer dispatch helper all CONSUME the carry
+    # at the listed position — reading it afterwards is the classic
+    # span-of-death (works on GPU until the allocator reuses the page,
+    # raises on CPU).
+    "resident_span_run": 0,
+    "_resident_span_run": 0,
+    "sharded_resident_span_run": 1,  # (mesh, carry, ...)
+    "_resident_dispatch": 0,
 }
 
 #: Parameter names that mark a carry-shaped argument in the
@@ -118,6 +157,15 @@ EXEMPT: Dict[Tuple[str, str, str], str] = {
         "bench and placement_sensitivity re-score the same [R, H, 4] "
         "replica ensemble across repeats; VMEM, not HBM aliasing, is "
         "the binding constraint for the Pallas form",
+    ("pivot_tpu/ops/tickloop.py", "_resident_carry_init", "avail"):
+        "init materializes the explicit device-owned copy that SEEDS "
+        "the resident donation chain; donating its input — possibly a "
+        "zero-copy view of caller host memory — is exactly the "
+        "round-13 hazard the copy exists to rule out",
+    ("pivot_tpu/ops/tickloop.py", "_resident_carry_clone", "carry"):
+        "the splice checkpoint clone must leave its SOURCE intact (the "
+        "span re-runs from it on a mid-span arrival); donation would "
+        "defeat the clone's purpose",
     ("pivot_tpu/parallel/ensemble/checkpoint.py", "_segment_step",
      "state"):
         "the deliberately NON-donating twin behind the segmented "

@@ -44,6 +44,11 @@ DISCOVER: Dict[str, Tuple[str, ...]] = {
     ),
     "pivot_tpu/ops/tickloop.py": (
         "_fused_tick_run_impl", "_span_*",
+        # Round-20 resident tier: the donated-carry span driver and the
+        # carry init/clone impls (a host sync inside any of them would
+        # fetch the device-persistent state every span — the exact
+        # round-trip residency exists to eliminate).
+        "_resident_*",
     ),
     "pivot_tpu/ops/shard.py": (
         "*_sharded_pass", "*_sharded_chunk*", "_sharded_chunk_drive",
@@ -54,6 +59,8 @@ DISCOVER: Dict[str, Tuple[str, ...]] = {
         # AND [G]-batched 2-D jit factories both wrap (a host sync here
         # would poison every sharded program at once).
         "*_sharded_body", "_span_fn_body",
+        # Round-20: the shard-resident donated-carry span body factory.
+        "_resident_span_fn_body",
     ),
     "pivot_tpu/parallel/ensemble/tick.py": ("_rollout_segment",),
     "pivot_tpu/search/fitness.py": ("_fitness_rows_impl", "_draw_rows_impl"),
@@ -66,10 +73,13 @@ REQUIRED: Dict[str, Tuple[str, ...]] = {
         "opportunistic_impl", "first_fit_impl", "best_fit_impl",
         "cost_aware_impl", "_speculate_commit",
     ),
-    "pivot_tpu/ops/tickloop.py": ("_fused_tick_run_impl",),
+    "pivot_tpu/ops/tickloop.py": (
+        "_fused_tick_run_impl", "_resident_span_run_impl",
+    ),
     "pivot_tpu/ops/shard.py": (
         "_sharded_span_body", "_two_stage_argmin",
         "_cost_aware_sharded_body", "_span_fn_body",
+        "_resident_span_fn_body",
     ),
     "pivot_tpu/parallel/ensemble/tick.py": ("_rollout_segment",),
     "pivot_tpu/search/fitness.py": ("_fitness_rows_impl",),

@@ -127,11 +127,24 @@ MANIFEST: Dict[str, Dict[str, Tuple[str, FrozenSet[str]]]] = {
 #: Span-driver family: one knob contract across the fused driver, the
 #: sequential referee, the host-sharded twin, and the round-17
 #: [G]-batched 2-D form.
+#: The resident span forms (round 20) carry ``live`` INSIDE the donated
+#: carry (edited via sparse ``edit_live`` rows, never re-staged) and
+#: replace the host-rendered ``risk_rows`` [K, H] with a once-staged
+#: ``risk_table`` [P, H] gathered by a per-span ``risk_seg`` [K] row —
+#: the knobs are absent because their STATE moved device-side, not
+#: because the feature is unreachable (tests/test_resident.py pins
+#: live/risk parity against the re-staged driver).
+_RESIDENT_EXEMPT = frozenset({"live", "risk_rows"})
+
 SPAN_MANIFEST: Dict[str, Tuple[str, FrozenSet[str]]] = {
     "fused_tick_run": (_TICKLOOP, frozenset()),
     "reference_tick_run": (_TICKLOOP, frozenset()),
     "sharded_fused_tick_run": (_SHARD, _SHARD_EXEMPT),
     "sharded_batched_tick_run": (_SHARD, _SHARD_EXEMPT),
+    "resident_span_run": (_TICKLOOP, _RESIDENT_EXEMPT),
+    "sharded_resident_span_run": (
+        _SHARD, _RESIDENT_EXEMPT | _SHARD_EXEMPT,
+    ),
 }
 
 #: Knobs the routing layer must forward per family (∩ the family's

@@ -52,8 +52,12 @@ RULE = "profiler-boundary"
 #: route through it); ``_execute`` is the batcher flush's per-group
 #: device call (``DispatchBatcher._flush`` delegates to it so the
 #: profiled span nests inside the flush span).
+#: ``_resident_dispatch`` is the resident tier's dispatch rung (round
+#: 20): it cannot route through ``_call_kernel`` because the donated
+#: carry must be threaded positionally and the returned carry captured
+#: — but it brackets exactly one device call, same as the others.
 BOUNDARIES: Dict[str, Tuple[str, ...]] = {
-    "pivot_tpu/sched/tpu.py": ("_call_kernel",),
+    "pivot_tpu/sched/tpu.py": ("_call_kernel", "_resident_dispatch"),
     "pivot_tpu/sched/batch.py": ("_execute",),
 }
 

@@ -597,6 +597,24 @@ def parse_args(argv=None):
                           "(trimmed per request, bit-identical); this "
                           "flag pins the round-17 same-shape-only "
                           "coalescing for A/B runs")
+    srv.add_argument("--resident", action=argparse.BooleanOptionalAction,
+                     default=False,
+                     help="resident span carries (round 20): keep each "
+                          "session's span state (availability, counts, "
+                          "live mask) device-persistent between spans, "
+                          "donated forward span to span, shipping only "
+                          "sparse mirror-diff deltas instead of the "
+                          "full O(H) re-staging (bit-identical "
+                          "placements; the serve_resident bench row is "
+                          "the A/B).  Skipped for policies without the "
+                          "tier (numpy backends).  --no-resident pins "
+                          "the re-staged path for A/B runs")
+    srv.add_argument("--splice-tier", type=int, default=0,
+                     help="with --resident: arrivals at or below this "
+                          "priority tier may join a RUNNING span via "
+                          "the checkpoint splice (re-run from the span-"
+                          "entry carry clone, prefix bitwise-verified); "
+                          "higher tiers wait for the flush boundary")
     srv.add_argument("--tenant-quota", type=float, default=0.0,
                      help="DRF tenant fairness within a tier: cap each "
                           "tenant's dominant-resource occupancy at "
@@ -1735,6 +1753,8 @@ def run_serve_stream(args) -> dict:
         mesh=mesh,
         tenant_quota=args.tenant_quota or None,
         ragged=not args.no_ragged,
+        resident=args.resident,
+        splice_tier=args.splice_tier,
     )
     metrics_server = None
     if args.metrics_port:

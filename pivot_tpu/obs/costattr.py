@@ -162,6 +162,34 @@ def _b_fused_tick_run(o):
     ), "scan"
 
 
+def _b_resident_span_run(o):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pivot_tpu.ops.tickloop import ResidentCarry, _resident_span_run
+
+    K = 4
+    H = o["avail"].shape[0]
+    carry = ResidentCarry(
+        o["avail"],
+        jnp.zeros((H,), jnp.int32),
+        jnp.ones((H,), bool),
+    )
+    arrive = jnp.asarray(
+        (np.arange(o["dem"].shape[0]) % K).astype(np.int32)
+    )
+    args = (
+        carry, None, None, None, None, o["dem"], arrive, jnp.int32(K),
+        None, None, None, None, None, None, None, None, None, None,
+        None, None, None,
+    )
+    return _resident_span_run, args, dict(
+        policy="first-fit", n_ticks=K, strict=False, decreasing=False,
+        bin_pack="first-fit", sort_tasks=False, sort_hosts=True,
+        host_decay=False, phase2="auto",
+    ), "scan"
+
+
 #: Builder registry: key → callable(operands) returning ``(jit entry
 #: point, positional args, static kwargs, analytic kind-or-None)``
 #: (``None`` = resolve the two-phase kind per backend).
@@ -175,6 +203,7 @@ _BUILDERS: Dict[str, Callable] = {
     "best_fit": _b_best_fit,
     "cost_aware": _b_cost_aware,
     "fused_tick_run": _b_fused_tick_run,
+    "resident_span_run": _b_resident_span_run,
 }
 
 
@@ -211,6 +240,17 @@ ENTRY_POINTS: Dict[Tuple[str, str], Tuple[str, str]] = {
         measure("cost_aware"),
     ("pivot_tpu/ops/tickloop.py", "_fused_tick_run"):
         measure("fused_tick_run"),
+    # -- round-20 resident span tier (device-persistent donated carry) ---
+    ("pivot_tpu/ops/tickloop.py", "_resident_span_run"):
+        measure("resident_span_run"),
+    ("pivot_tpu/ops/tickloop.py", "_resident_carry_init"): flag(
+        "O(H) carry staging, one call per scheduler bind (or geometry "
+        "change) — negligible next to the span driver it feeds"
+    ),
+    ("pivot_tpu/ops/tickloop.py", "_resident_carry_clone"): flag(
+        "O(H) device-side checkpoint copy taken before each spliceable "
+        "span — no host traffic; dwarfed by the span program it brackets"
+    ),
     # -- sharded twins: same program family, host-sharded over a mesh ----
     ("pivot_tpu/ops/shard.py", "_opportunistic_sharded_fn"): flag(
         "host-sharded twin of opportunistic_kernel (bit-identical by "
@@ -253,6 +293,15 @@ ENTRY_POINTS: Dict[Tuple[str, str], Tuple[str, str]] = {
     ("pivot_tpu/ops/shard.py", "_sharded_span_batched_fn"): flag(
         "[G]-batched 2-D form of _sharded_span_fn — see serve_sharded "
         "row"
+    ),
+    ("pivot_tpu/ops/shard.py", "_sharded_resident_span_fn"): flag(
+        "host-sharded twin of _resident_span_run (bit-identical by "
+        "tests/test_resident.py) — per-shard work attributed by the "
+        "single-device resident row, throughput by serve_resident"
+    ),
+    ("pivot_tpu/ops/shard.py", "_sharded_resident_init_fn"): flag(
+        "sharded carry staging, one call per bind — same story as "
+        "_resident_carry_init (see the resident_span_run measured row)"
     ),
     # -- Pallas: Mosaic programs, only meaningful on the TPU backend -----
     ("pivot_tpu/ops/pallas_kernels.py", "cost_aware_pallas"): flag(
@@ -405,7 +454,7 @@ def cost_attribution(
         model_kind = model_kind or (
             "slim" if backend == "cpu" else "scan"
         )
-        k = 4 if payload == "fused_tick_run" else 1
+        k = 4 if payload in ("fused_tick_run", "resident_span_run") else 1
         analytic = roofline.placement_cost(
             model_kind, T * k, H, dtype_bytes=4
         )

@@ -69,7 +69,7 @@ _TWO_PHASE = {
 }
 _SCAN_ONLY = {
     "opportunistic_ref", "first_fit_ref", "best_fit_ref",
-    "cost_aware_ref", "fused_tick_run",
+    "cost_aware_ref", "fused_tick_run", "resident_span_run",
 }
 _PALLAS = {"cost_aware_pallas", "cost_aware_pallas_batched"}
 
@@ -133,7 +133,7 @@ class _FamilyStats:
     """Streaming per-family latency stats + a bounded duration ring."""
 
     __slots__ = ("calls", "sampled", "total_s", "min_s", "max_s",
-                 "durs", "ratios")
+                 "durs", "ratios", "h2d_bytes")
 
     _RING = 1024  # bounded memory for quantiles on long soaks
 
@@ -145,6 +145,12 @@ class _FamilyStats:
         self.max_s = 0.0
         self.durs: List[float] = []
         self.ratios: List[float] = []
+        # Host→device bytes freshly staged for the dispatch, as declared
+        # by the boundary hook (round 20, the resident-carry ISSUE):
+        # accumulated for EVERY call — transfer volume is an exact
+        # caller-side count, not a sampled wall measurement — so the
+        # resident-vs-re-staged comparison is census-grade.
+        self.h2d_bytes = 0
 
     def record(self, dur: float, ratio: Optional[float]) -> None:
         self.sampled += 1
@@ -288,6 +294,7 @@ class DispatchProfiler:
         fn: Callable[[], Any],
         shape: Optional[Dict[str, int]] = None,
         flush: bool = False,
+        h2d_bytes: int = 0,
     ):
         """Run one dispatch thunk, timing it to completion when this
         call lands on the family's sampling cadence.
@@ -301,12 +308,17 @@ class DispatchProfiler:
         shape, backend, and analytic prediction.  ``flush=True`` marks
         spans recorded inside a batcher flush (``in_flush``), which
         ``obs_report --check`` requires to nest inside their
-        ``dispatch/flush`` parent span.
+        ``dispatch/flush`` parent span.  ``h2d_bytes`` is the caller's
+        count of operand bytes freshly staged host→device for THIS
+        dispatch (cached device buffers excluded) — accumulated on
+        every call, sampled or not, so transfer totals stay exact.
         """
         if not self.enabled:
             return fn()
         with self._lock:
             sampled = self._tick(family)
+            if h2d_bytes:
+                self._stats[family].h2d_bytes += int(h2d_bytes)
         if not sampled:
             return fn()
         import jax
@@ -343,6 +355,8 @@ class DispatchProfiler:
                 args["cold"] = True  # first sample: includes compile
             if flush:
                 args["in_flush"] = True
+            if h2d_bytes:
+                args["h2d_bytes"] = int(h2d_bytes)
             tracer.record_span("device", family, dur, **args)
         return out
 
@@ -359,6 +373,11 @@ class DispatchProfiler:
                     "calls": st.calls,
                     "sampled": st.sampled,
                 }
+                if st.h2d_bytes:
+                    row["h2d_bytes_total"] = st.h2d_bytes
+                    row["h2d_bytes_per_call"] = round(
+                        st.h2d_bytes / st.calls, 1
+                    )
                 if st.sampled:
                     row.update(
                         total_ms=round(st.total_s * 1e3, 3),
@@ -416,6 +435,12 @@ class DispatchProfiler:
             "is lying)",
             labelnames=("family", "backend"),
         )
+        registry.counter(
+            "pivot_dispatch_h2d_bytes_total",
+            "operand bytes freshly staged host->device at profiled "
+            "dispatch boundaries (cached device buffers excluded)",
+            labelnames=("family", "backend"),
+        )
         with self._lock:
             # Full snapshot under the lock: a --metrics-port scrape runs
             # concurrently with recording threads, and reading the
@@ -424,16 +449,20 @@ class DispatchProfiler:
             items = [
                 (
                     family, st.calls, st.sampled, st.total_s,
-                    list(st.durs), list(st.ratios),
+                    list(st.durs), list(st.ratios), st.h2d_bytes,
                 )
                 for family, st in sorted(self._stats.items())
             ]
-        for family, calls, sampled, total_s, durs, ratios in items:
+        for family, calls, sampled, total_s, durs, ratios, h2d in items:
             labels = dict(family=family, backend=backend)
             registry.set("pivot_dispatch_calls_total", calls, **labels)
             registry.set(
                 "pivot_dispatch_sampled_total", sampled, **labels
             )
+            if h2d:
+                registry.set(
+                    "pivot_dispatch_h2d_bytes_total", h2d, **labels
+                )
             if sampled:
                 registry.observe_summary(
                     "pivot_dispatch_latency_seconds",

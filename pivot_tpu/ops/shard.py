@@ -126,7 +126,9 @@ from pivot_tpu.ops.kernels import (
     opportunistic_kernel,
 )
 from pivot_tpu.ops.tickloop import (
+    ResidentCarry,
     SpanResult,
+    _resident_carry_init_impl,
     _span_group_entries,
     _span_ready_batch,
     _span_requeue,
@@ -152,6 +154,8 @@ __all__ = [
     "row_sharding",
     "sharded_batched_tick_run",
     "sharded_fused_tick_run",
+    "sharded_resident_carry_init",
+    "sharded_resident_span_run",
     "sharded_twin_of",
 ]
 
@@ -1632,6 +1636,197 @@ def sharded_batched_tick_run(
         avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
         anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
         base_task_counts, live, risk_rows, cost_stack, cost_seg,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharded resident span driver (the ``tickloop.resident_span_run`` twin)
+#
+# Same delta contract as the single-device resident driver — the carry
+# (shard-local availability, counts, live mask) stays device-resident
+# between spans, edits arrive as GLOBAL host indices each shard projects
+# into its own block (foreign rows drop), and the market risk rows are
+# gathered shard-locally from a once-staged [P, H] segment table.  The
+# carry is DONATED: like the 1-D resident driver (and unlike the
+# re-staged sharded span twin above), every carry a caller can hold is a
+# previous jit output, so the zero-copy hazard cannot occur.
+# ---------------------------------------------------------------------------
+
+_RESIDENT_CARRY_SPECS = ResidentCarry(
+    avail=_HOST_MAT, counts=_HOST_VEC, live=_HOST_VEC
+)
+
+_RESIDENT_IN_SPECS = (
+    _RESIDENT_CARRY_SPECS,  # carry
+    _REP,             # edit_idx [E] global host indices (or None)
+    P(None, None),    # edit_avail [E, 4] (or None)
+    _REP,             # edit_counts [E] (or None)
+    _REP,             # edit_live [E] (or None)
+    P(None, None),    # demands
+    _REP,             # arrive
+    P(),              # n_ticks_dyn
+    P(None, None),    # uniforms (or None)
+    _REP,             # sort_norm (or None)
+    _REP,             # anchor_zone (or None)
+    _REP,             # bucket_id (or None)
+    P(None, None),    # cost_zz (or None)
+    P(None, None),    # bw_zz (or None)
+    _HOST_VEC,        # host_zone (or None)
+    P(None, HOST_AXIS),   # risk_table [P, H] (or None)
+    _REP,                 # risk_seg [K] (or None)
+    P(None, None, None),  # cost_stack [P, Z, Z] (or None)
+    _REP,                 # cost_seg [K] (or None)
+)
+
+_RESIDENT_OUT_SPECS = (_SPAN_OUT_SPECS, _RESIDENT_CARRY_SPECS)
+
+
+def _resident_span_fn_body(mesh, policy, n_ticks, strict, decreasing,
+                           bin_pack, sort_tasks, sort_hosts, host_decay):
+    n = host_axis_size(mesh)
+
+    def fn(carry, edit_idx, edit_avail, edit_counts, edit_live, demands,
+           arrive, n_ticks_dyn, uniforms, sort_norm, anchor_zone,
+           bucket_id, cost_zz, bw_zz, host_zone, risk_table, risk_seg,
+           cost_stack, cost_seg):
+        avail, counts, live = carry
+        Hl = avail.shape[0]
+        offset = _shard_offset(Hl)
+        if edit_idx is not None:
+            # Global→local projection: rows owned elsewhere (and the
+            # pad rows, global index H) land outside [0, Hl) → dropped.
+            li = edit_idx - offset
+            li = jnp.where((li >= 0) & (li < Hl), li, Hl)
+            avail = avail.at[li].set(edit_avail, mode="drop")
+            counts = counts.at[li].set(edit_counts, mode="drop")
+            live = live.at[li].set(edit_live, mode="drop")
+        risk_rows = None if risk_seg is None else risk_table[risk_seg]
+        res = _sharded_span_body(
+            avail, demands, arrive, n_ticks_dyn, uniforms, sort_norm,
+            anchor_zone, bucket_id, cost_zz, bw_zz, host_zone,
+            counts, live, risk_rows, cost_stack, cost_seg,
+            policy=policy, n_ticks=n_ticks, n_shards=n, strict=strict,
+            decreasing=decreasing, bin_pack=bin_pack,
+            sort_tasks=sort_tasks, sort_hosts=sort_hosts,
+            host_decay=host_decay,
+        )
+        # Fold this span's placements into the shard-local count state
+        # (mirrors the tickloop resident driver's histogram fold).
+        placed = res.placements >= 0
+        local = res.placements - offset
+        mine = placed & (local >= 0) & (local < Hl)
+        tgt = jnp.where(mine, local, Hl)
+        hist = jnp.zeros((Hl,), jnp.int32).at[tgt.reshape(-1)].add(
+            mine.reshape(-1).astype(jnp.int32), mode="drop"
+        )
+        return res, ResidentCarry(res.avail, counts + hist, live)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_resident_span_fn(mesh, policy, n_ticks, strict, decreasing,
+                              bin_pack, sort_tasks, sort_hosts,
+                              host_decay):
+    fn = _resident_span_fn_body(mesh, policy, n_ticks, strict, decreasing,
+                                bin_pack, sort_tasks, sort_hosts,
+                                host_decay)
+    return jax.jit(
+        _shard_map(
+            fn, mesh=mesh,
+            in_specs=_RESIDENT_IN_SPECS,
+            out_specs=_RESIDENT_OUT_SPECS,
+            check_rep=False,
+        ),
+        # The carry IS donated — the sharded leg of the positive
+        # resident-carry manifest entry (analysis/donation.py): its
+        # leaves are always previous jit outputs, never zero-copy views
+        # of caller numpy.  Contrast ``_sharded_span_fn`` above.
+        donate_argnums=(0,),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_resident_init_fn(mesh):
+    sh = functools.partial(jax.sharding.NamedSharding, mesh)
+    return jax.jit(
+        _resident_carry_init_impl,
+        out_shardings=ResidentCarry(
+            avail=sh(_HOST_MAT), counts=sh(_HOST_VEC), live=sh(_HOST_VEC)
+        ),
+    )
+
+
+def sharded_resident_carry_init(mesh, avail, counts=None, live=None):
+    """Materialize a host-sharded :class:`ResidentCarry` from host state
+    — the one full [H]-sized staging of the sharded resident path.  The
+    outputs are device-owned copies laid out on ``mesh``'s host axis;
+    :func:`tickloop.resident_carry_clone` preserves that layout for
+    splice checkpoints."""
+    avail = jnp.asarray(avail)
+    H = avail.shape[0]
+    _check_host_axis(H, mesh)
+    if counts is None:
+        counts = jnp.zeros((H,), jnp.int32)
+    if live is None:
+        live = jnp.ones((H,), bool)
+    return _sharded_resident_init_fn(mesh)(
+        avail,
+        jnp.asarray(counts, jnp.int32),
+        jnp.asarray(live, bool),
+    )
+
+
+def sharded_resident_span_run(
+    mesh,
+    carry,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    *,
+    policy: str,
+    n_ticks: int,
+    edit_idx=None,
+    edit_avail=None,
+    edit_counts=None,
+    edit_live=None,
+    uniforms=None,
+    sort_norm=None,
+    anchor_zone=None,
+    bucket_id=None,
+    cost_zz=None,
+    bw_zz=None,
+    host_zone=None,
+    totals=None,
+    risk_table=None,
+    risk_seg=None,
+    cost_stack=None,
+    cost_seg=None,
+    strict: bool = False,
+    decreasing: bool = False,
+    bin_pack: str = "first-fit",
+    sort_tasks: bool = False,
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    phase2="auto",
+):
+    """Host-sharded :func:`tickloop.resident_span_run` — same delta
+    contract and ``(SpanResult, ResidentCarry)`` return, the carry kept
+    shard-resident between SPANS (not just between ticks).  ``edit_idx``
+    holds GLOBAL host indices; each shard projects them into its own
+    block.  ``totals``/``phase2`` accepted for signature compatibility
+    with the re-staged twin (speculation-free pass).  Bit-identical to
+    :func:`sharded_fused_tick_run` on the post-edit host state."""
+    _resolve_phase2(phase2)
+    _check_host_axis(carry.avail.shape[0], mesh)
+    return _sharded_resident_span_fn(
+        mesh, policy, n_ticks, bool(strict), bool(decreasing), bin_pack,
+        bool(sort_tasks), bool(sort_hosts), bool(host_decay),
+    )(
+        carry, edit_idx, edit_avail, edit_counts, edit_live, demands,
+        arrive, n_ticks_dyn, uniforms, sort_norm, anchor_zone, bucket_id,
+        cost_zz, bw_zz, host_zone, risk_table, risk_seg, cost_stack,
+        cost_seg,
     )
 
 

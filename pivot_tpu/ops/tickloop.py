@@ -84,12 +84,17 @@ from pivot_tpu.ops.kernels import (
 __all__ = [
     "RAGGED_AXES",
     "RAGGED_INVARIANT",
+    "ResidentCarry",
     "SpanResult",
+    "edit_bucket",
     "fused_tick_run",
     "ragged_span_pad",
     "ragged_span_signature",
     "ragged_span_trim",
     "reference_tick_run",
+    "resident_carry_clone",
+    "resident_carry_init",
+    "resident_span_run",
     "span_bucket",
 ]
 
@@ -406,6 +411,13 @@ _fused_tick_run = jax.jit(
     # snapshot the sequential referee reads).  The donation pass
     # enforces this decision in BOTH directions: adding donate_argnums
     # here is a finding until the manifest entry flips.
+    #
+    # The DONATING form of this driver is ``_resident_span_run`` below:
+    # its carry is always a previous jit OUTPUT (device-owned by
+    # construction — ``resident_carry_init`` materializes an explicit
+    # device copy before the first donation), so the zero-copy hazard
+    # structurally cannot occur there.  Callers that want buffer reuse
+    # go resident; this entry point stays the safe re-staged form.
 )
 
 
@@ -498,6 +510,326 @@ def fused_tick_run(
         totals,
         live,
         risk_rows,
+        cost_stack,
+        cost_seg,
+        score_exp,
+        policy=policy,
+        n_ticks=n_ticks,
+        strict=strict,
+        decreasing=decreasing,
+        bin_pack=bin_pack,
+        sort_tasks=sort_tasks,
+        sort_hosts=sort_hosts,
+        host_decay=host_decay,
+        phase2=phase2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Resident span carries — device-persistent serve state (round 20).
+#
+# ``fused_tick_run`` re-stages the full operand set from host numpy every
+# span and ships the availability carry back after every program; at serve
+# scale (H up to 100k hosts) the staging bytes, not the decisions, dominate
+# the span cost.  The resident entry point below keeps the span carry —
+# availability, per-host task counts, live mask — ON DEVICE between
+# consecutive spans and accepts only a small host-built *delta* per span:
+#
+#   * sparse host-row EDITS (chaos/live-mask flips, completion releases,
+#     any host divergence the caller's mirror-diff detects), padded to an
+#     edit bucket and scattered with ``mode="drop"`` inert padding;
+#   * the per-span slot operands (demands/arrive/norms/anchors), which are
+#     genuinely new each span and stay host-staged;
+#   * a market-segment GATHER: instead of rendering ``risk_rows`` [K, H]
+#     on the host per span (O(K*H) bytes), the caller stages the full
+#     per-segment risk table [P, H] ONCE and sends a [K] i32 segment row
+#     per span — the device gathers its own rows.
+#
+# The carry argument is DONATED (the manifest-declared positive entry in
+# ``analysis/donation.py`` — contrast the re-staged driver's negative
+# entry above): every carry a caller can hold is a previous jit OUTPUT
+# (``resident_carry_init``/``resident_carry_clone`` are themselves jitted
+# ``jnp.copy`` programs, so even the first carry is a device-owned copy,
+# never a zero-copy view of caller numpy).  The PR-11 hazard therefore
+# structurally cannot occur: XLA reuses only buffers the caller received
+# from XLA.  The caller-side discipline — never touch a carry after
+# passing it — is enforced by the donation pass's use-after-donate check
+# (``resident_span_run`` is a registered donating call).
+#
+# Mid-span splice rides the same machinery: the scheduler keeps a cloned
+# checkpoint of the span-entry carry, and a qualifying mid-span arrival
+# re-dispatches the WHOLE span from the checkpoint with the new slot
+# joined at ``arrive = k``.  The inert-join contract (a slot with
+# ``arrive > tick`` sorts last and places −1, exactly how pump cohorts
+# already enter mid-span) makes ticks [0, k) of the re-run bit-identical
+# to the committed prefix, so splice admission is bit-identical to the
+# flush-boundary referee replayed sequentially — the in-flight program's
+# result is simply discarded.
+# ---------------------------------------------------------------------------
+
+#: Static edit-row buckets: one XLA program per (edit bucket, B, K, H,
+#: config).  0 = the steady-state no-edit program (no scatter traced).
+_EDIT_BUCKETS = (0, 8, 32, 128, 512)
+
+
+def edit_bucket(n: int) -> int:
+    """Smallest edit-row bucket ≥ n (caps XLA program count per shape)."""
+    for b in _EDIT_BUCKETS:
+        if n <= b:
+            return b
+    return ((n + 511) // 512) * 512
+
+
+class ResidentCarry(NamedTuple):
+    """Device-resident serve state carried between consecutive spans.
+
+    Opaque to the host: callers obtain one from
+    :func:`resident_carry_init` / :func:`resident_carry_clone` /
+    :func:`resident_span_run` and must treat a carry passed to
+    :func:`resident_span_run` as CONSUMED (the buffers are donated).
+    ``live`` is always materialized — an all-True mask is bitwise
+    identity through ``_apply_live`` (``jnp.where(True, x, y) == x``),
+    so the no-quarantine case costs nothing and the traced program stays
+    shape-stable when quarantines come and go.
+    """
+
+    avail: jax.Array  # [H, 4] availability
+    counts: jax.Array  # [H] i32 resident-task counts (cost-aware decay)
+    live: jax.Array  # [H] bool quarantine mask (all-True when unused)
+
+
+def _resident_carry_init_impl(avail, counts, live):
+    # ``jnp.copy`` inside jit forces fresh DEVICE-OWNED output buffers:
+    # on the CPU backend a bare identity jit would alias the caller's
+    # numpy (the zero-copy hazard), and ``x + 0`` is not bitwise for
+    # -0.0.  These copies are what licenses donation downstream.
+    return ResidentCarry(jnp.copy(avail), jnp.copy(counts), jnp.copy(live))
+
+
+_resident_carry_init = jax.jit(_resident_carry_init_impl)
+
+
+def resident_carry_init(avail, counts=None, live=None) -> ResidentCarry:
+    """Materialize a device-owned :class:`ResidentCarry` from host state.
+
+    ``counts`` defaults to zeros, ``live`` to all-True.  This is the one
+    full [H]-sized staging the resident path pays; every subsequent span
+    ships only deltas.  The returned carry's buffers are explicit device
+    copies — safe to donate even though the inputs were host numpy.
+    """
+    avail = jnp.asarray(avail)
+    H = avail.shape[0]
+    if counts is None:
+        counts = np.zeros((H,), np.int32)
+    if live is None:
+        live = np.ones((H,), bool)
+    return _resident_carry_init(
+        avail,
+        jnp.asarray(counts, jnp.int32),
+        jnp.asarray(live, bool),
+    )
+
+
+def _resident_carry_clone_impl(carry):
+    avail, counts, live = carry
+    return ResidentCarry(jnp.copy(avail), jnp.copy(counts), jnp.copy(live))
+
+
+_resident_carry_clone = jax.jit(_resident_carry_clone_impl)
+
+
+def resident_carry_clone(carry: ResidentCarry) -> ResidentCarry:
+    """Independent device copy of ``carry`` (splice checkpoints).
+
+    The clone and the original are separately donate-able; cloning before
+    a speculative dispatch is how the scheduler keeps a rollback point
+    without violating the consumed-on-call contract.
+    """
+    return _resident_carry_clone(carry)
+
+
+def _resident_span_run_impl(
+    carry,
+    edit_idx,
+    edit_avail,
+    edit_counts,
+    edit_live,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    uniforms,
+    sort_norm,
+    anchor_zone,
+    bucket_id,
+    cost_zz,
+    bw_zz,
+    host_zone,
+    totals,
+    risk_table,
+    risk_seg,
+    cost_stack,
+    cost_seg,
+    score_exp,
+    *,
+    policy,
+    n_ticks,
+    strict,
+    decreasing,
+    bin_pack,
+    sort_tasks,
+    sort_hosts,
+    host_decay,
+    phase2,
+):
+    avail, counts, live = carry
+    H = avail.shape[0]
+    if edit_idx is not None:
+        # Sparse host-row repairs; pad rows carry index H → dropped.
+        avail = avail.at[edit_idx].set(edit_avail, mode="drop")
+        counts = counts.at[edit_idx].set(edit_counts, mode="drop")
+        live = live.at[edit_idx].set(edit_live, mode="drop")
+    # Market rows gathered on device from the once-staged segment table —
+    # bitwise the host-rendered ``risk_rows[k] = table[seg[k]]`` rows the
+    # re-staged arm ships, because both sides index the same f-dtype rows.
+    risk_rows = None if risk_seg is None else risk_table[risk_seg]
+    res = _fused_tick_run_impl(
+        avail,
+        demands,
+        arrive,
+        n_ticks_dyn,
+        uniforms,
+        sort_norm,
+        anchor_zone,
+        bucket_id,
+        cost_zz,
+        bw_zz,
+        host_zone,
+        counts,
+        totals,
+        live,
+        risk_rows,
+        cost_stack,
+        cost_seg,
+        score_exp,
+        policy=policy,
+        n_ticks=n_ticks,
+        strict=strict,
+        decreasing=decreasing,
+        bin_pack=bin_pack,
+        sort_tasks=sort_tasks,
+        sort_hosts=sort_hosts,
+        host_decay=host_decay,
+        phase2=phase2,
+    )
+    # Fold the span's own placements into the resident count state so the
+    # steady state (no completions between spans) needs zero edit rows;
+    # the caller's mirror-diff repairs completion decrements.
+    placed = res.placements >= 0
+    tgt = jnp.where(placed, res.placements, H)
+    hist = jnp.zeros((H,), jnp.int32).at[tgt.reshape(-1)].add(
+        placed.reshape(-1).astype(jnp.int32), mode="drop"
+    )
+    return res, ResidentCarry(res.avail, counts + hist, live)
+
+
+_resident_span_run = jax.jit(
+    _resident_span_run_impl,
+    static_argnames=(
+        "policy",
+        "n_ticks",
+        "strict",
+        "decreasing",
+        "bin_pack",
+        "sort_tasks",
+        "sort_hosts",
+        "host_decay",
+        "phase2",
+    ),
+    # The carry IS donated — the declared positive manifest entry in
+    # ``analysis/donation.py`` (resident-span-carry).  Safe because the
+    # carry pytree is always jit output (see the section comment above);
+    # the use-after-donate caller check polices the host side.
+    donate_argnums=(0,),
+)
+
+
+def resident_span_run(
+    carry: ResidentCarry,
+    demands,
+    arrive,
+    n_ticks_dyn,
+    *,
+    policy: str,
+    n_ticks: int,
+    edit_idx=None,
+    edit_avail=None,
+    edit_counts=None,
+    edit_live=None,
+    uniforms=None,
+    sort_norm=None,
+    anchor_zone=None,
+    bucket_id=None,
+    cost_zz=None,
+    bw_zz=None,
+    host_zone=None,
+    totals=None,
+    risk_table=None,
+    risk_seg=None,
+    cost_stack=None,
+    cost_seg=None,
+    score_exp=None,
+    strict: bool = False,
+    decreasing: bool = False,
+    bin_pack: str = "first-fit",
+    sort_tasks: bool = False,
+    sort_hosts: bool = True,
+    host_decay: bool = False,
+    phase2="auto",
+):
+    """Run one fused span against a device-resident carry.
+
+    The delta contract (vs :func:`fused_tick_run`'s full re-staging):
+
+      carry        ResidentCarry — CONSUMED (donated); use the returned
+                   carry for the next span.  Never re-read after the call.
+      edit_idx     [E] i32 host-row indices to repair before the span
+                   (pad entries = H, dropped), or None for the
+                   steady-state no-edit program
+      edit_avail   [E, 4] replacement availability rows
+      edit_counts  [E] i32 replacement resident-task counts
+      edit_live    [E] bool replacement quarantine-mask entries
+      risk_table   [P, H] per-market-segment eviction-risk rows, staged
+                   once per market epoch (or None)
+      risk_seg     [K] i32 per-tick segment index into ``risk_table``
+                   (or None → no risk shaping this span)
+
+    Per-span slot operands (``demands``/``arrive``/``uniforms``/
+    ``sort_norm``/``anchor_zone``/``bucket_id``) and the static config
+    match :func:`fused_tick_run` exactly; ``base_task_counts`` and
+    ``live`` come from the carry instead of keywords.  Returns
+    ``(SpanResult, ResidentCarry)`` where the result is bit-identical to
+    ``fused_tick_run`` on the post-edit host state — the resident parity
+    suite's contract (``tests/test_resident.py``).
+    """
+    return _resident_span_run(
+        carry,
+        edit_idx,
+        edit_avail,
+        edit_counts,
+        edit_live,
+        demands,
+        arrive,
+        n_ticks_dyn,
+        uniforms,
+        sort_norm,
+        anchor_zone,
+        bucket_id,
+        cost_zz,
+        bw_zz,
+        host_zone,
+        totals,
+        risk_table,
+        risk_seg,
         cost_stack,
         cost_seg,
         score_exp,

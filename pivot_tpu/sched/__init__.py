@@ -346,16 +346,19 @@ class SpanPlan:
     """
 
     __slots__ = (
-        "ctx", "grid", "slots", "arrive", "pump_ticks", "epoch", "outcome",
+        "ctx", "grid", "slots", "arrive", "pump_ticks", "epoch", "disturb",
+        "outcome",
     )
 
-    def __init__(self, ctx, grid, slots, arrive, pump_ticks, epoch):
+    def __init__(self, ctx, grid, slots, arrive, pump_ticks, epoch,
+                 disturb=0):
         self.ctx = ctx
         self.grid = grid  # [K] exact tick instants (iterated fl-adds)
         self.slots = slots  # [S] Task — ready batch, then cohorts
         self.arrive = arrive  # [S] int — delivery tick per slot
         self.pump_ticks = pump_ticks  # delivery tick per folded pump
         self.epoch = epoch  # span epoch at extraction
+        self.disturb = disturb  # disturbance epoch at extraction
         self.outcome = None
 
     @property
@@ -450,6 +453,21 @@ class GlobalScheduler(LogMixin):
         #: commits precomputed ticks only while this stays unchanged; any
         #: bump aborts the remaining span (the committed prefix is exact).
         self._span_epoch = 0
+        #: Disturbance sub-counter (round 20): the epoch bumps that are
+        #: NOT pure arrivals — completions, withdrawals, preemption
+        #: drains.  A span-epoch mismatch with this unchanged means the
+        #: only in-window mutations were submissions + pump fires, which
+        #: is the mid-span-splice qualifying condition: the new work can
+        #: be JOINED into the running span (``_try_splice``) instead of
+        #: aborting it.  Any disturbance still aborts exactly as before.
+        self._disturb_epoch = 0
+        #: Mid-span-splice admission gate: an optional ``task -> bool``
+        #: predicate every mid-span arrival must pass before the running
+        #: span re-runs with it joined.  The serve driver points this at
+        #: its tier policy (tier-0 latency-critical sessions splice;
+        #: batch tiers wait for the flush boundary).  ``None`` admits
+        #: every arrival (when the policy has splice enabled at all).
+        self.splice_gate = None
         #: Serving's SLO-checkpoint span bound (round 17,
         #: ``fuse_spans="slo"``): an optional zero-arg callable returning
         #: a sim-time horizon spans must not cross.  The serve driver
@@ -483,6 +501,9 @@ class GlobalScheduler(LogMixin):
             # dispatch, so summary key sets match across serve arms.
             "span_ticks_max": 0,
             "span_ticks_sum": 0,
+            # Mid-span splices committed (round 20): arrivals joined into
+            # a RUNNING span without waiting for the flush boundary.
+            "span_splices": 0,
         }
         policy.bind(self)
 
@@ -582,6 +603,7 @@ class GlobalScheduler(LogMixin):
         # speculated over — and wakes the loop's fast-forward sleep path
         # conservatively via the epoch bump at its next check.
         self._span_epoch += 1
+        self._disturb_epoch += 1
         self.tracer.emit("app", "withdrawn", self.env.now, id=app.id)
         return True
 
@@ -647,6 +669,7 @@ class GlobalScheduler(LogMixin):
                     "task", "migrated", env.now, id=task.id, host=host.id
                 )
             self._span_epoch += 1
+            self._disturb_epoch += 1
         # Restart doomed running residents under the retry governor.
         executor = getattr(self.cluster, "executor", None)
         if executor is not None and lead >= 0:
@@ -659,6 +682,7 @@ class GlobalScheduler(LogMixin):
                         id=task.id, host=host.id,
                     )
                 self._span_epoch += 1
+                self._disturb_epoch += 1
 
     # -- the tick loop ---------------------------------------------------
     def _dispatch_loop(self):
@@ -883,7 +907,7 @@ class GlobalScheduler(LogMixin):
         if not any_delivery:
             return None
         plan = SpanPlan(ctx, grid, slots, arrive, pump_ticks,
-                        self._span_epoch)
+                        self._span_epoch, self._disturb_epoch)
         outcome = place_span(ctx, plan)
         if outcome is None:
             self.span_stats["spans_declined"] += 1
@@ -919,8 +943,21 @@ class GlobalScheduler(LogMixin):
                     1 for pt in plan.pump_ticks if pt <= k
                 )
                 if self._span_epoch != expected or not self.is_active:
-                    self.span_stats["span_aborts"] += 1
-                    return True
+                    new = self._try_splice(plan, k, slot_of)
+                    if new is None:
+                        self.span_stats["span_aborts"] += 1
+                        return True
+                    # Splice committed: the running span's universe now
+                    # includes the mid-span arrivals (joined at tick k)
+                    # and the outcome matrix was re-run from the resident
+                    # checkpoint — adopt both and keep replaying.
+                    slots = plan.slots
+                    for t in new:
+                        slot_of[t] = len(slot_of)
+                    placements = plan.outcome.placements
+                    if decreasing:
+                        dem = np.stack([t.demand for t in slots])
+                        norms = np.sqrt(np.sum(dem * dem, axis=1))
                 ready_k = []
                 while self._wait_stack:
                     ready_k.append(self._wait_stack.pop())
@@ -974,6 +1011,64 @@ class GlobalScheduler(LogMixin):
                 visit = list(range(len(ready_k)))
             self._dispatch_tick(ctx, ready_k, pl, visit)
         return False
+
+    def _try_splice(self, plan: SpanPlan, k: int, slot_of) -> Optional[list]:
+        """Attempt a mid-span splice at replay tick ``k`` (round 20).
+
+        Runs inside ``_serve_span``'s epoch-mismatch branch: the span
+        speculated past a scheduler-visible mutation.  When that
+        mutation is PURELY new arrivals (submissions + their pump
+        fires — the disturbance epoch unchanged), the arrivals can be
+        joined into the RUNNING span instead of aborting it: the policy
+        re-runs the span from its resident span-entry checkpoint with
+        the new slots joined at ``arrive = k``
+        (``sched/tpu.py:span_splice``), verifies the committed prefix
+        bit-identical, and hands back the extended placements matrix.
+        On success the plan's universe/outcome are extended in place,
+        the epoch re-anchored, and the replay continues — batch
+        membership changed INSIDE the span.  Returns the joined tasks,
+        or None to decline (the caller aborts exactly as before; the
+        queues are only PEEKED here, never drained, so a decline leaves
+        every task where the live tick expects it).
+
+        Declines when: the policy has no splice support or checkpoint,
+        any disturbance landed (completions / withdraw / preempt
+        drain), a folded cohort also lands at tick ``k`` (its
+        submit-queue drain order would interleave with the arrivals,
+        while slot order cannot), the gate rejects an arrival, or the
+        prefix check fails."""
+        policy_splice = getattr(self.policy, "span_splice", None)
+        if policy_splice is None or not self.is_active:
+            return None
+        if self._disturb_epoch != plan.disturb:
+            return None
+        if any(pt == k for pt in plan.pump_ticks):
+            return None
+        if any(t not in slot_of for t in self._wait_stack):
+            return None  # foreign task in the wait stack — not a pure join
+        new = [t for t in self.submit_q.items if t not in slot_of]
+        if not new or any(not t.is_nascent for t in new):
+            return None
+        gate = self.splice_gate
+        if gate is not None and not all(gate(t) for t in new):
+            return None
+        pl = policy_splice(plan.ctx, plan, k, new)
+        if pl is None:
+            return None
+        plan.slots = list(plan.slots) + new
+        plan.arrive = list(plan.arrive) + [k] * len(new)
+        plan.outcome.placements = pl
+        # Re-anchor: future expected-epoch checks count folded pumps
+        # STRICTLY AFTER k on top of the epoch as of this commit.
+        plan.epoch = self._span_epoch - sum(
+            1 for pt in plan.pump_ticks if pt <= k
+        )
+        self.span_stats["span_splices"] += 1
+        self.tracer.emit(
+            "scheduler", "span_splice", self.env.now, tick=k,
+            joined=len(new),
+        )
+        return new
 
     def _reschedule_ff_wake(self) -> None:
         """Pull a pending fast-forward wake back to the first grid tick
@@ -1118,6 +1213,7 @@ class GlobalScheduler(LogMixin):
     def _handle_notification(self, item):
         env = self.env
         self._span_epoch += 1  # completions invalidate speculated spans
+        self._disturb_epoch += 1
         success, task = item
         app = task.application
         if app is None:

@@ -264,6 +264,27 @@ def batch_execute(
     g = len(requests)
     if g == 0:
         return []
+    # Resident-carry firewall (round 20): the batcher re-stages every
+    # operand from host numpy at the flush boundary (``stack`` below) —
+    # a device-persistent ResidentCarry riding through here would be
+    # silently fetched, copied, and severed from its donation chain,
+    # defeating residency while APPEARING to work.  The policy layer
+    # rejects the combination at enable time (``sched/tpu.py``); this
+    # structural check is the belt-and-braces for direct callers.
+    from pivot_tpu.ops.tickloop import ResidentCarry
+
+    for req_args, req_kw in requests:
+        if any(isinstance(a, ResidentCarry) for a in req_args) or any(
+            isinstance(v, ResidentCarry) for v in req_kw.values()
+        ):
+            raise TypeError(
+                "batch_execute cannot serve a resident-carry dispatch: "
+                "the flush boundary re-stages operands from host numpy, "
+                "which would sever the carry's device-donation chain — "
+                "use ops.tickloop.resident_span_run directly (the "
+                "resident tier and the cross-run batcher are mutually "
+                "exclusive)"
+            )
     if g == 1:
         args, arr_kw = requests[0]
         if mesh is not None:
@@ -700,8 +721,19 @@ class DispatchBatcher:
         )
         if pad:
             shape["ragged_pad_cells"] = pad
+        # Staged-operand bytes (round 20): every member's args + array
+        # kwargs re-enter the device from host numpy at this flush —
+        # the re-staged arm's per-span transfer bill, the number the
+        # ``serve_resident`` bench row compares against the resident
+        # tier's delta shipping.
+        h2d = sum(
+            int(getattr(a, "nbytes", 0))
+            for r in reqs
+            for a in (*r.args, *r.arr_kw.values())
+        )
         return prof.profile(
-            family_of(reqs[0].kernel), call, shape=shape, flush=True
+            family_of(reqs[0].kernel), call, shape=shape, flush=True,
+            h2d_bytes=h2d,
         )
 
     def _fallback_cause(self, req: "_Request", fragmented: bool) -> str:

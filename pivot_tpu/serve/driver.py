@@ -157,6 +157,8 @@ class ServeDriver(LogMixin):
         mesh=None,
         tenant_quota: Optional[float] = None,
         ragged: bool = True,
+        resident: bool = False,
+        splice_tier: int = 0,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
@@ -240,6 +242,20 @@ class ServeDriver(LogMixin):
         #: identical by the inert-tail contract).  ``False`` keeps the
         #: PR-15 exact-shape coalescing — the bench A/B arm.
         self.ragged = bool(ragged)
+        #: Resident span carries (round 20): every session policy keeps
+        #: its [H] span state device-persistent between spans
+        #: (``sched/tpu.py:enable_resident``) and ships per-span deltas
+        #: instead of full re-staged operands.  Mutually exclusive with
+        #: the shared DispatchBatcher (whose flush re-stages every
+        #: operand from host numpy — exactly the cost residency
+        #: removes), so a resident pool runs its sessions free; the
+        #: ``serve_resident`` bench row is the A/B.  ``splice_tier``
+        #: gates MID-SPAN admission: an arrival whose tier is at most
+        #: this joins a running span via the resident checkpoint splice
+        #: (``GlobalScheduler.splice_gate``); higher tiers wait for the
+        #: flush boundary as before.
+        self.resident = bool(resident)
+        self.splice_tier = int(splice_tier)
         self.routing = routing
         self.preempt = preempt
         self.preempt_timeout = preempt_timeout
@@ -615,6 +631,8 @@ class ServeDriver(LogMixin):
             client = self.batcher.respawn_client()
             new.policy.enable_batching(client)
             new.slot = client.slot
+        elif self.resident:
+            self._enable_resident(new)
         new._client = client
         thread = threading.Thread(
             target=new.loop, args=(client,),
@@ -1104,6 +1122,25 @@ class ServeDriver(LogMixin):
             for s in pool:
                 s.shutdown()
 
+    def _splice_gate(self, task) -> bool:
+        """Mid-span admission predicate handed to every session's
+        scheduler: only arrivals at or below ``splice_tier`` may join a
+        RUNNING span (latency-critical work skips the flush-boundary
+        wait); everything else aborts the span exactly as before."""
+        return (
+            int(getattr(task.application, "_serve_tier", 0))
+            <= self.splice_tier
+        )
+
+    def _enable_resident(self, s: ServeSession) -> None:
+        """(cv held) Turn the resident span tier on for one session:
+        device-persistent carry on the policy, tier-gated mid-span
+        splice on its scheduler.  Skips policies without the tier
+        (numpy arms serve per-tick regardless)."""
+        if hasattr(s.policy, "enable_resident"):
+            s.policy.enable_resident()
+            s.scheduler.splice_gate = self._splice_gate
+
     def _batching_compatible(self) -> bool:
         """(cv held) Whether the pool can share a DispatchBatcher: every policy
         batchable (device-backed, deterministic routing), and — when
@@ -1157,7 +1194,17 @@ class ServeDriver(LogMixin):
         started: List[threading.Thread] = []
         with self._cv:
             clients = [None] * len(self.sessions)
-            if self._batching_compatible():
+            if self.resident:
+                # Resident pool: no batcher (see __init__) — but the
+                # backend still initializes HERE, once, before any
+                # session thread's first dispatch (concurrent
+                # first-touch PJRT client creation is not safe).
+                import jax
+
+                jax.default_backend()
+                for s in self.sessions:
+                    self._enable_resident(s)
+            elif self._batching_compatible():
                 # Initialize the backend once, here, before any session
                 # thread dispatches — concurrent first-touch PJRT client
                 # creation is not safe (same guard as run_grid_lockstep).
@@ -1310,6 +1357,8 @@ class ServeDriver(LogMixin):
             "queue_depth": self.queue.depth,
             "flush_after_s": self.flush_after,
             "ragged": self.ragged,
+            "resident": self.resident,
+            "splice_tier": self.splice_tier,
             "routing": self.routing,
             "preempt": self.preempt,
             "tenant_quota": self.queue.tenant_quota,

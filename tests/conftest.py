@@ -157,6 +157,16 @@ _SLOW_TESTS = {
         # Quick twin in tier 1: test_full_sim_parity_smoke_opportunistic.
         "test_full_sim_parity_opportunistic",
     ],
+    "test_resident.py": [
+        # Quick twins in tier 1: test_resident_span_parity_quick,
+        # test_des_resident_bit_parity_quick,
+        # test_resident_splice_parity_quick (stops at the first
+        # confirmed splice).  The sweeps also carry the ``fused``
+        # marker (-m fused).
+        "test_resident_span_parity_sweep_full",
+        "test_des_resident_bit_parity_full",
+        "test_resident_splice_parity_full",
+    ],
     "test_sensitivity.py": ["test_cli_sensitivity_paired_experiment"],
     "test_shard.py": [
         # Quick twins in tier 1: test_sharded_parity_h1024 (the H=1024

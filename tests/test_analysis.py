@@ -738,6 +738,46 @@ def test_donation_catches_forbidden_donation(tmp_path):
     ), "\n".join(str(f) for f in findings)
 
 
+def test_donation_catches_dropped_resident_donation(tmp_path):
+    """Round 20: stripping donate_argnums from the resident span
+    driver is flagged BY NAME — the resident-span-carry manifest entry
+    declares the donation, so losing it is a two-copies-per-span
+    regression, not a silent style change."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/ops/tickloop.py"
+    text = p.read_text()
+    mutated = text.replace("    donate_argnums=(0,),\n", "", 1)
+    assert mutated != text
+    p.write_text(mutated)
+    findings = run(root=root, rules=["donation"])
+    assert any(
+        "resident-span-carry" in f.message
+        and "does not donate" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
+def test_donation_resident_use_after_donate_bites(tmp_path):
+    """A caller reading the carry it just fed to resident_span_run is
+    reading a deleted buffer — the use-after-donate check must bite on
+    the resident call names exactly as it does for the ensemble
+    segment carry."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/ops/tickloop.py"
+    p.write_text(p.read_text() + textwrap.dedent("""\n
+        def _bad_resident_caller(carry, dem, arrive, k):
+            res, fresh = resident_span_run(
+                carry, dem, arrive, k, policy="first-fit", n_ticks=4,
+            )
+            return res, carry.avail
+    """))
+    findings = run(root=root, rules=["donation"])
+    assert any(
+        "use-after-donate" in f.message and "'carry'" in f.message
+        for f in findings
+    ), "\n".join(str(f) for f in findings)
+
+
 def test_retrace_flags_unregistered_jit_file(tmp_path):
     """jitmap discovery: a NEW file growing a jax.jit wrapper must join
     JIT_FILES or the sweep flags it (register-or-flag, like parity)."""

@@ -1,0 +1,662 @@
+"""Resident-carry span parity (round 20, ``ops/tickloop.py``).
+
+Three layers of contract, mirroring the resident section of
+``ops/tickloop.py``'s docstring:
+
+  * **kernel parity** — ``resident_span_run`` (device-persistent carry,
+    donated forward span to span, sparse edit-row repairs, once-staged
+    risk table) is bit-identical — placements, availability, meter
+    inputs — to ``fused_tick_run`` on the equivalent re-staged host
+    state, across every policy config, phase-2 mode, live mask, risk
+    shaping, and multi-span chains with the carry's own histogram fold.
+  * **DES parity** — a full simulation with ``enable_resident()`` is
+    bit-identical end to end (placements, app end times, tick counts,
+    meter totals) to the re-staged fused-span path, including chaos
+    live-mask flips (surface as mirror-diff edit rows), market risk
+    shaping, and the host-sharded composition.
+  * **splice parity** — a qualifying mid-span arrival joined into the
+    RUNNING span (``span_splice``: checkpoint clone, re-run, prefix
+    bitwise check) leaves the simulation bit-identical to the
+    ``fuse_spans=False`` sequential referee.
+
+Plus the serving-economics invariant the bench row gates: zero
+recompiles/retraces after warmup — the resident program's shapes are
+span-invariant, so steady-state serving never re-traces.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.faults import FaultInjector
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.market import MarketSchedule
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.ops.shard import (
+    sharded_resident_carry_init,
+    sharded_resident_span_run,
+)
+from pivot_tpu.ops.tickloop import (
+    fused_tick_run,
+    resident_carry_clone,
+    resident_carry_init,
+    resident_span_run,
+    span_bucket,
+)
+from pivot_tpu.parallel.mesh import host_sharded_mesh
+from pivot_tpu.sched import GlobalScheduler
+from pivot_tpu.sched.tpu import (
+    TpuBestFitPolicy,
+    TpuCostAwarePolicy,
+    TpuFirstFitPolicy,
+    TpuOpportunisticPolicy,
+)
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.utils.compile_counter import count_compiles
+from pivot_tpu.workload import Application, TaskGroup
+
+MESH = host_sharded_mesh(8)
+
+# --------------------------------------------------------------------------
+# Kernel-level parity: resident_span_run vs fused_tick_run re-staging
+# --------------------------------------------------------------------------
+
+H, B, K_FULL = 12, 32, 16
+Z = 3
+P_SEG = 6  # market segments in the once-staged risk table
+
+_POLICY_CONFIGS = {
+    "opportunistic": dict(policy="opportunistic"),
+    "first_fit": dict(policy="first-fit", strict=False),
+    "first_fit_decreasing": dict(
+        policy="first-fit", strict=False, decreasing=True
+    ),
+    "best_fit": dict(policy="best-fit"),
+    "best_fit_decreasing": dict(policy="best-fit", decreasing=True),
+    "cost_aware_ff": dict(policy="cost-aware", bin_pack="first-fit",
+                          sort_tasks=True),
+    "cost_aware_bf_decay": dict(policy="cost-aware", bin_pack="best-fit",
+                                host_decay=True),
+}
+
+
+def _span_inputs(n_hosts=H, seed=0):
+    rng = np.random.default_rng(seed)
+    avail = rng.uniform(1, 6, (n_hosts, 4))
+    dem = rng.uniform(0.3, 2.5, (B, 4))
+    arrive = np.zeros(B, np.int32)
+    arrive[20:26] = 2
+    arrive[26:32] = 5
+    norms = np.sqrt((dem * dem).sum(1))
+    uniforms = jnp.asarray(rng.random((K_FULL, B)))
+    return avail, dem, arrive, norms, uniforms
+
+
+def _ca_tables(n_hosts=H, seed=7):
+    rng = np.random.default_rng(seed)
+    return dict(
+        cost_zz=jnp.asarray(rng.uniform(0.01, 0.2, (Z, Z))),
+        bw_zz=jnp.asarray(rng.uniform(50, 500, (Z, Z))),
+        host_zone=jnp.asarray(rng.integers(0, Z, n_hosts), dtype=jnp.int32),
+        base_task_counts=jnp.asarray(
+            rng.integers(0, 3, n_hosts), dtype=jnp.int32
+        ),
+        anchor_zone=jnp.asarray(rng.integers(0, Z, B).astype(np.int32)),
+        bucket_id=jnp.asarray(rng.integers(0, 5, B).astype(np.int32)),
+    )
+
+
+def _risk_tables(n_hosts=H, n_ticks=8, seed=11):
+    """(risk_table [P, H], risk_seg [K]) plus the equivalent host-rendered
+    ``risk_rows[k] = table[seg[k]]`` rows the re-staged arm ships."""
+    rng = np.random.default_rng(seed)
+    table = rng.uniform(0.0, 0.4, (P_SEG, n_hosts))
+    seg = rng.integers(0, P_SEG, n_ticks).astype(np.int32)
+    return jnp.asarray(table), jnp.asarray(seg), jnp.asarray(table[seg])
+
+
+def _split_kw(config_kw, n_ticks, phase2, norms, uniforms, n_hosts=H,
+              risk=False):
+    """(shared static config, fused-only kw, resident-only kw, counts).
+
+    The fused arm takes ``base_task_counts``/``live``/``risk_rows``
+    keywords; the resident arm carries counts/live in the donated carry
+    and gathers risk rows on device from the once-staged table.
+    """
+    kw = dict(config_kw)
+    kw["uniforms"] = uniforms[:span_bucket(n_ticks)] if (
+        kw["policy"] == "opportunistic"
+    ) else None
+    kw["sort_norm"] = jnp.asarray(norms)
+    counts = np.zeros(n_hosts, np.int32)
+    if kw["policy"] == "cost-aware":
+        tables = _ca_tables(n_hosts)
+        counts = np.asarray(tables.pop("base_task_counts"))
+        kw.update(tables)
+    kw["phase2"] = phase2
+    fused_kw, res_kw = {}, {}
+    if risk:
+        table, seg, rows = _risk_tables(n_hosts, span_bucket(n_ticks))
+        fused_kw["risk_rows"] = rows
+        res_kw["risk_table"] = table
+        res_kw["risk_seg"] = seg
+    return kw, fused_kw, res_kw, counts
+
+
+def _assert_results_equal(res, ref, carry=None):
+    np.testing.assert_array_equal(
+        np.asarray(res.placements), np.asarray(ref.placements)
+    )
+    np.testing.assert_array_equal(np.asarray(res.avail), np.asarray(ref.avail))
+    np.testing.assert_array_equal(
+        np.asarray(res.n_placed), np.asarray(ref.n_placed)
+    )
+    assert int(res.ticks_run) == int(ref.ticks_run)
+    assert int(res.n_stack_final) == int(ref.n_stack_final)
+    if carry is not None:
+        # The returned carry IS the span's post state: the next span needs
+        # zero edit rows when nothing completed in between.
+        np.testing.assert_array_equal(
+            np.asarray(carry.avail), np.asarray(ref.avail)
+        )
+
+
+def _assert_resident_parity(config_kw, n_ticks, phase2, live=None,
+                            risk=False, seed=0):
+    avail, dem, arrive, norms, uniforms = _span_inputs(seed=seed)
+    kw, fused_kw, res_kw, counts = _split_kw(
+        config_kw, n_ticks, phase2, norms, uniforms, risk=risk
+    )
+    live_np = np.ones(H, bool) if live is None else np.asarray(live)
+    ref = fused_tick_run(
+        jnp.asarray(avail), jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(n_ticks, jnp.int32), n_ticks=span_bucket(n_ticks),
+        base_task_counts=jnp.asarray(counts),
+        live=None if live is None else jnp.asarray(live_np),
+        **fused_kw, **kw,
+    )
+    carry = resident_carry_init(jnp.asarray(avail), counts, live_np)
+    res, carry = resident_span_run(
+        carry, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(n_ticks, jnp.int32), n_ticks=span_bucket(n_ticks),
+        **res_kw, **kw,
+    )
+    _assert_results_equal(res, ref, carry)
+
+
+@pytest.mark.parametrize("config", sorted(_POLICY_CONFIGS))
+def test_resident_span_parity_quick(config):
+    """Tier-1 twin of the full sweep: every policy config, one span
+    length with mid-span cohorts, the CPU-default phase-2 mode."""
+    _assert_resident_parity(_POLICY_CONFIGS[config], n_ticks=8,
+                            phase2="auto")
+
+
+def test_resident_span_parity_live_quick():
+    """A quarantine mask riding the carry is bit-identical to the
+    re-staged ``live`` keyword."""
+    live = np.ones(H, bool)
+    live[3] = live[7] = False
+    _assert_resident_parity(
+        _POLICY_CONFIGS["cost_aware_ff"], n_ticks=8, phase2="auto",
+        live=live,
+    )
+    _assert_resident_parity(
+        _POLICY_CONFIGS["first_fit"], n_ticks=8, phase2="auto", live=live,
+    )
+
+
+def test_resident_span_parity_risk_quick():
+    """Device-gathered ``risk_table[risk_seg]`` rows are bitwise the
+    host-rendered ``risk_rows`` the re-staged arm ships."""
+    _assert_resident_parity(
+        _POLICY_CONFIGS["cost_aware_ff"], n_ticks=8, phase2="auto",
+        risk=True,
+    )
+    _assert_resident_parity(
+        _POLICY_CONFIGS["first_fit"], n_ticks=8, phase2="auto", risk=True,
+    )
+
+
+@pytest.mark.fused
+@pytest.mark.parametrize("config", sorted(_POLICY_CONFIGS))
+@pytest.mark.parametrize("phase2", ["scan", "slim", 8])
+def test_resident_span_parity_sweep_full(config, phase2):
+    """The acceptance sweep: every phase-2 mode (scan oracle, slim,
+    chunk commit) × every policy config × live × risk, resident
+    bit-identical to re-staged."""
+    live = np.ones(H, bool)
+    live[5] = False
+    _assert_resident_parity(_POLICY_CONFIGS[config], 8, phase2)
+    _assert_resident_parity(_POLICY_CONFIGS[config], 8, phase2, live=live)
+    _assert_resident_parity(_POLICY_CONFIGS[config], 8, phase2, risk=True)
+
+
+def test_resident_edit_rows_repair():
+    """Sparse edit rows repair the carry to the post-edit host state —
+    including pad entries (index H) which must be dropped — so the span
+    matches a full re-stage of that state."""
+    avail, dem, arrive, norms, uniforms = _span_inputs()
+    kw, _, _, counts = _split_kw(
+        _POLICY_CONFIGS["cost_aware_ff"], 8, "auto", norms, uniforms
+    )
+    carry = resident_carry_init(jnp.asarray(avail), counts)
+    # Host truth moved while the carry sat on device: a completion freed
+    # resources on rows 2 and 9, row 4 went into quarantine.
+    post = avail.copy()
+    post[2] += 0.7
+    post[9] += 1.3
+    post_counts = counts.copy()
+    post_counts[2] -= 1
+    post_live = np.ones(H, bool)
+    post_live[4] = False
+    edit_idx = np.array([2, 9, 4, H, H], np.int32)  # two pad rows
+    edit_avail = np.stack([post[2], post[9], post[4],
+                           np.zeros(4), np.zeros(4)]).astype(post.dtype)
+    edit_counts = np.array(
+        [post_counts[2], post_counts[9], post_counts[4], 0, 0], np.int32
+    )
+    edit_live = np.array([True, True, False, True, True])
+    ref = fused_tick_run(
+        jnp.asarray(post), jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), n_ticks=8,
+        base_task_counts=jnp.asarray(post_counts),
+        live=jnp.asarray(post_live), **kw,
+    )
+    res, carry = resident_span_run(
+        carry, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), n_ticks=8,
+        edit_idx=jnp.asarray(edit_idx),
+        edit_avail=jnp.asarray(edit_avail),
+        edit_counts=jnp.asarray(edit_counts),
+        edit_live=jnp.asarray(edit_live), **kw,
+    )
+    _assert_results_equal(res, ref, carry)
+
+
+def test_resident_multi_span_chain():
+    """Four spans chained through the donated carry — counts fold the
+    span's own placement histogram on device — match four full
+    re-stagings with the histogram applied host-side."""
+    avail, _, arrive, _, _ = _span_inputs()
+    rng = np.random.default_rng(3)
+    dems = rng.uniform(0.1, 0.8, (4, B, 4))
+    host_avail = avail.copy()
+    counts = np.zeros(H, np.int32)
+    carry = resident_carry_init(jnp.asarray(avail), counts)
+    for i in range(4):
+        norms = np.sqrt((dems[i] * dems[i]).sum(1))
+        kw, _, _, _ = _split_kw(
+            _POLICY_CONFIGS["cost_aware_ff"], 8, "auto",
+            norms, jnp.zeros((8, B)),
+        )
+        ref = fused_tick_run(
+            jnp.asarray(host_avail), jnp.asarray(dems[i]),
+            jnp.asarray(arrive), jnp.asarray(8, jnp.int32), n_ticks=8,
+            base_task_counts=jnp.asarray(counts), **kw,
+        )
+        res, carry = resident_span_run(
+            carry, jnp.asarray(dems[i]), jnp.asarray(arrive),
+            jnp.asarray(8, jnp.int32), n_ticks=8, **kw,
+        )
+        _assert_results_equal(res, ref, carry)
+        host_avail = np.asarray(ref.avail)
+        pl = np.asarray(ref.placements)
+        np.add.at(counts, pl[pl >= 0], 1)
+        np.testing.assert_array_equal(np.asarray(carry.counts), counts)
+
+
+def test_resident_carry_clone_is_independent():
+    """A splice checkpoint survives its parent being consumed: the clone
+    re-runs the span and reproduces the original result bitwise."""
+    avail, dem, arrive, norms, _ = _span_inputs()
+    kw, _, _, _ = _split_kw(
+        _POLICY_CONFIGS["first_fit"], 8, "auto", norms, jnp.zeros((8, B))
+    )
+    carry = resident_carry_init(jnp.asarray(avail))
+    ckpt = resident_carry_clone(carry)
+    res1, _ = resident_span_run(
+        carry, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), n_ticks=8, **kw,
+    )
+    res2, _ = resident_span_run(
+        ckpt, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), n_ticks=8, **kw,
+    )
+    _assert_results_equal(res2, res1)
+
+
+def test_resident_zero_recompiles_after_warmup():
+    """Steady-state serving never re-traces: after one warmup span, both
+    the edit and no-edit resident programs run compile-free."""
+    avail, dem, arrive, norms, _ = _span_inputs()
+    kw, _, _, _ = _split_kw(
+        _POLICY_CONFIGS["cost_aware_ff"], 8, "auto", norms,
+        jnp.zeros((8, B)),
+    )
+    run_kw = dict(n_ticks=8, **kw)
+    carry = resident_carry_init(jnp.asarray(avail))
+    _, carry = resident_span_run(
+        carry, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), **run_kw,
+    )
+    _, carry = resident_span_run(
+        carry, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32),
+        edit_idx=jnp.asarray(np.array([1], np.int32)),
+        edit_avail=jnp.asarray(avail[1:2]),
+        edit_counts=jnp.asarray(np.array([0], np.int32)),
+        edit_live=jnp.asarray(np.array([True])), **run_kw,
+    )
+    with count_compiles() as counter:
+        for i in range(3):
+            res, carry = resident_span_run(
+                carry, jnp.asarray(dem * (0.5 + 0.1 * i)),
+                jnp.asarray(arrive), jnp.asarray(8, jnp.int32), **run_kw,
+            )
+            res.placements.block_until_ready()
+        _, carry = resident_span_run(
+            carry, jnp.asarray(dem), jnp.asarray(arrive),
+            jnp.asarray(8, jnp.int32),
+            edit_idx=jnp.asarray(np.array([3], np.int32)),
+            edit_avail=jnp.asarray(avail[3:4]),
+            edit_counts=jnp.asarray(np.array([1], np.int32)),
+            edit_live=jnp.asarray(np.array([True])), **run_kw,
+        )
+        carry.avail.block_until_ready()
+    assert counter.compiles == 0, counter.compiles
+    assert counter.traces == 0, counter.traces
+
+
+# --------------------------------------------------------------------------
+# Sharded twin: the carry shard-resident between spans
+# --------------------------------------------------------------------------
+
+_H_SHARD = 16  # divisible by the conftest-forced 8-device mesh
+
+
+@pytest.mark.parametrize("config", ["first_fit", "cost_aware_ff"])
+def test_sharded_resident_span_parity_quick(config):
+    """``sharded_resident_span_run`` — global edit indices projected into
+    each shard's block, risk gathered shard-local — is bit-identical to
+    the single-device resident driver and the re-staged oracle."""
+    avail, dem, arrive, norms, uniforms = _span_inputs(_H_SHARD)
+    kw, fused_kw, res_kw, counts = _split_kw(
+        _POLICY_CONFIGS[config], 8, "auto", norms, uniforms,
+        n_hosts=_H_SHARD, risk=True,
+    )
+    edit_idx = np.array([1, 9, _H_SHARD], np.int32)  # rows in two shards + pad
+    post = avail.copy()
+    post[1] += 0.5
+    post[9] += 0.25
+    edit_avail = np.stack(
+        [post[1], post[9], np.zeros(4)]
+    ).astype(post.dtype)
+    edit_counts = np.asarray(counts)[[1, 9, 0]].astype(np.int32)
+    edit_live = np.array([True, True, True])
+    ref = fused_tick_run(
+        jnp.asarray(post), jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), n_ticks=8,
+        base_task_counts=jnp.asarray(counts), **fused_kw, **kw,
+    )
+    edits = dict(
+        edit_idx=jnp.asarray(edit_idx),
+        edit_avail=jnp.asarray(edit_avail),
+        edit_counts=jnp.asarray(edit_counts),
+        edit_live=jnp.asarray(edit_live),
+    )
+    carry_1d = resident_carry_init(jnp.asarray(avail), counts)
+    res_1d, carry_1d = resident_span_run(
+        carry_1d, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), n_ticks=8,
+        **edits, **res_kw, **kw,
+    )
+    carry_sh = sharded_resident_carry_init(MESH, jnp.asarray(avail), counts)
+    res_sh, carry_sh = sharded_resident_span_run(
+        MESH, carry_sh, jnp.asarray(dem), jnp.asarray(arrive),
+        jnp.asarray(8, jnp.int32), n_ticks=8,
+        **edits, **res_kw, **kw,
+    )
+    _assert_results_equal(res_1d, ref)
+    _assert_results_equal(res_sh, ref)
+    np.testing.assert_array_equal(
+        np.asarray(carry_sh.avail), np.asarray(carry_1d.avail)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(carry_sh.counts), np.asarray(carry_1d.counts)
+    )
+
+
+# --------------------------------------------------------------------------
+# DES-level parity: enable_resident() is bit-identical end to end
+# --------------------------------------------------------------------------
+
+
+def _build_cluster(env, meter, n_hosts=4, cpus=4.0):
+    meta = ResourceMetadata(seed=0)
+    zones = meta.zones
+    hosts = [
+        Host(env, cpus, 1024, 100, 1, locality=zones[i % 2], meter=meter,
+             id=f"h{i}")
+        for i in range(n_hosts)
+    ]
+    storage = [
+        Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)
+    ]
+    return Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, meter=meter,
+        route_mode="meta", seed=0, executor_backend="fast",
+    )
+
+
+def _chain_apps(n_apps=3):
+    return [
+        Application(f"app{i}", [
+            TaskGroup("a", cpus=1, mem=64, runtime=17.0, output_size=400,
+                      instances=10),
+            TaskGroup("b", cpus=2, mem=64, runtime=9.0,
+                      dependencies=["a"], instances=6),
+            TaskGroup("c", cpus=1, mem=32, runtime=5.0,
+                      dependencies=["b"], instances=8),
+        ])
+        for i in range(n_apps)
+    ]
+
+
+def _run_full_sim(policy_fn, fuse, resident=False, splice=True, chaos=False,
+                  market=False, n_hosts=4, late_at=None):
+    reset_ids()
+    env = Environment()
+    meta = ResourceMetadata(seed=0)
+    meter = Meter(env, meta)
+    cluster = _build_cluster(env, meter, n_hosts=n_hosts)
+    policy = policy_fn()
+    if resident:
+        policy.enable_resident(splice=splice)
+    mkt = None
+    if market:
+        mkt = MarketSchedule.generate(
+            meta, seed=5, horizon=400.0, n_segments=4, hot_fraction=0.3,
+            hot_hazard=1e-2, base_hazard=1e-4,
+        )
+    sched = GlobalScheduler(
+        env, cluster, policy, seed=3, meter=meter, fuse_spans=fuse,
+        market=mkt,
+    )
+    cluster.start()
+    sched.start()
+    if chaos:
+        injector = FaultInjector(cluster, seed=0)
+        injector.preempt_host(cluster.hosts[1].id, at=27.0, lead=6.0,
+                              outage=25.0)
+    apps = _chain_apps()
+    for a in apps:
+        sched.submit(a)
+    if late_at is not None:
+        # A mid-run submission at a DES instant that can land mid-span:
+        # the splice path's feedstock (driver-level "slo" windows end at
+        # the admission boundary, so only timed DES submissions splice).
+        env.run(until=late_at)
+        late = Application("late", [
+            TaskGroup("z", cpus=1, mem=32, runtime=4.0, instances=3),
+        ])
+        sched.submit(late)
+        apps = apps + [late]
+    sched.stop()
+    env.run()
+    placements = sorted(
+        (t.id, t.placement) for a in apps for g in a.groups for t in g.tasks
+    )
+    summary = (
+        placements,
+        [a.end_time for a in apps],
+        sched._tick_seq,
+        meter.total_scheduling_ops,
+        env.now,
+    )
+    return summary, dict(sched.span_stats), policy
+
+
+_DES_POLICIES = {
+    "first_fit": lambda: TpuFirstFitPolicy(),
+    "first_fit_decreasing": lambda: TpuFirstFitPolicy(decreasing=True),
+    "best_fit": lambda: TpuBestFitPolicy(),
+    "opportunistic": lambda: TpuOpportunisticPolicy(),
+    "cost_aware": lambda: TpuCostAwarePolicy(sort_tasks=True,
+                                             sort_hosts=True),
+}
+
+
+def _assert_des_resident_parity(policy_fn, **sim_kw):
+    base, stats0, _ = _run_full_sim(policy_fn, fuse=True, **sim_kw)
+    res, stats1, pol = _run_full_sim(
+        policy_fn, fuse=True, resident=True, **sim_kw
+    )
+    assert base == res
+    assert stats0 == stats1, (stats0, stats1)
+    # Every fused span actually rode the resident path.
+    assert pol._resident.spans == stats1["fused_spans"]
+    return stats1, pol
+
+
+@pytest.mark.parametrize("policy", ["first_fit", "cost_aware"])
+def test_des_resident_bit_parity_quick(policy):
+    """Tier-1: the resident DES run is bit-identical (placements, end
+    times, tick counts, meter totals) to the re-staged fused path."""
+    _assert_des_resident_parity(_DES_POLICIES[policy])
+
+
+@pytest.mark.fused
+@pytest.mark.parametrize("policy", sorted(_DES_POLICIES))
+def test_des_resident_bit_parity_full(policy):
+    _assert_des_resident_parity(_DES_POLICIES[policy])
+
+
+@pytest.mark.parametrize("phase2", ["slim", 8])
+def test_des_resident_phase2_parity_quick(phase2):
+    """The resident carry composes with every phase-2 commit mode."""
+    _assert_des_resident_parity(
+        lambda: TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True,
+                                   phase2=phase2)
+    )
+
+
+def test_des_resident_chaos_parity():
+    """A chaos-engine preemption flips the live mask mid-run: the flip
+    surfaces as mirror-diff edit rows and stays bit-identical.
+    ``cost_aware`` is the policy that fuses more than one span here, so
+    the second span actually exercises the repair path."""
+    stats, pol = _assert_des_resident_parity(
+        _DES_POLICIES["cost_aware"], chaos=True
+    )
+    # The inter-span state drift (completions + the quarantine flip)
+    # forced at least one mirror-diff repair row.
+    assert pol._resident.edit_rows > 0
+
+
+def test_des_resident_market_risk_parity():
+    """Risk-shaped scoring via the once-staged [P, H] table matches the
+    re-staged host-rendered rows through a full market simulation."""
+    _assert_des_resident_parity(
+        lambda: TpuCostAwarePolicy(sort_tasks=True, sort_hosts=True,
+                                   risk_weight=0.5),
+        market=True,
+    )
+
+
+def test_des_sharded_resident_parity():
+    """enable_sharding + enable_resident compose: the carry lives
+    shard-resident between spans, still bit-identical."""
+    def mk():
+        p = TpuFirstFitPolicy()
+        p.enable_sharding(MESH)
+        return p
+
+    _assert_des_resident_parity(mk, n_hosts=16)
+
+
+# --------------------------------------------------------------------------
+# Mid-span splice vs the sequential referee
+# --------------------------------------------------------------------------
+
+_SPLICE_INSTANTS = (3.0, 8.0, 12.0, 18.0, 22.0, 27.0, 33.0, 38.0, 43.0, 48.0)
+
+
+def _splice_sweep(policy_fn, instants):
+    """(splice count) — parity asserted at EVERY instant against the
+    ``fuse_spans=False`` sequential referee, spliced or not."""
+    total = 0
+    for t in instants:
+        plain, _, _ = _run_full_sim(policy_fn, fuse=False, late_at=t)
+        res, stats, _ = _run_full_sim(
+            policy_fn, fuse=True, resident=True, late_at=t
+        )
+        assert plain == res, f"splice parity broke at t={t}"
+        total += stats["span_splices"]
+    return total
+
+
+def test_resident_splice_parity_quick():
+    """Tiny splice soak: timed mid-run submissions across a band of
+    instants — every run bit-identical to the sequential referee, and at
+    least one instant actually joins a RUNNING span."""
+    total = 0
+    for t in _SPLICE_INSTANTS:
+        plain, _, _ = _run_full_sim(
+            _DES_POLICIES["first_fit"], fuse=False, late_at=t
+        )
+        res, stats, _ = _run_full_sim(
+            _DES_POLICIES["first_fit"], fuse=True, resident=True, late_at=t
+        )
+        assert plain == res, f"splice parity broke at t={t}"
+        total += stats["span_splices"]
+        if total:
+            break  # tier-1 stops at the first confirmed splice
+    assert total > 0, "no submission instant produced a splice"
+
+
+@pytest.mark.fused
+@pytest.mark.parametrize("policy",
+                         ["first_fit", "opportunistic", "cost_aware"])
+def test_resident_splice_parity_full(policy):
+    assert _splice_sweep(_DES_POLICIES[policy], _SPLICE_INSTANTS) > 0
+
+
+def test_resident_splice_off_never_splices():
+    """``enable_resident(splice=False)`` keeps the late submission at the
+    flush boundary — still bit-identical, zero splices."""
+    for t in (22.0, 27.0):
+        plain, _, _ = _run_full_sim(
+            _DES_POLICIES["first_fit"], fuse=False, late_at=t
+        )
+        res, stats, _ = _run_full_sim(
+            _DES_POLICIES["first_fit"], fuse=True, resident=True,
+            splice=False, late_at=t,
+        )
+        assert plain == res
+        assert stats["span_splices"] == 0

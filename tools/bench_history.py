@@ -155,9 +155,9 @@ TRACKED: Tuple[Metric, ...] = (
         # mixed-horizon spans padded into shared K-buckets (best-of-3
         # dense passes, so the value is compile-stall-free); same
         # threaded-soak load sensitivity as the other serve rows.
-        # Phase-in: absent from pre-round-18 histories, so the gate
-        # notes (not fires) until the baseline carries rows with it on
-        # the gating box's fingerprint.
+        # Gated as of round 20: the committed baseline carries
+        # fingerprint-matched records with this row, so the gate fires
+        # (not notes) on the CI box.
         rel_floor=30.0,
     ),
     Metric(
@@ -168,8 +168,22 @@ TRACKED: Tuple[Metric, ...] = (
         # stream WITH the controller, forecaster tap, and background
         # tuner attached — a collapse here means the MPC threads are
         # stealing the serving path's cycles.  Same threaded-soak load
-        # sensitivity as the other serve rows.  Phase-in: absent from
-        # pre-round-19 histories, so the gate notes (not fires) until
+        # sensitivity as the other serve rows.  Gated as of round 20:
+        # the committed baseline carries fingerprint-matched records
+        # with this row, so the gate fires (not notes) on the CI box.
+        rel_floor=30.0,
+    ),
+    Metric(
+        "serve_resident_dps",
+        ("serve_resident", "resident", "decisions_per_sec"),
+        lower_better=False, kind="rate",
+        # Round-20 resident-carry serving: the donated device-resident
+        # span driver's kernel-level arm at H=100k hosts with live,
+        # counts, and market risk engaged — a collapse here means the
+        # carry is being re-staged (or the edit path re-materialized).
+        # Measured single-pass over a fixed span count, so it rides
+        # box load like the serve rows.  Phase-in: absent from
+        # pre-round-20 histories, so the gate notes (not fires) until
         # the baseline carries rows with it on the gating box's
         # fingerprint.
         rel_floor=30.0,

@@ -71,6 +71,12 @@
 #      dispatch, and the off-switch pin (mpc=None never engages the
 #      subsystem; dry_run observes without perturbing one outcome
 #      counter).  The full chaos+market acceptance soak stays tier-1.
+#  11. resident-carry serving (round 20, ops/tickloop.py
+#      resident_span_run): device-persistent span state donated forward
+#      span to span — the resident-vs-re-staged bit-parity smalls
+#      (kernel, sharded twin, DES end to end), zero recompiles after
+#      warmup, and the tiny mid-span splice soak against the
+#      sequential referee.
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -82,11 +88,11 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/10] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/11] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/10] graftcheck static analysis (10 passes) + compile check =="
+echo "== [2/11] graftcheck static analysis (10 passes) + compile check =="
 # Machine-readable findings, annotated per file:line; the 10 s timeout
 # IS the wall-clock budget check for the full static suite.  The
 # capture must not abort under `set -e` before lint_annotate has
@@ -111,7 +117,7 @@ python tools/hotpath_lint.py
 # assert ZERO recompiles in steady state (quick mode).
 python -m pivot_tpu.analysis --compile-check quick
 
-echo "== [3/10] chaos replay determinism on the committed seed =="
+echo "== [3/11] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -126,7 +132,7 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
-echo "== [4/10] sharded-placement parity on a forced 8-device CPU mesh =="
+echo "== [4/11] sharded-placement parity on a forced 8-device CPU mesh =="
 # Small-H quick twins + the H=1024 acceptance + the sharded span driver
 # + the round-17 2-D suite: the [G]-batched replica × host programs
 # (shard_map(vmap(...)) via batch_execute(mesh=...)) vs the sequential
@@ -145,7 +151,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_serve_2d.py -q -m 'not slow' \
     -k 'not 100x' -p no:cacheprovider
 
-echo "== [5/10] spot soak + market replay determinism on the committed seed =="
+echo "== [5/11] spot soak + market replay determinism on the committed seed =="
 MARKET_SEED_FILE=data/market/ci_seed.json
 # The quick acceptance soak (tier-1 twin in tests/test_market.py).
 python -m pytest tests/test_market.py -q -m 'not slow' \
@@ -165,7 +171,7 @@ python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
     --out "$TMP/spot_b.json"
 python tools/market_replay.py diff "$TMP/spot_a.json" "$TMP/spot_b.json"
 
-echo "== [6/10] observability plane: traced+profiled soak + trace check =="
+echo "== [6/11] observability plane: traced+profiled soak + trace check =="
 # A tiny traced serve soak through the CLI — device policy so the
 # sampled dispatch profiler (--profile-dispatch) has dispatches to
 # bracket; the Perfetto artifact must pass the structural + causal +
@@ -183,7 +189,7 @@ grep -q "pivot_dispatch_latency_seconds" "$TMP/soak.prom"
 python -m pytest tests/test_obs.py -q -m 'not slow' \
     -k 'parity or chain or overhead' -p no:cacheprovider
 
-echo "== [7/10] continuous-bench regression gate (committed baseline) =="
+echo "== [7/11] continuous-bench regression gate (committed baseline) =="
 BASELINE=data/bench/ci_baseline.jsonl
 # The committed baseline history must gate clean against itself...
 python tools/bench_history.py check --history "$BASELINE"
@@ -202,7 +208,7 @@ if [ "$inj_rc" -ne 1 ]; then
     exit 1
 fi
 
-echo "== [8/10] policy search: tiny CEM beats bad init + replays =="
+echo "== [8/11] policy search: tiny CEM beats bad init + replays =="
 # The round-16 learned-scheduler gate: a tiny CEM search (2
 # generations, popsize 4, small cluster) over the COMMITTED seeded
 # config (data/search/ci_seed.json) must strictly beat the
@@ -238,7 +244,7 @@ print(
 )
 PYEOF
 
-echo "== [9/10] ragged continuous batching: repack parity + mixed-horizon soak =="
+echo "== [9/11] ragged continuous batching: repack parity + mixed-horizon soak =="
 # Round 18: mixed-horizon serve spans padded into a shared (K, B)
 # bucket and run as ONE device program.  Quick repack/batcher parity
 # smalls + the tiny mixed-horizon soak vs the per-tick referee, on the
@@ -247,7 +253,7 @@ echo "== [9/10] ragged continuous batching: repack parity + mixed-horizon soak =
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_ragged.py -q -m 'not slow' -p no:cacheprovider
 
-echo "== [10/10] model-predictive serving: replay + parity + off-switch =="
+echo "== [10/11] model-predictive serving: replay + parity + off-switch =="
 # Round 19: the simulator's fitness estimator runs INSIDE the server.
 # Quick deterministic gates only — forecast/render bit-replay, the
 # five-slot planner's clone-parity/bitwise-replay/referee contract,
@@ -258,5 +264,17 @@ echo "== [10/10] model-predictive serving: replay + parity + off-switch =="
 python -m pytest tests/test_mpc.py -q -m 'not slow' \
     -k 'determinism or parity or replay or recompiles or dry_run' \
     -p no:cacheprovider
+
+echo "== [11/11] resident-carry serving: parity smalls + tiny splice soak =="
+# Round 20: device-persistent span state, donated forward span to span.
+# Quick gates only — kernel-level resident vs re-staged bit-parity
+# (every policy config, live masks, the once-staged risk table, edit-row
+# repairs, multi-span chains), the sharded twin on the same forced
+# 8-device mesh as step 4, zero recompiles after warmup, the DES
+# end-to-end parity smalls, and the tiny mid-span splice soak diffed
+# bit-identical against the fuse_spans=False sequential referee.  The
+# full policy × phase2 × instant sweeps are slow-marked tier-1.
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+python -m pytest tests/test_resident.py -q -m 'not slow' -p no:cacheprovider
 
 echo "smoke lane: all green"

@@ -334,12 +334,17 @@ def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
     ]
     fam_durs: Dict[str, List[float]] = {}
     fam_ratios: Dict[str, List[float]] = {}
+    fam_h2d: Dict[str, List[int]] = {}
     for e in device_spans:
         fam = str(e.get("name"))
         fam_durs.setdefault(fam, []).append(e.get("dur", 0.0))
-        ratio = (e.get("args") or {}).get("model_ratio")
+        span_args = e.get("args") or {}
+        ratio = span_args.get("model_ratio")
         if isinstance(ratio, (int, float)):
             fam_ratios.setdefault(fam, []).append(float(ratio))
+        h2d = span_args.get("h2d_bytes")
+        if isinstance(h2d, (int, float)):
+            fam_h2d.setdefault(fam, []).append(int(h2d))
     fam_census = {}
     drift: List[str] = []
     for fam, durs in sorted(fam_durs.items()):
@@ -349,6 +354,13 @@ def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
             "p95_us": round(_pct(durs, 95), 3),
             "max_us": round(max(durs), 3),
         }
+        # Staged-operand bytes (round 20): the resident-carry economics
+        # signal — a resident family's per-span bytes should sit orders
+        # of magnitude under its re-staged twin's.
+        h2d_rows = fam_h2d.get(fam, [])
+        if h2d_rows:
+            row["h2d_bytes_sampled_total"] = sum(h2d_rows)
+            row["h2d_bytes_per_span_p50"] = round(_pct(h2d_rows, 50), 1)
         ratios = fam_ratios.get(fam, [])
         if ratios:
             med = _pct(ratios, 50)
@@ -370,7 +382,8 @@ def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
                 k: v
                 for k, v in (e.get("args") or {}).items()
                 if k in ("backend", "t", "b", "h", "k", "g",
-                         "pred_us", "model_ratio", "in_flush")
+                         "pred_us", "model_ratio", "in_flush",
+                         "h2d_bytes")
             },
         }
         for e in sorted(
@@ -539,11 +552,13 @@ def main(argv=None) -> int:
         )
         for fam, row in dd["families"].items():
             ratio = row.get("model_ratio_p50")
+            h2d = row.get("h2d_bytes_per_span_p50")
             print(
                 f"  {fam:24s} n={row['n']:<5d} "
                 f"p50={row['p50_us']:<10g} p95={row['p95_us']:<10g} "
                 f"max={row['max_us']:<10g} us"
                 + (f"  x model={ratio:g}" if ratio is not None else "")
+                + (f"  h2d/span={h2d:g} B" if h2d is not None else "")
             )
         for row in dd["top_slow"]:
             extra = {
