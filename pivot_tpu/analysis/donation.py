@@ -41,9 +41,17 @@ device-only).  Both sides rot silently, so both are checked:
      donated position kills that variable: any later read of it in the
      same function (without an intervening rebind — the
      ``state, pending = step(state, ...)`` loop idiom rebinds at the
-     call itself) is a finding.  Precision limit: only direct ``Name``
-     arguments are tracked (a ``*args`` spread or a fresh
-     ``jnp.asarray(...)`` at the call site has no name to misuse).
+     call itself) is a finding.  Round 21 extends the tracked argument
+     shapes from plain ``Name``\\ s to **dotted attribute paths**
+     (``rs.carry``): the resident-state object hangs its donated carry
+     off an attribute, and the crash-safe snapshot hook made host
+     reads of that attribute (``np.asarray(rs.carry.avail)``) an easy
+     mistake — reading any path AT or BELOW a donated path after the
+     donating call, without an intervening rebind of the path or a
+     prefix of it (``rs.carry = new`` or ``rs = ...``), is a finding.
+     Precision limit: only Name/Attribute chains are tracked (a
+     ``*args`` spread or a fresh ``jnp.asarray(...)`` at the call site
+     has no path to misuse).
   3. **Missed donations** — discovery: a jitted entry point whose
      wrapped function *returns* a carry-named parameter
      (:data:`_CARRY_HINTS` — the structurally-unchanged-shape carry
@@ -221,6 +229,20 @@ def _manifest_findings(
     return out
 
 
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Resolve a Name/Attribute chain to its dotted path (``rs.carry``
+    → ``"rs.carry"``), or None when any link is something else (a
+    subscript, a call result — no stable path to track)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
 def _assigned_names(stmt: ast.stmt) -> Set[str]:
     out: Set[str] = set()
     if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
@@ -231,6 +253,10 @@ def _assigned_names(stmt: ast.stmt) -> Set[str]:
             for node in ast.walk(tgt):
                 if isinstance(node, ast.Name):
                     out.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    path = _dotted(node)
+                    if path is not None:
+                        out.add(path)
     elif isinstance(stmt, (ast.For, ast.AsyncFor)):
         for node in ast.walk(stmt.target):
             if isinstance(node, ast.Name):
@@ -255,12 +281,14 @@ def _own_nodes(fn: ast.AST) -> List[ast.AST]:
 
 
 def _use_after_donate(src, fn: ast.AST) -> List[Finding]:
-    """Flag reads of a variable after it was passed at a donated
-    position, with no rebind in between (line-ordered approximation
-    over ONE function scope; a rebind at the donating call's own
-    statement counts)."""
+    """Flag reads of a variable — or dotted attribute path — after it
+    was passed at a donated position, with no rebind in between
+    (line-ordered approximation over ONE function scope; a rebind at
+    the donating call's own statement counts, and rebinding any dotted
+    PREFIX of a donated path — ``rs.carry = new``, ``rs = fresh()`` —
+    clears the path it carries)."""
     out: List[Finding] = []
-    # (var, call lineno, call end lineno) — the call's own span is
+    # (path, call lineno, call end lineno) — the call's own span is
     # excluded from the read scan (the donated argument itself may sit
     # on a later physical line of a multi-line call).
     donations: List[Tuple[str, int, int]] = []
@@ -281,34 +309,42 @@ def _use_after_donate(src, fn: ast.AST) -> List[Finding]:
         if callee not in DONATING_CALLS:
             continue
         idx = DONATING_CALLS[callee]
-        if idx < len(node.args) and isinstance(node.args[idx], ast.Name):
-            donations.append((
-                node.args[idx].id, node.lineno,
-                node.end_lineno or node.lineno,
-            ))
+        if idx < len(node.args):
+            path = _dotted(node.args[idx])
+            if path is not None:
+                donations.append((
+                    path, node.lineno, node.end_lineno or node.lineno,
+                ))
 
     if not donations:
         return out
     for var, call_line, call_end in donations:
         for node in nodes:
-            if (
-                isinstance(node, ast.Name)
-                and isinstance(node.ctx, ast.Load)
-                and node.id == var
-                and node.lineno > call_end
-            ):
-                rebound = any(
-                    name == var and call_line <= line <= node.lineno
-                    for name, line in rebinds
-                )
-                if not rebound:
-                    out.append(Finding(
-                        RULE, src.path, node.lineno,
-                        f"use-after-donate: {var!r} was donated at line "
-                        f"{call_line} (its buffer is deleted by the "
-                        "call) and is read here without a rebind — "
-                        "re-stage the operand or restructure",
-                    ))
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            if node.lineno <= call_end:
+                continue
+            # Exact-path matching suffices for deeper reads too: a load
+            # of ``rs.carry.avail`` CONTAINS the ``rs.carry`` Attribute
+            # node as a Load child, which matches here — one finding at
+            # the donated path, not one per trailing attribute.
+            if _dotted(node) != var:
+                continue
+            rebound = any(
+                (name == var or var.startswith(name + "."))
+                and call_line <= line <= node.lineno
+                for name, line in rebinds
+            )
+            if not rebound:
+                out.append(Finding(
+                    RULE, src.path, node.lineno,
+                    f"use-after-donate: {var!r} was donated at line "
+                    f"{call_line} (its buffer is deleted by the "
+                    "call) and is read here without a rebind — "
+                    "re-stage the operand or restructure",
+                ))
     return out
 
 

@@ -323,6 +323,13 @@ class MpcController(LogMixin):
         chosen = result.chosen
         obj = float(result.objectives[result.index])
         pool = driver.pool_size()
+        plane = getattr(driver, "_recovery", None)
+        if plane is not None:
+            # Write-ahead: the actuation intent hits the journal before
+            # the actuator moves — a crash between record and effect is
+            # a journaled intent a replay can reconcile, never a silent
+            # pool mutation.
+            plane.journal_mpc(chosen.kind, pool)
         if chosen.kind == "grow":
             if driver.grow_pool(reason=f"mpc predicted obj {obj:.4f}"):
                 driver.slo.count("mpc_grows")

@@ -38,7 +38,7 @@ import re
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["MetricsRegistry"]
+__all__ = ["MetricsRegistry", "declare_recovery_metrics"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -256,3 +256,36 @@ class MetricsRegistry:
                 return fam.samples.get(self._key(fam, labels))
             except ValueError:
                 return None
+
+
+def declare_recovery_metrics(registry: MetricsRegistry) -> None:
+    """Declare the ``pivot_recover_*`` family (idempotent — declare is
+    chainable and re-declaration with identical schema is a no-op).
+    Published by ``pivot_tpu.recover.RecoveryPlane.publish`` whenever a
+    serve driver runs with a recovery plane attached:
+
+      * ``pivot_recover_snapshot_age_s`` — seconds since the last
+        resident-carry snapshot landed on disk (the recovery-point age).
+      * ``pivot_recover_journal_lag`` — journaled records not yet
+        fsynced (the write-ahead journal's durability lag).
+      * ``pivot_recover_retries_total`` — watchdog dispatch retries.
+      * ``pivot_recover_quarantined_rows`` — rows in the per-tenant
+        penalty box, labelled by tenant.
+    """
+    registry.gauge(
+        "pivot_recover_snapshot_age_s",
+        "seconds since the last resident-carry snapshot was written",
+    )
+    registry.gauge(
+        "pivot_recover_journal_lag",
+        "journal records appended but not yet fsynced",
+    )
+    registry.counter(
+        "pivot_recover_retries_total",
+        "watchdog dispatch retries issued",
+    )
+    registry.gauge(
+        "pivot_recover_quarantined_rows",
+        "rows quarantined in the penalty box, per tenant",
+        labelnames=("tenant",),
+    )

@@ -93,7 +93,9 @@ __all__ = [
     "ragged_span_trim",
     "reference_tick_run",
     "resident_carry_clone",
+    "resident_carry_export",
     "resident_carry_init",
+    "resident_carry_restore",
     "resident_span_run",
     "span_bucket",
 ]
@@ -646,6 +648,35 @@ def resident_carry_clone(carry: ResidentCarry) -> ResidentCarry:
     without violating the consumed-on-call contract.
     """
     return _resident_carry_clone(carry)
+
+
+def resident_carry_export(carry: ResidentCarry) -> dict:
+    """Host numpy copies of a carry's buffers (the snapshot D2H fetch).
+
+    Donation safety: call this ONLY on a clone or on a PENDING carry (a
+    jit output not yet passed to the next donating dispatch — the same
+    window the resident mirror-diff reads in).  Reading a carry after
+    it was donated is the exact hazard the extended
+    ``analysis/donation.py`` host-read-after-donate check flags.
+    """
+    return {
+        "avail": np.asarray(carry.avail),
+        "counts": np.asarray(carry.counts),
+        "live": np.asarray(carry.live),
+    }
+
+
+def resident_carry_restore(avail, counts, live) -> ResidentCarry:
+    """Re-materialize a device-owned carry from snapshot host arrays.
+
+    The warm-resume half of the recovery plane: a carry exported (or
+    snapshotted) at span ``n`` restores here, and continuing the span
+    chain from it is bit-identical to never having stopped
+    (``tests/test_recovery.py`` kernel-level referee).  Same explicit
+    device-copy contract as :func:`resident_carry_init` — the restored
+    buffers are safe to donate immediately.
+    """
+    return resident_carry_init(avail, counts=counts, live=live)
 
 
 def _resident_span_run_impl(

@@ -411,6 +411,11 @@ class BatchClient:
                 batcher.stats["device_calls"] += 1
                 batcher.stats["single_fast_path"] += 1
         if solo:
+            if batcher._journal is not None:
+                # Write-ahead parity with _flush: a solo dispatch is a
+                # one-request flush and journals as one before the
+                # device call commits it.
+                batcher._journal.append("flush", groups=1, reqs=1)
             # The batcher's mesh rides along so a lone slot on a 2-D
             # mesh still dispatches host-sharded (batch_execute's g=1
             # twin path); on a replica-only mesh g=1 has nothing to
@@ -523,7 +528,7 @@ class DispatchBatcher:
 
     def __init__(self, n_slots: int, flush_after: Optional[float] = None,
                  mesh: Optional[object] = None, tracer=None,
-                 profiler=None, ragged: bool = True):
+                 profiler=None, ragged: bool = True, journal=None):
         if n_slots < 1:
             raise ValueError("DispatchBatcher needs at least one slot")
         if flush_after is not None and flush_after <= 0:
@@ -547,6 +552,12 @@ class DispatchBatcher:
         #: sampling decision both live inside the profiler (this module
         #: is determinism-scoped).  ``None`` = zero cost.
         self.profiler = profiler
+        #: Write-ahead journal (``pivot_tpu.recover.Journal``): when the
+        #: serve driver runs a recovery plane, every flush appends a
+        #: record BEFORE any of its device calls execute, so a replay
+        #: knows which co-pending sets the killed run committed to.
+        #: ``None`` (default) = no recovery plane, zero cost.
+        self._journal = journal
         self._cond = threading.Condition()
         self._n_slots = n_slots
         self._open = n_slots
@@ -813,6 +824,13 @@ class DispatchBatcher:
             kernel_keys: Dict[object, set] = {}
             for key in groups:
                 kernel_keys.setdefault(key[0], set()).add(key)
+            if self._journal is not None and batch:
+                # Write-ahead: the flush's composition hits the journal
+                # before its first device call, so a crash mid-flush
+                # leaves a record of what was committed.
+                self._journal.append(
+                    "flush", groups=len(groups), reqs=len(batch),
+                )
             for reqs in groups.values():
                 reqs.sort(key=lambda r: r.slot)
                 # Under the cond: the single-live-slot fast path bumps

@@ -31,8 +31,12 @@ scheduler loop wires in (``sched/__init__.py``):
     mask (``TickContext.live_mask`` → ``sched/policies.fold_quarantine``
     / the kernels' ``live`` argument), so no NEW placement lands on a
     quarantined host while the cooldown runs.
+  * :class:`RetryGate` — a process-wide cap on *concurrent* retries
+    (round 21, the serve recovery plane): backoff spreads a retry wave
+    in time, the gate bounds its width, so a degraded device cannot
+    amplify one slow dispatch into a metastable retry storm.
 
-All three are inert by default — ``GlobalScheduler(retry=None,
+All of these are inert by default — ``GlobalScheduler(retry=None,
 breaker=None)`` keeps the reference-parity resubmit-forever loop
 bit-identical to before this module existed.
 """
@@ -40,10 +44,11 @@ bit-identical to before this module existed.
 from __future__ import annotations
 
 import hashlib
+import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-__all__ = ["DeadLetter", "HostCircuitBreaker", "RetryPolicy"]
+__all__ = ["DeadLetter", "HostCircuitBreaker", "RetryGate", "RetryPolicy"]
 
 
 def _unit_hash(*parts) -> float:
@@ -119,9 +124,21 @@ class RetryPolicy:
         budget = self.budget(tier)
         return budget is not None and attempts > budget
 
+    def max_attempts(self, tier: int = 0) -> Optional[int]:
+        """Explicit total-attempt bound for ``tier``: the initial try
+        plus its retry budget (``None`` = unbounded).  The recovery
+        plane's dispatch watchdog sizes its loop off THIS, not off the
+        raw retry budget, so "how many times may this run at all" is a
+        stated number rather than an off-by-one folklore."""
+        budget = self.budget(tier)
+        return None if budget is None else budget + 1
+
     def backoff(self, attempt: int, key: str) -> float:
         """Sim-seconds to wait before resubmitting failure ``attempt`` of
-        the task identified by ``key`` (its id).  Deterministic."""
+        the task identified by ``key`` (its id).  Deterministic: the
+        jitter draw is the seeded ``_unit_hash(seed, key, attempt)`` —
+        never an ambient RNG — so a journaled replay backs off
+        identically to the run it replays."""
         if self.base <= 0.0:
             return 0.0
         delay = min(self.base * self.factor ** (attempt - 1), self.cap)
@@ -129,6 +146,64 @@ class RetryPolicy:
             u = _unit_hash(self.seed, key, attempt)
             delay *= 1.0 + self.jitter * (2.0 * u - 1.0)
         return delay
+
+
+class RetryGate:
+    """Cap on CONCURRENT retries — the metastable-failure guard.
+
+    Backoff de-synchronizes a retry wave in *time*; this gate bounds it
+    in *width*.  Bronson et al. ("Metastable Failures", PAPERS.md): a
+    degraded device that slows every dispatch turns unbounded retry
+    concurrency into a sustaining feedback loop — retries of slow work
+    make the work slower, which makes more of it retry.  Admission to a
+    retry therefore goes through this gate: at most ``max_concurrent``
+    retries may be in flight across the process at once, and a caller
+    that cannot get a slot within its patience *sheds* (fails fast)
+    rather than queueing more load onto a plane that is already
+    drowning.
+
+    Thread-safe; shared by every dispatch path of one recovery plane.
+    ``peak`` records the high-water mark (the soak test's cap
+    assertion), ``shed`` the fast-failed acquisitions.
+    """
+
+    def __init__(self, max_concurrent: int = 2):
+        if max_concurrent < 1:
+            raise ValueError(
+                f"max_concurrent must be >= 1, got {max_concurrent}"
+            )
+        self.max_concurrent = int(max_concurrent)
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self.peak = 0
+        self.shed = 0
+
+    def acquire(self, timeout: Optional[float] = 0.0) -> bool:
+        """Take a retry slot; False (a shed) when none frees up within
+        ``timeout`` wall seconds (0 = fail fast, None = wait forever)."""
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._in_flight < self.max_concurrent,
+                timeout=timeout,
+            )
+            if not ok:
+                self.shed += 1
+                return False
+            self._in_flight += 1
+            self.peak = max(self.peak, self._in_flight)
+            return True
+
+    def release(self) -> None:
+        with self._cv:
+            if self._in_flight <= 0:
+                raise RuntimeError("RetryGate.release without acquire")
+            self._in_flight -= 1
+            self._cv.notify()
+
+    @property
+    def in_flight(self) -> int:
+        with self._cv:
+            return self._in_flight
 
 
 @dataclass(frozen=True)

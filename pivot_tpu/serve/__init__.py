@@ -62,6 +62,11 @@ from pivot_tpu.serve.autoscale import AutoscaleConfig, SloAutoscaler
 from pivot_tpu.serve.driver import ServeDriver, closed_loop_source
 from pivot_tpu.serve.session import STOP, PreemptRequest, ServeSession
 
+# Crash-safe serving (round 21): the recovery plane's config rides the
+# serve namespace so `ServeDriver(recovery=RecoveryConfig(...))` is one
+# import away from the driver it arms.
+from pivot_tpu.recover import RecoveryConfig
+
 __all__ = [
     "ADMITTED",
     "AdmissionQueue",
@@ -69,6 +74,7 @@ __all__ = [
     "BLOCKED",
     "JobArrival",
     "PreemptRequest",
+    "RecoveryConfig",
     "SHED",
     "SPILLED",
     "STOP",

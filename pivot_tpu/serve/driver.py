@@ -159,6 +159,7 @@ class ServeDriver(LogMixin):
         ragged: bool = True,
         resident: bool = False,
         splice_tier: int = 0,
+        recovery=None,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
@@ -256,6 +257,18 @@ class ServeDriver(LogMixin):
         #: flush boundary as before.
         self.resident = bool(resident)
         self.splice_tier = int(splice_tier)
+        #: Crash-safe serving (round 21, ``pivot_tpu.recover``):
+        #: ``recovery`` is a ``RecoveryConfig`` or None.  None — the
+        #: default — builds nothing and leaves the service bit-identical
+        #: to the PR-18 stack (pinned by tests/test_recovery.py).  A
+        #: config constructs the plane HERE (its write-ahead journal
+        #: must be open before the first admission); the snapshot
+        #: worker starts/stops inside :meth:`run`.
+        self._recovery = None
+        if recovery is not None:
+            from pivot_tpu.recover import RecoveryPlane
+
+            self._recovery = RecoveryPlane(recovery, tracer=self.tracer)
         self.routing = routing
         self.preempt = preempt
         self.preempt_timeout = preempt_timeout
@@ -633,6 +646,11 @@ class ServeDriver(LogMixin):
             new.slot = client.slot
         elif self.resident:
             self._enable_resident(new)
+        if self._recovery is not None:
+            # Supervisor replacements and autoscaler growth join the
+            # recovery plane too — a restarted session's spans journal
+            # and snapshot exactly like the original's.
+            new.attach_recovery(self._recovery)
         new._client = client
         thread = threading.Thread(
             target=new.loop, args=(client,),
@@ -990,6 +1008,12 @@ class ServeDriver(LogMixin):
 
     def _admit(self, arrival: JobArrival) -> None:
         tier = int(getattr(arrival, "tier", 0))
+        if self._recovery is not None:
+            # Write-ahead: the admission is journaled BEFORE any effect
+            # (gate release, queue offer, routing) — after a crash the
+            # journal tail is exactly the set of arrivals the dead
+            # server had committed to.
+            self._recovery.journal_admit(arrival)
         if self._mpc is not None:
             # Forecast tap: sim timestamp + tier, before any admission
             # verdict — shed/spilled arrivals are still demand.
@@ -1218,11 +1242,19 @@ class ServeDriver(LogMixin):
                     mesh=self.mesh,
                     tracer=self.tracer, profiler=self.profiler,
                     ragged=self.ragged,
+                    journal=(
+                        self._recovery.journal
+                        if self._recovery is not None else None
+                    ),
                 )
                 clients = [self.batcher.client() for _ in self.sessions]
                 for s, c in zip(self.sessions, clients):
                     s.policy.enable_batching(c)
                 self.slo.attach_dispatch_stats(self.batcher.stats)
+            if self._recovery is not None:
+                self._recovery.start()
+                for s in self.sessions:
+                    s.attach_recovery(self._recovery)
             for s, c in zip(self.sessions, clients):
                 s._client = c
                 thread = threading.Thread(
@@ -1283,6 +1315,11 @@ class ServeDriver(LogMixin):
             self._autoscaler.stop()
         if self._mpc is not None:
             self._mpc.stop()
+        if self._recovery is not None:
+            # Drain the pending snapshot and fsync the journal tail —
+            # runs on the error path too (the whole point is that the
+            # journal is trustworthy after ANY exit).
+            self._recovery.stop()
         with self._cv:
             errors = self._errors + [
                 s.error
@@ -1348,6 +1385,8 @@ class ServeDriver(LogMixin):
             s.meter.publish_metrics(registry, run=s.label)
         if self.profiler is not None:
             self.profiler.publish_metrics(registry)
+        if self._recovery is not None:
+            self._recovery.publish(registry)
         return registry.to_json()
 
     def report(self) -> dict:
@@ -1394,6 +1433,12 @@ class ServeDriver(LogMixin):
             ),
             "mpc": (
                 self._mpc.summary() if self._mpc is not None else None
+            ),
+            # Recovery plane (round 21): journal / snapshot / watchdog
+            # state when crash-safety is armed; None = legacy stack.
+            "recovery": (
+                self._recovery.summary()
+                if self._recovery is not None else None
             ),
             "slo": self.slo.snapshot(),
             "batcher": dict(self.batcher.stats) if self.batcher else None,

@@ -157,6 +157,14 @@ _SLOW_TESTS = {
         # Quick twin in tier 1: test_full_sim_parity_smoke_opportunistic.
         "test_full_sim_parity_opportunistic",
     ],
+    "test_recovery.py": [
+        # Quick twins in tier 1: test_driver_recovery_journal_smoke
+        # (armed-driver integration) and
+        # test_kernel_kill_and_resume_bit_identical (the restore half
+        # with deterministic span boundaries).
+        "test_kill_and_resume_referee",
+        "test_watchdog_armed_driver_parity",
+    ],
     "test_resident.py": [
         # Quick twins in tier 1: test_resident_span_parity_quick,
         # test_des_resident_bit_parity_quick,

@@ -778,6 +778,48 @@ def test_donation_resident_use_after_donate_bites(tmp_path):
     ), "\n".join(str(f) for f in findings)
 
 
+def test_donation_dotted_path_use_after_donate_bites(tmp_path):
+    """Round 21: the resident state hangs its donated carry off an
+    attribute (``rs.carry``), and the crash-safe snapshot hook made
+    host reads of that attribute after the donating dispatch an easy
+    mistake — the lint must track dotted paths, flag the stale read,
+    and stay silent when the path (or a prefix) is rebound first."""
+    root = _copy_tree(tmp_path, JITCHECK_FILES)
+    p = tmp_path / "pivot_tpu/ops/tickloop.py"
+    p.write_text(p.read_text() + textwrap.dedent("""\n
+        def _bad_dotted_caller(rs, dem, arrive, k):
+            res, fresh = resident_span_run(
+                rs.carry, dem, arrive, k, policy="first-fit", n_ticks=4,
+            )
+            return res, np.asarray(rs.carry.avail)
+
+
+        def _good_dotted_caller(rs, dem, arrive, k):
+            res, fresh = resident_span_run(
+                rs.carry, dem, arrive, k, policy="first-fit", n_ticks=4,
+            )
+            rs.carry = fresh
+            return res, np.asarray(rs.carry.avail)
+    """))
+    findings = run(root=root, rules=["donation"])
+    hits = [
+        f for f in findings
+        if "use-after-donate" in f.message and "'rs.carry'" in f.message
+    ]
+    # Exactly one finding — the bad caller's stale read; the rebound
+    # twin reads clean.
+    assert len(hits) == 1, "\n".join(str(f) for f in findings)
+    bad_line = next(
+        i + 1 for i, ln in enumerate(p.read_text().splitlines())
+        if "_bad_dotted_caller" in ln
+    )
+    good_line = next(
+        i + 1 for i, ln in enumerate(p.read_text().splitlines())
+        if "_good_dotted_caller" in ln
+    )
+    assert bad_line < hits[0].line < good_line, hits
+
+
 def test_retrace_flags_unregistered_jit_file(tmp_path):
     """jitmap discovery: a NEW file growing a jax.jit wrapper must join
     JIT_FILES or the sweep flags it (register-or-flag, like parity)."""

@@ -622,6 +622,9 @@ def _prof_skeleton(tmp_path):
         "pivot_tpu/sched/tpu.py": """\
             def _call_kernel(self, kernel):
                 return self._profiler.profile("k", lambda: kernel())
+
+            def _resident_dispatch(self, fn):
+                return self._profiler.profile("r", lambda: fn())
         """,
         "pivot_tpu/sched/batch.py": """\
             def _execute(self, reqs):

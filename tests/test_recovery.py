@@ -1,0 +1,691 @@
+"""Crash-safe serving: the recovery plane (``pivot_tpu.recover``).
+
+The acceptance bars, bottom-up:
+
+  * **journal** — append/read round-trip with seeded integrity tags;
+    mid-journal tampering raises, a torn FINAL line (the crash
+    artifact) is tolerated on read and amputated on resume; journaled
+    admissions verify against a seed-regenerated arrival stream
+    (``replay_prefix_check``) and catch a wrong-seed replay.
+  * **snapshots** — the double-buffered store round-trips a submitted
+    carry bit-identically with a matching content fingerprint, and a
+    corrupted newer buffer falls back to the older valid one.
+  * **watchdog** — batch bisection corners a planted NaN row into the
+    per-tenant penalty box while every tier-0 row is served untouched;
+    a hung dispatch times out and a persistently failing row
+    quarantines after its bounded retry budget; the shared
+    :class:`~pivot_tpu.sched.retry.RetryGate` caps concurrent retries
+    (the metastable-storm guard) and tier 0 sheds LAST.
+  * **kill-and-resume referee** — at the kernel level, a span chain
+    killed mid-run and restored from a :class:`SnapshotStore` snapshot
+    continues **bit-identically** (placements and carry) to the
+    uninterrupted chain; at the driver level, a server killed mid-soak
+    (chaos + market) resumes from journal + snapshot and serves the
+    regenerated stream bit-identically to an uninterrupted reference —
+    and ``recovery=None`` stays bit-identical to the PR-18 stack with
+    zero recompiles after warmup.
+
+Determinism note for the driver referee: span *slicing* depends on the
+driver's release frontier, which is revealed by the producer thread —
+a wall race the epoch-abort machinery makes harmless for placements
+(the pinned contract) but which can in principle shift snapshot span
+indices between runs.  The cross-run carry comparison with full teeth
+therefore lives at the kernel level, where span boundaries are under
+test control; the driver-level ``resume_verified`` assertion accepts
+"not yet re-reached" but never a fingerprint mismatch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pivot_tpu.infra.faults import FaultInjector
+from pivot_tpu.infra.market import MarketSchedule
+from pivot_tpu.obs.registry import MetricsRegistry
+from pivot_tpu.ops.tickloop import (
+    resident_carry_export,
+    resident_carry_init,
+    resident_carry_restore,
+    resident_span_run,
+)
+from pivot_tpu.recover import (
+    DispatchFailed,
+    DispatchTimeout,
+    DispatchWatchdog,
+    Journal,
+    JournalError,
+    PenaltyBox,
+    RecoveryConfig,
+    SnapshotStore,
+    fingerprint_arrays,
+    replay_prefix_check,
+)
+from pivot_tpu.sched.retry import RetryGate, RetryPolicy
+from pivot_tpu.serve import (
+    JobArrival,
+    ServeDriver,
+    ServeSession,
+    mixed_tier_arrivals,
+    poisson_arrivals,
+    synthetic_app_factory,
+)
+from pivot_tpu.workload import Application, TaskGroup
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.utils.compile_counter import count_compiles
+from pivot_tpu.utils.config import (
+    ClusterConfig,
+    PolicyConfig,
+    build_cluster,
+    make_policy,
+)
+
+
+def _device_policy():
+    return make_policy(
+        PolicyConfig(
+            name="cost-aware", device="tpu", bin_pack="first-fit",
+            sort_tasks=True, sort_hosts=True, adaptive=False,
+        )
+    )
+
+
+# --------------------------------------------------------------------------
+# Journal: tagged round-trip, torn tails, replay verification
+# --------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_tags_and_torn_tail(tmp_path):
+    """Records round-trip with valid seeded tags; a tampered middle
+    record raises; a torn FINAL line is reported, not raised."""
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path, seed=42, fsync_every=2)
+    j.append("admit", ts=0.5, tier=1, tenant="acme", app="app-1")
+    j.append("flush", groups=2, reqs=3)
+    j.append("span", session="s0", sim=5.0, k=8, slots=4)
+    j.close()
+
+    records, torn = Journal.read(path)
+    assert torn == 0
+    assert [r["kind"] for r in records] == ["open", "admit", "flush", "span"]
+    assert [r["seq"] for r in records] == [0, 1, 2, 3]
+    admits = Journal.admissions(records)
+    assert len(admits) == 1 and admits[0]["tenant"] == "acme"
+
+    # Tamper a MIDDLE record's payload: still-parseable JSON, wrong tag.
+    lines = (tmp_path / "journal.jsonl").read_text().splitlines()
+    rec = json.loads(lines[1])
+    rec["tier"] = 0  # the lie
+    lines[1] = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+    (tmp_path / "journal.jsonl").write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalError, match="bad tag"):
+        Journal.read(path)
+
+    # A torn final line — the crash artifact — is forgiven and counted.
+    j2 = Journal(str(tmp_path / "j2.jsonl"), seed=1)
+    j2.append("admit", ts=1.0, tier=0, tenant="default", app="a")
+    j2.close()
+    with open(tmp_path / "j2.jsonl", "a", encoding="utf-8") as f:
+        f.write('{"seq": 2, "kind": "fl')  # crash mid-append
+    records, torn = Journal.read(str(tmp_path / "j2.jsonl"))
+    assert torn == 1
+    assert [r["kind"] for r in records] == ["open", "admit"]
+
+
+def test_journal_resume_amputates_torn_tail(tmp_path):
+    """Reopening with ``resume=True`` rewrites the file without the torn
+    line, appends a validated ``resume`` header, and continues the
+    sequence — the whole history then reads clean."""
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path, seed=7)
+    j.append("admit", ts=0.1, tier=0, tenant="default", app="a")
+    j.append("admit", ts=0.2, tier=1, tenant="default", app="b")
+    j.close()
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"torn":')
+
+    j2 = Journal(path, seed=7, resume=True)
+    j2.append("admit", ts=0.3, tier=0, tenant="default", app="c")
+    j2.close()
+
+    records, torn = Journal.read(path)
+    assert torn == 0, "resume must amputate the torn tail"
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["open", "admit", "admit", "resume", "admit"]
+    assert [r["seq"] for r in records] == list(range(5))
+    resume_rec = records[3]
+    assert resume_rec["prior_records"] == 3
+    assert len(Journal.admissions(records)) == 3
+
+
+def test_journal_replay_prefix_check(tmp_path):
+    """Journaled admissions verify against a seed-regenerated stream and
+    catch a wrong-seed regeneration as a replay divergence."""
+
+    def stream(seed):
+        reset_ids()
+        return list(
+            mixed_tier_arrivals(
+                rate=1.0, n_jobs=6, weights=(0.5, 0.3, 0.2), seed=seed,
+                make_app=synthetic_app_factory(seed=11),
+            )
+        )
+
+    arrs = stream(3)
+    path = str(tmp_path / "journal.jsonl")
+    j = Journal(path, seed=0)
+    for a in arrs[:4]:  # the server died after admitting 4 of 6
+        j.append("admit", ts=a.ts, tier=int(a.tier), tenant=a.tenant,
+                 app=a.app.id)
+    j.close()
+    records, _ = Journal.read(path)
+
+    assert replay_prefix_check(records, stream(3)) == 4
+    with pytest.raises(JournalError, match="replay divergence"):
+        replay_prefix_check(records, stream(4))
+
+
+# --------------------------------------------------------------------------
+# Snapshots: fingerprint round-trip, double-buffer fallback
+# --------------------------------------------------------------------------
+
+
+def _wait_written(store, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while store.written < n:
+        assert time.monotonic() < deadline, (
+            f"snapshot worker stalled at written={store.written}"
+        )
+        time.sleep(0.005)
+
+
+def test_snapshot_fingerprint_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    payload = {
+        "avail": rng.uniform(0, 4, (8, 4)),
+        "counts": rng.integers(0, 3, 8).astype(np.int32),
+        "live": np.ones(8, bool),
+    }
+    store = SnapshotStore(str(tmp_path))
+    store.start()
+    store.submit(payload, {"span": 2, "policy_spans": 2})
+    _wait_written(store, 1)
+    store.stop()
+
+    loaded = store.latest()
+    assert loaded is not None
+    arrays, meta = loaded
+    for k, v in payload.items():
+        np.testing.assert_array_equal(arrays[k], np.asarray(v))
+    # The stored fingerprint re-derives from content + submit-side meta.
+    assert meta["fingerprint"] == fingerprint_arrays(
+        arrays, {"span": 2, "policy_spans": 2}
+    )
+    assert meta["snapshot_seq"] == 0
+    assert store.age_s is not None and store.age_s >= 0.0
+
+
+def test_snapshot_double_buffer_survives_corruption(tmp_path):
+    """Corrupting the newest buffer falls back to the older valid one —
+    a crash mid-write never loses the last good recovery point."""
+    store = SnapshotStore(str(tmp_path))
+    store.start()
+    a0 = {"avail": np.full((4, 4), 1.0)}
+    a1 = {"avail": np.full((4, 4), 2.0)}
+    store.submit(a0, {"span": 2})
+    _wait_written(store, 1)
+    store.submit(a1, {"span": 4})
+    _wait_written(store, 2)
+    store.stop()
+
+    arrays, meta = store.latest()
+    assert meta["span"] == 4  # buffer b, seq 1, is newest
+
+    with open(store.paths[1], "wb") as f:  # seq 1 lived in carry-b
+        f.write(b"not an npz")
+    arrays, meta = store.latest()
+    assert meta["span"] == 2 and meta["snapshot_seq"] == 0
+    np.testing.assert_array_equal(arrays["avail"], a0["avail"])
+
+    with open(store.paths[0], "wb") as f:
+        f.write(b"also garbage")
+    assert store.latest() is None
+
+
+# --------------------------------------------------------------------------
+# Watchdog: bisection quarantine, timeout, retry gate, penalty box
+# --------------------------------------------------------------------------
+
+
+def _rows(spec):
+    """spec: list of (tenant, tier) tuples."""
+    return [SimpleNamespace(tenant=t, tier=k) for t, k in spec]
+
+
+def test_watchdog_bisection_quarantines_nan_row():
+    """One planted non-finite row lands in the penalty box under its own
+    tenant; every other row — all of tier 0 included — is served."""
+    rows = _rows([("t0", 0), ("t0", 0), ("noisy", 2), ("t0", 0),
+                  ("acme", 1), ("noisy", 2), ("t0", 0), ("acme", 1)])
+    poison = 5  # a tier-2 "noisy" row
+    calls = []
+
+    def run_rows(idxs):
+        calls.append(list(idxs))
+        out = np.ones(len(idxs))
+        for j, i in enumerate(idxs):
+            if i == poison:
+                out[j] = np.nan
+        return out
+
+    def finite_of(out, idxs):
+        return np.isfinite(out)
+
+    wd = DispatchWatchdog(policy=RetryPolicy(max_retries=1, base=0.0))
+    results = wd.run_batch(rows, run_rows, finite_of=finite_of,
+                           tenant_of=lambda r: r.tenant,
+                           tier_of=lambda r: r.tier)
+
+    assert sorted(results) == [i for i in range(8) if i != poison]
+    assert wd.penalty.counts() == {"noisy": 1}
+    box = wd.penalty.rows()
+    assert box[0]["row"] == poison and box[0]["reason"] == "nonfinite"
+    assert box[0]["tier"] == 2
+    # The poisoned row got a singleton re-judgement (its retry budget)
+    # before quarantine, and the clean rows were re-served without it.
+    assert [poison] in calls
+    s = wd.summary()
+    assert s["quarantined_rows"] == 1
+    assert s["retry_concurrency_peak"] <= s["retry_concurrency_cap"]
+
+
+def test_watchdog_failing_rows_bisect_and_timeout():
+    """A raising row quarantines as "failing" after its bounded retries;
+    a hung dispatch raises :class:`DispatchTimeout` and is abandoned."""
+    rows = _rows([("a", 0), ("bad", 1), ("a", 0), ("a", 0)])
+
+    def run_rows(idxs):
+        if 1 in idxs:
+            raise ValueError("poisoned program")
+        return np.ones(len(idxs))
+
+    wd = DispatchWatchdog(policy=RetryPolicy(max_retries=1, base=0.0))
+    results = wd.run_batch(rows, run_rows,
+                           tenant_of=lambda r: r.tenant,
+                           tier_of=lambda r: r.tier)
+    assert sorted(results) == [0, 2, 3]
+    assert wd.penalty.counts() == {"bad": 1}
+    assert wd.penalty.rows()[0]["reason"] == "failing"
+    assert wd.summary()["failures"] >= 1
+
+    # Timeout: the guarded fn hangs past timeout_s; retries are bounded
+    # and the watchdog counts every timeout (threads are abandoned).
+    hang = threading.Event()
+    wd2 = DispatchWatchdog(
+        policy=RetryPolicy(max_retries=1, base=0.0), timeout_s=0.05,
+    )
+    with pytest.raises(DispatchFailed):
+        wd2.guard(lambda: hang.wait(5.0), key="wedged")
+    assert wd2.timeouts == 2  # first attempt + 1 retry
+    assert wd2.retries_total == 1
+    hang.set()
+
+    # A transient failure (fails once, then succeeds) is retried through.
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise DispatchTimeout("transient")
+        return "ok"
+
+    wd3 = DispatchWatchdog(policy=RetryPolicy(max_retries=2, base=0.0))
+    assert wd3.guard(flaky, key="flaky") == "ok"
+    assert wd3.retries_total == 1 and wd3.failures == 0
+
+
+def test_retry_gate_caps_concurrency():
+    """The shared gate bounds concurrent retries (peak ≤ cap), sheds
+    when saturated, and rejects unpaired releases."""
+    gate = RetryGate(2)
+    assert gate.acquire(timeout=0.0) and gate.acquire(timeout=0.0)
+    assert not gate.acquire(timeout=0.0)  # saturated → shed
+    assert gate.shed == 1
+    gate.release()
+    gate.release()
+    with pytest.raises(RuntimeError):
+        gate.release()
+
+    # Hammer from many threads: the high-water mark never exceeds the cap.
+    gate2 = RetryGate(3)
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        for _ in range(20):
+            if gate2.acquire(timeout=0.5):
+                gate2.release()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert 1 <= gate2.peak <= 3
+
+
+def test_penalty_box_sheds_tier_zero_last():
+    box = PenaltyBox()
+    for i, tier in enumerate([2, 0, 1, 2, 0]):
+        box.add(i, tenant=f"t{tier}", tier=tier)
+    order = box.shed_order()
+    assert [r["tier"] for r in order] == [2, 2, 1, 0, 0]
+    # FIFO within a tier; tier 0 is evicted last.
+    assert [r["row"] for r in order] == [0, 3, 2, 1, 4]
+    assert box.n == 5 and box.counts() == {"t2": 2, "t0": 2, "t1": 1}
+
+
+def test_recovery_config_validation(tmp_path):
+    with pytest.raises(ValueError, match="directory"):
+        RecoveryConfig(directory="")
+    with pytest.raises(ValueError, match="snapshot_every"):
+        RecoveryConfig(directory=str(tmp_path), snapshot_every=-1)
+    with pytest.raises(ValueError, match="fsync_every"):
+        RecoveryConfig(directory=str(tmp_path), fsync_every=0)
+    with pytest.raises(ValueError, match="dispatch_timeout_s"):
+        RecoveryConfig(directory=str(tmp_path), dispatch_timeout_s=0.0)
+    with pytest.raises(ValueError, match="max_concurrent_retries"):
+        RecoveryConfig(directory=str(tmp_path), max_concurrent_retries=0)
+
+
+# --------------------------------------------------------------------------
+# Kernel-level kill-and-resume: bit-identical warm resume from a snapshot
+# --------------------------------------------------------------------------
+
+_KH, _KB = 8, 16
+
+
+def _kernel_span(seed):
+    rng = np.random.default_rng(seed)
+    dem = rng.uniform(0.3, 2.5, (_KB, 4))
+    arrive = np.zeros(_KB, np.int32)
+    arrive[10:] = 3
+    norms = np.sqrt((dem * dem).sum(1))
+    return dem, arrive, norms
+
+
+def _run_spans(carry, span_seeds):
+    placements = []
+    for s in span_seeds:
+        dem, arrive, norms = _kernel_span(s)
+        res, carry = resident_span_run(
+            carry, jnp.asarray(dem), jnp.asarray(arrive),
+            jnp.asarray(8, jnp.int32), policy="first-fit", n_ticks=8,
+            sort_norm=jnp.asarray(norms),
+        )
+        placements.append(np.asarray(res.placements))
+    return placements, carry
+
+
+def test_kernel_kill_and_resume_bit_identical(tmp_path):
+    """The referee's restore half, where span boundaries are under test
+    control: kill a span chain after span 1, snapshot its pending carry
+    through the real :class:`SnapshotStore`, restore with
+    ``resident_carry_restore``, and the continued chain is bit-identical
+    (placements AND final carry) to never having stopped."""
+    rng = np.random.default_rng(100)
+    avail = rng.uniform(1, 6, (_KH, 4))
+    seeds = [1, 2, 3, 4]
+
+    ref_placements, ref_carry = _run_spans(
+        resident_carry_init(jnp.asarray(avail)), seeds
+    )
+
+    # Interrupted arm: two spans, then the process "dies".  The export
+    # reads the PENDING carry — a jit output not yet donated onward, the
+    # documented safe window.
+    killed_placements, pending = _run_spans(
+        resident_carry_init(jnp.asarray(avail)), seeds[:2]
+    )
+    store = SnapshotStore(str(tmp_path))
+    store.start()
+    store.submit(resident_carry_export(pending), {"span": 2})
+    _wait_written(store, 1)
+    store.stop()
+    del pending  # the kill: device state gone
+
+    arrays, meta = SnapshotStore(str(tmp_path)).latest()
+    assert meta["span"] == 2
+    resumed = resident_carry_restore(
+        arrays["avail"], arrays["counts"], arrays["live"]
+    )
+    resumed_placements, resumed_carry = _run_spans(resumed, seeds[2:])
+
+    for got, want in zip(
+        killed_placements + resumed_placements, ref_placements
+    ):
+        np.testing.assert_array_equal(got, want)
+    for field in ("avail", "counts", "live"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(resumed_carry, field)),
+            np.asarray(getattr(ref_carry, field)),
+        )
+
+
+# --------------------------------------------------------------------------
+# Driver-level integration: journal smoke + the kill-and-resume referee
+# --------------------------------------------------------------------------
+
+
+def test_driver_recovery_journal_smoke(tmp_path):
+    """A recovery-armed driver journals every admission and flush BEFORE
+    it takes effect, replays clean against its own stream, reports the
+    plane, and publishes the ``recover_*`` metrics."""
+    reset_ids()
+    arrs = list(poisson_arrivals(rate=0.5, n_jobs=5, seed=3))
+    session = ServeSession(
+        "s0", build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        _device_policy(), seed=0,
+    )
+    cfg = RecoveryConfig(directory=str(tmp_path), snapshot_every=4,
+                         fsync_every=4)
+    driver = ServeDriver([session], queue_depth=32, backpressure="shed",
+                         recovery=cfg)
+    report = driver.run(iter(arrs))
+    assert report["slo"]["counters"]["completed"] == 5
+
+    rec = report["recovery"]
+    assert rec["journal"]["records"] >= 6  # header + 5 admits + flushes
+    assert rec["journal"]["lag"] == 0  # closed journals are synced
+
+    records, torn = Journal.read(str(tmp_path / "journal.jsonl"))
+    assert torn == 0
+    kinds = {r["kind"] for r in records}
+    assert {"open", "admit", "flush"} <= kinds
+    assert replay_prefix_check(records, arrs) == 5
+
+    reg = MetricsRegistry()
+    driver.publish_metrics(reg)
+    assert reg.get("pivot_recover_journal_lag") == 0
+    assert reg.get("pivot_recover_retries_total") == 0
+    assert reg.get("pivot_recover_quarantined_rows", tenant="default") == 0
+
+
+def _soak_arrivals(n_jobs):
+    """The referee's seeded workload: a dense burst plus one straggler.
+
+    rate=20 piles a backlog deep enough that the "slo" fuser forms
+    multi-tick spans (a span needs armed pump deliveries inside its
+    window) — the resident/snapshot path needs real spans to exercise.
+    The far-future straggler matters for the KILL run: admitting it
+    releases the driver's frontier to ts=10000 while the producer still
+    holds the stream, so the burst serves (and snapshots) ungated
+    before the injected death — exactly a server dying with one job
+    still pending."""
+    reset_ids()
+    arrs = list(
+        mixed_tier_arrivals(
+            rate=20.0, n_jobs=n_jobs, weights=(0.5, 0.3, 0.2), seed=7,
+            make_app=synthetic_app_factory(seed=11),
+        )
+    )
+    straggler = Application("straggler", [
+        TaskGroup("s", cpus=1, mem=32, runtime=2.0, instances=1),
+    ])
+    arrs.append(JobArrival(ts=10_000.0, app=straggler, tier=0))
+    return arrs
+
+
+def _placements_of(arrs):
+    return sorted(
+        (t.id, t.placement)
+        for a in (x.app for x in arrs)
+        for g in a.groups
+        for t in g.tasks
+    )
+
+
+def _soak_run(recovery, n_jobs=18, source=None, chaos=True, market=True):
+    """One resident serve soak (single ``"slo"``-fused session, optional
+    proactive host preemption + spot market) under ``recovery``."""
+    arrs = _soak_arrivals(n_jobs)
+    session = ServeSession(
+        "s0", build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        _device_policy(), seed=0, fuse_spans="slo",
+    )
+    if chaos:
+        FaultInjector(session.cluster, seed=0).preempt_host(
+            session.cluster.hosts[2].id, at=8.0, lead=6.0, outage=25.0,
+        )
+    if market:
+        session.scheduler.market = MarketSchedule.generate(
+            session.cluster.meta, seed=5, horizon=400.0, n_segments=4,
+            hot_fraction=0.3, hot_hazard=1e-2, base_hazard=1e-4,
+        )
+    driver = ServeDriver(
+        [session], queue_depth=64, backpressure="shed", flush_after=0.02,
+        resident=True, splice_tier=2, recovery=recovery,
+    )
+    src = iter(arrs) if source is None else source(arrs, driver)
+    report = driver.run(src)
+    return arrs, driver, report
+
+
+def _kill_when_snapshotted(arrs, driver, timeout_s=120.0):
+    """Die mid-soak, after the first snapshot lands: every arrival is
+    admitted (journaled), the straggler's ts holds the frontier open so
+    the burst serves and snapshots, then the producer raises — the
+    driver's error path shuts the sessions down mid-service, with the
+    straggler still pending.  The journaled prefix covers the whole
+    stream, so the killed run's work is a prefix of the reference's."""
+
+    def gen():
+        for a in arrs:
+            yield a
+        plane = driver._recovery
+        deadline = time.monotonic() + timeout_s
+        while plane.snapshots.written < 1:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    "killed run never wrote a snapshot — no resident "
+                    "spans formed?"
+                )
+            time.sleep(0.01)
+        raise RuntimeError("injected kill: process died mid-soak")
+
+    return gen()
+
+
+def test_kill_and_resume_referee(tmp_path):
+    """THE referee: kill a recovery-armed chaos+market soak, tear its
+    journal tail, resume from snapshot + journal replay, and the
+    resumed service is bit-identical to an uninterrupted reference —
+    while ``recovery=None`` stays bit-identical to the PR-18 stack with
+    zero recompiles after warmup."""
+    n_jobs = 24
+    d_ref, d_kill = str(tmp_path / "ref"), str(tmp_path / "kill")
+
+    # Reference: uninterrupted, recovery-armed.
+    cfg_ref = RecoveryConfig(directory=d_ref, snapshot_every=2,
+                             fsync_every=8)
+    arrs_ref, drv_ref, rep_ref = _soak_run(cfg_ref, n_jobs)
+    ref_placements = _placements_of(arrs_ref)
+    ref_counters = rep_ref["slo"]["counters"]
+    assert ref_counters["arrived"] == n_jobs + 1  # burst + straggler
+    assert rep_ref["recovery"]["snapshots"]["written"] >= 1
+    assert rep_ref["recovery"]["journal"]["records"] > n_jobs
+
+    # The kill: same world, producer dies after the last admission; then
+    # simulate the crash tearing the journal's final append.
+    cfg_kill = RecoveryConfig(directory=d_kill, snapshot_every=2,
+                              fsync_every=8)
+    with pytest.raises(RuntimeError, match="injected kill"):
+        _soak_run(cfg_kill, n_jobs, source=_kill_when_snapshotted)
+    journal_path = str(tmp_path / "kill" / "journal.jsonl")
+    with open(journal_path, "a", encoding="utf-8") as f:
+        f.write('{"seq": 99999, "kind": "adm')  # torn mid-append
+
+    # Crash truth: the torn journal still validates, and its admissions
+    # match a seed-regenerated stream record for record.
+    records, torn = Journal.read(journal_path)
+    assert torn == 1
+    assert replay_prefix_check(
+        records, _soak_arrivals(n_jobs)
+    ) == n_jobs + 1
+
+    # Resume: same directory, resume=True — loads the killed run's
+    # latest snapshot, amputates the torn tail, replays the stream.
+    cfg_res = RecoveryConfig(directory=d_kill, snapshot_every=2,
+                             fsync_every=8, resume=True)
+    arrs_res, drv_res, rep_res = _soak_run(cfg_res, n_jobs)
+    plane = drv_res._recovery
+    assert plane.restored is not None, "no snapshot survived the kill"
+    # The resumed run re-reached the killed run's snapshotted span and
+    # its live carry fingerprinted bit-identically to the restored
+    # snapshot.  Span slicing is deterministic here because the
+    # straggler holds the frontier open through the whole burst in
+    # every run (see _soak_arrivals).
+    assert plane.resume_verified is True
+    assert _placements_of(arrs_res) == ref_placements
+    assert rep_res["slo"]["counters"] == ref_counters
+    records, torn = Journal.read(journal_path)
+    assert torn == 0
+    assert "resume" in {r["kind"] for r in records}
+
+    # The pin: recovery=None is bit-identical to the armed reference and
+    # compiles nothing new after the warmup runs above.
+    with count_compiles() as counter:
+        arrs_pin, _, rep_pin = _soak_run(None, n_jobs)
+    assert counter.compiles == 0, counter.compiles
+    assert _placements_of(arrs_pin) == ref_placements
+    assert rep_pin["slo"]["counters"] == ref_counters
+    assert rep_pin["recovery"] is None
+
+
+def test_watchdog_armed_driver_parity(tmp_path):
+    """Arming the dispatch watchdog (generous timeout) re-routes every
+    span dispatch through the guard thread yet changes nothing: bit-
+    identical placements, zero retries/timeouts/quarantine."""
+    n_jobs = 10
+    arrs_plain, _, rep_plain = _soak_run(None, n_jobs, chaos=False,
+                                         market=False)
+    cfg = RecoveryConfig(
+        directory=str(tmp_path), snapshot_every=4,
+        dispatch_timeout_s=120.0,
+        retry=RetryPolicy(max_retries=1, base=0.0),
+    )
+    arrs_armed, drv, rep_armed = _soak_run(cfg, n_jobs, chaos=False,
+                                           market=False)
+    assert _placements_of(arrs_armed) == _placements_of(arrs_plain)
+    assert rep_armed["slo"]["counters"] == rep_plain["slo"]["counters"]
+    wd = rep_armed["recovery"]["watchdog"]
+    assert wd["retries_total"] == 0 and wd["timeouts"] == 0
+    assert wd["quarantined_rows"] == 0
+    assert wd["retry_concurrency_peak"] == 0

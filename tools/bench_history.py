@@ -188,6 +188,23 @@ TRACKED: Tuple[Metric, ...] = (
         # fingerprint.
         rel_floor=30.0,
     ),
+    Metric(
+        "serve_recovery_dps",
+        ("serve_recovery", "recovery", "decisions_per_sec"),
+        lower_better=False, kind="rate",
+        # Round-21 crash-safe serving: resident serve throughput WITH
+        # the recovery plane armed (write-ahead journal on every
+        # admission/flush/span, background snapshot worker) — a
+        # collapse here means journaling or the carry clone leaked
+        # onto the dispatch hot path (the row's own overhead_5pct_ok
+        # flag catches the paired A/B regression; this tracks the
+        # absolute armed rate across commits).  Same threaded-soak
+        # load sensitivity as the other serve rows.  Phase-in: absent
+        # from pre-round-21 histories, so the gate notes (not fires)
+        # until the baseline carries rows with it on the gating box's
+        # fingerprint.
+        rel_floor=30.0,
+    ),
 )
 
 

@@ -77,6 +77,16 @@
 #      (kernel, sharded twin, DES end to end), zero recompiles after
 #      warmup, and the tiny mid-span splice soak against the
 #      sequential referee.
+#  12. crash-safe serving (round 21, pivot_tpu/recover/): the full
+#      recovery-plane module — journal tag/torn-tail/replay-prefix
+#      contracts, snapshot double-buffer round-trip + corruption
+#      fallback, watchdog batch bisection quarantining a planted NaN
+#      row with tier 0 untouched, the kernel-level kill-and-resume
+#      bit-identity referee, AND the driver-level referee: a server
+#      killed mid-soak (chaos + market engaged), restored from
+#      snapshot + journal replay, must be bit-identical to the
+#      uninterrupted run — plus the recovery=None off-switch pin
+#      (zero recompiles, nothing perturbed).
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -88,11 +98,11 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/11] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/12] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/11] graftcheck static analysis (10 passes) + compile check =="
+echo "== [2/12] graftcheck static analysis (10 passes) + compile check =="
 # Machine-readable findings, annotated per file:line; the 10 s timeout
 # IS the wall-clock budget check for the full static suite.  The
 # capture must not abort under `set -e` before lint_annotate has
@@ -117,7 +127,7 @@ python tools/hotpath_lint.py
 # assert ZERO recompiles in steady state (quick mode).
 python -m pivot_tpu.analysis --compile-check quick
 
-echo "== [3/11] chaos replay determinism on the committed seed =="
+echo "== [3/12] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -132,7 +142,7 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
-echo "== [4/11] sharded-placement parity on a forced 8-device CPU mesh =="
+echo "== [4/12] sharded-placement parity on a forced 8-device CPU mesh =="
 # Small-H quick twins + the H=1024 acceptance + the sharded span driver
 # + the round-17 2-D suite: the [G]-batched replica × host programs
 # (shard_map(vmap(...)) via batch_execute(mesh=...)) vs the sequential
@@ -151,7 +161,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_serve_2d.py -q -m 'not slow' \
     -k 'not 100x' -p no:cacheprovider
 
-echo "== [5/11] spot soak + market replay determinism on the committed seed =="
+echo "== [5/12] spot soak + market replay determinism on the committed seed =="
 MARKET_SEED_FILE=data/market/ci_seed.json
 # The quick acceptance soak (tier-1 twin in tests/test_market.py).
 python -m pytest tests/test_market.py -q -m 'not slow' \
@@ -171,7 +181,7 @@ python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
     --out "$TMP/spot_b.json"
 python tools/market_replay.py diff "$TMP/spot_a.json" "$TMP/spot_b.json"
 
-echo "== [6/11] observability plane: traced+profiled soak + trace check =="
+echo "== [6/12] observability plane: traced+profiled soak + trace check =="
 # A tiny traced serve soak through the CLI — device policy so the
 # sampled dispatch profiler (--profile-dispatch) has dispatches to
 # bracket; the Perfetto artifact must pass the structural + causal +
@@ -189,7 +199,7 @@ grep -q "pivot_dispatch_latency_seconds" "$TMP/soak.prom"
 python -m pytest tests/test_obs.py -q -m 'not slow' \
     -k 'parity or chain or overhead' -p no:cacheprovider
 
-echo "== [7/11] continuous-bench regression gate (committed baseline) =="
+echo "== [7/12] continuous-bench regression gate (committed baseline) =="
 BASELINE=data/bench/ci_baseline.jsonl
 # The committed baseline history must gate clean against itself...
 python tools/bench_history.py check --history "$BASELINE"
@@ -208,7 +218,7 @@ if [ "$inj_rc" -ne 1 ]; then
     exit 1
 fi
 
-echo "== [8/11] policy search: tiny CEM beats bad init + replays =="
+echo "== [8/12] policy search: tiny CEM beats bad init + replays =="
 # The round-16 learned-scheduler gate: a tiny CEM search (2
 # generations, popsize 4, small cluster) over the COMMITTED seeded
 # config (data/search/ci_seed.json) must strictly beat the
@@ -244,7 +254,7 @@ print(
 )
 PYEOF
 
-echo "== [9/11] ragged continuous batching: repack parity + mixed-horizon soak =="
+echo "== [9/12] ragged continuous batching: repack parity + mixed-horizon soak =="
 # Round 18: mixed-horizon serve spans padded into a shared (K, B)
 # bucket and run as ONE device program.  Quick repack/batcher parity
 # smalls + the tiny mixed-horizon soak vs the per-tick referee, on the
@@ -253,7 +263,7 @@ echo "== [9/11] ragged continuous batching: repack parity + mixed-horizon soak =
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_ragged.py -q -m 'not slow' -p no:cacheprovider
 
-echo "== [10/11] model-predictive serving: replay + parity + off-switch =="
+echo "== [10/12] model-predictive serving: replay + parity + off-switch =="
 # Round 19: the simulator's fitness estimator runs INSIDE the server.
 # Quick deterministic gates only — forecast/render bit-replay, the
 # five-slot planner's clone-parity/bitwise-replay/referee contract,
@@ -265,7 +275,7 @@ python -m pytest tests/test_mpc.py -q -m 'not slow' \
     -k 'determinism or parity or replay or recompiles or dry_run' \
     -p no:cacheprovider
 
-echo "== [11/11] resident-carry serving: parity smalls + tiny splice soak =="
+echo "== [11/12] resident-carry serving: parity smalls + tiny splice soak =="
 # Round 20: device-persistent span state, donated forward span to span.
 # Quick gates only — kernel-level resident vs re-staged bit-parity
 # (every policy config, live masks, the once-staged risk table, edit-row
@@ -276,5 +286,12 @@ echo "== [11/11] resident-carry serving: parity smalls + tiny splice soak =="
 # full policy × phase2 × instant sweeps are slow-marked tier-1.
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_resident.py -q -m 'not slow' -p no:cacheprovider
+
+echo "== [12/12] crash-safe serving: recovery plane + kill-and-resume =="
+# Round 21: the whole module, INCLUDING the slow-marked driver-level
+# kill-and-resume referee — a crash-recovery gate that only runs in
+# tier 1 would let a resume regression ship in any PR that skips the
+# slow tier, so the smoke lane pays the ~2 s for the real thing.
+python -m pytest tests/test_recovery.py -q -p no:cacheprovider
 
 echo "smoke lane: all green"

@@ -418,6 +418,14 @@ def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
     cats: Dict[str, int] = {}
     for e in events:
         cats[str(e.get("cat"))] = cats.get(str(e.get("cat")), 0) + 1
+    # Recovery-plane marks (round 21, cat="recover"): snapshot cadence
+    # and watchdog activity on the same timeline as the dispatches they
+    # protect — counted by name so a soak report shows the plane lived.
+    recover: Dict[str, int] = {}
+    for e in events:
+        if e.get("cat") == "recover":
+            name = str(e.get("name"))
+            recover[name] = recover.get(name, 0) + 1
     return {
         "events": len(events),
         "jobs_traced": len(chains),
@@ -464,6 +472,7 @@ def build_report(events: List[Dict[str, Any]], top: int = 10) -> dict:
             "final": depth,
             "curve_tail": depth_curve[-10:],
         },
+        "recovery_events": dict(sorted(recover.items())),
         "event_categories": dict(sorted(cats.items())),
     }
 
@@ -574,6 +583,8 @@ def main(argv=None) -> int:
         f"in-flight depth: peak={report['inflight_depth']['peak']} "
         f"final={report['inflight_depth']['final']}"
     )
+    if report.get("recovery_events"):
+        print(f"recovery plane: {report['recovery_events']}")
     print(f"categories: {report['event_categories']}")
     return 0
 
