@@ -2750,6 +2750,246 @@ def _bench_serve_recovery_in_child(timeout_s: int = 540) -> dict:
     return _run_row_in_child("PIVOT_BENCH_SERVE_RECOVERY_CHILD", timeout_s)
 
 
+# -- serve_elastic row: elastic mesh serving under device loss (round 22) ----
+
+
+def _bench_serve_elastic(
+    n_jobs: int = 18,
+    rate: float = 20.0,
+    seed: int = 0,
+) -> dict:
+    """Elastic-mesh serving row (round 22, ``pivot_tpu/serve/elastic``).
+
+    The same seeded mixed-tier chaos+market sharded resident soak as
+    the elastic referee (``tests/test_elastic.py``), served twice on
+    the forced-8-device CPU mesh:
+
+      * **healthy** — an armed ``ElasticMeshManager`` with an EMPTY
+        fault plan (the gate runs on every dispatch, pure overhead,
+        full mesh end to end);
+      * **kill_one_shard** — a seeded ``fail_device`` window drops
+        shard 3 mid-soak: the session crashes at the gate, the
+        supervisor requeues its work, the replacement reshards onto the
+        4-rung of the divisor ladder and keeps serving, and the
+        far-future straggler dispatch lands after the restore and
+        regrows the full mesh through a passing shadow probe.
+
+    Per arm: decisions/s, per-tier p99, completions; the kill arm adds
+    ``recovery_latency_ms`` — wall clock from the device-loss raise to
+    the first dispatch served by the shrunk mesh (the requeue + reshard
+    + re-warm window) — plus shrink/regrow/probe counts and tier-0
+    losslessness (``tier0_lossless_ok``).  Tracked as
+    ``serve_elastic_dps`` (the kill arm — the headline is throughput
+    *while surviving*) in ``tools/bench_history.py``, phase-in."""
+    from pivot_tpu.infra.faults import (
+        ChaosEvent,
+        ChaosSchedule,
+        FaultInjector,
+    )
+    from pivot_tpu.infra.market import MarketSchedule
+    from pivot_tpu.parallel.mesh import host_sharded_mesh
+    from pivot_tpu.serve import (
+        ElasticMeshManager,
+        JobArrival,
+        ServeDriver,
+        ServeSession,
+        mixed_tier_arrivals,
+        synthetic_app_factory,
+    )
+    from pivot_tpu.serve.elastic import ElasticConfig
+    from pivot_tpu.utils import reset_ids
+    from pivot_tpu.utils.config import (
+        ClusterConfig,
+        PolicyConfig,
+        build_cluster,
+        make_policy,
+    )
+    from pivot_tpu.workload import Application, TaskGroup
+
+    mesh = host_sharded_mesh(8)
+    pcfg = PolicyConfig(
+        name="cost-aware", device="tpu", bin_pack="first-fit",
+        sort_tasks=True, sort_hosts=True, adaptive=False,
+    )
+
+    class _TimedElastic(ElasticMeshManager):
+        """Bench instrumentation: wall-stamp the first device-loss
+        raise and the first dispatch the shrunk mesh serves — their
+        difference is the row's recovery latency (requeue + reshard +
+        replacement warmup, the price of surviving)."""
+
+        def __init__(self, config=None):
+            super().__init__(config)
+            self.loss_wall = None
+            self.resume_wall = None
+
+        def note_loss(self, exc, label):
+            if self.loss_wall is None:
+                self.loss_wall = time.perf_counter()
+            super().note_loss(exc, label)
+
+        def _gate_for(self, policy):
+            gate = super()._gate_for(policy)
+
+            def timed_gate(now):
+                gate(now)
+                if (
+                    self.resume_wall is None
+                    and self.loss_wall is not None
+                    and self.shrinks >= 1
+                ):
+                    self.resume_wall = time.perf_counter()
+
+            return timed_gate
+
+    def arrivals():
+        reset_ids()
+        arrs = list(
+            mixed_tier_arrivals(
+                rate=rate, n_jobs=n_jobs, weights=(0.5, 0.3, 0.2),
+                seed=7, make_app=synthetic_app_factory(seed=11),
+            )
+        )
+        # The far-future straggler dispatches past the restore window —
+        # the regrow arm's feedstock (frontier-judged promotion).
+        arrs.append(JobArrival(
+            ts=10_000.0,
+            app=Application("bench-straggler", [
+                TaskGroup("s", cpus=1, mem=32, runtime=2.0, instances=1),
+            ]),
+            tier=0,
+        ))
+        return arrs
+
+    def soak(manager):
+        arrs = arrivals()
+
+        def factory(label):
+            s = ServeSession(
+                label, build_cluster(ClusterConfig(n_hosts=8, seed=seed)),
+                make_policy(pcfg), seed=seed, fuse_spans="slo",
+            )
+            s.policy.enable_sharding(mesh)
+            FaultInjector(s.cluster, seed=seed).preempt_host(
+                s.cluster.hosts[2].id, at=8.0, lead=6.0, outage=25.0,
+            )
+            s.scheduler.market = MarketSchedule.generate(
+                s.cluster.meta, seed=5, horizon=400.0, n_segments=4,
+                hot_fraction=0.3, hot_hazard=1e-2, base_hazard=1e-4,
+            )
+            return s
+
+        driver = ServeDriver(
+            [factory("el-0")], queue_depth=64, backpressure="shed",
+            flush_after=0.02, resident=True, splice_tier=2,
+            session_factory=factory, max_restarts=4, elastic=manager,
+        )
+        t0 = time.perf_counter()
+        report = driver.run(iter(arrs))
+        wall = time.perf_counter() - t0
+        snap = report["slo"]
+        tiers = {}
+        for tier, tsnap in snap["tiers"].items():
+            lat = tsnap["decision_latency_s"]
+            tiers[tier] = {
+                "p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+                "admitted": tsnap["counters"]["admitted"],
+                "completed": tsnap["counters"]["completed"],
+            }
+        return {
+            "wall_s": round(wall, 3),
+            "decisions": snap["counters"]["decisions"],
+            "decisions_per_sec": round(
+                snap["counters"]["decisions"] / max(wall, 1e-9), 1
+            ),
+            "completed": snap["counters"]["completed"],
+            "failed": snap["counters"].get("failed_jobs", 0),
+            "tiers": tiers,
+        }, report
+
+    # Warmup compiles outside both timed arms — one healthy pass (the
+    # full-mesh program family) and one kill pass (the 4-rung family),
+    # so neither timed wall pays a trace.
+    soak(_TimedElastic())
+    soak(_TimedElastic(ElasticConfig(schedule=ChaosSchedule(
+        seed=13, events=[ChaosEvent(
+            kind="device_fault", at=6.0, target="device:3",
+            duration=200.0,
+        )],
+    ))))
+
+    healthy, _ = soak(_TimedElastic())
+
+    kill_mgr = _TimedElastic(ElasticConfig(schedule=ChaosSchedule(
+        seed=13, events=[ChaosEvent(
+            kind="device_fault", at=6.0, target="device:3",
+            duration=200.0,
+        )],
+    )))
+    kill, kill_report = soak(kill_mgr)
+    recovery_ms = (
+        round((kill_mgr.resume_wall - kill_mgr.loss_wall) * 1e3, 1)
+        if kill_mgr.resume_wall is not None
+        and kill_mgr.loss_wall is not None
+        else None
+    )
+    tier0 = kill["tiers"].get(0) or kill["tiers"].get("0") or {}
+    return {
+        "n_jobs": n_jobs,
+        "rate": rate,
+        "ladder": list(kill_mgr.ladder),
+        "healthy": healthy,
+        "kill_one_shard": {
+            **kill,
+            "recovery_latency_ms": recovery_ms,
+            "shrinks": kill_mgr.shrinks,
+            "regrows": kill_mgr.regrows,
+            "probes": kill_mgr.probes,
+            "probe_failures": kill_mgr.probe_failures,
+            "device_losses": kill_report["slo"]["counters"].get(
+                "device_losses", 0
+            ),
+            "session_restarts": kill_report["slo"]["counters"].get(
+                "session_restarts", 0
+            ),
+        },
+        "survived_ok": bool(
+            kill_mgr.shrinks >= 1
+            and kill["failed"] == 0
+            and kill["completed"] == n_jobs + 1
+        ),
+        "regrow_ok": bool(
+            kill_mgr.regrows >= 1 and kill_mgr.probe_failures == 0
+        ),
+        "tier0_lossless_ok": bool(
+            tier0.get("completed", 0) == tier0.get("admitted", -1)
+        ),
+    }
+
+
+def _serve_elastic_child() -> None:
+    """Child-mode entry (``PIVOT_BENCH_SERVE_ELASTIC_CHILD=1``): pin the
+    forced-8-device CPU mesh BEFORE the first jax import (XLA reads the
+    flag once per process), run the serve_elastic row, print ONE JSON
+    line."""
+    os.environ["PIVOT_BENCH_BACKEND"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    jax = _child_backend_setup()
+    row = _bench_serve_elastic()
+    row["backend"] = jax.default_backend()
+    row["n_devices"] = len(jax.devices())
+    print(json.dumps(row), flush=True)
+
+
+def _bench_serve_elastic_in_child(timeout_s: int = 540) -> dict:
+    """Parent side of the serve_elastic row — see
+    ``_run_row_in_child``."""
+    return _run_row_in_child("PIVOT_BENCH_SERVE_ELASTIC_CHILD", timeout_s)
+
+
 # -- shard_place row: pod-scale host-sharded placement (ops/shard.py) -------
 #
 # Weak-scaling protocol: per-shard host count H0 held fixed while the
@@ -3153,6 +3393,7 @@ def main() -> None:
             "headline", "two_phase", "grid_batched", "fused_tick",
             "serve_stream", "serve_tiers", "serve_sharded",
             "serve_ragged", "serve_mpc", "serve_resident", "serve_recovery",
+            "serve_elastic",
             "shard_place",
             "spot_survival", "policy_search", "obs_overhead",
             "profiler_overhead", "cost_attribution", "saturated",
@@ -3192,6 +3433,9 @@ def main() -> None:
         return
     if os.environ.get("PIVOT_BENCH_SERVE_RECOVERY_CHILD"):
         _serve_recovery_child()
+        return
+    if os.environ.get("PIVOT_BENCH_SERVE_ELASTIC_CHILD"):
+        _serve_elastic_child()
         return
     backend_override = os.environ.get("PIVOT_BENCH_BACKEND")
     # Probe breadcrumbs survive the watchdog re-exec via the environment,
@@ -3317,6 +3561,10 @@ def main() -> None:
     )
     serve_recovery = (
         _bench_serve_recovery_in_child() if _row_on("serve_recovery")
+        else skipped
+    )
+    serve_elastic = (
+        _bench_serve_elastic_in_child() if _row_on("serve_elastic")
         else skipped
     )
     # Pod-scale sharded placement, also all-children (each arm pins its
@@ -3505,6 +3753,7 @@ def main() -> None:
         "serve_mpc": serve_mpc,
         "serve_resident": serve_resident,
         "serve_recovery": serve_recovery,
+        "serve_elastic": serve_elastic,
         "shard_place": shard_place,
         "spot_survival": spot_survival,
         "policy_search": policy_search,

@@ -61,6 +61,14 @@ DISCOVER: Dict[str, Tuple[str, ...]] = {
         "*_sharded_body", "_span_fn_body",
         # Round-20: the shard-resident donated-carry span body factory.
         "_resident_span_fn_body",
+        # Round-22 (elastic mesh serving) adds NO new device bodies:
+        # every ladder rung reuses the sharded programs above on a
+        # smaller mesh.  The ``elastic_*`` / ``mesh_shape_ladder``
+        # re-layout helpers are deliberately HOST-side (numpy at the
+        # reshard boundary — folding the carry off a dying mesh IS a
+        # host materialization) and must stay out of these patterns:
+        # registering them would flag their np.asarray fetches, which
+        # are the feature, not a leak.
     ),
     "pivot_tpu/parallel/ensemble/tick.py": ("_rollout_segment",),
     "pivot_tpu/search/fitness.py": ("_fitness_rows_impl", "_draw_rows_impl"),

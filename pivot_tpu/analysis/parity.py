@@ -136,6 +136,14 @@ MANIFEST: Dict[str, Dict[str, Tuple[str, FrozenSet[str]]]] = {
 #: live/risk parity against the re-staged driver).
 _RESIDENT_EXEMPT = frozenset({"live", "risk_rows"})
 
+#: Elastic mesh serving (round 22) registers NO new span forms here:
+#: shrink/regrow re-instantiates the ``sharded_*`` entries below on a
+#: smaller/larger mesh from the divisor ladder
+#: (``ops.shard.mesh_shape_ladder``), so every rung is covered by the
+#: existing rows — the one-knob contract holds per rung for free.  The
+#: ``elastic_*`` re-layout helpers are host-side numpy (reshard
+#: boundary, not a device program) and are intentionally invisible to
+#: the discovery patterns.
 SPAN_MANIFEST: Dict[str, Tuple[str, FrozenSet[str]]] = {
     "fused_tick_run": (_TICKLOOP, frozenset()),
     "reference_tick_run": (_TICKLOOP, frozenset()),

@@ -67,8 +67,48 @@ from pivot_tpu.utils import LogMixin
 from pivot_tpu.utils.trace import NULL_TRACER, Tracer
 
 __all__ = [
-    "ChaosEvent", "ChaosSchedule", "FaultInjector", "check_schema_header",
+    "ChaosEvent", "ChaosSchedule", "DeviceFaultPlan", "DeviceLostError",
+    "FaultInjector", "check_schema_header", "device_ordinal",
 ]
+
+
+class DeviceLostError(RuntimeError):
+    """A dispatch targeted a mesh device that is down — raised
+    deterministically at the dispatch boundary by the elastic fault gate
+    (``serve/elastic.py``) when a :class:`DeviceFaultPlan` window covers
+    the dispatch instant, or by real-loss classification.  Carries the
+    dead ordinals so the elastic manager can shrink around them.  NOT
+    swallowed by the ``degrade_after`` guard: device loss is a
+    mesh-level event (shrink + reshard), not kernel flakiness (CPU-twin
+    fallback)."""
+
+    def __init__(self, ordinals, at: float):
+        self.ordinals = tuple(sorted(int(o) for o in ordinals))
+        self.at = float(at)
+        super().__init__(
+            f"mesh device(s) {list(self.ordinals)} down at t={self.at:g}"
+        )
+
+
+def device_ordinal(target: str) -> int:
+    """Parse a ``"device:<ordinal>"`` chaos target into its ordinal.
+    Raises ``ValueError`` on anything else — device events address mesh
+    device slots (the compute plane), not DES hosts, and a host id
+    leaking into a device event must fail at load, not replay."""
+    s = str(target)
+    if not s.startswith("device:"):
+        raise ValueError(
+            f"device event target must be 'device:<ordinal>', got {target!r}"
+        )
+    try:
+        ordinal = int(s.split(":", 1)[1])
+    except ValueError:
+        raise ValueError(
+            f"device event target must be 'device:<ordinal>', got {target!r}"
+        ) from None
+    if ordinal < 0:
+        raise ValueError(f"device ordinal must be >= 0, got {ordinal}")
+    return ordinal
 
 
 class FaultInjector(LogMixin):
@@ -106,12 +146,59 @@ class FaultInjector(LogMixin):
         # (``GlobalScheduler.on_preempt_warning``).  Empty by default, so
         # reactive worlds are untouched.
         self._warning_hooks: List = []
+        # Called with (ordinal, kind, sim_now) at every device_fault /
+        # device_restore instant — the elastic serving layer registers its
+        # shrink/regrow trigger here.  Device events address mesh device
+        # slots, not DES hosts, so the injector only logs and relays them.
+        self._device_hooks: List = []
 
     def add_warning_hook(self, hook) -> None:
         """Register ``hook(host, lead)`` to run at every spot-preemption
         warning instant, after the host's drain flag is set (``lead`` is
         the seconds until the abort fires)."""
         self._warning_hooks.append(hook)
+
+    def add_device_hook(self, hook) -> None:
+        """Register ``hook(ordinal, kind, now)`` to run at every
+        ``device_fault`` / ``device_restore`` instant (``kind`` is the
+        event kind string).  The serving stack's elastic manager is the
+        intended consumer; the DES-side cluster is untouched."""
+        self._device_hooks.append(hook)
+
+    # -- device (compute-plane) faults -------------------------------------
+    def _device_event(self, ordinal: int, kind: str, at: float) -> None:
+        """Schedule a device-plane event: log + tracer + relay to the
+        registered device hooks.  Unlike host faults there is no DES-side
+        state to mutate — the dispatch layer consults the
+        :class:`DeviceFaultPlan` (and/or these hooks) directly."""
+
+        def _fire():
+            label = f"device:{ordinal}"
+            self.log.append((self.env.now, label, kind))
+            self.tracer.emit("device", kind, self.env.now, id=label)
+            self.logger.debug("[%.3f] %s %s", self.env.now, label, kind)
+            for hook in self._device_hooks:
+                hook(ordinal, kind, self.env.now)
+
+        self.env.schedule_callback_at(at, _fire)
+
+    def fail_device(
+        self, ordinal: int, at: float, duration: Optional[float] = None
+    ) -> None:
+        """Kill mesh device slot ``ordinal`` at sim time ``at``; restore
+        it ``duration`` seconds later (never, if ``duration`` is None).
+        The DES cluster is untouched — targeted dispatches raise through
+        the :class:`DeviceFaultPlan` consulted at the dispatch boundary."""
+        if ordinal < 0:
+            raise ValueError(f"device ordinal must be >= 0, got {ordinal}")
+        if duration is not None and duration <= 0:
+            raise ValueError(
+                f"device outage duration must be > 0 (or None for "
+                f"permanent), got {duration}"
+            )
+        self._device_event(int(ordinal), "device_fault", at)
+        if duration is not None:
+            self._device_event(int(ordinal), "device_restore", at + duration)
 
     # -- host faults -----------------------------------------------------
     def fail_host(self, host_id: str, at: float, duration: Optional[float] = None):
@@ -422,6 +509,12 @@ class FaultInjector(LogMixin):
             elif ev.kind == "partition":
                 a, b = ev.target.split("|")
                 self.partition_regions(a, b, ev.at, ev.duration)
+            elif ev.kind == "device_fault":
+                self.fail_device(device_ordinal(ev.target), ev.at, ev.duration)
+            elif ev.kind == "device_restore":
+                self._device_event(
+                    device_ordinal(ev.target), "device_restore", ev.at
+                )
             else:
                 raise ValueError(f"unknown chaos event kind {ev.kind!r}")
         return self
@@ -512,14 +605,21 @@ class ChaosEvent:
     required for stragglers and partitions; ``lead`` / ``factor`` are the
     preemption warning lead and straggler slowdown."""
 
-    kind: str  # host_outage | domain_outage | preemption | straggler | partition
+    kind: str  # host_outage | domain_outage | preemption | straggler | partition | device_fault | device_restore
     at: float
     target: str
     duration: Optional[float] = None
     lead: float = 0.0
     factor: float = 1.0
 
-    KINDS = ("host_outage", "domain_outage", "preemption", "straggler", "partition")
+    KINDS = (
+        "host_outage", "domain_outage", "preemption", "straggler",
+        "partition", "device_fault", "device_restore",
+    )
+    #: Kinds addressing mesh device slots (the compute plane) rather than
+    #: DES hosts — consumed by :class:`DeviceFaultPlan`, ignored by the
+    #: DES-side injector primitives.
+    DEVICE_KINDS = ("device_fault", "device_restore")
 
     def __post_init__(self):
         if self.kind not in self.KINDS:
@@ -537,6 +637,20 @@ class ChaosEvent:
                 f"{self.kind} events require a positive duration, "
                 f"got {self.duration!r}"
             )
+        if self.kind in self.DEVICE_KINDS:
+            device_ordinal(self.target)  # 'device:<ordinal>' or ValueError
+            if self.kind == "device_restore" and self.duration is not None:
+                raise ValueError(
+                    "device_restore is instantaneous (a fail window ends "
+                    f"at its restore's time), got duration={self.duration!r}"
+                )
+            if self.kind == "device_fault" and (
+                self.duration is not None and self.duration <= 0
+            ):
+                raise ValueError(
+                    "device_fault duration must be > 0 (or None, ended by "
+                    f"an explicit device_restore), got {self.duration!r}"
+                )
 
     def to_dict(self) -> dict:
         d = {"kind": self.kind, "at": self.at, "target": self.target}
@@ -808,3 +922,127 @@ class ChaosSchedule:
                 "regions": regions,
             },
         )
+
+
+# ---------------------------------------------------------------------------
+# DeviceFaultPlan — the compute-plane fault plan (elastic mesh serving)
+# ---------------------------------------------------------------------------
+
+
+class DeviceFaultPlan:
+    """The device-plane view of a :class:`ChaosSchedule`: per-ordinal fail
+    windows, validated eagerly and consulted at the dispatch boundary.
+
+    A ``device_fault`` opens a window at ``at`` (closed by its own
+    ``duration``, or by a later explicit ``device_restore``; never, if
+    neither).  Windows are half-open ``[fail, restore)`` — a dispatch at
+    exactly the restore instant sees a healthy device.  The plan is a pure
+    function of the schedule, so replaying the same schedule reproduces
+    the identical loss sequence bit-for-bit (the elastic referee's
+    determinism contract).
+
+    Load-hardening (all rejected at construction, naming the event):
+      * unknown device index (``ordinal >= n_devices``)
+      * ``device_restore`` with no open fail window on that ordinal
+      * overlapping fail windows on one ordinal (a fault while down)
+    """
+
+    def __init__(self, windows: Dict[int, List[Tuple[float, float]]],
+                 n_devices: int):
+        #: ordinal -> sorted list of half-open (fail_at, restore_at)
+        #: windows; ``restore_at`` is ``inf`` for permanent faults.
+        self.windows = {k: sorted(v) for k, v in windows.items()}
+        self.n_devices = int(n_devices)
+
+    @classmethod
+    def from_schedule(
+        cls, schedule: "ChaosSchedule", n_devices: int
+    ) -> "DeviceFaultPlan":
+        if n_devices <= 0:
+            raise ValueError(f"n_devices must be > 0, got {n_devices}")
+        # Events arrive (at, kind, target)-sorted from ChaosSchedule; that
+        # orders a same-instant restore BEFORE a same-instant fault
+        # ('device_fault' < 'device_restore' lexically is false — fault
+        # sorts first), so walk with explicit open-window bookkeeping.
+        open_at: Dict[int, float] = {}
+        windows: Dict[int, List[Tuple[float, float]]] = {}
+        for ev in schedule.events:
+            if ev.kind not in ChaosEvent.DEVICE_KINDS:
+                continue
+            ordinal = device_ordinal(ev.target)
+            if ordinal >= n_devices:
+                raise ValueError(
+                    f"device event targets unknown device index {ordinal} "
+                    f"(mesh has {n_devices} devices): {ev.describe()}"
+                )
+            if ev.kind == "device_fault":
+                if ordinal in open_at:
+                    raise ValueError(
+                        f"overlapping fail windows on device {ordinal}: "
+                        f"fault at t={ev.at:g} while already down since "
+                        f"t={open_at[ordinal]:g}"
+                    )
+                if ev.duration is not None:
+                    windows.setdefault(ordinal, []).append(
+                        (ev.at, ev.at + ev.duration)
+                    )
+                else:
+                    open_at[ordinal] = ev.at
+            else:  # device_restore
+                if ordinal not in open_at:
+                    raise ValueError(
+                        f"device_restore at t={ev.at:g} for device "
+                        f"{ordinal} with no preceding open device_fault "
+                        "(self-closing faults carry their own duration)"
+                    )
+                windows.setdefault(ordinal, []).append(
+                    (open_at.pop(ordinal), ev.at)
+                )
+        for ordinal, at in open_at.items():
+            windows.setdefault(ordinal, []).append((at, float("inf")))
+        # A self-closing fault can still overlap a later window; check the
+        # assembled per-ordinal timelines.
+        for ordinal, spans in windows.items():
+            spans.sort()
+            for (a0, a1), (b0, _b1) in zip(spans, spans[1:]):
+                if b0 < a1:
+                    raise ValueError(
+                        f"overlapping fail windows on device {ordinal}: "
+                        f"[{a0:g}, {a1:g}) and one starting at t={b0:g}"
+                    )
+        return cls(windows, n_devices)
+
+    def down_at(self, t: float) -> frozenset:
+        """Ordinals whose fail window covers sim time ``t`` (half-open:
+        down at the fault instant, healthy at the restore instant)."""
+        return frozenset(
+            ordinal
+            for ordinal, spans in self.windows.items()
+            if any(a <= t < b for a, b in spans)
+        )
+
+    def hit(self, t: float, ordinals) -> frozenset:
+        """The subset of ``ordinals`` down at ``t`` — the dispatch-boundary
+        check: non-empty means this execution targets a dead device and
+        must raise (deterministically, every replay)."""
+        return self.down_at(t) & frozenset(int(o) for o in ordinals)
+
+    def events_in(self, t0: float, t1: float) -> List[Tuple[float, str, int]]:
+        """Chronological (time, kind, ordinal) transitions in ``[t0, t1)``
+        — what ``tools/chaos_replay.py diff`` renders for device events."""
+        out: List[Tuple[float, str, int]] = []
+        for ordinal, spans in self.windows.items():
+            for a, b in spans:
+                if t0 <= a < t1:
+                    out.append((a, "device_fault", ordinal))
+                if b != float("inf") and t0 <= b < t1:
+                    out.append((b, "device_restore", ordinal))
+        return sorted(out)
+
+    def describe(self) -> List[str]:
+        out = []
+        for ordinal in sorted(self.windows):
+            for a, b in self.windows[ordinal]:
+                end = "inf" if b == float("inf") else f"{b:g}"
+                out.append(f"device:{ordinal} down [{a:g}, {end})")
+        return out

@@ -96,6 +96,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 try:  # jax >= 0.6 top-level export
@@ -134,10 +135,13 @@ from pivot_tpu.ops.tickloop import (
     _span_requeue,
     _span_stream_order,
     fused_tick_run,
+    resident_carry_export,
+    resident_carry_init,
 )
 from pivot_tpu.parallel.mesh import host_axis_size
 
 __all__ = [
+    "DEAD_AVAIL",
     "HOST_AXIS",
     "REPLICA_AXIS",
     "batched_sharded_call",
@@ -146,9 +150,16 @@ __all__ = [
     "check_row_divisibility",
     "cost_aware_kernel_sharded",
     "cost_aware_kernel_sharded_batched",
+    "elastic_fold_carry",
+    "elastic_host_extent",
+    "elastic_pad_rows",
+    "elastic_pad_state",
+    "elastic_trim_rows",
     "first_fit_kernel_sharded",
     "first_fit_kernel_sharded_batched",
     "mesh_is_2d",
+    "mesh_shape_ladder",
+    "next_ladder_shape",
     "opportunistic_kernel_sharded",
     "opportunistic_kernel_sharded_batched",
     "row_sharding",
@@ -1897,3 +1908,171 @@ def batched_sharded_call(mesh, kernel, static_kw, n_args, kw_keys):
         )
 
     return call
+
+
+# ---------------------------------------------------------------------------
+# Elastic re-layout helpers (round 20 — elastic mesh serving)
+#
+# When a mesh device dies mid-soak the serving stack shrinks onto the
+# next rung of a DECLARED mesh-shape ladder (the descending divisor
+# chain of the launch device count — a bounded set, so the per-shape
+# compile caches stay bounded too).  Host-state arrays re-lay from the
+# old shape onto the new one here: trim any old pad rows back to the
+# true host count, then pad to the new shape's extent with DEAD-sentinel
+# rows.  Pad rows are inert by construction — a :data:`DEAD_AVAIL`
+# availability row can never satisfy a demand (fit requires
+# ``demand <= avail`` per dimension, and demands are >= 0) and the pad
+# live mask is False, so the masked-argmin reduces the kernels already
+# obey can never select one.  Elasticity changes WHERE state lives,
+# never WHAT is decided: placements on the shrunk mesh are bit-identical
+# to a from-scratch run on that mesh over the same host truth
+# (``tests/test_elastic.py`` pad-inertness + shrink-parity referees).
+# ---------------------------------------------------------------------------
+
+#: Availability fill for dead-sentinel pad hosts: strictly below any
+#: demand (demands are >= 0), so a pad row fails every fit mask even
+#: before the False live mask excludes it (belt and braces — the same
+#: -1 convention ``_check_host_axis``'s error message documents).
+DEAD_AVAIL = -1.0
+
+
+def mesh_shape_ladder(n_devices: int):
+    """The declared elastic shapes for a ``n_devices`` launch mesh: its
+    divisors, descending (8 → ``(8, 4, 2, 1)``).  Shrink steps walk DOWN
+    the ladder to the largest rung the survivors can fill; regrow walks
+    back UP.  The ladder bounds the compile cache: one program per
+    (rung, span config), zero recompiles after warmup per shape."""
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"mesh ladder needs n_devices >= 1, got {n}")
+    return tuple(s for s in range(n, 0, -1) if n % s == 0)
+
+
+def next_ladder_shape(ladder, n_live: int) -> int:
+    """Largest ladder rung fillable by ``n_live`` surviving devices —
+    the shrink target after a loss.  Raises when nothing survives."""
+    for s in ladder:
+        if s <= n_live:
+            return int(s)
+    raise ValueError(
+        f"no ladder rung <= {n_live} surviving devices (ladder {ladder})"
+    )
+
+
+def elastic_host_extent(H: int, n_shards: int) -> int:
+    """Smallest multiple of ``n_shards`` >= ``H`` — the padded host
+    extent a non-dividing host count re-lays onto (pad rows are
+    dead-sentinel, inert by masked-argmin; see module comment)."""
+    if H < 1 or n_shards < 1:
+        raise ValueError(
+            f"elastic extent needs H >= 1 and n_shards >= 1, "
+            f"got H={H}, n_shards={n_shards}"
+        )
+    return -(-H // n_shards) * n_shards
+
+
+def elastic_pad_rows(arr, extent: int, fill):
+    """Pad a host-leading array's axis 0 to ``extent`` with ``fill``
+    rows (no-op when already there).  numpy in, numpy out — re-layout
+    runs on host truth between device programs, never inside one."""
+    arr = np.asarray(arr)
+    H = arr.shape[0]
+    if H > extent:
+        raise ValueError(f"host axis {H} exceeds elastic extent {extent}")
+    if H == extent:
+        return arr
+    pad = np.full((extent - H,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def elastic_trim_rows(arr, H: int):
+    """Drop pad rows: the first ``H`` host rows of a padded array (the
+    inverse of :func:`elastic_pad_rows`, used when re-laying from one
+    rung's extent onto another's)."""
+    arr = np.asarray(arr)
+    if arr.shape[0] < H:
+        raise ValueError(
+            f"cannot trim to H={H}: array has {arr.shape[0]} host rows"
+        )
+    return arr[:H]
+
+
+def elastic_pad_state(H: int, n_shards: int, *, avail=None, counts=None,
+                      live=None, risk_rows=None, host_zone=None,
+                      base_task_counts=None):
+    """Re-lay global host-state arrays (true host count ``H``) onto a
+    ``n_shards`` mesh: returns ``(extent, dict)`` with every provided
+    array padded to the elastic extent.  Fill values make pad hosts
+    inert: :data:`DEAD_AVAIL` availability, False live mask, zero
+    counts/risk/zone.  ``live`` defaults to all-true over ``H`` whenever
+    padding occurs and ``avail`` was provided — a None live mask means
+    "every host selectable", which would include the pad rows.
+    ``risk_rows`` pads its TRAILING axis ([K, H] layout)."""
+    extent = elastic_host_extent(H, n_shards)
+    out = {}
+    if avail is not None:
+        avail = np.asarray(avail)
+        if avail.shape[0] != H:
+            raise ValueError(
+                f"avail has {avail.shape[0]} host rows, expected H={H}"
+            )
+        out["avail"] = elastic_pad_rows(avail, extent, DEAD_AVAIL)
+        if live is None and extent != H:
+            live = np.ones((H,), bool)
+    if counts is not None:
+        out["counts"] = elastic_pad_rows(
+            np.asarray(counts, np.int32), extent, 0
+        )
+    if live is not None:
+        out["live"] = elastic_pad_rows(np.asarray(live, bool), extent, False)
+    if risk_rows is not None:
+        risk_rows = np.asarray(risk_rows)
+        if risk_rows.shape[-1] != H:
+            raise ValueError(
+                f"risk_rows trailing axis {risk_rows.shape[-1]} != H={H}"
+            )
+        pad = extent - H
+        if pad:
+            widths = [(0, 0)] * (risk_rows.ndim - 1) + [(0, pad)]
+            risk_rows = np.pad(risk_rows, widths, constant_values=0.0)
+        out["risk_rows"] = risk_rows
+    if host_zone is not None:
+        out["host_zone"] = elastic_pad_rows(
+            np.asarray(host_zone, np.int32), extent, 0
+        )
+    if base_task_counts is not None:
+        out["base_task_counts"] = elastic_pad_rows(
+            np.asarray(base_task_counts, np.int32), extent, 0
+        )
+    return extent, out
+
+
+def elastic_fold_carry(carry, H: int, mesh):
+    """Re-lay a resident span carry onto ``mesh`` (or onto the
+    single-device layout when ``mesh`` is None): D2H export, trim the
+    OLD shape's pad rows back to the true host count ``H``, pad to the
+    new shape's extent, re-init device-owned on the new layout.
+
+    Donation safety: ``carry`` must be a PENDING carry or a clone (the
+    same window :func:`tickloop.resident_carry_export` documents) — a
+    shrink always holds the pending carry, never a donated one.  The
+    returned carry is bit-equal to the source on the true host rows:
+    folding is a pure re-layout, decisions made from it are identical
+    (the shrink-parity referee's state-map leg)."""
+    snap = resident_carry_export(carry)
+    if mesh is None:
+        return resident_carry_init(
+            elastic_trim_rows(snap["avail"], H),
+            counts=elastic_trim_rows(snap["counts"], H),
+            live=elastic_trim_rows(snap["live"], H),
+        )
+    n = host_axis_size(mesh)
+    _, padded = elastic_pad_state(
+        H, n,
+        avail=elastic_trim_rows(snap["avail"], H),
+        counts=elastic_trim_rows(snap["counts"], H),
+        live=elastic_trim_rows(snap["live"], H),
+    )
+    return sharded_resident_carry_init(
+        mesh, padded["avail"], counts=padded["counts"], live=padded["live"]
+    )

@@ -65,16 +65,21 @@ from pivot_tpu.ops.kernels import (
     opportunistic_kernel,
 )
 from pivot_tpu.ops.shard import (
+    DEAD_AVAIL,
     HOST_AXIS,
     REPLICA_AXIS,
     best_fit_kernel_sharded,
     cost_aware_kernel_sharded,
+    elastic_fold_carry,
+    elastic_host_extent,
+    elastic_pad_rows,
     first_fit_kernel_sharded,
     opportunistic_kernel_sharded,
     sharded_fused_tick_run,
     sharded_resident_carry_init,
     sharded_resident_span_run,
 )
+from pivot_tpu.infra.faults import DeviceLostError
 from pivot_tpu.parallel.mesh import host_axis_size
 from pivot_tpu.ops.pallas_kernels import (
     cost_aware_pallas,
@@ -133,6 +138,101 @@ class _SpanOutcome:
 
     def __init__(self, placements: np.ndarray):
         self.placements = placements
+
+
+class _DegradeGuard:
+    """Graceful-degradation state machine: closed → degraded → half-open.
+
+    *Closed*: the device kernel serves; ``after`` CONSECUTIVE failures
+    trip the guard to *degraded* (any success resets the streak;
+    individual failures are served by the CPU twin per-tick).
+    *Degraded*: the twin serves every decision.  Every ``probe_every``
+    twin-served decisions the guard goes *half-open* for one decision:
+    the device kernel is shadow-run and its placements diffed against
+    the twin's.  The decision is served by the TWIN either way — a probe
+    can never change a placement — and an exact match promotes the
+    device back to closed (a transient fault no longer strands the
+    policy on CPU forever, the round-20 ``degrade_after`` fix); a
+    mismatch or a raise keeps the twin serving and restarts the probe
+    countdown.  ``probe_every=None`` restores the permanent fallback.
+
+    ``after=None`` disables the guard entirely — kernel exceptions stay
+    fatal (the batch-experiment default)."""
+
+    #: Twin-served decisions between half-open probes.  Small enough
+    #: that a recovered device is re-engaged within one serving flush,
+    #: large enough that a hard-down device is not shadow-dispatched
+    #: (and its raise re-swallowed) every tick.
+    PROBE_EVERY = 64
+
+    __slots__ = ("after", "probe_every", "degraded", "kernel_failures",
+                 "consecutive", "since_probe", "probes", "promotions")
+
+    def __init__(self, after: Optional[int],
+                 probe_every: Optional[int] = PROBE_EVERY):
+        self.after = after
+        self.probe_every = probe_every
+        self.degraded = False
+        self.kernel_failures = 0
+        self.consecutive = 0
+        self.since_probe = 0
+        self.probes = 0
+        self.promotions = 0
+
+    def note_success(self) -> None:
+        self.consecutive = 0
+
+    def note_failure(self, exc: BaseException, logger) -> None:
+        self.kernel_failures += 1
+        self.consecutive += 1
+        if self.consecutive >= self.after:
+            self.degraded = True
+            self.since_probe = 0
+            logger.error(
+                "device kernel failed %d times consecutively — degrading "
+                "to the CPU twin%s: %s",
+                self.consecutive,
+                (" permanently" if self.probe_every is None
+                 else f" (half-open probe every {self.probe_every})"),
+                exc,
+            )
+        else:
+            logger.warning(
+                "device kernel failed (%d/%d before degradation): %s",
+                self.consecutive, self.after, exc,
+            )
+
+    def should_probe(self) -> bool:
+        """Call once per degraded (twin-served) decision; True on the
+        decision that should shadow-run the device kernel."""
+        if not self.degraded or self.probe_every is None:
+            return False
+        self.since_probe += 1
+        if self.since_probe >= self.probe_every:
+            self.since_probe = 0
+            return True
+        return False
+
+    def note_probe(self, ok: bool, logger,
+                   exc: Optional[BaseException] = None) -> None:
+        self.probes += 1
+        if ok:
+            self.degraded = False
+            self.consecutive = 0
+            self.promotions += 1
+            logger.info(
+                "half-open probe matched the CPU twin — promoting the "
+                "device kernel back (probe %d)", self.probes,
+            )
+        elif exc is not None:
+            logger.warning(
+                "half-open probe raised — device still down: %s", exc,
+            )
+        else:
+            logger.warning(
+                "half-open probe DIVERGED from the CPU twin — keeping "
+                "the twin (probe %d)", self.probes,
+            )
 
 
 class _ResidentState:
@@ -299,18 +399,18 @@ class _DevicePolicyBase(Policy):
         self._market_cost_dev: dict = {}
         self._market_stack_dev = None
         #: Graceful degradation (serving self-healing, ``serve/driver``):
-        #: after this many CONSECUTIVE device-kernel failures the policy
-        #: permanently falls back to its CPU twin — the same numpy
-        #: oracle the parity suite holds the kernels to, so placements
-        #: don't change, only the backend serving them.  Individual
-        #: failures are served by the twin too (per-tick fallback) and
-        #: counted in ``kernel_failures``.  ``None`` (default) keeps
+        #: after ``degrade_after`` CONSECUTIVE device-kernel failures
+        #: the policy falls back to its CPU twin — the same numpy oracle
+        #: the parity suite holds the kernels to, so placements don't
+        #: change, only the backend serving them.  Individual failures
+        #: are served by the twin too (per-tick fallback) and counted in
+        #: ``kernel_failures``.  Since round 20 the fallback is
+        #: HALF-OPEN, not permanent: every ``_DegradeGuard.PROBE_EVERY``
+        #: twin decisions the device kernel is shadow-run and promoted
+        #: back on an exact placement match.  ``None`` (default) keeps
         #: kernel exceptions fatal — batch experiments must not silently
         #: mask a broken kernel as twin output.
-        self.degrade_after = degrade_after
-        self.degraded = False
-        self.kernel_failures = 0
-        self._consecutive_failures = 0
+        self._degrade = _DegradeGuard(degrade_after)
         #: Phase-2 mode forwarded to the two-phase kernels
         #: (``ops/kernels.py``): "auto" (slim on CPU, scan elsewhere),
         #: "scan", "slim", or an int chunk size for speculative chunk
@@ -325,6 +425,21 @@ class _DevicePolicyBase(Policy):
         # every placement dispatch — per-tick kernels AND fused spans —
         # runs host-sharded over the mesh's ``host`` axis.
         self._mesh = None
+        # Elastic re-layout (round 20): when :meth:`reshard` lands on a
+        # ladder rung the true host count does not divide, every staged
+        # [H] operand pads to this extent with dead-sentinel rows (inert
+        # by masked-argmin — ops/shard.py elastic helpers).  None = no
+        # padding (the launch shape, and every dividing rung).
+        self._host_extent: Optional[int] = None
+        self._padded_host_zone = None  # lazily padded bind-time [H] zone
+        # Elastic fault gate (round 20, ``serve/elastic.py``): a callable
+        # ``gate(env_now)`` invoked at the top of every dispatch entry
+        # point (place / place_span).  The elastic mesh manager installs
+        # one that raises DeviceLostError when a DeviceFaultPlan window
+        # covers the dispatch instant — deterministic, replayable device
+        # loss at the exact boundary a real loss would surface.  None
+        # (default) = zero cost, bit-identical to the ungated stack.
+        self._fault_gate = None
         # Resident span tier (round 20, ``ops/tickloop.py`` resident
         # section): when enabled, consecutive ``place_span`` calls keep
         # the [H] carry device-resident and ship only deltas.
@@ -348,6 +463,41 @@ class _DevicePolicyBase(Policy):
         # the device path for the rest of the process).
         self._warm_buckets: set = set()
 
+    # -- degrade-guard views (backward-compat attribute surface) -----------
+    # ``serve/session.py`` meters and the chaos suite read these off the
+    # policy; the state itself lives in the guard.
+    @property
+    def degrade_after(self) -> Optional[int]:
+        return self._degrade.after
+
+    @degrade_after.setter
+    def degrade_after(self, value: Optional[int]) -> None:
+        self._degrade.after = value
+
+    @property
+    def degraded(self) -> bool:
+        return self._degrade.degraded
+
+    @degraded.setter
+    def degraded(self, value: bool) -> None:
+        self._degrade.degraded = bool(value)
+
+    @property
+    def kernel_failures(self) -> int:
+        return self._degrade.kernel_failures
+
+    @kernel_failures.setter
+    def kernel_failures(self, value: int) -> None:
+        self._degrade.kernel_failures = int(value)
+
+    @property
+    def _consecutive_failures(self) -> int:
+        return self._degrade.consecutive
+
+    @_consecutive_failures.setter
+    def _consecutive_failures(self, value: int) -> None:
+        self._degrade.consecutive = int(value)
+
     def apply_weights(self, weights) -> None:
         """Live weight promotion, forwarded to the CPU twin so kernel
         and twin keep scoring from the same vector (adaptive routing and
@@ -366,8 +516,15 @@ class _DevicePolicyBase(Policy):
         self._market_stack_dev = None
         if self._resident is not None:
             self._resident.reset()  # rebind = new [H] layout; drop the carry
+        self._padded_host_zone = None  # rebind = new topology buffers
         if self._mesh is not None:
-            self._check_mesh_hosts(self._mesh)  # rebind = new H; re-validate
+            if self._host_extent is not None:
+                # A resharded (elastic) mesh re-derives its pad extent
+                # for the new host count instead of demanding
+                # divisibility — pad rows are inert either way.
+                self._refresh_host_extent()
+            else:
+                self._check_mesh_hosts(self._mesh)  # rebind: re-validate
         if self._cpu_twin is not None:
             self._cpu_twin.bind(scheduler)
         if self.adaptive:
@@ -489,6 +646,128 @@ class _DevicePolicyBase(Policy):
                 f"multiple of {n} hosts"
             )
 
+    # -- elastic re-layout (round 20, ``serve/elastic.py``) ----------------
+    def reshard(self, mesh) -> None:
+        """Swap the host-sharding mesh for a NEW shape mid-serve — the
+        shrink/regrow primitive of elastic mesh serving.  ``mesh`` is a
+        surviving-shard mesh from the declared ladder (or None to
+        collapse to the single-device layout).  When the true host count
+        does not divide the new shape, every staged [H] operand pads to
+        the elastic extent with dead-sentinel rows (DEAD_AVAIL
+        availability + False live mask — inert by masked-argmin, so
+        placements are bit-identical to an unpadded run; ``ops/shard.py``
+        elastic helpers).  A pending resident carry is FOLDED onto the
+        new layout (:func:`ops.shard.elastic_fold_carry` — a pure
+        re-layout, bit-equal on the true host rows); the splice
+        checkpoint is dropped (a splice cannot cross a reshard) and the
+        next mirror-diff self-heals any divergence from the DES truth.
+        Compile cost is bounded by the ladder: each shape's programs are
+        cached (``lru_cache`` keyed on the mesh), so revisiting a rung
+        compiles nothing."""
+        if self.adaptive:
+            raise ValueError(
+                "elastic resharding needs deterministic dispatch — "
+                "construct the policy with adaptive=False"
+            )
+        if self._batch_client is not None:
+            raise ValueError(
+                "elastic resharding does not compose with the cross-run "
+                "batcher (its 2-D mesh is fixed at construction) — "
+                "detach the batcher first"
+            )
+        if mesh is not None:
+            if getattr(self, "use_pallas", False):
+                raise ValueError(
+                    "the Pallas kernel has no sharded form; drop "
+                    "use_pallas=True"
+                )
+            if getattr(self, "realtime_bw", False):
+                raise ValueError(
+                    "realtime_bw has no sharded form (per-tick sampled "
+                    "rows would reshard every dispatch)"
+                )
+            if host_axis_size(mesh) < 1:
+                raise ValueError("mesh has an empty host axis")
+        self._mesh = mesh
+        self._padded_host_zone = None
+        self._refresh_host_extent()
+        rs = self._resident
+        if rs is not None:
+            if rs.carry is not None and self.topology is not None:
+                rs.carry = elastic_fold_carry(
+                    rs.carry, self.topology.n_hosts, mesh
+                )
+            rs.checkpoint = None
+            rs.staging = None
+            rs.risk_table_dev = None  # re-staged (padded) on next span
+
+    def enable_fault_gate(self, gate) -> None:
+        """Install (or clear, ``None``) the elastic fault gate — a
+        callable ``gate(env_now)`` run at the top of every ``place`` /
+        ``place_span`` dispatch.  The gate raises
+        :class:`~pivot_tpu.infra.faults.DeviceLostError` when the
+        dispatch instant falls inside a device-fault window, which
+        propagates THROUGH the degradation guard (device loss is a
+        mesh-level event, not kernel flakiness) up to the serving
+        supervisor, which shrinks the mesh and requeues
+        (``serve/elastic.py``)."""
+        self._fault_gate = gate
+
+    def _refresh_host_extent(self) -> None:
+        """Recompute the elastic pad extent for the current (mesh,
+        topology) pair — None when unsharded or when the host count
+        divides the mesh (no padding, today's programs untouched)."""
+        if self._mesh is None or self.topology is None:
+            self._host_extent = None
+            return
+        H = self.topology.n_hosts
+        extent = elastic_host_extent(H, host_axis_size(self._mesh))
+        self._host_extent = None if extent == H else extent
+
+    def _pad_avail_np(self, avail):
+        """[H, 4] availability padded to the elastic extent with
+        DEAD_AVAIL rows (no-op host-side passthrough when unpadded)."""
+        if self._host_extent is None:
+            return avail
+        return elastic_pad_rows(
+            np.asarray(avail, dtype=np.dtype(self.dtype)),
+            self._host_extent, DEAD_AVAIL,
+        )
+
+    def _pad_h(self, arr, fill):
+        """[H] host vector padded to the elastic extent with ``fill``."""
+        if self._host_extent is None:
+            return arr
+        return elastic_pad_rows(np.asarray(arr), self._host_extent, fill)
+
+    def _pad_tail(self, arr):
+        """[..., H] array zero-padded on its TRAILING axis to the
+        elastic extent (the risk-row/table layout)."""
+        if self._host_extent is None:
+            return arr
+        arr = np.asarray(arr)
+        pad = self._host_extent - arr.shape[-1]
+        if pad <= 0:
+            return arr
+        widths = [(0, 0)] * (arr.ndim - 1) + [(0, pad)]
+        return np.pad(arr, widths, constant_values=0)
+
+    def _host_zone_arg(self, topo):
+        """The [H] host→zone row for a dispatch: the bind-time device
+        array normally; a padded copy (staged once per reshard) when the
+        elastic extent is engaged (zone 0 for pad rows — harmless, a
+        dead-sentinel host is unselectable)."""
+        if self._host_extent is None:
+            return topo.host_zone
+        if self._padded_host_zone is None:
+            self._padded_host_zone = self._stage(
+                elastic_pad_rows(
+                    np.asarray(topo.host_zone), self._host_extent, 0
+                ),
+                jnp.int32,
+            )
+        return self._padded_host_zone
+
     def _kernel_for(self, kernel, sharded_kernel):
         """The dispatch rung for one placement call: the single-device
         kernel (through the cross-run batcher when attached), its
@@ -574,6 +853,15 @@ class _DevicePolicyBase(Policy):
         argument, or None when every host is live (None keeps the
         all-live compiled program — and today's outputs — untouched)."""
         live = ctx.live_mask
+        if self._host_extent is not None:
+            # Padded layout: the mask MUST materialize even when every
+            # true host is live — None would mean "all live" and include
+            # the dead-sentinel pad rows.
+            full = np.zeros(self._host_extent, dtype=bool)
+            full[: ctx.n_hosts] = (
+                True if live is None else np.asarray(live, bool)
+            )
+            return self._stage(full)
         if live is None:
             return None
         return self._stage(live)
@@ -587,7 +875,7 @@ class _DevicePolicyBase(Policy):
         risk = resolve_risk(ctx, self.risk_weight, self.rework_cost)
         if risk is None:
             return None
-        return self._stage(risk, self.dtype)
+        return self._stage(self._pad_h(risk, 0.0), self.dtype)
 
     def _market_cost_arg(self, ctx: TickContext):
         """The tick's ``[Z, Z]`` egress-cost operand: the bind-time
@@ -629,48 +917,64 @@ class _DevicePolicyBase(Policy):
             # once on assignment — bit-identical to the old
             # cast-at-staging — and an x64 run can no longer stage a
             # double-width [K, H] buffer / fork the compile cache.
-            rows = np.zeros((K, len(hz)), dtype=np.dtype(self.dtype))
+            He = self._host_extent or len(hz)
+            rows = np.zeros((K, He), dtype=np.dtype(self.dtype))
             # One vectorized [k_dyn] segment lookup + [k_dyn, H] zone
             # gather — the same per-span time-index pattern as cost_seg —
-            # instead of k_dyn Python-level hazard_vector calls.
+            # instead of k_dyn Python-level hazard_vector calls.  Pad
+            # columns (elastic extent) stay zero — their hosts are
+            # dead-sentinel and unselectable anyway.
             seg = market.segment_indices(np.asarray(plan.grid[:k_dyn]))
-            rows[:k_dyn] = w * market.hazard[seg][:, hz]
+            rows[:k_dyn, : len(hz)] = w * market.hazard[seg][:, hz]
             if rows.any():
                 kw["risk_rows"] = self._stage(rows, self.dtype)
         return kw
 
     # -- graceful degradation ----------------------------------------------
     def _note_kernel_failure(self, exc: BaseException) -> None:
-        self.kernel_failures += 1
-        self._consecutive_failures += 1
-        if self._consecutive_failures >= self.degrade_after:
-            self.degraded = True
-            self.logger.error(
-                "device kernel failed %d times consecutively — degrading "
-                "to the CPU twin permanently: %s",
-                self._consecutive_failures, exc,
-            )
-        else:
-            self.logger.warning(
-                "device kernel failed (%d/%d before degradation): %s",
-                self._consecutive_failures, self.degrade_after, exc,
-            )
+        self._degrade.note_failure(exc, self.logger)
+
+    def _degraded_place(self, ctx: TickContext) -> np.ndarray:
+        """A twin-served decision while degraded, with the half-open
+        probe: on the probe cadence the device kernel is SHADOW-run and
+        its placements diffed against the twin's — an exact match
+        promotes the device back (:class:`_DegradeGuard`).  The decision
+        returned is always the twin's, so placements are bit-identical
+        whether or not this was a probe tick, and whatever the probe's
+        verdict."""
+        out = self._cpu_twin.place(ctx)
+        if self._degrade.should_probe():
+            try:
+                shadow = self._device_place(ctx)
+            except Exception as exc:  # noqa: BLE001 — probe of a dead device
+                self._degrade.note_probe(False, self.logger, exc)
+            else:
+                self._degrade.note_probe(
+                    np.array_equal(np.asarray(shadow), np.asarray(out)),
+                    self.logger,
+                )
+        return out
 
     def _guarded_device_place(self, ctx: TickContext) -> np.ndarray:
         """Device dispatch with the degradation guard: a failing kernel
         call is served by the CPU twin for this tick (bit-identical
         placements — the twin consumes the same per-tick Philox stream);
-        ``degrade_after`` consecutive failures make the fallback
-        permanent.  Guard disabled (``degrade_after=None``): exceptions
-        propagate unchanged."""
+        ``degrade_after`` consecutive failures make the fallback sticky
+        until a half-open probe matches (:class:`_DegradeGuard`).  Guard
+        disabled (``degrade_after=None``): exceptions propagate
+        unchanged."""
         if self.degrade_after is None or self._cpu_twin is None:
             return self._device_place(ctx)
         try:
             out = self._device_place(ctx)
+        except DeviceLostError:
+            # Mesh-level loss, not kernel flakiness: the elastic
+            # supervisor must see it (shrink + reshard), not the twin.
+            raise
         except Exception as exc:  # noqa: BLE001 — the guard's whole point
             self._note_kernel_failure(exc)
             return self._cpu_twin.place(ctx)
-        self._consecutive_failures = 0
+        self._degrade.note_success()
         return out
 
     # -- fused span tier (round 8, ``ops/tickloop.py``) --------------------
@@ -732,6 +1036,8 @@ class _DevicePolicyBase(Policy):
         down is the K-bucket; the true horizon rides as the dynamic
         ``k_dyn`` operand, so a merged bucket never changes results.
         """
+        if self._fault_gate is not None:
+            self._fault_gate(ctx.env_now)
         if self._resident is not None:
             # Resident tier (round 20): the [H] carry is already on
             # device — ship only this span's delta.  Bit-identical to
@@ -750,12 +1056,12 @@ class _DevicePolicyBase(Policy):
         dem[:S] = dem_host
         arrive = np.full(B, K, dtype=np.int32)
         arrive[:S] = plan.arrive
-        live = ctx.live_mask
-        if live is not None:
-            kw["live"] = self._stage(live)
+        live_arg = self._live_arg(ctx)
+        if live_arg is not None:
+            kw["live"] = live_arg
         kw.update(self._span_market_kw(ctx, plan, K))
         span_args = (
-            self._stage(ctx.avail, self.dtype),
+            self._stage(self._pad_avail_np(ctx.avail), self.dtype),
             self._stage(dem),
             self._stage(arrive),
             np.int32(k_dyn),
@@ -855,7 +1161,11 @@ class _DevicePolicyBase(Policy):
         if not rs.risk_table_np[seg[:k_dyn]].any():
             return {}
         if rs.risk_table_dev is None:
-            rs.risk_table_dev = jnp.asarray(rs.risk_table_np)
+            # Padded on its host axis when the elastic extent is engaged
+            # (the [P, H] table shards over the mesh's host axis).
+            rs.risk_table_dev = jnp.asarray(
+                self._pad_tail(rs.risk_table_np)
+            )
         return {"risk_table": rs.risk_table_dev,
                 "risk_seg": self._stage(seg)}
 
@@ -885,6 +1195,16 @@ class _DevicePolicyBase(Policy):
         host_live = (
             np.ones(H, bool) if lm is None else np.asarray(lm, bool)
         )
+        if self._host_extent is not None:
+            # Elastic pad layout: the mirror (and so the carry, the edit
+            # drop sentinel, and the geometry check) live at the padded
+            # extent; pad rows are dead-sentinel and never diff (their
+            # truth never changes).
+            He = self._host_extent
+            host_avail = elastic_pad_rows(host_avail, He, DEAD_AVAIL)
+            host_counts = elastic_pad_rows(host_counts, He, 0)
+            host_live = elastic_pad_rows(host_live, He, False)
+            H = He
         h2d = 0
         carry = rs.carry
         if carry is not None and carry.avail.shape[0] != H:
@@ -1086,8 +1406,10 @@ class _DevicePolicyBase(Policy):
 
     # -- adaptive dispatch ------------------------------------------------
     def place(self, ctx: TickContext) -> np.ndarray:
+        if self._fault_gate is not None:
+            self._fault_gate(ctx.env_now)
         if self.degraded and self._cpu_twin is not None:
-            return self._cpu_twin.place(ctx)
+            return self._degraded_place(ctx)
         if self.adaptive and self._cpu_twin is not None:
             import jax
 
@@ -1192,7 +1514,7 @@ class _DevicePolicyBase(Policy):
         dem[:T] = demands
         valid = np.zeros(B, dtype=bool)
         valid[:T] = True
-        avail = self._stage(ctx.avail, self.dtype)
+        avail = self._stage(self._pad_avail_np(ctx.avail), self.dtype)
         return avail, self._stage(dem, self.dtype), self._stage(valid)
 
     @staticmethod
@@ -1218,8 +1540,12 @@ class _DevicePolicyBase(Policy):
         T = ctx.n_tasks
         avail, dem, valid = self._padded(ctx, order)
         rng = np.random.default_rng(seed)
+        # Sized off the STAGED avail (== ctx.n_hosts except under the
+        # elastic pad extent, where perturbed DEAD_AVAIL rows stay
+        # negative and so inert).
         noise = rng.uniform(
-            1 - perturb, 1 + perturb, size=(n_replicas, ctx.n_hosts, 1)
+            1 - perturb, 1 + perturb,
+            size=(n_replicas, int(np.asarray(avail).shape[0]), 1),
         )
         noise[0] = 1.0  # replica 0 = the production decision
         avail_r = jnp.asarray(np.asarray(avail)[None] * noise,
@@ -1601,12 +1927,14 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             bucket_id=self._stage(bucket),
             cost_zz=topo.cost,
             bw_zz=topo.bw,
-            host_zone=topo.host_zone,
+            host_zone=self._host_zone_arg(topo),
             base_task_counts=(
                 # The resident tier carries the counts device-side — do
                 # not stage the [H] buffer it would immediately discard.
                 None if self._resident is not None
-                else self._stage(ctx.host_task_counts, jnp.int32)
+                else self._stage(
+                    self._pad_h(ctx.host_task_counts, 0), jnp.int32
+                )
             ),
             totals=topo.totals,
             phase2=self.phase2,
@@ -1845,8 +2173,8 @@ class TpuCostAwarePolicy(_DevicePolicyBase):
             self._stage(az_arr),
             self._market_cost_arg(ctx),
             topo.bw,
-            topo.host_zone,
-            self._stage(ctx.host_task_counts, jnp.int32),
+            self._host_zone_arg(topo),
+            self._stage(self._pad_h(ctx.host_task_counts, 0), jnp.int32),
             bin_pack=self.bin_pack,
             sort_hosts=self.sort_hosts,
             host_decay=self.host_decay,

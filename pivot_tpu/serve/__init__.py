@@ -60,6 +60,7 @@ from pivot_tpu.serve.arrivals import (
 )
 from pivot_tpu.serve.autoscale import AutoscaleConfig, SloAutoscaler
 from pivot_tpu.serve.driver import ServeDriver, closed_loop_source
+from pivot_tpu.serve.elastic import ElasticConfig, ElasticMeshManager
 from pivot_tpu.serve.session import STOP, PreemptRequest, ServeSession
 
 # Crash-safe serving (round 21): the recovery plane's config rides the
@@ -72,6 +73,8 @@ __all__ = [
     "AdmissionQueue",
     "AutoscaleConfig",
     "BLOCKED",
+    "ElasticConfig",
+    "ElasticMeshManager",
     "JobArrival",
     "PreemptRequest",
     "RecoveryConfig",

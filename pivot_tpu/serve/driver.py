@@ -160,6 +160,7 @@ class ServeDriver(LogMixin):
         resident: bool = False,
         splice_tier: int = 0,
         recovery=None,
+        elastic=None,
     ):
         if not sessions:
             raise ValueError("ServeDriver needs at least one session")
@@ -269,6 +270,44 @@ class ServeDriver(LogMixin):
             from pivot_tpu.recover import RecoveryPlane
 
             self._recovery = RecoveryPlane(recovery, tracer=self.tracer)
+        #: Elastic mesh serving (round 20, ``serve/elastic.py``):
+        #: ``elastic`` is an ``ElasticMeshManager``, an ``ElasticConfig``,
+        #: a ``ChaosSchedule`` with device events, or None.  None — the
+        #: default — builds nothing and leaves the service bit-identical
+        #: to the inelastic stack (pinned by tests/test_elastic.py).
+        #: Otherwise the manager gates every session policy's dispatches
+        #: against the device-fault plan: a covered dispatch raises
+        #: ``DeviceLostError``, the supervisor requeues through the
+        #: existing restart machinery (tier 0 first out of the queue),
+        #: and the replacement session is resharded onto the
+        #: surviving-shard mesh before it serves a decision.  Mutually
+        #: exclusive with the shared DispatchBatcher (fixed 2-D mesh) —
+        #: an elastic pool runs resident or free.
+        self._elastic = None
+        if elastic is not None:
+            from pivot_tpu.serve.elastic import (
+                ElasticConfig, ElasticMeshManager,
+            )
+
+            if isinstance(elastic, ElasticMeshManager):
+                self._elastic = elastic
+            elif isinstance(elastic, ElasticConfig):
+                self._elastic = ElasticMeshManager(elastic)
+            else:  # a ChaosSchedule with device_fault/restore events
+                self._elastic = ElasticMeshManager(
+                    ElasticConfig(schedule=elastic)
+                )
+            if session_factory is None:
+                raise ValueError(
+                    "elastic serving needs a session_factory — a shrink "
+                    "replaces the crashed session on the smaller mesh"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "elastic serving does not compose with the driver's "
+                    "2-D batcher mesh (fixed at construction) — shard "
+                    "the session policies instead (enable_sharding)"
+                )
         self.routing = routing
         self.preempt = preempt
         self.preempt_timeout = preempt_timeout
@@ -451,6 +490,17 @@ class ServeDriver(LogMixin):
                 self._session_factory is not None
                 and self._restarts < self._max_restarts
             )
+        if self._elastic is not None:
+            from pivot_tpu.serve.elastic import is_device_loss
+
+            if is_device_loss(exc):
+                # Mesh-level loss: record it, then let the ordinary
+                # supervisor path below replace the session — the
+                # replacement is resharded onto the survivors by
+                # _wire_and_start, and _requeue routes its in-flight
+                # work back through the admission queue (tier 0 first).
+                self._elastic.note_loss(exc, session.label)
+                self.slo.count("device_losses")
         if session.retiring and not stopped:
             # A crash DURING a scale-down drain: the retire was already
             # decided — settle it (requeue the in-flight jobs onto the
@@ -651,6 +701,12 @@ class ServeDriver(LogMixin):
             # recovery plane too — a restarted session's spans journal
             # and snapshot exactly like the original's.
             new.attach_recovery(self._recovery)
+        if self._elastic is not None:
+            # The replacement's factory-fresh policy is gated AND
+            # resharded onto the current surviving-shard mesh here —
+            # before its thread starts — or its first gated dispatch
+            # would hit the same down window and burn another restart.
+            self._elastic.attach(new.policy)
         new._client = client
         thread = threading.Thread(
             target=new.loop, args=(client,),
@@ -1228,7 +1284,7 @@ class ServeDriver(LogMixin):
                 jax.default_backend()
                 for s in self.sessions:
                     self._enable_resident(s)
-            elif self._batching_compatible():
+            elif self._elastic is None and self._batching_compatible():
                 # Initialize the backend once, here, before any session
                 # thread dispatches — concurrent first-touch PJRT client
                 # creation is not safe (same guard as run_grid_lockstep).
@@ -1255,6 +1311,11 @@ class ServeDriver(LogMixin):
                 self._recovery.start()
                 for s in self.sessions:
                     s.attach_recovery(self._recovery)
+            if self._elastic is not None:
+                # Gate + align every launch policy before its thread
+                # exists (attach may reshard — cv held, no races).
+                for s in self.sessions:
+                    self._elastic.attach(s.policy)
             for s, c in zip(self.sessions, clients):
                 s._client = c
                 thread = threading.Thread(

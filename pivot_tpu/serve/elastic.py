@@ -1,0 +1,384 @@
+"""Elastic mesh serving (round 20): survive device loss mid-span.
+
+The serving stack (``serve/driver.py``) assumed an immortal compute
+plane: ``enable_sharding`` pins every session policy to a fixed device
+set, and the only device-failure story was ``degrade_after``'s
+permanent CPU-twin fallback.  This module makes the mesh ELASTIC — the
+pool shrinks around a lost device, keeps serving on the surviving
+shards, and regrows when the device returns:
+
+* :class:`ElasticConfig` — the knob bundle ``ServeDriver(elastic=...)``
+  takes.  Device faults come from the same seeded, serializable
+  :class:`~pivot_tpu.infra.faults.ChaosSchedule` every other chaos
+  source uses (``device_fault`` / ``device_restore`` event kinds),
+  compiled to a :class:`~pivot_tpu.infra.faults.DeviceFaultPlan` of
+  half-open per-ordinal down windows.
+
+* :class:`ElasticMeshManager` — owns the launch device set, the
+  mesh-shape ladder (descending divisors of the launch device count),
+  the per-rung mesh cache, and the shrink/regrow state machine.  It
+  installs a FAULT GATE on every session policy
+  (``_DevicePolicyBase.enable_fault_gate``) that runs at each dispatch:
+
+  - **loss**: the dispatch instant falls inside a down window covering
+    a device of the policy's CURRENT mesh → raise
+    :class:`~pivot_tpu.infra.faults.DeviceLostError`.  The session
+    crashes, the driver's existing supervisor requeues its in-flight
+    work (tier 0 first out — the admission queue's tier ordering) and
+    builds a replacement whose policy this manager RESHARDS onto the
+    surviving-shard mesh before it serves a single decision.
+
+  - **regrow**: the down-set no longer covers an excluded device and
+    the ladder admits a larger rung → SHADOW-PROBE the candidate mesh
+    (a canonical fused-span dispatch diffed bit-for-bit against the
+    single-device reference program) and, on an exact match, promote by
+    resharding IN-THREAD at the dispatch boundary — the policy is only
+    ever touched by its own session thread, so promotion is race-free.
+    A failed probe holds the device out and retries on the half-open
+    cadence (every ``probe_every`` gated dispatches).
+
+The bit-parity referee: placements depend only on the global ``[H]``
+state — the sharded kernels are bit-identical to the single-device
+reference on every mesh shape (``tests/test_shard.py``), so a shrink
+changes *where* state lives, never *what* is decided.  Post-shrink
+placements are therefore bit-identical to a from-scratch run on the
+smaller mesh over the same admitted stream (``tests/test_elastic.py``),
+and regrow timing — wall-clock-dependent by nature — can never change a
+decision.  Compile cost is bounded by the ladder: meshes are cached per
+surviving-ordinal tuple and the jitted sharded programs are
+``lru_cache``'d on the mesh, so revisiting a rung compiles nothing.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pivot_tpu.infra.faults import (
+    ChaosSchedule,
+    DeviceFaultPlan,
+    DeviceLostError,
+)
+
+__all__ = [
+    "DeviceLostError",
+    "ElasticConfig",
+    "ElasticMeshManager",
+    "is_device_loss",
+]
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Classify a session error as a device loss.  Injected faults
+    arrive as :class:`DeviceLostError` (the gate's own type); real
+    losses surface as XLA runtime errors whose text names the device —
+    matched loosely here so a production backend's "device lost" /
+    "failed to enqueue" family routes to shrink instead of fail-stop."""
+    if isinstance(exc, DeviceLostError):
+        return True
+    text = str(exc).lower()
+    return type(exc).__name__ == "XlaRuntimeError" and (
+        "device" in text and ("lost" in text or "halted" in text)
+    )
+
+
+@dataclass
+class ElasticConfig:
+    """Elastic mesh serving knobs (``ServeDriver(elastic=...)``).
+
+    ``schedule``: a :class:`ChaosSchedule` whose ``device_fault`` /
+    ``device_restore`` events define the injected down windows —
+    seeded, serializable, replayable (``tools/chaos_replay.py``).
+    ``plan`` wins over ``schedule`` when both are given (a pre-built
+    :class:`DeviceFaultPlan`, e.g. from a replay diff).  Neither →
+    no injected faults; the manager still classifies real losses and
+    serves ``mark_dead`` (tests, external watchdogs).
+
+    ``probe``: shadow-probe a returning device before promoting the
+    larger mesh (the half-open regrow contract).  ``probe_every``: a
+    failed probe is retried after this many gated dispatches.
+    ``probe_ticks`` / ``probe_tasks``: the canonical probe span's
+    (K, B) extents; ``seed`` feeds its synthetic operands."""
+
+    schedule: Optional[ChaosSchedule] = None
+    plan: Optional[DeviceFaultPlan] = None
+    probe: bool = True
+    probe_every: int = 64
+    probe_ticks: int = 2
+    probe_tasks: int = 3
+    seed: int = 0
+
+
+class ElasticMeshManager:
+    """The shrink/reshard/regrow brain behind ``ServeDriver(elastic=)``.
+
+    Thread model: ``attach``/``align`` run under the driver's cv (pool
+    surgery); gates run on session threads.  The manager's own mutable
+    state (mesh cache, probe verdicts, counters, frontier) is guarded by
+    ``_lock``; each POLICY is only ever resharded by its owning session
+    thread (gate) or under the cv before its thread starts (attach) —
+    never concurrently."""
+
+    def __init__(self, config: Optional[ElasticConfig] = None):
+        self.config = config or ElasticConfig()
+        self.logger = logging.getLogger("pivot_tpu.serve.elastic")
+        self._lock = threading.Lock()
+        #: Launch device set (ordinal order), derived from the first
+        #: attached policy's mesh — ordinal i == plan ordinal i.
+        self.devices: Optional[List] = None
+        self.ladder: Tuple[int, ...] = ()
+        self.plan: Optional[DeviceFaultPlan] = None
+        self._launch_mesh = None
+        #: Mesh cache keyed on the chosen surviving-ordinal tuple —
+        #: bounded by the ladder (one entry per visited rung + survivor
+        #: choice), so compile count is bounded too.
+        self._meshes: Dict[Tuple[int, ...], object] = {}
+        #: Manually marked dead ordinals (real losses / tests) — the
+        #: plan-driven windows are time-indexed and need no marking.
+        self._dead: set = set()
+        #: Largest dispatch instant any gate has observed: the sim time
+        #: ``align`` evaluates the down-set at when wiring a replacement
+        #: session (whose own env clock restarts behind the frontier).
+        self._frontier = 0.0
+        #: Probe verdicts per candidate ordinal tuple: True = promoted
+        #: once already (never re-probe), int = gate-call countdown
+        #: until the half-open retry after a failed probe.
+        self._probe_state: Dict[Tuple[int, ...], object] = {}
+        # Event log + counters (bench / tests read these).
+        self.events: List[Tuple[float, str, Tuple[int, ...]]] = []
+        self.shrinks = 0
+        self.regrows = 0
+        self.probes = 0
+        self.probe_failures = 0
+
+    # -- wiring ------------------------------------------------------------
+    def attach(self, policy) -> None:
+        """Adopt a session policy: derive the launch device set from the
+        first mesh seen, build the fault plan against it, install the
+        dispatch gate, and align the policy onto the current target mesh
+        (a replacement session built after a shrink must come up ON the
+        shrunk mesh, or its first gated dispatch would re-crash it and
+        burn the restart budget)."""
+        mesh = getattr(policy, "_mesh", None)
+        if mesh is None:
+            raise ValueError(
+                "elastic serving needs host-sharded session policies — "
+                "call enable_sharding(host_sharded_mesh(...)) in the "
+                "session factory"
+            )
+        from pivot_tpu.ops.shard import REPLICA_AXIS, mesh_shape_ladder
+
+        if int(mesh.shape.get(REPLICA_AXIS, 1)) > 1:
+            raise ValueError(
+                "elastic serving shrinks the 1-D host axis; a mesh with "
+                "a non-trivial replica axis (the batcher's 2-D layout) "
+                "is fixed at construction"
+            )
+        with self._lock:
+            if self.devices is None:
+                self.devices = list(np.asarray(mesh.devices).ravel())
+                self.ladder = mesh_shape_ladder(len(self.devices))
+                self._launch_mesh = mesh
+                self._meshes[tuple(range(len(self.devices)))] = mesh
+                cfg = self.config
+                if cfg.plan is not None:
+                    self.plan = cfg.plan
+                elif cfg.schedule is not None:
+                    self.plan = DeviceFaultPlan.from_schedule(
+                        cfg.schedule, len(self.devices)
+                    )
+                else:
+                    self.plan = DeviceFaultPlan({}, len(self.devices))
+            frontier = self._frontier
+        policy.enable_fault_gate(self._gate_for(policy))
+        self.align(policy, frontier)
+
+    def align(self, policy, now: float) -> None:
+        """Reshard ``policy`` onto the target mesh for the down-set at
+        sim time ``now`` (no-op when already there).  The attach-time
+        shrink path — no probe: shrinking is always safe, and a
+        replacement session has no in-flight work to quarantine."""
+        target = self._target_mesh(self._down_at(now))
+        if getattr(policy, "_mesh", None) != target:
+            policy.reshard(target)
+
+    # -- the down-set ------------------------------------------------------
+    def _down_at(self, now: float) -> frozenset:
+        plan_down = self.plan.down_at(now) if self.plan is not None else ()
+        return frozenset(plan_down) | frozenset(self._dead)
+
+    def mark_dead(self, ordinal: int) -> None:
+        """Record a non-injected (real) loss — the classification path
+        for watchdog timeouts and raised executions that carry no
+        ordinal windows."""
+        with self._lock:
+            self._dead.add(int(ordinal))
+
+    def mark_restored(self, ordinal: int) -> None:
+        with self._lock:
+            self._dead.discard(int(ordinal))
+
+    # -- mesh geometry -----------------------------------------------------
+    def _survivor_key(self, down: frozenset) -> Tuple[int, ...]:
+        """The chosen surviving-ordinal tuple for a down-set: the first
+        ``shape`` survivors in ordinal order, where ``shape`` is the
+        largest ladder rung the survivor count admits — deterministic,
+        so replaying the same fault plan rebuilds the same meshes."""
+        survivors = [
+            o for o in range(len(self.devices)) if o not in down
+        ]
+        if not survivors:
+            raise DeviceLostError(sorted(down), self._frontier)
+        from pivot_tpu.ops.shard import next_ladder_shape
+
+        shape = next_ladder_shape(self.ladder, len(survivors))
+        return tuple(survivors[:shape])
+
+    def _target_mesh(self, down: frozenset):
+        key = self._survivor_key(down)
+        with self._lock:
+            mesh = self._meshes.get(key)
+            if mesh is None:
+                from pivot_tpu.parallel.mesh import host_sharded_mesh
+
+                mesh = host_sharded_mesh(
+                    len(key), devices=[self.devices[o] for o in key]
+                )
+                self._meshes[key] = mesh
+        return mesh
+
+    def _mesh_ordinals(self, mesh) -> frozenset:
+        devs = list(np.asarray(mesh.devices).ravel())
+        index = {id(d): o for o, d in enumerate(self.devices)}
+        return frozenset(index[id(d)] for d in devs)
+
+    # -- the dispatch gate -------------------------------------------------
+    def _gate_for(self, policy):
+        """The per-policy dispatch gate (closure over ``policy``; runs
+        on the owning session thread only)."""
+
+        def _gate(now: float) -> None:
+            now = float(now)
+            with self._lock:
+                if now > self._frontier:
+                    self._frontier = now
+                frontier = self._frontier
+            down = self._down_at(now)
+            mesh = policy._mesh
+            hit = down & self._mesh_ordinals(mesh)
+            if hit:
+                with self._lock:
+                    self.shrinks += 1
+                    self.events.append((now, "loss", tuple(sorted(hit))))
+                raise DeviceLostError(hit, now)
+            # Regrow is judged at the SERVICE-WIDE frontier, not this
+            # session's local clock: a supervisor replacement replays
+            # sim times from before the fault window, and promoting on
+            # those "healthy past" instants would march the pool
+            # straight back onto the dead device (crash loop).  Shrink
+            # above stays on ``now`` — a dispatch before the window is
+            # genuinely healthy and must serve (determinism: the gate
+            # raises at the first dispatch INSIDE the window, replayed
+            # identically).
+            down_front = self._down_at(frontier)
+            target = self._target_mesh(down_front)
+            if mesh != target and not (down_front & self._mesh_ordinals(mesh)):
+                # Regrow candidate (never a shrink: a frontier down-set
+                # covering this mesh is excluded above): half-open
+                # probe, promote in-thread.
+                self._try_promote(policy, target, frontier)
+
+        return _gate
+
+    def _try_promote(self, policy, target, now: float) -> None:
+        key = self._survivor_key(self._down_at(now))
+        with self._lock:
+            state = self._probe_state.get(key)
+            # NB ``state`` is True (certified), an int cooldown, or None
+            # — test identity first (bool IS an int to isinstance).
+            if state is not True and isinstance(state, int) and state > 0:
+                self._probe_state[key] = state - 1
+                return  # failed probe cooling down (half-open cadence)
+        if state is not True and self.config.probe:
+            ok = self.shadow_probe(policy, target)
+            with self._lock:
+                self.probes += 1
+                if not ok:
+                    self.probe_failures += 1
+                    self._probe_state[key] = int(self.config.probe_every)
+                    self.events.append(
+                        (now, "probe_failed", tuple(sorted(key)))
+                    )
+                    return
+                self._probe_state[key] = True
+        policy.reshard(target)
+        with self._lock:
+            self.regrows += 1
+            self.events.append((now, "regrow", tuple(sorted(key))))
+        self.logger.info(
+            "elastic regrow: mesh promoted to %d shard(s) at t=%g",
+            len(key), now,
+        )
+
+    # -- the shadow probe --------------------------------------------------
+    def shadow_probe(self, policy, mesh) -> bool:
+        """Run a canonical fused span on the CANDIDATE mesh and diff its
+        placements bit-for-bit against the single-device reference
+        program — the same oracle the sharded parity suite holds every
+        mesh shape to.  An exact match certifies the returning device
+        computes what the live program would (promotion is safe by the
+        bit-parity referee); any mismatch or raise holds it out."""
+        from pivot_tpu.ops.shard import sharded_fused_tick_run
+        from pivot_tpu.ops.tickloop import fused_tick_run
+        from pivot_tpu.parallel.mesh import host_axis_size
+
+        cfg = self.config
+        S = host_axis_size(mesh)
+        topo = getattr(policy, "topology", None)
+        H = topo.n_hosts if topo is not None else S * 4
+        if H % S:  # pragma: no cover — ladder rungs always divide H
+            H = -(-H // S) * S
+        dtype = np.dtype(getattr(policy, "dtype", np.float64))
+        rng = np.random.default_rng(cfg.seed)
+        K, B = int(cfg.probe_ticks), int(cfg.probe_tasks)
+        avail = rng.uniform(1.0, 4.0, size=(H, 4)).astype(dtype)
+        demands = rng.uniform(0.1, 0.9, size=(B, 4)).astype(dtype)
+        arrive = np.zeros(B, dtype=np.int32)
+        kw = dict(policy="first-fit", n_ticks=K)
+        try:
+            want = fused_tick_run(avail, demands, arrive, K, **kw)
+            got = sharded_fused_tick_run(
+                mesh, avail, demands, arrive, K, **kw
+            )
+        except Exception as exc:  # noqa: BLE001 — a dead probe holds out
+            self.logger.warning("elastic shadow probe raised: %s", exc)
+            return False
+        return bool(
+            np.array_equal(
+                np.asarray(want.placements), np.asarray(got.placements)
+            )
+        )
+
+    # -- reporting ---------------------------------------------------------
+    def note_loss(self, exc, label: str = "?") -> None:
+        """Record a classified device loss from the supervisor path (the
+        gate already logged injected ones; real losses without ordinals
+        land here as bare events)."""
+        ordinals = tuple(getattr(exc, "ordinals", ()))
+        self.logger.error(
+            "session %s lost device(s) %s — shrinking mesh",
+            label, list(ordinals) or "?",
+        )
+
+    def describe(self) -> str:
+        lines = [
+            f"ladder: {list(self.ladder)}",
+            f"shrinks: {self.shrinks}  regrows: {self.regrows}  "
+            f"probes: {self.probes} ({self.probe_failures} failed)",
+        ]
+        if self.plan is not None:
+            lines.extend(self.plan.describe())
+        return "\n".join(lines)

@@ -568,6 +568,56 @@ def test_degradation_disabled_raises(meta):
         env.run()
 
 
+def test_degradation_half_open_promotes_device_back(meta):
+    """Round-20 regression: ``degrade_after`` is half-open, not
+    permanent.  A TRANSIENT device fault degrades the policy to its CPU
+    twin, but once the device heals a half-open probe (shadow-run,
+    diffed against the twin, never served) matches and promotes the
+    device kernel back — the policy no longer serves from CPU forever.
+
+    Timeline with ``degrade_after=2``, ``probe_every=2`` and a fault
+    that clears after 3 device calls: fail, fail (degrade), twin, twin +
+    probe (raises — still down), twin, twin + probe (matches — promote),
+    device."""
+    from pivot_tpu.sched.tpu import TpuFirstFitPolicy
+
+    policy = TpuFirstFitPolicy(adaptive=False, degrade_after=2)
+    policy._degrade.probe_every = 2  # probe fast enough for a 7-tick app
+    boom = {"left": 3}
+    served = {"device": 0}
+    orig = policy._device_place
+
+    def flaky(ctx):
+        if boom["left"] > 0:
+            boom["left"] -= 1
+            raise RuntimeError("injected transient fault")
+        out = orig(ctx)
+        served["device"] += 1
+        return out
+
+    policy._device_place = flaky
+    env, cluster, sched = build(meta, [(4, 4096, 10, 0)] * 2, policy=policy)
+    groups = [TaskGroup("g1", cpus=1, mem=256, runtime=10)]
+    for i in range(2, 8):  # 7 chained groups => 7 placement ticks
+        groups.append(TaskGroup(f"g{i}", cpus=1, mem=256, runtime=10,
+                                dependencies=[f"g{i - 1}"]))
+    app = Application("halfopen", [g for g in groups])
+    sched.submit(app)
+    sched.stop()
+    env.run()
+    assert app.is_finished
+    guard = policy._degrade
+    assert not policy.degraded  # promoted back, not stranded on CPU
+    assert guard.probes == 2  # one raised, one matched
+    assert guard.promotions == 1
+    assert boom["left"] == 0
+    # The probe's shadow run plus the post-promotion tick both reached
+    # the healed device kernel.
+    assert served["device"] >= 2
+    for group in app.groups:
+        assert all(t.placement is not None for t in group.tasks)
+
+
 # -- schedule-file hardening (round-11 satellites) ---------------------------
 
 

@@ -1,0 +1,541 @@
+"""Elastic mesh serving (round 20, ``serve/elastic.py``).
+
+The contract, bottom-up:
+
+  * **helpers** — the mesh-shape ladder and the elastic pad/trim/fold
+    re-layout helpers (``ops/shard.py``) round-trip and their pad rows
+    are INERT: a fused span on dead-sentinel-padded operands places
+    bit-identically to the unpadded reference (the masked-argmin rules
+    the kernels already obey).
+  * **reshard parity** — the bit-parity referee's teeth: a DES run that
+    shrinks the policy mesh MID-RUN (8 → 4 shards, live carry folded)
+    is bit-identical — placements, end times — to a from-scratch run on
+    either mesh; elasticity changes *where* state lives, never *what*
+    is decided.  Zero recompiles on the second visit to a warm rung.
+  * **state machine** — the manager's gate raises
+    :class:`DeviceLostError` inside a fault window, replacement
+    policies align onto the surviving-shard mesh, and a restored device
+    is promoted back only through the half-open shadow probe.
+  * **serve referee** — a mixed-tier chaos+market soak with a seeded
+    ``fail_device`` plan killing one shard mid-span keeps serving
+    (tier-0 lossless, ``audit_serve`` clean), shrinks exactly through
+    the supervisor requeue machinery, and regrows on restore with a
+    passing probe; ``elastic=None`` stays bit-identical to an armed
+    manager with an empty plan, with zero compiles once warm.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pivot_tpu.des import Environment
+from pivot_tpu.infra import Cluster, Host, Storage
+from pivot_tpu.infra.faults import (
+    ChaosEvent,
+    ChaosSchedule,
+    DeviceFaultPlan,
+    DeviceLostError,
+    FaultInjector,
+)
+from pivot_tpu.infra.locality import ResourceMetadata
+from pivot_tpu.infra.market import MarketSchedule
+from pivot_tpu.infra.meter import Meter
+from pivot_tpu.infra.audit import audit_serve
+from pivot_tpu.ops.shard import (
+    DEAD_AVAIL,
+    elastic_fold_carry,
+    elastic_host_extent,
+    elastic_pad_rows,
+    elastic_pad_state,
+    elastic_trim_rows,
+    mesh_shape_ladder,
+    next_ladder_shape,
+    sharded_fused_tick_run,
+)
+from pivot_tpu.ops.tickloop import fused_tick_run, resident_carry_init
+from pivot_tpu.parallel.mesh import host_axis_size, host_sharded_mesh
+from pivot_tpu.sched import GlobalScheduler
+from pivot_tpu.sched.tpu import TpuCostAwarePolicy, TpuFirstFitPolicy
+from pivot_tpu.serve import (
+    ElasticConfig,
+    ElasticMeshManager,
+    JobArrival,
+    ServeDriver,
+    ServeSession,
+    mixed_tier_arrivals,
+    synthetic_app_factory,
+)
+from pivot_tpu.utils import reset_ids
+from pivot_tpu.utils.compile_counter import count_compiles
+from pivot_tpu.utils.config import (
+    ClusterConfig,
+    PolicyConfig,
+    build_cluster,
+    make_policy,
+)
+from pivot_tpu.workload import Application, TaskGroup
+
+MESH8 = host_sharded_mesh(8)
+MESH4 = host_sharded_mesh(4, devices=list(np.asarray(MESH8.devices).ravel())[:4])
+
+
+# --------------------------------------------------------------------------
+# Ladder + pad/trim/fold helpers
+# --------------------------------------------------------------------------
+
+
+def test_mesh_shape_ladder():
+    assert mesh_shape_ladder(8) == (8, 4, 2, 1)
+    assert mesh_shape_ladder(12) == (12, 6, 4, 3, 2, 1)
+    assert next_ladder_shape((8, 4, 2, 1), 7) == 4
+    assert next_ladder_shape((8, 4, 2, 1), 8) == 8
+    assert next_ladder_shape((8, 4, 2, 1), 1) == 1
+    with pytest.raises(ValueError):
+        next_ladder_shape((8, 4, 2, 1), 0)
+
+
+def test_elastic_pad_trim_roundtrip():
+    assert elastic_host_extent(12, 4) == 12  # divides: no padding
+    assert elastic_host_extent(10, 4) == 12
+    arr = np.arange(10, dtype=np.float64).reshape(5, 2)
+    padded = elastic_pad_rows(arr, 8, DEAD_AVAIL)
+    assert padded.shape == (8, 2)
+    np.testing.assert_array_equal(padded[5:], DEAD_AVAIL)
+    np.testing.assert_array_equal(elastic_trim_rows(padded, 5), arr)
+    with pytest.raises(ValueError):
+        elastic_pad_rows(arr, 3, 0.0)
+
+
+def test_elastic_pad_state_inert_rows():
+    """Pad rows carry the dead-sentinel availability AND a False live
+    mask — inert belt-and-braces under the masked-argmin rules."""
+    rng = np.random.default_rng(0)
+    avail = rng.uniform(1, 4, (10, 4))
+    counts = rng.integers(0, 3, 10).astype(np.int32)
+    risk = rng.uniform(0, 1, (3, 10))
+    extent, state = elastic_pad_state(
+        10, 4, avail=avail, counts=counts, live=None, risk_rows=risk,
+    )
+    assert extent == 12
+    assert state["avail"].shape == (12, 4)
+    np.testing.assert_array_equal(state["avail"][10:], DEAD_AVAIL)
+    assert state["live"].dtype == np.bool_ and not state["live"][10:].any()
+    assert state["live"][:10].all()
+    assert state["counts"].shape == (12,) and not state["counts"][10:].any()
+    assert state["risk_rows"].shape == (3, 12)
+    np.testing.assert_array_equal(state["risk_rows"][:, :10], risk)
+
+
+def test_padded_span_placements_bit_identical():
+    """The kernel-level inertness referee: a fused span on operands
+    padded to a non-dividing rung's extent places bit-identically to
+    the unpadded single-device reference — pad rows are never chosen."""
+    rng = np.random.default_rng(3)
+    H, B, K = 10, 12, 4
+    avail = rng.uniform(1, 5, (H, 4))
+    dem = rng.uniform(0.3, 2.0, (B, 4))
+    arrive = np.zeros(B, np.int32)
+    arrive[8:] = 2
+    want = fused_tick_run(avail, dem, arrive, K, policy="first-fit",
+                          n_ticks=K)
+    extent, state = elastic_pad_state(H, 4, avail=avail, counts=None,
+                                      live=None)
+    got = sharded_fused_tick_run(
+        MESH4, state["avail"], dem, arrive, K,
+        policy="first-fit", n_ticks=K, live=state["live"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.placements), np.asarray(want.placements)
+    )
+    # No placement ever names a pad row.
+    placed = np.asarray(got.placements)
+    assert placed.max() < H
+    np.testing.assert_array_equal(
+        elastic_trim_rows(np.asarray(got.avail), H), np.asarray(want.avail)
+    )
+
+
+def test_elastic_fold_carry_roundtrip():
+    """A resident carry folds 8-shard → 4-shard bit-equal on the true
+    host rows, and back."""
+    rng = np.random.default_rng(1)
+    H = 16
+    avail = rng.uniform(1, 5, (H, 4))
+    carry8 = resident_carry_init(avail)
+    carry4 = elastic_fold_carry(carry8, H, MESH4)
+    np.testing.assert_array_equal(np.asarray(carry4.avail), avail)
+    assert np.asarray(carry4.live).all()
+    back = elastic_fold_carry(carry4, H, MESH8)
+    np.testing.assert_array_equal(np.asarray(back.avail), avail)
+    host = elastic_fold_carry(back, H, None)
+    np.testing.assert_array_equal(np.asarray(host.avail), avail)
+
+
+# --------------------------------------------------------------------------
+# Mid-run reshard: the bit-parity referee at the DES level
+# --------------------------------------------------------------------------
+
+
+def _chain_apps(n_apps=3):
+    return [
+        Application(f"app{i}", [
+            TaskGroup("a", cpus=1, mem=64, runtime=17.0, output_size=400,
+                      instances=10),
+            TaskGroup("b", cpus=2, mem=64, runtime=9.0,
+                      dependencies=["a"], instances=6),
+            TaskGroup("c", cpus=1, mem=32, runtime=5.0,
+                      dependencies=["b"], instances=8),
+        ])
+        for i in range(n_apps)
+    ]
+
+
+def _build_des_cluster(env, meter, n_hosts):
+    meta = ResourceMetadata(seed=0)
+    zones = meta.zones
+    hosts = [
+        Host(env, 4.0, 1024, 100, 1, locality=zones[i % 2], meter=meter,
+             id=f"h{i}")
+        for i in range(n_hosts)
+    ]
+    storage = [
+        Storage(env, z) for z in dict.fromkeys(h.locality for h in hosts)
+    ]
+    return Cluster(
+        env, hosts=hosts, storage=storage, meta=meta, meter=meter,
+        route_mode="meta", seed=0, executor_backend="fast",
+    )
+
+
+def _full_sim(policy_fn, n_hosts=16, reshard_at=None, reshard_mesh=None,
+              resident=True):
+    """One full DES run; optionally swap the policy mesh at a sim
+    instant (the live carry folds across)."""
+    reset_ids()
+    env = Environment()
+    meta = ResourceMetadata(seed=0)
+    meter = Meter(env, meta)
+    cluster = _build_des_cluster(env, meter, n_hosts)
+    policy = policy_fn()
+    if resident:
+        policy.enable_resident(splice=True)
+    sched = GlobalScheduler(env, cluster, policy, seed=3, meter=meter,
+                            fuse_spans=True)
+    cluster.start()
+    sched.start()
+    apps = _chain_apps()
+    for a in apps:
+        sched.submit(a)
+    if reshard_at is not None:
+        env.run(until=reshard_at)
+        policy.reshard(reshard_mesh)
+    sched.stop()
+    env.run()
+    placements = sorted(
+        (t.id, t.placement) for a in apps for g in a.groups for t in g.tasks
+    )
+    ends = sorted((a.id, a.end_time) for a in apps)
+    return placements, ends
+
+
+def _sharded_ff(mesh):
+    def mk():
+        p = TpuFirstFitPolicy()
+        p.enable_sharding(mesh)
+        return p
+
+    return mk
+
+
+def test_mid_run_shrink_bit_parity():
+    """Shrink 8 → 4 shards mid-run: placements and end times are
+    bit-identical to from-scratch runs on EITHER mesh — and a second
+    visit to the warm rungs compiles nothing."""
+    ref8 = _full_sim(_sharded_ff(MESH8))
+    ref4 = _full_sim(_sharded_ff(MESH4))
+    assert ref8 == ref4  # placements are mesh-shape invariant
+    shrunk = _full_sim(_sharded_ff(MESH8), reshard_at=12.0,
+                       reshard_mesh=MESH4)
+    assert shrunk == ref8
+    with count_compiles() as counter:
+        again = _full_sim(_sharded_ff(MESH8), reshard_at=12.0,
+                          reshard_mesh=MESH4)
+    assert again == ref8
+    assert counter.compiles == 0, "warm ladder rungs must not recompile"
+
+
+def test_mid_run_regrow_bit_parity():
+    """The regrow direction (4 → 8) holds the same parity."""
+    ref = _full_sim(_sharded_ff(MESH4))
+    grown = _full_sim(_sharded_ff(MESH4), reshard_at=12.0,
+                      reshard_mesh=MESH8)
+    assert grown == ref
+
+
+def test_reshard_to_non_dividing_rung():
+    """H=10 on 4 shards pads to extent 12 with inert rows — the DES run
+    still matches the unsharded reference bit for bit."""
+    ref = _full_sim(lambda: TpuFirstFitPolicy(), n_hosts=10)
+    padded = _full_sim(lambda: TpuFirstFitPolicy(), n_hosts=10,
+                       reshard_at=12.0, reshard_mesh=MESH4)
+    assert padded == ref
+
+
+def test_reshard_guards():
+    p = TpuFirstFitPolicy(adaptive=True)
+    with pytest.raises(ValueError, match="adaptive"):
+        p.reshard(MESH4)
+    p2 = TpuFirstFitPolicy()
+    p2.use_pallas = True
+    with pytest.raises(ValueError, match="[Pp]allas"):
+        p2.reshard(MESH4)
+
+
+# --------------------------------------------------------------------------
+# The manager's shrink/regrow state machine (gate-level, no serve pool)
+# --------------------------------------------------------------------------
+
+
+def _plan_schedule(at=5.0, duration=10.0, target="device:3"):
+    return ChaosSchedule(seed=7, events=[
+        ChaosEvent(kind="device_fault", at=at, target=target,
+                   duration=duration),
+    ])
+
+
+class _StubPolicy:
+    """Just enough policy surface for the manager: a mesh, a gate slot,
+    and a reshard that records itself."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self.topology = None
+        self.dtype = np.float64
+        self.resharded = []
+        self._gate = None
+
+    def enable_fault_gate(self, gate):
+        self._gate = gate
+
+    def reshard(self, mesh):
+        self.resharded.append(mesh)
+        self._mesh = mesh
+
+
+def test_manager_shrink_align_regrow():
+    mgr = ElasticMeshManager(ElasticConfig(schedule=_plan_schedule()))
+    pol = _StubPolicy(MESH8)
+    mgr.attach(pol)
+    assert mgr.ladder == (8, 4, 2, 1)
+    pol._gate(1.0)  # before the window: no-op
+    assert not pol.resharded
+    with pytest.raises(DeviceLostError) as err:
+        pol._gate(6.0)
+    assert err.value.ordinals == (3,)
+    assert mgr.shrinks == 1
+    # The replacement policy aligns onto the survivors at attach.
+    pol2 = _StubPolicy(MESH8)
+    mgr.attach(pol2)
+    assert host_axis_size(pol2._mesh) == 4
+    assert 3 not in mgr._mesh_ordinals(pol2._mesh)
+    pol2._gate(7.0)  # inside the window, on survivors: serves fine
+    pol2._gate(20.0)  # restored: half-open probe, then promote
+    assert host_axis_size(pol2._mesh) == 8
+    assert mgr.probes == 1 and mgr.probe_failures == 0
+    assert mgr.regrows == 1
+    assert [kind for _, kind, _ in mgr.events] == ["loss", "regrow"]
+
+
+def test_manager_failed_probe_holds_device_out():
+    mgr = ElasticMeshManager(
+        ElasticConfig(schedule=_plan_schedule(), probe_every=2)
+    )
+    mgr.shadow_probe = lambda policy, mesh: False  # a still-sick device
+    pol = _StubPolicy(MESH8)
+    mgr.attach(pol)
+    with pytest.raises(DeviceLostError):
+        pol._gate(6.0)
+    pol2 = _StubPolicy(MESH8)
+    mgr.attach(pol2)
+    pol2._gate(20.0)  # probe fails: stay shrunk
+    assert host_axis_size(pol2._mesh) == 4
+    assert mgr.probe_failures == 1
+    pol2._gate(20.5)  # cooling down: no new probe
+    pol2._gate(21.0)
+    assert mgr.probes == 1
+    mgr.shadow_probe = lambda policy, mesh: True  # device healed
+    pol2._gate(21.5)  # cooldown expired: re-probe, promote
+    assert host_axis_size(pol2._mesh) == 8
+    assert mgr.probes == 2 and mgr.regrows == 1
+
+
+def test_manager_rejects_unsharded_policy():
+    mgr = ElasticMeshManager()
+    with pytest.raises(ValueError, match="enable_sharding"):
+        mgr.attach(_StubPolicy(None))
+
+
+def test_shadow_probe_real_kernels():
+    """The probe's own parity: candidate-mesh placements diff clean
+    against the single-device reference program."""
+    mgr = ElasticMeshManager()
+    pol = _StubPolicy(MESH8)
+    mgr.attach(pol)
+    assert mgr.shadow_probe(pol, MESH4) is True
+    assert mgr.shadow_probe(pol, MESH8) is True
+
+
+# --------------------------------------------------------------------------
+# The serve referee: kill a shard mid-soak, keep serving, regrow
+# --------------------------------------------------------------------------
+
+
+def _elastic_policy():
+    p = make_policy(
+        PolicyConfig(
+            name="cost-aware", device="tpu", bin_pack="first-fit",
+            sort_tasks=True, sort_hosts=True, adaptive=False,
+        )
+    )
+    p.enable_sharding(MESH8)
+    return p
+
+
+def _soak_arrivals(n_jobs):
+    reset_ids()
+    arrs = list(
+        mixed_tier_arrivals(
+            rate=20.0, n_jobs=n_jobs, weights=(0.5, 0.3, 0.2), seed=7,
+            make_app=synthetic_app_factory(seed=11),
+        )
+    )
+    straggler = Application("straggler", [
+        TaskGroup("s", cpus=1, mem=32, runtime=2.0, instances=1),
+    ])
+    arrs.append(JobArrival(ts=10_000.0, app=straggler, tier=0))
+    return arrs
+
+
+def _elastic_soak(elastic, n_jobs=18, chaos=True, market=True,
+                  max_restarts=4):
+    """One sharded resident chaos+market soak under ``elastic``."""
+    arrs = _soak_arrivals(n_jobs)
+
+    def factory(label):
+        s = ServeSession(
+            label, build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+            _elastic_policy(), seed=0, fuse_spans="slo",
+        )
+        if chaos:
+            FaultInjector(s.cluster, seed=0).preempt_host(
+                s.cluster.hosts[2].id, at=8.0, lead=6.0, outage=25.0,
+            )
+        if market:
+            s.scheduler.market = MarketSchedule.generate(
+                s.cluster.meta, seed=5, horizon=400.0, n_segments=4,
+                hot_fraction=0.3, hot_hazard=1e-2, base_hazard=1e-4,
+            )
+        return s
+
+    driver = ServeDriver(
+        [factory("s0")], queue_depth=64, backpressure="shed",
+        flush_after=0.02, resident=True, splice_tier=2,
+        session_factory=factory, max_restarts=max_restarts,
+        elastic=elastic,
+    )
+    report = driver.run(iter(arrs))
+    return arrs, driver, report
+
+
+def _placements_of(arrs):
+    return sorted(
+        (t.id, t.placement)
+        for a in (x.app for x in arrs)
+        for g in a.groups
+        for t in g.tasks
+    )
+
+
+@pytest.mark.slow
+def test_elastic_serve_referee():
+    """THE referee: a seeded ``fail_device`` plan kills shard 3 mid-soak
+    — the driver shrinks through the supervisor requeue machinery and
+    keeps serving (tier-0 lossless, audit clean), then the straggler's
+    far-future dispatch lands after the restore and regrows the full
+    mesh through a passing shadow probe."""
+    n_jobs = 18
+    schedule = ChaosSchedule(seed=13, events=[
+        ChaosEvent(kind="device_fault", at=6.0, target="device:3",
+                   duration=200.0),
+    ])
+    mgr = ElasticMeshManager(ElasticConfig(schedule=schedule))
+    arrs, driver, report = _elastic_soak(mgr)
+
+    c = report["slo"]["counters"]
+    assert c["arrived"] == n_jobs + 1
+    assert c["completed"] == n_jobs + 1, "elastic soak lost jobs"
+    assert c.get("failed_jobs", 0) == 0
+    assert c.get("device_losses", 0) >= 1
+    assert c.get("session_restarts", 0) >= 1
+    assert audit_serve(driver) == []
+    assert mgr.shrinks >= 1, "the fault window never hit a dispatch"
+    assert mgr.regrows >= 1, "the straggler dispatch never regrew"
+    assert mgr.probes >= 1 and mgr.probe_failures == 0
+    kinds = [kind for _, kind, _ in mgr.events]
+    assert kinds[0] == "loss" and kinds[-1] == "regrow"
+    # Every decision made while shrunk ran on the survivor mesh (no
+    # dispatch ever targeted the dead ordinal inside its window).
+    for t, kind, ordinals in mgr.events:
+        if kind == "loss":
+            assert ordinals == (3,)
+
+
+def test_elastic_none_is_inert_and_empty_plan_matches():
+    """``elastic=None`` builds nothing; an armed manager with an EMPTY
+    plan serves bit-identically (the gate is pure overhead), and the
+    warm second run compiles nothing."""
+    arrs_none, drv_none, rep_none = _elastic_soak(None, chaos=False,
+                                                  market=False)
+    assert drv_none._elastic is None
+    mgr = ElasticMeshManager()
+    with count_compiles() as counter:
+        arrs_gated, drv_gated, rep_gated = _elastic_soak(
+            mgr, chaos=False, market=False
+        )
+    assert counter.compiles == 0, "the elastic gate must not add compiles"
+    assert mgr.shrinks == 0 and mgr.regrows == 0
+    assert _placements_of(arrs_gated) == _placements_of(arrs_none)
+    assert (
+        rep_gated["slo"]["counters"]["completed"]
+        == rep_none["slo"]["counters"]["completed"]
+    )
+
+
+def test_driver_elastic_needs_factory():
+    s = ServeSession(
+        "s0", build_cluster(ClusterConfig(n_hosts=8, seed=0)),
+        _elastic_policy(), seed=0,
+    )
+    with pytest.raises(ValueError, match="session_factory"):
+        ServeDriver([s], elastic=ElasticConfig())
+
+
+def test_device_fault_plan_windows():
+    """Half-open windows, self-closing faults, explicit restores."""
+    sched = ChaosSchedule(seed=1, events=[
+        ChaosEvent(kind="device_fault", at=2.0, target="device:0",
+                   duration=3.0),
+        ChaosEvent(kind="device_fault", at=10.0, target="device:1"),
+        ChaosEvent(kind="device_restore", at=14.0, target="device:1"),
+    ])
+    plan = DeviceFaultPlan.from_schedule(sched, 4)
+    assert plan.down_at(2.0) == frozenset({0})
+    assert plan.down_at(4.999) == frozenset({0})
+    assert plan.down_at(5.0) == frozenset()
+    assert plan.down_at(12.0) == frozenset({1})
+    assert plan.down_at(14.0) == frozenset()
+    assert plan.hit(11.0, [0, 1]) == frozenset({1})
+    assert [k for _, k, _ in plan.events_in(0.0, 20.0)] == [
+        "device_fault", "device_restore", "device_fault", "device_restore",
+    ]

@@ -315,3 +315,148 @@ def test_fluctuation_tick_on_horizon_does_not_resample(meta):
     assert route.bw != base  # the t=50 tick did resample
     env.run(until=200.0)
     assert route.bw == base  # restored at 100; the on-horizon tick no-oped
+
+
+# --------------------------------------------------------------------------
+# Device-fault events (round 20, elastic mesh serving): loader hardening
+# --------------------------------------------------------------------------
+
+
+def test_device_event_loader_hardening():
+    """Malformed device events fail EAGERLY — at event construction or
+    plan compilation — with messages naming the broken field, never deep
+    inside a serving soak's dispatch gate."""
+    import json
+
+    from pivot_tpu.infra.faults import (
+        ChaosEvent,
+        ChaosSchedule,
+        DeviceFaultPlan,
+        device_ordinal,
+    )
+
+    # Target format: "device:<ordinal>".
+    assert device_ordinal("device:3") == 3
+    with pytest.raises(ValueError, match="device:"):
+        device_ordinal("host-0")
+    with pytest.raises(ValueError, match="ordinal"):
+        device_ordinal("device:banana")
+    with pytest.raises(ValueError, match="ordinal"):
+        device_ordinal("device:-1")
+
+    good = {"kind": "device_fault", "at": 5.0, "target": "device:0",
+            "duration": 10.0}
+
+    def load(events):
+        return ChaosSchedule.loads(json.dumps({
+            "schema": "chaos-schedule", "schema_version": 1,
+            "events": events,
+        }))
+
+    assert len(load([good])) == 1
+    with pytest.raises(ValueError, match="device:"):
+        load([dict(good, target="host-0")])
+    with pytest.raises(ValueError, match="> 0"):
+        load([dict(good, duration=-2.0)])
+    with pytest.raises(ValueError, match="duration"):
+        load([{"kind": "device_restore", "at": 9.0, "target": "device:0",
+               "duration": 5.0}])
+
+    # Plan compilation rejects inconsistent schedules eagerly.
+    def plan(events, n=4):
+        return DeviceFaultPlan.from_schedule(
+            ChaosSchedule([ChaosEvent.from_dict(e) for e in events]), n
+        )
+
+    # Unknown device index (beyond the mesh).
+    with pytest.raises(ValueError, match="unknown device index"):
+        plan([dict(good, target="device:9")])
+    # Restore before any fault.
+    with pytest.raises(ValueError, match="restore"):
+        plan([{"kind": "device_restore", "at": 1.0, "target": "device:0"}])
+    # Overlapping fail windows on one ordinal.
+    with pytest.raises(ValueError, match="overlap"):
+        plan([
+            good,
+            {"kind": "device_fault", "at": 8.0, "target": "device:0",
+             "duration": 10.0},
+        ])
+    # Double-fault without an intervening restore.
+    with pytest.raises(ValueError, match="already down"):
+        plan([
+            {"kind": "device_fault", "at": 1.0, "target": "device:0"},
+            {"kind": "device_fault", "at": 5.0, "target": "device:0"},
+        ])
+
+
+def test_device_events_round_trip_and_injector_log():
+    """Device events serialize/replay like every other chaos source:
+    save/load round-trips them, ``apply_schedule`` delivers them to the
+    injector log and registered device hooks at their sim instants."""
+    from pivot_tpu.infra.faults import ChaosEvent, ChaosSchedule
+
+    sched = ChaosSchedule(seed=3, events=[
+        ChaosEvent(kind="device_fault", at=4.0, target="device:1",
+                   duration=6.0),
+        ChaosEvent(kind="device_fault", at=20.0, target="device:2"),
+        ChaosEvent(kind="device_restore", at=30.0, target="device:2"),
+    ])
+    again = ChaosSchedule.loads(sched.dumps())
+    assert again.diff(sched) == []
+    assert again.counts() == {"device_fault": 2, "device_restore": 1}
+
+    meta2 = ResourceMetadata(seed=0)
+    env = Environment()
+    meter = Meter(env, meta2)
+    zones = meta2.zones
+    hosts = [Host(env, 4, 4096, 10, 0, locality=zones[0], meter=meter)]
+    cluster = Cluster(
+        env, hosts=hosts, storage=[Storage(env, zones[0])], meta=meta2,
+        meter=meter, route_mode="meta", seed=0,
+    )
+    inj = FaultInjector(cluster, seed=0)
+    seen = []
+    inj.add_device_hook(lambda o, kind, t: seen.append((t, o, kind)))
+    inj.apply_schedule(again)
+    env.run(until=100.0)
+    assert seen == [
+        (4.0, 1, "device_fault"),
+        (10.0, 1, "device_restore"),
+        (20.0, 2, "device_fault"),
+        (30.0, 2, "device_restore"),
+    ]
+    dev_log = [(t, tgt, ev) for t, tgt, ev in inj.log
+               if tgt.startswith("device:")]
+    assert [(t, tgt) for t, tgt, _ in dev_log] == [
+        (4.0, "device:1"), (10.0, "device:1"),
+        (20.0, "device:2"), (30.0, "device:2"),
+    ]
+
+
+def test_chaos_replay_diff_covers_device_windows(tmp_path):
+    """``chaos_replay diff`` renders device events BOTH as raw event
+    diffs and as resolved down-window diffs, and its exit code keys on
+    them (the CI determinism step's contract)."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import chaos_replay
+    from pivot_tpu.infra.faults import ChaosEvent, ChaosSchedule
+
+    sched = ChaosSchedule(seed=3, events=[
+        ChaosEvent(kind="device_fault", at=4.0, target="device:1",
+                   duration=6.0),
+    ])
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    sched.save(a)
+    sched.save(b)
+    assert chaos_replay.main(["diff", a, b]) == 0
+    d = sched.to_dict()
+    d["events"][0]["duration"] = 60.0  # the restore moved: window reshapes
+    with open(b, "w") as f:
+        json.dump(d, f)
+    assert chaos_replay.main(["diff", a, b]) == 1

@@ -351,6 +351,117 @@ def test_watchdog_failing_rows_bisect_and_timeout():
     assert wd3.retries_total == 1 and wd3.failures == 0
 
 
+def _carry_fingerprint(carry):
+    return fingerprint_arrays(
+        {
+            "avail": np.asarray(carry.avail),
+            "counts": np.asarray(carry.counts),
+            "live": np.asarray(carry.live),
+        },
+        {"kind": "resident-carry"},
+    )
+
+
+@pytest.mark.parametrize("recovers", [True, False])
+def test_watchdog_timeout_during_pending_splice_is_atomic(recovers):
+    """Watchdog × resident splice: a dispatch timeout firing while
+    ``enable_resident(splice=True)`` has a pending mid-span admission
+    ROLLS THE SPLICE BACK atomically — the splice re-dispatch consumes a
+    clone of the span-entry checkpoint and adopts state only after the
+    prefix verifies, so a timeout leaves the pending carry, the
+    checkpoint, and the staged slot set bit-identical to pre-attempt
+    (pinned via the snapshot-store carry fingerprint).
+
+    ``recovers=True``: the watchdog's bounded retry re-runs the splice
+    and it completes — placements bit-identical to the no-fault resident
+    run.  ``recovers=False``: retries exhaust, the splice declines (the
+    admission waits for the flush boundary, the splice=False contract) —
+    placements STILL bit-identical to the sequential referee."""
+    import tests.test_resident as tr
+    from pivot_tpu.sched.tpu import TpuFirstFitPolicy
+
+    late_at = 33.0  # the _SPLICE_INSTANTS entry that joins a RUNNING span
+    wd = DispatchWatchdog(
+        policy=RetryPolicy(max_retries=1 if recovers else 0, base=0.0),
+    )
+    trace = {"attempts": 0, "fp": [], "staged_s": [], "splices_seen": []}
+
+    def policy_fn():
+        policy = TpuFirstFitPolicy()
+        orig_splice = policy.span_splice
+        orig_dispatch = policy._resident_dispatch
+        in_splice = {"on": False}
+        fail = {"left": 1 if recovers else 2}
+
+        def wedged_dispatch(*a, **k):
+            # The wedge fires INSIDE span_splice — after the checkpoint
+            # clone and operand staging, at the device boundary — the
+            # same instant the serve watchdog abandons a hung worker.
+            if in_splice["on"] and fail["left"] > 0:
+                fail["left"] -= 1
+                raise DispatchTimeout("injected wedged splice dispatch")
+            return orig_dispatch(*a, **k)
+
+        policy._resident_dispatch = wedged_dispatch
+
+        def guarded_splice(ctx, plan, k, new_tasks):
+            rs = policy._resident
+            before = (_carry_fingerprint(rs.carry), rs.staging["S"],
+                      rs.splices)
+
+            def attempt():
+                trace["attempts"] += 1
+                in_splice["on"] = True
+                try:
+                    return orig_splice(ctx, plan, k, new_tasks)
+                finally:
+                    in_splice["on"] = False
+                    # Pin the atomicity contract at every attempt
+                    # boundary: a raised attempt must leave no partial
+                    # splice state behind.
+                    if rs.splices == before[2]:
+                        trace["fp"].append(
+                            (_carry_fingerprint(rs.carry), before[0])
+                        )
+                        trace["staged_s"].append(
+                            (rs.staging["S"], before[1])
+                        )
+
+            try:
+                out = wd.guard(attempt, key="splice")
+            except DispatchFailed:
+                out = None  # decline: the flush boundary serves it
+            trace["splices_seen"].append(rs.splices - before[2])
+            return out
+
+        policy.span_splice = guarded_splice
+        return policy
+
+    plain, _, _ = tr._run_full_sim(
+        tr._DES_POLICIES["first_fit"], fuse=False, late_at=late_at,
+    )
+    res, stats, pol = tr._run_full_sim(
+        policy_fn, fuse=True, resident=True, late_at=late_at,
+    )
+    assert res == plain, "splice-path fault broke placement parity"
+    assert trace["attempts"] == (2 if recovers else 1)
+    # Every failed attempt rolled back: same carry fingerprint, same
+    # staged slot count, no splice counted.
+    assert trace["fp"], "the wedged attempt was never exercised"
+    for got, want in trace["fp"]:
+        assert got == want
+    for got, want in trace["staged_s"]:
+        assert got == want
+    if recovers:
+        assert stats["span_splices"] == 1
+        assert wd.retries_total == 1 and wd.failures == 0
+        assert 1 in trace["splices_seen"]
+    else:
+        assert stats["span_splices"] == 0
+        assert wd.failures == 1
+        assert trace["splices_seen"] == [0]
+
+
 def test_retry_gate_caps_concurrency():
     """The shared gate bounds concurrent retries (peak ≤ cap), sheds
     when saturated, and rejects unpaired releases."""

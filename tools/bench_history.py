@@ -205,6 +205,24 @@ TRACKED: Tuple[Metric, ...] = (
         # fingerprint.
         rel_floor=30.0,
     ),
+    Metric(
+        "serve_elastic_dps",
+        ("serve_elastic", "kill_one_shard", "decisions_per_sec"),
+        lower_better=False, kind="rate",
+        # Round-22 elastic mesh serving: throughput of the KILL arm —
+        # the soak where a seeded fail_device window drops one shard
+        # mid-span and the service shrinks to the survivor rung, keeps
+        # serving, and regrows through the shadow probe.  The headline
+        # is throughput *while surviving*: a collapse here means the
+        # shrink path re-compiles inside the wall, the requeue storm
+        # amplifies, or the gate leaked onto the healthy hot path
+        # (the row's own survived_ok/regrow_ok flags catch outright
+        # functional breakage).  Same threaded-soak load sensitivity
+        # as the other serve rows.  Phase-in: absent from pre-round-22
+        # histories, so the gate notes (not fires) until the baseline
+        # carries rows with it on the gating box's fingerprint.
+        rel_floor=30.0,
+    ),
 )
 
 

@@ -176,11 +176,31 @@ def cmd_diff(args) -> int:
     with open(args.b) as f:
         b = json.load(f)
     if "events" in a and "events" in b:  # two schedules
-        from pivot_tpu.infra.faults import ChaosSchedule
+        from pivot_tpu.infra.faults import (
+            ChaosEvent, ChaosSchedule, DeviceFaultPlan, device_ordinal,
+        )
 
-        delta = ChaosSchedule.from_dict(a).diff(ChaosSchedule.from_dict(b))
+        sa, sb = ChaosSchedule.from_dict(a), ChaosSchedule.from_dict(b)
+        delta = sa.diff(sb)
         for line in delta:
             print(line)
+        # Device events additionally render as resolved DOWN WINDOWS —
+        # the form the elastic serving gate consumes — so a schedule
+        # diff shows not just the raw events but the mesh intervals
+        # they imply (a restore moved by one event reshapes a window).
+        def windows(s):
+            dev = [e for e in s.events if e.kind in ChaosEvent.DEVICE_KINDS]
+            if not dev:
+                return []
+            n = 1 + max(device_ordinal(e.target) for e in dev)
+            return DeviceFaultPlan.from_schedule(s, n).describe()
+
+        wa, wb = set(windows(sa)), set(windows(sb))
+        for w in sorted(wa - wb):
+            print(f"- window {w}")
+        for w in sorted(wb - wa):
+            print(f"+ window {w}")
+        delta += sorted(wa ^ wb)
         print("schedules identical" if not delta else f"{len(delta)} diffs")
         return 0 if not delta else 1
     # Two run reports: field-by-field.

@@ -87,6 +87,15 @@
 #      snapshot + journal replay, must be bit-identical to the
 #      uninterrupted run — plus the recovery=None off-switch pin
 #      (zero recompiles, nothing perturbed).
+#  13. elastic mesh serving (round 22, pivot_tpu/serve/elastic.py):
+#      device-fault plan loader hardening, mesh-shape-ladder
+#      shrink/regrow bit-parity (mid-run reshard == from-scratch
+#      smaller-mesh run, padded non-dividing rungs included, zero
+#      recompiles on warm rungs), the half-open shadow-probe promotion
+#      state machine, the elastic=None off-switch pin, AND the
+#      slow-marked serve referee: a seeded fail_device kills one shard
+#      mid-soak and the driver must shrink, keep serving tier-0
+#      lossless, and regrow through a passing probe.
 #
 # Usage: tools/ci_smoke.sh   (or: make smoke)
 
@@ -98,11 +107,11 @@ SEED_FILE=data/chaos/ci_seed.json
 TMP="$(mktemp -d)"
 trap 'rm -rf "$TMP"' EXIT
 
-echo "== [1/12] quick chaos soak + replay determinism (tier-1 twins) =="
+echo "== [1/13] quick chaos soak + replay determinism (tier-1 twins) =="
 python -m pytest tests/test_chaos.py -q -m 'not slow' \
     -k 'soak_quick or replay_determinism' -p no:cacheprovider
 
-echo "== [2/12] graftcheck static analysis (10 passes) + compile check =="
+echo "== [2/13] graftcheck static analysis (10 passes) + compile check =="
 # Machine-readable findings, annotated per file:line; the 10 s timeout
 # IS the wall-clock budget check for the full static suite.  The
 # capture must not abort under `set -e` before lint_annotate has
@@ -127,7 +136,7 @@ python tools/hotpath_lint.py
 # assert ZERO recompiles in steady state (quick mode).
 python -m pivot_tpu.analysis --compile-check quick
 
-echo "== [3/12] chaos replay determinism on the committed seed =="
+echo "== [3/13] chaos replay determinism on the committed seed =="
 # Schedule generation is a pure function of (topology, seed, params):
 # regenerate and diff against the committed artifact.
 python tools/chaos_replay.py generate --seed 7 --hosts 12 \
@@ -142,7 +151,7 @@ python tools/chaos_replay.py run --schedule "$SEED_FILE" --hosts 12 \
     --seed 7 --out "$TMP/report_b.json"
 python tools/chaos_replay.py diff "$TMP/report_a.json" "$TMP/report_b.json"
 
-echo "== [4/12] sharded-placement parity on a forced 8-device CPU mesh =="
+echo "== [4/13] sharded-placement parity on a forced 8-device CPU mesh =="
 # Small-H quick twins + the H=1024 acceptance + the sharded span driver
 # + the round-17 2-D suite: the [G]-batched replica × host programs
 # (shard_map(vmap(...)) via batch_execute(mesh=...)) vs the sequential
@@ -161,7 +170,7 @@ XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_serve_2d.py -q -m 'not slow' \
     -k 'not 100x' -p no:cacheprovider
 
-echo "== [5/12] spot soak + market replay determinism on the committed seed =="
+echo "== [5/13] spot soak + market replay determinism on the committed seed =="
 MARKET_SEED_FILE=data/market/ci_seed.json
 # The quick acceptance soak (tier-1 twin in tests/test_market.py).
 python -m pytest tests/test_market.py -q -m 'not slow' \
@@ -181,7 +190,7 @@ python tools/market_replay.py run --market "$MARKET_SEED_FILE" --hosts 12 \
     --out "$TMP/spot_b.json"
 python tools/market_replay.py diff "$TMP/spot_a.json" "$TMP/spot_b.json"
 
-echo "== [6/12] observability plane: traced+profiled soak + trace check =="
+echo "== [6/13] observability plane: traced+profiled soak + trace check =="
 # A tiny traced serve soak through the CLI — device policy so the
 # sampled dispatch profiler (--profile-dispatch) has dispatches to
 # bracket; the Perfetto artifact must pass the structural + causal +
@@ -199,7 +208,7 @@ grep -q "pivot_dispatch_latency_seconds" "$TMP/soak.prom"
 python -m pytest tests/test_obs.py -q -m 'not slow' \
     -k 'parity or chain or overhead' -p no:cacheprovider
 
-echo "== [7/12] continuous-bench regression gate (committed baseline) =="
+echo "== [7/13] continuous-bench regression gate (committed baseline) =="
 BASELINE=data/bench/ci_baseline.jsonl
 # The committed baseline history must gate clean against itself...
 python tools/bench_history.py check --history "$BASELINE"
@@ -218,7 +227,7 @@ if [ "$inj_rc" -ne 1 ]; then
     exit 1
 fi
 
-echo "== [8/12] policy search: tiny CEM beats bad init + replays =="
+echo "== [8/13] policy search: tiny CEM beats bad init + replays =="
 # The round-16 learned-scheduler gate: a tiny CEM search (2
 # generations, popsize 4, small cluster) over the COMMITTED seeded
 # config (data/search/ci_seed.json) must strictly beat the
@@ -254,7 +263,7 @@ print(
 )
 PYEOF
 
-echo "== [9/12] ragged continuous batching: repack parity + mixed-horizon soak =="
+echo "== [9/13] ragged continuous batching: repack parity + mixed-horizon soak =="
 # Round 18: mixed-horizon serve spans padded into a shared (K, B)
 # bucket and run as ONE device program.  Quick repack/batcher parity
 # smalls + the tiny mixed-horizon soak vs the per-tick referee, on the
@@ -263,7 +272,7 @@ echo "== [9/12] ragged continuous batching: repack parity + mixed-horizon soak =
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_ragged.py -q -m 'not slow' -p no:cacheprovider
 
-echo "== [10/12] model-predictive serving: replay + parity + off-switch =="
+echo "== [10/13] model-predictive serving: replay + parity + off-switch =="
 # Round 19: the simulator's fitness estimator runs INSIDE the server.
 # Quick deterministic gates only — forecast/render bit-replay, the
 # five-slot planner's clone-parity/bitwise-replay/referee contract,
@@ -275,7 +284,7 @@ python -m pytest tests/test_mpc.py -q -m 'not slow' \
     -k 'determinism or parity or replay or recompiles or dry_run' \
     -p no:cacheprovider
 
-echo "== [11/12] resident-carry serving: parity smalls + tiny splice soak =="
+echo "== [11/13] resident-carry serving: parity smalls + tiny splice soak =="
 # Round 20: device-persistent span state, donated forward span to span.
 # Quick gates only — kernel-level resident vs re-staged bit-parity
 # (every policy config, live masks, the once-staged risk table, edit-row
@@ -287,11 +296,25 @@ echo "== [11/12] resident-carry serving: parity smalls + tiny splice soak =="
 XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
 python -m pytest tests/test_resident.py -q -m 'not slow' -p no:cacheprovider
 
-echo "== [12/12] crash-safe serving: recovery plane + kill-and-resume =="
+echo "== [12/13] crash-safe serving: recovery plane + kill-and-resume =="
 # Round 21: the whole module, INCLUDING the slow-marked driver-level
 # kill-and-resume referee — a crash-recovery gate that only runs in
 # tier 1 would let a resume regression ship in any PR that skips the
 # slow tier, so the smoke lane pays the ~2 s for the real thing.
 python -m pytest tests/test_recovery.py -q -p no:cacheprovider
+
+echo "== [13/13] elastic mesh serving: shrink-reshard parity + kill-mid-span soak =="
+# Round 22: survive device loss mid-span.  The shrink/regrow bit-parity
+# smalls (mid-run reshard == from-scratch smaller-mesh run, including
+# the non-dividing padded rung, zero recompiles on warm rungs), the
+# device-fault plan loader hardening, the manager's half-open probe
+# state machine — plus the slow-marked serve referee itself (a seeded
+# fail_device kills one shard mid-soak; the driver shrinks, keeps
+# serving tier-0 lossless, and regrows through the shadow probe): like
+# step 12's kill-and-resume, a device-loss gate that only runs in
+# tier 1 would let a shrink regression ship in a PR that skips the
+# slow tier, so the lane pays the ~6 s for the real thing.
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+python -m pytest tests/test_elastic.py -q -p no:cacheprovider
 
 echo "smoke lane: all green"
